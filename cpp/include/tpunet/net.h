@@ -1,0 +1,106 @@
+// tpunet — abstract point-to-point DCN transport interface.
+//
+// TPU-native re-design of the reference transport trait
+// (reference: src/interface.rs:34-74 `trait Net`, :3-11 `BaguaNetError`,
+// :13-22 `NCCLNetProperties`, :24-27 `SocketHandle`). Semantics match the
+// reference: device enumeration, listen/connect/accept rendezvous, non-blocking
+// isend/irecv returning request ids, `test()` polling for completion, close.
+// Engines must tolerate >= 8 in-flight requests per comm (reference:
+// cc/nccl_types.h:50 NCCL_NET_MAX_REQUESTS).
+#ifndef TPUNET_NET_H_
+#define TPUNET_NET_H_
+
+#include <netinet/in.h>
+#include <sys/socket.h>
+
+#include <cstddef>
+#include <cstdint>
+#include <memory>
+#include <string>
+
+namespace tpunet {
+
+// Error taxonomy mirrors reference interface.rs:3-11 {IOError, TCPError,
+// InnerError}.
+enum class ErrorKind : int32_t {
+  kOk = 0,
+  kIOError = 1,
+  kTCPError = 2,
+  kInnerError = 3,
+};
+
+struct Status {
+  ErrorKind kind = ErrorKind::kOk;
+  std::string msg;
+
+  bool ok() const { return kind == ErrorKind::kOk; }
+  static Status Ok() { return Status{}; }
+  static Status IO(std::string m) { return Status{ErrorKind::kIOError, std::move(m)}; }
+  static Status TCP(std::string m) { return Status{ErrorKind::kTCPError, std::move(m)}; }
+  static Status Inner(std::string m) { return Status{ErrorKind::kInnerError, std::move(m)}; }
+};
+
+// Reference: interface.rs:13-22 NCCLNetProperties.
+struct NetProperties {
+  std::string name;
+  std::string pci_path;
+  uint64_t guid = 0;
+  int32_t ptr_support = 1;  // host memory only (NCCL_PTR_HOST)
+  int32_t speed_mbps = 10000;
+  int32_t port = 0;
+  int32_t max_comms = 65536;  // reference: nthread_per_socket_backend.rs:100
+};
+
+// Opaque rendezvous handle: a serialized sockaddr, must fit the reference's
+// 64-byte NCCL handle budget (reference: cc/nccl_types.h:44
+// NCCL_NET_HANDLE_MAXSIZE=64, src/lib.rs:121-124 SocketHandleC).
+constexpr size_t kHandleSize = 64;
+struct SocketHandle {
+  sockaddr_storage addr = {};  // only first kHandleSize bytes travel the wire
+  socklen_t addrlen = 0;
+};
+static_assert(sizeof(sockaddr_in6) <= kHandleSize, "handle must fit sockaddr");
+
+// Abstract transport. All ids are process-local opaque tokens. Thread-safety:
+// all methods may be called concurrently from different threads; `accept`
+// blocks until a peer connects.
+class Net {
+ public:
+  virtual ~Net() = default;
+
+  virtual int32_t devices() = 0;
+  virtual Status get_properties(int32_t dev, NetProperties* props) = 0;
+
+  // Bind a listening socket on device `dev`; return the rendezvous handle the
+  // caller ships out-of-band to the sender, plus a listen-comm id for accept().
+  virtual Status listen(int32_t dev, SocketHandle* handle, uint64_t* listen_comm) = 0;
+  // Establish the multi-stream connection bundle to a remote handle
+  // (nstreams data conns + 1 ctrl conn; see wire protocol in basic_engine.cc).
+  virtual Status connect(int32_t dev, const SocketHandle& handle, uint64_t* send_comm) = 0;
+  // Accept one sender's bundle on a listen comm. Blocks.
+  virtual Status accept(uint64_t listen_comm, uint64_t* recv_comm) = 0;
+
+  // Post a send/recv; returns immediately with a request id polled via test().
+  // The caller must keep `data` alive/pinned until test() reports done
+  // (reference contract: src/lib.rs:251,279).
+  virtual Status isend(uint64_t send_comm, const void* data, size_t nbytes, uint64_t* request) = 0;
+  // The posted recv buffer may be larger than the incoming message; the actual
+  // size comes from the ctrl-stream length frame and is reported by test().
+  virtual Status irecv(uint64_t recv_comm, void* data, size_t nbytes, uint64_t* request) = 0;
+  // Poll a request. On done=true the request id is consumed (freed).
+  virtual Status test(uint64_t request, bool* done, size_t* nbytes) = 0;
+
+  virtual Status close_send(uint64_t send_comm) = 0;
+  virtual Status close_recv(uint64_t recv_comm) = 0;
+  virtual Status close_listen(uint64_t listen_comm) = 0;
+};
+
+// Factory. Engine selected by env TPUNET_IMPLEMENT in {"BASIC" (default),
+// "EPOLL"} (reference seam: src/lib.rs:20-29 BAGUA_NET_IMPLEMENT).
+std::unique_ptr<Net> CreateEngine();
+std::unique_ptr<Net> CreateBasicEngine();
+std::unique_ptr<Net> CreateEpollEngine();
+
+}  // namespace tpunet
+
+#endif  // TPUNET_NET_H_

@@ -1,0 +1,79 @@
+// tpunet — OS helpers: NIC discovery, link speed, socket IO, chunk math.
+// Reference behavior being reproduced: src/utils.rs (find_interfaces :32-130,
+// get_net_if_speed :7-23, nonblocking_write_all/read_exact :132-178,
+// chunk_size :200-205, parse_user_pass_and_addr :180-198).
+#ifndef TPUNET_UTILS_H_
+#define TPUNET_UTILS_H_
+
+#include <sys/socket.h>
+
+#include <cstddef>
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "tpunet/net.h"
+
+namespace tpunet {
+
+struct NicInfo {
+  std::string name;
+  sockaddr_storage addr = {};
+  socklen_t addrlen = 0;
+  std::string pci_path;   // resolved from /sys/class/net/<if>/device
+  int32_t speed_mbps = 0; // from /sys/class/net/<if>/speed
+};
+
+// Enumerate non-loopback up interfaces with an IPv4/IPv6 address, dedup by
+// name, honoring:
+//   TPUNET_SOCKET_IFNAME / NCCL_SOCKET_IFNAME — "^a,b" prefix-exclude,
+//     "=a,b" exact-include, "a,b" prefix-include; default exclude "^docker,lo"
+//     (reference: utils.rs:37-49).
+//   TPUNET_SOCKET_FAMILY / NCCL_SOCKET_FAMILY — AF_INET / AF_INET6 restrict
+//     (reference: utils.rs:33-36,100-103).
+std::vector<NicInfo> FindInterfaces();
+
+// Link speed in Mbps from /sys/class/net/<if>/speed; 10000 when unreadable
+// (reference: utils.rs:7-23, default :8).
+int32_t GetNetIfSpeed(const std::string& ifname);
+
+// max(ceil(total/n), min_chunksize) — both peers compute identical chunk
+// boundaries from (len, min_chunksize, nstreams) alone, so the wire carries no
+// per-chunk metadata (reference: utils.rs:200-205).
+size_t ChunkSize(size_t total, size_t min_chunksize, size_t n);
+// Number of chunks a message of `total` bytes splits into (0 for total==0).
+size_t ChunkCount(size_t total, size_t chunksize);
+
+// Blocking write/read of exactly n bytes, retrying on EINTR/partial IO.
+// A read of 0 bytes means EOF -> error (reference: utils.rs:168-171).
+// If `spin` is true the fd is assumed nonblocking and we busy-poll on
+// EWOULDBLOCK with sched_yield (the reference's only mode, utils.rs:132-178);
+// the default blocking mode is our TPU-host-friendly improvement (no 100% CPU
+// burn on a shared trainer host).
+Status WriteAll(int fd, const void* buf, size_t n, bool spin = false);
+Status ReadExact(int fd, void* buf, size_t n, bool spin = false);
+
+// "user:pass@host:port" -> (user, pass, addr); user/pass empty when absent
+// (reference: utils.rs:180-198).
+struct UserPassAddr {
+  std::string user, pass, addr;
+};
+bool ParseUserPassAndAddr(const std::string& s, UserPassAddr* out);
+
+// 8-byte big-endian frame helpers (wire protocol ids + length frames;
+// reference: nthread_per_socket_backend.rs:327,395-397 to_be_bytes).
+void EncodeU64BE(uint64_t v, uint8_t out[8]);
+uint64_t DecodeU64BE(const uint8_t in[8]);
+
+// Env helpers.
+std::string GetEnv(const char* name, const std::string& fallback = "");
+uint64_t GetEnvU64(const char* name, uint64_t fallback);
+
+// Socket helpers.
+Status SetNodelay(int fd);
+Status SetNonblocking(int fd);
+std::string SockaddrToString(const sockaddr_storage& ss, socklen_t len);
+
+}  // namespace tpunet
+
+#endif  // TPUNET_UTILS_H_

@@ -1,0 +1,15 @@
+// tpunet EPOLL engine — the second engine behind the TPUNET_IMPLEMENT seam
+// (reference's analogue: the TOKIO backend, src/implement/tokio_backend.rs).
+// Placeholder for now: falls back to the BASIC engine until the event-loop
+// implementation lands. Unlike the reference's TOKIO engine we will keep the
+// wire protocol identical to BASIC (the reference's two engines were
+// wire-incompatible: 8-byte vs 4-byte length frames, tokio_backend.rs:456)
+// and keep BASIC's fair rotating-cursor chunk assignment (the TOKIO engine
+// always started at stream 0, tokio_backend.rs:392-404 — a fairness bug).
+#include "tpunet/net.h"
+
+namespace tpunet {
+
+std::unique_ptr<Net> CreateEpollEngine() { return CreateBasicEngine(); }
+
+}  // namespace tpunet

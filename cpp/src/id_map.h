@@ -1,0 +1,82 @@
+// Sharded concurrent id->value map. Replaces the reference's single global
+// Arc<Mutex<Box<dyn Net>>> big-lock (reference: src/lib.rs:14-16) which
+// serialized even the hot test() polling path; here each id hashes to one of
+// 16 independently-locked shards.
+#ifndef TPUNET_ID_MAP_H_
+#define TPUNET_ID_MAP_H_
+
+#include <array>
+#include <cstdint>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace tpunet {
+
+template <typename V>
+class IdMap {
+ public:
+  void Put(uint64_t id, V v) {
+    Shard& s = shard(id);
+    std::lock_guard<std::mutex> lk(s.mu);
+    s.m[id] = std::move(v);
+  }
+
+  bool Get(uint64_t id, V* out) const {
+    const Shard& s = shard(id);
+    std::lock_guard<std::mutex> lk(s.mu);
+    auto it = s.m.find(id);
+    if (it == s.m.end()) return false;
+    *out = it->second;
+    return true;
+  }
+
+  bool Take(uint64_t id, V* out) {
+    Shard& s = shard(id);
+    std::lock_guard<std::mutex> lk(s.mu);
+    auto it = s.m.find(id);
+    if (it == s.m.end()) return false;
+    *out = std::move(it->second);
+    s.m.erase(it);
+    return true;
+  }
+
+  bool Erase(uint64_t id) {
+    Shard& s = shard(id);
+    std::lock_guard<std::mutex> lk(s.mu);
+    return s.m.erase(id) > 0;
+  }
+
+  std::vector<V> DrainAll() {
+    std::vector<V> out;
+    for (Shard& s : shards_) {
+      std::lock_guard<std::mutex> lk(s.mu);
+      for (auto& kv : s.m) out.push_back(std::move(kv.second));
+      s.m.clear();
+    }
+    return out;
+  }
+
+  size_t Size() const {
+    size_t n = 0;
+    for (const Shard& s : shards_) {
+      std::lock_guard<std::mutex> lk(s.mu);
+      n += s.m.size();
+    }
+    return n;
+  }
+
+ private:
+  static constexpr size_t kShards = 16;
+  struct Shard {
+    mutable std::mutex mu;
+    std::unordered_map<uint64_t, V> m;
+  };
+  Shard& shard(uint64_t id) { return shards_[id % kShards]; }
+  const Shard& shard(uint64_t id) const { return shards_[id % kShards]; }
+  std::array<Shard, kShards> shards_;
+};
+
+}  // namespace tpunet
+
+#endif  // TPUNET_ID_MAP_H_

@@ -1,0 +1,282 @@
+#include "tpunet/utils.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <ifaddrs.h>
+#include <limits.h>
+#include <net/if.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sched.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <fstream>
+#include <set>
+#include <sstream>
+
+namespace tpunet {
+
+std::string GetEnv(const char* name, const std::string& fallback) {
+  const char* v = getenv(name);
+  return v ? std::string(v) : fallback;
+}
+
+uint64_t GetEnvU64(const char* name, uint64_t fallback) {
+  const char* v = getenv(name);
+  if (!v || !*v) return fallback;
+  // strtoull silently wraps negatives ("-1" -> 2^64-1) — reject them, and
+  // reject overflow, rather than exploding a stream count.
+  const char* p = v;
+  while (*p == ' ' || *p == '\t') ++p;
+  if (*p == '-') return fallback;
+  errno = 0;
+  char* end = nullptr;
+  unsigned long long parsed = strtoull(v, &end, 10);
+  if (end == v || (end && *end != '\0') || errno == ERANGE) return fallback;
+  return static_cast<uint64_t>(parsed);
+}
+
+int32_t GetNetIfSpeed(const std::string& ifname) {
+  // Reference: utils.rs:7-23 — read /sys/class/net/<if>/speed, default 10000.
+  std::ifstream f("/sys/class/net/" + ifname + "/speed");
+  long speed = 0;
+  if (f && (f >> speed) && speed > 0 && speed <= INT32_MAX) {
+    return static_cast<int32_t>(speed);
+  }
+  return 10000;
+}
+
+static std::string ResolvePciPath(const std::string& ifname) {
+  // Reference: utils.rs:73-77 — realpath of /sys/class/net/<if>/device.
+  std::string link = "/sys/class/net/" + ifname + "/device";
+  char buf[PATH_MAX];
+  if (realpath(link.c_str(), buf) != nullptr) return std::string(buf);
+  return "";
+}
+
+namespace {
+
+struct IfnameFilter {
+  bool exclude = false;   // "^" prefix
+  bool exact = false;     // "=" prefix
+  std::vector<std::string> names;
+
+  // Parse "NCCL_SOCKET_IFNAME"-style spec (reference: utils.rs:37-49).
+  static IfnameFilter Parse(std::string spec) {
+    IfnameFilter f;
+    if (!spec.empty() && spec[0] == '^') {
+      f.exclude = true;
+      spec = spec.substr(1);
+    } else if (!spec.empty() && spec[0] == '=') {
+      f.exact = true;
+      spec = spec.substr(1);
+    }
+    std::stringstream ss(spec);
+    std::string item;
+    while (std::getline(ss, item, ',')) {
+      if (!item.empty()) f.names.push_back(item);
+    }
+    return f;
+  }
+
+  bool Admits(const std::string& ifname) const {
+    if (names.empty()) return true;
+    bool matched = false;
+    for (const auto& n : names) {
+      if (exact ? (ifname == n) : (ifname.rfind(n, 0) == 0)) {
+        matched = true;
+        break;
+      }
+    }
+    return exclude ? !matched : matched;
+  }
+};
+
+}  // namespace
+
+std::vector<NicInfo> FindInterfaces() {
+  // Reference behavior: utils.rs:32-130. Default filter excludes docker*/lo*.
+  std::string spec = GetEnv("TPUNET_SOCKET_IFNAME", GetEnv("NCCL_SOCKET_IFNAME", "^docker,lo"));
+  IfnameFilter filter = IfnameFilter::Parse(spec);
+
+  std::string family = GetEnv("TPUNET_SOCKET_FAMILY", GetEnv("NCCL_SOCKET_FAMILY", ""));
+  bool want_v4 = family != "AF_INET6";
+  bool want_v6 = family != "AF_INET";
+
+  std::vector<NicInfo> out;
+  std::set<std::string> seen;  // dedup by name, first address wins
+
+  struct ifaddrs* ifs = nullptr;
+  if (getifaddrs(&ifs) != 0) return out;
+  for (struct ifaddrs* it = ifs; it != nullptr; it = it->ifa_next) {
+    if (!it->ifa_addr || !it->ifa_name) continue;
+    int af = it->ifa_addr->sa_family;
+    if (af != AF_INET && af != AF_INET6) continue;
+    if (af == AF_INET && !want_v4) continue;
+    if (af == AF_INET6 && !want_v6) continue;
+    if (!(it->ifa_flags & IFF_UP)) continue;
+    std::string name(it->ifa_name);
+    if (!filter.Admits(name)) continue;
+    // Skip link-local IPv6 (not routable without scope plumbing).
+    if (af == AF_INET6) {
+      auto* sin6 = reinterpret_cast<sockaddr_in6*>(it->ifa_addr);
+      if (IN6_IS_ADDR_LINKLOCAL(&sin6->sin6_addr)) continue;
+    }
+    if (!seen.insert(name).second) continue;
+
+    NicInfo nic;
+    nic.name = name;
+    socklen_t len = (af == AF_INET) ? sizeof(sockaddr_in) : sizeof(sockaddr_in6);
+    memcpy(&nic.addr, it->ifa_addr, len);
+    nic.addrlen = len;
+    nic.pci_path = ResolvePciPath(name);
+    nic.speed_mbps = GetNetIfSpeed(name);
+    out.push_back(std::move(nic));
+  }
+  freeifaddrs(ifs);
+
+  // Fall back to loopback when the filter admits nothing — a TPU-VM CI host
+  // may only have lo; the reference would return an empty device list and
+  // NCCL would fail, we prefer degraded-but-working.
+  if (out.empty()) {
+    NicInfo lo;
+    lo.name = "lo";
+    auto* sin = reinterpret_cast<sockaddr_in*>(&lo.addr);
+    sin->sin_family = AF_INET;
+    sin->sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    sin->sin_port = 0;
+    lo.addrlen = sizeof(sockaddr_in);
+    lo.speed_mbps = GetNetIfSpeed("lo");
+    out.push_back(std::move(lo));
+  }
+  return out;
+}
+
+size_t ChunkSize(size_t total, size_t min_chunksize, size_t n) {
+  // Reference: utils.rs:200-205 — max(ceil(total/n), min_chunksize).
+  if (n == 0) n = 1;
+  size_t per = (total + n - 1) / n;
+  return std::max(per, min_chunksize);
+}
+
+size_t ChunkCount(size_t total, size_t chunksize) {
+  if (total == 0) return 0;
+  return (total + chunksize - 1) / chunksize;
+}
+
+Status WriteAll(int fd, const void* buf, size_t n, bool spin) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  size_t left = n;
+  while (left > 0) {
+    ssize_t w = ::send(fd, p, left, MSG_NOSIGNAL);
+    if (w > 0) {
+      p += w;
+      left -= static_cast<size_t>(w);
+      continue;
+    }
+    if (w < 0 && (errno == EINTR)) continue;
+    if (w < 0 && spin && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      sched_yield();  // reference busy-poll: utils.rs:140-144
+      continue;
+    }
+    return Status::IO("write failed: " + std::string(strerror(errno)));
+  }
+  return Status::Ok();
+}
+
+Status ReadExact(int fd, void* buf, size_t n, bool spin) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  size_t left = n;
+  while (left > 0) {
+    ssize_t r = ::recv(fd, p, left, 0);
+    if (r > 0) {
+      p += r;
+      left -= static_cast<size_t>(r);
+      continue;
+    }
+    if (r == 0) {
+      // EOF mid-frame (reference: utils.rs:168-171 UnexpectedEof).
+      return Status::IO("unexpected EOF: peer closed connection");
+    }
+    if (errno == EINTR) continue;
+    if (spin && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      sched_yield();
+      continue;
+    }
+    return Status::IO("read failed: " + std::string(strerror(errno)));
+  }
+  return Status::Ok();
+}
+
+bool ParseUserPassAndAddr(const std::string& s, UserPassAddr* out) {
+  // Reference: utils.rs:180-198 regex ^((user):(pass)@)?addr$.
+  out->user.clear();
+  out->pass.clear();
+  out->addr.clear();
+  size_t at = s.rfind('@');
+  if (at == std::string::npos) {
+    if (s.empty()) return false;
+    out->addr = s;
+    return true;
+  }
+  std::string cred = s.substr(0, at);
+  out->addr = s.substr(at + 1);
+  size_t colon = cred.find(':');
+  if (colon == std::string::npos || out->addr.empty()) return false;
+  out->user = cred.substr(0, colon);
+  out->pass = cred.substr(colon + 1);
+  return !out->user.empty();
+}
+
+void EncodeU64BE(uint64_t v, uint8_t out[8]) {
+  for (int i = 7; i >= 0; --i) {
+    out[i] = static_cast<uint8_t>(v & 0xff);
+    v >>= 8;
+  }
+}
+
+uint64_t DecodeU64BE(const uint8_t in[8]) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; ++i) v = (v << 8) | in[i];
+  return v;
+}
+
+Status SetNodelay(int fd) {
+  int one = 1;
+  if (setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one)) != 0) {
+    return Status::TCP("TCP_NODELAY failed: " + std::string(strerror(errno)));
+  }
+  return Status::Ok();
+}
+
+Status SetNonblocking(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  if (flags < 0 || fcntl(fd, F_SETFL, flags | O_NONBLOCK) != 0) {
+    return Status::TCP("O_NONBLOCK failed: " + std::string(strerror(errno)));
+  }
+  return Status::Ok();
+}
+
+std::string SockaddrToString(const sockaddr_storage& ss, socklen_t len) {
+  char host[INET6_ADDRSTRLEN] = {0};
+  uint16_t port = 0;
+  if (ss.ss_family == AF_INET && len >= sizeof(sockaddr_in)) {
+    auto* sin = reinterpret_cast<const sockaddr_in*>(&ss);
+    inet_ntop(AF_INET, &sin->sin_addr, host, sizeof(host));
+    port = ntohs(sin->sin_port);
+  } else if (ss.ss_family == AF_INET6 && len >= sizeof(sockaddr_in6)) {
+    auto* sin6 = reinterpret_cast<const sockaddr_in6*>(&ss);
+    inet_ntop(AF_INET6, &sin6->sin6_addr, host, sizeof(host));
+    port = ntohs(sin6->sin6_port);
+  } else {
+    return "<unknown af " + std::to_string(ss.ss_family) + ">";
+  }
+  return std::string(host) + ":" + std::to_string(port);
+}
+
+}  // namespace tpunet
