@@ -1,0 +1,88 @@
+/* tpunet stable C ABI.
+ *
+ * Mirror of the reference's 13 extern "C" functions (reference:
+ * src/lib.rs:19-392 bagua_net_c_* and cc/bagua_net.h:37-111), renamed
+ * tpunet_c_*, with the reference's quirks fixed:
+ *   - no global big-lock serializing every call (reference lib.rs:14-16);
+ *   - request ids are freed when test() reports done (reference leaked one
+ *     8-byte heap id per request, cc/bagua_net.cc:111-121);
+ *   - property strings are owned by the instance and freed with the same
+ *     allocator that made them (reference mixed Rust CString with C++
+ *     delete, cc/bagua_net.cc:15-21);
+ *   - multiple instances allowed (reference: one global singleton);
+ *   - tpunet_c_last_error() exposes the failure detail per thread.
+ *
+ * Error codes (reference doc comments lib.rs:61-63,131-135,290-294):
+ *   0 success, -1 null pointer, -2 invalid argument, -3 inner error.
+ * Buffer lifetime contract: data passed to isend/irecv must stay alive and
+ * unmoved until test() reports the request done (reference lib.rs:251,279).
+ */
+#ifndef TPUNET_C_API_H_
+#define TPUNET_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define TPUNET_OK 0
+#define TPUNET_ERR_NULL -1
+#define TPUNET_ERR_INVALID -2
+#define TPUNET_ERR_INNER -3
+
+/* 64-byte opaque rendezvous blob: the serialized listen sockaddr, sized to
+ * NCCL's handle budget (reference: cc/nccl_types.h:44). Ship it to the
+ * connecting side out-of-band (bootstrap). */
+typedef struct tpunet_socket_handle {
+  uint8_t data[64];
+} tpunet_socket_handle_t;
+
+/* Reference: NCCLNetPropertiesC (lib.rs:41-55). Strings are owned by the
+ * instance and live until tpunet_c_destroy. */
+typedef struct tpunet_net_properties {
+  const char* name;
+  const char* pci_path;
+  uint64_t guid;
+  int32_t ptr_support; /* 1 = host memory */
+  int32_t speed_mbps;
+  int32_t port;
+  int32_t max_comms;
+} tpunet_net_properties_t;
+
+/* Engine selected by env TPUNET_IMPLEMENT in {BASIC (default), EPOLL}. */
+int32_t tpunet_c_create(uintptr_t* out_instance);
+int32_t tpunet_c_destroy(uintptr_t* instance);
+
+int32_t tpunet_c_devices(uintptr_t instance, int32_t* ndev);
+int32_t tpunet_c_get_properties(uintptr_t instance, int32_t dev,
+                                tpunet_net_properties_t* props);
+
+int32_t tpunet_c_listen(uintptr_t instance, int32_t dev,
+                        tpunet_socket_handle_t* handle, uintptr_t* listen_comm);
+int32_t tpunet_c_connect(uintptr_t instance, int32_t dev,
+                         const tpunet_socket_handle_t* handle, uintptr_t* send_comm);
+int32_t tpunet_c_accept(uintptr_t instance, uintptr_t listen_comm,
+                        uintptr_t* recv_comm);
+
+int32_t tpunet_c_isend(uintptr_t instance, uintptr_t send_comm, const void* data,
+                       uint64_t nbytes, uintptr_t* request);
+int32_t tpunet_c_irecv(uintptr_t instance, uintptr_t recv_comm, void* data,
+                       uint64_t nbytes, uintptr_t* request);
+/* done: 0/1 out-flag; nbytes: actual message size once done (may be smaller
+ * than the posted recv buffer). On done the request id is consumed. */
+int32_t tpunet_c_test(uintptr_t instance, uintptr_t request, uint8_t* done,
+                      uint64_t* nbytes);
+
+int32_t tpunet_c_close_send(uintptr_t instance, uintptr_t send_comm);
+int32_t tpunet_c_close_recv(uintptr_t instance, uintptr_t recv_comm);
+int32_t tpunet_c_close_listen(uintptr_t instance, uintptr_t listen_comm);
+
+/* Thread-local message for the last TPUNET_ERR_* returned on this thread. */
+const char* tpunet_c_last_error(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUNET_C_API_H_ */

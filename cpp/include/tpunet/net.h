@@ -21,12 +21,14 @@
 namespace tpunet {
 
 // Error taxonomy mirrors reference interface.rs:3-11 {IOError, TCPError,
-// InnerError}.
+// InnerError}, plus kInvalidArgument so programmer errors (stale/unknown ids,
+// bad device index) are distinguishable from transport failures at the ABI.
 enum class ErrorKind : int32_t {
   kOk = 0,
   kIOError = 1,
   kTCPError = 2,
   kInnerError = 3,
+  kInvalidArgument = 4,
 };
 
 struct Status {
@@ -38,6 +40,7 @@ struct Status {
   static Status IO(std::string m) { return Status{ErrorKind::kIOError, std::move(m)}; }
   static Status TCP(std::string m) { return Status{ErrorKind::kTCPError, std::move(m)}; }
   static Status Inner(std::string m) { return Status{ErrorKind::kInnerError, std::move(m)}; }
+  static Status Invalid(std::string m) { return Status{ErrorKind::kInvalidArgument, std::move(m)}; }
 };
 
 // Reference: interface.rs:13-22 NCCLNetProperties.

@@ -53,6 +53,12 @@ size_t ChunkCount(size_t total, size_t chunksize);
 Status WriteAll(int fd, const void* buf, size_t n, bool spin = false);
 Status ReadExact(int fd, void* buf, size_t n, bool spin = false);
 
+// Read exactly n bytes with a hard wall-clock deadline over the WHOLE read
+// (poll + MSG_DONTWAIT recv) — unlike SO_RCVTIMEO, which restarts on every
+// byte and lets a slow-loris client stretch a 40-byte read to 40x the
+// timeout. Returns IOError on timeout or EOF.
+Status ReadExactDeadline(int fd, void* buf, size_t n, int timeout_ms);
+
 // "user:pass@host:port" -> (user, pass, addr); user/pass empty when absent
 // (reference: utils.rs:180-198).
 struct UserPassAddr {

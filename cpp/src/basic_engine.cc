@@ -45,6 +45,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <deque>
 #include <map>
@@ -217,6 +218,7 @@ struct PartialBundle {
   uint64_t nstreams = UINT64_MAX;
   uint64_t min_chunksize = 0;
   int ctrl_fd = -1;
+  std::chrono::steady_clock::time_point first_seen;
   std::map<uint64_t, int> data_fds;  // stream_id -> fd (ordered)
   bool Complete() const {
     return ctrl_fd >= 0 && nstreams != UINT64_MAX && data_fds.size() == nstreams;
@@ -374,9 +376,11 @@ Status WritePreamble(int fd, const Preamble& p) {
   return WriteAll(fd, buf, sizeof(buf));
 }
 
-Status ReadPreamble(int fd, Preamble* p) {
+Status ReadPreamble(int fd, Preamble* p, int timeout_ms) {
   uint8_t buf[40];
-  Status s = ReadExact(fd, buf, sizeof(buf));
+  // Hard deadline over the whole 40 bytes — a slow-loris client trickling
+  // one byte per interval cannot stretch this past timeout_ms.
+  Status s = ReadExactDeadline(fd, buf, sizeof(buf), timeout_ms);
   if (!s.ok()) return s;
   if (DecodeU64BE(buf) != kWireMagic) {
     return Status::TCP("bad wire magic — peer is not tpunet or version mismatch");
@@ -420,14 +424,22 @@ class BasicEngine : public Net {
   ~BasicEngine() override {
     for (auto& c : send_comms_.DrainAll()) c->Shutdown();
     for (auto& c : recv_comms_.DrainAll()) c->Shutdown();
-    listen_comms_.DrainAll();
+    // Wake any thread still parked in accept() — mirror of close_listen;
+    // without this, destroying the engine would strand it forever.
+    for (auto& lc : listen_comms_.DrainAll()) {
+      lc->closed.store(true, std::memory_order_release);
+      if (lc->wake_fd >= 0) {
+        uint64_t one = 1;
+        (void)!::write(lc->wake_fd, &one, sizeof(one));
+      }
+    }
   }
 
   int32_t devices() override { return static_cast<int32_t>(nics_.size()); }
 
   Status get_properties(int32_t dev, NetProperties* props) override {
     if (dev < 0 || dev >= static_cast<int32_t>(nics_.size())) {
-      return Status::Inner("bad device index " + std::to_string(dev));
+      return Status::Invalid("bad device index " + std::to_string(dev));
     }
     const NicInfo& nic = nics_[dev];
     props->name = nic.name;
@@ -442,7 +454,7 @@ class BasicEngine : public Net {
 
   Status listen(int32_t dev, SocketHandle* handle, uint64_t* listen_comm) override {
     if (dev < 0 || dev >= static_cast<int32_t>(nics_.size())) {
-      return Status::Inner("bad device index " + std::to_string(dev));
+      return Status::Invalid("bad device index " + std::to_string(dev));
     }
     const NicInfo& nic = nics_[dev];
     int fd = -1;
@@ -487,7 +499,7 @@ class BasicEngine : public Net {
 
   Status connect(int32_t dev, const SocketHandle& handle, uint64_t* send_comm) override {
     if (dev < 0 || dev >= static_cast<int32_t>(nics_.size())) {
-      return Status::Inner("bad device index " + std::to_string(dev));
+      return Status::Invalid("bad device index " + std::to_string(dev));
     }
     auto comm = std::make_shared<Comm>();
     comm->is_send = true;
@@ -531,13 +543,26 @@ class BasicEngine : public Net {
   Status accept(uint64_t listen_comm, uint64_t* recv_comm) override {
     ListenPtr lc;
     if (!listen_comms_.Get(listen_comm, &lc)) {
-      return Status::Inner("unknown listen comm " + std::to_string(listen_comm));
+      return Status::Invalid("unknown listen comm " + std::to_string(listen_comm));
     }
     // Accept connections, grouping by bundle id, until one bundle is whole
     // (reference accepts exactly nstreams+1 and keys by raw id,
     // nthread:425-522; bundles make concurrent senders safe).
     std::lock_guard<std::mutex> accept_lk(lc->mu);
+    uint64_t expiry_ms = 2 * GetEnvU64("TPUNET_HANDSHAKE_TIMEOUT_MS", 10000);
     while (true) {
+      // Expire half-arrived bundles from dead senders so their parked fds
+      // don't accumulate toward RLIMIT_NOFILE on a long-lived listen comm.
+      auto now = std::chrono::steady_clock::now();
+      for (auto it = lc->partials.begin(); it != lc->partials.end();) {
+        if (!it->second.Complete() &&
+            now - it->second.first_seen > std::chrono::milliseconds(expiry_ms)) {
+          it->second.CloseAll();
+          it = lc->partials.erase(it);
+        } else {
+          ++it;
+        }
+      }
       for (auto it = lc->partials.begin(); it != lc->partials.end(); ++it) {
         if (it->second.Complete()) {
           PartialBundle b = std::move(it->second);
@@ -547,12 +572,14 @@ class BasicEngine : public Net {
       }
       // poll so close_listen can abort us via the eventfd (a blocked
       // ::accept is not reliably interruptible by shutdown() on Linux).
+      // Finite timeout so the expiry sweep above runs even with no events.
       struct pollfd pfds[2] = {{lc->fd, POLLIN, 0}, {lc->wake_fd, POLLIN, 0}};
-      int pr = ::poll(pfds, 2, -1);
+      int pr = ::poll(pfds, 2, 1000);
       if (pr < 0) {
         if (errno == EINTR) continue;
         return Status::TCP("poll failed: " + std::string(strerror(errno)));
       }
+      if (pr == 0) continue;  // timeout tick: re-run expiry sweep
       if (lc->closed.load(std::memory_order_acquire) || (pfds[1].revents & POLLIN)) {
         return Status::Inner("listen comm closed while accepting");
       }
@@ -573,24 +600,18 @@ class BasicEngine : public Net {
       // the 40-byte handshake (scanner, stalled peer) must not wedge accept()
       // while it holds lc->mu. Malformed/timed-out clients are dropped and
       // accept keeps serving legitimate peers.
-      struct timeval tv;
       uint64_t handshake_ms = GetEnvU64("TPUNET_HANDSHAKE_TIMEOUT_MS", 10000);
-      tv.tv_sec = handshake_ms / 1000;
-      tv.tv_usec = (handshake_ms % 1000) * 1000;
-      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
       Preamble p;
-      s = ReadPreamble(fd, &p);
+      s = ReadPreamble(fd, &p, static_cast<int>(handshake_ms));
       if (!s.ok()) {
         ::close(fd);
         continue;
       }
-      tv.tv_sec = 0;
-      tv.tv_usec = 0;
-      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));  // back to no timeout
       PartialBundle& b = lc->partials[p.bundle_id];
       if (b.nstreams == UINT64_MAX) {
         b.nstreams = p.nstreams;
         b.min_chunksize = p.min_chunksize;
+        b.first_seen = std::chrono::steady_clock::now();
       } else if (b.nstreams != p.nstreams || b.min_chunksize != p.min_chunksize) {
         ::close(fd);  // inconsistent members: drop the whole bundle
         b.CloseAll();
@@ -613,7 +634,7 @@ class BasicEngine : public Net {
   Status isend(uint64_t send_comm, const void* data, size_t nbytes, uint64_t* request) override {
     CommPtr c;
     if (!send_comms_.Get(send_comm, &c)) {
-      return Status::Inner("unknown send comm " + std::to_string(send_comm));
+      return Status::Invalid("unknown send comm " + std::to_string(send_comm));
     }
     auto state = std::make_shared<RequestState>();
     uint64_t id = next_id_.fetch_add(1);
@@ -626,7 +647,7 @@ class BasicEngine : public Net {
   Status irecv(uint64_t recv_comm, void* data, size_t nbytes, uint64_t* request) override {
     CommPtr c;
     if (!recv_comms_.Get(recv_comm, &c)) {
-      return Status::Inner("unknown recv comm " + std::to_string(recv_comm));
+      return Status::Invalid("unknown recv comm " + std::to_string(recv_comm));
     }
     auto state = std::make_shared<RequestState>();
     uint64_t id = next_id_.fetch_add(1);
@@ -639,7 +660,7 @@ class BasicEngine : public Net {
   Status test(uint64_t request, bool* done, size_t* nbytes) override {
     RequestPtr state;
     if (!requests_.Get(request, &state)) {
-      return Status::Inner("unknown request " + std::to_string(request));
+      return Status::Invalid("unknown request " + std::to_string(request));
     }
     if (state->failed.load(std::memory_order_acquire)) {
       // Surface the error only once all dispatched chunk workers have
@@ -663,7 +684,7 @@ class BasicEngine : public Net {
   Status close_send(uint64_t send_comm) override {
     CommPtr c;
     if (!send_comms_.Take(send_comm, &c)) {
-      return Status::Inner("unknown send comm " + std::to_string(send_comm));
+      return Status::Invalid("unknown send comm " + std::to_string(send_comm));
     }
     c->Shutdown();
     return Status::Ok();
@@ -672,7 +693,7 @@ class BasicEngine : public Net {
   Status close_recv(uint64_t recv_comm) override {
     CommPtr c;
     if (!recv_comms_.Take(recv_comm, &c)) {
-      return Status::Inner("unknown recv comm " + std::to_string(recv_comm));
+      return Status::Invalid("unknown recv comm " + std::to_string(recv_comm));
     }
     c->Shutdown();
     return Status::Ok();
@@ -681,7 +702,7 @@ class BasicEngine : public Net {
   Status close_listen(uint64_t listen_comm) override {
     ListenPtr lc;
     if (!listen_comms_.Take(listen_comm, &lc)) {
-      return Status::Inner("unknown listen comm " + std::to_string(listen_comm));
+      return Status::Invalid("unknown listen comm " + std::to_string(listen_comm));
     }
     // Wake any thread parked in accept(); it returns "listen comm closed".
     lc->closed.store(true, std::memory_order_release);
@@ -764,6 +785,7 @@ class BasicEngine : public Net {
     comm->spin = spin_;
     comm->ctrl_fd = b.ctrl_fd;
     b.ctrl_fd = -1;
+    if (spin_) SetNonblocking(comm->ctrl_fd);  // ctrl carries the latency-critical length frame
     // Data streams ordered by stream id (reference: BTreeMap nthread:432).
     for (auto& kv : b.data_fds) {
       auto w = std::make_unique<StreamWorker>();

@@ -1,0 +1,219 @@
+// tpunet C ABI implementation. See c_api.h for the contract and the list of
+// reference quirks deliberately fixed here (reference: src/lib.rs:19-392).
+#include "tpunet/c_api.h"
+
+#include <string.h>
+
+#include <algorithm>
+#include <atomic>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <vector>
+
+#include "id_map.h"
+#include "tpunet/net.h"
+
+namespace {
+
+using tpunet::Net;
+using tpunet::NetProperties;
+using tpunet::SocketHandle;
+using tpunet::Status;
+
+thread_local std::string g_last_error;
+
+int32_t Fail(int32_t code, const std::string& msg) {
+  g_last_error = msg;
+  return code;
+}
+
+int32_t FromStatus(const Status& s) {
+  if (s.ok()) return TPUNET_OK;
+  if (s.kind == tpunet::ErrorKind::kInvalidArgument) {
+    return Fail(TPUNET_ERR_INVALID, s.msg);
+  }
+  return Fail(TPUNET_ERR_INNER, s.msg);
+}
+
+// An instance: the engine plus a property cache that owns the name/pci_path
+// strings handed out through the ABI (reference kept a similar cache but
+// freed Rust-allocated strings with C++ delete, cc/bagua_net.cc:8-31; here
+// one allocator owns everything).
+struct Instance {
+  std::unique_ptr<Net> net;
+  std::mutex props_mu;
+  // One cached entry per device, reused across calls — properties are static
+  // per NIC, and reusing bounds the cache (a poll-properties loop must not
+  // grow memory for the instance lifetime).
+  std::map<int32_t, std::unique_ptr<NetProperties>> props_cache;
+};
+
+tpunet::IdMap<std::shared_ptr<Instance>> g_instances;
+std::atomic<uint64_t> g_next_instance_id{1};
+
+std::shared_ptr<Instance> GetInstance(uintptr_t id) {
+  std::shared_ptr<Instance> inst;
+  g_instances.Get(id, &inst);
+  return inst;
+}
+
+}  // namespace
+
+extern "C" {
+
+int32_t tpunet_c_create(uintptr_t* out_instance) {
+  if (!out_instance) return Fail(TPUNET_ERR_NULL, "out_instance is null");
+  auto inst = std::make_shared<Instance>();
+  inst->net = tpunet::CreateEngine();
+  if (!inst->net) return Fail(TPUNET_ERR_INNER, "engine creation failed");
+  uint64_t id = g_next_instance_id.fetch_add(1);
+  g_instances.Put(id, inst);
+  *out_instance = id;
+  return TPUNET_OK;
+}
+
+int32_t tpunet_c_destroy(uintptr_t* instance) {
+  if (!instance) return Fail(TPUNET_ERR_NULL, "instance is null");
+  std::shared_ptr<Instance> inst;
+  if (!g_instances.Take(*instance, &inst)) {
+    return Fail(TPUNET_ERR_INVALID, "unknown instance");
+  }
+  *instance = 0;
+  return TPUNET_OK;
+}
+
+int32_t tpunet_c_devices(uintptr_t instance, int32_t* ndev) {
+  if (!ndev) return Fail(TPUNET_ERR_NULL, "ndev is null");
+  auto inst = GetInstance(instance);
+  if (!inst) return Fail(TPUNET_ERR_INVALID, "unknown instance");
+  *ndev = inst->net->devices();
+  return TPUNET_OK;
+}
+
+int32_t tpunet_c_get_properties(uintptr_t instance, int32_t dev,
+                                tpunet_net_properties_t* props) {
+  if (!props) return Fail(TPUNET_ERR_NULL, "props is null");
+  auto inst = GetInstance(instance);
+  if (!inst) return Fail(TPUNET_ERR_INVALID, "unknown instance");
+  std::lock_guard<std::mutex> lk(inst->props_mu);
+  auto it = inst->props_cache.find(dev);
+  if (it == inst->props_cache.end()) {
+    auto p = std::make_unique<NetProperties>();
+    Status s = inst->net->get_properties(dev, p.get());
+    if (!s.ok()) return FromStatus(s);
+    it = inst->props_cache.emplace(dev, std::move(p)).first;
+  }
+  const NetProperties& p = *it->second;  // strings live until destroy
+  props->name = p.name.c_str();
+  props->pci_path = p.pci_path.c_str();
+  props->guid = p.guid;
+  props->ptr_support = p.ptr_support;
+  props->speed_mbps = p.speed_mbps;
+  props->port = p.port;
+  props->max_comms = p.max_comms;
+  return TPUNET_OK;
+}
+
+int32_t tpunet_c_listen(uintptr_t instance, int32_t dev,
+                        tpunet_socket_handle_t* handle, uintptr_t* listen_comm) {
+  if (!handle || !listen_comm) return Fail(TPUNET_ERR_NULL, "null out param");
+  auto inst = GetInstance(instance);
+  if (!inst) return Fail(TPUNET_ERR_INVALID, "unknown instance");
+  SocketHandle h;
+  uint64_t id = 0;
+  Status s = inst->net->listen(dev, &h, &id);
+  if (!s.ok()) return FromStatus(s);
+  // Marshal: only the sockaddr bytes travel; length is derived from the
+  // family on the far side (see basic_engine.cc AddrLenForFamily).
+  memset(handle->data, 0, sizeof(handle->data));
+  memcpy(handle->data, &h.addr, std::min(sizeof(handle->data), sizeof(h.addr)));
+  *listen_comm = id;
+  return TPUNET_OK;
+}
+
+int32_t tpunet_c_connect(uintptr_t instance, int32_t dev,
+                         const tpunet_socket_handle_t* handle, uintptr_t* send_comm) {
+  if (!handle || !send_comm) return Fail(TPUNET_ERR_NULL, "null param");
+  auto inst = GetInstance(instance);
+  if (!inst) return Fail(TPUNET_ERR_INVALID, "unknown instance");
+  SocketHandle h;
+  memcpy(&h.addr, handle->data, sizeof(handle->data));
+  h.addrlen = 0;  // derived from family by the engine
+  uint64_t id = 0;
+  Status s = inst->net->connect(dev, h, &id);
+  if (!s.ok()) return FromStatus(s);
+  *send_comm = id;
+  return TPUNET_OK;
+}
+
+int32_t tpunet_c_accept(uintptr_t instance, uintptr_t listen_comm, uintptr_t* recv_comm) {
+  if (!recv_comm) return Fail(TPUNET_ERR_NULL, "recv_comm is null");
+  auto inst = GetInstance(instance);
+  if (!inst) return Fail(TPUNET_ERR_INVALID, "unknown instance");
+  uint64_t id = 0;
+  Status s = inst->net->accept(listen_comm, &id);
+  if (!s.ok()) return FromStatus(s);
+  *recv_comm = id;
+  return TPUNET_OK;
+}
+
+int32_t tpunet_c_isend(uintptr_t instance, uintptr_t send_comm, const void* data,
+                       uint64_t nbytes, uintptr_t* request) {
+  if (!request || (nbytes > 0 && !data)) return Fail(TPUNET_ERR_NULL, "null param");
+  auto inst = GetInstance(instance);
+  if (!inst) return Fail(TPUNET_ERR_INVALID, "unknown instance");
+  uint64_t id = 0;
+  Status s = inst->net->isend(send_comm, data, nbytes, &id);
+  if (!s.ok()) return FromStatus(s);
+  *request = id;
+  return TPUNET_OK;
+}
+
+int32_t tpunet_c_irecv(uintptr_t instance, uintptr_t recv_comm, void* data,
+                       uint64_t nbytes, uintptr_t* request) {
+  if (!request || (nbytes > 0 && !data)) return Fail(TPUNET_ERR_NULL, "null param");
+  auto inst = GetInstance(instance);
+  if (!inst) return Fail(TPUNET_ERR_INVALID, "unknown instance");
+  uint64_t id = 0;
+  Status s = inst->net->irecv(recv_comm, data, nbytes, &id);
+  if (!s.ok()) return FromStatus(s);
+  *request = id;
+  return TPUNET_OK;
+}
+
+int32_t tpunet_c_test(uintptr_t instance, uintptr_t request, uint8_t* done,
+                      uint64_t* nbytes) {
+  if (!done) return Fail(TPUNET_ERR_NULL, "done is null");
+  auto inst = GetInstance(instance);
+  if (!inst) return Fail(TPUNET_ERR_INVALID, "unknown instance");
+  bool d = false;
+  size_t n = 0;
+  Status s = inst->net->test(request, &d, &n);
+  if (!s.ok()) return FromStatus(s);
+  *done = d ? 1 : 0;
+  if (nbytes) *nbytes = n;
+  return TPUNET_OK;
+}
+
+int32_t tpunet_c_close_send(uintptr_t instance, uintptr_t send_comm) {
+  auto inst = GetInstance(instance);
+  if (!inst) return Fail(TPUNET_ERR_INVALID, "unknown instance");
+  return FromStatus(inst->net->close_send(send_comm));
+}
+
+int32_t tpunet_c_close_recv(uintptr_t instance, uintptr_t recv_comm) {
+  auto inst = GetInstance(instance);
+  if (!inst) return Fail(TPUNET_ERR_INVALID, "unknown instance");
+  return FromStatus(inst->net->close_recv(recv_comm));
+}
+
+int32_t tpunet_c_close_listen(uintptr_t instance, uintptr_t listen_comm) {
+  auto inst = GetInstance(instance);
+  if (!inst) return Fail(TPUNET_ERR_INVALID, "unknown instance");
+  return FromStatus(inst->net->close_listen(listen_comm));
+}
+
+const char* tpunet_c_last_error(void) { return g_last_error.c_str(); }
+
+}  // extern "C"

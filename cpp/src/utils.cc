@@ -8,7 +8,9 @@
 #include <net/if.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sched.h>
+#include <time.h>
 #include <stdlib.h>
 #include <string.h>
 #include <unistd.h>
@@ -208,6 +210,37 @@ Status ReadExact(int fd, void* buf, size_t n, bool spin) {
       sched_yield();
       continue;
     }
+    return Status::IO("read failed: " + std::string(strerror(errno)));
+  }
+  return Status::Ok();
+}
+
+Status ReadExactDeadline(int fd, void* buf, size_t n, int timeout_ms) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  size_t left = n;
+  struct timespec start;
+  clock_gettime(CLOCK_MONOTONIC, &start);
+  while (left > 0) {
+    struct timespec now;
+    clock_gettime(CLOCK_MONOTONIC, &now);
+    long elapsed_ms = (now.tv_sec - start.tv_sec) * 1000 + (now.tv_nsec - start.tv_nsec) / 1000000;
+    long remaining = timeout_ms - elapsed_ms;
+    if (remaining <= 0) return Status::IO("read timed out");
+    struct pollfd pfd = {fd, POLLIN, 0};
+    int pr = ::poll(&pfd, 1, static_cast<int>(remaining));
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return Status::IO("poll failed: " + std::string(strerror(errno)));
+    }
+    if (pr == 0) return Status::IO("read timed out");
+    ssize_t r = ::recv(fd, p, left, MSG_DONTWAIT);
+    if (r > 0) {
+      p += r;
+      left -= static_cast<size_t>(r);
+      continue;
+    }
+    if (r == 0) return Status::IO("unexpected EOF: peer closed connection");
+    if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK) continue;
     return Status::IO("read failed: " + std::string(strerror(errno)));
   }
   return Status::Ok();

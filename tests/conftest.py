@@ -1,0 +1,12 @@
+"""Test harness config.
+
+JAX tests run on a virtual 8-device CPU mesh (no TPU pod in CI) — the env
+must be set before the first jax import anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,158 @@
+"""Loopback transport tests over the C ABI + ctypes binding.
+
+This is the multi-process harness the reference never had (SURVEY §4 gap):
+two real OS processes on 127.0.0.1 running listen/connect/accept +
+isend/irecv size sweeps with payload verification (BASELINE config 1).
+"""
+
+from __future__ import annotations
+
+import gc
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+# Sizes: 8 B .. 16 MB (powers of 4) + oddball non-aligned sizes; the full
+# 8B-128MB x2 sweep lives in the bench CLI.
+SWEEP_SIZES = [0, 8, 128, 2048, 32768, 524288, 1 << 20, (1 << 24) + 13, 777]
+
+
+def _pattern(size: int, seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=size, dtype=np.uint8)
+
+
+def _receiver_proc(conn, nstreams: int) -> None:
+    os.environ["TPUNET_NSTREAMS"] = str(nstreams)
+    from tpunet.transport import Net
+
+    net = Net()
+    listen = net.listen(0)
+    conn.send(listen.handle)
+    rc = listen.accept()
+    ok = True
+    for i, size in enumerate(SWEEP_SIZES):
+        buf = np.zeros(size + 64, dtype=np.uint8)  # oversized on purpose
+        got = rc.recv(buf, timeout=60)
+        expect = _pattern(size, seed=1000 + i)
+        if got != size or not np.array_equal(buf[:size], expect):
+            ok = False
+            break
+    conn.send("OK" if ok else "CORRUPT")
+    rc.close()
+    listen.close()
+    net.close()
+
+
+@pytest.mark.parametrize("nstreams", [1, 2, 4])
+def test_loopback_sweep(nstreams):
+    ctx = mp.get_context("spawn")
+    parent, child = ctx.Pipe()
+    proc = ctx.Process(target=_receiver_proc, args=(child, nstreams))
+    proc.start()
+    try:
+        handle = parent.recv()
+        os.environ["TPUNET_NSTREAMS"] = str(nstreams)
+        from tpunet.transport import Net
+
+        net = Net()
+        sc = net.connect(handle)
+        for i, size in enumerate(SWEEP_SIZES):
+            data = _pattern(size, seed=1000 + i)
+            sent = sc.send(data, timeout=60)
+            assert sent == size
+        assert parent.recv() == "OK"
+        sc.close()
+        net.close()
+    finally:
+        proc.join(timeout=30)
+        if proc.is_alive():
+            proc.kill()
+            pytest.fail("receiver process hung")
+    assert proc.exitcode == 0
+
+
+def _pin_receiver(conn) -> None:
+    from tpunet.transport import Net
+
+    net = Net()
+    listen = net.listen(0)
+    conn.send(listen.handle)
+    rc = listen.accept()
+    buf = np.zeros(1 << 22, dtype=np.uint8)
+    got = rc.recv(buf, timeout=60)
+    expect = _pattern(1 << 22, seed=7)
+    conn.send("OK" if (got == len(expect) and np.array_equal(buf, expect)) else "CORRUPT")
+    rc.close()
+    listen.close()
+    net.close()
+
+
+def test_request_pins_buffer_until_done():
+    """The Request must keep the send buffer alive: drop the caller's only
+    reference right after isend and force GC while the transfer runs."""
+    ctx = mp.get_context("spawn")
+    parent, child = ctx.Pipe()
+    proc = ctx.Process(target=_pin_receiver, args=(child,))
+    proc.start()
+    try:
+        handle = parent.recv()
+        from tpunet.transport import Net
+
+        net = Net()
+        sc = net.connect(handle)
+        data = _pattern(1 << 22, seed=7)
+        req = sc.isend(data)
+        del data  # request's pin is now the only live reference
+        gc.collect()
+        req.wait(timeout=60)
+        assert parent.recv() == "OK"
+        sc.close()
+        net.close()
+    finally:
+        proc.join(timeout=30)
+        if proc.is_alive():
+            proc.kill()
+            pytest.fail("receiver process hung")
+    assert proc.exitcode == 0
+
+
+def test_devices_and_properties():
+    from tpunet.transport import Net
+
+    with Net() as net:
+        n = net.devices()
+        assert n >= 1
+        props = net.properties(0)
+        assert props["name"]
+        assert props["speed_mbps"] > 0
+        assert props["max_comms"] == 65536
+        assert props["ptr_support"] == 1
+
+
+def test_connect_bad_handle_fails():
+    from tpunet import _native
+    from tpunet.transport import Net
+
+    with Net() as net:
+        # AF_INET sockaddr pointing at a port nothing listens on.
+        import socket
+        import struct
+
+        sa = struct.pack("!HHI", socket.AF_INET, 1, 0)  # wrong byte order on purpose
+        handle = (sa + b"\x00" * 64)[:64]
+        with pytest.raises(_native.NativeError):
+            net.connect(handle)
+
+
+def test_double_close_rejected():
+    from tpunet import _native
+    from tpunet.transport import Net
+
+    with Net() as net:
+        listen = net.listen(0)
+        listen.close()
+        with pytest.raises(_native.NativeError):
+            listen.close()

@@ -1,0 +1,24 @@
+"""tpunet — TPU-native multi-stream DCN transport, collectives, and JAX glue.
+
+A from-scratch TPU-native framework with the capabilities of the reference
+bagua-net (an NCCL net plugin striping messages across parallel TCP streams;
+see SURVEY.md). Layers, bottom to top:
+
+- ``tpunet.transport``   — ctypes binding to the C++ engine (libtpunet.so):
+  listen/connect/accept rendezvous + chunk-striped isend/irecv/test.
+- ``tpunet.collectives`` — bootstrap rendezvous + ring AllReduce/AllGather/
+  ReduceScatter/Broadcast over the transport (the role NCCL's algorithms
+  played above the reference plugin).
+- ``tpunet.distributed`` — process-group initialization from env vars.
+- ``tpunet.interop``     — JAX integration: host-callback collectives so
+  ``psum``-shaped ops on host-staged buffers ride this transport across
+  hosts, plus mesh/sharding helpers for the in-pod (ICI) path.
+- ``tpunet.models`` / ``tpunet.train`` — flagship DP benchmark stack (VGG16
+  synthetic, mirroring the reference's headline benchmark).
+"""
+
+__version__ = "0.1.0"
+
+from tpunet import config as config  # noqa: F401
+
+__all__ = ["config", "__version__"]

@@ -1,0 +1,150 @@
+"""Loader + raw ctypes signatures for libtpunet.so (the C ABI, c_api.h).
+
+Builds the native library on demand (``make -C cpp``) with a file lock so
+concurrent test processes don't race the build. The reference shipped its
+native core the same way conceptually: cargo staticlib + make shared object
+(reference: cc/Makefile:9-16).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import fcntl
+import os
+import subprocess
+from pathlib import Path
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+_CPP_DIR = _REPO_ROOT / "cpp"
+_LIB_PATH = _CPP_DIR / "build" / "libtpunet.so"
+
+TPUNET_OK = 0
+TPUNET_ERR_NULL = -1
+TPUNET_ERR_INVALID = -2
+TPUNET_ERR_INNER = -3
+
+HANDLE_SIZE = 64
+
+
+class SocketHandle(ctypes.Structure):
+    _fields_ = [("data", ctypes.c_uint8 * HANDLE_SIZE)]
+
+
+class NetProperties(ctypes.Structure):
+    _fields_ = [
+        ("name", ctypes.c_char_p),
+        ("pci_path", ctypes.c_char_p),
+        ("guid", ctypes.c_uint64),
+        ("ptr_support", ctypes.c_int32),
+        ("speed_mbps", ctypes.c_int32),
+        ("port", ctypes.c_int32),
+        ("max_comms", ctypes.c_int32),
+    ]
+
+
+def _sources_mtime() -> float:
+    newest = 0.0
+    for sub in ("src", "include/tpunet", "tests"):
+        d = _CPP_DIR / sub
+        if d.is_dir():
+            for f in d.rglob("*"):
+                if f.suffix in (".cc", ".h"):
+                    newest = max(newest, f.stat().st_mtime)
+    mk = _CPP_DIR / "Makefile"
+    if mk.exists():
+        newest = max(newest, mk.stat().st_mtime)
+    return newest
+
+
+def build_native(force: bool = False) -> Path:
+    """Build libtpunet.so if missing or stale. Safe across processes."""
+    lock_path = _CPP_DIR / ".build.lock"
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            stale = (
+                force
+                or not _LIB_PATH.exists()
+                or _LIB_PATH.stat().st_mtime < _sources_mtime()
+            )
+            if stale:
+                subprocess.run(
+                    ["make", "-C", str(_CPP_DIR), "all"],
+                    check=True,
+                    capture_output=True,
+                    text=True,
+                )
+        except subprocess.CalledProcessError as e:  # surface compiler output
+            raise RuntimeError(
+                f"native build failed:\n{e.stdout}\n{e.stderr}"
+            ) from e
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+    return _LIB_PATH
+
+
+_lib: ctypes.CDLL | None = None
+
+
+def load() -> ctypes.CDLL:
+    """Load (building if needed) and memoize the native library."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = os.environ.get("TPUNET_LIBRARY_PATH", "")
+    lib_file = Path(path) if path else build_native()
+    lib = ctypes.CDLL(str(lib_file))
+
+    u = ctypes.c_uintptr if hasattr(ctypes, "c_uintptr") else ctypes.c_size_t
+    i32, u8, u64 = ctypes.c_int32, ctypes.c_uint8, ctypes.c_uint64
+    P = ctypes.POINTER
+
+    lib.tpunet_c_create.argtypes = [P(u)]
+    lib.tpunet_c_create.restype = i32
+    lib.tpunet_c_destroy.argtypes = [P(u)]
+    lib.tpunet_c_destroy.restype = i32
+    lib.tpunet_c_devices.argtypes = [u, P(i32)]
+    lib.tpunet_c_devices.restype = i32
+    lib.tpunet_c_get_properties.argtypes = [u, i32, P(NetProperties)]
+    lib.tpunet_c_get_properties.restype = i32
+    lib.tpunet_c_listen.argtypes = [u, i32, P(SocketHandle), P(u)]
+    lib.tpunet_c_listen.restype = i32
+    lib.tpunet_c_connect.argtypes = [u, i32, P(SocketHandle), P(u)]
+    lib.tpunet_c_connect.restype = i32
+    lib.tpunet_c_accept.argtypes = [u, u, P(u)]
+    lib.tpunet_c_accept.restype = i32
+    lib.tpunet_c_isend.argtypes = [u, u, ctypes.c_void_p, u64, P(u)]
+    lib.tpunet_c_isend.restype = i32
+    lib.tpunet_c_irecv.argtypes = [u, u, ctypes.c_void_p, u64, P(u)]
+    lib.tpunet_c_irecv.restype = i32
+    lib.tpunet_c_test.argtypes = [u, u, P(u8), P(u64)]
+    lib.tpunet_c_test.restype = i32
+    lib.tpunet_c_close_send.argtypes = [u, u]
+    lib.tpunet_c_close_send.restype = i32
+    lib.tpunet_c_close_recv.argtypes = [u, u]
+    lib.tpunet_c_close_recv.restype = i32
+    lib.tpunet_c_close_listen.argtypes = [u, u]
+    lib.tpunet_c_close_listen.restype = i32
+    lib.tpunet_c_last_error.argtypes = []
+    lib.tpunet_c_last_error.restype = ctypes.c_char_p
+
+    _lib = lib
+    return lib
+
+
+def last_error() -> str:
+    if _lib is None:
+        return ""
+    msg = _lib.tpunet_c_last_error()
+    return msg.decode("utf-8", "replace") if msg else ""
+
+
+class NativeError(RuntimeError):
+    def __init__(self, code: int, op: str):
+        self.code = code
+        super().__init__(f"tpunet native {op} failed (code {code}): {last_error()}")
+
+
+def check(code: int, op: str) -> None:
+    if code != TPUNET_OK:
+        raise NativeError(code, op)

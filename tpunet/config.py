@@ -1,0 +1,74 @@
+"""tpunet configuration — the complete env-var inventory in one place.
+
+The reference read its env vars ad hoc all over the tree (SURVEY §5 config
+inventory; reference files cited per flag below). tpunet centralizes them.
+``TPUNET_*`` names are canonical; the reference-compatible ``BAGUA_NET_*`` /
+``NCCL_*`` spellings are honored as fallbacks by the native layer where
+noted.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+
+def _env_int(name: str, fallback: int) -> int:
+    v = os.environ.get(name, "")
+    try:
+        n = int(v)
+        return n if n >= 0 else fallback
+    except ValueError:
+        return fallback
+
+
+@dataclass(frozen=True)
+class Config:
+    """Snapshot of tpunet env configuration at construction time."""
+
+    # Engine selection (reference: src/lib.rs:20-29 BAGUA_NET_IMPLEMENT).
+    implement: str = "BASIC"
+    # Parallel TCP data streams per comm (reference default 2,
+    # nthread_per_socket_backend.rs:228-231).
+    nstreams: int = 2
+    # Minimum chunk size in bytes (reference default 1 MiB, nthread:232-235).
+    min_chunksize: int = 1 << 20
+    # Busy-poll IO instead of blocking IO (reference's only mode).
+    spin: bool = False
+    # NIC selection, NCCL syntax: "^a,b" exclude, "=a,b" exact, "a,b" prefix
+    # (reference: utils.rs:37-49).
+    socket_ifname: str = "^docker,lo"
+    # AF_INET / AF_INET6 restriction (reference: utils.rs:33-36).
+    socket_family: str = ""
+    # Bootstrap coordinator "host:port" for collectives rendezvous (the role
+    # NCCL's OOB bootstrap played for the reference).
+    coordinator: str = "127.0.0.1:29500"
+    # This process's rank and the world size (reference read RANK for
+    # telemetry gating only, nthread:104-107; here they drive the group).
+    rank: int = 0
+    world_size: int = 1
+    # Observability (reference: BAGUA_NET_JAEGER_ADDRESS nthread:113,
+    # BAGUA_NET_PROMETHEUS_ADDRESS nthread:184-185). Empty = disabled.
+    trace_dir: str = ""
+    metrics_addr: str = ""
+
+    @staticmethod
+    def from_env() -> "Config":
+        env = os.environ
+        return Config(
+            implement=env.get("TPUNET_IMPLEMENT", env.get("BAGUA_NET_IMPLEMENT", "BASIC")),
+            nstreams=_env_int("TPUNET_NSTREAMS", _env_int("BAGUA_NET_NSTREAMS", 2)),
+            min_chunksize=_env_int(
+                "TPUNET_MIN_CHUNKSIZE", _env_int("BAGUA_NET_MIN_CHUNKSIZE", 1 << 20)
+            ),
+            spin=env.get("TPUNET_SPIN", "0") not in ("", "0", "false"),
+            socket_ifname=env.get(
+                "TPUNET_SOCKET_IFNAME", env.get("NCCL_SOCKET_IFNAME", "^docker,lo")
+            ),
+            socket_family=env.get("TPUNET_SOCKET_FAMILY", env.get("NCCL_SOCKET_FAMILY", "")),
+            coordinator=env.get("TPUNET_COORDINATOR", "127.0.0.1:29500"),
+            rank=_env_int("TPUNET_RANK", _env_int("RANK", 0)),
+            world_size=_env_int("TPUNET_WORLD_SIZE", _env_int("WORLD_SIZE", 1)),
+            trace_dir=env.get("TPUNET_TRACE_DIR", ""),
+            metrics_addr=env.get("TPUNET_METRICS_ADDR", os.environ.get("TPUNET_PROMETHEUS_ADDRESS", "")),
+        )

@@ -1,0 +1,199 @@
+"""Pythonic wrapper over the tpunet C ABI (point-to-point transport).
+
+Maps the reference's C++ singleton wrapper role (reference: cc/bagua_net.h
+class BaguaNet) into Python, with the buffer-lifetime hazard handled
+explicitly: every in-flight request pins a reference to its buffer until
+``test()`` reports done, so the GC cannot free memory the native stream
+workers are still reading/writing (SURVEY hard-part #3; reference fabricated
+'static slices and relied on NCCL, src/lib.rs:251,279).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import time
+from typing import Any
+
+import numpy as np
+
+from tpunet import _native
+
+
+def _as_buffer(obj: Any, writable: bool) -> tuple[int, int, Any]:
+    """Return (address, nbytes, pin) for bytes/bytearray/numpy/memoryview."""
+    if isinstance(obj, np.ndarray):
+        if writable and not obj.flags.writeable:
+            raise ValueError("recv buffer must be writable")
+        if not obj.flags.c_contiguous:
+            raise ValueError("buffer must be C-contiguous")
+        return obj.ctypes.data, obj.nbytes, obj
+    mv = memoryview(obj)
+    if writable and mv.readonly:
+        raise ValueError("recv buffer must be writable")
+    if not mv.c_contiguous:
+        raise ValueError("buffer must be C-contiguous")
+    c = (ctypes.c_char * mv.nbytes).from_buffer(mv) if not mv.readonly else (
+        ctypes.c_char * mv.nbytes).from_buffer_copy(mv)
+    return ctypes.addressof(c), mv.nbytes, (c, mv)
+
+
+class Request:
+    """In-flight isend/irecv; poll with test(), or wait()."""
+
+    def __init__(self, net: "Net", req_id: int, pin: Any):
+        self._net = net
+        self._id = req_id
+        self._pin = pin  # keeps the buffer alive until done
+        self._done = False
+        self._nbytes = 0
+
+    def test(self) -> tuple[bool, int]:
+        if self._done:
+            return True, self._nbytes
+        lib = self._net._lib
+        done = ctypes.c_uint8(0)
+        nbytes = ctypes.c_uint64(0)
+        _native.check(
+            lib.tpunet_c_test(self._net._id, self._id, ctypes.byref(done), ctypes.byref(nbytes)),
+            "test",
+        )
+        if done.value:
+            self._done = True
+            self._nbytes = nbytes.value
+            self._pin = None  # release the buffer pin
+        return self._done, self._nbytes
+
+    def wait(self, timeout: float | None = None) -> int:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        polls = 0
+        while True:
+            done, nbytes = self.test()
+            if done:
+                return nbytes
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"request {self._id} not done within {timeout}s")
+            # Adaptive backoff: poll hard briefly for low latency on small
+            # messages, then yield — a Python poll loop must not pin a core
+            # for a whole multi-MB transfer on a shared trainer host.
+            polls += 1
+            if polls > 200:
+                time.sleep(min(1e-3, 1e-5 * (polls - 200)))
+
+
+class SendComm:
+    def __init__(self, net: "Net", comm_id: int):
+        self._net = net
+        self._id = comm_id
+
+    def isend(self, buf: Any) -> Request:
+        addr, nbytes, pin = _as_buffer(buf, writable=False)
+        req = ctypes.c_size_t(0)
+        _native.check(
+            self._net._lib.tpunet_c_isend(self._net._id, self._id, addr, nbytes, ctypes.byref(req)),
+            "isend",
+        )
+        return Request(self._net, req.value, pin)
+
+    def send(self, buf: Any, timeout: float | None = None) -> int:
+        return self.isend(buf).wait(timeout)
+
+    def close(self) -> None:
+        _native.check(self._net._lib.tpunet_c_close_send(self._net._id, self._id), "close_send")
+
+
+class RecvComm:
+    def __init__(self, net: "Net", comm_id: int):
+        self._net = net
+        self._id = comm_id
+
+    def irecv(self, buf: Any) -> Request:
+        addr, nbytes, pin = _as_buffer(buf, writable=True)
+        req = ctypes.c_size_t(0)
+        _native.check(
+            self._net._lib.tpunet_c_irecv(self._net._id, self._id, addr, nbytes, ctypes.byref(req)),
+            "irecv",
+        )
+        return Request(self._net, req.value, pin)
+
+    def recv(self, buf: Any, timeout: float | None = None) -> int:
+        return self.irecv(buf).wait(timeout)
+
+    def close(self) -> None:
+        _native.check(self._net._lib.tpunet_c_close_recv(self._net._id, self._id), "close_recv")
+
+
+class ListenComm:
+    def __init__(self, net: "Net", comm_id: int, handle: bytes):
+        self._net = net
+        self._id = comm_id
+        self.handle = handle  # 64-byte rendezvous blob, ship out-of-band
+
+    def accept(self) -> RecvComm:
+        rid = ctypes.c_size_t(0)
+        _native.check(
+            self._net._lib.tpunet_c_accept(self._net._id, self._id, ctypes.byref(rid)), "accept"
+        )
+        return RecvComm(self._net, rid.value)
+
+    def close(self) -> None:
+        _native.check(self._net._lib.tpunet_c_close_listen(self._net._id, self._id), "close_listen")
+
+
+class Net:
+    """One transport engine instance (reference: BaguaNet singleton — but
+    multiple instances are allowed here)."""
+
+    def __init__(self) -> None:
+        self._lib = _native.load()
+        inst = ctypes.c_size_t(0)
+        _native.check(self._lib.tpunet_c_create(ctypes.byref(inst)), "create")
+        self._id = inst.value
+
+    def devices(self) -> int:
+        n = ctypes.c_int32(0)
+        _native.check(self._lib.tpunet_c_devices(self._id, ctypes.byref(n)), "devices")
+        return n.value
+
+    def properties(self, dev: int = 0) -> dict:
+        p = _native.NetProperties()
+        _native.check(self._lib.tpunet_c_get_properties(self._id, dev, ctypes.byref(p)), "props")
+        return {
+            "name": (p.name or b"").decode(),
+            "pci_path": (p.pci_path or b"").decode(),
+            "guid": p.guid,
+            "ptr_support": p.ptr_support,
+            "speed_mbps": p.speed_mbps,
+            "port": p.port,
+            "max_comms": p.max_comms,
+        }
+
+    def listen(self, dev: int = 0) -> ListenComm:
+        h = _native.SocketHandle()
+        lid = ctypes.c_size_t(0)
+        _native.check(
+            self._lib.tpunet_c_listen(self._id, dev, ctypes.byref(h), ctypes.byref(lid)), "listen"
+        )
+        return ListenComm(self, lid.value, bytes(h.data))
+
+    def connect(self, handle: bytes, dev: int = 0) -> SendComm:
+        if len(handle) != _native.HANDLE_SIZE:
+            raise ValueError(f"handle must be {_native.HANDLE_SIZE} bytes")
+        h = _native.SocketHandle()
+        ctypes.memmove(h.data, handle, _native.HANDLE_SIZE)
+        sid = ctypes.c_size_t(0)
+        _native.check(
+            self._lib.tpunet_c_connect(self._id, dev, ctypes.byref(h), ctypes.byref(sid)), "connect"
+        )
+        return SendComm(self, sid.value)
+
+    def close(self) -> None:
+        if self._id:
+            inst = ctypes.c_size_t(self._id)
+            self._id = 0
+            _native.check(self._lib.tpunet_c_destroy(ctypes.byref(inst)), "destroy")
+
+    def __enter__(self) -> "Net":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
