@@ -1,0 +1,43 @@
+// tpunet bootstrap — out-of-band rendezvous for collective groups.
+//
+// The reference relied on NCCL's bootstrap to ship its 64-byte listen handle
+// between ranks (SURVEY §2.2 step 1; reference README.md:20-45 runs under
+// mpirun). tpunet owns this layer: a tiny TCP coordinator (rank 0) that
+// supports fixed-size AllGather rounds, used to exchange transport handles
+// when building communicators, plus a barrier.
+#ifndef TPUNET_BOOTSTRAP_H_
+#define TPUNET_BOOTSTRAP_H_
+
+#include <cstddef>
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "tpunet/net.h"
+
+namespace tpunet {
+
+class Bootstrap {
+ public:
+  virtual ~Bootstrap() = default;
+
+  // coordinator: "host:port". Rank 0 binds and serves it; other ranks
+  // connect with retry until TPUNET_BOOTSTRAP_TIMEOUT_MS (default 120s).
+  static Status Create(const std::string& coordinator, int rank, int world_size,
+                       std::unique_ptr<Bootstrap>* out);
+
+  // Gather `len` bytes from every rank, in rank order, into all (world*len
+  // bytes). Every rank must pass the same len. Collective: all ranks call.
+  virtual Status AllGather(const void* mine, size_t len, std::vector<uint8_t>* all) = 0;
+
+  // All ranks synchronize (one empty AllGather round).
+  virtual Status Barrier() = 0;
+
+  virtual int rank() const = 0;
+  virtual int world_size() const = 0;
+};
+
+}  // namespace tpunet
+
+#endif  // TPUNET_BOOTSTRAP_H_

@@ -81,6 +81,33 @@ int32_t tpunet_c_close_listen(uintptr_t instance, uintptr_t listen_comm);
 /* Thread-local message for the last TPUNET_ERR_* returned on this thread. */
 const char* tpunet_c_last_error(void);
 
+/* ---- Collectives (ring communicator over the transport) ----------------
+ * The layer NCCL provided above the reference plugin (SURVEY §2.3); here it
+ * is in-repo: bootstrap rendezvous + ring AllReduce/ReduceScatter/AllGather/
+ * Broadcast/Barrier + the neighbor-exchange step sequence parallelism needs.
+ * dtype: 0=f32 1=f64 2=bf16 3=i32 4=i64 5=u8; op: 0=sum 1=prod 2=min 3=max.
+ * A communicator is single-threaded (one collective at a time); all ranks
+ * must call the same collectives in the same order. */
+int32_t tpunet_comm_create(const char* coordinator, int32_t rank, int32_t world_size,
+                           uintptr_t* comm);
+int32_t tpunet_comm_destroy(uintptr_t* comm);
+int32_t tpunet_comm_rank(uintptr_t comm, int32_t* rank, int32_t* world_size);
+/* sendbuf may equal recvbuf (in-place). count = elements. */
+int32_t tpunet_comm_all_reduce(uintptr_t comm, const void* sendbuf, void* recvbuf,
+                               uint64_t count, int32_t dtype, int32_t op);
+/* sendbuf: world*recv_count elements; recvbuf: this rank's recv_count. */
+int32_t tpunet_comm_reduce_scatter(uintptr_t comm, const void* sendbuf, void* recvbuf,
+                                   uint64_t recv_count, int32_t dtype, int32_t op);
+/* sendbuf: bytes_per_rank; recvbuf: world*bytes_per_rank rank-ordered. */
+int32_t tpunet_comm_all_gather(uintptr_t comm, const void* sendbuf, void* recvbuf,
+                               uint64_t bytes_per_rank);
+int32_t tpunet_comm_broadcast(uintptr_t comm, void* buf, uint64_t nbytes, int32_t root);
+/* Send to (rank+1)%world while receiving from (rank-1+world)%world. */
+int32_t tpunet_comm_neighbor_exchange(uintptr_t comm, const void* sendbuf,
+                                      uint64_t send_nbytes, void* recvbuf,
+                                      uint64_t recv_nbytes, uint64_t* got);
+int32_t tpunet_comm_barrier(uintptr_t comm);
+
 #ifdef __cplusplus
 }
 #endif

@@ -1,0 +1,78 @@
+// tpunet collectives — ring algorithms over the multi-stream transport.
+//
+// The reference provided only point-to-point isend/irecv; NCCL's ring
+// algorithms lived above it (SURVEY §2.3: "AllReduce / collectives
+// algorithms — absent in-repo, external"). On TPU there is no NCCL to sit
+// under, so tpunet owns this layer: ring AllReduce (reduce-scatter +
+// all-gather phases), AllGather, ReduceScatter, Broadcast, Barrier, and the
+// neighbor-exchange primitive that sequence-parallel/ring-attention layers
+// need. Rendezvous handles travel via the Bootstrap (bootstrap.h).
+#ifndef TPUNET_COLLECTIVES_H_
+#define TPUNET_COLLECTIVES_H_
+
+#include <cstddef>
+#include <cstdint>
+#include <memory>
+#include <string>
+
+#include "tpunet/net.h"
+
+namespace tpunet {
+
+// Values are ABI: they cross the C layer and the Python binding.
+enum class DType : int32_t {
+  kF32 = 0,
+  kF64 = 1,
+  kBF16 = 2,
+  kI32 = 3,
+  kI64 = 4,
+  kU8 = 5,
+};
+
+enum class RedOp : int32_t {
+  kSum = 0,
+  kProd = 1,
+  kMin = 2,
+  kMax = 3,
+};
+
+size_t DTypeSize(DType d);
+
+// A ring communicator: every rank holds a send comm to (rank+1)%world and a
+// recv comm from (rank-1+world)%world over the multi-stream transport.
+class Communicator {
+ public:
+  virtual ~Communicator() = default;
+
+  // Collective constructor — all ranks must call with the same coordinator
+  // and world_size. Owns its own transport engine instance.
+  static Status Create(const std::string& coordinator, int rank, int world_size,
+                       std::unique_ptr<Communicator>* out);
+
+  // sendbuf may equal recvbuf (in-place). count = elements.
+  virtual Status AllReduce(const void* sendbuf, void* recvbuf, size_t count,
+                           DType dtype, RedOp op) = 0;
+  // sendbuf holds world*recv_count elements; recvbuf gets this rank's
+  // reduced recv_count elements.
+  virtual Status ReduceScatter(const void* sendbuf, void* recvbuf, size_t recv_count,
+                               DType dtype, RedOp op) = 0;
+  // sendbuf holds bytes_per_rank bytes; recvbuf gets world*bytes_per_rank,
+  // rank-ordered. Byte-oriented (no dtype needed).
+  virtual Status AllGather(const void* sendbuf, void* recvbuf, size_t bytes_per_rank) = 0;
+  // In-place broadcast of nbytes from root, pipelined around the ring.
+  virtual Status Broadcast(void* buf, size_t nbytes, int root) = 0;
+  // Simultaneous send-to-next / recv-from-prev (the ppermute step of ring
+  // attention / sequence parallelism). send_nbytes bytes go to (rank+1)%W;
+  // recv buffer receives prev rank's message (recv_nbytes posted capacity;
+  // actual size returned in *got if non-null).
+  virtual Status NeighborExchange(const void* sendbuf, size_t send_nbytes, void* recvbuf,
+                                  size_t recv_nbytes, size_t* got) = 0;
+  virtual Status Barrier() = 0;
+
+  virtual int rank() const = 0;
+  virtual int world_size() const = 0;
+};
+
+}  // namespace tpunet
+
+#endif  // TPUNET_COLLECTIVES_H_

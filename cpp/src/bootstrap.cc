@@ -1,0 +1,258 @@
+// tpunet bootstrap implementation: star topology over plain TCP.
+// Rank 0 serves; every other rank keeps one persistent connection. Each
+// AllGather round: clients send [len u64 | blob], rank 0 checks lengths
+// match, concatenates in rank order (own blob included) and fans the result
+// back. Wire frames are u64 big-endian like the transport (basic_engine.cc).
+#include "tpunet/bootstrap.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <thread>
+
+#include "tpunet/utils.h"
+
+namespace tpunet {
+namespace {
+
+constexpr uint64_t kBootstrapMagic = 0x7470626f6f747331ull;  // "tpboots1"
+
+Status ParseHostPort(const std::string& coordinator, sockaddr_storage* addr, socklen_t* alen) {
+  size_t colon = coordinator.rfind(':');
+  if (colon == std::string::npos) {
+    return Status::Inner("coordinator must be host:port, got '" + coordinator + "'");
+  }
+  std::string host = coordinator.substr(0, colon);
+  std::string port = coordinator.substr(colon + 1);
+  if (!host.empty() && host.front() == '[' && host.back() == ']') {
+    host = host.substr(1, host.size() - 2);  // [v6]:port
+  }
+  struct addrinfo hints = {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  int rc = getaddrinfo(host.c_str(), port.c_str(), &hints, &res);
+  if (rc != 0 || !res) {
+    return Status::Inner("cannot resolve coordinator '" + coordinator + "': " + gai_strerror(rc));
+  }
+  memcpy(addr, res->ai_addr, res->ai_addrlen);
+  *alen = res->ai_addrlen;
+  freeaddrinfo(res);
+  return Status::Ok();
+}
+
+Status SendFrame(int fd, const void* data, size_t len) {
+  uint8_t hdr[8];
+  EncodeU64BE(len, hdr);
+  Status s = WriteAll(fd, hdr, sizeof(hdr));
+  if (!s.ok()) return s;
+  if (len == 0) return Status::Ok();
+  return WriteAll(fd, data, len);
+}
+
+Status RecvFrame(int fd, std::vector<uint8_t>* out, int timeout_ms) {
+  uint8_t hdr[8];
+  Status s = ReadExactDeadline(fd, hdr, sizeof(hdr), timeout_ms);
+  if (!s.ok()) return s;
+  uint64_t len = DecodeU64BE(hdr);
+  if (len > (1ull << 32)) return Status::Inner("bootstrap frame too large");
+  out->resize(len);
+  if (len == 0) return Status::Ok();
+  return ReadExactDeadline(fd, out->data(), len, timeout_ms);
+}
+
+int TimeoutMs() {
+  return static_cast<int>(GetEnvU64("TPUNET_BOOTSTRAP_TIMEOUT_MS", 120000));
+}
+
+// Rank 0: owns the listening socket and one connection per peer rank.
+class RootBootstrap : public Bootstrap {
+ public:
+  RootBootstrap(int world) : world_(world), peer_fds_(world, -1) {}
+
+  ~RootBootstrap() override {
+    for (int fd : peer_fds_) {
+      if (fd >= 0) ::close(fd);
+    }
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+  }
+
+  Status Init(const sockaddr_storage& addr, socklen_t alen) {
+    listen_fd_ = ::socket(addr.ss_family, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return Status::TCP("bootstrap socket: " + std::string(strerror(errno)));
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    if (::bind(listen_fd_, reinterpret_cast<const sockaddr*>(&addr), alen) != 0) {
+      return Status::TCP("bootstrap bind: " + std::string(strerror(errno)));
+    }
+    if (::listen(listen_fd_, 1024) != 0) {
+      return Status::TCP("bootstrap listen: " + std::string(strerror(errno)));
+    }
+    // Collect hellos from all world-1 peers. Poll with the remaining budget
+    // before each accept — a blocking accept would never observe the
+    // deadline when a rank dies before joining, wedging the coordinator.
+    auto deadline = std::chrono::steady_clock::now() + std::chrono::milliseconds(TimeoutMs());
+    int connected = 0;
+    while (connected < world_ - 1) {
+      auto remaining = std::chrono::duration_cast<std::chrono::milliseconds>(
+                           deadline - std::chrono::steady_clock::now())
+                           .count();
+      if (remaining <= 0) {
+        return Status::TCP("bootstrap timed out waiting for " +
+                           std::to_string(world_ - 1 - connected) + " rank(s)");
+      }
+      struct pollfd pfd = {listen_fd_, POLLIN, 0};
+      int pr = ::poll(&pfd, 1, static_cast<int>(remaining));
+      if (pr < 0 && errno != EINTR) {
+        return Status::TCP("bootstrap poll: " + std::string(strerror(errno)));
+      }
+      if (pr <= 0) continue;  // EINTR or timeout tick: recheck deadline
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (errno == EINTR) continue;
+        return Status::TCP("bootstrap accept: " + std::string(strerror(errno)));
+      }
+      SetNodelay(fd);
+      uint8_t hello[16];
+      Status s = ReadExactDeadline(fd, hello, sizeof(hello), 10000);
+      if (!s.ok() || DecodeU64BE(hello) != kBootstrapMagic) {
+        ::close(fd);  // scanner or stray client — ignore
+        continue;
+      }
+      uint64_t peer_rank = DecodeU64BE(hello + 8);
+      if (peer_rank == 0 || peer_rank >= static_cast<uint64_t>(world_) ||
+          peer_fds_[peer_rank] >= 0) {
+        ::close(fd);
+        return Status::Inner("bootstrap: bad or duplicate rank " + std::to_string(peer_rank));
+      }
+      peer_fds_[peer_rank] = fd;
+      ++connected;
+    }
+    return Status::Ok();
+  }
+
+  Status AllGather(const void* mine, size_t len, std::vector<uint8_t>* all) override {
+    all->assign(world_ * len, 0);
+    memcpy(all->data(), mine, len);  // rank 0's own blob
+    for (int r = 1; r < world_; ++r) {
+      std::vector<uint8_t> blob;
+      Status s = RecvFrame(peer_fds_[r], &blob, TimeoutMs());
+      if (!s.ok()) return Status::TCP("bootstrap gather from rank " + std::to_string(r) + ": " + s.msg);
+      if (blob.size() != len) {
+        return Status::Inner("bootstrap length mismatch from rank " + std::to_string(r));
+      }
+      memcpy(all->data() + r * len, blob.data(), len);
+    }
+    for (int r = 1; r < world_; ++r) {
+      Status s = SendFrame(peer_fds_[r], all->data(), all->size());
+      if (!s.ok()) return Status::TCP("bootstrap scatter to rank " + std::to_string(r) + ": " + s.msg);
+    }
+    return Status::Ok();
+  }
+
+  Status Barrier() override {
+    uint8_t token = 0;
+    std::vector<uint8_t> all;
+    return AllGather(&token, 1, &all);
+  }
+
+  int rank() const override { return 0; }
+  int world_size() const override { return world_; }
+
+ private:
+  int world_;
+  int listen_fd_ = -1;
+  std::vector<int> peer_fds_;
+};
+
+// Ranks != 0: one persistent connection to rank 0.
+class PeerBootstrap : public Bootstrap {
+ public:
+  PeerBootstrap(int rank, int world) : rank_(rank), world_(world) {}
+
+  ~PeerBootstrap() override {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  Status Init(const sockaddr_storage& addr, socklen_t alen) {
+    auto deadline = std::chrono::steady_clock::now() + std::chrono::milliseconds(TimeoutMs());
+    // Retry until the coordinator is up (rank 0 may start last).
+    while (true) {
+      fd_ = ::socket(addr.ss_family, SOCK_STREAM, 0);
+      if (fd_ < 0) return Status::TCP("bootstrap socket: " + std::string(strerror(errno)));
+      if (::connect(fd_, reinterpret_cast<const sockaddr*>(&addr), alen) == 0) break;
+      ::close(fd_);
+      fd_ = -1;
+      if (std::chrono::steady_clock::now() > deadline) {
+        return Status::TCP("bootstrap: cannot reach coordinator");
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    }
+    SetNodelay(fd_);
+    uint8_t hello[16];
+    EncodeU64BE(kBootstrapMagic, hello);
+    EncodeU64BE(static_cast<uint64_t>(rank_), hello + 8);
+    return WriteAll(fd_, hello, sizeof(hello));
+  }
+
+  Status AllGather(const void* mine, size_t len, std::vector<uint8_t>* all) override {
+    Status s = SendFrame(fd_, mine, len);
+    if (!s.ok()) return s;
+    s = RecvFrame(fd_, all, TimeoutMs());
+    if (!s.ok()) return s;
+    if (all->size() != static_cast<size_t>(world_) * len) {
+      return Status::Inner("bootstrap reply size mismatch");
+    }
+    return Status::Ok();
+  }
+
+  Status Barrier() override {
+    uint8_t token = 0;
+    std::vector<uint8_t> all;
+    return AllGather(&token, 1, &all);
+  }
+
+  int rank() const override { return rank_; }
+  int world_size() const override { return world_; }
+
+ private:
+  int rank_;
+  int world_;
+  int fd_ = -1;
+};
+
+}  // namespace
+
+Status Bootstrap::Create(const std::string& coordinator, int rank, int world_size,
+                         std::unique_ptr<Bootstrap>* out) {
+  if (world_size < 1 || rank < 0 || rank >= world_size) {
+    return Status::Invalid("bad rank/world_size " + std::to_string(rank) + "/" +
+                           std::to_string(world_size));
+  }
+  sockaddr_storage addr;
+  socklen_t alen = 0;
+  Status s = ParseHostPort(coordinator, &addr, &alen);
+  if (!s.ok()) return s;
+  if (rank == 0) {
+    auto b = std::make_unique<RootBootstrap>(world_size);
+    s = b->Init(addr, alen);
+    if (!s.ok()) return s;
+    *out = std::move(b);
+  } else {
+    auto b = std::make_unique<PeerBootstrap>(rank, world_size);
+    s = b->Init(addr, alen);
+    if (!s.ok()) return s;
+    *out = std::move(b);
+  }
+  return Status::Ok();
+}
+
+}  // namespace tpunet

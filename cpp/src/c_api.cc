@@ -217,3 +217,112 @@ int32_t tpunet_c_close_listen(uintptr_t instance, uintptr_t listen_comm) {
 const char* tpunet_c_last_error(void) { return g_last_error.c_str(); }
 
 }  // extern "C"
+
+// ---- Collectives ABI ------------------------------------------------------
+
+#include "tpunet/collectives.h"
+
+namespace {
+
+tpunet::IdMap<std::shared_ptr<tpunet::Communicator>> g_comms;
+std::atomic<uint64_t> g_next_comm_id{1};
+
+std::shared_ptr<tpunet::Communicator> GetComm(uintptr_t id) {
+  std::shared_ptr<tpunet::Communicator> c;
+  g_comms.Get(id, &c);
+  return c;
+}
+
+bool ValidDType(int32_t d) { return d >= 0 && d <= 5; }
+bool ValidOp(int32_t o) { return o >= 0 && o <= 3; }
+
+}  // namespace
+
+extern "C" {
+
+int32_t tpunet_comm_create(const char* coordinator, int32_t rank, int32_t world_size,
+                           uintptr_t* comm) {
+  if (!coordinator || !comm) return Fail(TPUNET_ERR_NULL, "null param");
+  std::unique_ptr<tpunet::Communicator> c;
+  Status s = tpunet::Communicator::Create(coordinator, rank, world_size, &c);
+  if (!s.ok()) return FromStatus(s);
+  uint64_t id = g_next_comm_id.fetch_add(1);
+  g_comms.Put(id, std::shared_ptr<tpunet::Communicator>(std::move(c)));
+  *comm = id;
+  return TPUNET_OK;
+}
+
+int32_t tpunet_comm_destroy(uintptr_t* comm) {
+  if (!comm) return Fail(TPUNET_ERR_NULL, "comm is null");
+  std::shared_ptr<tpunet::Communicator> c;
+  if (!g_comms.Take(*comm, &c)) return Fail(TPUNET_ERR_INVALID, "unknown comm");
+  *comm = 0;
+  return TPUNET_OK;
+}
+
+int32_t tpunet_comm_rank(uintptr_t comm, int32_t* rank, int32_t* world_size) {
+  auto c = GetComm(comm);
+  if (!c) return Fail(TPUNET_ERR_INVALID, "unknown comm");
+  if (rank) *rank = c->rank();
+  if (world_size) *world_size = c->world_size();
+  return TPUNET_OK;
+}
+
+int32_t tpunet_comm_all_reduce(uintptr_t comm, const void* sendbuf, void* recvbuf,
+                               uint64_t count, int32_t dtype, int32_t op) {
+  if (count > 0 && (!sendbuf || !recvbuf)) return Fail(TPUNET_ERR_NULL, "null buffer");
+  if (!ValidDType(dtype) || !ValidOp(op)) return Fail(TPUNET_ERR_INVALID, "bad dtype/op");
+  auto c = GetComm(comm);
+  if (!c) return Fail(TPUNET_ERR_INVALID, "unknown comm");
+  return FromStatus(c->AllReduce(sendbuf, recvbuf, count, static_cast<tpunet::DType>(dtype),
+                                 static_cast<tpunet::RedOp>(op)));
+}
+
+int32_t tpunet_comm_reduce_scatter(uintptr_t comm, const void* sendbuf, void* recvbuf,
+                                   uint64_t recv_count, int32_t dtype, int32_t op) {
+  if (recv_count > 0 && (!sendbuf || !recvbuf)) return Fail(TPUNET_ERR_NULL, "null buffer");
+  if (!ValidDType(dtype) || !ValidOp(op)) return Fail(TPUNET_ERR_INVALID, "bad dtype/op");
+  auto c = GetComm(comm);
+  if (!c) return Fail(TPUNET_ERR_INVALID, "unknown comm");
+  return FromStatus(c->ReduceScatter(sendbuf, recvbuf, recv_count,
+                                     static_cast<tpunet::DType>(dtype),
+                                     static_cast<tpunet::RedOp>(op)));
+}
+
+int32_t tpunet_comm_all_gather(uintptr_t comm, const void* sendbuf, void* recvbuf,
+                               uint64_t bytes_per_rank) {
+  if (bytes_per_rank > 0 && (!sendbuf || !recvbuf)) return Fail(TPUNET_ERR_NULL, "null buffer");
+  auto c = GetComm(comm);
+  if (!c) return Fail(TPUNET_ERR_INVALID, "unknown comm");
+  return FromStatus(c->AllGather(sendbuf, recvbuf, bytes_per_rank));
+}
+
+int32_t tpunet_comm_broadcast(uintptr_t comm, void* buf, uint64_t nbytes, int32_t root) {
+  if (nbytes > 0 && !buf) return Fail(TPUNET_ERR_NULL, "null buffer");
+  auto c = GetComm(comm);
+  if (!c) return Fail(TPUNET_ERR_INVALID, "unknown comm");
+  return FromStatus(c->Broadcast(buf, nbytes, root));
+}
+
+int32_t tpunet_comm_neighbor_exchange(uintptr_t comm, const void* sendbuf,
+                                      uint64_t send_nbytes, void* recvbuf,
+                                      uint64_t recv_nbytes, uint64_t* got) {
+  if ((send_nbytes > 0 && !sendbuf) || (recv_nbytes > 0 && !recvbuf)) {
+    return Fail(TPUNET_ERR_NULL, "null buffer");
+  }
+  auto c = GetComm(comm);
+  if (!c) return Fail(TPUNET_ERR_INVALID, "unknown comm");
+  size_t g = 0;
+  Status s = c->NeighborExchange(sendbuf, send_nbytes, recvbuf, recv_nbytes, &g);
+  if (!s.ok()) return FromStatus(s);
+  if (got) *got = g;
+  return TPUNET_OK;
+}
+
+int32_t tpunet_comm_barrier(uintptr_t comm) {
+  auto c = GetComm(comm);
+  if (!c) return Fail(TPUNET_ERR_INVALID, "unknown comm");
+  return FromStatus(c->Barrier());
+}
+
+}  // extern "C"

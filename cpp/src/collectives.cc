@@ -1,0 +1,425 @@
+// tpunet ring collectives over the multi-stream transport. See collectives.h.
+//
+// Algorithms (chunked ring, the same family NCCL runs above the reference
+// plugin — SURVEY §1 L6):
+//   AllReduce      = reduce-scatter phase + all-gather phase, 2(W-1) steps,
+//                    busbw-optimal 2(W-1)/W bytes per element on the wire.
+//   ReduceScatter  = the RS phase alone on W equal blocks.
+//   AllGather      = the AG phase alone.
+//   Broadcast      = pipelined ring forward from root (1 MiB chunks).
+//   Barrier        = 1-byte AllGather.
+// Every step posts the irecv before the isend and waits on both — each rank
+// sends to (rank+1)%W and receives from (rank-1+W)%W over independent
+// full-duplex comms, so the ring cannot deadlock.
+#include "tpunet/collectives.h"
+
+#include <string.h>
+
+#include <algorithm>
+#include <chrono>
+#include <thread>
+#include <vector>
+
+#include "tpunet/bootstrap.h"
+#include "tpunet/utils.h"
+
+namespace tpunet {
+
+size_t DTypeSize(DType d) {
+  switch (d) {
+    case DType::kF32:
+      return 4;
+    case DType::kF64:
+      return 8;
+    case DType::kBF16:
+      return 2;
+    case DType::kI32:
+      return 4;
+    case DType::kI64:
+      return 8;
+    case DType::kU8:
+      return 1;
+  }
+  return 0;
+}
+
+namespace {
+
+constexpr size_t kBcastChunk = 1 << 20;  // broadcast pipeline granularity
+
+// --------------------------------------------------------------------------
+// Reduction kernels. bf16 is stored as uint16_t and reduced in float with
+// round-to-nearest-even back-conversion (TPU-native dtype; XLA does the same
+// for bf16 accumulation on host).
+
+inline float Bf16ToF32(uint16_t v) {
+  uint32_t bits = static_cast<uint32_t>(v) << 16;
+  float f;
+  memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t F32ToBf16(float f) {
+  uint32_t bits;
+  memcpy(&bits, &f, 4);
+  // RNE: add half-ulp (0x7FFF) plus the lsb of the kept part.
+  uint32_t rounded = bits + 0x7FFF + ((bits >> 16) & 1);
+  return static_cast<uint16_t>(rounded >> 16);
+}
+
+template <typename T>
+void ReduceTyped(T* dst, const T* src, size_t n, RedOp op) {
+  switch (op) {
+    case RedOp::kSum:
+      for (size_t i = 0; i < n; ++i) dst[i] = dst[i] + src[i];
+      break;
+    case RedOp::kProd:
+      for (size_t i = 0; i < n; ++i) dst[i] = dst[i] * src[i];
+      break;
+    case RedOp::kMin:
+      for (size_t i = 0; i < n; ++i) dst[i] = std::min(dst[i], src[i]);
+      break;
+    case RedOp::kMax:
+      for (size_t i = 0; i < n; ++i) dst[i] = std::max(dst[i], src[i]);
+      break;
+  }
+}
+
+void ReduceBf16(uint16_t* dst, const uint16_t* src, size_t n, RedOp op) {
+  for (size_t i = 0; i < n; ++i) {
+    float a = Bf16ToF32(dst[i]);
+    float b = Bf16ToF32(src[i]);
+    float r = 0;
+    switch (op) {
+      case RedOp::kSum:
+        r = a + b;
+        break;
+      case RedOp::kProd:
+        r = a * b;
+        break;
+      case RedOp::kMin:
+        r = std::min(a, b);
+        break;
+      case RedOp::kMax:
+        r = std::max(a, b);
+        break;
+    }
+    dst[i] = F32ToBf16(r);
+  }
+}
+
+void Reduce(void* dst, const void* src, size_t n, DType dtype, RedOp op) {
+  switch (dtype) {
+    case DType::kF32:
+      ReduceTyped(static_cast<float*>(dst), static_cast<const float*>(src), n, op);
+      break;
+    case DType::kF64:
+      ReduceTyped(static_cast<double*>(dst), static_cast<const double*>(src), n, op);
+      break;
+    case DType::kBF16:
+      ReduceBf16(static_cast<uint16_t*>(dst), static_cast<const uint16_t*>(src), n, op);
+      break;
+    case DType::kI32:
+      ReduceTyped(static_cast<int32_t*>(dst), static_cast<const int32_t*>(src), n, op);
+      break;
+    case DType::kI64:
+      ReduceTyped(static_cast<int64_t*>(dst), static_cast<const int64_t*>(src), n, op);
+      break;
+    case DType::kU8:
+      ReduceTyped(static_cast<uint8_t*>(dst), static_cast<const uint8_t*>(src), n, op);
+      break;
+  }
+}
+
+// --------------------------------------------------------------------------
+
+class RingCommunicator : public Communicator {
+ public:
+  RingCommunicator(int rank, int world) : rank_(rank), world_(world) {}
+
+  ~RingCommunicator() override {
+    if (net_) {
+      if (send_comm_) net_->close_send(send_comm_);
+      if (recv_comm_) net_->close_recv(recv_comm_);
+      if (listen_comm_) net_->close_listen(listen_comm_);
+    }
+  }
+
+  Status Init(const std::string& coordinator) {
+    net_ = CreateEngine();
+    Status s = Bootstrap::Create(coordinator, rank_, world_, &bootstrap_);
+    if (!s.ok()) return s;
+    if (world_ == 1) {
+      bootstrap_.reset();
+      return Status::Ok();
+    }
+
+    SocketHandle handle;
+    s = net_->listen(0, &handle, &listen_comm_);
+    if (!s.ok()) return s;
+    uint8_t blob[kHandleSize] = {0};
+    memcpy(blob, &handle.addr, std::min(sizeof(handle.addr), sizeof(blob)));
+    std::vector<uint8_t> all;
+    s = bootstrap_->AllGather(blob, kHandleSize, &all);
+    if (!s.ok()) return s;
+
+    int next = (rank_ + 1) % world_;
+    SocketHandle next_handle;
+    memcpy(&next_handle.addr, all.data() + next * kHandleSize, kHandleSize);
+    next_handle.addrlen = 0;  // derived from family by the engine
+    s = ConnectAndWire(next_handle);
+    if (!s.ok()) return s;
+    // The bootstrap's job is done once the ring is wired; dropping it frees
+    // the coordinator port and rank 0's W-1 peer sockets so long-lived jobs
+    // don't pin fds and another communicator can reuse the address.
+    bootstrap_.reset();
+    return Status::Ok();
+  }
+
+  Status ConnectAndWire(const SocketHandle& next_handle) {
+    Status s = net_->connect(0, next_handle, &send_comm_);
+    if (!s.ok()) return s;
+    // Barrier BEFORE accept: once it passes, every rank has connected to its
+    // next, so our prev's bundle is already inbound and accept() cannot
+    // block forever. A rank that died earlier fails the barrier with a clean
+    // error instead of wedging the ring (observed: peer death between
+    // bootstrap and connect hung accept indefinitely).
+    s = bootstrap_->Barrier();
+    if (!s.ok()) return s;
+    return net_->accept(listen_comm_, &recv_comm_);
+  }
+
+  Status AllReduce(const void* sendbuf, void* recvbuf, size_t count, DType dtype,
+                   RedOp op) override {
+    size_t esize = DTypeSize(dtype);
+    if (esize == 0) return Status::Invalid("bad dtype");
+    if (count == 0) return Status::Ok();
+    if (sendbuf != recvbuf) memcpy(recvbuf, sendbuf, count * esize);
+    if (world_ == 1) return Status::Ok();
+
+    uint8_t* data = static_cast<uint8_t*>(recvbuf);
+    const int W = world_;
+    auto off = [&](int i) { return (count * static_cast<size_t>(i)) / W; };
+    size_t max_slice = 0;
+    for (int i = 0; i < W; ++i) max_slice = std::max(max_slice, off(i + 1) - off(i));
+    scratch_.resize(max_slice * esize);
+
+    // vr relabels the ring so this rank finishes the RS phase owning slice
+    // `rank`, which the AG phase then circulates.
+    const int vr = (rank_ + W - 1) % W;
+    for (int s = 0; s < W - 1; ++s) {
+      int sidx = (vr - s + W) % W;
+      int ridx = (vr - s - 1 + W) % W;
+      size_t sbytes = (off(sidx + 1) - off(sidx)) * esize;
+      size_t rbytes = (off(ridx + 1) - off(ridx)) * esize;
+      Status st = Exchange(data + off(sidx) * esize, sbytes, scratch_.data(), rbytes, nullptr);
+      if (!st.ok()) return st;
+      Reduce(data + off(ridx) * esize, scratch_.data(), off(ridx + 1) - off(ridx), dtype, op);
+    }
+    for (int s = 0; s < W - 1; ++s) {
+      int sidx = (rank_ - s + W) % W;
+      int ridx = (rank_ - s - 1 + W) % W;
+      size_t sbytes = (off(sidx + 1) - off(sidx)) * esize;
+      size_t rbytes = (off(ridx + 1) - off(ridx)) * esize;
+      Status st = Exchange(data + off(sidx) * esize, sbytes, data + off(ridx) * esize, rbytes, nullptr);
+      if (!st.ok()) return st;
+    }
+    return Status::Ok();
+  }
+
+  Status ReduceScatter(const void* sendbuf, void* recvbuf, size_t recv_count, DType dtype,
+                       RedOp op) override {
+    size_t esize = DTypeSize(dtype);
+    if (esize == 0) return Status::Invalid("bad dtype");
+    if (recv_count == 0) return Status::Ok();
+    const int W = world_;
+    if (W == 1) {
+      if (sendbuf != recvbuf) memcpy(recvbuf, sendbuf, recv_count * esize);
+      return Status::Ok();
+    }
+    // Working copy of the whole W*recv_count input; the RS ring reduces
+    // blocks in place as they circulate.
+    size_t block = recv_count * esize;
+    work_.resize(static_cast<size_t>(W) * block);
+    memcpy(work_.data(), sendbuf, work_.size());
+    scratch_.resize(block);
+
+    const int vr = (rank_ + W - 1) % W;
+    for (int s = 0; s < W - 1; ++s) {
+      int sidx = (vr - s + W) % W;
+      int ridx = (vr - s - 1 + W) % W;
+      Status st = Exchange(work_.data() + sidx * block, block, scratch_.data(), block, nullptr);
+      if (!st.ok()) return st;
+      Reduce(work_.data() + ridx * block, scratch_.data(), recv_count, dtype, op);
+    }
+    memcpy(recvbuf, work_.data() + rank_ * block, block);
+    return Status::Ok();
+  }
+
+  Status AllGather(const void* sendbuf, void* recvbuf, size_t bytes_per_rank) override {
+    const int W = world_;
+    uint8_t* out = static_cast<uint8_t*>(recvbuf);
+    if (out + rank_ * bytes_per_rank != sendbuf) {
+      memcpy(out + rank_ * bytes_per_rank, sendbuf, bytes_per_rank);
+    }
+    if (W == 1 || bytes_per_rank == 0) return Status::Ok();
+    for (int s = 0; s < W - 1; ++s) {
+      int sidx = (rank_ - s + W) % W;
+      int ridx = (rank_ - s - 1 + W) % W;
+      Status st = Exchange(out + sidx * bytes_per_rank, bytes_per_rank,
+                           out + ridx * bytes_per_rank, bytes_per_rank, nullptr);
+      if (!st.ok()) return st;
+    }
+    return Status::Ok();
+  }
+
+  Status Broadcast(void* buf, size_t nbytes, int root) override {
+    const int W = world_;
+    if (W == 1 || nbytes == 0) return Status::Ok();
+    if (root < 0 || root >= W) return Status::Invalid("bad broadcast root");
+    uint8_t* data = static_cast<uint8_t*>(buf);
+    int dist = (rank_ - root + W) % W;          // hops from root along the ring
+    bool is_tail = dist == W - 1;               // last rank forwards nothing
+    size_t nchunks = (nbytes + kBcastChunk - 1) / kBcastChunk;
+
+    // Pipelined forward: receive chunk c, then send it on while chunk c+1 is
+    // in flight — the ring streams instead of store-and-forwarding the
+    // whole buffer W-1 times.
+    std::vector<uint64_t> pending_sends;
+    for (size_t c = 0; c < nchunks; ++c) {
+      size_t coff = c * kBcastChunk;
+      size_t clen = std::min(kBcastChunk, nbytes - coff);
+      if (dist != 0) {
+        uint64_t rreq = 0;
+        Status st = net_->irecv(recv_comm_, data + coff, clen, &rreq);
+        if (!st.ok()) return DrainSends(pending_sends, st);
+        size_t got = 0;
+        st = WaitRequest(rreq, &got);
+        if (!st.ok()) return DrainSends(pending_sends, st);
+        if (got != clen) {
+          return DrainSends(pending_sends, Status::Inner("broadcast chunk size mismatch"));
+        }
+      }
+      if (!is_tail) {
+        uint64_t sreq = 0;
+        Status st = net_->isend(send_comm_, data + coff, clen, &sreq);
+        if (!st.ok()) return DrainSends(pending_sends, st);
+        pending_sends.push_back(sreq);
+      }
+    }
+    return DrainSends(pending_sends, Status::Ok());
+  }
+
+  Status NeighborExchange(const void* sendbuf, size_t send_nbytes, void* recvbuf,
+                          size_t recv_nbytes, size_t* got) override {
+    if (world_ == 1) {
+      if (send_nbytes > recv_nbytes) return Status::Invalid("recv buffer too small");
+      memcpy(recvbuf, sendbuf, send_nbytes);
+      if (got) *got = send_nbytes;
+      return Status::Ok();
+    }
+    return Exchange(sendbuf, send_nbytes, recvbuf, recv_nbytes, got);
+  }
+
+  Status Barrier() override {
+    if (world_ == 1) return Status::Ok();
+    barrier_scratch_.resize(world_);
+    uint8_t token = 1;
+    return AllGather(&token, barrier_scratch_.data(), 1);
+  }
+
+  int rank() const override { return rank_; }
+  int world_size() const override { return world_; }
+
+ private:
+  // One ring step: recv from prev into recvbuf while sending sendbuf to
+  // next. Posts the irecv first; BOTH requests are waited before returning —
+  // even on error — because an abandoned in-flight request would let the
+  // caller free a buffer the stream workers still touch. When got==nullptr
+  // the step is fixed-size and a short receive (ranks disagreeing on counts)
+  // is an error, not silent stale-tail corruption.
+  Status Exchange(const void* sendbuf, size_t send_nbytes, void* recvbuf, size_t recv_nbytes,
+                  size_t* got) {
+    uint64_t rreq = 0, sreq = 0;
+    Status st = net_->irecv(recv_comm_, recvbuf, recv_nbytes, &rreq);
+    if (!st.ok()) return st;
+    st = net_->isend(send_comm_, sendbuf, send_nbytes, &sreq);
+    if (!st.ok()) {
+      WaitRequest(rreq, nullptr);  // quiesce the posted recv before unwinding
+      return st;
+    }
+    size_t rgot = 0;
+    Status r_st = WaitRequest(rreq, &rgot);
+    Status s_st = WaitRequest(sreq, nullptr);
+    if (!r_st.ok()) return r_st;
+    if (!s_st.ok()) return s_st;
+    if (got) {
+      *got = rgot;
+    } else if (rgot != recv_nbytes) {
+      return Status::Inner("ring step size mismatch: expected " + std::to_string(recv_nbytes) +
+                           "B from prev rank, got " + std::to_string(rgot) +
+                           "B (ranks disagree on collective arguments?)");
+    }
+    return Status::Ok();
+  }
+
+  // Wait out every pending send (ignoring their status) before surfacing
+  // `primary` — never abandon in-flight requests that reference caller
+  // buffers.
+  Status DrainSends(std::vector<uint64_t>& reqs, Status primary) {
+    for (uint64_t req : reqs) {
+      Status st = WaitRequest(req, nullptr);
+      if (primary.ok() && !st.ok()) primary = st;
+    }
+    reqs.clear();
+    return primary;
+  }
+
+  Status WaitRequest(uint64_t req, size_t* nbytes) {
+    bool done = false;
+    uint64_t polls = 0;
+    while (!done) {
+      Status st = net_->test(req, &done, nbytes);
+      if (!st.ok()) return st;
+      if (done) break;
+      // Poll hard briefly (small-message latency), then back off — a
+      // multi-second collective must not pin a core on test().
+      ++polls;
+      if (polls > 4096) {
+        std::this_thread::sleep_for(std::chrono::microseconds(50));
+      } else if (polls > 256) {
+        std::this_thread::yield();
+      }
+    }
+    return Status::Ok();
+  }
+
+  int rank_;
+  int world_;
+  std::unique_ptr<Net> net_;
+  std::unique_ptr<Bootstrap> bootstrap_;
+  uint64_t listen_comm_ = 0;
+  uint64_t send_comm_ = 0;
+  uint64_t recv_comm_ = 0;
+  // Scratch buffers reused across calls; a Communicator is not thread-safe
+  // (one collective at a time, like an MPI communicator).
+  std::vector<uint8_t> scratch_;
+  std::vector<uint8_t> work_;
+  std::vector<uint8_t> barrier_scratch_;
+};
+
+}  // namespace
+
+Status Communicator::Create(const std::string& coordinator, int rank, int world_size,
+                            std::unique_ptr<Communicator>* out) {
+  if (world_size < 1 || rank < 0 || rank >= world_size) {
+    return Status::Invalid("bad rank/world_size");
+  }
+  auto comm = std::make_unique<RingCommunicator>(rank, world_size);
+  Status s = comm->Init(coordinator);
+  if (!s.ok()) return s;
+  *out = std::move(comm);
+  return Status::Ok();
+}
+
+}  // namespace tpunet

@@ -1,0 +1,146 @@
+"""Multiprocess ring-collectives tests vs NumPy ground truth.
+
+N real OS processes rendezvous through the TCP bootstrap on 127.0.0.1 and
+run the same collective sequence; every rank checks results against a
+locally-computed NumPy reference (it knows all ranks' seeds).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import socket
+
+import numpy as np
+import pytest
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _rank_data(rank: int, n: int, dtype) -> np.ndarray:
+    rng = np.random.default_rng(42 + rank)
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        return rng.integers(-50, 50, size=n).astype(dtype)
+    return rng.standard_normal(n).astype(dtype)
+
+
+def _worker(rank: int, world: int, port: int, q) -> None:
+    try:
+        import ml_dtypes
+
+        from tpunet.collectives import Communicator
+
+        comm = Communicator(f"127.0.0.1:{port}", rank, world)
+        n = 40_003  # odd on purpose: uneven ring slices
+
+        # AllReduce sum f32 — bitwise-comparable because ring reduction order
+        # is identical on every rank.
+        mine = _rank_data(rank, n, np.float32)
+        got = comm.all_reduce(mine, "sum")
+        expect = sum(_rank_data(r, n, np.float32) for r in range(world))
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+
+        # AllReduce max f64.
+        mine64 = _rank_data(rank, n, np.float64)
+        got = comm.all_reduce(mine64, "max")
+        expect = np.max([_rank_data(r, n, np.float64) for r in range(world)], axis=0)
+        np.testing.assert_array_equal(got, expect)
+
+        # AllReduce sum i64 — exact.
+        mine_i = _rank_data(rank, n, np.int64)
+        got = comm.all_reduce(mine_i, "sum")
+        expect = sum(_rank_data(r, n, np.int64) for r in range(world))
+        np.testing.assert_array_equal(got, expect)
+
+        # AllReduce sum bf16 — loose tolerance (7-bit mantissa).
+        bf = np.dtype(ml_dtypes.bfloat16)
+        mine_bf = _rank_data(rank, 1024, np.float32).astype(bf)
+        got = comm.all_reduce(mine_bf, "sum").astype(np.float32)
+        expect = sum(_rank_data(r, 1024, np.float32).astype(bf).astype(np.float32)
+                     for r in range(world))
+        np.testing.assert_allclose(got, expect, rtol=0.1, atol=0.5)
+
+        # ReduceScatter sum.
+        per = 1000
+        full = np.concatenate([_rank_data(rank, per, np.float32) + r for r in range(world)])
+        got = comm.reduce_scatter(full.reshape(world, per), "sum")
+        expect = sum(
+            (_rank_data(r, per, np.float32) + rank) for r in range(world)
+        )
+        np.testing.assert_allclose(got.ravel(), expect, rtol=1e-5, atol=1e-5)
+
+        # AllGather.
+        shard = _rank_data(rank, 777, np.float32)
+        got = comm.all_gather(shard)
+        assert got.shape == (world, 777)
+        for r in range(world):
+            np.testing.assert_array_equal(got[r], _rank_data(r, 777, np.float32))
+
+        # Broadcast from a non-zero root, > one pipeline chunk.
+        root = world - 1
+        if rank == root:
+            payload = _rank_data(root, 3 * (1 << 20) // 4, np.float32)  # 3 MB
+        else:
+            payload = np.zeros(3 * (1 << 20) // 4, dtype=np.float32)
+        got = comm.broadcast(payload, root=root)
+        np.testing.assert_array_equal(got, _rank_data(root, 3 * (1 << 20) // 4, np.float32))
+
+        # NeighborExchange: receive prev rank's array.
+        mine_ne = _rank_data(rank, 5000, np.float32)
+        got = comm.neighbor_exchange(mine_ne)
+        prev = (rank - 1 + world) % world
+        np.testing.assert_array_equal(got, _rank_data(prev, 5000, np.float32))
+
+        # Barrier (just must not hang or error).
+        comm.barrier()
+
+        comm.close()
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001 — report to parent
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_ring_collectives(world):
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = _free_port()
+    procs = [ctx.Process(target=_worker, args=(r, world, port, q)) for r in range(world)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(world):
+            rank, status = q.get(timeout=180)
+            results[rank] = status
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.kill()
+    assert all(v == "OK" for v in results.values()), f"worker failures: {results}"
+    assert len(results) == world
+
+
+def test_world_size_one_shortcuts():
+    from tpunet.collectives import Communicator
+
+    with Communicator(f"127.0.0.1:{_free_port()}", 0, 1) as comm:
+        x = np.arange(100, dtype=np.float32)
+        np.testing.assert_array_equal(comm.all_reduce(x, "sum"), x)
+        np.testing.assert_array_equal(comm.all_gather(x)[0], x)
+        np.testing.assert_array_equal(comm.neighbor_exchange(x), x)
+        comm.barrier()
+
+
+def test_unsupported_dtype_raises():
+    from tpunet.collectives import Communicator
+
+    with Communicator(f"127.0.0.1:{_free_port()}", 0, 1) as comm:
+        with pytest.raises(TypeError):
+            comm.all_reduce(np.zeros(4, dtype=np.complex64))

@@ -128,6 +128,25 @@ def load() -> ctypes.CDLL:
     lib.tpunet_c_last_error.argtypes = []
     lib.tpunet_c_last_error.restype = ctypes.c_char_p
 
+    lib.tpunet_comm_create.argtypes = [ctypes.c_char_p, i32, i32, P(u)]
+    lib.tpunet_comm_create.restype = i32
+    lib.tpunet_comm_destroy.argtypes = [P(u)]
+    lib.tpunet_comm_destroy.restype = i32
+    lib.tpunet_comm_rank.argtypes = [u, P(i32), P(i32)]
+    lib.tpunet_comm_rank.restype = i32
+    lib.tpunet_comm_all_reduce.argtypes = [u, ctypes.c_void_p, ctypes.c_void_p, u64, i32, i32]
+    lib.tpunet_comm_all_reduce.restype = i32
+    lib.tpunet_comm_reduce_scatter.argtypes = [u, ctypes.c_void_p, ctypes.c_void_p, u64, i32, i32]
+    lib.tpunet_comm_reduce_scatter.restype = i32
+    lib.tpunet_comm_all_gather.argtypes = [u, ctypes.c_void_p, ctypes.c_void_p, u64]
+    lib.tpunet_comm_all_gather.restype = i32
+    lib.tpunet_comm_broadcast.argtypes = [u, ctypes.c_void_p, u64, i32]
+    lib.tpunet_comm_broadcast.restype = i32
+    lib.tpunet_comm_neighbor_exchange.argtypes = [u, ctypes.c_void_p, u64, ctypes.c_void_p, u64, P(u64)]
+    lib.tpunet_comm_neighbor_exchange.restype = i32
+    lib.tpunet_comm_barrier.argtypes = [u]
+    lib.tpunet_comm_barrier.restype = i32
+
     _lib = lib
     return lib
 

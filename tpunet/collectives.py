@@ -1,0 +1,183 @@
+"""Python collectives over the tpunet ring communicator.
+
+The role NCCL's algorithm layer played above the reference plugin, exposed
+to Python/NumPy. All ranks must call the same collectives in the same order
+(MPI semantics). Arrays must be C-contiguous; results come back as NumPy
+arrays of the input dtype.
+
+Supported dtypes: float32, float64, bfloat16 (via ml_dtypes), int32, int64,
+uint8. Ops: sum, prod, min, max.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Any
+
+import numpy as np
+
+from tpunet import _native
+
+try:  # bf16 is first-class on TPU; ml_dtypes ships with jax
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    _BF16 = None
+
+_OPS = {"sum": 0, "prod": 1, "min": 2, "max": 3}
+
+
+def _dtype_code(dt: np.dtype) -> int:
+    dt = np.dtype(dt)
+    if dt == np.float32:
+        return 0
+    if dt == np.float64:
+        return 1
+    if _BF16 is not None and dt == _BF16:
+        return 2
+    if dt == np.int32:
+        return 3
+    if dt == np.int64:
+        return 4
+    if dt == np.uint8:
+        return 5
+    raise TypeError(f"unsupported dtype for tpunet collectives: {dt}")
+
+
+def _c_contig(arr: np.ndarray) -> np.ndarray:
+    return arr if arr.flags.c_contiguous else np.ascontiguousarray(arr)
+
+
+class Communicator:
+    """Ring communicator; rank/world/coordinator default from env
+    (TPUNET_RANK/RANK, TPUNET_WORLD_SIZE/WORLD_SIZE, TPUNET_COORDINATOR)."""
+
+    def __init__(
+        self,
+        coordinator: str | None = None,
+        rank: int | None = None,
+        world_size: int | None = None,
+    ):
+        env = os.environ
+        coordinator = coordinator or env.get("TPUNET_COORDINATOR", "127.0.0.1:29500")
+        rank = rank if rank is not None else int(env.get("TPUNET_RANK", env.get("RANK", "0")))
+        world_size = (
+            world_size
+            if world_size is not None
+            else int(env.get("TPUNET_WORLD_SIZE", env.get("WORLD_SIZE", "1")))
+        )
+        self._lib = _native.load()
+        cid = ctypes.c_size_t(0)
+        _native.check(
+            self._lib.tpunet_comm_create(coordinator.encode(), rank, world_size, ctypes.byref(cid)),
+            "comm_create",
+        )
+        self._id = cid.value
+        self.rank = rank
+        self.world_size = world_size
+
+    # -- collectives -------------------------------------------------------
+
+    def all_reduce(self, arr: Any, op: str = "sum") -> np.ndarray:
+        arr = _c_contig(np.asarray(arr))
+        out = np.empty_like(arr)
+        _native.check(
+            self._lib.tpunet_comm_all_reduce(
+                self._id,
+                arr.ctypes.data if arr.size else None,
+                out.ctypes.data if out.size else None,
+                arr.size,
+                _dtype_code(arr.dtype),
+                _OPS[op],
+            ),
+            "all_reduce",
+        )
+        return out
+
+    def reduce_scatter(self, arr: Any, op: str = "sum") -> np.ndarray:
+        """arr: leading axis divisible by world_size; returns this rank's
+        reduced shard (shape[0] / world_size leading axis)."""
+        arr = _c_contig(np.asarray(arr))
+        if arr.shape[0] % self.world_size != 0:
+            raise ValueError(
+                f"leading axis {arr.shape[0]} not divisible by world size {self.world_size}"
+            )
+        out_shape = (arr.shape[0] // self.world_size,) + arr.shape[1:]
+        out = np.empty(out_shape, dtype=arr.dtype)
+        _native.check(
+            self._lib.tpunet_comm_reduce_scatter(
+                self._id,
+                arr.ctypes.data if arr.size else None,
+                out.ctypes.data if out.size else None,
+                out.size,
+                _dtype_code(arr.dtype),
+                _OPS[op],
+            ),
+            "reduce_scatter",
+        )
+        return out
+
+    def all_gather(self, arr: Any) -> np.ndarray:
+        """Returns shape (world_size, *arr.shape), rank-ordered."""
+        arr = _c_contig(np.asarray(arr))
+        out = np.empty((self.world_size,) + arr.shape, dtype=arr.dtype)
+        _native.check(
+            self._lib.tpunet_comm_all_gather(
+                self._id,
+                arr.ctypes.data if arr.size else None,
+                out.ctypes.data if out.size else None,
+                arr.nbytes,
+            ),
+            "all_gather",
+        )
+        return out
+
+    def broadcast(self, arr: Any, root: int = 0) -> np.ndarray:
+        arr = np.ascontiguousarray(np.asarray(arr)).copy()
+        _native.check(
+            self._lib.tpunet_comm_broadcast(
+                self._id, arr.ctypes.data if arr.size else None, arr.nbytes, root
+            ),
+            "broadcast",
+        )
+        return arr
+
+    def neighbor_exchange(self, arr: Any) -> np.ndarray:
+        """Send arr to (rank+1)%W, receive the same-shaped message from
+        (rank-1+W)%W — the ring-attention / sequence-parallel shift step."""
+        arr = _c_contig(np.asarray(arr))
+        out = np.empty_like(arr)
+        got = ctypes.c_uint64(0)
+        _native.check(
+            self._lib.tpunet_comm_neighbor_exchange(
+                self._id,
+                arr.ctypes.data if arr.size else None,
+                arr.nbytes,
+                out.ctypes.data if out.size else None,
+                out.nbytes,
+                ctypes.byref(got),
+            ),
+            "neighbor_exchange",
+        )
+        if got.value != arr.nbytes:
+            raise RuntimeError(
+                f"neighbor_exchange size mismatch: sent {arr.nbytes}, got {got.value}"
+            )
+        return out
+
+    def barrier(self) -> None:
+        _native.check(self._lib.tpunet_comm_barrier(self._id), "barrier")
+
+    def close(self) -> None:
+        if self._id:
+            cid = ctypes.c_size_t(self._id)
+            self._id = 0
+            _native.check(self._lib.tpunet_comm_destroy(ctypes.byref(cid)), "comm_destroy")
+
+    def __enter__(self) -> "Communicator":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
