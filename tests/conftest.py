@@ -6,7 +6,61 @@ must be set before the first jax import anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the driver environment pins JAX_PLATFORMS=axon
+# (the tunneled TPU), but the suite must run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon sitecustomize hook runs at interpreter start and overrides
+# jax_platforms to "axon,cpu" via jax.config.update — env alone cannot win.
+# Counter-override before any backend initializes, or every jax.devices()
+# call tries to bring up the TPU tunnel (and hangs the suite if it's down).
+# Guarded so the non-JAX tests (transport/collectives) still run without jax.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # pragma: no cover
+    pass
+
+
+import socket  # noqa: E402
+
+
+def free_port() -> int:
+    """Shared helper: an ephemeral 127.0.0.1 port for bootstrap coordinators."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_spawn_workers(target, world: int, timeout: float = 180.0, extra_args=()):
+    """Spawn `world` processes running target(rank, world, port, queue, *extra)
+    and assert every rank reports 'OK'. Shared by the multiprocess suites."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = free_port()
+    procs = [
+        ctx.Process(target=target, args=(r, world, port, q) + tuple(extra_args))
+        for r in range(world)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(world):
+            rank, status = q.get(timeout=timeout)
+            results[rank] = status
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.kill()
+    assert all(v == "OK" for v in results.values()), f"worker failures: {results}"
+    assert len(results) == world

@@ -7,19 +7,10 @@ locally-computed NumPy reference (it knows all ranks' seeds).
 
 from __future__ import annotations
 
-import multiprocessing as mp
-import socket
-
 import numpy as np
 import pytest
 
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from conftest import free_port, run_spawn_workers
 
 
 def _rank_data(rank: int, n: int, dtype) -> np.ndarray:
@@ -107,30 +98,13 @@ def _worker(rank: int, world: int, port: int, q) -> None:
 
 @pytest.mark.parametrize("world", [2, 4])
 def test_ring_collectives(world):
-    ctx = mp.get_context("spawn")
-    q = ctx.Queue()
-    port = _free_port()
-    procs = [ctx.Process(target=_worker, args=(r, world, port, q)) for r in range(world)]
-    for p in procs:
-        p.start()
-    results = {}
-    try:
-        for _ in range(world):
-            rank, status = q.get(timeout=180)
-            results[rank] = status
-    finally:
-        for p in procs:
-            p.join(timeout=30)
-            if p.is_alive():
-                p.kill()
-    assert all(v == "OK" for v in results.values()), f"worker failures: {results}"
-    assert len(results) == world
+    run_spawn_workers(_worker, world)
 
 
 def test_world_size_one_shortcuts():
     from tpunet.collectives import Communicator
 
-    with Communicator(f"127.0.0.1:{_free_port()}", 0, 1) as comm:
+    with Communicator(f"127.0.0.1:{free_port()}", 0, 1) as comm:
         x = np.arange(100, dtype=np.float32)
         np.testing.assert_array_equal(comm.all_reduce(x, "sum"), x)
         np.testing.assert_array_equal(comm.all_gather(x)[0], x)
@@ -141,6 +115,6 @@ def test_world_size_one_shortcuts():
 def test_unsupported_dtype_raises():
     from tpunet.collectives import Communicator
 
-    with Communicator(f"127.0.0.1:{_free_port()}", 0, 1) as comm:
+    with Communicator(f"127.0.0.1:{free_port()}", 0, 1) as comm:
         with pytest.raises(TypeError):
             comm.all_reduce(np.zeros(4, dtype=np.complex64))

@@ -1,0 +1,84 @@
+"""Process-group lifecycle for tpunet (the `jax.distributed`-style entry).
+
+One global ring communicator per process, created from env or explicit
+arguments. The JAX integration (tpunet.interop) routes cross-host DCN
+collectives through it; in-pod (ICI) collectives stay with XLA
+(`jax.lax.psum` over the device mesh) — matching the reference's division
+of labor, where NCCL handled in-node NVLink and the plugin handled the
+cross-host TCP path (SURVEY §5 "Distributed communication backend").
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+
+from tpunet.collectives import Communicator
+
+_lock = threading.Lock()
+_comm: Communicator | None = None
+_comm_args: tuple | None = None
+
+
+def initialize(
+    coordinator: str | None = None,
+    rank: int | None = None,
+    world_size: int | None = None,
+) -> Communicator:
+    """Create (or return) the process-global communicator.
+
+    Collective across processes: every process of the job must call it.
+    Defaults from env: TPUNET_COORDINATOR, TPUNET_RANK/RANK,
+    TPUNET_WORLD_SIZE/WORLD_SIZE.
+    """
+    global _comm, _comm_args
+    with _lock:
+        if _comm is None:
+            _comm = Communicator(coordinator, rank, world_size)
+            _comm_args = (coordinator, rank, world_size)
+        elif (coordinator, rank, world_size) != _comm_args and any(
+            a is not None for a in (coordinator, rank, world_size)
+        ):
+            raise RuntimeError(
+                f"tpunet.distributed already initialized with {_comm_args}; "
+                f"got conflicting ({coordinator}, {rank}, {world_size}) — call "
+                "finalize() first to re-initialize"
+            )
+        return _comm
+
+
+def is_initialized() -> bool:
+    return _comm is not None
+
+
+def global_communicator() -> Communicator:
+    if _comm is None:
+        raise RuntimeError(
+            "tpunet.distributed.initialize() has not been called in this process"
+        )
+    return _comm
+
+
+def finalize() -> None:
+    global _comm, _comm_args
+    with _lock:
+        if _comm is not None:
+            _comm.close()
+            _comm = None
+            _comm_args = None
+
+
+def rank() -> int:
+    return global_communicator().rank
+
+
+def world_size() -> int:
+    return global_communicator().world_size
+
+
+@atexit.register
+def _cleanup() -> None:  # pragma: no cover - interpreter teardown
+    try:
+        finalize()
+    except Exception:
+        pass

@@ -1,0 +1,145 @@
+"""JAX ↔ tpunet interop: cross-host collectives inside jitted programs.
+
+XLA has no NCCL-style net-plugin seam (SURVEY §7 hard-part #1), so the
+cross-host path enters jitted code via `jax.experimental.io_callback`:
+device buffers are staged to host, the ring communicator moves/reduces them
+over the multi-stream DCN transport, and the result is staged back. In-pod
+(ICI) collectives should keep using `jax.lax.psum` et al. — these functions
+are the *between-hosts* tier of a hierarchical collective.
+
+All ranks must execute the same dcn_* calls in the same order (the
+callbacks are `ordered=True`, which pins their relative order inside a
+trace). `dcn_all_reduce(sum)` is differentiable: the VJP of a sum
+all-reduce is a sum all-reduce of the cotangent.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import io_callback
+
+from tpunet import distributed
+
+
+def _comm():
+    return distributed.global_communicator()
+
+
+def _callback_result_spec(x: jax.Array | jnp.ndarray):
+    return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
+
+
+# -- all-reduce -------------------------------------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def dcn_all_reduce(x, op: str = "sum"):
+    """AllReduce `x` across all processes over the DCN transport."""
+    return _dcn_all_reduce_impl(x, op)
+
+
+def _dcn_all_reduce_impl(x, op: str):
+    def cb(a):
+        return _comm().all_reduce(np.asarray(a), op)
+
+    return io_callback(cb, _callback_result_spec(x), x, ordered=True)
+
+
+def _dcn_all_reduce_fwd(x, op: str):
+    if op != "sum":
+        raise NotImplementedError(f"gradient of dcn_all_reduce only defined for sum, got {op}")
+    return _dcn_all_reduce_impl(x, op), None
+
+
+def _dcn_all_reduce_bwd(op: str, _res, g):
+    return (_dcn_all_reduce_impl(g, "sum"),)
+
+
+dcn_all_reduce.defvjp(_dcn_all_reduce_fwd, _dcn_all_reduce_bwd)
+
+
+def dcn_psum(x):
+    """`jax.lax.psum` shape, but across processes over DCN."""
+    return dcn_all_reduce(x, "sum")
+
+
+def dcn_pmean(x):
+    w = distributed.world_size()
+    return dcn_all_reduce(x, "sum") / jnp.asarray(w, dtype=jnp.result_type(x))
+
+
+# -- other collectives ------------------------------------------------------
+
+
+def dcn_all_gather(x):
+    """Gather `x` from every process: result shape (world, *x.shape)."""
+    w = distributed.world_size()
+
+    def cb(a):
+        return _comm().all_gather(np.asarray(a))
+
+    spec = jax.ShapeDtypeStruct((w,) + tuple(jnp.shape(x)), jnp.result_type(x))
+    return io_callback(cb, spec, x, ordered=True)
+
+
+def dcn_reduce_scatter(x, op: str = "sum"):
+    """x: leading axis divisible by world; returns this process's reduced
+    shard (shape[0]/world leading axis)."""
+    w = distributed.world_size()
+    shape = tuple(jnp.shape(x))
+    if shape[0] % w != 0:
+        raise ValueError(f"leading axis {shape[0]} not divisible by world size {w}")
+
+    def cb(a):
+        return _comm().reduce_scatter(np.asarray(a), op)
+
+    spec = jax.ShapeDtypeStruct((shape[0] // w,) + shape[1:], jnp.result_type(x))
+    return io_callback(cb, spec, x, ordered=True)
+
+
+def dcn_broadcast(x, root: int = 0):
+    def cb(a):
+        return _comm().broadcast(np.asarray(a), root)
+
+    return io_callback(cb, _callback_result_spec(x), x, ordered=True)
+
+
+def dcn_neighbor_exchange(x):
+    """Send x to (rank+1)%world, receive from (rank-1+world)%world — the
+    ring-shift step of ring attention / sequence parallelism, across hosts."""
+
+    def cb(a):
+        return _comm().neighbor_exchange(np.asarray(a))
+
+    return io_callback(cb, _callback_result_spec(x), x, ordered=True)
+
+
+def dcn_barrier():
+    """Host-level barrier (outside jit)."""
+    _comm().barrier()
+
+
+# -- hierarchical helper ----------------------------------------------------
+
+
+def hierarchical_psum(x, axis_name: str | None = None):
+    """Two-tier psum: `lax.psum` over the in-pod mesh axis (ICI, XLA
+    collectives), then a DCN all-reduce across processes. This is the shape
+    a v5e-32 (4 hosts x 8 chips) gradient sync takes: ICI does the heavy
+    intra-pod reduction at interconnect speed, DCN carries one
+    already-reduced copy per host.
+
+    Requires `tpunet.distributed.initialize()` BEFORE the first trace: the
+    world-size decision is baked into the jitted executable, so a lazy
+    "skip DCN when uninitialized" fallback would silently cache an unsynced
+    gradient step if tracing ever preceded initialization.
+    """
+    if axis_name is not None:
+        x = jax.lax.psum(x, axis_name)
+    if distributed.world_size() > 1:  # raises if initialize() was not called
+        x = dcn_all_reduce(x, "sum")
+    return x
