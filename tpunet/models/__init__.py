@@ -1,0 +1,9 @@
+"""Model zoo for tpunet benchmarks.
+
+The reference's end-to-end benchmark is data-parallel VGG16 synthetic
+training (reference: README.md:52-84, 4046 img/s on 32 V100 with the
+multi-stream transport vs 2744 baseline); VGG16 is therefore the flagship
+model here, built TPU-first in flax (bf16-friendly, MXU-sized matmuls).
+"""
+
+from tpunet.models.vgg import VGG, VGG16, vgg16  # noqa: F401

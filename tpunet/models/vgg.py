@@ -1,0 +1,79 @@
+"""VGG — TPU-first flax implementation.
+
+Mirrors the capability of the reference's benchmark workload (Bagua's
+synthetic_benchmark.py VGG16, reference README.md:52) without copying any
+torch code: conv stacks run in NHWC (TPU-native layout), compute dtype is
+configurable (bfloat16 by default for the MXU — params stay f32), and the
+classifier is expressed as two large matmuls that tensor-parallel sharding
+can split over the `mdl` mesh axis (column- then row-parallel, the
+Megatron pattern — XLA inserts the collectives from the shardings).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Channel plan per block; "M" = 2x2 max-pool. The classic 16-layer config.
+VGG16_CFG: tuple = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M", 512, 512, 512, "M")
+
+
+class VGG(nn.Module):
+    """VGG-style conv net.
+
+    Attributes:
+      cfg: channel plan (ints = 3x3 conv channels, "M" = maxpool).
+      num_classes: classifier output size.
+      width_mult: scales every channel count (tiny configs for tests).
+      hidden: classifier hidden width (4096 in the paper config).
+      compute_dtype: activations/matmul dtype (bf16 keeps the MXU fed;
+        params remain float32 and XLA casts per-op).
+      classifier_dropout: train-mode dropout rate in the head.
+    """
+
+    cfg: Sequence = VGG16_CFG
+    num_classes: int = 1000
+    width_mult: float = 1.0
+    hidden: int = 4096
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    classifier_dropout: float = 0.5
+
+    def _width(self, c: int) -> int:
+        return max(8, int(c * self.width_mult)) if self.width_mult != 1.0 else c
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        """x: NHWC images. Returns (batch, num_classes) float32 logits."""
+        dt = self.compute_dtype
+        x = x.astype(dt)
+        conv_i = 0
+        for item in self.cfg:
+            if item == "M":
+                x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(self._width(item), kernel_size=(3, 3), padding=1, dtype=dt,
+                            name=f"conv{conv_i}")(x)
+                x = nn.relu(x)
+                conv_i += 1
+        x = x.reshape((x.shape[0], -1))  # flatten
+        hidden = self._width(self.hidden)
+        # Two big matmuls: fc1 column-parallel / fc2 row-parallel under TP.
+        x = nn.Dense(hidden, dtype=dt, name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.classifier_dropout, deterministic=not train)(x)
+        x = nn.Dense(hidden, dtype=dt, name="fc2")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.classifier_dropout, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=dt, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def vgg16(num_classes: int = 1000, width_mult: float = 1.0,
+          compute_dtype=jnp.bfloat16) -> VGG:
+    return VGG(cfg=VGG16_CFG, num_classes=num_classes, width_mult=width_mult,
+               compute_dtype=compute_dtype)
+
+
+VGG16 = vgg16  # alias

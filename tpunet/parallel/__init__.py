@@ -1,0 +1,17 @@
+"""Parallelism layer: device meshes, sharding rules, and the DP/TP/SP
+building blocks for multi-chip training.
+
+In-pod (ICI) parallelism is expressed through `jax.sharding` — pick a mesh,
+annotate shardings, let XLA insert the collectives. Cross-host (DCN)
+parallelism rides the tpunet transport via `tpunet.interop`. This split
+mirrors the reference stack, where NCCL handled intra-node NVLink and the
+reference plugin carried the inter-node TCP traffic (SURVEY §5).
+"""
+
+from tpunet.parallel.mesh import (  # noqa: F401
+    batch_sharding,
+    make_mesh,
+    replicated,
+    shard_params,
+    vgg_partition_rules,
+)

@@ -1,0 +1,10 @@
+"""Training stack: jitted DP/TP train step + synthetic benchmark workload
+(the reference's end-to-end validation was Bagua's VGG16
+synthetic_benchmark.py, reference README.md:52)."""
+
+from tpunet.train.trainer import (  # noqa: F401
+    TrainState,
+    create_train_state,
+    make_train_step,
+    synthetic_batch,
+)
