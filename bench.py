@@ -1,0 +1,133 @@
+"""tpunet headline benchmark (driver entry).
+
+Measures the framework's headline metric — ring AllReduce bus bandwidth over
+the multi-stream DCN transport — in the reference's own terms: a 128 MiB
+AllReduce between 2 ranks, multi-stream engine vs the single-stream baseline
+(the configuration stock NCCL-TCP / gRPC-DCN uses one connection per peer;
+reference headline: +50% AllReduce throughput from multi-stream striping,
+reference README.md:50).
+
+Prints ONE JSON line:
+  {"metric": "allreduce_busbw_128MiB", "value": <GB/s multi-stream>,
+   "unit": "GB/s", "vs_baseline": <multi-stream busbw / single-stream busbw>}
+
+busbw follows the nccl-tests definition for AllReduce: 2*(W-1)/W * bytes / t.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing as mp
+import os
+import socket
+import sys
+import time
+
+NBYTES = 128 << 20  # 128 MiB, the top of the reference's sweep (-e 128M)
+WORLD = 2
+WARMUP = 2
+ITERS = 6
+MULTI_NSTREAMS = 4
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _worker(rank: int, world: int, port: int, nstreams: int, q) -> None:
+    try:
+        os.environ["TPUNET_NSTREAMS"] = str(nstreams)
+        os.environ.setdefault("TPUNET_MIN_CHUNKSIZE", str(1 << 20))
+        import numpy as np
+
+        from tpunet.collectives import Communicator
+
+        comm = Communicator(
+            coordinator=f"127.0.0.1:{port}", rank=rank, world_size=world
+        )
+        n = NBYTES // 4
+        arr = np.full(n, float(rank + 1), dtype=np.float32)
+        times = []
+        for it in range(WARMUP + ITERS):
+            comm.barrier()
+            t0 = time.perf_counter()
+            out = comm.all_reduce(arr)
+            dt = time.perf_counter() - t0
+            if it >= WARMUP:
+                times.append(dt)
+        expect = float(sum(r + 1 for r in range(world)))
+        if out[0] != expect or out[-1] != expect:
+            raise RuntimeError(f"allreduce wrong result: {out[0]} != {expect}")
+        comm.close()
+        q.put((rank, "OK", times))
+    except Exception as e:  # surface the failure to the parent
+        q.put((rank, f"ERR: {e!r}", []))
+
+
+def _run_config(nstreams: int) -> float:
+    """Returns busbw in GB/s (best iteration, nccl-tests convention)."""
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = _free_port()
+    procs = [
+        ctx.Process(target=_worker, args=(r, WORLD, port, nstreams, q))
+        for r in range(WORLD)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(WORLD):
+            rank, status, times = q.get(timeout=300)
+            results[rank] = (status, times)
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.kill()
+    for rank, (status, _) in sorted(results.items()):
+        if status != "OK":
+            raise RuntimeError(f"rank {rank} failed: {status}")
+    # Per iteration both ranks measure the same collective; use the max of the
+    # per-rank times (the collective isn't done until the slowest rank is),
+    # then the best iteration, as nccl-tests does with its min/avg columns.
+    per_iter = [
+        max(results[r][1][i] for r in range(WORLD)) for i in range(ITERS)
+    ]
+    best = min(per_iter)
+    busbw_factor = 2.0 * (WORLD - 1) / WORLD
+    return busbw_factor * NBYTES / best / 1e9
+
+
+def main() -> None:
+    # Make sure the native library exists before timing anything.
+    from tpunet import _native
+
+    _native.build_native()
+
+    baseline = _run_config(nstreams=1)
+    multi = _run_config(nstreams=MULTI_NSTREAMS)
+    print(
+        f"[bench] single-stream {baseline:.3f} GB/s, "
+        f"{MULTI_NSTREAMS}-stream {multi:.3f} GB/s "
+        f"({multi / baseline:.2f}x)",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "allreduce_busbw_128MiB",
+                "value": round(multi, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(multi / baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -2,21 +2,16 @@
 
 from __future__ import annotations
 
-import os
-
 import numpy as np
 import pytest
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-import jax  # noqa: E402
+# conftest.py forces JAX_PLATFORMS=cpu + the 8-device XLA flag before any
+# test module is imported.
+import jax
+import jax.numpy as jnp
+import optax
 
-jax.config.update("jax_platforms", "cpu")
-
-import jax.numpy as jnp  # noqa: E402
-import optax  # noqa: E402
-
-from conftest import run_spawn_workers  # noqa: E402
+from conftest import run_spawn_workers
 
 
 def _tiny_model():
@@ -134,7 +129,9 @@ def _dp_worker(rank: int, world: int, port: int, q) -> None:
             )
         # After synced-gradient steps from identical init, params must be
         # identical across ranks (the DP invariant).
-        flat, _ = jax.flatten_util.ravel_pytree(state.params)
+        from jax.flatten_util import ravel_pytree
+
+        flat, _ = ravel_pytree(state.params)
         from tpunet.interop import dcn_all_gather
 
         all_params = np.asarray(dcn_all_gather(flat))

@@ -82,7 +82,8 @@ def shard_params(params, mesh: Mesh, rules: Sequence[tuple[str, P]] | None = Non
         for dim, axis in enumerate(spec):
             if axis is None:
                 continue
-            size = mesh.shape[axis] if isinstance(axis, str) else 1
+            axes = (axis,) if isinstance(axis, str) else tuple(axis)
+            size = int(np.prod([mesh.shape[a] for a in axes]))
             if dim >= leaf.ndim or leaf.shape[dim] % size != 0:
                 return NamedSharding(mesh, P())
         return NamedSharding(mesh, spec)
