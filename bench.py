@@ -17,11 +17,11 @@ busbw follows the nccl-tests definition for AllReduce: 2*(W-1)/W * bytes / t.
 from __future__ import annotations
 
 import json
-import multiprocessing as mp
 import os
-import socket
 import sys
 import time
+
+from benchmarks import spawn_ranks
 
 NBYTES = 128 << 20  # 128 MiB, the top of the reference's sweep (-e 128M)
 WORLD = 2
@@ -30,15 +30,7 @@ ITERS = 6
 MULTI_NSTREAMS = 4
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def _worker(rank: int, world: int, port: int, nstreams: int, q) -> None:
+def _worker(rank: int, world: int, port: int, q, nstreams: int) -> None:
     try:
         os.environ["TPUNET_NSTREAMS"] = str(nstreams)
         os.environ.setdefault("TPUNET_MIN_CHUNKSIZE", str(1 << 20))
@@ -63,32 +55,14 @@ def _worker(rank: int, world: int, port: int, nstreams: int, q) -> None:
         if out[0] != expect or out[-1] != expect:
             raise RuntimeError(f"allreduce wrong result: {out[0]} != {expect}")
         comm.close()
-        q.put((rank, "OK", times))
+        q.put((rank, ("OK", times)))
     except Exception as e:  # surface the failure to the parent
-        q.put((rank, f"ERR: {e!r}", []))
+        q.put((rank, (f"ERR: {e!r}", [])))
 
 
 def _run_config(nstreams: int) -> float:
     """Returns busbw in GB/s (best iteration, nccl-tests convention)."""
-    ctx = mp.get_context("spawn")
-    q = ctx.Queue()
-    port = _free_port()
-    procs = [
-        ctx.Process(target=_worker, args=(r, WORLD, port, nstreams, q))
-        for r in range(WORLD)
-    ]
-    for p in procs:
-        p.start()
-    results = {}
-    try:
-        for _ in range(WORLD):
-            rank, status, times = q.get(timeout=300)
-            results[rank] = (status, times)
-    finally:
-        for p in procs:
-            p.join(timeout=30)
-            if p.is_alive():
-                p.kill()
+    results = spawn_ranks(_worker, WORLD, extra_args=(nstreams,), timeout=300)
     for rank, (status, _) in sorted(results.items()):
         if status != "OK":
             raise RuntimeError(f"rank {rank} failed: {status}")

@@ -1,0 +1,43 @@
+"""Shared multiprocess launch harness for the benchmark entrypoints."""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import socket
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def spawn_ranks(target, world: int, extra_args=(), timeout: float = 600.0) -> dict:
+    """Spawn `world` processes running target(rank, world, port, queue, *extra).
+
+    Each worker must post (rank, payload) to the queue exactly once. Returns
+    {rank: payload}. Workers are always joined/killed, even if a rank dies
+    without reporting (a native-layer crash posts nothing).
+    """
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = free_port()
+    procs = [
+        ctx.Process(target=target, args=(r, world, port, q) + tuple(extra_args))
+        for r in range(world)
+    ]
+    for p in procs:
+        p.start()
+    results: dict = {}
+    try:
+        for _ in range(world):
+            rank, payload = q.get(timeout=timeout)
+            results[rank] = payload
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.kill()
+    return results

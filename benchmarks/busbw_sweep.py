@@ -1,0 +1,223 @@
+"""all_reduce_perf-style bandwidth sweep over the tpunet transport.
+
+The in-repo replacement for the external harness the reference relied on
+(nccl-tests `all_reduce_perf -b 8 -e 128M -f 2 -g 1` under mpirun,
+reference README.md:20-44). Sweeps message sizes 8 B -> 128 MiB (x2 steps by
+default) and prints the familiar table: size, count, time, algbw, busbw.
+
+Modes:
+  --op p2p            raw isend/irecv one-way stream between 2 ranks
+  --op allreduce      ring AllReduce        (busbw = algbw * 2(W-1)/W)
+  --op allgather      ring AllGather        (busbw = algbw * (W-1)/W)
+  --op reducescatter  ring ReduceScatter    (busbw = algbw * (W-1)/W)
+
+Launching:
+  Local loopback (spawns -n worker processes itself):
+      python -m benchmarks.busbw_sweep --op allreduce -n 2 --nstreams 4
+  Multi-host (one process per host, like mpirun): set TPUNET_RANK,
+  TPUNET_WORLD_SIZE, TPUNET_COORDINATOR and pass --external.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from benchmarks import spawn_ranks
+
+
+def parse_size(s: str) -> int:
+    s = s.strip().upper()
+    mult = 1
+    for suffix, m in (("G", 1 << 30), ("M", 1 << 20), ("K", 1 << 10)):
+        if s.endswith(suffix):
+            mult = m
+            s = s[: -len(suffix)]
+            break
+    return int(float(s) * mult)
+
+
+def sweep_sizes(begin: int, end: int, factor: int) -> list[int]:
+    sizes = []
+    n = max(begin, 1)
+    while n <= end:
+        sizes.append(n)
+        n *= factor
+    return sizes
+
+
+def _busbw_factor(op: str, world: int) -> float:
+    if op == "allreduce":
+        return 2.0 * (world - 1) / world
+    if op in ("allgather", "reducescatter"):
+        return float(world - 1) / world
+    return 1.0  # p2p
+
+
+def _run_collective_rank(rank, world, coordinator, args, emit):
+    import numpy as np
+
+    from tpunet.collectives import Communicator
+
+    comm = Communicator(coordinator=coordinator, rank=rank, world_size=world)
+    rows = []
+    for nbytes in sweep_sizes(args.begin, args.end, args.factor):
+        # nccl-tests convention: `size` is the TOTAL vector size S. For
+        # AllGather/ReduceScatter each rank's shard is S/W; algbw = S/t and
+        # busbw = algbw * (W-1)/W for both, 2(W-1)/W for AllReduce.
+        count = max(nbytes // 4, 1)
+        if args.op == "allgather":
+            shard = np.full(max(count // world, 1), float(rank + 1), np.float32)
+            count = shard.size * world
+            run = lambda: comm.all_gather(shard)
+        elif args.op == "reducescatter":
+            big = np.full(max(count // world, 1) * world, float(rank + 1), np.float32)
+            count = big.size
+            run = lambda: comm.reduce_scatter(big)
+        else:
+            arr = np.full(count, float(rank + 1), np.float32)
+            run = lambda: comm.all_reduce(arr)
+        iters = args.iters if nbytes >= (1 << 16) else args.iters * 4
+        for _ in range(args.warmup):
+            run()
+        comm.barrier()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = run()
+        comm.barrier()
+        dt = (time.perf_counter() - t0) / iters
+        if args.op == "allreduce":
+            expect = sum(r + 1 for r in range(world))
+            assert out[0] == expect, f"bad allreduce result {out[0]} != {expect}"
+        rows.append((count * 4, count, dt))
+    comm.close()
+    if rank == 0:
+        emit(rows, world)
+
+
+def _run_p2p_rank(rank, world, coordinator, args, emit):
+    """One-way stream: rank 0 sends, rank 1 receives; handles swap over the
+    collectives bootstrap (the role NCCL's OOB bootstrap played)."""
+    import numpy as np
+
+    from tpunet.collectives import Communicator
+    from tpunet.transport import Net
+
+    assert world == 2, "p2p sweep needs exactly 2 ranks"
+    boot = Communicator(coordinator=coordinator, rank=rank, world_size=world)
+    net = Net()
+    listen = net.listen()
+    handles = boot.all_gather(np.frombuffer(listen.handle, np.uint8))
+    peer = bytes(handles[1 - rank].tobytes())
+    if rank == 0:
+        send = net.connect(peer)
+        boot.barrier()
+        recv = listen.accept()
+    else:
+        boot.barrier()
+        recv = listen.accept()
+        send = net.connect(peer)
+
+    rows = []
+    depth = 4  # keep a few requests in flight, like NCCL's proxy (<=8)
+    for nbytes in sweep_sizes(args.begin, args.end, args.factor):
+        buf = np.ones(nbytes, np.uint8)
+        out = np.empty(nbytes, np.uint8)
+        iters = args.iters if nbytes >= (1 << 16) else args.iters * 4
+        boot.barrier()
+        t0 = time.perf_counter()
+        pending = []
+        for _ in range(iters):
+            if rank == 0:
+                pending.append(send.isend(buf))
+            else:
+                pending.append(recv.irecv(out))
+            if len(pending) >= depth:
+                pending.pop(0).wait()
+        for r in pending:
+            r.wait()
+        boot.barrier()
+        dt = (time.perf_counter() - t0) / iters
+        rows.append((nbytes, nbytes, dt))
+    send.close()
+    recv.close()
+    listen.close()
+    net.close()
+    boot.close()
+    if rank == 0:
+        emit(rows, world)
+
+
+def _emit_table(args):
+    def emit(rows, world):
+        factor = _busbw_factor(args.op, world)
+        print(f"# tpunet {args.op} sweep  world={world} "
+              f"nstreams={os.environ.get('TPUNET_NSTREAMS', '2')} "
+              f"engine={os.environ.get('TPUNET_IMPLEMENT', 'BASIC')}")
+        print(f"# {'size':>12} {'count':>12} {'time(us)':>12} "
+              f"{'algbw(GB/s)':>12} {'busbw(GB/s)':>12}")
+        out = []
+        for nbytes, count, dt in rows:
+            algbw = nbytes / dt / 1e9
+            busbw = algbw * factor
+            print(f"  {nbytes:>12} {count:>12} {dt * 1e6:>12.1f} "
+                  f"{algbw:>12.3f} {busbw:>12.3f}")
+            out.append({"bytes": nbytes, "time_us": dt * 1e6,
+                        "algbw_gbps": algbw, "busbw_gbps": busbw})
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump({"op": args.op, "world": world, "rows": out}, f)
+    return emit
+
+
+def _worker(rank, world, port, q, args):
+    try:
+        if args.nstreams:
+            os.environ["TPUNET_NSTREAMS"] = str(args.nstreams)
+        run = _run_p2p_rank if args.op == "p2p" else _run_collective_rank
+        run(rank, world, f"127.0.0.1:{port}", args, _emit_table(args))
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--op", default="allreduce",
+                    choices=["p2p", "allreduce", "allgather", "reducescatter"])
+    ap.add_argument("-b", "--begin", type=parse_size, default=8)
+    ap.add_argument("-e", "--end", type=parse_size, default=128 << 20)
+    ap.add_argument("-f", "--factor", type=int, default=2)
+    ap.add_argument("-n", "--world", type=int, default=2)
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--warmup", type=int, default=1)
+    ap.add_argument("--nstreams", type=int, default=0, help="override TPUNET_NSTREAMS")
+    ap.add_argument("--json", default="", help="also dump rows to this file")
+    ap.add_argument("--external", action="store_true",
+                    help="run as one rank; rank/world/coordinator from env")
+    args = ap.parse_args()
+
+    from tpunet import _native
+
+    _native.build_native()
+
+    if args.external:
+        rank = int(os.environ.get("TPUNET_RANK", os.environ.get("RANK", "0")))
+        world = int(os.environ.get("TPUNET_WORLD_SIZE", os.environ.get("WORLD_SIZE", "1")))
+        coord = os.environ.get("TPUNET_COORDINATOR", "127.0.0.1:29500")
+        run = _run_p2p_rank if args.op == "p2p" else _run_collective_rank
+        run(rank, world, coord, args, _emit_table(args))
+        return
+
+    results = spawn_ranks(_worker, args.world, extra_args=(args,), timeout=3600)
+    fails = [(r, s) for r, s in sorted(results.items()) if s != "OK"]
+    if fails:
+        print(f"FAILED ranks: {fails}", file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
