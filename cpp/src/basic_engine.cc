@@ -20,13 +20,10 @@
 //     consumes the id.
 //
 // Deliberate improvements over the reference (documented deltas):
-//   * Wire preamble: every connection opens with
-//     [magic u64 | bundle_id u64 | stream_id u64 | nstreams u64 |
-//     min_chunksize u64] (40B, BE) instead of a bare stream id (reference
-//     :327). This (a) lets several
-//     connect() bundles target one listen socket concurrently without
-//     interleaving, (b) carries nstreams so sender/receiver cannot disagree,
-//     (c) catches protocol mismatch via the magic.
+//   * Wire preamble carries bundle id + nstreams + min_chunksize (wire.h) —
+//     concurrent senders on one listen socket, no config divergence, magic
+//     check. Shared with the EPOLL engine, so the two engines interoperate
+//     (the reference's BASIC/TOKIO were wire-incompatible).
 //   * Blocking sockets by default instead of the reference's nonblocking
 //     busy-poll spin (reference utils.rs:132-178) — a TPU host shares cores
 //     with the trainer; TPUNET_SPIN=1 restores spin mode for latency hunts.
@@ -34,72 +31,26 @@
 //     sharded maps, test() touches only atomics.
 //   * Request ids are freed on completion (reference leaked them:
 //     cc/bagua_net.cc:111-121).
-#include <arpa/inet.h>
-#include <errno.h>
-#include <netinet/in.h>
-#include <netinet/tcp.h>
-#include <poll.h>
 #include <string.h>
-#include <sys/eventfd.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
 #include <atomic>
-#include <chrono>
 #include <condition_variable>
 #include <deque>
-#include <map>
 #include <memory>
 #include <mutex>
-#include <random>
 #include <thread>
 #include <vector>
 
+#include "engine_base.h"
 #include "id_map.h"
 #include "tpunet/net.h"
 #include "tpunet/utils.h"
+#include "wire.h"
 
 namespace tpunet {
 namespace {
-
-constexpr uint64_t kWireMagic = 0x7470756e65743102ull;  // "tpunet" + wire ver 2
-constexpr int kListenBacklog = 16384;  // reference: nthread:101
-constexpr uint64_t kMaxStreams = 256;  // sanity bound on peer-supplied nstreams
-
-socklen_t AddrLenForFamily(const sockaddr_storage& ss) {
-  return ss.ss_family == AF_INET6 ? sizeof(sockaddr_in6) : sizeof(sockaddr_in);
-}
-
-// ---------------------------------------------------------------------------
-// Request state: lock-free completion accounting.
-// Reference: RequestState{nsubtasks, completed_subtasks, nbytes_transferred,
-// err} (nthread:54-60). `total` doubles as the "scheduled" flag: UINT64_MAX
-// until the scheduler has chunked the message.
-struct RequestState {
-  std::atomic<uint64_t> total{UINT64_MAX};
-  std::atomic<uint64_t> completed{0};
-  std::atomic<uint64_t> nbytes{0};
-  std::atomic<bool> failed{false};
-  std::mutex err_mu;
-  std::string err_msg;
-
-  void SetError(const std::string& m) {
-    {
-      std::lock_guard<std::mutex> lk(err_mu);
-      if (err_msg.empty()) err_msg = m;
-    }
-    failed.store(true, std::memory_order_release);
-  }
-  std::string ErrorMsg() {
-    std::lock_guard<std::mutex> lk(err_mu);
-    return err_msg;
-  }
-  bool Done() const {
-    uint64_t t = total.load(std::memory_order_acquire);
-    return t != UINT64_MAX && completed.load(std::memory_order_acquire) >= t;
-  }
-};
-using RequestPtr = std::shared_ptr<RequestState>;
 
 // MPSC blocking queue with close semantics (stands in for the reference's
 // flume channels, nthread:224-226). Pop returns false only when closed AND
@@ -212,41 +163,6 @@ struct Comm {
 };
 using CommPtr = std::shared_ptr<Comm>;
 
-// Parked connection bundle on a listen comm, keyed by bundle id, until all
-// nstreams+1 members have arrived.
-struct PartialBundle {
-  uint64_t nstreams = UINT64_MAX;
-  uint64_t min_chunksize = 0;
-  int ctrl_fd = -1;
-  std::chrono::steady_clock::time_point first_seen;
-  std::map<uint64_t, int> data_fds;  // stream_id -> fd (ordered)
-  bool Complete() const {
-    return ctrl_fd >= 0 && nstreams != UINT64_MAX && data_fds.size() == nstreams;
-  }
-  void CloseAll() {
-    if (ctrl_fd >= 0) ::close(ctrl_fd);
-    ctrl_fd = -1;
-    for (auto& df : data_fds) ::close(df.second);
-    data_fds.clear();
-  }
-};
-
-struct ListenComm {
-  int fd = -1;
-  int wake_fd = -1;  // eventfd; close_listen signals it to abort a blocked accept()
-  int32_t dev = 0;
-  std::atomic<bool> closed{false};
-  std::mutex mu;  // guards partials; accept() may be called from many threads
-  std::map<uint64_t, PartialBundle> partials;
-
-  ~ListenComm() {
-    for (auto& kv : partials) kv.second.CloseAll();
-    if (fd >= 0) ::close(fd);
-    if (wake_fd >= 0) ::close(wake_fd);
-  }
-};
-using ListenPtr = std::shared_ptr<ListenComm>;
-
 // ---------------------------------------------------------------------------
 // Worker / scheduler loops.
 
@@ -349,189 +265,42 @@ void RecvSchedulerLoop(Comm* c) {
 
 // ---------------------------------------------------------------------------
 
-Status MakeSocket(int family, int* out) {
-  int fd = ::socket(family, SOCK_STREAM, 0);
-  if (fd < 0) return Status::TCP("socket() failed: " + std::string(strerror(errno)));
-  *out = fd;
-  return Status::Ok();
-}
-
-// Connection preamble: both chunk-map inputs (nstreams AND min_chunksize)
-// travel with the sender so the two sides can never compute divergent chunk
-// boundaries from mismatched env config — the sender's values win.
-struct Preamble {
-  uint64_t bundle_id = 0;
-  uint64_t stream_id = 0;
-  uint64_t nstreams = 0;
-  uint64_t min_chunksize = 0;
-};
-
-Status WritePreamble(int fd, const Preamble& p) {
-  uint8_t buf[40];
-  EncodeU64BE(kWireMagic, buf);
-  EncodeU64BE(p.bundle_id, buf + 8);
-  EncodeU64BE(p.stream_id, buf + 16);
-  EncodeU64BE(p.nstreams, buf + 24);
-  EncodeU64BE(p.min_chunksize, buf + 32);
-  return WriteAll(fd, buf, sizeof(buf));
-}
-
-Status ReadPreamble(int fd, Preamble* p, int timeout_ms) {
-  uint8_t buf[40];
-  // Hard deadline over the whole 40 bytes — a slow-loris client trickling
-  // one byte per interval cannot stretch this past timeout_ms.
-  Status s = ReadExactDeadline(fd, buf, sizeof(buf), timeout_ms);
-  if (!s.ok()) return s;
-  if (DecodeU64BE(buf) != kWireMagic) {
-    return Status::TCP("bad wire magic — peer is not tpunet or version mismatch");
-  }
-  p->bundle_id = DecodeU64BE(buf + 8);
-  p->stream_id = DecodeU64BE(buf + 16);
-  p->nstreams = DecodeU64BE(buf + 24);
-  p->min_chunksize = DecodeU64BE(buf + 32);
-  if (p->nstreams == 0 || p->nstreams > kMaxStreams || p->stream_id > p->nstreams ||
-      p->min_chunksize == 0) {
-    return Status::TCP("malformed preamble: nstreams=" + std::to_string(p->nstreams) +
-                       " stream_id=" + std::to_string(p->stream_id));
-  }
-  return Status::Ok();
-}
-
-uint64_t RandomBundleId() {
-  static std::atomic<uint64_t> ctr{1};
-  std::random_device rd;
-  uint64_t hi = (static_cast<uint64_t>(rd()) << 32) ^ rd();
-  return hi ^ (ctr.fetch_add(1) << 1) ^ (static_cast<uint64_t>(::getpid()) << 40);
-}
-
-// ---------------------------------------------------------------------------
-
-class BasicEngine : public Net {
+class BasicEngine : public EngineBase {
  public:
-  BasicEngine()
-      : nics_(FindInterfaces()),
-        // Reference defaults: nstreams=2 (nthread:228-231), min_chunksize=1MiB
-        // (nthread:232-235).
-        nstreams_(GetEnvU64("TPUNET_NSTREAMS", GetEnvU64("BAGUA_NET_NSTREAMS", 2))),
-        min_chunksize_(GetEnvU64("TPUNET_MIN_CHUNKSIZE",
-                                 GetEnvU64("BAGUA_NET_MIN_CHUNKSIZE", 1 << 20))),
-        spin_(GetEnvU64("TPUNET_SPIN", 0) != 0) {
-    if (nstreams_ == 0) nstreams_ = 1;
-    if (nstreams_ > kMaxStreams) nstreams_ = kMaxStreams;
-    if (min_chunksize_ == 0) min_chunksize_ = 1;
-  }
+  BasicEngine() : spin_(GetEnvU64("TPUNET_SPIN", 0) != 0) {}
 
   ~BasicEngine() override {
     for (auto& c : send_comms_.DrainAll()) c->Shutdown();
     for (auto& c : recv_comms_.DrainAll()) c->Shutdown();
     // Wake any thread still parked in accept() — mirror of close_listen;
     // without this, destroying the engine would strand it forever.
-    for (auto& lc : listen_comms_.DrainAll()) {
-      lc->closed.store(true, std::memory_order_release);
-      if (lc->wake_fd >= 0) {
-        uint64_t one = 1;
-        (void)!::write(lc->wake_fd, &one, sizeof(one));
-      }
-    }
-  }
-
-  int32_t devices() override { return static_cast<int32_t>(nics_.size()); }
-
-  Status get_properties(int32_t dev, NetProperties* props) override {
-    if (dev < 0 || dev >= static_cast<int32_t>(nics_.size())) {
-      return Status::Invalid("bad device index " + std::to_string(dev));
-    }
-    const NicInfo& nic = nics_[dev];
-    props->name = nic.name;
-    props->pci_path = nic.pci_path;
-    props->guid = static_cast<uint64_t>(dev);
-    props->ptr_support = 1;  // host memory only
-    props->speed_mbps = nic.speed_mbps;
-    props->port = 0;
-    props->max_comms = 65536;
-    return Status::Ok();
-  }
-
-  Status listen(int32_t dev, SocketHandle* handle, uint64_t* listen_comm) override {
-    if (dev < 0 || dev >= static_cast<int32_t>(nics_.size())) {
-      return Status::Invalid("bad device index " + std::to_string(dev));
-    }
-    const NicInfo& nic = nics_[dev];
-    int fd = -1;
-    Status s = MakeSocket(nic.addr.ss_family, &fd);
-    if (!s.ok()) return s;
-    int one = 1;
-    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
-    // Bind to the NIC's address with an ephemeral port; the resulting
-    // sockaddr IS the rendezvous handle (reference: nthread:259-303).
-    sockaddr_storage bind_addr = nic.addr;
-    if (bind_addr.ss_family == AF_INET) {
-      reinterpret_cast<sockaddr_in*>(&bind_addr)->sin_port = 0;
-    } else {
-      reinterpret_cast<sockaddr_in6*>(&bind_addr)->sin6_port = 0;
-    }
-    if (::bind(fd, reinterpret_cast<sockaddr*>(&bind_addr), nic.addrlen) != 0) {
-      ::close(fd);
-      return Status::TCP("bind failed: " + std::string(strerror(errno)));
-    }
-    if (::listen(fd, kListenBacklog) != 0) {
-      ::close(fd);
-      return Status::TCP("listen failed: " + std::string(strerror(errno)));
-    }
-    auto lc = std::make_shared<ListenComm>();
-    lc->fd = fd;
-    lc->wake_fd = ::eventfd(0, EFD_CLOEXEC);
-    if (lc->wake_fd < 0) {
-      // Without the wake fd close_listen could never abort a parked accept().
-      return Status::TCP("eventfd failed: " + std::string(strerror(errno)));
-    }
-    SetNonblocking(fd);  // accept() polls first; EAGAIN is handled
-    lc->dev = dev;
-    handle->addrlen = nic.addrlen;
-    if (getsockname(fd, reinterpret_cast<sockaddr*>(&handle->addr), &handle->addrlen) != 0) {
-      return Status::TCP("getsockname failed: " + std::string(strerror(errno)));
-    }
-    uint64_t id = next_id_.fetch_add(1);
-    listen_comms_.Put(id, lc);
-    *listen_comm = id;
-    return Status::Ok();
+    WakeAllListens();
   }
 
   Status connect(int32_t dev, const SocketHandle& handle, uint64_t* send_comm) override {
-    if (dev < 0 || dev >= static_cast<int32_t>(nics_.size())) {
-      return Status::Invalid("bad device index " + std::to_string(dev));
-    }
+    Status sdev = CheckDev(dev);
+    if (!sdev.ok()) return sdev;
+    std::vector<int> data_fds;
+    int ctrl_fd = -1;
+    Status s = ConnectBundle(nics_, dev, handle, nstreams_, min_chunksize_, &data_fds, &ctrl_fd);
+    if (!s.ok()) return s;
+
     auto comm = std::make_shared<Comm>();
     comm->is_send = true;
     comm->nstreams = nstreams_;
     comm->min_chunksize = min_chunksize_;
     comm->spin = spin_;
-    uint64_t bundle = RandomBundleId();
-
-    // nstreams data connections, each introducing itself with its stream id
-    // (reference: nthread:313-327), then the ctrl connection with
-    // stream_id == nstreams (reference: nthread:366-380).
-    for (uint64_t sid = 0; sid <= nstreams_; ++sid) {
-      int fd = -1;
-      Status s = ConnectOne(dev, handle, &fd);
-      if (!s.ok()) {
-        comm->Shutdown();
-        return s;
-      }
-      s = WritePreamble(fd, Preamble{bundle, sid, nstreams_, min_chunksize_});
-      if (s.ok() && spin_) s = SetNonblocking(fd);  // only after the blocking preamble write
-      if (!s.ok()) {
-        ::close(fd);
-        comm->Shutdown();
-        return s;
-      }
-      if (sid < nstreams_) {
-        auto w = std::make_unique<StreamWorker>();
-        w->fd = fd;
-        comm->workers.push_back(std::move(w));
-      } else {
-        comm->ctrl_fd = fd;
-      }
+    comm->ctrl_fd = ctrl_fd;
+    for (int fd : data_fds) {
+      auto w = std::make_unique<StreamWorker>();
+      w->fd = fd;
+      comm->workers.push_back(std::move(w));
+    }
+    if (spin_) {
+      // Spin mode busy-polls nonblocking fds (set only after the blocking
+      // preamble writes inside ConnectBundle).
+      for (auto& w : comm->workers) SetNonblocking(w->fd);
+      SetNonblocking(comm->ctrl_fd);
     }
     StartThreads(comm.get());
     uint64_t id = next_id_.fetch_add(1);
@@ -541,94 +310,10 @@ class BasicEngine : public Net {
   }
 
   Status accept(uint64_t listen_comm, uint64_t* recv_comm) override {
-    ListenPtr lc;
-    if (!listen_comms_.Get(listen_comm, &lc)) {
-      return Status::Invalid("unknown listen comm " + std::to_string(listen_comm));
-    }
-    // Accept connections, grouping by bundle id, until one bundle is whole
-    // (reference accepts exactly nstreams+1 and keys by raw id,
-    // nthread:425-522; bundles make concurrent senders safe).
-    std::lock_guard<std::mutex> accept_lk(lc->mu);
-    uint64_t expiry_ms = 2 * GetEnvU64("TPUNET_HANDSHAKE_TIMEOUT_MS", 10000);
-    while (true) {
-      // Expire half-arrived bundles from dead senders so their parked fds
-      // don't accumulate toward RLIMIT_NOFILE on a long-lived listen comm.
-      auto now = std::chrono::steady_clock::now();
-      for (auto it = lc->partials.begin(); it != lc->partials.end();) {
-        if (!it->second.Complete() &&
-            now - it->second.first_seen > std::chrono::milliseconds(expiry_ms)) {
-          it->second.CloseAll();
-          it = lc->partials.erase(it);
-        } else {
-          ++it;
-        }
-      }
-      for (auto it = lc->partials.begin(); it != lc->partials.end(); ++it) {
-        if (it->second.Complete()) {
-          PartialBundle b = std::move(it->second);
-          lc->partials.erase(it);
-          return BuildRecvComm(b, recv_comm);
-        }
-      }
-      // poll so close_listen can abort us via the eventfd (a blocked
-      // ::accept is not reliably interruptible by shutdown() on Linux).
-      // Finite timeout so the expiry sweep above runs even with no events.
-      struct pollfd pfds[2] = {{lc->fd, POLLIN, 0}, {lc->wake_fd, POLLIN, 0}};
-      int pr = ::poll(pfds, 2, 1000);
-      if (pr < 0) {
-        if (errno == EINTR) continue;
-        return Status::TCP("poll failed: " + std::string(strerror(errno)));
-      }
-      if (pr == 0) continue;  // timeout tick: re-run expiry sweep
-      if (lc->closed.load(std::memory_order_acquire) || (pfds[1].revents & POLLIN)) {
-        return Status::Inner("listen comm closed while accepting");
-      }
-      if (!(pfds[0].revents & POLLIN)) continue;
-      sockaddr_storage peer;
-      socklen_t plen = sizeof(peer);
-      int fd = ::accept(lc->fd, reinterpret_cast<sockaddr*>(&peer), &plen);
-      if (fd < 0) {
-        if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK) continue;
-        return Status::TCP("accept failed: " + std::string(strerror(errno)));
-      }
-      Status s = SetNodelay(fd);
-      if (!s.ok()) {
-        ::close(fd);
-        return s;
-      }
-      // Bound the preamble read: a client that connects but never completes
-      // the 40-byte handshake (scanner, stalled peer) must not wedge accept()
-      // while it holds lc->mu. Malformed/timed-out clients are dropped and
-      // accept keeps serving legitimate peers.
-      uint64_t handshake_ms = GetEnvU64("TPUNET_HANDSHAKE_TIMEOUT_MS", 10000);
-      Preamble p;
-      s = ReadPreamble(fd, &p, static_cast<int>(handshake_ms));
-      if (!s.ok()) {
-        ::close(fd);
-        continue;
-      }
-      PartialBundle& b = lc->partials[p.bundle_id];
-      if (b.nstreams == UINT64_MAX) {
-        b.nstreams = p.nstreams;
-        b.min_chunksize = p.min_chunksize;
-        b.first_seen = std::chrono::steady_clock::now();
-      } else if (b.nstreams != p.nstreams || b.min_chunksize != p.min_chunksize) {
-        ::close(fd);  // inconsistent members: drop the whole bundle
-        b.CloseAll();
-        lc->partials.erase(p.bundle_id);
-        continue;
-      }
-      if (p.stream_id == p.nstreams) {
-        if (b.ctrl_fd >= 0) {
-          ::close(fd);  // duplicate ctrl stream: keep the first
-          continue;
-        }
-        b.ctrl_fd = fd;
-      } else if (!b.data_fds.emplace(p.stream_id, fd).second) {
-        ::close(fd);  // duplicate stream id: keep the first, drop the dup
-        continue;
-      }
-    }
+    PartialBundle b;
+    Status s = AcceptBundleOn(listen_comm, &b);
+    if (!s.ok()) return s;
+    return BuildRecvComm(b, recv_comm);
   }
 
   Status isend(uint64_t send_comm, const void* data, size_t nbytes, uint64_t* request) override {
@@ -699,71 +384,7 @@ class BasicEngine : public Net {
     return Status::Ok();
   }
 
-  Status close_listen(uint64_t listen_comm) override {
-    ListenPtr lc;
-    if (!listen_comms_.Take(listen_comm, &lc)) {
-      return Status::Invalid("unknown listen comm " + std::to_string(listen_comm));
-    }
-    // Wake any thread parked in accept(); it returns "listen comm closed".
-    lc->closed.store(true, std::memory_order_release);
-    if (lc->wake_fd >= 0) {
-      uint64_t one = 1;
-      (void)!::write(lc->wake_fd, &one, sizeof(one));
-    }
-    return Status::Ok();
-  }
-
  private:
-  Status ConnectOne(int32_t dev, const SocketHandle& handle, int* out_fd) {
-    int fd = -1;
-    Status s = MakeSocket(handle.addr.ss_family, &fd);
-    if (!s.ok()) return s;
-    // Route out of the chosen NIC when address families line up.
-    const NicInfo& nic = nics_[dev];
-    if (nic.addr.ss_family == handle.addr.ss_family && nic.name != "lo") {
-      sockaddr_storage local = nic.addr;
-      if (local.ss_family == AF_INET) {
-        reinterpret_cast<sockaddr_in*>(&local)->sin_port = 0;
-      } else {
-        reinterpret_cast<sockaddr_in6*>(&local)->sin6_port = 0;
-      }
-      ::bind(fd, reinterpret_cast<sockaddr*>(&local), nic.addrlen);  // best effort
-    }
-    // addrlen is derived from the family, not trusted from the handle: a
-    // handle marshaled through the 64-byte wire blob (C ABI / ncclNet shim)
-    // carries only the sockaddr bytes.
-    socklen_t alen = AddrLenForFamily(handle.addr);
-    if (::connect(fd, reinterpret_cast<const sockaddr*>(&handle.addr), alen) != 0) {
-      // POSIX: after EINTR the connect proceeds asynchronously — retrying
-      // ::connect() yields EALREADY. Wait for writability + check SO_ERROR.
-      bool pending = (errno == EINTR || errno == EINPROGRESS || errno == EALREADY);
-      if (!pending) {
-        ::close(fd);
-        return Status::TCP("connect to " + SockaddrToString(handle.addr, alen) +
-                           " failed: " + std::string(strerror(errno)));
-      }
-      struct pollfd pfd = {fd, POLLOUT, 0};
-      int pr;
-      do {
-        pr = ::poll(&pfd, 1, -1);
-      } while (pr < 0 && errno == EINTR);
-      int soerr = 0;
-      socklen_t slen = sizeof(soerr);
-      if (pr < 0 || getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen) != 0 || soerr != 0) {
-        ::close(fd);
-        return Status::TCP("connect to " + SockaddrToString(handle.addr, alen) +
-                           " failed: " + std::string(strerror(soerr ? soerr : errno)));
-      }
-    }
-    s = SetNodelay(fd);  // reference: nthread:329
-    if (!s.ok()) {
-      ::close(fd);
-      return s;
-    }
-    *out_fd = fd;
-    return Status::Ok();
-  }
-
   void StartThreads(Comm* c) {
     bool spin = c->spin;
     for (auto& w : c->workers) {
@@ -801,14 +422,9 @@ class BasicEngine : public Net {
     return Status::Ok();
   }
 
-  std::vector<NicInfo> nics_;
-  uint64_t nstreams_;
-  uint64_t min_chunksize_;
   bool spin_;
-  std::atomic<uint64_t> next_id_{1};
   IdMap<CommPtr> send_comms_;
   IdMap<CommPtr> recv_comms_;
-  IdMap<ListenPtr> listen_comms_;
   IdMap<RequestPtr> requests_;
 };
 

@@ -1,0 +1,101 @@
+// Net boilerplate shared by both transport engines: device enumeration and
+// properties, listen-socket management, and env-config parsing (defaults
+// per the reference: nstreams=2 nthread:228-231, min_chunksize=1MiB
+// nthread:232-235). Engines derive and add only their data path, so the
+// NIC/config surface cannot diverge between them.
+#ifndef TPUNET_ENGINE_BASE_H_
+#define TPUNET_ENGINE_BASE_H_
+
+#include <atomic>
+#include <string>
+#include <vector>
+
+#include "id_map.h"
+#include "tpunet/net.h"
+#include "tpunet/utils.h"
+#include "wire.h"
+
+namespace tpunet {
+
+class EngineBase : public Net {
+ public:
+  EngineBase()
+      : nics_(FindInterfaces()),
+        nstreams_(GetEnvU64("TPUNET_NSTREAMS", GetEnvU64("BAGUA_NET_NSTREAMS", 2))),
+        min_chunksize_(GetEnvU64("TPUNET_MIN_CHUNKSIZE",
+                                 GetEnvU64("BAGUA_NET_MIN_CHUNKSIZE", 1 << 20))) {
+    if (nstreams_ == 0) nstreams_ = 1;
+    if (nstreams_ > kMaxStreams) nstreams_ = kMaxStreams;
+    if (min_chunksize_ == 0) min_chunksize_ = 1;
+  }
+
+  int32_t devices() override { return static_cast<int32_t>(nics_.size()); }
+
+  Status get_properties(int32_t dev, NetProperties* props) override {
+    Status s = CheckDev(dev);
+    if (!s.ok()) return s;
+    const NicInfo& nic = nics_[dev];
+    props->name = nic.name;
+    props->pci_path = nic.pci_path;
+    props->guid = static_cast<uint64_t>(dev);
+    props->ptr_support = 1;  // host memory only
+    props->speed_mbps = nic.speed_mbps;
+    props->port = 0;
+    props->max_comms = 65536;  // reference: nthread:100
+    return Status::Ok();
+  }
+
+  Status listen(int32_t dev, SocketHandle* handle, uint64_t* listen_comm) override {
+    Status s = CheckDev(dev);
+    if (!s.ok()) return s;
+    ListenSockPtr lc;
+    s = ListenOn(nics_[dev], dev, handle, &lc);
+    if (!s.ok()) return s;
+    uint64_t id = next_id_.fetch_add(1);
+    listen_comms_.Put(id, lc);
+    *listen_comm = id;
+    return Status::Ok();
+  }
+
+  Status close_listen(uint64_t listen_comm) override {
+    ListenSockPtr lc;
+    if (!listen_comms_.Take(listen_comm, &lc)) {
+      return Status::Invalid("unknown listen comm " + std::to_string(listen_comm));
+    }
+    // Wake any thread parked in accept(); it returns "listen comm closed".
+    WakeListen(lc.get());
+    return Status::Ok();
+  }
+
+ protected:
+  Status CheckDev(int32_t dev) const {
+    if (dev < 0 || dev >= static_cast<int32_t>(nics_.size())) {
+      return Status::Invalid("bad device index " + std::to_string(dev));
+    }
+    return Status::Ok();
+  }
+
+  // Blocks in the shared bundle-accept loop for the given listen comm.
+  Status AcceptBundleOn(uint64_t listen_comm, PartialBundle* b) {
+    ListenSockPtr lc;
+    if (!listen_comms_.Get(listen_comm, &lc)) {
+      return Status::Invalid("unknown listen comm " + std::to_string(listen_comm));
+    }
+    return AcceptBundle(lc.get(), b);
+  }
+
+  // Engine destructors call this so no thread stays parked in accept().
+  void WakeAllListens() {
+    for (auto& lc : listen_comms_.DrainAll()) WakeListen(lc.get());
+  }
+
+  std::vector<NicInfo> nics_;
+  uint64_t nstreams_;
+  uint64_t min_chunksize_;
+  std::atomic<uint64_t> next_id_{1};
+  IdMap<ListenSockPtr> listen_comms_;
+};
+
+}  // namespace tpunet
+
+#endif  // TPUNET_ENGINE_BASE_H_

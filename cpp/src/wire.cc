@@ -1,0 +1,304 @@
+// Shared wire protocol + rendezvous implementation. See wire.h.
+#include "wire.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <random>
+
+namespace tpunet {
+
+socklen_t AddrLenForFamily(const sockaddr_storage& ss) {
+  return ss.ss_family == AF_INET6 ? sizeof(sockaddr_in6) : sizeof(sockaddr_in);
+}
+
+Status MakeSocket(int family, int* out) {
+  int fd = ::socket(family, SOCK_STREAM, 0);
+  if (fd < 0) return Status::TCP("socket() failed: " + std::string(strerror(errno)));
+  *out = fd;
+  return Status::Ok();
+}
+
+Status WritePreamble(int fd, const Preamble& p) {
+  uint8_t buf[40];
+  EncodeU64BE(kWireMagic, buf);
+  EncodeU64BE(p.bundle_id, buf + 8);
+  EncodeU64BE(p.stream_id, buf + 16);
+  EncodeU64BE(p.nstreams, buf + 24);
+  EncodeU64BE(p.min_chunksize, buf + 32);
+  return WriteAll(fd, buf, sizeof(buf));
+}
+
+Status ReadPreamble(int fd, Preamble* p, int timeout_ms) {
+  uint8_t buf[40];
+  // Hard deadline over the whole 40 bytes — a slow-loris client trickling
+  // one byte per interval cannot stretch this past timeout_ms.
+  Status s = ReadExactDeadline(fd, buf, sizeof(buf), timeout_ms);
+  if (!s.ok()) return s;
+  if (DecodeU64BE(buf) != kWireMagic) {
+    return Status::TCP("bad wire magic — peer is not tpunet or version mismatch");
+  }
+  p->bundle_id = DecodeU64BE(buf + 8);
+  p->stream_id = DecodeU64BE(buf + 16);
+  p->nstreams = DecodeU64BE(buf + 24);
+  p->min_chunksize = DecodeU64BE(buf + 32);
+  if (p->nstreams == 0 || p->nstreams > kMaxStreams || p->stream_id > p->nstreams ||
+      p->min_chunksize == 0) {
+    return Status::TCP("malformed preamble: nstreams=" + std::to_string(p->nstreams) +
+                       " stream_id=" + std::to_string(p->stream_id));
+  }
+  return Status::Ok();
+}
+
+uint64_t RandomBundleId() {
+  static std::atomic<uint64_t> ctr{1};
+  std::random_device rd;
+  uint64_t hi = (static_cast<uint64_t>(rd()) << 32) ^ rd();
+  return hi ^ (ctr.fetch_add(1) << 1) ^ (static_cast<uint64_t>(::getpid()) << 40);
+}
+
+void PartialBundle::CloseAll() {
+  if (ctrl_fd >= 0) ::close(ctrl_fd);
+  ctrl_fd = -1;
+  for (auto& df : data_fds) ::close(df.second);
+  data_fds.clear();
+}
+
+ListenSock::~ListenSock() {
+  for (auto& kv : partials) kv.second.CloseAll();
+  if (fd >= 0) ::close(fd);
+  if (wake_fd >= 0) ::close(wake_fd);
+}
+
+Status ListenOn(const NicInfo& nic, int32_t dev, SocketHandle* handle, ListenSockPtr* out) {
+  int fd = -1;
+  Status s = MakeSocket(nic.addr.ss_family, &fd);
+  if (!s.ok()) return s;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  // Bind to the NIC's address with an ephemeral port; the resulting
+  // sockaddr IS the rendezvous handle (reference: nthread:259-303).
+  sockaddr_storage bind_addr = nic.addr;
+  if (bind_addr.ss_family == AF_INET) {
+    reinterpret_cast<sockaddr_in*>(&bind_addr)->sin_port = 0;
+  } else {
+    reinterpret_cast<sockaddr_in6*>(&bind_addr)->sin6_port = 0;
+  }
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&bind_addr), nic.addrlen) != 0) {
+    ::close(fd);
+    return Status::TCP("bind failed: " + std::string(strerror(errno)));
+  }
+  if (::listen(fd, kListenBacklog) != 0) {
+    ::close(fd);
+    return Status::TCP("listen failed: " + std::string(strerror(errno)));
+  }
+  auto lc = std::make_shared<ListenSock>();
+  lc->fd = fd;
+  lc->wake_fd = ::eventfd(0, EFD_CLOEXEC);
+  if (lc->wake_fd < 0) {
+    // Without the wake fd close_listen could never abort a parked accept().
+    return Status::TCP("eventfd failed: " + std::string(strerror(errno)));
+  }
+  SetNonblocking(fd);  // accept() polls first; EAGAIN is handled
+  lc->dev = dev;
+  handle->addrlen = nic.addrlen;
+  if (getsockname(fd, reinterpret_cast<sockaddr*>(&handle->addr), &handle->addrlen) != 0) {
+    return Status::TCP("getsockname failed: " + std::string(strerror(errno)));
+  }
+  *out = std::move(lc);
+  return Status::Ok();
+}
+
+void WakeListen(ListenSock* ls) {
+  ls->closed.store(true, std::memory_order_release);
+  if (ls->wake_fd >= 0) {
+    uint64_t one = 1;
+    (void)!::write(ls->wake_fd, &one, sizeof(one));
+  }
+}
+
+Status AcceptBundle(ListenSock* lc, PartialBundle* out) {
+  // Accept connections, grouping by bundle id, until one bundle is whole
+  // (reference accepts exactly nstreams+1 and keys by raw id,
+  // nthread:425-522; bundles make concurrent senders safe).
+  std::lock_guard<std::mutex> accept_lk(lc->mu);
+  uint64_t expiry_ms = 2 * GetEnvU64("TPUNET_HANDSHAKE_TIMEOUT_MS", 10000);
+  while (true) {
+    // Expire half-arrived bundles from dead senders so their parked fds
+    // don't accumulate toward RLIMIT_NOFILE on a long-lived listen comm.
+    auto now = std::chrono::steady_clock::now();
+    for (auto it = lc->partials.begin(); it != lc->partials.end();) {
+      if (!it->second.Complete() &&
+          now - it->second.first_seen > std::chrono::milliseconds(expiry_ms)) {
+        it->second.CloseAll();
+        it = lc->partials.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    for (auto it = lc->partials.begin(); it != lc->partials.end(); ++it) {
+      if (it->second.Complete()) {
+        *out = std::move(it->second);
+        lc->partials.erase(it);
+        return Status::Ok();
+      }
+    }
+    // poll so close_listen can abort us via the eventfd (a blocked
+    // ::accept is not reliably interruptible by shutdown() on Linux).
+    // Finite timeout so the expiry sweep above runs even with no events.
+    struct pollfd pfds[2] = {{lc->fd, POLLIN, 0}, {lc->wake_fd, POLLIN, 0}};
+    int pr = ::poll(pfds, 2, 1000);
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return Status::TCP("poll failed: " + std::string(strerror(errno)));
+    }
+    if (pr == 0) continue;  // timeout tick: re-run expiry sweep
+    if (lc->closed.load(std::memory_order_acquire) || (pfds[1].revents & POLLIN)) {
+      return Status::Inner("listen comm closed while accepting");
+    }
+    if (!(pfds[0].revents & POLLIN)) continue;
+    sockaddr_storage peer;
+    socklen_t plen = sizeof(peer);
+    int fd = ::accept(lc->fd, reinterpret_cast<sockaddr*>(&peer), &plen);
+    if (fd < 0) {
+      if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK) continue;
+      return Status::TCP("accept failed: " + std::string(strerror(errno)));
+    }
+    Status s = SetNodelay(fd);
+    if (!s.ok()) {
+      ::close(fd);
+      return s;
+    }
+    // Bound the preamble read: a client that connects but never completes
+    // the 40-byte handshake (scanner, stalled peer) must not wedge accept()
+    // while it holds lc->mu. Malformed/timed-out clients are dropped and
+    // accept keeps serving legitimate peers.
+    uint64_t handshake_ms = GetEnvU64("TPUNET_HANDSHAKE_TIMEOUT_MS", 10000);
+    Preamble p;
+    s = ReadPreamble(fd, &p, static_cast<int>(handshake_ms));
+    if (!s.ok()) {
+      ::close(fd);
+      continue;
+    }
+    PartialBundle& b = lc->partials[p.bundle_id];
+    if (b.nstreams == UINT64_MAX) {
+      b.nstreams = p.nstreams;
+      b.min_chunksize = p.min_chunksize;
+      b.first_seen = std::chrono::steady_clock::now();
+    } else if (b.nstreams != p.nstreams || b.min_chunksize != p.min_chunksize) {
+      ::close(fd);  // inconsistent members: drop the whole bundle
+      b.CloseAll();
+      lc->partials.erase(p.bundle_id);
+      continue;
+    }
+    if (p.stream_id == p.nstreams) {
+      if (b.ctrl_fd >= 0) {
+        ::close(fd);  // duplicate ctrl stream: keep the first
+        continue;
+      }
+      b.ctrl_fd = fd;
+    } else if (!b.data_fds.emplace(p.stream_id, fd).second) {
+      ::close(fd);  // duplicate stream id: keep the first, drop the dup
+      continue;
+    }
+  }
+}
+
+namespace {
+
+Status ConnectOne(const std::vector<NicInfo>& nics, int32_t dev, const SocketHandle& handle,
+                  int* out_fd) {
+  int fd = -1;
+  Status s = MakeSocket(handle.addr.ss_family, &fd);
+  if (!s.ok()) return s;
+  // Route out of the chosen NIC when address families line up.
+  const NicInfo& nic = nics[dev];
+  if (nic.addr.ss_family == handle.addr.ss_family && nic.name != "lo") {
+    sockaddr_storage local = nic.addr;
+    if (local.ss_family == AF_INET) {
+      reinterpret_cast<sockaddr_in*>(&local)->sin_port = 0;
+    } else {
+      reinterpret_cast<sockaddr_in6*>(&local)->sin6_port = 0;
+    }
+    ::bind(fd, reinterpret_cast<sockaddr*>(&local), nic.addrlen);  // best effort
+  }
+  // addrlen is derived from the family, not trusted from the handle: a
+  // handle marshaled through the 64-byte wire blob (C ABI / ncclNet shim)
+  // carries only the sockaddr bytes.
+  socklen_t alen = AddrLenForFamily(handle.addr);
+  if (::connect(fd, reinterpret_cast<const sockaddr*>(&handle.addr), alen) != 0) {
+    // POSIX: after EINTR the connect proceeds asynchronously — retrying
+    // ::connect() yields EALREADY. Wait for writability + check SO_ERROR.
+    bool pending = (errno == EINTR || errno == EINPROGRESS || errno == EALREADY);
+    if (!pending) {
+      ::close(fd);
+      return Status::TCP("connect to " + SockaddrToString(handle.addr, alen) +
+                         " failed: " + std::string(strerror(errno)));
+    }
+    struct pollfd pfd = {fd, POLLOUT, 0};
+    int pr;
+    do {
+      pr = ::poll(&pfd, 1, -1);
+    } while (pr < 0 && errno == EINTR);
+    int soerr = 0;
+    socklen_t slen = sizeof(soerr);
+    if (pr < 0 || getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen) != 0 || soerr != 0) {
+      ::close(fd);
+      return Status::TCP("connect to " + SockaddrToString(handle.addr, alen) +
+                         " failed: " + std::string(strerror(soerr ? soerr : errno)));
+    }
+  }
+  s = SetNodelay(fd);  // reference: nthread:329
+  if (!s.ok()) {
+    ::close(fd);
+    return s;
+  }
+  *out_fd = fd;
+  return Status::Ok();
+}
+
+}  // namespace
+
+Status ConnectBundle(const std::vector<NicInfo>& nics, int32_t dev, const SocketHandle& handle,
+                     uint64_t nstreams, uint64_t min_chunksize, std::vector<int>* data_fds,
+                     int* ctrl_fd) {
+  uint64_t bundle = RandomBundleId();
+  auto cleanup = [&]() {
+    for (int fd : *data_fds) ::close(fd);
+    data_fds->clear();
+    if (*ctrl_fd >= 0) ::close(*ctrl_fd);
+    *ctrl_fd = -1;
+  };
+  // nstreams data connections, each introducing itself with its stream id
+  // (reference: nthread:313-327), then the ctrl connection with
+  // stream_id == nstreams (reference: nthread:366-380).
+  for (uint64_t sid = 0; sid <= nstreams; ++sid) {
+    int fd = -1;
+    Status s = ConnectOne(nics, dev, handle, &fd);
+    if (!s.ok()) {
+      cleanup();
+      return s;
+    }
+    s = WritePreamble(fd, Preamble{bundle, sid, nstreams, min_chunksize});
+    if (!s.ok()) {
+      ::close(fd);
+      cleanup();
+      return s;
+    }
+    if (sid < nstreams) {
+      data_fds->push_back(fd);
+    } else {
+      *ctrl_fd = fd;
+    }
+  }
+  return Status::Ok();
+}
+
+}  // namespace tpunet

@@ -1,0 +1,133 @@
+// Shared wire protocol + rendezvous for both transport engines.
+//
+// The connection-establishment contract is engine-independent (SURVEY §2.2
+// steps 1-3; reference: nthread_per_socket_backend.rs:259-522): listen binds
+// an ephemeral socket whose sockaddr is the 64-byte rendezvous handle;
+// connect opens nstreams data connections + 1 ctrl connection, each opening
+// with a preamble; accept groups arriving connections into bundles until one
+// sender's bundle is complete. Engines differ only in how they move bytes
+// after the bundle is wired (thread-per-stream vs epoll event loop), so this
+// file owns everything up to that point — guaranteeing the two engines are
+// wire-compatible (unlike the reference's BASIC/TOKIO pair, which framed
+// lengths differently and could not interoperate; tokio_backend.rs:456).
+#ifndef TPUNET_WIRE_H_
+#define TPUNET_WIRE_H_
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "tpunet/net.h"
+#include "tpunet/utils.h"
+
+namespace tpunet {
+
+constexpr uint64_t kWireMagic = 0x7470756e65743102ull;  // "tpunet" + wire ver 2
+constexpr int kListenBacklog = 16384;  // reference: nthread:101
+constexpr uint64_t kMaxStreams = 256;  // sanity bound on peer-supplied nstreams
+
+socklen_t AddrLenForFamily(const sockaddr_storage& ss);
+
+Status MakeSocket(int family, int* out);
+
+// Connection preamble: both chunk-map inputs (nstreams AND min_chunksize)
+// travel with the sender so the two sides can never compute divergent chunk
+// boundaries from mismatched env config — the sender's values win.
+// [magic u64 | bundle_id u64 | stream_id u64 | nstreams u64 |
+//  min_chunksize u64], all big-endian. stream_id == nstreams marks the ctrl
+// connection (reference: nthread:380).
+struct Preamble {
+  uint64_t bundle_id = 0;
+  uint64_t stream_id = 0;
+  uint64_t nstreams = 0;
+  uint64_t min_chunksize = 0;
+};
+
+Status WritePreamble(int fd, const Preamble& p);
+// Bounded by timeout_ms over the WHOLE 40 bytes (slow-loris defense).
+Status ReadPreamble(int fd, Preamble* p, int timeout_ms);
+
+uint64_t RandomBundleId();
+
+// Request completion accounting, shared by both engines.
+// Reference: RequestState{nsubtasks, completed_subtasks, nbytes_transferred,
+// err} (nthread:54-60). `total` doubles as the "scheduled" flag: UINT64_MAX
+// until the scheduler has chunked the message.
+struct RequestState {
+  std::atomic<uint64_t> total{UINT64_MAX};
+  std::atomic<uint64_t> completed{0};
+  std::atomic<uint64_t> nbytes{0};
+  std::atomic<bool> failed{false};
+  std::mutex err_mu;
+  std::string err_msg;
+
+  void SetError(const std::string& m) {
+    {
+      std::lock_guard<std::mutex> lk(err_mu);
+      if (err_msg.empty()) err_msg = m;
+    }
+    failed.store(true, std::memory_order_release);
+  }
+  std::string ErrorMsg() {
+    std::lock_guard<std::mutex> lk(err_mu);
+    return err_msg;
+  }
+  bool Done() const {
+    uint64_t t = total.load(std::memory_order_acquire);
+    return t != UINT64_MAX && completed.load(std::memory_order_acquire) >= t;
+  }
+};
+using RequestPtr = std::shared_ptr<RequestState>;
+
+// Parked connection bundle on a listen socket, keyed by bundle id, until all
+// nstreams+1 members have arrived.
+struct PartialBundle {
+  uint64_t nstreams = UINT64_MAX;
+  uint64_t min_chunksize = 0;
+  int ctrl_fd = -1;
+  std::chrono::steady_clock::time_point first_seen;
+  std::map<uint64_t, int> data_fds;  // stream_id -> fd (ordered)
+  bool Complete() const {
+    return ctrl_fd >= 0 && nstreams != UINT64_MAX && data_fds.size() == nstreams;
+  }
+  void CloseAll();
+};
+
+// A listening socket + the bundle-grouping state accept() needs.
+struct ListenSock {
+  int fd = -1;
+  int wake_fd = -1;  // eventfd; close_listen signals it to abort a blocked accept
+  int32_t dev = 0;
+  std::atomic<bool> closed{false};
+  std::mutex mu;  // guards partials; accept() may be called from many threads
+  std::map<uint64_t, PartialBundle> partials;
+
+  ~ListenSock();
+};
+using ListenSockPtr = std::shared_ptr<ListenSock>;
+
+// Bind an ephemeral listening socket on `nic`; fills the rendezvous handle.
+Status ListenOn(const NicInfo& nic, int32_t dev, SocketHandle* handle, ListenSockPtr* out);
+
+// Signal a (possibly) blocked AcceptBundle to abort with "closed".
+void WakeListen(ListenSock* ls);
+
+// Accept connections, grouping by bundle id, until one sender's bundle is
+// whole; expires half-arrived bundles from dead senders. Blocks.
+Status AcceptBundle(ListenSock* ls, PartialBundle* out);
+
+// Open the nstreams+1 connection bundle to a remote handle, writing each
+// preamble. On success data_fds holds nstreams stream-ordered connections
+// and ctrl_fd the ctrl connection; all blocking, TCP_NODELAY set.
+Status ConnectBundle(const std::vector<NicInfo>& nics, int32_t dev, const SocketHandle& handle,
+                     uint64_t nstreams, uint64_t min_chunksize, std::vector<int>* data_fds,
+                     int* ctrl_fd);
+
+}  // namespace tpunet
+
+#endif  // TPUNET_WIRE_H_

@@ -119,18 +119,21 @@ static void WaitDone(Net* net, uint64_t req, size_t* nbytes) {
   }
 }
 
-static void TestEngineLoopback() {
-  auto net = CreateEngine();
-  CHECK(net->devices() >= 1);
+// Loopback sweep between a sender engine and a receiver engine (they may be
+// the same object, or different engines — the shared wire protocol makes
+// BASIC and EPOLL interoperable, unlike the reference's BASIC/TOKIO pair).
+static void TestEngineLoopback(Net* snet, Net* rnet, const char* label) {
+  fprintf(stderr, "  loopback: %s\n", label);
+  CHECK(snet->devices() >= 1);
   NetProperties props;
-  CHECK_OK(net->get_properties(0, &props));
+  CHECK_OK(snet->get_properties(0, &props));
   CHECK(!props.name.empty());
 
   SocketHandle handle;
   uint64_t listen_id = 0, send_id = 0, recv_id = 0;
-  CHECK_OK(net->listen(0, &handle, &listen_id));
-  std::thread acceptor([&] { CHECK_OK(net->accept(listen_id, &recv_id)); });
-  CHECK_OK(net->connect(0, handle, &send_id));
+  CHECK_OK(rnet->listen(0, &handle, &listen_id));
+  std::thread acceptor([&] { CHECK_OK(rnet->accept(listen_id, &recv_id)); });
+  CHECK_OK(snet->connect(0, handle, &send_id));
   acceptor.join();
 
   // Size sweep with payload verification; recv buffer deliberately larger.
@@ -138,11 +141,11 @@ static void TestEngineLoopback() {
     std::vector<uint8_t> src(size), dst(size + 64, 0xAA);
     for (size_t i = 0; i < size; ++i) src[i] = static_cast<uint8_t>(i * 131 + 17);
     uint64_t sreq = 0, rreq = 0;
-    CHECK_OK(net->irecv(recv_id, dst.data(), dst.size(), &rreq));
-    CHECK_OK(net->isend(send_id, src.data(), src.size(), &sreq));
+    CHECK_OK(rnet->irecv(recv_id, dst.data(), dst.size(), &rreq));
+    CHECK_OK(snet->isend(send_id, src.data(), src.size(), &sreq));
     size_t sent = 0, got = 0;
-    WaitDone(net.get(), sreq, &sent);
-    WaitDone(net.get(), rreq, &got);
+    WaitDone(snet, sreq, &sent);
+    WaitDone(rnet, rreq, &got);
     CHECK(sent == size);
     CHECK(got == size);  // true size from ctrl frame, not posted buffer size
     CHECK(memcmp(src.data(), dst.data(), size) == 0);
@@ -157,22 +160,22 @@ static void TestEngineLoopback() {
   for (int i = 0; i < kInflight; ++i) {
     srcs[i].assign(kMsg, static_cast<uint8_t>(i + 1));
     dsts[i].assign(kMsg, 0);
-    CHECK_OK(net->irecv(recv_id, dsts[i].data(), kMsg, &rreqs[i]));
+    CHECK_OK(rnet->irecv(recv_id, dsts[i].data(), kMsg, &rreqs[i]));
   }
   for (int i = 0; i < kInflight; ++i) {
-    CHECK_OK(net->isend(send_id, srcs[i].data(), kMsg, &sreqs[i]));
+    CHECK_OK(snet->isend(send_id, srcs[i].data(), kMsg, &sreqs[i]));
   }
   for (int i = 0; i < kInflight; ++i) {
     size_t n = 0;
-    WaitDone(net.get(), sreqs[i], &n);
-    WaitDone(net.get(), rreqs[i], &n);
+    WaitDone(snet, sreqs[i], &n);
+    WaitDone(rnet, rreqs[i], &n);
     CHECK(n == kMsg);
     CHECK(memcmp(srcs[i].data(), dsts[i].data(), kMsg) == 0);
   }
 
-  CHECK_OK(net->close_send(send_id));
-  CHECK_OK(net->close_recv(recv_id));
-  CHECK_OK(net->close_listen(listen_id));
+  CHECK_OK(snet->close_send(send_id));
+  CHECK_OK(rnet->close_recv(recv_id));
+  CHECK_OK(rnet->close_listen(listen_id));
 }
 
 int main() {
@@ -181,7 +184,21 @@ int main() {
   TestParse();
   TestSocketIO();
   TestInterfaces();
-  TestEngineLoopback();
+  {
+    auto basic = CreateBasicEngine();
+    TestEngineLoopback(basic.get(), basic.get(), "BASIC <-> BASIC");
+  }
+  {
+    auto ep = CreateEpollEngine();
+    TestEngineLoopback(ep.get(), ep.get(), "EPOLL <-> EPOLL");
+  }
+  {
+    // Cross-engine interop both ways — the wire protocol is shared.
+    auto basic = CreateBasicEngine();
+    auto ep = CreateEpollEngine();
+    TestEngineLoopback(basic.get(), ep.get(), "BASIC -> EPOLL");
+    TestEngineLoopback(ep.get(), basic.get(), "EPOLL -> BASIC");
+  }
   if (g_failures == 0) {
     printf("OK: all C++ engine tests passed\n");
     return 0;
