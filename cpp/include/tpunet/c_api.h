@@ -108,6 +108,16 @@ int32_t tpunet_comm_neighbor_exchange(uintptr_t comm, const void* sendbuf,
                                       uint64_t recv_nbytes, uint64_t* got);
 int32_t tpunet_comm_barrier(uintptr_t comm);
 
+/* ---- Telemetry ---------------------------------------------------------
+ * Metrics counters are process-global and always on; spans/push are gated by
+ * env (TPUNET_TRACE_DIR / TPUNET_METRICS_ADDR, rank 0-7 — the reference's
+ * gating, nthread:108-130). */
+/* Write the Prometheus text exposition into buf (NUL-terminated, truncated
+ * to cap). Returns the full length (excluding NUL), or a TPUNET_ERR_*. */
+int32_t tpunet_c_metrics_text(char* buf, uint64_t cap);
+/* Flush buffered trace spans to TPUNET_TRACE_DIR (no-op when disabled). */
+int32_t tpunet_c_trace_flush(void);
+
 #ifdef __cplusplus
 }
 #endif

@@ -1,0 +1,87 @@
+// tpunet observability: per-request tracing + transport metrics.
+//
+// TPU-native re-design of the reference's OpenTelemetry stack (SURVEY §5;
+// reference: nthread_per_socket_backend.rs:108-212): no third-party SDK,
+// one in-process singleton the engines feed through a decorator.
+//
+// Tracing (reference: root span "BaguaNet-{rank}" nthread:132-137, child
+// span per isend/irecv with id+nbytes attrs :529-538, ended at test()
+// completion :606): spans are buffered and flushed as Chrome-trace JSON
+// (loadable in Perfetto) to TPUNET_TRACE_DIR/tpunet-trace-rank<R>.json.
+// Env-gated exactly like the reference (rank 0-7 AND the address var set,
+// nthread:108-130).
+//
+// Metrics (reference: isend/irecv_nbytes histograms with boundaries
+// [16,1024,4096,1048576] nthread:139-180, bytes/s observers :343-348,
+// in-flight gauge tokio:184-190): counters are always-on atomics; a push
+// thread POSTs Prometheus text to a pushgateway at TPUNET_METRICS_ADDR
+// ("user:pass@host:port", basic auth, reference utils.rs:180-198) every
+// TPUNET_METRICS_INTERVAL_MS (default 1000 — the reference pushed every
+// 200 µs, nthread:183-211, which SURVEY flags as a bug we do not copy).
+#ifndef TPUNET_TELEMETRY_H_
+#define TPUNET_TELEMETRY_H_
+
+#include <cstdint>
+#include <memory>
+#include <string>
+
+#include "tpunet/net.h"
+
+namespace tpunet {
+
+// Histogram bucket upper bounds in bytes (reference: nthread:139-141), plus
+// a +Inf bucket.
+constexpr uint64_t kHistBounds[4] = {16, 1024, 4096, 1048576};
+constexpr int kHistBuckets = 5;
+
+struct MetricsSnapshot {
+  uint64_t isend_count = 0;
+  uint64_t irecv_count = 0;
+  uint64_t isend_bytes = 0;
+  uint64_t irecv_bytes = 0;
+  uint64_t isend_hist[kHistBuckets] = {0};
+  uint64_t irecv_hist[kHistBuckets] = {0};
+  uint64_t inflight = 0;        // requests posted but not yet test()ed done
+  uint64_t failed_requests = 0;
+  double uptime_s = 0;          // for bytes/s derivation
+};
+
+class Telemetry {
+ public:
+  static Telemetry& Get();
+
+  // Always-on counter hooks (lock-free). Span tracking only when tracing.
+  // `owner` disambiguates engine-local request ids across Net instances.
+  void OnRequestStart(uint64_t owner, bool is_send, uint64_t comm, uint64_t req,
+                      uint64_t nbytes);
+  void OnRequestDone(uint64_t owner, uint64_t req, bool failed);
+
+  MetricsSnapshot Snapshot() const;
+  // Prometheus text exposition of the snapshot (also what the push thread
+  // sends).
+  std::string PrometheusText() const;
+
+  bool tracing_enabled() const { return trace_enabled_; }
+  // Write buffered spans to the trace file; called on buffer pressure, from
+  // tpunet_c_trace_flush(), and at process exit (atexit — the singleton is
+  // leaked so its destructor never runs).
+  void FlushTrace();
+  // Stop the push thread and flush; atexit hook (safe to call repeatedly).
+  void ShutdownForExit();
+
+  ~Telemetry();
+
+ private:
+  Telemetry();
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+  bool trace_enabled_ = false;
+};
+
+// Decorator installed by CreateEngine() around the selected engine so both
+// engines (and any future one) report identically.
+std::unique_ptr<Net> WrapWithTelemetry(std::unique_ptr<Net> inner);
+
+}  // namespace tpunet
+
+#endif  // TPUNET_TELEMETRY_H_

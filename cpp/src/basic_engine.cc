@@ -46,6 +46,7 @@
 #include "engine_base.h"
 #include "id_map.h"
 #include "tpunet/net.h"
+#include "tpunet/telemetry.h"
 #include "tpunet/utils.h"
 #include "wire.h"
 
@@ -298,9 +299,16 @@ class BasicEngine : public EngineBase {
     }
     if (spin_) {
       // Spin mode busy-polls nonblocking fds (set only after the blocking
-      // preamble writes inside ConnectBundle).
-      for (auto& w : comm->workers) SetNonblocking(w->fd);
-      SetNonblocking(comm->ctrl_fd);
+      // preamble writes inside ConnectBundle). A failed fcntl must abort:
+      // a silently-blocking fd would wedge the busy-poll path.
+      Status ns = SetNonblocking(comm->ctrl_fd);
+      for (auto& w : comm->workers) {
+        if (ns.ok()) ns = SetNonblocking(w->fd);
+      }
+      if (!ns.ok()) {
+        comm->Shutdown();
+        return ns;
+      }
     }
     StartThreads(comm.get());
     uint64_t id = next_id_.fetch_add(1);
@@ -406,15 +414,20 @@ class BasicEngine : public EngineBase {
     comm->spin = spin_;
     comm->ctrl_fd = b.ctrl_fd;
     b.ctrl_fd = -1;
-    if (spin_) SetNonblocking(comm->ctrl_fd);  // ctrl carries the latency-critical length frame
+    Status ns = Status::Ok();
+    if (spin_) ns = SetNonblocking(comm->ctrl_fd);  // ctrl carries the length frame
     // Data streams ordered by stream id (reference: BTreeMap nthread:432).
     for (auto& kv : b.data_fds) {
       auto w = std::make_unique<StreamWorker>();
       w->fd = kv.second;
-      if (spin_) SetNonblocking(w->fd);
+      if (spin_ && ns.ok()) ns = SetNonblocking(w->fd);
       comm->workers.push_back(std::move(w));
     }
     b.data_fds.clear();
+    if (!ns.ok()) {
+      comm->Shutdown();
+      return ns;
+    }
     StartThreads(comm.get());
     uint64_t id = next_id_.fetch_add(1);
     recv_comms_.Put(id, comm);
@@ -434,10 +447,12 @@ std::unique_ptr<Net> CreateBasicEngine() { return std::make_unique<BasicEngine>(
 
 std::unique_ptr<Net> CreateEngine() {
   // Engine seam (reference: src/lib.rs:20-29 BAGUA_NET_IMPLEMENT
-  // BASIC|TOKIO); ours is TPUNET_IMPLEMENT BASIC|EPOLL.
+  // BASIC|TOKIO); ours is TPUNET_IMPLEMENT BASIC|EPOLL. Every engine goes
+  // out wrapped in the telemetry decorator so metrics/tracing cannot
+  // diverge between engines.
   std::string impl = GetEnv("TPUNET_IMPLEMENT", GetEnv("BAGUA_NET_IMPLEMENT", "BASIC"));
-  if (impl == "EPOLL") return CreateEpollEngine();
-  return CreateBasicEngine();
+  auto engine = impl == "EPOLL" ? CreateEpollEngine() : CreateBasicEngine();
+  return WrapWithTelemetry(std::move(engine));
 }
 
 }  // namespace tpunet

@@ -13,6 +13,7 @@
 
 #include "id_map.h"
 #include "tpunet/net.h"
+#include "tpunet/telemetry.h"
 
 namespace {
 
@@ -323,6 +324,22 @@ int32_t tpunet_comm_barrier(uintptr_t comm) {
   auto c = GetComm(comm);
   if (!c) return Fail(TPUNET_ERR_INVALID, "unknown comm");
   return FromStatus(c->Barrier());
+}
+
+int32_t tpunet_c_metrics_text(char* buf, uint64_t cap) {
+  if (!buf && cap > 0) return Fail(TPUNET_ERR_NULL, "buf is null");
+  std::string text = tpunet::Telemetry::Get().PrometheusText();
+  if (cap > 0) {
+    uint64_t n = std::min<uint64_t>(text.size(), cap - 1);
+    memcpy(buf, text.data(), n);
+    buf[n] = '\0';
+  }
+  return static_cast<int32_t>(text.size());
+}
+
+int32_t tpunet_c_trace_flush(void) {
+  tpunet::Telemetry::Get().FlushTrace();
+  return TPUNET_OK;
 }
 
 }  // extern "C"

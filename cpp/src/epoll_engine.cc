@@ -248,6 +248,14 @@ class Loop {
   }
 
   void Detach(const std::shared_ptr<EComm>& comm) {
+    // The NCCL contract says every request is test()ed done before close; if
+    // the caller closed early anyway, fail the stragglers so their test()
+    // surfaces an error instead of polling forever (BASIC flushes queued
+    // work on close for the same reason).
+    EComm* c = comm.get();
+    bool leftovers = !c->ctrl.segs.empty() || !c->pending.empty();
+    for (auto& s : c->streams) leftovers = leftovers || !s->segs.empty();
+    if (leftovers) FailComm(c, "comm closed with requests in flight");
     CloseFds(comm.get());
     comms_.erase(comm.get());
     // Keep the comm alive until the current event batch has fully drained —

@@ -1,0 +1,412 @@
+// tpunet telemetry implementation. See include/tpunet/telemetry.h.
+#include "tpunet/telemetry.h"
+
+#include <netdb.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "tpunet/utils.h"
+
+namespace tpunet {
+namespace {
+
+uint64_t NowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+int HistBucket(uint64_t nbytes) {
+  for (int i = 0; i < kHistBuckets - 1; ++i) {
+    if (nbytes <= kHistBounds[i]) return i;
+  }
+  return kHistBuckets - 1;
+}
+
+int64_t RankFromEnv() {
+  return static_cast<int64_t>(GetEnvU64("TPUNET_RANK", GetEnvU64("RANK", 0)));
+}
+
+// Reference gating: telemetry only for ranks 0-7 with the address var set
+// (nthread:108-130).
+bool RankGate() {
+  int64_t r = RankFromEnv();
+  return r >= 0 && r <= 7;
+}
+
+std::string Base64(const std::string& in) {
+  static const char* tbl = "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  std::string out;
+  size_t i = 0;
+  while (i + 2 < in.size()) {
+    uint32_t v = (uint8_t(in[i]) << 16) | (uint8_t(in[i + 1]) << 8) | uint8_t(in[i + 2]);
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += tbl[(v >> 6) & 63];
+    out += tbl[v & 63];
+    i += 3;
+  }
+  if (i + 1 == in.size()) {
+    uint32_t v = uint8_t(in[i]) << 16;
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += "==";
+  } else if (i + 2 == in.size()) {
+    uint32_t v = (uint8_t(in[i]) << 16) | (uint8_t(in[i + 1]) << 8);
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += tbl[(v >> 6) & 63];
+    out += "=";
+  }
+  return out;
+}
+
+struct Span {
+  bool is_send;
+  uint64_t comm;
+  uint64_t req;
+  uint64_t nbytes;
+  uint64_t start_us;
+  uint64_t dur_us;
+};
+
+// Request ids are engine-local (each instance counts from 1), so open spans
+// are keyed by (owner instance tag, request id).
+using SpanKey = std::pair<uint64_t, uint64_t>;
+struct SpanKeyHash {
+  size_t operator()(const SpanKey& k) const {
+    return std::hash<uint64_t>()(k.first * 0x9e3779b97f4a7c15ull ^ k.second);
+  }
+};
+
+}  // namespace
+
+struct Telemetry::Impl {
+  // Counters: always on, lock-free.
+  std::atomic<uint64_t> isend_count{0}, irecv_count{0};
+  std::atomic<uint64_t> isend_bytes{0}, irecv_bytes{0};
+  std::atomic<uint64_t> isend_hist[kHistBuckets] = {};
+  std::atomic<uint64_t> irecv_hist[kHistBuckets] = {};
+  std::atomic<uint64_t> inflight{0};
+  std::atomic<uint64_t> failed{0};
+  uint64_t start_us = NowUs();
+  int64_t rank = RankFromEnv();
+
+  // Span tracking (tracing only).
+  std::mutex span_mu;
+  std::unordered_map<SpanKey, Span, SpanKeyHash> open_spans;
+  std::vector<Span> done_spans;
+  std::string trace_path;
+  bool trace_header_written = false;
+
+  // Push thread.
+  std::thread pusher;
+  std::mutex push_mu;
+  std::condition_variable push_cv;
+  bool stopping = false;
+};
+
+Telemetry& Telemetry::Get() {
+  static Telemetry* t = new Telemetry();  // leaked on purpose: engines may
+  return *t;                              // report during static teardown
+}
+
+namespace {
+// The leaked singleton's destructor never runs, so final trace flush and
+// pusher shutdown are driven by atexit instead (registered only when some
+// telemetry sink is enabled).
+void TelemetryAtExit() { Telemetry::Get().ShutdownForExit(); }
+}  // namespace
+
+Telemetry::Telemetry() : impl_(new Impl()) {
+  std::string trace_dir = GetEnv("TPUNET_TRACE_DIR", GetEnv("BAGUA_NET_JAEGER_ADDRESS", ""));
+  if (!trace_dir.empty() && RankGate()) {
+    // The BAGUA_NET_JAEGER_ADDRESS fallback accepts the reference's env name
+    // but writes local Chrome-trace JSON — there is no Jaeger agent here.
+    impl_->trace_path =
+        trace_dir + "/tpunet-trace-rank" + std::to_string(impl_->rank) + ".json";
+    trace_enabled_ = true;
+  }
+
+  std::string addr = GetEnv("TPUNET_METRICS_ADDR", GetEnv("TPUNET_PROMETHEUS_ADDRESS",
+                            GetEnv("BAGUA_NET_PROMETHEUS_ADDRESS", "")));
+  if (trace_enabled_ || (!addr.empty() && RankGate())) {
+    std::atexit(TelemetryAtExit);
+  }
+  if (!addr.empty() && RankGate()) {
+    uint64_t interval_ms = GetEnvU64("TPUNET_METRICS_INTERVAL_MS", 1000);
+    if (interval_ms == 0) interval_ms = 1000;
+    impl_->pusher = std::thread([this, addr, interval_ms] {
+      UserPassAddr upa;
+      if (!ParseUserPassAndAddr(addr, &upa)) return;
+      auto colon = upa.addr.rfind(':');
+      if (colon == std::string::npos) return;
+      std::string host = upa.addr.substr(0, colon);
+      std::string port = upa.addr.substr(colon + 1);
+      std::string auth =
+          upa.user.empty() ? "" : "Authorization: Basic " + Base64(upa.user + ":" + upa.pass) + "\r\n";
+      std::string path = "/metrics/job/tpunet/rank/" + std::to_string(impl_->rank);
+      while (true) {
+        {
+          std::unique_lock<std::mutex> lk(impl_->push_mu);
+          impl_->push_cv.wait_for(lk, std::chrono::milliseconds(interval_ms),
+                                  [&] { return impl_->stopping; });
+          if (impl_->stopping) return;
+        }
+        std::string body = PrometheusText();
+        std::string req = "PUT " + path + " HTTP/1.1\r\nHost: " + host +
+                          "\r\nContent-Type: text/plain\r\n" + auth +
+                          "Content-Length: " + std::to_string(body.size()) +
+                          "\r\nConnection: close\r\n\r\n" + body;
+        struct addrinfo hints = {};
+        hints.ai_socktype = SOCK_STREAM;
+        struct addrinfo* res = nullptr;
+        if (getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0 || !res) continue;
+        int fd = ::socket(res->ai_family, SOCK_STREAM, 0);
+        if (fd >= 0) {
+          if (::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+            (void)!::send(fd, req.data(), req.size(), MSG_NOSIGNAL);
+            char drain[256];
+            (void)!::recv(fd, drain, sizeof(drain), MSG_DONTWAIT);
+          }
+          ::close(fd);
+        }
+        freeaddrinfo(res);
+      }
+    });
+  }
+}
+
+Telemetry::~Telemetry() { ShutdownForExit(); }
+
+void Telemetry::ShutdownForExit() {
+  if (impl_->pusher.joinable()) {
+    {
+      std::lock_guard<std::mutex> lk(impl_->push_mu);
+      impl_->stopping = true;
+    }
+    impl_->push_cv.notify_all();
+    impl_->pusher.join();
+  }
+  FlushTrace();
+}
+
+void Telemetry::OnRequestStart(uint64_t owner, bool is_send, uint64_t comm, uint64_t req,
+                               uint64_t nbytes) {
+  Impl* im = impl_.get();
+  if (is_send) {
+    im->isend_count.fetch_add(1, std::memory_order_relaxed);
+    im->isend_bytes.fetch_add(nbytes, std::memory_order_relaxed);
+    im->isend_hist[HistBucket(nbytes)].fetch_add(1, std::memory_order_relaxed);
+  } else {
+    im->irecv_count.fetch_add(1, std::memory_order_relaxed);
+    im->irecv_bytes.fetch_add(nbytes, std::memory_order_relaxed);
+    im->irecv_hist[HistBucket(nbytes)].fetch_add(1, std::memory_order_relaxed);
+  }
+  im->inflight.fetch_add(1, std::memory_order_relaxed);
+  if (trace_enabled_) {
+    std::lock_guard<std::mutex> lk(im->span_mu);
+    im->open_spans[SpanKey{owner, req}] = Span{is_send, comm, req, nbytes, NowUs(), 0};
+  }
+}
+
+void Telemetry::OnRequestDone(uint64_t owner, uint64_t req, bool failed) {
+  Impl* im = impl_.get();
+  // Clamp-to-zero guard: a done for an unseen request must not wrap the gauge.
+  uint64_t cur = im->inflight.load(std::memory_order_relaxed);
+  while (cur > 0 &&
+         !im->inflight.compare_exchange_weak(cur, cur - 1, std::memory_order_relaxed)) {
+  }
+  if (failed) im->failed.fetch_add(1, std::memory_order_relaxed);
+  if (!trace_enabled_) return;
+  bool flush = false;
+  {
+    std::lock_guard<std::mutex> lk(im->span_mu);
+    auto it = im->open_spans.find(SpanKey{owner, req});
+    if (it == im->open_spans.end()) return;
+    Span s = it->second;
+    im->open_spans.erase(it);
+    s.dur_us = NowUs() - s.start_us;
+    im->done_spans.push_back(s);
+    flush = im->done_spans.size() >= 4096;
+  }
+  if (flush) FlushTrace();
+}
+
+MetricsSnapshot Telemetry::Snapshot() const {
+  const Impl* im = impl_.get();
+  MetricsSnapshot s;
+  s.isend_count = im->isend_count.load(std::memory_order_relaxed);
+  s.irecv_count = im->irecv_count.load(std::memory_order_relaxed);
+  s.isend_bytes = im->isend_bytes.load(std::memory_order_relaxed);
+  s.irecv_bytes = im->irecv_bytes.load(std::memory_order_relaxed);
+  for (int i = 0; i < kHistBuckets; ++i) {
+    s.isend_hist[i] = im->isend_hist[i].load(std::memory_order_relaxed);
+    s.irecv_hist[i] = im->irecv_hist[i].load(std::memory_order_relaxed);
+  }
+  s.inflight = im->inflight.load(std::memory_order_relaxed);
+  s.failed_requests = im->failed.load(std::memory_order_relaxed);
+  s.uptime_s = (NowUs() - im->start_us) / 1e6;
+  return s;
+}
+
+std::string Telemetry::PrometheusText() const {
+  MetricsSnapshot s = Snapshot();
+  char buf[2048];
+  std::string out;
+  auto emit = [&](const char* fmt, auto... args) {
+    snprintf(buf, sizeof(buf), fmt, args...);
+    out += buf;
+  };
+  int64_t rank = impl_->rank;
+  // Instrument names follow the reference (isend_nbytes / irecv_nbytes value
+  // recorders nthread:172-180, bytes/s observers :343-348, hold_on_request
+  // in-flight gauge tokio:184-190).
+  emit("# TYPE tpunet_isend_nbytes histogram\n");
+  uint64_t cum = 0;
+  for (int i = 0; i < kHistBuckets - 1; ++i) {
+    cum += s.isend_hist[i];
+    emit("tpunet_isend_nbytes_bucket{rank=\"%lld\",le=\"%llu\"} %llu\n", (long long)rank,
+         (unsigned long long)kHistBounds[i], (unsigned long long)cum);
+  }
+  cum += s.isend_hist[kHistBuckets - 1];
+  emit("tpunet_isend_nbytes_bucket{rank=\"%lld\",le=\"+Inf\"} %llu\n", (long long)rank,
+       (unsigned long long)cum);
+  emit("tpunet_isend_nbytes_sum{rank=\"%lld\"} %llu\n", (long long)rank,
+       (unsigned long long)s.isend_bytes);
+  emit("tpunet_isend_nbytes_count{rank=\"%lld\"} %llu\n", (long long)rank,
+       (unsigned long long)s.isend_count);
+  emit("# TYPE tpunet_irecv_nbytes histogram\n");
+  cum = 0;
+  for (int i = 0; i < kHistBuckets - 1; ++i) {
+    cum += s.irecv_hist[i];
+    emit("tpunet_irecv_nbytes_bucket{rank=\"%lld\",le=\"%llu\"} %llu\n", (long long)rank,
+         (unsigned long long)kHistBounds[i], (unsigned long long)cum);
+  }
+  cum += s.irecv_hist[kHistBuckets - 1];
+  emit("tpunet_irecv_nbytes_bucket{rank=\"%lld\",le=\"+Inf\"} %llu\n", (long long)rank,
+       (unsigned long long)cum);
+  emit("tpunet_irecv_nbytes_sum{rank=\"%lld\"} %llu\n", (long long)rank,
+       (unsigned long long)s.irecv_bytes);
+  emit("tpunet_irecv_nbytes_count{rank=\"%lld\"} %llu\n", (long long)rank,
+       (unsigned long long)s.irecv_count);
+  emit("# TYPE tpunet_isend_nbytes_per_second gauge\n");
+  emit("tpunet_isend_nbytes_per_second{rank=\"%lld\"} %.1f\n", (long long)rank,
+       s.uptime_s > 0 ? s.isend_bytes / s.uptime_s : 0.0);
+  emit("# TYPE tpunet_hold_on_request gauge\n");
+  emit("tpunet_hold_on_request{rank=\"%lld\"} %llu\n", (long long)rank,
+       (unsigned long long)s.inflight);
+  emit("# TYPE tpunet_failed_requests counter\n");
+  emit("tpunet_failed_requests{rank=\"%lld\"} %llu\n", (long long)rank,
+       (unsigned long long)s.failed_requests);
+  return out;
+}
+
+void Telemetry::FlushTrace() {
+  if (!trace_enabled_) return;
+  Impl* im = impl_.get();
+  std::vector<Span> spans;
+  {
+    std::lock_guard<std::mutex> lk(im->span_mu);
+    spans.swap(im->done_spans);
+  }
+  if (spans.empty() && im->trace_header_written) return;
+  std::lock_guard<std::mutex> lk(im->span_mu);  // serialize file writes
+  FILE* f = fopen(im->trace_path.c_str(), im->trace_header_written ? "a" : "w");
+  if (!f) return;
+  if (!im->trace_header_written) {
+    // Chrome trace format; Perfetto tolerates a missing closing bracket, so
+    // appends stay valid.
+    fprintf(f, "[\n");
+    fprintf(f,
+            "{\"name\":\"tpunet-rank%lld\",\"ph\":\"M\",\"pid\":%lld,"
+            "\"args\":{\"kind\":\"process_name\"}},\n",
+            (long long)im->rank, (long long)im->rank);
+    im->trace_header_written = true;
+  }
+  for (const Span& s : spans) {
+    // Span naming per the reference: "isend-{comm}" / "irecv-{comm}" with id
+    // and nbytes attributes (nthread:529-538).
+    fprintf(f,
+            "{\"name\":\"%s-%llu\",\"ph\":\"X\",\"pid\":%lld,\"tid\":%llu,"
+            "\"ts\":%llu,\"dur\":%llu,\"args\":{\"id\":%llu,\"nbytes\":%llu}},\n",
+            s.is_send ? "isend" : "irecv", (unsigned long long)s.comm, (long long)im->rank,
+            (unsigned long long)s.comm, (unsigned long long)s.start_us,
+            (unsigned long long)s.dur_us, (unsigned long long)s.req,
+            (unsigned long long)s.nbytes);
+  }
+  fclose(f);
+}
+
+// ---------------------------------------------------------------------------
+
+namespace {
+
+class TelemetryNet : public Net {
+ public:
+  explicit TelemetryNet(std::unique_ptr<Net> inner) : inner_(std::move(inner)) {}
+
+  int32_t devices() override { return inner_->devices(); }
+  Status get_properties(int32_t dev, NetProperties* p) override {
+    return inner_->get_properties(dev, p);
+  }
+  Status listen(int32_t dev, SocketHandle* h, uint64_t* lc) override {
+    return inner_->listen(dev, h, lc);
+  }
+  Status connect(int32_t dev, const SocketHandle& h, uint64_t* sc) override {
+    return inner_->connect(dev, h, sc);
+  }
+  Status accept(uint64_t lc, uint64_t* rc) override { return inner_->accept(lc, rc); }
+
+  Status isend(uint64_t comm, const void* data, size_t n, uint64_t* req) override {
+    Status s = inner_->isend(comm, data, n, req);
+    if (s.ok()) Telemetry::Get().OnRequestStart(Owner(), true, comm, *req, n);
+    return s;
+  }
+  Status irecv(uint64_t comm, void* data, size_t n, uint64_t* req) override {
+    Status s = inner_->irecv(comm, data, n, req);
+    if (s.ok()) Telemetry::Get().OnRequestStart(Owner(), false, comm, *req, n);
+    return s;
+  }
+  Status test(uint64_t req, bool* done, size_t* nbytes) override {
+    Status s = inner_->test(req, done, nbytes);
+    if (!s.ok()) {
+      // Invalid = unknown/stale id (double-poll, garbage): the request was
+      // never tracked here, so neither the failure counter nor the in-flight
+      // gauge may move. Real transport errors DO consume the request id.
+      if (s.kind != ErrorKind::kInvalidArgument) {
+        Telemetry::Get().OnRequestDone(Owner(), req, /*failed=*/true);
+      }
+    } else if (*done) {
+      Telemetry::Get().OnRequestDone(Owner(), req, /*failed=*/false);
+    }
+    return s;
+  }
+
+  Status close_send(uint64_t c) override { return inner_->close_send(c); }
+  Status close_recv(uint64_t c) override { return inner_->close_recv(c); }
+  Status close_listen(uint64_t c) override { return inner_->close_listen(c); }
+
+ private:
+  uint64_t Owner() const { return reinterpret_cast<uint64_t>(this); }
+
+  std::unique_ptr<Net> inner_;
+};
+
+}  // namespace
+
+std::unique_ptr<Net> WrapWithTelemetry(std::unique_ptr<Net> inner) {
+  return std::make_unique<TelemetryNet>(std::move(inner));
+}
+
+}  // namespace tpunet

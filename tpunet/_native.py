@@ -147,6 +147,11 @@ def load() -> ctypes.CDLL:
     lib.tpunet_comm_barrier.argtypes = [u]
     lib.tpunet_comm_barrier.restype = i32
 
+    lib.tpunet_c_metrics_text.argtypes = [ctypes.c_char_p, u64]
+    lib.tpunet_c_metrics_text.restype = i32
+    lib.tpunet_c_trace_flush.argtypes = []
+    lib.tpunet_c_trace_flush.restype = i32
+
     _lib = lib
     return lib
 

@@ -1,0 +1,60 @@
+"""Transport observability accessors.
+
+The native layer records per-request metrics (always-on counters) and,
+when env-gated, trace spans (SURVEY §5; reference: OpenTelemetry pipeline in
+nthread_per_socket_backend.rs:108-212). This module reads them from Python:
+
+  metrics_text()  -> Prometheus exposition text
+  metrics()       -> parsed {metric_name: {labels_tuple: value}}
+  flush_trace()   -> write buffered spans to TPUNET_TRACE_DIR
+
+Env flags (rank-gated 0-7 like the reference, nthread:108-130):
+  TPUNET_TRACE_DIR            directory for Chrome-trace JSON (Perfetto)
+  TPUNET_METRICS_ADDR         pushgateway "user:pass@host:port"
+  TPUNET_METRICS_INTERVAL_MS  push period, default 1000
+"""
+
+from __future__ import annotations
+
+import ctypes
+import re
+
+from tpunet import _native
+
+
+def metrics_text() -> str:
+    lib = _native.load()
+    # Counters move concurrently, so the text can grow between the sizing
+    # call and the copy; retry until the copy fits its own length.
+    cap = 4096
+    while True:
+        buf = ctypes.create_string_buffer(cap)
+        n = lib.tpunet_c_metrics_text(buf, cap)
+        if n < 0:
+            raise _native.NativeError(n, "metrics_text")
+        if n < cap:
+            return buf.value.decode()
+        cap = n + 256
+
+
+_LINE = re.compile(r"^(\w+)\{([^}]*)\}\s+([0-9.eE+-]+)$")
+
+
+def metrics() -> dict:
+    """Parse the Prometheus text into {name: {(label=value, ...): float}}."""
+    out: dict = {}
+    for line in metrics_text().splitlines():
+        if line.startswith("#"):
+            continue
+        m = _LINE.match(line)
+        if not m:
+            continue
+        name, labels, value = m.groups()
+        key = tuple(sorted(labels.split(","))) if labels else ()
+        out.setdefault(name, {})[key] = float(value)
+    return out
+
+
+def flush_trace() -> None:
+    lib = _native.load()
+    _native.check(lib.tpunet_c_trace_flush(), "trace_flush")
