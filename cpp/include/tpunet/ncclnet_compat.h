@@ -1,0 +1,133 @@
+/* tpunet — NCCL net-plugin ABI compatibility declarations (fresh-written).
+ *
+ * These declarations reproduce the *shape* of NCCL's public net-plugin ABI so
+ * that build/libtpunet.so can double as a drop-in `libnccl-net.so`: an
+ * NCCL-style loader dlopens the library and resolves `ncclNetPlugin_v4`
+ * (falling back to `ncclNetPlugin_v3`). The reference ships the same two
+ * adapters (reference: cc/v4/nccl_net_v4.h:24-62, cc/v3/nccl_net_v3.h:24-61,
+ * vendored enums cc/nccl_types.h). Nothing here is copied; the layouts are
+ * ABI facts of NCCL's published plugin interface.
+ *
+ * The only v3/v4 behavioral difference (reference: v3/nccl_net_v3.h:53 vs
+ * v4/nccl_net_v4.h:54): v3 `flush` is synchronous, v4 `iflush` returns a
+ * request polled via test().
+ */
+#ifndef TPUNET_NCCLNET_COMPAT_H_
+#define TPUNET_NCCLNET_COMPAT_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Reference: cc/nccl_types.h:6-12. */
+typedef enum {
+  ncclSuccess = 0,
+  ncclUnhandledCudaError = 1,
+  ncclSystemError = 2,
+  ncclInternalError = 3,
+  ncclInvalidArgument = 4,
+  ncclInvalidUsage = 5,
+  ncclNumResults = 6
+} ncclResult_t;
+
+/* Pointer kinds a plugin may register (reference: cc/nccl_types.h:46-47).
+ * tpunet supports host memory only, like the reference (v4/nccl_net_v4.cc:
+ * 105-109). */
+#define NCCL_PTR_HOST 0x1
+#define NCCL_PTR_CUDA 0x2
+
+/* Rendezvous-handle budget and request depth (reference: cc/nccl_types.h:44,
+ * :50). Engines must tolerate >= 8 in-flight requests per comm. */
+#define NCCL_NET_HANDLE_MAXSIZE 64
+#define NCCL_NET_MAX_REQUESTS 8
+
+/* Debug logger injected by the loader at init (reference: cc/nccl_types.h:
+ * 52-55). */
+typedef enum {
+  NCCL_LOG_NONE = 0,
+  NCCL_LOG_VERSION = 1,
+  NCCL_LOG_WARN = 2,
+  NCCL_LOG_INFO = 3,
+  NCCL_LOG_ABORT = 4,
+  NCCL_LOG_TRACE = 5
+} ncclDebugLogLevel;
+
+typedef void (*ncclDebugLogger_t)(ncclDebugLogLevel level, unsigned long flags,
+                                  const char* file, int line, const char* fmt,
+                                  ...);
+
+/* Device properties returned by getProperties (reference: v4/nccl_net_v4.h +
+ * src/lib.rs:41-55 NCCLNetPropertiesC). Strings are owned by the plugin and
+ * stay alive for the process lifetime. */
+typedef struct {
+  char* name;
+  char* pciPath;
+  uint64_t guid;
+  int ptrSupport; /* NCCL_PTR_HOST | NCCL_PTR_CUDA */
+  int speed;      /* Mbps */
+  int port;
+  int maxComms;
+} ncclNetProperties_v4_t;
+
+typedef ncclNetProperties_v4_t ncclNetProperties_v3_t;
+
+/* The v4 vtable (reference export: cc/v4/nccl_net_v4.cc:210-226). */
+typedef struct {
+  const char* name;
+  ncclResult_t (*init)(ncclDebugLogger_t logFunction);
+  ncclResult_t (*devices)(int* ndev);
+  ncclResult_t (*getProperties)(int dev, ncclNetProperties_v4_t* props);
+  ncclResult_t (*listen)(int dev, void* handle, void** listenComm);
+  ncclResult_t (*connect)(int dev, void* handle, void** sendComm);
+  ncclResult_t (*accept)(void* listenComm, void** recvComm);
+  ncclResult_t (*regMr)(void* comm, void* data, int size, int type,
+                        void** mhandle);
+  ncclResult_t (*deregMr)(void* comm, void* mhandle);
+  ncclResult_t (*isend)(void* sendComm, void* data, int size, void* mhandle,
+                        void** request);
+  ncclResult_t (*irecv)(void* recvComm, void* data, int size, void* mhandle,
+                        void** request);
+  ncclResult_t (*iflush)(void* recvComm, void* data, int size, void* mhandle,
+                         void** request);
+  ncclResult_t (*test)(void* request, int* done, int* size);
+  ncclResult_t (*closeSend)(void* sendComm);
+  ncclResult_t (*closeRecv)(void* recvComm);
+  ncclResult_t (*closeListen)(void* listenComm);
+} ncclNet_v4_t;
+
+/* The v3 vtable (reference export: cc/v3/nccl_net_v3.cc:210-226); synchronous
+ * flush instead of iflush. */
+typedef struct {
+  const char* name;
+  ncclResult_t (*init)(ncclDebugLogger_t logFunction);
+  ncclResult_t (*devices)(int* ndev);
+  ncclResult_t (*getProperties)(int dev, ncclNetProperties_v3_t* props);
+  ncclResult_t (*listen)(int dev, void* handle, void** listenComm);
+  ncclResult_t (*connect)(int dev, void* handle, void** sendComm);
+  ncclResult_t (*accept)(void* listenComm, void** recvComm);
+  ncclResult_t (*regMr)(void* comm, void* data, int size, int type,
+                        void** mhandle);
+  ncclResult_t (*deregMr)(void* comm, void* mhandle);
+  ncclResult_t (*isend)(void* sendComm, void* data, int size, void* mhandle,
+                        void** request);
+  ncclResult_t (*irecv)(void* recvComm, void* data, int size, void* mhandle,
+                        void** request);
+  ncclResult_t (*flush)(void* recvComm, void* data, int size, void* mhandle);
+  ncclResult_t (*test)(void* request, int* done, int* size);
+  ncclResult_t (*closeSend)(void* sendComm);
+  ncclResult_t (*closeRecv)(void* recvComm);
+  ncclResult_t (*closeListen)(void* listenComm);
+} ncclNet_v3_t;
+
+/* Exported by libtpunet.so; an NCCL-style loader resolves v4 first, then v3
+ * (reference: SURVEY §1 L5→NCCL). */
+extern ncclNet_v4_t ncclNetPlugin_v4;
+extern ncclNet_v3_t ncclNetPlugin_v3;
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUNET_NCCLNET_COMPAT_H_ */

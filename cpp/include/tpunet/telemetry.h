@@ -64,8 +64,10 @@ class Telemetry {
   bool tracing_enabled() const { return trace_enabled_; }
   // Write buffered spans to the trace file; called on buffer pressure, from
   // tpunet_c_trace_flush(), and at process exit (atexit — the singleton is
-  // leaked so its destructor never runs).
-  void FlushTrace();
+  // leaked so its destructor never runs). Returns false when the trace file
+  // could not be written (spans are dropped); true on success or when tracing
+  // is disabled.
+  bool FlushTrace();
   // Stop the push thread and flush; atexit hook (safe to call repeatedly).
   void ShutdownForExit();
 

@@ -75,6 +75,14 @@ uint64_t DecodeU64BE(const uint8_t in[8]);
 std::string GetEnv(const char* name, const std::string& fallback = "");
 uint64_t GetEnvU64(const char* name, uint64_t fallback);
 
+// Fork-generation counter: bumps in the child after every fork() (via a
+// pthread_atfork handler registered on first call). Threads do not survive
+// fork, so anything owning a thread records ForkGeneration() at creation and
+// treats a mismatch as "my thread does not exist in this process" — fail fast
+// / leak the handle instead of hanging in a queue no one drains or joining a
+// pthread that never existed here.
+uint64_t ForkGeneration();
+
 // Socket helpers.
 Status SetNodelay(int fd);
 Status SetNonblocking(int fd);

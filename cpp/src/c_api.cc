@@ -338,7 +338,9 @@ int32_t tpunet_c_metrics_text(char* buf, uint64_t cap) {
 }
 
 int32_t tpunet_c_trace_flush(void) {
-  tpunet::Telemetry::Get().FlushTrace();
+  if (!tpunet::Telemetry::Get().FlushTrace()) {
+    return Fail(TPUNET_ERR_INNER, "trace file unwritable; spans dropped");
+  }
   return TPUNET_OK;
 }
 

@@ -120,12 +120,21 @@ class Loop {
     ev.events = EPOLLIN;
     ev.data.ptr = nullptr;  // nullptr tags the wake eventfd
     ::epoll_ctl(ep_, EPOLL_CTL_ADD, wake_, &ev);
-    thread_ = std::thread([this] { Run(); });
+    thread_ = std::make_unique<std::thread>([this] { Run(); });
   }
 
   ~Loop() {
+    if (ForkGeneration() != fork_gen_) {
+      // Forked child: the loop thread never existed in this process, so any
+      // pthread call on its stale id (join OR detach) is UB. Leak the handle
+      // and just close this process's copies of the fds.
+      (void)thread_.release();
+      if (ep_ >= 0) ::close(ep_);
+      if (wake_ >= 0) ::close(wake_);
+      return;
+    }
     Post(Command{Command::kStop, nullptr, nullptr, 0, nullptr, nullptr});
-    if (thread_.joinable()) thread_.join();
+    if (thread_ && thread_->joinable()) thread_->join();
     if (ep_ >= 0) ::close(ep_);
     if (wake_ >= 0) ::close(wake_);
   }
@@ -133,6 +142,11 @@ class Loop {
   void Post(Command c) {
     {
       std::lock_guard<std::mutex> lk(mu_);
+      // Loop threads do not survive fork(): in a forked child this engine's
+      // loop is gone, so fail fast instead of queueing commands nobody will
+      // ever drain (create the engine after fork, as per-process runtimes do).
+      // ForkGeneration() is a relaxed atomic load — no syscall on the hot path.
+      if (ForkGeneration() != fork_gen_) dead_ = true;
       if (!dead_) {
         cmds_.push_back(std::move(c));
         uint64_t one = 1;
@@ -461,7 +475,8 @@ class Loop {
   int ep_ = -1;
   int wake_ = -1;
   bool dead_ = false;  // guarded by mu_ after construction
-  std::thread thread_;
+  const uint64_t fork_gen_ = ForkGeneration();  // fork detection (see Post)
+  std::unique_ptr<std::thread> thread_;
   std::mutex mu_;
   std::deque<Command> cmds_;
   std::map<EComm*, std::shared_ptr<EComm>> comms_;  // keeps comms alive on-loop

@@ -111,6 +111,7 @@ struct Telemetry::Impl {
 
   // Push thread.
   std::thread pusher;
+  uint64_t pusher_fork_gen = 0;  // ForkGeneration() when pusher started
   std::mutex push_mu;
   std::condition_variable push_cv;
   bool stopping = false;
@@ -146,6 +147,7 @@ Telemetry::Telemetry() : impl_(new Impl()) {
   if (!addr.empty() && RankGate()) {
     uint64_t interval_ms = GetEnvU64("TPUNET_METRICS_INTERVAL_MS", 1000);
     if (interval_ms == 0) interval_ms = 1000;
+    impl_->pusher_fork_gen = ForkGeneration();
     impl_->pusher = std::thread([this, addr, interval_ms] {
       UserPassAddr upa;
       if (!ParseUserPassAndAddr(addr, &upa)) return;
@@ -196,7 +198,10 @@ void Telemetry::ShutdownForExit() {
       impl_->stopping = true;
     }
     impl_->push_cv.notify_all();
-    impl_->pusher.join();
+    // In a forked child the pusher pthread never existed here (atexit hooks
+    // registered pre-fork still run at the child's exit()); joining its stale
+    // id is UB, so abandon it — only the parent joins.
+    if (ForkGeneration() == impl_->pusher_fork_gen) impl_->pusher.join();
   }
   FlushTrace();
 }
@@ -303,6 +308,9 @@ std::string Telemetry::PrometheusText() const {
   emit("# TYPE tpunet_isend_nbytes_per_second gauge\n");
   emit("tpunet_isend_nbytes_per_second{rank=\"%lld\"} %.1f\n", (long long)rank,
        s.uptime_s > 0 ? s.isend_bytes / s.uptime_s : 0.0);
+  emit("# TYPE tpunet_irecv_nbytes_per_second gauge\n");
+  emit("tpunet_irecv_nbytes_per_second{rank=\"%lld\"} %.1f\n", (long long)rank,
+       s.uptime_s > 0 ? s.irecv_bytes / s.uptime_s : 0.0);
   emit("# TYPE tpunet_hold_on_request gauge\n");
   emit("tpunet_hold_on_request{rank=\"%lld\"} %llu\n", (long long)rank,
        (unsigned long long)s.inflight);
@@ -312,18 +320,18 @@ std::string Telemetry::PrometheusText() const {
   return out;
 }
 
-void Telemetry::FlushTrace() {
-  if (!trace_enabled_) return;
+bool Telemetry::FlushTrace() {
+  if (!trace_enabled_) return true;
   Impl* im = impl_.get();
   std::vector<Span> spans;
   {
     std::lock_guard<std::mutex> lk(im->span_mu);
     spans.swap(im->done_spans);
   }
-  if (spans.empty() && im->trace_header_written) return;
+  if (spans.empty() && im->trace_header_written) return true;
   std::lock_guard<std::mutex> lk(im->span_mu);  // serialize file writes
   FILE* f = fopen(im->trace_path.c_str(), im->trace_header_written ? "a" : "w");
-  if (!f) return;
+  if (!f) return false;  // spans dropped; caller surfaces the failure
   if (!im->trace_header_written) {
     // Chrome trace format; Perfetto tolerates a missing closing bracket, so
     // appends stay valid.
@@ -346,6 +354,7 @@ void Telemetry::FlushTrace() {
             (unsigned long long)s.nbytes);
   }
   fclose(f);
+  return true;
 }
 
 // ---------------------------------------------------------------------------

@@ -9,6 +9,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
+#include <pthread.h>
 #include <sched.h>
 #include <time.h>
 #include <stdlib.h>
@@ -16,7 +17,9 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <atomic>
 #include <cstdio>
+#include <mutex>
 #include <fstream>
 #include <set>
 #include <sstream>
@@ -41,6 +44,19 @@ uint64_t GetEnvU64(const char* name, uint64_t fallback) {
   unsigned long long parsed = strtoull(v, &end, 10);
   if (end == v || (end && *end != '\0') || errno == ERANGE) return fallback;
   return static_cast<uint64_t>(parsed);
+}
+
+namespace {
+std::atomic<uint64_t> g_fork_gen{0};
+std::once_flag g_fork_once;
+}  // namespace
+
+uint64_t ForkGeneration() {
+  std::call_once(g_fork_once, [] {
+    ::pthread_atfork(nullptr, nullptr,
+                     [] { g_fork_gen.fetch_add(1, std::memory_order_relaxed); });
+  });
+  return g_fork_gen.load(std::memory_order_relaxed);
 }
 
 int32_t GetNetIfSpeed(const std::string& ifname) {

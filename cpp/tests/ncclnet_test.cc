@@ -1,0 +1,155 @@
+// tpunet — drop-in validation of the ncclNet-shaped vtable (BASELINE
+// config 1). Loads build/libtpunet.so the way an NCCL-style loader would
+// (dlopen + dlsym "ncclNetPlugin_v4", fallback probe of "_v3", SURVEY §1 L5),
+// then drives a loopback isend/irecv sweep purely through the vtable — no
+// tpunet headers other than the compat ABI are used past this point.
+#include <dlfcn.h>
+#include <string.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <thread>
+#include <vector>
+
+#include "tpunet/ncclnet_compat.h"
+
+static int g_failures = 0;
+
+#define CHECK(cond)                                                      \
+  do {                                                                   \
+    if (!(cond)) {                                                       \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);    \
+      ++g_failures;                                                      \
+    }                                                                    \
+  } while (0)
+
+static int g_log_lines = 0;
+static void TestLogger(ncclDebugLogLevel, unsigned long, const char*, int,
+                       const char*, ...) {
+  ++g_log_lines;
+}
+
+static void WaitDone(const ncclNet_v4_t* net, void* req, int* size) {
+  int done = 0;
+  while (!done) {
+    if (net->test(req, &done, size) != ncclSuccess) {
+      fprintf(stderr, "FAIL: vtable test() errored\n");
+      ++g_failures;
+      return;
+    }
+  }
+}
+
+int main(int argc, char** argv) {
+  const char* so = argc > 1 ? argv[1] : "build/libtpunet.so";
+  void* lib = dlopen(so, RTLD_NOW | RTLD_LOCAL);
+  if (lib == nullptr) {
+    fprintf(stderr, "FAIL: dlopen(%s): %s\n", so, dlerror());
+    return 1;
+  }
+  auto* net = static_cast<ncclNet_v4_t*>(dlsym(lib, "ncclNetPlugin_v4"));
+  auto* net3 = static_cast<ncclNet_v3_t*>(dlsym(lib, "ncclNetPlugin_v3"));
+  CHECK(net != nullptr);
+  CHECK(net3 != nullptr);
+  if (net == nullptr) return 1;
+  CHECK(strcmp(net->name, "TPUNet") == 0);
+  CHECK(strcmp(net3->name, "TPUNet") == 0);
+
+  CHECK(net->init(TestLogger) == ncclSuccess);
+  CHECK(g_log_lines > 0);
+
+  int ndev = 0;
+  CHECK(net->devices(&ndev) == ncclSuccess);
+  CHECK(ndev >= 1);
+  ncclNetProperties_v4_t props = {};
+  CHECK(net->getProperties(0, &props) == ncclSuccess);
+  CHECK(props.name != nullptr && props.name[0] != '\0');
+  CHECK(props.ptrSupport == NCCL_PTR_HOST);
+  CHECK(props.maxComms > 0);
+  CHECK(net->getProperties(ndev + 7, &props) == ncclInvalidArgument);
+
+  // Loopback rendezvous through the 64-byte opaque handle.
+  unsigned char handle[NCCL_NET_HANDLE_MAXSIZE] = {0};
+  void* listenComm = nullptr;
+  void* sendComm = nullptr;
+  void* recvComm = nullptr;
+  CHECK(net->listen(0, handle, &listenComm) == ncclSuccess);
+  CHECK(listenComm != nullptr);
+  std::thread acceptor(
+      [&] { CHECK(net->accept(listenComm, &recvComm) == ncclSuccess); });
+  CHECK(net->connect(0, handle, &sendComm) == ncclSuccess);
+  acceptor.join();
+  CHECK(sendComm != nullptr && recvComm != nullptr);
+
+  // regMr contract: host pointers fine (mhandle null), CUDA rejected.
+  void* mhandle = reinterpret_cast<void*>(0xdead);
+  CHECK(net->regMr(sendComm, handle, 64, NCCL_PTR_HOST, &mhandle) ==
+        ncclSuccess);
+  CHECK(mhandle == nullptr);
+  CHECK(net->regMr(sendComm, handle, 64, NCCL_PTR_CUDA, &mhandle) !=
+        ncclSuccess);
+  CHECK(net->deregMr(sendComm, nullptr) == ncclSuccess);
+  // No device memory -> flush paths must refuse.
+  void* freq = nullptr;
+  CHECK(net->iflush(recvComm, handle, 64, nullptr, &freq) != ncclSuccess);
+  CHECK(net3->flush(recvComm, handle, 64, nullptr) != ncclSuccess);
+
+  // Size sweep with payload verification; recv posts a larger buffer and the
+  // true size must come back from test() (ctrl-frame semantics, SURVEY §2.2).
+  for (int size : {0, 1, 8, 4096, 1 << 20, 5000000}) {
+    std::vector<unsigned char> src(size), dst(size + 64, 0xAA);
+    for (int i = 0; i < size; ++i) src[i] = static_cast<unsigned char>(i * 37 + 11);
+    void* sreq = nullptr;
+    void* rreq = nullptr;
+    CHECK(net->irecv(recvComm, dst.data(), static_cast<int>(dst.size()),
+                     nullptr, &rreq) == ncclSuccess);
+    CHECK(net->isend(sendComm, src.data(), size, nullptr, &sreq) ==
+          ncclSuccess);
+    CHECK(sreq != nullptr && rreq != nullptr);
+    int sent = -1, got = -1;
+    WaitDone(net, sreq, &sent);
+    WaitDone(net, rreq, &got);
+    CHECK(sent == size);
+    CHECK(got == size);
+    CHECK(memcmp(src.data(), dst.data(), size) == 0);
+    for (size_t i = size; i < dst.size(); ++i) CHECK(dst[i] == 0xAA);
+  }
+
+  // NCCL keeps up to 8 requests in flight per comm (NCCL_NET_MAX_REQUESTS).
+  constexpr int kInflight = NCCL_NET_MAX_REQUESTS;
+  constexpr int kMsg = 65536;
+  std::vector<std::vector<unsigned char>> srcs(kInflight), dsts(kInflight);
+  void* sreqs[kInflight];
+  void* rreqs[kInflight];
+  for (int i = 0; i < kInflight; ++i) {
+    srcs[i].assign(kMsg, static_cast<unsigned char>(i + 1));
+    dsts[i].assign(kMsg, 0);
+    CHECK(net->irecv(recvComm, dsts[i].data(), kMsg, nullptr, &rreqs[i]) ==
+          ncclSuccess);
+  }
+  for (int i = 0; i < kInflight; ++i) {
+    CHECK(net->isend(sendComm, srcs[i].data(), kMsg, nullptr, &sreqs[i]) ==
+          ncclSuccess);
+  }
+  for (int i = 0; i < kInflight; ++i) {
+    int n = 0;
+    WaitDone(net, sreqs[i], &n);
+    WaitDone(net, rreqs[i], &n);
+    CHECK(n == kMsg);
+    CHECK(memcmp(srcs[i].data(), dsts[i].data(), kMsg) == 0);
+  }
+
+  CHECK(net->closeSend(sendComm) == ncclSuccess);
+  CHECK(net->closeRecv(recvComm) == ncclSuccess);
+  CHECK(net->closeListen(listenComm) == ncclSuccess);
+  // Stale handles are invalid-argument, not a crash.
+  CHECK(net->closeSend(sendComm) == ncclInvalidArgument);
+
+  dlclose(lib);
+  if (g_failures == 0) {
+    printf("OK: ncclNet vtable drop-in tests passed\n");
+    return 0;
+  }
+  printf("FAILED: %d check(s)\n", g_failures);
+  return 1;
+}
