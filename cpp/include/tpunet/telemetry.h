@@ -34,6 +34,10 @@ namespace tpunet {
 constexpr uint64_t kHistBounds[4] = {16, 1024, 4096, 1048576};
 constexpr int kHistBuckets = 5;
 
+// Per-stream byte counters cap (streams beyond this lump into the last slot;
+// default nstreams is 2-8, so 32 covers every sane config).
+constexpr int kMaxStreamStats = 32;
+
 struct MetricsSnapshot {
   uint64_t isend_count = 0;
   uint64_t irecv_count = 0;
@@ -43,6 +47,11 @@ struct MetricsSnapshot {
   uint64_t irecv_hist[kHistBuckets] = {0};
   uint64_t inflight = 0;        // requests posted but not yet test()ed done
   uint64_t failed_requests = 0;
+  // Bytes moved per data-stream index, all comms aggregated — the observable
+  // form of the rotating-cursor fairness property (the reference exposed
+  // per-stream effective-time observers instead, nthread:343-348).
+  uint64_t stream_tx_bytes[kMaxStreamStats] = {0};
+  uint64_t stream_rx_bytes[kMaxStreamStats] = {0};
   double uptime_s = 0;          // for bytes/s derivation
 };
 
@@ -55,6 +64,9 @@ class Telemetry {
   void OnRequestStart(uint64_t owner, bool is_send, uint64_t comm, uint64_t req,
                       uint64_t nbytes);
   void OnRequestDone(uint64_t owner, uint64_t req, bool failed);
+  // Engine hot-path hook: `nbytes` moved on data-stream `stream_idx`
+  // (relaxed atomic add; indices >= kMaxStreamStats clamp to the last slot).
+  void OnStreamBytes(bool is_send, uint64_t stream_idx, uint64_t nbytes);
 
   MetricsSnapshot Snapshot() const;
   // Prometheus text exposition of the snapshot (also what the push thread

@@ -106,6 +106,7 @@ struct Comm;
 // One data stream: a TCP connection owned by one worker thread.
 struct StreamWorker {
   int fd = -1;
+  size_t idx = 0;  // data-stream index (for per-stream fairness counters)
   Comm* comm = nullptr;
   Queue<ChunkTask> tasks;
   std::thread thread;
@@ -120,7 +121,11 @@ struct Comm {
   bool spin = false;
   std::vector<std::unique_ptr<StreamWorker>> workers;
   Queue<Msg> msgs;
-  std::thread scheduler;
+  std::unique_ptr<std::thread> scheduler;
+  // Threads do not survive fork(): a mismatch means this comm's scheduler /
+  // workers never existed in this process (see Shutdown and the engine's
+  // isend/irecv fail-fast).
+  const uint64_t fork_gen = ForkGeneration();
 
   ~Comm() { Shutdown(); }
 
@@ -138,6 +143,21 @@ struct Comm {
   void Shutdown() {
     if (shut_) return;
     shut_ = true;
+    if (ForkGeneration() != fork_gen) {
+      // Forked child: scheduler/worker pthreads never existed here and the
+      // queue mutexes may have been captured mid-lock at fork. Leak the
+      // thread handles (any pthread call on their stale ids is UB) and only
+      // close this process's copies of the fds.
+      (void)scheduler.release();
+      for (auto& w : workers) {
+        if (w->fd >= 0) ::close(w->fd);
+        (void)w.release();
+      }
+      workers.clear();
+      if (ctrl_fd >= 0) ::close(ctrl_fd);
+      ctrl_fd = -1;
+      return;
+    }
     msgs.Close();
     // By the NCCL contract every request has been test()ed done before close,
     // so scheduler/workers are idle in Pop and the shutdown()s below are
@@ -145,7 +165,7 @@ struct Comm {
     // bytes in flight), SHUT_RDWR wakes threads blocked in kernel send/recv —
     // a hang would otherwise be permanent since std::thread has no timed join.
     AbortStreams();
-    if (scheduler.joinable()) scheduler.join();
+    if (scheduler && scheduler->joinable()) scheduler->join();
     for (auto& w : workers) w->tasks.Close();
     for (auto& w : workers) {
       if (w->thread.joinable()) w->thread.join();
@@ -174,6 +194,8 @@ void SendWorkerLoop(StreamWorker* w, bool spin) {
     if (!s.ok()) {
       t.state->SetError(s.msg);
       w->comm->AbortStreams();
+    } else {
+      Telemetry::Get().OnStreamBytes(true, w->idx, t.len);
     }
     t.state->nbytes.fetch_add(t.len, std::memory_order_relaxed);
     t.state->completed.fetch_add(1, std::memory_order_acq_rel);
@@ -187,6 +209,8 @@ void RecvWorkerLoop(StreamWorker* w, bool spin) {
     if (!s.ok()) {
       t.state->SetError(s.msg);
       w->comm->AbortStreams();
+    } else {
+      Telemetry::Get().OnStreamBytes(false, w->idx, t.len);
     }
     t.state->nbytes.fetch_add(t.len, std::memory_order_relaxed);
     t.state->completed.fetch_add(1, std::memory_order_acq_rel);
@@ -295,6 +319,7 @@ class BasicEngine : public EngineBase {
     for (int fd : data_fds) {
       auto w = std::make_unique<StreamWorker>();
       w->fd = fd;
+      w->idx = comm->workers.size();
       comm->workers.push_back(std::move(w));
     }
     if (spin_) {
@@ -329,6 +354,9 @@ class BasicEngine : public EngineBase {
     if (!send_comms_.Get(send_comm, &c)) {
       return Status::Invalid("unknown send comm " + std::to_string(send_comm));
     }
+    if (ForkGeneration() != c->fork_gen) {
+      return Status::Inner("send comm created before fork(); its threads do not exist here");
+    }
     auto state = std::make_shared<RequestState>();
     uint64_t id = next_id_.fetch_add(1);
     requests_.Put(id, state);
@@ -341,6 +369,9 @@ class BasicEngine : public EngineBase {
     CommPtr c;
     if (!recv_comms_.Get(recv_comm, &c)) {
       return Status::Invalid("unknown recv comm " + std::to_string(recv_comm));
+    }
+    if (ForkGeneration() != c->fork_gen) {
+      return Status::Inner("recv comm created before fork(); its threads do not exist here");
     }
     auto state = std::make_shared<RequestState>();
     uint64_t id = next_id_.fetch_add(1);
@@ -401,7 +432,8 @@ class BasicEngine : public EngineBase {
       w->thread = c->is_send ? std::thread(SendWorkerLoop, wp, spin)
                              : std::thread(RecvWorkerLoop, wp, spin);
     }
-    c->scheduler = c->is_send ? std::thread(SendSchedulerLoop, c) : std::thread(RecvSchedulerLoop, c);
+    c->scheduler = std::make_unique<std::thread>(
+        c->is_send ? SendSchedulerLoop : RecvSchedulerLoop, c);
   }
 
   Status BuildRecvComm(PartialBundle& b, uint64_t* recv_comm) {
@@ -420,6 +452,7 @@ class BasicEngine : public EngineBase {
     for (auto& kv : b.data_fds) {
       auto w = std::make_unique<StreamWorker>();
       w->fd = kv.second;
+      w->idx = comm->workers.size();
       if (spin_ && ns.ok()) ns = SetNonblocking(w->fd);
       comm->workers.push_back(std::move(w));
     }

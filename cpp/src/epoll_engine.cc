@@ -40,6 +40,7 @@
 #include "engine_base.h"
 #include "id_map.h"
 #include "tpunet/net.h"
+#include "tpunet/telemetry.h"
 #include "tpunet/utils.h"
 #include "wire.h"
 
@@ -64,6 +65,7 @@ struct EComm;
 struct FdState {
   int fd = -1;
   bool is_ctrl = false;
+  uint64_t stream_idx = 0;  // data-stream index (per-stream fairness counters)
   EComm* comm = nullptr;
   std::deque<Segment> segs;
   uint32_t armed = 0;  // events currently registered with epoll
@@ -140,13 +142,18 @@ class Loop {
   }
 
   void Post(Command c) {
+    // Loop threads do not survive fork(): in a forked child this engine's
+    // loop is gone, so fail fast instead of queueing commands nobody will
+    // ever drain (create the engine after fork, as per-process runtimes do).
+    // Checked BEFORE taking mu_ — fork may have captured mu_ locked by the
+    // loop thread, in which case the child would block on it forever.
+    // ForkGeneration() is a relaxed atomic load — no syscall on the hot path.
+    if (ForkGeneration() != fork_gen_) {
+      FailCommand(c, "engine created before fork(); its loop thread does not exist here");
+      return;
+    }
     {
       std::lock_guard<std::mutex> lk(mu_);
-      // Loop threads do not survive fork(): in a forked child this engine's
-      // loop is gone, so fail fast instead of queueing commands nobody will
-      // ever drain (create the engine after fork, as per-process runtimes do).
-      // ForkGeneration() is a relaxed atomic load — no syscall on the hot path.
-      if (ForkGeneration() != fork_gen_) dead_ = true;
       if (!dead_) {
         cmds_.push_back(std::move(c));
         uint64_t one = 1;
@@ -379,6 +386,10 @@ class Loop {
         m = ::recv(fs->fd, seg.data + seg.done, seg.len - seg.done, MSG_DONTWAIT);
       }
       if (m > 0) {
+        if (!fs->is_ctrl) {
+          Telemetry::Get().OnStreamBytes(c->is_send, fs->stream_idx,
+                                         static_cast<uint64_t>(m));
+        }
         seg.done += static_cast<size_t>(m);
         if (seg.done == seg.len) {
           CompleteSegment(seg);
@@ -591,6 +602,7 @@ class EpollEngine : public EngineBase {
     for (int fd : data_fds) {
       auto fs = std::make_unique<FdState>();
       fs->fd = fd;
+      fs->stream_idx = comm->streams.size();
       fs->comm = comm.get();
       comm->streams.push_back(std::move(fs));
     }

@@ -99,6 +99,8 @@ struct Telemetry::Impl {
   std::atomic<uint64_t> irecv_hist[kHistBuckets] = {};
   std::atomic<uint64_t> inflight{0};
   std::atomic<uint64_t> failed{0};
+  std::atomic<uint64_t> stream_tx[kMaxStreamStats] = {};
+  std::atomic<uint64_t> stream_rx[kMaxStreamStats] = {};
   uint64_t start_us = NowUs();
   int64_t rank = RankFromEnv();
 
@@ -109,9 +111,13 @@ struct Telemetry::Impl {
   std::string trace_path;
   bool trace_header_written = false;
 
+  // Threads do not survive fork(): a mismatch in the child means the pusher
+  // pthread never existed here and push_mu/span_mu may have been captured
+  // mid-lock at fork — skip the whole shutdown handshake there.
+  const uint64_t created_fork_gen = ForkGeneration();
+
   // Push thread.
   std::thread pusher;
-  uint64_t pusher_fork_gen = 0;  // ForkGeneration() when pusher started
   std::mutex push_mu;
   std::condition_variable push_cv;
   bool stopping = false;
@@ -147,7 +153,6 @@ Telemetry::Telemetry() : impl_(new Impl()) {
   if (!addr.empty() && RankGate()) {
     uint64_t interval_ms = GetEnvU64("TPUNET_METRICS_INTERVAL_MS", 1000);
     if (interval_ms == 0) interval_ms = 1000;
-    impl_->pusher_fork_gen = ForkGeneration();
     impl_->pusher = std::thread([this, addr, interval_ms] {
       UserPassAddr upa;
       if (!ParseUserPassAndAddr(addr, &upa)) return;
@@ -192,16 +197,18 @@ Telemetry::Telemetry() : impl_(new Impl()) {
 Telemetry::~Telemetry() { ShutdownForExit(); }
 
 void Telemetry::ShutdownForExit() {
+  // Forked child (atexit hooks registered pre-fork still run at its exit()):
+  // the pusher pthread never existed here and the mutexes below may have been
+  // captured locked at fork — skip the shutdown handshake entirely; the
+  // parent owns the final flush.
+  if (ForkGeneration() != impl_->created_fork_gen) return;
   if (impl_->pusher.joinable()) {
     {
       std::lock_guard<std::mutex> lk(impl_->push_mu);
       impl_->stopping = true;
     }
     impl_->push_cv.notify_all();
-    // In a forked child the pusher pthread never existed here (atexit hooks
-    // registered pre-fork still run at the child's exit()); joining its stale
-    // id is UB, so abandon it — only the parent joins.
-    if (ForkGeneration() == impl_->pusher_fork_gen) impl_->pusher.join();
+    impl_->pusher.join();
   }
   FlushTrace();
 }
@@ -248,9 +255,19 @@ void Telemetry::OnRequestDone(uint64_t owner, uint64_t req, bool failed) {
   if (flush) FlushTrace();
 }
 
+void Telemetry::OnStreamBytes(bool is_send, uint64_t stream_idx, uint64_t nbytes) {
+  if (stream_idx >= kMaxStreamStats) stream_idx = kMaxStreamStats - 1;
+  auto& slot = is_send ? impl_->stream_tx[stream_idx] : impl_->stream_rx[stream_idx];
+  slot.fetch_add(nbytes, std::memory_order_relaxed);
+}
+
 MetricsSnapshot Telemetry::Snapshot() const {
   const Impl* im = impl_.get();
   MetricsSnapshot s;
+  for (int i = 0; i < kMaxStreamStats; ++i) {
+    s.stream_tx_bytes[i] = im->stream_tx[i].load(std::memory_order_relaxed);
+    s.stream_rx_bytes[i] = im->stream_rx[i].load(std::memory_order_relaxed);
+  }
   s.isend_count = im->isend_count.load(std::memory_order_relaxed);
   s.irecv_count = im->irecv_count.load(std::memory_order_relaxed);
   s.isend_bytes = im->isend_bytes.load(std::memory_order_relaxed);
@@ -311,6 +328,18 @@ std::string Telemetry::PrometheusText() const {
   emit("# TYPE tpunet_irecv_nbytes_per_second gauge\n");
   emit("tpunet_irecv_nbytes_per_second{rank=\"%lld\"} %.1f\n", (long long)rank,
        s.uptime_s > 0 ? s.irecv_bytes / s.uptime_s : 0.0);
+  emit("# TYPE tpunet_stream_tx_bytes counter\n");
+  for (int i = 0; i < kMaxStreamStats; ++i) {
+    if (s.stream_tx_bytes[i] == 0) continue;
+    emit("tpunet_stream_tx_bytes{rank=\"%lld\",stream=\"%d\"} %llu\n", (long long)rank, i,
+         (unsigned long long)s.stream_tx_bytes[i]);
+  }
+  emit("# TYPE tpunet_stream_rx_bytes counter\n");
+  for (int i = 0; i < kMaxStreamStats; ++i) {
+    if (s.stream_rx_bytes[i] == 0) continue;
+    emit("tpunet_stream_rx_bytes{rank=\"%lld\",stream=\"%d\"} %llu\n", (long long)rank, i,
+         (unsigned long long)s.stream_rx_bytes[i]);
+  }
   emit("# TYPE tpunet_hold_on_request gauge\n");
   emit("tpunet_hold_on_request{rank=\"%lld\"} %llu\n", (long long)rank,
        (unsigned long long)s.inflight);

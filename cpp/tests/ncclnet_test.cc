@@ -51,7 +51,7 @@ int main(int argc, char** argv) {
   auto* net3 = static_cast<ncclNet_v3_t*>(dlsym(lib, "ncclNetPlugin_v3"));
   CHECK(net != nullptr);
   CHECK(net3 != nullptr);
-  if (net == nullptr) return 1;
+  if (net == nullptr || net3 == nullptr) return 1;
   CHECK(strcmp(net->name, "TPUNet") == 0);
   CHECK(strcmp(net3->name, "TPUNet") == 0);
 
