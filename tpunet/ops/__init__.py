@@ -1,0 +1,12 @@
+"""tpunet.ops — TPU kernels for the hot ops (Pallas).
+
+The reference (bagua-net) has no compute kernels — it is a transport. This
+package holds the compute-side hot ops our framework's model layer needs so
+the end-to-end benchmarks (VGG16-class DP, long-context transformer) keep the
+MXU fed: a flash-attention kernel with an online-softmax inner loop, used both
+for local attention and as the per-block compute of ring attention.
+"""
+
+from tpunet.ops.flash_attention import attention_reference, flash_attention
+
+__all__ = ["flash_attention", "attention_reference"]

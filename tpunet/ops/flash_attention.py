@@ -1,0 +1,155 @@
+"""Flash attention — Pallas TPU kernel with online softmax.
+
+The reference repo (bagua-net) is pure transport and has no kernels; this op
+exists because our framework's model layer (transformer family, long-context
+ring attention) needs the attention hot op to be MXU-shaped: blockwise QK^T
+and PV matmuls with f32 accumulators, never materializing the (Sq, Sk) score
+matrix in HBM.
+
+Design notes (TPU-first):
+  * grid = (batch*heads, Sq/block_q); each program streams the K/V sequence
+    blockwise through VMEM with a `fori_loop`, carrying the online-softmax
+    state (m, l, acc) functionally.
+  * causal masking prunes the k-loop upper bound per q-block (no wasted
+    MXU work on fully-masked blocks); the diagonal block is masked
+    elementwise.
+  * backward pass: recompute-based `custom_vjp` — the canonical flash
+    strategy (store only q/k/v and the output statistics are recomputed).
+    We recompute via the reference einsum path, whose VJP XLA fuses well;
+    a dedicated backward kernel is a later optimization.
+  * `interpret` defaults to "auto": the Pallas interpreter on CPU (tests),
+    compiled Mosaic on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def attention_reference(q, k, v, causal: bool = False):
+    """Plain softmax attention, f32 internally. Shapes (B, S, H, D)."""
+    dt = q.dtype
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale, k.astype(jnp.float32))
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        qpos = jnp.arange(sq)[:, None]
+        kpos = jnp.arange(sk)[None, :]
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return o.astype(dt)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
+                  seq_k: int, causal: bool, scale: float):
+    """One (batch*head, q-block) program. Refs: q (1, block_q, D),
+    k/v (1, seq_k, D), o (1, block_q, D)."""
+    qi = pl.program_id(1)
+    q = q_ref[0, :, :].astype(jnp.float32) * scale
+    head_dim = q.shape[-1]
+
+    if causal:
+        # Last k-block that the final row of this q-block may attend to.
+        num_kb = pl.cdiv((qi + 1) * block_q, block_k)
+    else:
+        num_kb = seq_k // block_k
+
+    def body(j, carry):
+        acc, m, l = carry
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            kpos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, vb, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, _, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
+    o_ref[0, :, :] = (acc / l).astype(o_ref.dtype)
+
+
+def _auto_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None):
+    """Flash attention. q/k/v: (batch, seq, heads, head_dim); returns q-shaped.
+
+    Falls back to the reference einsum path when the sequence lengths don't
+    tile evenly (ragged tails are a later kernel feature, not a behavioral
+    gap — results are identical either way).
+    """
+    return _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k or (causal and block_q % block_k):
+        return attention_reference(q, k, v, causal)
+    if interpret is None:
+        interpret = _auto_interpret()
+
+    # (B, S, H, D) -> (B*H, S, D): grid programs are independent per head.
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    kernel = functools.partial(
+        _flash_kernel, block_q=block_q, block_k=block_k, seq_k=sk,
+        causal=causal, scale=1.0 / math.sqrt(d),
+    )
+    of = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return of.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    o = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return o, (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: attention_reference(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
