@@ -11,7 +11,12 @@ reference plugin carried the inter-node TCP traffic (SURVEY §5).
 from tpunet.parallel.mesh import (  # noqa: F401
     batch_sharding,
     make_mesh,
+    make_named_mesh,
     replicated,
     shard_params,
     vgg_partition_rules,
+)
+from tpunet.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    ring_self_attention,
 )

@@ -34,6 +34,20 @@ def make_mesh(dp: int | None = None, mdl: int = 1, devices=None) -> Mesh:
     return Mesh(arr, axis_names=("dp", "mdl"))
 
 
+def make_named_mesh(axis_sizes: dict[str, int], devices=None) -> Mesh:
+    """Build a mesh with arbitrary named axes, e.g. {"dp": 2, "tp": 2, "sp": 2}.
+
+    Axis order is the dict order (outermost first — put the axis whose
+    collectives are heaviest innermost so it maps to the fastest ICI links)."""
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = tuple(axis_sizes.values())
+    n = int(np.prod(sizes))
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices for {axis_sizes}, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(sizes)
+    return Mesh(arr, axis_names=tuple(axis_sizes.keys()))
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Leading (batch) axis over dp; everything else replicated."""
     return NamedSharding(mesh, P("dp"))
