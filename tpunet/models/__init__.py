@@ -6,4 +6,8 @@ multi-stream transport vs 2744 baseline); VGG16 is therefore the flagship
 model here, built TPU-first in flax (bf16-friendly, MXU-sized matmuls).
 """
 
+from tpunet.models.transformer import (  # noqa: F401
+    Transformer,
+    transformer_partition_rules,
+)
 from tpunet.models.vgg import VGG, VGG16, vgg16  # noqa: F401

@@ -1,0 +1,258 @@
+"""Transformer (GPT-style decoder) — TPU-first flax implementation.
+
+The second model family of the framework (next to VGG): a causal LM built for
+the parallelism layer to exercise every axis the task requires first-class:
+
+  * dp  — batch sharding (gradient all-reduce inserted by XLA / DCN tier)
+  * mdl — Megatron tensor parallelism: qkv + mlp-up column-parallel,
+    out-proj + mlp-down row-parallel (`transformer_partition_rules`); XLA
+    derives the all-reduces from the shardings alone.
+  * sp  — sequence/context parallelism: `attn_impl="ring"` routes attention
+    through `tpunet.parallel.ring_attention` (shard_map + ppermute ring,
+    online softmax) so context length scales with devices.
+  * ep  — expert parallelism: optional Switch-style MoE MLP whose expert
+    weights carry a leading expert dim to shard over `ep`; the one-hot
+    einsum dispatch lets XLA emit the all-to-alls.
+
+Design: pre-norm blocks, RMSNorm, rotary position embeddings (global
+positions — computed before the sequence dim is sharded, so ring attention
+needs no position bookkeeping), no biases (TP-friendly), f32 params with
+configurable compute dtype (bf16 keeps the MXU fed).
+
+The reference repo has no model layer at all (SURVEY §2.3: TP/PP/SP/EP
+"absent"); this module is capability the TPU build adds above the transport.
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpunet.ops import attention_reference, flash_attention
+from tpunet.parallel.ring_attention import ring_self_attention
+
+
+def rotary_embed(x, base: float = 10000.0):
+    """Rotary position embedding over global positions. x: (b, s, h, d)."""
+    _, s, _, d = x.shape
+    half = d // 2
+    freqs = jnp.exp(-math.log(base) * jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = jnp.arange(s, dtype=jnp.float32)[:, None] * freqs[None, :]  # (s, half)
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    rot = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rot.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (norm * scale).astype(x.dtype)
+
+
+class SelfAttention(nn.Module):
+    """Causal multi-head self-attention with pluggable impl.
+
+    attn_impl: "reference" (einsum softmax), "flash" (Pallas kernel), or
+    "ring" (sequence-parallel ring attention over `sp_axis` of `mesh`).
+    """
+
+    n_heads: int
+    head_dim: int
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    attn_impl: str = "reference"
+    mesh: Mesh | None = None
+    dp_axis: str | None = "dp"
+    sp_axis: str = "sp"
+    tp_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, _ = x.shape
+        h, dh = self.n_heads, self.head_dim
+        dt = self.compute_dtype
+        proj = lambda name: nn.Dense(h * dh, use_bias=False, dtype=dt, name=name)
+        q = proj("q")(x).reshape(b, s, h, dh)
+        k = proj("k")(x).reshape(b, s, h, dh)
+        v = proj("v")(x).reshape(b, s, h, dh)
+        q, k = rotary_embed(q), rotary_embed(k)
+
+        if self.attn_impl == "ring":
+            if self.mesh is None:
+                raise ValueError("attn_impl='ring' requires a mesh")
+            o = ring_self_attention(
+                q, k, v, self.mesh, causal=True,
+                dp_axis=self.dp_axis, sp_axis=self.sp_axis, tp_axis=self.tp_axis,
+            )
+        elif self.attn_impl == "flash":
+            o = flash_attention(q, k, v, True)
+        else:
+            o = attention_reference(q, k, v, True)
+
+        o = o.reshape(b, s, h * dh)
+        return nn.Dense(x.shape[-1], use_bias=False, dtype=dt, name="out")(o)
+
+
+class Mlp(nn.Module):
+    d_ff: int
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        dt = self.compute_dtype
+        h = nn.Dense(self.d_ff, use_bias=False, dtype=dt, name="up")(x)
+        h = nn.gelu(h)
+        return nn.Dense(x.shape[-1], use_bias=False, dtype=dt, name="down")(h)
+
+
+class MoeMlp(nn.Module):
+    """Switch-style top-1 MoE with capacity-bounded one-hot einsum dispatch.
+
+    Expert weights carry a leading expert dim — shard it over the `ep` mesh
+    axis (`transformer_partition_rules`) and XLA turns the dispatch/combine
+    einsums into all-to-alls. Tokens over capacity are dropped (residual
+    passes them through unchanged), the standard Switch behavior. The router
+    load-balancing loss is sown under `intermediates/moe_aux_loss`.
+    """
+
+    n_experts: int
+    d_ff: int
+    capacity_factor: float = 1.25
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        e, f, dt = self.n_experts, self.d_ff, self.compute_dtype
+        t = b * s
+        cap = max(1, int(math.ceil(t / e * self.capacity_factor)))
+
+        wg = self.param("router", nn.initializers.lecun_normal(), (d, e))
+        wi = self.param("wi", nn.initializers.lecun_normal(), (e, d, f))
+        wo = self.param("wo", nn.initializers.lecun_normal(), (e, f, d))
+
+        xt = x.reshape(t, d)
+        logits = jnp.einsum("td,de->te", xt.astype(jnp.float32), wg.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate = jnp.max(probs, axis=-1)            # (t,)
+        expert = jnp.argmax(probs, axis=-1)       # (t,)
+        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # (t, e)
+
+        # Switch load-balancing aux loss: e * sum_e(frac_tokens * frac_prob).
+        frac_tokens = jnp.mean(onehot, axis=0)
+        frac_probs = jnp.mean(probs, axis=0)
+        self.sow("intermediates", "moe_aux_loss", e * jnp.sum(frac_tokens * frac_probs))
+
+        # Position of each token within its expert's capacity buffer.
+        pos = jnp.cumsum(onehot, axis=0) * onehot          # 1-based
+        keep = (pos > 0) & (pos <= cap)
+        slot = jnp.clip(pos - 1, 0, cap - 1).astype(jnp.int32)
+        slot_oh = jax.nn.one_hot(jnp.sum(slot * onehot.astype(jnp.int32), axis=-1), cap,
+                                 dtype=jnp.float32)
+        dispatch = (onehot * keep)[:, :, None] * slot_oh[:, None, :]  # (t, e, c)
+
+        xe = jnp.einsum("tec,td->ecd", dispatch.astype(dt), xt.astype(dt))
+        hdn = nn.gelu(jnp.einsum("ecd,edf->ecf", xe, wi.astype(dt)))
+        ye = jnp.einsum("ecf,efd->ecd", hdn, wo.astype(dt))
+        yt = jnp.einsum("tec,ecd->td", dispatch.astype(dt), ye)
+        yt = yt * gate[:, None].astype(dt)
+        return yt.reshape(b, s, d)
+
+
+class Block(nn.Module):
+    n_heads: int
+    head_dim: int
+    d_ff: int
+    n_experts: int = 0
+    capacity_factor: float = 1.25
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    attn_impl: str = "reference"
+    mesh: Mesh | None = None
+    dp_axis: str | None = "dp"
+    sp_axis: str = "sp"
+    tp_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = x + SelfAttention(
+            self.n_heads, self.head_dim, self.compute_dtype, self.attn_impl,
+            self.mesh, self.dp_axis, self.sp_axis, self.tp_axis, name="attn",
+        )(RMSNorm(name="norm1")(x))
+        if self.n_experts > 0:
+            mlp = MoeMlp(self.n_experts, self.d_ff, self.capacity_factor,
+                         self.compute_dtype, name="moe")
+        else:
+            mlp = Mlp(self.d_ff, self.compute_dtype, name="mlp")
+        return x + mlp(RMSNorm(name="norm2")(x))
+
+
+class Transformer(nn.Module):
+    """Causal decoder-only LM. Tokens (b, s) int32 -> logits (b, s, vocab) f32."""
+
+    vocab: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    d_ff: int = 2048
+    n_experts: int = 0            # 0 = dense MLP in every block
+    moe_every: int = 2            # every k-th block is MoE (when n_experts>0)
+    capacity_factor: float = 1.25
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    attn_impl: str = "reference"
+    mesh: Mesh | None = None
+    dp_axis: str | None = "dp"
+    sp_axis: str = "sp"
+    tp_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        del train  # no dropout in this family; kept for trainer signature
+        emb = self.param(
+            "embed", nn.initializers.normal(0.02), (self.vocab, self.d_model)
+        )
+        x = emb[tokens].astype(self.compute_dtype)
+        head_dim = self.d_model // self.n_heads
+        for i in range(self.n_layers):
+            moe = self.n_experts > 0 and (i + 1) % self.moe_every == 0
+            x = Block(
+                self.n_heads, head_dim, self.d_ff,
+                n_experts=self.n_experts if moe else 0,
+                capacity_factor=self.capacity_factor,
+                compute_dtype=self.compute_dtype, attn_impl=self.attn_impl,
+                mesh=self.mesh, dp_axis=self.dp_axis, sp_axis=self.sp_axis,
+                tp_axis=self.tp_axis, name=f"block{i}",
+            )(x)
+        x = RMSNorm(name="norm_f")(x)
+        logits = nn.Dense(self.vocab, use_bias=False,
+                          dtype=self.compute_dtype, name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def transformer_partition_rules(
+    tp_axis: str | None = "mdl", ep_axis: str | None = None
+) -> list[tuple[str, P]]:
+    """Path-regex → PartitionSpec rules (first match wins; no match =
+    replicated). Megatron TP over `tp_axis` (None = no TP); MoE experts over
+    `ep_axis` (None = experts replicated)."""
+    ep = ep_axis
+    return [
+        (r".*attn/(q|k|v)/kernel", P(None, tp_axis)),
+        (r".*attn/out/kernel", P(tp_axis, None)),
+        (r".*mlp/up/kernel", P(None, tp_axis)),
+        (r".*mlp/down/kernel", P(tp_axis, None)),
+        (r".*moe/router", P()),
+        (r".*moe/wi", P(ep, None, tp_axis)),
+        (r".*moe/wo", P(ep, tp_axis, None)),
+        (r".*embed", P(tp_axis, None)),
+        (r".*lm_head/kernel", P(None, tp_axis)),
+    ]
