@@ -16,6 +16,10 @@ from tpunet.parallel.mesh import (  # noqa: F401
     shard_params,
     vgg_partition_rules,
 )
+from tpunet.parallel.pipeline import (  # noqa: F401
+    gpipe,
+    stack_stage_params,
+)
 from tpunet.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ring_self_attention,

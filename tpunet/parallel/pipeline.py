@@ -1,0 +1,111 @@
+"""Pipeline parallelism — GPipe microbatch schedule over a `pp` mesh axis.
+
+SPMD formulation (the TPU-idiomatic one — no per-stage programs, one jitted
+program on every device): stage s holds the parameters of its layer slice
+(stacked leading dim sharded over `pp`); a scan runs M + W - 1 ticks, every
+device applies its stage to one microbatch per tick, and activations hop to
+the next stage via `lax.ppermute`. Bubbles at fill/drain compute on dummy
+data and are masked out of the result. Autodiff flows through scan+ppermute,
+so the same schedule serves forward and backward (the backward pipeline runs
+in reverse automatically).
+
+Constraint: every stage must map (microbatch, ...) -> same shape/dtype (true
+for stacks of identical transformer blocks). Peak activation memory per
+device is O(one microbatch), the point of pipelining.
+
+The reference repo has no pipeline parallelism (SURVEY §2.3 "absent" — it
+is transport only); this module is part of the parallelism capability the
+TPU build adds above the transport layer.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpunet.parallel.smap import full_varying, shard_map
+
+
+def stack_stage_params(param_trees):
+    """Stack per-stage param pytrees along a new leading dim (the `pp` axis).
+    Use with per-stage inits: `stack_stage_params([init(s) for s in range(W)])`."""
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *param_trees)
+
+
+def gpipe_stage_loop(stage_fn, stage_params, xs, axis_name: str):
+    """Per-device GPipe schedule; call inside shard_map.
+
+    stage_fn: (params, x) -> y with y.shape == x.shape.
+    stage_params: this stage's params, leaves with leading dim 1 (the local
+      shard of the stacked stage dim) — squeezed here.
+    xs: (M, mb, ...) microbatched input, replicated across the pp axis.
+    Returns (M, mb, ...) outputs, replicated (psum-broadcast from the last
+    stage).
+    """
+    w = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    params = jax.tree.map(lambda a: a[0], stage_params)
+    m = xs.shape[0]
+
+    # The carries become pp-varying through the stage params / axis_index;
+    # the replicated xs input can't seed that type, so cast explicitly.
+    out0 = full_varying(xs.shape, 0.0, xs.dtype, (axis_name,))
+    recv0 = full_varying(xs.shape[1:], 0.0, xs.dtype, (axis_name,))
+    perm = [(i, (i + 1) % w) for i in range(w)]
+
+    def tick(carry, t):
+        recv, outs = carry
+        # Stage 0 injects microbatch t during the fill phase; other stages
+        # (and drain ticks) consume what arrived on the ring.
+        inj = xs[jnp.clip(t, 0, m - 1)]
+        x_in = jnp.where(idx == 0, jnp.where(t < m, inj, recv), recv)
+        y = stage_fn(params, x_in)
+        recv_next = jax.lax.ppermute(y, axis_name, perm)
+        # The last stage emits microbatch t-(w-1) at tick t.
+        oi = t - (w - 1)
+        write = (oi >= 0) & (idx == w - 1)
+        upd = jax.lax.dynamic_update_slice_in_dim(
+            outs, y[None], jnp.clip(oi, 0, m - 1), axis=0
+        )
+        outs = jnp.where(write, upd, outs)
+        return (recv_next, outs), None
+
+    (_, outs), _ = jax.lax.scan(tick, (recv0, out0), jnp.arange(m + w - 1))
+    # Replicate the last stage's outputs to every device.
+    return jax.lax.psum(jnp.where(idx == w - 1, outs, jnp.zeros_like(outs)), axis_name)
+
+
+def gpipe(
+    stage_fn,
+    stacked_params,
+    x,
+    mesh: Mesh,
+    num_microbatches: int,
+    pp_axis: str = "pp",
+):
+    """Full-array entry point. stacked_params: pytree with leading stage dim
+    W == mesh.shape[pp_axis] (see `stack_stage_params`); x: (batch, ...)
+    replicated; returns (batch, ...) replicated."""
+    w = mesh.shape[pp_axis]
+    batch = x.shape[0]
+    if batch % num_microbatches:
+        raise ValueError(f"batch {batch} not divisible by {num_microbatches} microbatches")
+    for leaf in jax.tree.leaves(stacked_params):
+        if leaf.shape[0] != w:
+            raise ValueError(
+                f"stacked param leading dim {leaf.shape[0]} != pp axis size {w}"
+            )
+    xs = x.reshape((num_microbatches, batch // num_microbatches) + x.shape[1:])
+
+    param_specs = jax.tree.map(lambda _: P(pp_axis), stacked_params)
+    fn = shard_map(
+        partial(gpipe_stage_loop, stage_fn, axis_name=pp_axis),
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+    )
+    ys = fn(stacked_params, xs)
+    return ys.reshape((batch,) + ys.shape[2:])

@@ -29,10 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.4.35 re-exports shard_map at top level
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from tpunet.parallel.smap import full_varying, shard_map, vma_of
 
 NEG_INF = -1e30
 
@@ -83,20 +80,10 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     # The accumulators must carry q's varying-manual-axes type (jax >= 0.9
     # tracks vma through shard_map; a plain zeros literal is "unvarying" and
     # the scan carry types wouldn't match after the block update).
-    try:
-        vma = tuple(jax.typeof(q).vma)
-    except AttributeError:  # older jax: no vma tracking
-        vma = ()
-
-    _pcast = getattr(jax.lax, "pcast", None)
+    vma = vma_of(q)
 
     def _init(shape, fill):
-        x = jnp.full(shape, fill, jnp.float32)
-        if not vma:
-            return x
-        if _pcast is not None:
-            return _pcast(x, vma, to="varying")
-        return jax.lax.pvary(x, vma)
+        return full_varying(shape, fill, jnp.float32, vma)
 
     acc0 = _init(q.shape[:3] + (v.shape[-1],), 0.0)
     m0 = _init(q.shape[:3] + (1,), NEG_INF)

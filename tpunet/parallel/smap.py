@@ -1,0 +1,35 @@
+"""Small shared helpers for shard_map-based collectives code.
+
+jax >= 0.9 tracks varying-manual-axes (vma) in avals inside shard_map:
+fresh literals (zeros/full) are "unvarying" and cannot meet device-varying
+values in a scan carry without an explicit cast. `full_varying_like` builds
+a filled array that carries the vma of a reference value, portably across
+jax versions (pcast / pvary / no-op).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+try:  # jax >= 0.4.35 re-exports shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+
+def vma_of(x) -> tuple:
+    try:
+        return tuple(jax.typeof(x).vma)
+    except AttributeError:  # older jax: no vma tracking
+        return ()
+
+
+def full_varying(shape, fill, dtype, vma: tuple):
+    x = jnp.full(shape, fill, dtype)
+    if not vma:
+        return x
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, vma, to="varying")
+    return jax.lax.pvary(x, vma)
