@@ -1,0 +1,28 @@
+"""Build hook: compile libtpunet.so (make -C cpp) and bundle it as package
+data so wheels are self-contained (reference analogue: release workflow built
+the .so and shipped a tarball; we additionally ship a wheel)."""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+ROOT = Path(__file__).resolve().parent
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        cpp = ROOT / "cpp"
+        if cpp.is_dir():
+            subprocess.run(
+                ["make", "-C", str(cpp), "-j", "build/libtpunet.so"], check=True
+            )
+            dest = ROOT / "tpunet" / "lib"
+            dest.mkdir(exist_ok=True)
+            shutil.copy2(cpp / "build" / "libtpunet.so", dest / "libtpunet.so")
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildWithNative})

@@ -92,7 +92,13 @@ def load() -> ctypes.CDLL:
     if _lib is not None:
         return _lib
     path = os.environ.get("TPUNET_LIBRARY_PATH", "")
-    lib_file = Path(path) if path else build_native()
+    bundled = Path(__file__).resolve().parent / "lib" / "libtpunet.so"
+    if path:
+        lib_file = Path(path)
+    elif bundled.exists():  # installed wheel: .so shipped as package data
+        lib_file = bundled
+    else:  # source checkout: build on demand
+        lib_file = build_native()
     lib = ctypes.CDLL(str(lib_file))
 
     u = ctypes.c_uintptr if hasattr(ctypes, "c_uintptr") else ctypes.c_size_t
