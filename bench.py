@@ -42,12 +42,12 @@ def _worker(rank: int, world: int, port: int, q, nstreams: int) -> None:
             coordinator=f"127.0.0.1:{port}", rank=rank, world_size=world
         )
         n = NBYTES // 4
-        arr = np.full(n, float(rank + 1), dtype=np.float32)
         times = []
         for it in range(WARMUP + ITERS):
+            arr = np.full(n, float(rank + 1), dtype=np.float32)
             comm.barrier()
             t0 = time.perf_counter()
-            out = comm.all_reduce(arr)
+            out = comm.all_reduce(arr, inplace=True)
             dt = time.perf_counter() - t0
             if it >= WARMUP:
                 times.append(dt)

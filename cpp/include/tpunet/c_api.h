@@ -73,6 +73,9 @@ int32_t tpunet_c_irecv(uintptr_t instance, uintptr_t recv_comm, void* data,
  * than the posted recv buffer). On done the request id is consumed. */
 int32_t tpunet_c_test(uintptr_t instance, uintptr_t request, uint8_t* done,
                       uint64_t* nbytes);
+/* Blocking companion to test(): parks until the request settles (condvar,
+ * no CPU burn) and consumes it. nbytes as in tpunet_c_test. */
+int32_t tpunet_c_wait(uintptr_t instance, uintptr_t request, uint64_t* nbytes);
 
 int32_t tpunet_c_close_send(uintptr_t instance, uintptr_t send_comm);
 int32_t tpunet_c_close_recv(uintptr_t instance, uintptr_t recv_comm);

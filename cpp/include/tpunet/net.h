@@ -17,6 +17,7 @@
 #include <cstdint>
 #include <memory>
 #include <string>
+#include <thread>
 
 namespace tpunet {
 
@@ -92,6 +93,17 @@ class Net {
   virtual Status irecv(uint64_t recv_comm, void* data, size_t nbytes, uint64_t* request) = 0;
   // Poll a request. On done=true the request id is consumed (freed).
   virtual Status test(uint64_t request, bool* done, size_t* nbytes) = 0;
+  // Block until the request settles, then consume it like a done test().
+  // Engines override with a condvar park (a test() poll loop starves the
+  // worker threads of CPU on small hosts); the base fallback polls.
+  virtual Status wait(uint64_t request, size_t* nbytes) {
+    bool done = false;
+    while (true) {
+      Status st = test(request, &done, nbytes);
+      if (!st.ok() || done) return st;
+      std::this_thread::yield();
+    }
+  }
 
   virtual Status close_send(uint64_t send_comm) = 0;
   virtual Status close_recv(uint64_t recv_comm) = 0;

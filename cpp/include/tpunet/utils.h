@@ -86,6 +86,10 @@ uint64_t ForkGeneration();
 // Socket helpers.
 Status SetNodelay(int fd);
 Status SetNonblocking(int fd);
+// Grow SO_SNDBUF/SO_RCVBUF to TPUNET_SOCKET_BUFSIZE bytes (0 = leave kernel
+// autotuning alone, the default). Best-effort: the kernel clamps to
+// net.core.{w,r}mem_max and never errors the connection over it.
+void ApplySocketBufsize(int fd);
 std::string SockaddrToString(const sockaddr_storage& ss, socklen_t len);
 
 }  // namespace tpunet

@@ -199,6 +199,7 @@ void SendWorkerLoop(StreamWorker* w, bool spin) {
     }
     t.state->nbytes.fetch_add(t.len, std::memory_order_relaxed);
     t.state->completed.fetch_add(1, std::memory_order_acq_rel);
+    t.state->NotifyIfSettled();
   }
 }
 
@@ -214,6 +215,7 @@ void RecvWorkerLoop(StreamWorker* w, bool spin) {
     }
     t.state->nbytes.fetch_add(t.len, std::memory_order_relaxed);
     t.state->completed.fetch_add(1, std::memory_order_acq_rel);
+    t.state->NotifyIfSettled();
   }
 }
 
@@ -225,6 +227,7 @@ void DispatchChunks(Comm* c, uint8_t* data, size_t len, const RequestPtr& state,
   size_t csize = ChunkSize(len, c->min_chunksize, c->nstreams);
   size_t nchunks = ChunkCount(len, csize);
   state->total.store(nchunks, std::memory_order_release);  // 0-byte msg: done now
+  state->NotifyIfSettled();
   size_t off = 0;
   for (size_t i = 0; i < nchunks; ++i) {
     size_t n = std::min(csize, len - off);
@@ -238,6 +241,7 @@ void DispatchChunks(Comm* c, uint8_t* data, size_t len, const RequestPtr& state,
 void FailAndDrain(Comm* c, const RequestPtr& state, const std::string& msg) {
   state->SetError(msg);
   state->total.store(0, std::memory_order_release);
+  state->NotifyIfSettled();
   c->AbortStreams();
   // Reference breaks its loop on ctrl error leaving queued requests to hang
   // (nthread:396-401); we fail them promptly instead.
@@ -245,6 +249,7 @@ void FailAndDrain(Comm* c, const RequestPtr& state, const std::string& msg) {
   while (c->msgs.Pop(&m)) {
     m.state->SetError("comm broken by earlier ctrl-stream error: " + msg);
     m.state->total.store(0, std::memory_order_release);
+    m.state->NotifyIfSettled();
   }
 }
 
@@ -403,6 +408,10 @@ class BasicEngine : public EngineBase {
       requests_.Erase(request);  // reference leaked these (bagua_net.cc:111-121)
     }
     return Status::Ok();
+  }
+
+  Status wait(uint64_t request, size_t* nbytes) override {
+    return WaitIn(requests_, request, nbytes);
   }
 
   Status close_send(uint64_t send_comm) override {

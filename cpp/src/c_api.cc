@@ -197,6 +197,16 @@ int32_t tpunet_c_test(uintptr_t instance, uintptr_t request, uint8_t* done,
   return TPUNET_OK;
 }
 
+int32_t tpunet_c_wait(uintptr_t instance, uintptr_t request, uint64_t* nbytes) {
+  auto inst = GetInstance(instance);
+  if (!inst) return Fail(TPUNET_ERR_INVALID, "unknown instance");
+  size_t n = 0;
+  Status s = inst->net->wait(request, &n);
+  if (!s.ok()) return FromStatus(s);
+  if (nbytes) *nbytes = n;
+  return TPUNET_OK;
+}
+
 int32_t tpunet_c_close_send(uintptr_t instance, uintptr_t send_comm) {
   auto inst = GetInstance(instance);
   if (!inst) return Fail(TPUNET_ERR_INVALID, "unknown instance");

@@ -47,6 +47,15 @@ namespace {
 
 constexpr size_t kBcastChunk = 1 << 20;  // broadcast pipeline granularity
 
+// Reduce-phase pipeline granularity: each ring step streams its slice in
+// chunks this size so the reduction of chunk i overlaps the wire transfer of
+// chunk i+1 (the NCCL pipelining insight — without it a step is strictly
+// transfer-then-reduce and the reduce time adds to the critical path).
+size_t RingChunkBytes() {
+  static const size_t v = GetEnvU64("TPUNET_RING_CHUNKSIZE", 8 << 20);
+  return v ? v : (8 << 20);
+}
+
 // --------------------------------------------------------------------------
 // Reduction kernels. bf16 is stored as uint16_t and reduced in float with
 // round-to-nearest-even back-conversion (TPU-native dtype; XLA does the same
@@ -200,9 +209,6 @@ class RingCommunicator : public Communicator {
     uint8_t* data = static_cast<uint8_t*>(recvbuf);
     const int W = world_;
     auto off = [&](int i) { return (count * static_cast<size_t>(i)) / W; };
-    size_t max_slice = 0;
-    for (int i = 0; i < W; ++i) max_slice = std::max(max_slice, off(i + 1) - off(i));
-    scratch_.resize(max_slice * esize);
 
     // vr relabels the ring so this rank finishes the RS phase owning slice
     // `rank`, which the AG phase then circulates.
@@ -212,9 +218,9 @@ class RingCommunicator : public Communicator {
       int ridx = (vr - s - 1 + W) % W;
       size_t sbytes = (off(sidx + 1) - off(sidx)) * esize;
       size_t rbytes = (off(ridx + 1) - off(ridx)) * esize;
-      Status st = Exchange(data + off(sidx) * esize, sbytes, scratch_.data(), rbytes, nullptr);
+      Status st = ExchangeReduce(data + off(sidx) * esize, sbytes,
+                                 data + off(ridx) * esize, rbytes, dtype, op);
       if (!st.ok()) return st;
-      Reduce(data + off(ridx) * esize, scratch_.data(), off(ridx + 1) - off(ridx), dtype, op);
     }
     for (int s = 0; s < W - 1; ++s) {
       int sidx = (rank_ - s + W) % W;
@@ -242,15 +248,14 @@ class RingCommunicator : public Communicator {
     size_t block = recv_count * esize;
     work_.resize(static_cast<size_t>(W) * block);
     memcpy(work_.data(), sendbuf, work_.size());
-    scratch_.resize(block);
 
     const int vr = (rank_ + W - 1) % W;
     for (int s = 0; s < W - 1; ++s) {
       int sidx = (vr - s + W) % W;
       int ridx = (vr - s - 1 + W) % W;
-      Status st = Exchange(work_.data() + sidx * block, block, scratch_.data(), block, nullptr);
+      Status st = ExchangeReduce(work_.data() + sidx * block, block,
+                                 work_.data() + ridx * block, block, dtype, op);
       if (!st.ok()) return st;
-      Reduce(work_.data() + ridx * block, scratch_.data(), recv_count, dtype, op);
     }
     memcpy(recvbuf, work_.data() + rank_ * block, block);
     return Status::Ok();
@@ -332,6 +337,90 @@ class RingCommunicator : public Communicator {
   int world_size() const override { return world_; }
 
  private:
+  // One pipelined reduce ring step: send `sendbuf` to next while receiving
+  // the same-size slice from prev in chunks, folding each received chunk
+  // into `accum` (element count = slice bytes / esize) as soon as it lands —
+  // chunk i's Reduce overlaps chunk i+1's transfer. Double-buffered scratch;
+  // all in-flight requests are quiesced before returning, even on error.
+  Status ExchangeReduce(const uint8_t* sendbuf, size_t send_nbytes, uint8_t* accum,
+                        size_t recv_nbytes, DType dtype, RedOp op) {
+    size_t esize = DTypeSize(dtype);
+    size_t chunk = RingChunkBytes() / esize * esize;
+    if (chunk == 0 || (send_nbytes <= chunk && recv_nbytes <= chunk)) {
+      scratch_.resize(std::max(scratch_.size(), recv_nbytes));
+      Status st = Exchange(sendbuf, send_nbytes, scratch_.data(), recv_nbytes, nullptr);
+      if (!st.ok()) return st;
+      Reduce(accum, scratch_.data(), recv_nbytes / esize, dtype, op);
+      return Status::Ok();
+    }
+    // Send and recv slice sizes can differ (ring slices are count*i/W
+    // splits); each side chunks ITS byte count with the shared chunk size,
+    // which matches what the peer computes for the same bytes. A chunk-size
+    // mismatch between ranks surfaces as a size-mismatch error below.
+    size_t ns = (send_nbytes + chunk - 1) / chunk;
+    size_t nr = (recv_nbytes + chunk - 1) / chunk;
+    size_t n = std::max(ns, nr);
+    scratch_.resize(2 * chunk);
+    auto slen = [&](size_t i) { return std::min(chunk, send_nbytes - i * chunk); };
+    auto rlen = [&](size_t i) { return std::min(chunk, recv_nbytes - i * chunk); };
+
+    uint64_t rreq[2] = {0, 0}, sreq[2] = {0, 0};
+    bool rlive[2] = {false, false}, slive[2] = {false, false};
+    auto post = [&](size_t i) -> Status {
+      int slot = i & 1;
+      if (i < nr) {
+        Status st = net_->irecv(recv_comm_, scratch_.data() + slot * chunk, rlen(i), &rreq[slot]);
+        if (!st.ok()) return st;
+        rlive[slot] = true;
+      }
+      if (i < ns) {
+        Status st = net_->isend(send_comm_, sendbuf + i * chunk, slen(i), &sreq[slot]);
+        if (!st.ok()) return st;
+        slive[slot] = true;
+      }
+      return Status::Ok();
+    };
+    auto quiesce = [&](Status primary) {
+      for (int b = 0; b < 2; ++b) {
+        if (rlive[b]) WaitRequest(rreq[b], nullptr);
+        if (slive[b]) WaitRequest(sreq[b], nullptr);
+      }
+      return primary;
+    };
+
+    Status st = post(0);
+    if (!st.ok()) return quiesce(st);
+    for (size_t i = 0; i < n; ++i) {
+      int slot = i & 1;
+      bool has_r = i < nr;
+      if (has_r) {
+        size_t got = 0;
+        st = WaitRequest(rreq[slot], &got);
+        rlive[slot] = false;
+        if (!st.ok()) return quiesce(st);
+        if (got != rlen(i)) {
+          return quiesce(Status::Inner(
+              "ring step size mismatch: expected " + std::to_string(rlen(i)) +
+              "B chunk, got " + std::to_string(got) +
+              "B (ranks disagree on collective arguments or TPUNET_RING_CHUNKSIZE?)"));
+        }
+      }
+      if (i + 1 < n) {
+        st = post(i + 1);  // keep the wire busy while we reduce chunk i
+        if (!st.ok()) return quiesce(st);
+      }
+      if (has_r) {
+        Reduce(accum + i * chunk, scratch_.data() + slot * chunk, rlen(i) / esize, dtype, op);
+      }
+      if (i < ns) {
+        st = WaitRequest(sreq[slot], nullptr);
+        slive[slot] = false;
+        if (!st.ok()) return quiesce(st);
+      }
+    }
+    return Status::Ok();
+  }
+
   // One ring step: recv from prev into recvbuf while sending sendbuf to
   // next. Posts the irecv first; BOTH requests are waited before returning —
   // even on error — because an abandoned in-flight request would let the
@@ -376,22 +465,9 @@ class RingCommunicator : public Communicator {
   }
 
   Status WaitRequest(uint64_t req, size_t* nbytes) {
-    bool done = false;
-    uint64_t polls = 0;
-    while (!done) {
-      Status st = net_->test(req, &done, nbytes);
-      if (!st.ok()) return st;
-      if (done) break;
-      // Poll hard briefly (small-message latency), then back off — a
-      // multi-second collective must not pin a core on test().
-      ++polls;
-      if (polls > 4096) {
-        std::this_thread::sleep_for(std::chrono::microseconds(50));
-      } else if (polls > 256) {
-        std::this_thread::yield();
-      }
-    }
-    return Status::Ok();
+    // Blocking condvar wait — a test() poll loop here competes with the
+    // stream worker threads for CPU (catastrophic on few-core hosts).
+    return net_->wait(req, nbytes);
   }
 
   int rank_;

@@ -68,6 +68,23 @@ class EngineBase : public Net {
   }
 
  protected:
+  // Shared blocking-wait body for engines (their requests_ maps are their
+  // own, so they pass it in): park on the request condvar, then consume via
+  // the engine's test(). The loop re-parks for the failed-but-not-yet-
+  // quiesced window where test() reports not-done.
+  Status WaitIn(IdMap<RequestPtr>& requests, uint64_t request, size_t* nbytes) {
+    while (true) {
+      RequestPtr state;
+      if (!requests.Get(request, &state)) {
+        return Status::Invalid("unknown request " + std::to_string(request));
+      }
+      state->WaitSettled();
+      bool done = false;
+      Status st = test(request, &done, nbytes);
+      if (!st.ok() || done) return st;
+    }
+  }
+
   Status CheckDev(int32_t dev) const {
     if (dev < 0 || dev >= static_cast<int32_t>(nics_.size())) {
       return Status::Invalid("bad device index " + std::to_string(dev));

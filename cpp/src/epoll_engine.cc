@@ -171,6 +171,7 @@ class Loop {
     if (c.state) {
       c.state->SetError(why);
       c.state->total.store(0, std::memory_order_release);
+      c.state->NotifyIfSettled();
     }
     if (c.ack) c.ack->set_value();
   }
@@ -325,6 +326,7 @@ class Loop {
     if (c->failed) {
       state->SetError("comm broken by earlier error: " + c->fail_msg);
       state->total.store(0, std::memory_order_release);
+      state->NotifyIfSettled();
       return;
     }
     if (c->is_send) {
@@ -431,6 +433,7 @@ class Loop {
         size_t nchunks = ChunkCount(target, csize);
         pr.state->total.store(1 + nchunks, std::memory_order_release);
         pr.state->completed.fetch_add(1, std::memory_order_acq_rel);
+        pr.state->NotifyIfSettled();  // 0-byte message: settled right here
         DispatchChunks(c, pr.data, static_cast<size_t>(target), pr.state);
         continue;
       }
@@ -451,6 +454,7 @@ class Loop {
       seg.state->nbytes.fetch_add(seg.len, std::memory_order_relaxed);
     }
     seg.state->completed.fetch_add(1, std::memory_order_acq_rel);
+    seg.state->NotifyIfSettled();
   }
 
   // Fail every in-flight and future request on the comm. Buffers are safe to
@@ -464,6 +468,7 @@ class Loop {
       for (Segment& seg : fs.segs) {
         seg.state->SetError(msg);
         seg.state->completed.fetch_add(1, std::memory_order_acq_rel);
+        seg.state->NotifyIfSettled();
       }
       fs.segs.clear();
       // Fully deregister (not just interest=0): EPOLLHUP/ERR are reported
@@ -479,6 +484,7 @@ class Loop {
     for (PendingRecv& pr : c->pending) {
       pr.state->SetError(msg);
       pr.state->total.store(0, std::memory_order_release);
+      pr.state->NotifyIfSettled();
     }
     c->pending.clear();
   }
@@ -568,6 +574,10 @@ class EpollEngine : public EngineBase {
       requests_.Erase(request);
     }
     return Status::Ok();
+  }
+
+  Status wait(uint64_t request, size_t* nbytes) override {
+    return WaitIn(requests_, request, nbytes);
   }
 
   Status close_send(uint64_t send_comm) override {

@@ -431,6 +431,18 @@ class TelemetryNet : public Net {
     return s;
   }
 
+  Status wait(uint64_t req, size_t* nbytes) override {
+    Status s = inner_->wait(req, nbytes);
+    if (!s.ok()) {
+      if (s.kind != ErrorKind::kInvalidArgument) {
+        Telemetry::Get().OnRequestDone(Owner(), req, /*failed=*/true);
+      }
+    } else {
+      Telemetry::Get().OnRequestDone(Owner(), req, /*failed=*/false);
+    }
+    return s;
+  }
+
   Status close_send(uint64_t c) override { return inner_->close_send(c); }
   Status close_recv(uint64_t c) override { return inner_->close_recv(c); }
   Status close_listen(uint64_t c) override { return inner_->close_listen(c); }

@@ -303,6 +303,13 @@ Status SetNodelay(int fd) {
   return Status::Ok();
 }
 
+void ApplySocketBufsize(int fd) {
+  static const int kBufsize = static_cast<int>(GetEnvU64("TPUNET_SOCKET_BUFSIZE", 0));
+  if (kBufsize <= 0) return;
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &kBufsize, sizeof(kBufsize));
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &kBufsize, sizeof(kBufsize));
+}
+
 Status SetNonblocking(int fd) {
   int flags = fcntl(fd, F_GETFL, 0);
   if (flags < 0 || fcntl(fd, F_SETFL, flags | O_NONBLOCK) != 0) {

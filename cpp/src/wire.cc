@@ -176,6 +176,7 @@ Status AcceptBundle(ListenSock* lc, PartialBundle* out) {
       ::close(fd);
       return s;
     }
+    ApplySocketBufsize(fd);
     // Bound the preamble read: a client that connects but never completes
     // the 40-byte handshake (scanner, stalled peer) must not wedge accept()
     // while it holds lc->mu. Malformed/timed-out clients are dropped and
@@ -260,6 +261,7 @@ Status ConnectOne(const std::vector<NicInfo>& nics, int32_t dev, const SocketHan
     ::close(fd);
     return s;
   }
+  ApplySocketBufsize(fd);
   *out_fd = fd;
   return Status::Ok();
 }

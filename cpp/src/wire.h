@@ -15,6 +15,7 @@
 
 #include <atomic>
 #include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <map>
 #include <memory>
@@ -81,6 +82,26 @@ struct RequestState {
     uint64_t t = total.load(std::memory_order_acquire);
     return t != UINT64_MAX && completed.load(std::memory_order_acquire) >= t;
   }
+
+  // Blocking-wait support (the polling test() loop starves worker threads of
+  // CPU on small hosts — a single-core box loses ~5x allreduce throughput to
+  // it). Completion sites call NotifyIfSettled() after updating the atomics;
+  // waiters park on the condvar. The atomics are written BEFORE the notify
+  // takes err_mu, and the waiter's predicate runs under err_mu, so the wakeup
+  // cannot be lost; the wait_for timeout is belt-and-braces only.
+  void NotifyIfSettled() {
+    if (!Done() && !failed.load(std::memory_order_acquire)) return;
+    std::lock_guard<std::mutex> lk(err_mu);
+    cv.notify_all();
+  }
+  void WaitSettled() {
+    std::unique_lock<std::mutex> lk(err_mu);
+    while (!Done() && !failed.load(std::memory_order_acquire)) {
+      cv.wait_for(lk, std::chrono::milliseconds(100));
+    }
+  }
+
+  std::condition_variable cv;
 };
 using RequestPtr = std::shared_ptr<RequestState>;
 

@@ -125,6 +125,8 @@ def load() -> ctypes.CDLL:
     lib.tpunet_c_irecv.restype = i32
     lib.tpunet_c_test.argtypes = [u, u, P(u8), P(u64)]
     lib.tpunet_c_test.restype = i32
+    lib.tpunet_c_wait.argtypes = [u, u, P(u64)]
+    lib.tpunet_c_wait.restype = i32
     lib.tpunet_c_close_send.argtypes = [u, u]
     lib.tpunet_c_close_send.restype = i32
     lib.tpunet_c_close_recv.argtypes = [u, u]

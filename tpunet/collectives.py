@@ -80,9 +80,12 @@ class Communicator:
 
     # -- collectives -------------------------------------------------------
 
-    def all_reduce(self, arr: Any, op: str = "sum") -> np.ndarray:
+    def all_reduce(self, arr: Any, op: str = "sum", inplace: bool = False) -> np.ndarray:
+        """AllReduce. inplace=True reduces into `arr` itself (must be a
+        C-contiguous ndarray) — skips the send→recv staging copy, which
+        matters at 100MB+ gradient-bucket sizes."""
         arr = _c_contig(np.asarray(arr))
-        out = np.empty_like(arr)
+        out = arr if inplace else np.empty_like(arr)
         _native.check(
             self._lib.tpunet_comm_all_reduce(
                 self._id,

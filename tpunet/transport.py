@@ -64,17 +64,30 @@ class Request:
         return self._done, self._nbytes
 
     def wait(self, timeout: float | None = None) -> int:
-        deadline = None if timeout is None else time.monotonic() + timeout
+        if self._done:
+            return self._nbytes
+        if timeout is None:
+            # True blocking wait in native code: ctypes releases the GIL for
+            # the call and the condvar park costs no CPU — a Python poll loop
+            # here would compete with the stream worker threads for cores.
+            lib = self._net._lib
+            nbytes = ctypes.c_uint64(0)
+            _native.check(
+                lib.tpunet_c_wait(self._net._id, self._id, ctypes.byref(nbytes)),
+                "wait",
+            )
+            self._done = True
+            self._nbytes = nbytes.value
+            self._pin = None
+            return self._nbytes
+        deadline = time.monotonic() + timeout
         polls = 0
         while True:
             done, nbytes = self.test()
             if done:
                 return nbytes
-            if deadline is not None and time.monotonic() > deadline:
+            if time.monotonic() > deadline:
                 raise TimeoutError(f"request {self._id} not done within {timeout}s")
-            # Adaptive backoff: poll hard briefly for low latency on small
-            # messages, then yield — a Python poll loop must not pin a core
-            # for a whole multi-MB transfer on a shared trainer host.
             polls += 1
             if polls > 200:
                 time.sleep(min(1e-3, 1e-5 * (polls - 200)))
