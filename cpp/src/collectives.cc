@@ -17,6 +17,9 @@
 
 #include <algorithm>
 #include <chrono>
+#include <condition_variable>
+#include <functional>
+#include <mutex>
 #include <thread>
 #include <vector>
 
@@ -117,7 +120,7 @@ void ReduceBf16(uint16_t* dst, const uint16_t* src, size_t n, RedOp op) {
   }
 }
 
-void Reduce(void* dst, const void* src, size_t n, DType dtype, RedOp op) {
+void ReduceSerial(void* dst, const void* src, size_t n, DType dtype, RedOp op) {
   switch (dtype) {
     case DType::kF32:
       ReduceTyped(static_cast<float*>(dst), static_cast<const float*>(src), n, op);
@@ -138,6 +141,110 @@ void Reduce(void* dst, const void* src, size_t n, DType dtype, RedOp op) {
       ReduceTyped(static_cast<uint8_t*>(dst), static_cast<const uint8_t*>(src), n, op);
       break;
   }
+}
+
+// Fork-join pool for the reduction kernels. At 100Gb-class DCN speeds a
+// single core's reduce bandwidth (~5-10 GB/s streaming) becomes the pipeline
+// bottleneck of ExchangeReduce, so large chunks fan out across a few cores.
+// Persistent parked threads (no spawn per chunk); sized well below the host
+// core count — the transport's stream workers need cores too.
+class ReducePool {
+ public:
+  static ReducePool& Get() {
+    static ReducePool* pool = new ReducePool();  // leaked: lives for process
+    return *pool;
+  }
+
+  // Run fn(i) for i in [0, njobs) on the pool + calling thread; blocks.
+  void Run(const std::function<void(size_t)>& fn, size_t njobs) {
+    if (nworkers_ == 0 || njobs <= 1) {
+      for (size_t i = 0; i < njobs; ++i) fn(i);
+      return;
+    }
+    std::unique_lock<std::mutex> lk(mu_);
+    job_ = &fn;
+    njobs_ = njobs;
+    next_ = 0;
+    pending_ = njobs;
+    ++gen_;
+    work_cv_.notify_all();
+    // The caller pulls jobs too — no idle waiting while work remains.
+    while (true) {
+      size_t i = next_;
+      if (i >= njobs_) break;
+      next_ = i + 1;
+      lk.unlock();
+      fn(i);
+      lk.lock();
+      --pending_;
+    }
+    done_cv_.wait(lk, [&] { return pending_ == 0; });
+    job_ = nullptr;
+  }
+
+  size_t nworkers() const { return nworkers_; }
+
+ private:
+  ReducePool() {
+    unsigned hw = std::thread::hardware_concurrency();
+    size_t n = hw > 2 ? std::min<size_t>(3, hw / 2) : 0;
+    // TPUNET_REDUCE_THREADS overrides (total shards = workers + caller);
+    // also how CI exercises the parallel path on small runners.
+    uint64_t env = GetEnvU64("TPUNET_REDUCE_THREADS", 0);
+    if (env > 0) n = std::min<uint64_t>(env - 1, 15);
+    nworkers_ = n;
+    for (size_t t = 0; t < n; ++t) {
+      threads_.emplace_back([this] { WorkerLoop(); });
+      threads_.back().detach();  // pool is process-lifetime
+    }
+  }
+
+  void WorkerLoop() {
+    uint64_t seen = 0;
+    std::unique_lock<std::mutex> lk(mu_);
+    while (true) {
+      work_cv_.wait(lk, [&] { return gen_ != seen && job_ != nullptr; });
+      seen = gen_;
+      while (true) {
+        size_t i = next_;
+        if (i >= njobs_) break;
+        next_ = i + 1;
+        const auto* fn = job_;
+        lk.unlock();
+        (*fn)(i);
+        lk.lock();
+        if (--pending_ == 0) done_cv_.notify_all();
+      }
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable work_cv_, done_cv_;
+  const std::function<void(size_t)>* job_ = nullptr;
+  size_t njobs_ = 0, next_ = 0, pending_ = 0;
+  uint64_t gen_ = 0;
+  size_t nworkers_ = 0;
+  std::vector<std::thread> threads_;
+};
+
+// Parallel reduce: split [0, n) into per-core ranges when the chunk is big
+// enough to amortize the fork-join (>= 4 MiB) and cores are available.
+void Reduce(void* dst, const void* src, size_t n, DType dtype, RedOp op) {
+  size_t esize = DTypeSize(dtype);
+  ReducePool& pool = ReducePool::Get();
+  size_t nshards = pool.nworkers() + 1;
+  if (nshards <= 1 || n * esize < (4u << 20)) {
+    ReduceSerial(dst, src, n, dtype, op);
+    return;
+  }
+  auto* d8 = static_cast<uint8_t*>(dst);
+  const auto* s8 = static_cast<const uint8_t*>(src);
+  pool.Run(
+      [&](size_t i) {
+        size_t lo = n * i / nshards, hi = n * (i + 1) / nshards;
+        ReduceSerial(d8 + lo * esize, s8 + lo * esize, hi - lo, dtype, op);
+      },
+      nshards);
 }
 
 // --------------------------------------------------------------------------

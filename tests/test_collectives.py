@@ -20,8 +20,12 @@ def _rank_data(rank: int, n: int, dtype) -> np.ndarray:
     return rng.standard_normal(n).astype(dtype)
 
 
-def _worker(rank: int, world: int, port: int, q) -> None:
+def _worker(rank: int, world: int, port: int, q, env: dict | None = None) -> None:
     try:
+        import os
+
+        for k, v in (env or {}).items():
+            os.environ[k] = v
         import ml_dtypes
 
         from tpunet.collectives import Communicator
@@ -99,6 +103,34 @@ def _worker(rank: int, world: int, port: int, q) -> None:
 @pytest.mark.parametrize("world", [2, 4])
 def test_ring_collectives(world):
     run_spawn_workers(_worker, world)
+
+
+def _big_allreduce_worker(rank: int, world: int, port: int, q, env) -> None:
+    try:
+        import os
+
+        for k, v in env.items():
+            os.environ[k] = v
+        from tpunet.collectives import Communicator
+
+        comm = Communicator(f"127.0.0.1:{port}", rank, world)
+        n = (16 << 20) // 4  # 16 MiB: crosses the parallel-reduce threshold
+        mine = _rank_data(rank, n, np.float32)
+        got = comm.all_reduce(mine, "sum", inplace=True)
+        assert got is mine
+        expect = sum(_rank_data(r, n, np.float32) for r in range(world))
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+        comm.close()
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def test_parallel_reduce_and_inplace():
+    # Force the fork-join reduce pool on (4 shards) regardless of host cores,
+    # with a small ring chunk so many pipelined chunks hit the pool.
+    env = {"TPUNET_REDUCE_THREADS": "4", "TPUNET_RING_CHUNKSIZE": str(4 << 20)}
+    run_spawn_workers(_big_allreduce_worker, 2, extra_args=(env,))
 
 
 def test_world_size_one_shortcuts():
