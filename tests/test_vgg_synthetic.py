@@ -1,0 +1,16 @@
+"""Smoke test for the VGG synthetic img/s benchmark (reference headline)."""
+
+from benchmarks.vgg_synthetic import _parse, run_benchmark
+
+
+def test_single_process_tiny():
+    args = _parse(
+        [
+            "--width-mult", "0.0625", "--image-size", "32", "--classes", "16",
+            "--batch-size", "4", "--iters", "2", "--batches-per-iter", "1",
+            "--warmup", "1", "--no-bf16",
+        ]
+    )
+    rates = run_benchmark(args, emit=lambda *_: None)
+    assert len(rates) == 2
+    assert all(r > 0 for r in rates)

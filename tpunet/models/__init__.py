@@ -10,4 +10,4 @@ from tpunet.models.transformer import (  # noqa: F401
     Transformer,
     transformer_partition_rules,
 )
-from tpunet.models.vgg import VGG, VGG16, vgg16  # noqa: F401
+from tpunet.models.vgg import VGG, VGG16, VGG16_CFG, vgg16  # noqa: F401
