@@ -2,6 +2,11 @@
 (the reference's end-to-end validation was Bagua's VGG16
 synthetic_benchmark.py, reference README.md:52)."""
 
+from tpunet.train.checkpoint import (  # noqa: F401
+    CheckpointManager,
+    restore_pytree,
+    save_pytree,
+)
 from tpunet.train.trainer import (  # noqa: F401
     TrainState,
     create_train_state,
