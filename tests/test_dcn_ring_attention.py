@@ -1,0 +1,65 @@
+"""Cross-host (multi-process) ring attention parity vs full attention."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+# Module level so mp-spawn children (which re-import this module) also pin
+# JAX to CPU — the axon sitecustomize hook force-selects the TPU otherwise.
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from conftest import run_spawn_workers  # noqa: E402
+
+B, S, H, D = 2, 32, 2, 8  # full (unsharded) attention problem
+
+
+def _full_qkv():
+    import jax
+    import jax.numpy as jnp
+
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    return tuple(jax.random.normal(k, (B, S, H, D), jnp.float32) for k in ks)
+
+
+def _worker(rank: int, world: int, port: int, q, causal: bool) -> None:
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+
+        from tpunet import distributed
+        from tpunet.ops import attention_reference
+        from tpunet.parallel import dcn_ring_attention
+
+        distributed.initialize(f"127.0.0.1:{port}", rank, world)
+        qf, kf, vf = _full_qkv()  # same on every rank (same seed)
+        s_local = S // world
+        sl = slice(rank * s_local, (rank + 1) * s_local)
+
+        fn = jax.jit(lambda a, b, c: dcn_ring_attention(a, b, c, causal=causal))
+        got = fn(qf[:, sl], kf[:, sl], vf[:, sl])
+
+        want = attention_reference(qf, kf, vf, causal)[:, sl]
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+        )
+        distributed.finalize()
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_dcn_ring_attention_2proc(causal):
+    run_spawn_workers(_worker, 2, extra_args=(causal,))
+
+
+def test_dcn_ring_attention_4proc_causal():
+    run_spawn_workers(_worker, 4, extra_args=(True,))

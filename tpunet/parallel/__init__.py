@@ -16,6 +16,9 @@ from tpunet.parallel.mesh import (  # noqa: F401
     shard_params,
     vgg_partition_rules,
 )
+from tpunet.parallel.dcn_ring_attention import (  # noqa: F401
+    dcn_ring_attention,
+)
 from tpunet.parallel.pipeline import (  # noqa: F401
     gpipe,
     stack_stage_params,
