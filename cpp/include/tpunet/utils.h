@@ -90,6 +90,9 @@ Status SetNonblocking(int fd);
 // autotuning alone, the default). Best-effort: the kernel clamps to
 // net.core.{w,r}mem_max and never errors the connection over it.
 void ApplySocketBufsize(int fd);
+// TCP keepalive for dead-peer detection (TPUNET_KEEPALIVE_{IDLE_S,INTVL_S,
+// CNT}; idle 0 disables). Best-effort.
+void ApplyKeepalive(int fd);
 std::string SockaddrToString(const sockaddr_storage& ss, socklen_t len);
 
 }  // namespace tpunet

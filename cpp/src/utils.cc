@@ -310,6 +310,24 @@ void ApplySocketBufsize(int fd) {
   setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &kBufsize, sizeof(kBufsize));
 }
 
+void ApplyKeepalive(int fd) {
+  // Dead-peer detection: without keepalive, a host that vanishes (power
+  // loss, network partition) leaves blocked reads hanging forever — the
+  // reference has no liveness mechanism at all (SURVEY §5 "failure
+  // detection: essentially absent"). Defaults: first probe after 30s idle,
+  // then every 10s, declare dead after 3 misses (~60s to error).
+  // TPUNET_KEEPALIVE_IDLE_S=0 disables.
+  static const int kIdle = static_cast<int>(GetEnvU64("TPUNET_KEEPALIVE_IDLE_S", 30));
+  if (kIdle <= 0) return;
+  static const int kIntvl = static_cast<int>(GetEnvU64("TPUNET_KEEPALIVE_INTVL_S", 10));
+  static const int kCnt = static_cast<int>(GetEnvU64("TPUNET_KEEPALIVE_CNT", 3));
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPIDLE, &kIdle, sizeof(kIdle));
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPINTVL, &kIntvl, sizeof(kIntvl));
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPCNT, &kCnt, sizeof(kCnt));
+}
+
 Status SetNonblocking(int fd) {
   int flags = fcntl(fd, F_GETFL, 0);
   if (flags < 0 || fcntl(fd, F_SETFL, flags | O_NONBLOCK) != 0) {

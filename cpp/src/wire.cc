@@ -177,6 +177,7 @@ Status AcceptBundle(ListenSock* lc, PartialBundle* out) {
       return s;
     }
     ApplySocketBufsize(fd);
+    ApplyKeepalive(fd);
     // Bound the preamble read: a client that connects but never completes
     // the 40-byte handshake (scanner, stalled peer) must not wedge accept()
     // while it holds lc->mu. Malformed/timed-out clients are dropped and
@@ -262,6 +263,7 @@ Status ConnectOne(const std::vector<NicInfo>& nics, int32_t dev, const SocketHan
     return s;
   }
   ApplySocketBufsize(fd);
+  ApplyKeepalive(fd);
   *out_fd = fd;
   return Status::Ok();
 }

@@ -63,3 +63,42 @@ def test_dcn_ring_attention_2proc(causal):
 
 def test_dcn_ring_attention_4proc_causal():
     run_spawn_workers(_worker, 4, extra_args=(True,))
+
+
+def _model_worker(rank: int, world: int, port: int, q) -> None:
+    # Full Transformer with sequence sharded across processes: each rank's
+    # logits on its shard must equal the single-host reference model's
+    # logits sliced to that shard (global rotary + ring causality).
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+
+        from tpunet import distributed
+        from tpunet.models import Transformer
+
+        distributed.initialize(f"127.0.0.1:{port}", rank, world)
+        kw = dict(vocab=32, d_model=16, n_layers=2, n_heads=2, d_ff=32,
+                  compute_dtype=jnp.float32)
+        ref_model = Transformer(attn_impl="reference", **kw)
+        dcn_model = Transformer(attn_impl="dcn_ring", **kw)
+
+        toks = jax.random.randint(jax.random.PRNGKey(3), (2, S), 0, 32)
+        params = ref_model.init(jax.random.PRNGKey(4), toks)["params"]
+        want = ref_model.apply({"params": params}, toks)
+
+        s_local = S // world
+        sl = slice(rank * s_local, (rank + 1) * s_local)
+        got = dcn_model.apply({"params": params}, toks[:, sl])
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want[:, sl]), atol=1e-4, rtol=1e-4
+        )
+        distributed.finalize()
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def test_transformer_dcn_ring_2proc():
+    run_spawn_workers(_model_worker, 2)

@@ -12,9 +12,14 @@ see SURVEY.md). Layers, bottom to top:
 - ``tpunet.distributed`` — process-group initialization from env vars.
 - ``tpunet.interop``     — JAX integration: host-callback collectives so
   ``psum``-shaped ops on host-staged buffers ride this transport across
-  hosts, plus mesh/sharding helpers for the in-pod (ICI) path.
-- ``tpunet.models`` / ``tpunet.train`` — flagship DP benchmark stack (VGG16
-  synthetic, mirroring the reference's headline benchmark).
+  hosts, plus a hierarchical (ICI then DCN) psum.
+- ``tpunet.parallel``    — meshes, Megatron-TP partition rules, ring
+  attention (in-pod shard_map+ppermute AND cross-host over the transport),
+  GPipe pipeline parallelism.
+- ``tpunet.ops``         — Pallas TPU kernels (flash attention).
+- ``tpunet.models`` / ``tpunet.train`` — VGG16 (the reference's headline DP
+  benchmark) and a GPT-style Transformer (TP/SP/MoE-EP); jitted train step
+  with optional DCN gradient tier; orbax checkpoint/resume.
 """
 
 __version__ = "0.1.0"

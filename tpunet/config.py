@@ -51,6 +51,18 @@ class Config:
     # BAGUA_NET_PROMETHEUS_ADDRESS nthread:184-185). Empty = disabled.
     trace_dir: str = ""
     metrics_addr: str = ""
+    # SO_SNDBUF/SO_RCVBUF override in bytes; 0 = kernel autotuning.
+    socket_bufsize: int = 0
+    # Collectives pipeline granularity: ring steps stream their slice in
+    # chunks this size so reduction overlaps transfer.
+    ring_chunksize: int = 8 << 20
+    # Fork-join reduce shards (0 = auto: min(4, cores/2)).
+    reduce_threads: int = 0
+    # TCP keepalive dead-peer detection: first probe after idle_s (0 =
+    # disabled), then every intvl_s, dead after cnt misses.
+    keepalive_idle_s: int = 30
+    keepalive_intvl_s: int = 10
+    keepalive_cnt: int = 3
 
     @staticmethod
     def from_env() -> "Config":
@@ -71,4 +83,10 @@ class Config:
             world_size=_env_int("TPUNET_WORLD_SIZE", _env_int("WORLD_SIZE", 1)),
             trace_dir=env.get("TPUNET_TRACE_DIR", ""),
             metrics_addr=env.get("TPUNET_METRICS_ADDR", os.environ.get("TPUNET_PROMETHEUS_ADDRESS", "")),
+            socket_bufsize=_env_int("TPUNET_SOCKET_BUFSIZE", 0),
+            ring_chunksize=_env_int("TPUNET_RING_CHUNKSIZE", 8 << 20),
+            reduce_threads=_env_int("TPUNET_REDUCE_THREADS", 0),
+            keepalive_idle_s=_env_int("TPUNET_KEEPALIVE_IDLE_S", 30),
+            keepalive_intvl_s=_env_int("TPUNET_KEEPALIVE_INTVL_S", 10),
+            keepalive_cnt=_env_int("TPUNET_KEEPALIVE_CNT", 3),
         )

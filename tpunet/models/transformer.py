@@ -36,12 +36,15 @@ from tpunet.ops import attention_reference, flash_attention
 from tpunet.parallel.ring_attention import ring_self_attention
 
 
-def rotary_embed(x, base: float = 10000.0):
-    """Rotary position embedding over global positions. x: (b, s, h, d)."""
+def rotary_embed(x, base: float = 10000.0, pos_offset: int = 0):
+    """Rotary position embedding. x: (b, s, h, d). pos_offset shifts to
+    global positions when x is a sequence shard (cross-host ring attention —
+    each process holds positions [offset, offset + s))."""
     _, s, _, d = x.shape
     half = d // 2
     freqs = jnp.exp(-math.log(base) * jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = jnp.arange(s, dtype=jnp.float32)[:, None] * freqs[None, :]  # (s, half)
+    positions = pos_offset + jnp.arange(s, dtype=jnp.float32)
+    angles = positions[:, None] * freqs[None, :]  # (s, half)
     cos = jnp.cos(angles)[None, :, None, :]
     sin = jnp.sin(angles)[None, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
@@ -63,8 +66,10 @@ class RMSNorm(nn.Module):
 class SelfAttention(nn.Module):
     """Causal multi-head self-attention with pluggable impl.
 
-    attn_impl: "reference" (einsum softmax), "flash" (Pallas kernel), or
-    "ring" (sequence-parallel ring attention over `sp_axis` of `mesh`).
+    attn_impl: "reference" (einsum softmax), "flash" (Pallas kernel),
+    "ring" (sequence-parallel ring attention over `sp_axis` of `mesh`), or
+    "dcn_ring" (sequence sharded across PROCESSES, k/v rotating over the
+    tpunet DCN transport — requires tpunet.distributed.initialize()).
     """
 
     n_heads: int
@@ -85,7 +90,15 @@ class SelfAttention(nn.Module):
         q = proj("q")(x).reshape(b, s, h, dh)
         k = proj("k")(x).reshape(b, s, h, dh)
         v = proj("v")(x).reshape(b, s, h, dh)
-        q, k = rotary_embed(q), rotary_embed(k)
+        pos_offset = 0
+        if self.attn_impl == "dcn_ring":
+            # The per-process model sees only its sequence shard; rotary
+            # must use global positions for the ring to be coherent.
+            from tpunet import distributed
+
+            pos_offset = distributed.rank() * s
+        q = rotary_embed(q, pos_offset=pos_offset)
+        k = rotary_embed(k, pos_offset=pos_offset)
 
         if self.attn_impl == "ring":
             if self.mesh is None:
@@ -94,6 +107,10 @@ class SelfAttention(nn.Module):
                 q, k, v, self.mesh, causal=True,
                 dp_axis=self.dp_axis, sp_axis=self.sp_axis, tp_axis=self.tp_axis,
             )
+        elif self.attn_impl == "dcn_ring":
+            from tpunet.parallel.dcn_ring_attention import dcn_ring_attention
+
+            o = dcn_ring_attention(q, k, v, causal=True)
         elif self.attn_impl == "flash":
             o = flash_attention(q, k, v, True)
         else:
