@@ -1,0 +1,85 @@
+"""JAX-tier AllReduce sweep: `dcn_psum` inside jit over the tpunet transport.
+
+BASELINE config 2 ("JAX pmap(lax.psum)-style AllReduce sweep 8 B - 128 MB
+over the new DCN transport"): measures the full path a training step pays —
+jitted program -> io_callback host staging -> ring collectives -> multi-
+stream engine — vs `benchmarks.busbw_sweep --op allreduce`, which measures
+the native collectives alone; the difference is the JAX-integration tax.
+
+    python -m benchmarks.psum_sweep -n 2 --nstreams 4 -b 1K -e 64M
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from benchmarks import spawn_ranks
+from benchmarks.busbw_sweep import parse_size, sweep_sizes
+
+
+def _worker(rank, world, port, q, args):
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["TPUNET_NSTREAMS"] = str(args.nstreams)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+
+        from tpunet import distributed
+        from tpunet.interop import dcn_psum
+
+        distributed.initialize(f"127.0.0.1:{port}", rank, world)
+        fn = jax.jit(dcn_psum)
+        rows = []
+        for nbytes in sweep_sizes(args.begin, args.end, args.factor):
+            count = max(nbytes // 4, 1)
+            x = jnp.full((count,), float(rank + 1), jnp.float32)
+            iters = args.iters if nbytes >= (1 << 16) else args.iters * 4
+            for _ in range(args.warmup):
+                fn(x).block_until_ready()
+            distributed.global_communicator().barrier()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(x)
+            out.block_until_ready()
+            dt = (time.perf_counter() - t0) / iters
+            expect = float(sum(r + 1 for r in range(world)))
+            assert float(out[0]) == expect, f"bad psum result {out[0]} != {expect}"
+            rows.append((count * 4, count, dt))
+        distributed.finalize()
+        q.put((rank, ("OK", rows)))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, (f"FAIL: {type(e).__name__}: {e}", [])))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--world", type=int, default=2)
+    ap.add_argument("--nstreams", type=int, default=4)
+    ap.add_argument("-b", "--begin", type=parse_size, default=8)
+    ap.add_argument("-e", "--end", type=parse_size, default=128 << 20)
+    ap.add_argument("-f", "--factor", type=int, default=2)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--warmup", type=int, default=1)
+    args = ap.parse_args(argv)
+
+    results = spawn_ranks(_worker, args.world, extra_args=(args,))
+    for r, (status, _) in sorted(results.items()):
+        if status != "OK":
+            raise SystemExit(f"rank {r} failed: {status}")
+    rows = results[0][1]
+    w = args.world
+    print(f"# tpunet jit(dcn_psum) sweep  world={w} nstreams={args.nstreams}")
+    print(f"# {'size':>12} {'count':>12} {'time(us)':>12} {'algbw(GB/s)':>12} {'busbw(GB/s)':>12}")
+    for size, count, dt in rows:
+        algbw = size / dt / 1e9
+        busbw = algbw * 2.0 * (w - 1) / w
+        print(f"  {size:>12} {count:>12} {dt * 1e6:>12.1f} {algbw:>12.3f} {busbw:>12.3f}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
