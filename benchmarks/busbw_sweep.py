@@ -50,7 +50,7 @@ def sweep_sizes(begin: int, end: int, factor: int) -> list[int]:
 
 
 def _busbw_factor(op: str, world: int) -> float:
-    if op == "allreduce":
+    if op in ("allreduce", "psum"):  # psum = the jit(dcn_psum) sweep
         return 2.0 * (world - 1) / world
     if op in ("allgather", "reducescatter"):
         return float(world - 1) / world

@@ -17,11 +17,14 @@ import sys
 import time
 
 from benchmarks import spawn_ranks
-from benchmarks.busbw_sweep import parse_size, sweep_sizes
+from benchmarks.busbw_sweep import _emit_table, parse_size, sweep_sizes
 
 
 def _worker(rank, world, port, q, args):
     try:
+        # Env var AND config.update: an axon-style sitecustomize pins
+        # jax_platforms at interpreter start, so env alone cannot win; the
+        # env var still covers plain hosts where jax reads it at import.
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["TPUNET_NSTREAMS"] = str(args.nstreams)
         import jax
@@ -39,13 +42,18 @@ def _worker(rank, world, port, q, args):
             count = max(nbytes // 4, 1)
             x = jnp.full((count,), float(rank + 1), jnp.float32)
             iters = args.iters if nbytes >= (1 << 16) else args.iters * 4
+            comm = distributed.global_communicator()
             for _ in range(args.warmup):
                 fn(x).block_until_ready()
-            distributed.global_communicator().barrier()
+            comm.barrier()
             t0 = time.perf_counter()
             for _ in range(iters):
                 out = fn(x)
             out.block_until_ready()
+            # Closing barrier before reading the clock, matching the
+            # busbw_sweep baseline loop — the reported delta between the two
+            # IS the JAX-integration tax, so methodology must match.
+            comm.barrier()
             dt = (time.perf_counter() - t0) / iters
             expect = float(sum(r + 1 for r in range(world)))
             assert float(out[0]) == expect, f"bad psum result {out[0]} != {expect}"
@@ -65,20 +73,16 @@ def main(argv=None):
     ap.add_argument("-f", "--factor", type=int, default=2)
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--warmup", type=int, default=1)
+    ap.add_argument("--json", default="", help="also dump rows to this file")
     args = ap.parse_args(argv)
+    args.op = "psum"  # table header + AllReduce busbw factor (shared emitter)
+    os.environ["TPUNET_NSTREAMS"] = str(args.nstreams)  # emitter header reads env
 
-    results = spawn_ranks(_worker, args.world, extra_args=(args,))
+    results = spawn_ranks(_worker, args.world, extra_args=(args,), timeout=3600)
     for r, (status, _) in sorted(results.items()):
         if status != "OK":
             raise SystemExit(f"rank {r} failed: {status}")
-    rows = results[0][1]
-    w = args.world
-    print(f"# tpunet jit(dcn_psum) sweep  world={w} nstreams={args.nstreams}")
-    print(f"# {'size':>12} {'count':>12} {'time(us)':>12} {'algbw(GB/s)':>12} {'busbw(GB/s)':>12}")
-    for size, count, dt in rows:
-        algbw = size / dt / 1e9
-        busbw = algbw * 2.0 * (w - 1) / w
-        print(f"  {size:>12} {count:>12} {dt * 1e6:>12.1f} {algbw:>12.3f} {busbw:>12.3f}")
+    _emit_table(args)(results[0][1], args.world)
 
 
 if __name__ == "__main__":
