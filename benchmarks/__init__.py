@@ -3,7 +3,22 @@
 from __future__ import annotations
 
 import multiprocessing as mp
+import os
 import socket
+
+
+def reassert_jax_platform(platform: str | None = None) -> None:
+    """Make JAX_PLATFORMS actually win: an axon-style sitecustomize pins
+    jax_platforms via jax.config at interpreter start, so the env var alone
+    cannot select CPU (and a down TPU tunnel would hang the run). Call
+    before any jax use; no-op when neither `platform` nor the env is set."""
+    platform = platform or os.environ.get("JAX_PLATFORMS")
+    if not platform:
+        return
+    os.environ["JAX_PLATFORMS"] = platform
+    import jax
+
+    jax.config.update("jax_platforms", platform)
 
 
 def free_port() -> int:

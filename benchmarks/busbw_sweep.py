@@ -151,12 +151,19 @@ def _run_p2p_rank(rank, world, coordinator, args, emit):
         emit(rows, world)
 
 
-def _emit_table(args):
+def make_table_emitter(op: str, nstreams=None, engine=None, json_path: str = ""):
+    """Shared all_reduce_perf-style table emitter (also used by psum_sweep,
+    keeping the two sweeps' output directly comparable). nstreams/engine
+    default to the env the workers ran with."""
+    if nstreams is None:
+        nstreams = os.environ.get("TPUNET_NSTREAMS", "2")
+    if engine is None:
+        engine = os.environ.get("TPUNET_IMPLEMENT", "BASIC")
+
     def emit(rows, world):
-        factor = _busbw_factor(args.op, world)
-        print(f"# tpunet {args.op} sweep  world={world} "
-              f"nstreams={os.environ.get('TPUNET_NSTREAMS', '2')} "
-              f"engine={os.environ.get('TPUNET_IMPLEMENT', 'BASIC')}")
+        factor = _busbw_factor(op, world)
+        print(f"# tpunet {op} sweep  world={world} "
+              f"nstreams={nstreams} engine={engine}")
         print(f"# {'size':>12} {'count':>12} {'time(us)':>12} "
               f"{'algbw(GB/s)':>12} {'busbw(GB/s)':>12}")
         out = []
@@ -167,10 +174,14 @@ def _emit_table(args):
                   f"{algbw:>12.3f} {busbw:>12.3f}")
             out.append({"bytes": nbytes, "time_us": dt * 1e6,
                         "algbw_gbps": algbw, "busbw_gbps": busbw})
-        if args.json:
-            with open(args.json, "w") as f:
-                json.dump({"op": args.op, "world": world, "rows": out}, f)
+        if json_path:
+            with open(json_path, "w") as f:
+                json.dump({"op": op, "world": world, "rows": out}, f)
     return emit
+
+
+def _emit_table(args):
+    return make_table_emitter(args.op, json_path=args.json)
 
 
 def _worker(rank, world, port, q, args):

@@ -17,19 +17,16 @@ import sys
 import time
 
 from benchmarks import spawn_ranks
-from benchmarks.busbw_sweep import _emit_table, parse_size, sweep_sizes
+from benchmarks.busbw_sweep import make_table_emitter, parse_size, sweep_sizes
 
 
 def _worker(rank, world, port, q, args):
     try:
-        # Env var AND config.update: an axon-style sitecustomize pins
-        # jax_platforms at interpreter start, so env alone cannot win; the
-        # env var still covers plain hosts where jax reads it at import.
-        os.environ["JAX_PLATFORMS"] = "cpu"
+        from benchmarks import reassert_jax_platform
+
+        reassert_jax_platform("cpu")  # loopback ranks cannot share one TPU
         os.environ["TPUNET_NSTREAMS"] = str(args.nstreams)
         import jax
-
-        jax.config.update("jax_platforms", "cpu")
         import jax.numpy as jnp
 
         from tpunet import distributed
@@ -75,14 +72,13 @@ def main(argv=None):
     ap.add_argument("--warmup", type=int, default=1)
     ap.add_argument("--json", default="", help="also dump rows to this file")
     args = ap.parse_args(argv)
-    args.op = "psum"  # table header + AllReduce busbw factor (shared emitter)
-    os.environ["TPUNET_NSTREAMS"] = str(args.nstreams)  # emitter header reads env
 
     results = spawn_ranks(_worker, args.world, extra_args=(args,), timeout=3600)
     for r, (status, _) in sorted(results.items()):
         if status != "OK":
             raise SystemExit(f"rank {r} failed: {status}")
-    _emit_table(args)(results[0][1], args.world)
+    emit = make_table_emitter("psum", nstreams=args.nstreams, json_path=args.json)
+    emit(results[0][1], args.world)
 
 
 if __name__ == "__main__":

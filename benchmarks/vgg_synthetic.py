@@ -61,9 +61,11 @@ def run_benchmark(args, emit=print):
     rngkey = jax.random.PRNGKey(1)
 
     # Warmup (compile).
+    loss = None
     for _ in range(args.warmup):
         state, loss = step(state, images, labels, rngkey)
-    loss.block_until_ready()
+    if loss is not None:
+        loss.block_until_ready()
 
     rates = []
     for it in range(args.iters):
@@ -82,13 +84,9 @@ def run_benchmark(args, emit=print):
 
 def _mp_worker(rank, world, port, q, argv):
     try:
-        # Loopback multi-rank mode runs every rank on host CPU: N ranks
-        # cannot share one TPU chip, and an axon-style sitecustomize may pin
-        # jax_platforms at interpreter start — env alone cannot win.
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
+        from benchmarks import reassert_jax_platform
 
-        jax.config.update("jax_platforms", "cpu")
+        reassert_jax_platform("cpu")  # loopback ranks cannot share one TPU
         args = _parse(argv)
         from tpunet import distributed
 
@@ -120,10 +118,16 @@ def _parse(argv):
 
 def main(argv=None):
     args = _parse(argv)
+    if args.world == 1:
+        from benchmarks import reassert_jax_platform
+
+        reassert_jax_platform()  # the world>1 parent never runs JAX
     if args.world > 1:
         from benchmarks import spawn_ranks
 
-        results = spawn_ranks(_mp_worker, args.world, extra_args=(argv or sys.argv[1:],))
+        results = spawn_ranks(
+            _mp_worker, args.world, extra_args=(argv or sys.argv[1:],), timeout=3600
+        )
         for r, (status, _) in sorted(results.items()):
             if status != "OK":
                 raise SystemExit(f"rank {r} failed: {status}")

@@ -1,0 +1,16 @@
+"""Smoke test for the Transformer tokens/s benchmark."""
+
+from benchmarks.lm_synthetic import _parse, run_benchmark
+
+
+def test_single_process_tiny():
+    args = _parse(
+        [
+            "--d-model", "32", "--layers", "1", "--heads", "2", "--vocab", "64",
+            "--seq", "32", "--batch-size", "2", "--iters", "2",
+            "--batches-per-iter", "1", "--warmup", "1", "--no-bf16",
+        ]
+    )
+    rates = run_benchmark(args, emit=lambda *_: None)
+    assert len(rates) == 2
+    assert all(r > 0 for r in rates)

@@ -4,6 +4,11 @@ The reference's end-to-end benchmark is data-parallel VGG16 synthetic
 training (reference: README.md:52-84, 4046 img/s on 32 V100 with the
 multi-stream transport vs 2744 baseline); VGG16 is therefore the flagship
 model here, built TPU-first in flax (bf16-friendly, MXU-sized matmuls).
+
+The second family is a GPT-style Transformer exercising every parallelism
+axis first-class: Megatron TP partition rules, ring attention (in-pod
+shard_map/ppermute or cross-host over the DCN transport), and a
+Switch-style MoE with expert-parallel sharding.
 """
 
 from tpunet.models.transformer import (  # noqa: F401
