@@ -29,6 +29,15 @@ def free_port() -> int:
     return port
 
 
+def check_rank_results(results: dict) -> dict:
+    """For workers posting (status, payload): raise if any rank failed,
+    else return {rank: payload}. Shared by the benchmark entrypoints."""
+    for rank, (status, _) in sorted(results.items()):
+        if status != "OK":
+            raise SystemExit(f"rank {rank} failed: {status}")
+    return {rank: payload for rank, (_, payload) in results.items()}
+
+
 def spawn_ranks(target, world: int, extra_args=(), timeout: float = 600.0) -> dict:
     """Spawn `world` processes running target(rank, world, port, queue, *extra).
 

@@ -94,8 +94,9 @@ def run_benchmark(args, emit=print):
             dt = time.perf_counter() - t0
             rates.append(tokens_per_batch * args.batches_per_iter / dt)
             emit(f"Iter #{it}: {rates[-1]:.0f} tokens/sec")
-    lv = float(loss)
-    if lv != lv:
+    import math
+
+    if not math.isfinite(float(loss)):
         raise RuntimeError("non-finite loss during benchmark")
     return rates
 
@@ -110,7 +111,6 @@ def _mp_worker(rank, world, port, q, argv):
 
         distributed.initialize(f"127.0.0.1:{port}", rank, world)
         args.cross_host = True
-        args.sp = args.tp = 1  # loopback ranks are single-device
         rates = run_benchmark(args, emit=lambda *_: None)
         distributed.finalize()
         q.put((rank, ("OK", rates)))
@@ -142,6 +142,13 @@ def _parse(argv):
 def main(argv=None):
     args = _parse(argv)
     need = args.sp * args.tp
+    if args.world > 1 and need > 1:
+        # Loopback ranks are single-device; silently downgrading sp/tp would
+        # report tokens/s for a configuration the user didn't ask for.
+        raise SystemExit(
+            "--sp/--tp (in-process mesh axes) apply to single-process mode; "
+            "with -n, each rank is one device and parallelism is cross-host DP"
+        )
     flags = os.environ.get("XLA_FLAGS", "")
     if (os.environ.get("JAX_PLATFORMS") == "cpu" and need > 1
             and "--xla_force_host_platform_device_count" not in flags):
@@ -155,15 +162,12 @@ def main(argv=None):
 
         reassert_jax_platform()  # the world>1 parent never runs JAX
     if args.world > 1:
-        from benchmarks import spawn_ranks
+        from benchmarks import check_rank_results, spawn_ranks
 
-        results = spawn_ranks(
+        results = check_rank_results(spawn_ranks(
             _mp_worker, args.world, extra_args=(argv or sys.argv[1:],), timeout=3600
-        )
-        for r, (status, _) in sorted(results.items()):
-            if status != "OK":
-                raise SystemExit(f"rank {r} failed: {status}")
-        per_rank = [results[r][1] for r in range(args.world)]
+        ))
+        per_rank = [results[r] for r in range(args.world)]
         totals = [sum(it) for it in zip(*per_rank)]
         mean, std = statistics.mean(totals), statistics.pstdev(totals)
         print(f"Tokens/sec per rank: {mean / args.world:.0f}")

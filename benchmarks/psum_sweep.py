@@ -73,12 +73,13 @@ def main(argv=None):
     ap.add_argument("--json", default="", help="also dump rows to this file")
     args = ap.parse_args(argv)
 
-    results = spawn_ranks(_worker, args.world, extra_args=(args,), timeout=3600)
-    for r, (status, _) in sorted(results.items()):
-        if status != "OK":
-            raise SystemExit(f"rank {r} failed: {status}")
+    from benchmarks import check_rank_results
+
+    results = check_rank_results(
+        spawn_ranks(_worker, args.world, extra_args=(args,), timeout=3600)
+    )
     emit = make_table_emitter("psum", nstreams=args.nstreams, json_path=args.json)
-    emit(results[0][1], args.world)
+    emit(results[0], args.world)
 
 
 if __name__ == "__main__":

@@ -76,8 +76,9 @@ def run_benchmark(args, emit=print):
         dt = time.perf_counter() - t0
         rates.append(args.batch_size * args.batches_per_iter / dt)
         emit(f"Iter #{it}: {rates[-1]:.1f} img/sec")
-    lv = float(loss)
-    if lv != lv:  # NaN guard
+    import math
+
+    if not math.isfinite(float(loss)):
         raise RuntimeError("non-finite loss during benchmark")
     return rates
 
@@ -125,13 +126,12 @@ def main(argv=None):
     if args.world > 1:
         from benchmarks import spawn_ranks
 
-        results = spawn_ranks(
+        from benchmarks import check_rank_results
+
+        results = check_rank_results(spawn_ranks(
             _mp_worker, args.world, extra_args=(argv or sys.argv[1:],), timeout=3600
-        )
-        for r, (status, _) in sorted(results.items()):
-            if status != "OK":
-                raise SystemExit(f"rank {r} failed: {status}")
-        per_rank = [results[r][1] for r in range(args.world)]
+        ))
+        per_rank = [results[r] for r in range(args.world)]
         totals = [sum(it) for it in zip(*per_rank)]
         mean, std = statistics.mean(totals), statistics.pstdev(totals)
         per = mean / args.world

@@ -118,6 +118,38 @@ def test_ep_sharded_moe_forward_matches():
 
 
 @pytest.mark.parametrize("n_experts", [0, 4])
+def test_remat_matches_no_remat(n_experts):
+    # Rematerialization must not change values — forward or gradients —
+    # including the MoE path (sown aux loss under the lifted remat).
+    plain = _tiny(n_experts=n_experts, moe_every=1)
+    remat = _tiny(remat=True, n_experts=n_experts, moe_every=1)
+    toks = _tokens(jax.random.PRNGKey(0), 2, 16)
+    labels = jnp.roll(toks, -1, axis=1)
+    params = plain.init(jax.random.PRNGKey(1), toks)["params"]
+
+    np.testing.assert_allclose(
+        np.asarray(remat.apply({"params": params}, toks)),
+        np.asarray(plain.apply({"params": params}, toks)),
+        atol=1e-6,
+    )
+
+    def loss_fn(model):
+        def f(p):
+            logits = model.apply({"params": p}, toks)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+        return f
+
+    g_plain = jax.grad(loss_fn(plain))(params)
+    g_remat = jax.grad(loss_fn(remat))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5
+        ),
+        g_plain, g_remat,
+    )
+
+
+@pytest.mark.parametrize("n_experts", [0, 4])
 def test_train_step_loss_decreases(n_experts):
     model = _tiny(n_experts=n_experts)
     tx = optax.adam(1e-2)

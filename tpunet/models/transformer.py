@@ -225,6 +225,7 @@ class Transformer(nn.Module):
     moe_every: int = 2            # every k-th block is MoE (when n_experts>0)
     capacity_factor: float = 1.25
     compute_dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = False           # rematerialize blocks: trade FLOPs for HBM
     attn_impl: str = "reference"
     mesh: Mesh | None = None
     dp_axis: str | None = "dp"
@@ -239,9 +240,13 @@ class Transformer(nn.Module):
         )
         x = emb[tokens].astype(self.compute_dtype)
         head_dim = self.d_model // self.n_heads
+        # remat drops block activations in the forward pass and recomputes
+        # them in the backward — the standard long-context memory lever
+        # (sequence activations dominate HBM; FLOPs are MXU-cheap).
+        block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.n_layers):
             moe = self.n_experts > 0 and (i + 1) % self.moe_every == 0
-            x = Block(
+            x = block_cls(
                 self.n_heads, head_dim, self.d_ff,
                 n_experts=self.n_experts if moe else 0,
                 capacity_factor=self.capacity_factor,
