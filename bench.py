@@ -62,15 +62,16 @@ def _worker(rank: int, world: int, port: int, q, nstreams: int) -> None:
 
 def _run_config(nstreams: int) -> float:
     """Returns busbw in GB/s (best iteration, nccl-tests convention)."""
-    results = spawn_ranks(_worker, WORLD, extra_args=(nstreams,), timeout=300)
-    for rank, (status, _) in sorted(results.items()):
-        if status != "OK":
-            raise RuntimeError(f"rank {rank} failed: {status}")
+    from benchmarks import check_rank_results
+
+    results = check_rank_results(
+        spawn_ranks(_worker, WORLD, extra_args=(nstreams,), timeout=300)
+    )
     # Per iteration both ranks measure the same collective; use the max of the
     # per-rank times (the collective isn't done until the slowest rank is),
     # then the best iteration, as nccl-tests does with its min/avg columns.
     per_iter = [
-        max(results[r][1][i] for r in range(WORLD)) for i in range(ITERS)
+        max(results[r][i] for r in range(WORLD)) for i in range(ITERS)
     ]
     best = min(per_iter)
     busbw_factor = 2.0 * (WORLD - 1) / WORLD

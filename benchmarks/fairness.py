@@ -1,0 +1,112 @@
+"""Stream-fairness benchmark: how evenly do bytes spread across streams?
+
+The reference's whole point is FAIR multi-stream striping: its BASIC engine
+rotates the chunk round-robin cursor ACROSS messages, so even single-chunk
+(small) messages take turns on every TCP connection; its TOKIO engine always
+started at stream 0 and pinned small messages there (reference
+nthread_per_socket_backend.rs:393,412 vs tokio_backend.rs:392-404 — SURVEY
+hard-part #4). This benchmark makes that property measurable: a sender
+pushes many single-chunk messages, then we read the engine's per-stream
+byte counters (tpunet_stream_tx_bytes) and report the distribution plus
+Jain's fairness index J = (Σx)² / (n·Σx²) — 1.0 is perfectly fair, 1/n is
+one stream hogging everything.
+
+    python -m benchmarks.fairness --nstreams 4 --messages 2000 --size 8192
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+
+def _worker(rank, world, port, q, args):
+    try:
+        os.environ["TPUNET_NSTREAMS"] = str(args.nstreams)
+        # Every message must be single-chunk: fairness then rests entirely
+        # on the rotating cursor, the property under test.
+        os.environ["TPUNET_MIN_CHUNKSIZE"] = str(max(args.size, 1 << 20))
+        import numpy as np
+
+        from tpunet.collectives import Communicator
+        from tpunet.telemetry import metrics_text
+        from tpunet.transport import Net
+
+        boot = Communicator(f"127.0.0.1:{port}", rank, world)
+        net = Net()
+        listen = net.listen()
+        handles = boot.all_gather(np.frombuffer(listen.handle, np.uint8))
+        peer = bytes(handles[1 - rank].tobytes())
+        if rank == 0:
+            send = net.connect(peer)
+            boot.barrier()
+            recv = listen.accept()
+        else:
+            boot.barrier()
+            recv = listen.accept()
+            send = net.connect(peer)
+
+        buf = np.ones(args.size, np.uint8)
+        out = np.empty(args.size, np.uint8)
+        if rank == 0:
+            pending = []
+            for _ in range(args.messages):
+                pending.append(send.isend(buf))
+                if len(pending) >= 8:
+                    pending.pop(0).wait()
+            for r in pending:
+                r.wait()
+        else:
+            for _ in range(args.messages):
+                recv.irecv(out).wait()
+        boot.barrier()
+
+        counter = "tpunet_stream_tx_bytes" if rank == 0 else "tpunet_stream_rx_bytes"
+        per_stream = {}
+        for line in metrics_text().splitlines():
+            m = re.match(rf'{counter}{{.*stream="(\d+)"}} (\d+)', line)
+            if m:
+                per_stream[int(m.group(1))] = int(m.group(2))
+        send.close(); recv.close(); listen.close(); net.close(); boot.close()
+        q.put((rank, ("OK", per_stream)))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, (f"FAIL: {type(e).__name__}: {e}", {})))
+
+
+def jain(xs) -> float:
+    xs = [float(x) for x in xs]
+    if not xs or sum(xs) == 0:
+        return 0.0
+    return sum(xs) ** 2 / (len(xs) * sum(x * x for x in xs))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nstreams", type=int, default=4)
+    ap.add_argument("--messages", type=int, default=2000)
+    ap.add_argument("--size", type=int, default=8192, help="bytes per message")
+    args = ap.parse_args(argv)
+
+    from benchmarks import check_rank_results, spawn_ranks
+
+    results = check_rank_results(
+        spawn_ranks(_worker, 2, extra_args=(args,), timeout=1800)
+    )
+    tx = results[0]
+    counts = [tx.get(i, 0) for i in range(args.nstreams)]
+    j = jain(counts)
+    total = sum(counts)
+    print(f"# tpunet stream fairness  nstreams={args.nstreams} "
+          f"messages={args.messages} size={args.size}B (single-chunk)")
+    for i, c in enumerate(counts):
+        pct = 100.0 * c / total if total else 0.0
+        print(f"  stream {i}: {c:>12} B  {pct:5.1f}%")
+    print(f"  Jain fairness index: {j:.4f}  (1.0 = perfectly fair, "
+          f"{1.0 / args.nstreams:.2f} = one stream hogs)")
+    return j
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -15,6 +15,7 @@ reports tokens/s mean ± std. Exercises the parallelism axes end-to-end:
 from __future__ import annotations
 
 import argparse
+import math
 import os
 import statistics
 import sys
@@ -94,8 +95,6 @@ def run_benchmark(args, emit=print):
             dt = time.perf_counter() - t0
             rates.append(tokens_per_batch * args.batches_per_iter / dt)
             emit(f"Iter #{it}: {rates[-1]:.0f} tokens/sec")
-    import math
-
     if not math.isfinite(float(loss)):
         raise RuntimeError("non-finite loss during benchmark")
     return rates

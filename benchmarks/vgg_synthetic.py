@@ -20,6 +20,7 @@ Modes:
 from __future__ import annotations
 
 import argparse
+import math
 import os
 import statistics
 import sys
@@ -76,8 +77,6 @@ def run_benchmark(args, emit=print):
         dt = time.perf_counter() - t0
         rates.append(args.batch_size * args.batches_per_iter / dt)
         emit(f"Iter #{it}: {rates[-1]:.1f} img/sec")
-    import math
-
     if not math.isfinite(float(loss)):
         raise RuntimeError("non-finite loss during benchmark")
     return rates
@@ -124,9 +123,7 @@ def main(argv=None):
 
         reassert_jax_platform()  # the world>1 parent never runs JAX
     if args.world > 1:
-        from benchmarks import spawn_ranks
-
-        from benchmarks import check_rank_results
+        from benchmarks import check_rank_results, spawn_ranks
 
         results = check_rank_results(spawn_ranks(
             _mp_worker, args.world, extra_args=(argv or sys.argv[1:],), timeout=3600

@@ -1,0 +1,27 @@
+"""Smoke + property test for the stream-fairness benchmark."""
+
+from benchmarks.fairness import jain
+
+
+def test_jain_index_math():
+    assert jain([100, 100, 100, 100]) == 1.0
+    assert abs(jain([400, 0, 0, 0]) - 0.25) < 1e-9
+    assert jain([]) == 0.0
+    assert jain([0, 0]) == 0.0
+
+
+def test_fairness_end_to_end():
+    # Small run: the rotating round-robin cursor must spread single-chunk
+    # messages near-perfectly across streams (the reference's core claim).
+    import sys
+    from io import StringIO
+
+    from benchmarks.fairness import main
+
+    old = sys.stdout
+    sys.stdout = StringIO()
+    try:
+        j = main(["--nstreams", "4", "--messages", "64", "--size", "1024"])
+    finally:
+        sys.stdout = old
+    assert j > 0.99, f"fairness index {j} — striping is not rotating"

@@ -133,6 +133,16 @@ def test_remat_matches_no_remat(n_experts):
         atol=1e-6,
     )
 
+    if n_experts:
+        # The sown moe_aux_loss must survive the lifted remat transform and
+        # carry the same values.
+        _, ip = plain.apply({"params": params}, toks, mutable=["intermediates"])
+        _, ir = remat.apply({"params": params}, toks, mutable=["intermediates"])
+        aux_p = sorted(float(a) for a in jax.tree.leaves(ip["intermediates"]))
+        aux_r = sorted(float(a) for a in jax.tree.leaves(ir["intermediates"]))
+        assert len(aux_r) == len(aux_p) > 0
+        np.testing.assert_allclose(aux_r, aux_p, atol=1e-6)
+
     def loss_fn(model):
         def f(p):
             logits = model.apply({"params": p}, toks)
