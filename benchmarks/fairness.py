@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import argparse
 import os
-import re
 import sys
 
 
@@ -31,7 +30,7 @@ def _worker(rank, world, port, q, args):
         import numpy as np
 
         from tpunet.collectives import Communicator
-        from tpunet.telemetry import metrics_text
+        from tpunet.telemetry import metrics
         from tpunet.transport import Net
 
         boot = Communicator(f"127.0.0.1:{port}", rank, world)
@@ -65,10 +64,15 @@ def _worker(rank, world, port, q, args):
 
         counter = "tpunet_stream_tx_bytes" if rank == 0 else "tpunet_stream_rx_bytes"
         per_stream = {}
-        for line in metrics_text().splitlines():
-            m = re.match(rf'{counter}{{.*stream="(\d+)"}} (\d+)', line)
-            if m:
-                per_stream[int(m.group(1))] = int(m.group(2))
+        for labels, value in metrics().get(counter, {}).items():
+            stream = next(
+                (l.split("=")[1].strip('"') for l in labels if l.startswith("stream=")),
+                None,
+            )
+            if stream is not None:
+                per_stream[int(stream)] = int(value)
+        if not per_stream:
+            raise RuntimeError(f"no {counter} samples in telemetry output")
         send.close(); recv.close(); listen.close(); net.close(); boot.close()
         q.put((rank, ("OK", per_stream)))
     except Exception as e:  # noqa: BLE001
