@@ -156,11 +156,15 @@ class ReducePool {
   }
 
   // Run fn(i) for i in [0, njobs) on the pool + calling thread; blocks.
+  // Serialized across callers: two Communicators driven from different
+  // Python threads (ctypes releases the GIL) must not interleave the shared
+  // job_/njobs_/next_/pending_ state mid-reduction.
   void Run(const std::function<void(size_t)>& fn, size_t njobs) {
     if (nworkers_ == 0 || njobs <= 1) {
       for (size_t i = 0; i < njobs; ++i) fn(i);
       return;
     }
+    std::lock_guard<std::mutex> run_lk(run_mu_);
     std::unique_lock<std::mutex> lk(mu_);
     job_ = &fn;
     njobs_ = njobs;
@@ -218,6 +222,7 @@ class ReducePool {
     }
   }
 
+  std::mutex run_mu_;  // serializes concurrent Run() callers
   std::mutex mu_;
   std::condition_variable work_cv_, done_cv_;
   const std::function<void(size_t)>* job_ = nullptr;

@@ -159,6 +159,24 @@ def test_remat_matches_no_remat(n_experts):
     )
 
 
+def test_train_step_includes_moe_aux_loss():
+    """The Switch balancing term must reach the training loss (ADVICE r1):
+    the same step with a larger moe_aux_weight must report a larger loss."""
+    model = _tiny(n_experts=4, moe_every=1)
+    tx = optax.adam(1e-2)
+    toks = _tokens(jax.random.PRNGKey(0), 4, 16)
+    labels = jnp.roll(toks, -1, axis=1)
+    state, _ = create_train_state(model, jax.random.PRNGKey(1), toks, tx)
+    losses = {}
+    for w in (0.0, 10.0):
+        step = make_train_step(model, tx, donate=False, moe_aux_weight=w)
+        _, loss = step(state, toks, labels, jax.random.PRNGKey(0))
+        losses[w] = float(loss)
+    # aux loss is e*sum(frac_tokens*frac_probs) >= 1 > 0, so weight 10 must
+    # add a visible amount over weight 0.
+    assert losses[10.0] > losses[0.0] + 1.0
+
+
 @pytest.mark.parametrize("n_experts", [0, 4])
 def test_train_step_loss_decreases(n_experts):
     model = _tiny(n_experts=n_experts)

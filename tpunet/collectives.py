@@ -84,7 +84,14 @@ class Communicator:
         """AllReduce. inplace=True reduces into `arr` itself (must be a
         C-contiguous ndarray) — skips the send→recv staging copy, which
         matters at 100MB+ gradient-bucket sizes."""
-        arr = _c_contig(np.asarray(arr))
+        caller_arr = arr
+        arr = np.asarray(arr)
+        if inplace and (arr is not caller_arr or not arr.flags.c_contiguous):
+            raise ValueError(
+                "inplace=True requires a C-contiguous ndarray (a staging "
+                "copy would leave the caller's buffer unchanged)"
+            )
+        arr = _c_contig(arr)
         out = arr if inplace else np.empty_like(arr)
         _native.check(
             self._lib.tpunet_comm_all_reduce(

@@ -38,7 +38,8 @@ def create_train_state(model, rng, sample_input, tx) -> tuple[TrainState, Any]:
 
 
 def make_train_step(model, tx, cross_host: bool = False, donate: bool = True,
-                    grad_compression: str | None = None):
+                    grad_compression: str | None = None,
+                    moe_aux_weight: float = 0.01):
     """Build the jitted train step.
 
     cross_host=True adds the DCN gradient all-reduce tier (requires
@@ -50,9 +51,15 @@ def make_train_step(model, tx, cross_host: bool = False, donate: bool = True,
     ~1 ulp of bf16 noise on already-noisy SGD gradients (the reference has
     no compression; its parent project's QAdam/bytegrad live a layer above —
     this is that capability at the transport-facing tier).
+
+    When the model has MoE blocks (``n_experts > 0``), the Switch router's
+    sown load-balancing losses are collected via mutable=['intermediates']
+    and added to the loss scaled by ``moe_aux_weight`` — without this term
+    the router can collapse onto one expert and capacity-drop most tokens.
     """
     if grad_compression not in (None, "bf16"):
         raise ValueError(f"unknown grad_compression {grad_compression!r}")
+    has_moe = getattr(model, "n_experts", 0) > 0
     if cross_host:
         # Import here so single-host training never touches the transport.
         from tpunet import distributed
@@ -62,11 +69,35 @@ def make_train_step(model, tx, cross_host: bool = False, donate: bool = True,
 
     def train_step(state: TrainState, images, labels, dropout_rng):
         def loss_fn(p):
-            logits = model.apply(
-                {"params": p}, images, train=True, rngs={"dropout": dropout_rng}
-            )
+            if has_moe:
+                logits, mut = model.apply(
+                    {"params": p}, images, train=True,
+                    rngs={"dropout": dropout_rng}, mutable=["intermediates"],
+                )
+            else:
+                logits = model.apply(
+                    {"params": p}, images, train=True, rngs={"dropout": dropout_rng}
+                )
             loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
-            return loss.mean()
+            loss = loss.mean()
+            if has_moe:
+                # Each MoeMlp sows one scalar under .../moe_aux_loss; flax
+                # wraps sown values in tuples, so sum all leaves on matching
+                # paths and average over MoE blocks.
+                aux = [
+                    leaf
+                    for path, leaf in jax.tree_util.tree_leaves_with_path(
+                        mut.get("intermediates", {})
+                    )
+                    if any(
+                        getattr(k, "key", None) == "moe_aux_loss" for k in path
+                    )
+                ]
+                if aux:
+                    loss = loss + moe_aux_weight * (
+                        sum(aux) / len(aux)
+                    ).astype(loss.dtype)
+            return loss
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
 
