@@ -105,6 +105,10 @@ int32_t tpunet_comm_reduce_scatter(uintptr_t comm, const void* sendbuf, void* re
 int32_t tpunet_comm_all_gather(uintptr_t comm, const void* sendbuf, void* recvbuf,
                                uint64_t bytes_per_rank);
 int32_t tpunet_comm_broadcast(uintptr_t comm, void* buf, uint64_t nbytes, int32_t root);
+/* sendbuf: world blocks of bytes_per_rank, block j for rank j; recvbuf:
+ * world blocks, block j from rank j. sendbuf may equal recvbuf. */
+int32_t tpunet_comm_all_to_all(uintptr_t comm, const void* sendbuf, void* recvbuf,
+                               uint64_t bytes_per_rank);
 /* Send to (rank+1)%world while receiving from (rank-1+world)%world. */
 int32_t tpunet_comm_neighbor_exchange(uintptr_t comm, const void* sendbuf,
                                       uint64_t send_nbytes, void* recvbuf,

@@ -61,6 +61,15 @@ class Communicator {
   virtual Status AllGather(const void* sendbuf, void* recvbuf, size_t bytes_per_rank) = 0;
   // In-place broadcast of nbytes from root, pipelined around the ring.
   virtual Status Broadcast(void* buf, size_t nbytes, int root) = 0;
+  // AllToAll: sendbuf holds world blocks of bytes_per_rank bytes, block j
+  // destined for rank j; recvbuf gets world blocks, block j originating at
+  // rank j. sendbuf may equal recvbuf (in-place). Implemented as a
+  // store-and-forward relay around the ring (constant connection degree; a
+  // block bound d hops ahead travels d hops), so per-rank traffic is
+  // W(W-1)/2 blocks vs the (W-1) of an all-pairs topology — the trade the
+  // ring makes for not opening W^2 multi-stream socket bundles. This is the
+  // primitive Ulysses sequence parallelism and cross-host MoE dispatch ride.
+  virtual Status AllToAll(const void* sendbuf, void* recvbuf, size_t bytes_per_rank) = 0;
   // Simultaneous send-to-next / recv-from-prev (the ppermute step of ring
   // attention / sequence parallelism). send_nbytes bytes go to (rank+1)%W;
   // recv buffer receives prev rank's message (recv_nbytes posted capacity;

@@ -315,6 +315,14 @@ int32_t tpunet_comm_broadcast(uintptr_t comm, void* buf, uint64_t nbytes, int32_
   return FromStatus(c->Broadcast(buf, nbytes, root));
 }
 
+int32_t tpunet_comm_all_to_all(uintptr_t comm, const void* sendbuf, void* recvbuf,
+                               uint64_t bytes_per_rank) {
+  if (bytes_per_rank > 0 && (!sendbuf || !recvbuf)) return Fail(TPUNET_ERR_NULL, "null buffer");
+  auto c = GetComm(comm);
+  if (!c) return Fail(TPUNET_ERR_INVALID, "unknown comm");
+  return FromStatus(c->AllToAll(sendbuf, recvbuf, bytes_per_rank));
+}
+
 int32_t tpunet_comm_neighbor_exchange(uintptr_t comm, const void* sendbuf,
                                       uint64_t send_nbytes, void* recvbuf,
                                       uint64_t recv_nbytes, uint64_t* got) {

@@ -427,6 +427,40 @@ class RingCommunicator : public Communicator {
     return DrainSends(pending_sends, Status::Ok());
   }
 
+  Status AllToAll(const void* sendbuf, void* recvbuf, size_t bytes_per_rank) override {
+    const int W = world_;
+    const size_t B = bytes_per_rank;
+    const uint8_t* in = static_cast<const uint8_t*>(sendbuf);
+    uint8_t* out = static_cast<uint8_t*>(recvbuf);
+    if (static_cast<const void*>(out) != sendbuf) {
+      memcpy(out + rank_ * B, in + rank_ * B, B);  // own block stays local
+    }
+    if (W == 1 || B == 0) return Status::Ok();
+
+    // Store-and-forward relay. Packet invariant at step s: the packet holds
+    // nblk = W-1-s blocks; position p carries the block with nblk-p hops of
+    // remaining travel (descending). After one Exchange hop every block's
+    // remaining distance drops by one: the last block has arrived (it is the
+    // block rank (rank-s-1) addressed to us), the rest forward verbatim next
+    // step. Both sides compute identical per-step sizes, so the fixed-size
+    // Exchange path (got=nullptr) catches rank disagreement as an error.
+    a2a_fwd_.resize(static_cast<size_t>(W - 1) * B);
+    a2a_rcv_.resize(static_cast<size_t>(W - 1) * B);
+    for (int p = 0; p < W - 1; ++p) {
+      int dest = (rank_ + (W - 1 - p)) % W;
+      memcpy(a2a_fwd_.data() + static_cast<size_t>(p) * B, in + dest * B, B);
+    }
+    for (int s = 0; s < W - 1; ++s) {
+      size_t nblk = static_cast<size_t>(W - 1 - s);
+      Status st = Exchange(a2a_fwd_.data(), nblk * B, a2a_rcv_.data(), nblk * B, nullptr);
+      if (!st.ok()) return st;
+      int src = (rank_ - s - 1 + W) % W;
+      memcpy(out + src * B, a2a_rcv_.data() + (nblk - 1) * B, B);
+      std::swap(a2a_fwd_, a2a_rcv_);
+    }
+    return Status::Ok();
+  }
+
   Status NeighborExchange(const void* sendbuf, size_t send_nbytes, void* recvbuf,
                           size_t recv_nbytes, size_t* got) override {
     if (world_ == 1) {
@@ -594,6 +628,7 @@ class RingCommunicator : public Communicator {
   std::vector<uint8_t> scratch_;
   std::vector<uint8_t> work_;
   std::vector<uint8_t> barrier_scratch_;
+  std::vector<uint8_t> a2a_fwd_, a2a_rcv_;
 };
 
 }  // namespace

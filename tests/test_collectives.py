@@ -91,6 +91,20 @@ def _worker(rank: int, world: int, port: int, q, env: dict | None = None) -> Non
         prev = (rank - 1 + world) % world
         np.testing.assert_array_equal(got, _rank_data(prev, 5000, np.float32))
 
+        # AllToAll: my send block j goes to rank j; my result block j is
+        # rank j's block addressed to me. Verified against each peer's
+        # deterministic construction.
+        per_a2a = 257  # odd on purpose: non-round block bytes
+        send = np.stack(
+            [_rank_data(rank, per_a2a, np.float32) + j for j in range(world)]
+        )
+        got = comm.all_to_all(send)
+        assert got.shape == send.shape
+        for r in range(world):
+            np.testing.assert_array_equal(
+                got[r], _rank_data(r, per_a2a, np.float32) + rank
+            )
+
         # Barrier (just must not hang or error).
         comm.barrier()
 
@@ -141,6 +155,7 @@ def test_world_size_one_shortcuts():
         np.testing.assert_array_equal(comm.all_reduce(x, "sum"), x)
         np.testing.assert_array_equal(comm.all_gather(x)[0], x)
         np.testing.assert_array_equal(comm.neighbor_exchange(x), x)
+        np.testing.assert_array_equal(comm.all_to_all(x[None]), x[None])
         comm.barrier()
 
 

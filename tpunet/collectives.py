@@ -154,6 +154,27 @@ class Communicator:
         )
         return arr
 
+    def all_to_all(self, arr: Any) -> np.ndarray:
+        """arr: leading axis == world_size, block j destined for rank j.
+        Returns the same shape with block j originating at rank j — the
+        Ulysses sequence-parallel / cross-host MoE dispatch primitive."""
+        arr = _c_contig(np.asarray(arr))
+        if arr.shape[0] != self.world_size:
+            raise ValueError(
+                f"leading axis {arr.shape[0]} must equal world size {self.world_size}"
+            )
+        out = np.empty_like(arr)
+        _native.check(
+            self._lib.tpunet_comm_all_to_all(
+                self._id,
+                arr.ctypes.data if arr.size else None,
+                out.ctypes.data if out.size else None,
+                arr.nbytes // self.world_size,
+            ),
+            "all_to_all",
+        )
+        return out
+
     def neighbor_exchange(self, arr: Any) -> np.ndarray:
         """Send arr to (rank+1)%W, receive the same-shaped message from
         (rank-1+W)%W — the ring-attention / sequence-parallel shift step."""

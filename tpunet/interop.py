@@ -101,6 +101,21 @@ def dcn_reduce_scatter(x, op: str = "sum"):
     return io_callback(cb, spec, x, ordered=True)
 
 
+def dcn_all_to_all(x):
+    """AllToAll across processes: x has leading axis == world, block j goes
+    to process j; the result's block j came from process j. Shape-preserving.
+    The cross-host leg of Ulysses sequence parallelism and MoE dispatch."""
+    w = distributed.world_size()
+    shape = tuple(jnp.shape(x))
+    if not shape or shape[0] != w:
+        raise ValueError(f"leading axis must equal world size {w}, got {shape}")
+
+    def cb(a):
+        return _comm().all_to_all(np.asarray(a))
+
+    return io_callback(cb, _callback_result_spec(x), x, ordered=True)
+
+
 def dcn_broadcast(x, root: int = 0):
     def cb(a):
         return _comm().broadcast(np.asarray(a), root)

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from tpunet.ops import attention_reference, flash_attention
 from tpunet.parallel.ring_attention import ring_self_attention
+from tpunet.parallel.ulysses import ulysses_self_attention
 
 
 def rotary_embed(x, base: float = 10000.0, pos_offset: int = 0):
@@ -67,8 +68,9 @@ class SelfAttention(nn.Module):
     """Causal multi-head self-attention with pluggable impl.
 
     attn_impl: "reference" (einsum softmax), "flash" (Pallas kernel),
-    "ring" (sequence-parallel ring attention over `sp_axis` of `mesh`), or
-    "dcn_ring" (sequence sharded across PROCESSES, k/v rotating over the
+    "ring" / "ulysses" (sequence-parallel attention over `sp_axis` of
+    `mesh` — k/v ring rotation vs all-to-all head re-sharding), or
+    "dcn_ring" / "dcn_ulysses" (sequence sharded across PROCESSES over the
     tpunet DCN transport — requires tpunet.distributed.initialize()).
     """
 
@@ -91,7 +93,7 @@ class SelfAttention(nn.Module):
         k = proj("k")(x).reshape(b, s, h, dh)
         v = proj("v")(x).reshape(b, s, h, dh)
         pos_offset = 0
-        if self.attn_impl == "dcn_ring":
+        if self.attn_impl in ("dcn_ring", "dcn_ulysses"):
             # The per-process model sees only its sequence shard; rotary
             # must use global positions for the ring to be coherent.
             from tpunet import distributed
@@ -100,10 +102,11 @@ class SelfAttention(nn.Module):
         q = rotary_embed(q, pos_offset=pos_offset)
         k = rotary_embed(k, pos_offset=pos_offset)
 
-        if self.attn_impl == "ring":
+        if self.attn_impl in ("ring", "ulysses"):
             if self.mesh is None:
-                raise ValueError("attn_impl='ring' requires a mesh")
-            o = ring_self_attention(
+                raise ValueError(f"attn_impl={self.attn_impl!r} requires a mesh")
+            sp_fn = ring_self_attention if self.attn_impl == "ring" else ulysses_self_attention
+            o = sp_fn(
                 q, k, v, self.mesh, causal=True,
                 dp_axis=self.dp_axis, sp_axis=self.sp_axis, tp_axis=self.tp_axis,
             )
@@ -111,6 +114,10 @@ class SelfAttention(nn.Module):
             from tpunet.parallel.dcn_ring_attention import dcn_ring_attention
 
             o = dcn_ring_attention(q, k, v, causal=True)
+        elif self.attn_impl == "dcn_ulysses":
+            from tpunet.parallel.ulysses import dcn_ulysses_attention
+
+            o = dcn_ulysses_attention(q, k, v, causal=True)
         elif self.attn_impl == "flash":
             o = flash_attention(q, k, v, True)
         else:

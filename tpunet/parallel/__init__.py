@@ -27,3 +27,8 @@ from tpunet.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ring_self_attention,
 )
+from tpunet.parallel.ulysses import (  # noqa: F401
+    dcn_ulysses_attention,
+    ulysses_attention,
+    ulysses_self_attention,
+)

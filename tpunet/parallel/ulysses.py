@@ -1,0 +1,112 @@
+"""Ulysses sequence parallelism — all-to-all head/sequence re-sharding.
+
+The second SP strategy next to ring attention (SURVEY §2.3 checklist): instead
+of rotating K/V blocks around a ring, two all-to-alls re-shard the arrays so
+each device sees the FULL sequence for a SUBSET of heads:
+
+    (b, S/P, H, d) --all_to_all--> (b, S, H/P, d)   attention   --back-->
+
+Attention itself then needs no communication at all (each head attends over
+the whole sequence locally), which makes Ulysses the better choice when
+head count >= devices and the interconnect favors few large collectives;
+ring attention wins when S/P blocks overlap compute with permutes or when
+H < P. Both tiers are provided:
+
+  * in-pod (ICI): `ulysses_self_attention` — `lax.all_to_all` inside
+    shard_map; XLA lowers it onto the ICI mesh.
+  * cross-host (DCN): `dcn_ulysses_attention` — the transport's native
+    store-and-forward AllToAll (`Communicator.all_to_all`) entering jit via
+    `tpunet.interop.dcn_all_to_all`.
+
+The reference repo has no attention layer (SURVEY §5 "long-context:
+absent"); this is capability the TPU build makes first-class, riding the
+framework's own AllToAll collective.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpunet.ops import attention_reference
+from tpunet.parallel.smap import shard_map
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Per-shard Ulysses attention; call inside `shard_map` (or pmap).
+
+    q/k/v: this device's sequence shard (batch, s_local, heads, head_dim),
+    sequence sharded over `axis_name` in ring order, heads divisible by the
+    axis size. Returns the local shard of the output, q-shaped.
+    """
+    w = jax.lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % w != 0:
+        raise ValueError(f"heads {h} not divisible by '{axis_name}' size {w}")
+
+    # seq-sharded -> head-sharded: split heads (axis 2) across the axis,
+    # concatenate the received sequence chunks (axis 1) in device order —
+    # which is global sequence order, so causal masking stays plain.
+    def to_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    o = attention_reference(to_heads(q), to_heads(k), to_heads(v), causal)
+    # head-sharded -> seq-sharded: the inverse re-shard.
+    return jax.lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_self_attention(
+    q, k, v, mesh: Mesh, causal: bool = False,
+    dp_axis: str | None = "dp", sp_axis: str = "sp", tp_axis: str | None = None,
+):
+    """Full-array entry point (mirror of `ring_self_attention`): q/k/v are
+    (batch, seq, heads, head_dim) global arrays with batch over `dp_axis`,
+    sequence over `sp_axis`, optionally heads over `tp_axis`."""
+    spec = P(dp_axis, sp_axis, tp_axis, None)
+    fn = shard_map(
+        partial(ulysses_attention, axis_name=sp_axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+def dcn_ulysses_attention(q, k, v, causal: bool = False):
+    """Ulysses attention across PROCESSES over the DCN transport.
+
+    q/k/v: this process's sequence shard (batch, s_local, heads, head_dim) in
+    rank order; heads divisible by world size. Jittable (the all-to-alls are
+    ordered io_callbacks). Requires `tpunet.distributed.initialize()` before
+    the first trace. Rotary/positions must already be global (the caller
+    applies them with this process's sequence offset, exactly as for
+    `dcn_ring_attention`)."""
+    from tpunet import distributed
+    from tpunet.interop import dcn_all_to_all
+
+    w = distributed.world_size()
+    if w == 1:
+        return attention_reference(q, k, v, causal)
+    b, s_local, h, d = q.shape
+    if h % w != 0:
+        raise ValueError(f"heads {h} not divisible by world size {w}")
+    hl = h // w
+
+    # One relay re-shards q, k, and v together — blocks (w, 3, b, sl, h/w, d),
+    # head-group j to rank j. Three separate ordered relays would serialize
+    # into 3*(W-1) latency-bound exchange rounds per layer; stacking moves
+    # the same bytes in W-1.
+    qkv = jnp.stack([q, k, v], axis=0)
+    blocks = qkv.reshape(3, b, s_local, w, hl, d).transpose(3, 0, 1, 2, 4, 5)
+    blocks = dcn_all_to_all(blocks)
+    # received block j = rank j's sequence chunk of MY head group; ranks
+    # hold contiguous chunks in rank order -> concat along seq.
+    full = blocks.transpose(1, 2, 0, 3, 4, 5).reshape(3, b, w * s_local, hl, d)
+    o = attention_reference(full[0], full[1], full[2], causal)
+
+    # inverse: split full seq into per-rank chunks, all-to-all, reassemble
+    # the original head order (block j = my sequence chunk of head-group j).
+    blocks = o.reshape(b, w, s_local, hl, d).transpose(1, 0, 2, 3, 4)
+    blocks = dcn_all_to_all(blocks)
+    return blocks.transpose(1, 2, 0, 3, 4).reshape(b, s_local, h, d)

@@ -69,15 +69,11 @@ def make_train_step(model, tx, cross_host: bool = False, donate: bool = True,
 
     def train_step(state: TrainState, images, labels, dropout_rng):
         def loss_fn(p):
-            if has_moe:
-                logits, mut = model.apply(
-                    {"params": p}, images, train=True,
-                    rngs={"dropout": dropout_rng}, mutable=["intermediates"],
-                )
-            else:
-                logits = model.apply(
-                    {"params": p}, images, train=True, rngs={"dropout": dropout_rng}
-                )
+            out = model.apply(
+                {"params": p}, images, train=True, rngs={"dropout": dropout_rng},
+                mutable=["intermediates"] if has_moe else False,
+            )
+            logits, mut = out if has_moe else (out, None)
             loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
             loss = loss.mean()
             if has_moe:
