@@ -67,7 +67,10 @@ def _build(args):
         tokens = jax.device_put(tokens, data_sh)
         labels = jax.device_put(labels, data_sh)
 
-    step = make_train_step(model, tx, cross_host=args.cross_host, donate=True)
+    # Passed through unguarded: make_train_step rejects bucket_bytes without
+    # cross_host, which is better than silently benchmarking the wrong path.
+    step = make_train_step(model, tx, cross_host=args.cross_host, donate=True,
+                           bucket_bytes=args.bucket_bytes)
     return state, step, tokens, labels, mesh
 
 
@@ -135,6 +138,9 @@ def _parse(argv):
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--batches-per-iter", type=int, default=3)
     ap.add_argument("--cross-host", action="store_true")
+    ap.add_argument("--bucket-bytes", type=int, default=None,
+                    help="multi-rank only: nonblocking bucketed gradient sync "
+                         "(overlaps DCN transfer with backward); bytes per bucket")
     return ap.parse_args(argv)
 
 

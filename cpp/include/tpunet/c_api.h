@@ -114,6 +114,15 @@ int32_t tpunet_comm_neighbor_exchange(uintptr_t comm, const void* sendbuf,
                                       uint64_t send_nbytes, void* recvbuf,
                                       uint64_t recv_nbytes, uint64_t* got);
 int32_t tpunet_comm_barrier(uintptr_t comm);
+/* Nonblocking AllReduce: enqueues on the comm's worker thread, returns a
+ * ticket immediately. Buffers must stay alive until ticket_wait returns.
+ * Jobs run in submission order; tickets may be waited in any order; a
+ * blocking collective issued while tickets are outstanding fences first. */
+int32_t tpunet_comm_iall_reduce(uintptr_t comm, const void* sendbuf, void* recvbuf,
+                                uint64_t count, int32_t dtype, int32_t op,
+                                uint64_t* ticket);
+int32_t tpunet_comm_ticket_wait(uintptr_t comm, uint64_t ticket);
+int32_t tpunet_comm_ticket_test(uintptr_t comm, uint64_t ticket, uint8_t* done);
 
 /* ---- Telemetry ---------------------------------------------------------
  * Metrics counters are process-global and always on; spans/push are gated by

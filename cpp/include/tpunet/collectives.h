@@ -78,6 +78,27 @@ class Communicator {
                                   size_t recv_nbytes, size_t* got) = 0;
   virtual Status Barrier() = 0;
 
+  // -- Nonblocking collectives ---------------------------------------------
+  // The request-depth design the reference transport was built to serve
+  // (NCCL keeps <=8 requests in flight per comm, reference
+  // cc/nccl_types.h:50): IAllReduce enqueues the collective on the
+  // communicator's internal worker thread and returns a ticket immediately,
+  // so a trainer can overlap gradient-bucket reduction with backward
+  // compute. Jobs execute one at a time in submission order (every rank
+  // must submit the same collectives in the same order — MPI semantics);
+  // tickets may be waited in any order. The caller must keep sendbuf and
+  // recvbuf alive until WaitTicket returns. Blocking collectives issued
+  // while tickets are outstanding implicitly fence: they wait for the
+  // async queue to drain first, so mixing is well-defined.
+  virtual Status IAllReduce(const void* sendbuf, void* recvbuf, size_t count,
+                            DType dtype, RedOp op, uint64_t* ticket) = 0;
+  // Blocks until the ticket's collective completes; returns its Status.
+  // A ticket can be waited exactly once; unknown tickets are errors.
+  virtual Status WaitTicket(uint64_t ticket) = 0;
+  // done=true iff the ticket's collective has completed (ticket stays
+  // waitable). Unknown/already-waited tickets are errors.
+  virtual Status TestTicket(uint64_t ticket, bool* done) = 0;
+
   virtual int rank() const = 0;
   virtual int world_size() const = 0;
 };

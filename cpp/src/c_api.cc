@@ -338,6 +338,37 @@ int32_t tpunet_comm_neighbor_exchange(uintptr_t comm, const void* sendbuf,
   return TPUNET_OK;
 }
 
+int32_t tpunet_comm_iall_reduce(uintptr_t comm, const void* sendbuf, void* recvbuf,
+                                uint64_t count, int32_t dtype, int32_t op,
+                                uint64_t* ticket) {
+  if (!ticket || (count > 0 && (!sendbuf || !recvbuf))) {
+    return Fail(TPUNET_ERR_NULL, "null param");
+  }
+  if (!ValidDType(dtype) || !ValidOp(op)) return Fail(TPUNET_ERR_INVALID, "bad dtype/op");
+  auto c = GetComm(comm);
+  if (!c) return Fail(TPUNET_ERR_INVALID, "unknown comm");
+  return FromStatus(c->IAllReduce(sendbuf, recvbuf, count,
+                                  static_cast<tpunet::DType>(dtype),
+                                  static_cast<tpunet::RedOp>(op), ticket));
+}
+
+int32_t tpunet_comm_ticket_wait(uintptr_t comm, uint64_t ticket) {
+  auto c = GetComm(comm);
+  if (!c) return Fail(TPUNET_ERR_INVALID, "unknown comm");
+  return FromStatus(c->WaitTicket(ticket));
+}
+
+int32_t tpunet_comm_ticket_test(uintptr_t comm, uint64_t ticket, uint8_t* done) {
+  if (!done) return Fail(TPUNET_ERR_NULL, "done is null");
+  auto c = GetComm(comm);
+  if (!c) return Fail(TPUNET_ERR_INVALID, "unknown comm");
+  bool d = false;
+  Status s = c->TestTicket(ticket, &d);
+  if (!s.ok()) return FromStatus(s);
+  *done = d ? 1 : 0;
+  return TPUNET_OK;
+}
+
 int32_t tpunet_comm_barrier(uintptr_t comm) {
   auto c = GetComm(comm);
   if (!c) return Fail(TPUNET_ERR_INVALID, "unknown comm");

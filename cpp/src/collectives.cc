@@ -18,7 +18,9 @@
 #include <algorithm>
 #include <chrono>
 #include <condition_variable>
+#include <deque>
 #include <functional>
+#include <map>
 #include <mutex>
 #include <thread>
 #include <vector>
@@ -259,6 +261,7 @@ class RingCommunicator : public Communicator {
   RingCommunicator(int rank, int world) : rank_(rank), world_(world) {}
 
   ~RingCommunicator() override {
+    StopAsyncWorker();
     if (net_) {
       if (send_comm_) net_->close_send(send_comm_);
       if (recv_comm_) net_->close_recv(recv_comm_);
@@ -312,6 +315,12 @@ class RingCommunicator : public Communicator {
 
   Status AllReduce(const void* sendbuf, void* recvbuf, size_t count, DType dtype,
                    RedOp op) override {
+    FenceAsync();
+    return DoAllReduce(sendbuf, recvbuf, count, dtype, op);
+  }
+
+  Status DoAllReduce(const void* sendbuf, void* recvbuf, size_t count, DType dtype,
+                     RedOp op) {
     size_t esize = DTypeSize(dtype);
     if (esize == 0) return Status::Invalid("bad dtype");
     if (count == 0) return Status::Ok();
@@ -347,6 +356,7 @@ class RingCommunicator : public Communicator {
 
   Status ReduceScatter(const void* sendbuf, void* recvbuf, size_t recv_count, DType dtype,
                        RedOp op) override {
+    FenceAsync();
     size_t esize = DTypeSize(dtype);
     if (esize == 0) return Status::Invalid("bad dtype");
     if (recv_count == 0) return Status::Ok();
@@ -374,6 +384,7 @@ class RingCommunicator : public Communicator {
   }
 
   Status AllGather(const void* sendbuf, void* recvbuf, size_t bytes_per_rank) override {
+    FenceAsync();
     const int W = world_;
     uint8_t* out = static_cast<uint8_t*>(recvbuf);
     if (out + rank_ * bytes_per_rank != sendbuf) {
@@ -391,6 +402,7 @@ class RingCommunicator : public Communicator {
   }
 
   Status Broadcast(void* buf, size_t nbytes, int root) override {
+    FenceAsync();
     const int W = world_;
     if (W == 1 || nbytes == 0) return Status::Ok();
     if (root < 0 || root >= W) return Status::Invalid("bad broadcast root");
@@ -428,6 +440,7 @@ class RingCommunicator : public Communicator {
   }
 
   Status AllToAll(const void* sendbuf, void* recvbuf, size_t bytes_per_rank) override {
+    FenceAsync();
     const int W = world_;
     const size_t B = bytes_per_rank;
     const uint8_t* in = static_cast<const uint8_t*>(sendbuf);
@@ -463,6 +476,7 @@ class RingCommunicator : public Communicator {
 
   Status NeighborExchange(const void* sendbuf, size_t send_nbytes, void* recvbuf,
                           size_t recv_nbytes, size_t* got) override {
+    FenceAsync();
     if (world_ == 1) {
       if (send_nbytes > recv_nbytes) return Status::Invalid("recv buffer too small");
       memcpy(recvbuf, sendbuf, send_nbytes);
@@ -476,7 +490,50 @@ class RingCommunicator : public Communicator {
     if (world_ == 1) return Status::Ok();
     barrier_scratch_.resize(world_);
     uint8_t token = 1;
-    return AllGather(&token, barrier_scratch_.data(), 1);
+    return AllGather(&token, barrier_scratch_.data(), 1);  // fences via AllGather
+  }
+
+  Status IAllReduce(const void* sendbuf, void* recvbuf, size_t count, DType dtype,
+                    RedOp op, uint64_t* ticket) override {
+    std::unique_lock<std::mutex> lk(async_mu_);
+    if (!worker_started_) {
+      worker_started_ = true;
+      worker_ = std::thread([this] { AsyncWorkerLoop(); });
+    }
+    uint64_t t = next_ticket_++;
+    queue_.emplace_back(t, [this, sendbuf, recvbuf, count, dtype, op] {
+      return DoAllReduce(sendbuf, recvbuf, count, dtype, op);
+    });
+    *ticket = t;
+    work_cv_.notify_one();
+    return Status::Ok();
+  }
+
+  Status WaitTicket(uint64_t ticket) override {
+    std::unique_lock<std::mutex> lk(async_mu_);
+    if (!TicketLive(ticket)) return Status::Invalid("unknown or already-waited ticket");
+    // Also wake if the ticket stops being live without completing (shutdown
+    // dropped it, or a racing waiter claimed it) — never sleep forever.
+    done_cv_.wait(lk, [&] { return done_.count(ticket) != 0 || !TicketLive(ticket); });
+    auto it = done_.find(ticket);
+    if (it == done_.end()) {
+      return Status::Invalid("ticket abandoned (shutdown or waited elsewhere)");
+    }
+    Status s = it->second;
+    done_.erase(it);
+    return s;
+  }
+
+  Status TestTicket(uint64_t ticket, bool* done) override {
+    std::unique_lock<std::mutex> lk(async_mu_);
+    auto it = done_.find(ticket);
+    if (it != done_.end()) {
+      *done = true;
+      return Status::Ok();
+    }
+    if (!TicketLive(ticket)) return Status::Invalid("unknown or already-waited ticket");
+    *done = false;
+    return Status::Ok();
   }
 
   int rank() const override { return rank_; }
@@ -610,6 +667,63 @@ class RingCommunicator : public Communicator {
     return primary;
   }
 
+  // -- async worker machinery ---------------------------------------------
+
+  // Caller holds async_mu_. A ticket is live (waitable) if it is queued,
+  // currently executing, or completed-but-unclaimed.
+  bool TicketLive(uint64_t ticket) {
+    if (done_.count(ticket)) return true;
+    if (running_ticket_ == ticket) return true;
+    for (const auto& job : queue_) {
+      if (job.first == ticket) return true;
+    }
+    return false;
+  }
+
+  void AsyncWorkerLoop() {
+    std::unique_lock<std::mutex> lk(async_mu_);
+    while (true) {
+      work_cv_.wait(lk, [&] { return stop_ || !queue_.empty(); });
+      if (stop_) return;
+      auto job = std::move(queue_.front());
+      queue_.pop_front();
+      running_ticket_ = job.first;
+      lk.unlock();
+      Status s = job.second();  // the ring collective, off the caller thread
+      lk.lock();
+      running_ticket_ = 0;
+      done_[job.first] = s;
+      done_cv_.notify_all();  // wakes WaitTicket and FenceAsync
+    }
+  }
+
+  // Blocking collectives fence behind outstanding async work so the two
+  // kinds never interleave on the underlying comms.
+  void FenceAsync() {
+    std::unique_lock<std::mutex> lk(async_mu_);
+    if (!worker_started_) return;
+    done_cv_.wait(lk, [&] { return queue_.empty() && running_ticket_ == 0; });
+  }
+
+  void StopAsyncWorker() {
+    {
+      std::unique_lock<std::mutex> lk(async_mu_);
+      if (!worker_started_) return;
+      // Destroying with queued work is a caller error (peers would be left
+      // mid-collective); the running job finishes, queued jobs fail their
+      // tickets so any blocked WaitTicket returns an error instead of
+      // sleeping forever.
+      stop_ = true;
+      for (auto& job : queue_) {
+        done_[job.first] = Status::Inner("communicator destroyed with pending collectives");
+      }
+      queue_.clear();
+      work_cv_.notify_all();
+      done_cv_.notify_all();
+    }
+    worker_.join();
+  }
+
   Status WaitRequest(uint64_t req, size_t* nbytes) {
     // Blocking condvar wait — a test() poll loop here competes with the
     // stream worker threads for CPU (catastrophic on few-core hosts).
@@ -629,6 +743,18 @@ class RingCommunicator : public Communicator {
   std::vector<uint8_t> work_;
   std::vector<uint8_t> barrier_scratch_;
   std::vector<uint8_t> a2a_fwd_, a2a_rcv_;
+  // Async (nonblocking-collective) state; async_mu_ guards all of it. The
+  // worker thread is the only place async jobs touch the comms/scratch, and
+  // FenceAsync keeps the sync paths out while it runs.
+  std::mutex async_mu_;
+  std::condition_variable work_cv_, done_cv_;
+  std::deque<std::pair<uint64_t, std::function<Status()>>> queue_;
+  std::map<uint64_t, Status> done_;
+  uint64_t next_ticket_ = 1;
+  uint64_t running_ticket_ = 0;
+  bool worker_started_ = false;
+  bool stop_ = false;
+  std::thread worker_;
 };
 
 }  // namespace

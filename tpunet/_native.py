@@ -156,6 +156,14 @@ def load() -> ctypes.CDLL:
     lib.tpunet_comm_neighbor_exchange.restype = i32
     lib.tpunet_comm_barrier.argtypes = [u]
     lib.tpunet_comm_barrier.restype = i32
+    lib.tpunet_comm_iall_reduce.argtypes = [
+        u, ctypes.c_void_p, ctypes.c_void_p, u64, i32, i32, P(u64),
+    ]
+    lib.tpunet_comm_iall_reduce.restype = i32
+    lib.tpunet_comm_ticket_wait.argtypes = [u, u64]
+    lib.tpunet_comm_ticket_wait.restype = i32
+    lib.tpunet_comm_ticket_test.argtypes = [u, u64, P(ctypes.c_uint8)]
+    lib.tpunet_comm_ticket_test.restype = i32
 
     lib.tpunet_c_metrics_text.argtypes = [ctypes.c_char_p, u64]
     lib.tpunet_c_metrics_text.restype = i32

@@ -50,6 +50,41 @@ def _c_contig(arr: np.ndarray) -> np.ndarray:
     return arr if arr.flags.c_contiguous else np.ascontiguousarray(arr)
 
 
+class AsyncResult:
+    """Handle for a nonblocking collective. Pins the send/recv buffers until
+    `wait()` — the native layer reads/writes them from its worker thread."""
+
+    def __init__(self, comm: "Communicator", ticket: int, send: np.ndarray,
+                 out: np.ndarray):
+        self._comm = comm
+        self._ticket = ticket
+        self._send = send  # keep alive until wait
+        self._out: np.ndarray | None = out
+
+    def test(self) -> bool:
+        """True iff the collective has completed (non-blocking)."""
+        if self._send is None:  # already waited: the native ticket is gone
+            return True
+        done = ctypes.c_uint8(0)
+        _native.check(
+            self._comm._lib.tpunet_comm_ticket_test(
+                self._comm._id, self._ticket, ctypes.byref(done)
+            ),
+            "ticket_test",
+        )
+        return bool(done.value)
+
+    def wait(self) -> np.ndarray:
+        """Block until complete; returns the result array. Idempotent."""
+        if self._send is not None:
+            _native.check(
+                self._comm._lib.tpunet_comm_ticket_wait(self._comm._id, self._ticket),
+                "ticket_wait",
+            )
+            self._send = None
+        return self._out
+
+
 class Communicator:
     """Ring communicator; rank/world/coordinator default from env
     (TPUNET_RANK/RANK, TPUNET_WORLD_SIZE/WORLD_SIZE, TPUNET_COORDINATOR)."""
@@ -105,6 +140,29 @@ class Communicator:
             "all_reduce",
         )
         return out
+
+    def iall_reduce(self, arr: Any, op: str = "sum") -> AsyncResult:
+        """Nonblocking AllReduce: returns immediately with an AsyncResult;
+        the reduction runs on the communicator's worker thread (submission
+        order across ranks must match). `result.wait()` yields the reduced
+        array — this is how a trainer overlaps gradient-bucket sync with
+        backward compute."""
+        arr = _c_contig(np.asarray(arr))
+        out = np.empty_like(arr)
+        ticket = ctypes.c_uint64(0)
+        _native.check(
+            self._lib.tpunet_comm_iall_reduce(
+                self._id,
+                arr.ctypes.data if arr.size else None,
+                out.ctypes.data if out.size else None,
+                arr.size,
+                _dtype_code(arr.dtype),
+                _OPS[op],
+                ctypes.byref(ticket),
+            ),
+            "iall_reduce",
+        )
+        return AsyncResult(self, ticket.value, arr, out)
 
     def reduce_scatter(self, arr: Any, op: str = "sum") -> np.ndarray:
         """arr: leading axis divisible by world_size; returns this rank's

@@ -72,6 +72,57 @@ def dcn_pmean(x):
     return dcn_all_reduce(x, "sum") / jnp.asarray(w, dtype=jnp.result_type(x))
 
 
+# -- nonblocking all-reduce (gradient-bucket overlap) -----------------------
+
+# Outstanding AsyncResults keyed by native ticket. The start callback pins
+# the buffers here; the finish callback releases them. max_in_flight is the
+# observable proof that buckets actually overlapped (tests assert on it).
+_async_pending: dict[int, Any] = {}
+_async_stats = {"in_flight": 0, "max_in_flight": 0}
+
+
+def dcn_async_stats() -> dict[str, int]:
+    """Snapshot of nonblocking-collective depth (host-side, for tests/bench)."""
+    return dict(_async_stats)
+
+
+def dcn_async_stats_reset() -> None:
+    _async_stats["in_flight"] = 0
+    _async_stats["max_in_flight"] = 0
+
+
+def dcn_all_reduce_start(x, op: str = "sum"):
+    """Begin a nonblocking AllReduce of `x`; returns a ticket (int64 scalar)
+    to pass to `dcn_all_reduce_finish`. The reduction runs on the native
+    worker thread, overlapping whatever compute XLA schedules between the
+    start and finish callbacks — the bucketed-gradient-overlap primitive."""
+
+    def cb(a):
+        res = _comm().iall_reduce(np.asarray(a), op)
+        # uint32 keeps the ticket jax-representable without x64; native
+        # tickets are sequential from 1 so wraparound is out of reach.
+        _async_pending[res._ticket & 0xFFFFFFFF] = res
+        _async_stats["in_flight"] += 1
+        _async_stats["max_in_flight"] = max(
+            _async_stats["max_in_flight"], _async_stats["in_flight"]
+        )
+        return np.uint32(res._ticket & 0xFFFFFFFF)
+
+    return io_callback(cb, jax.ShapeDtypeStruct((), jnp.uint32), x, ordered=True)
+
+
+def dcn_all_reduce_finish(ticket, like):
+    """Complete the nonblocking AllReduce for `ticket`; returns the reduced
+    array (shape/dtype of `like`, the array passed to the start call)."""
+
+    def cb(t):
+        res = _async_pending.pop(int(t))
+        _async_stats["in_flight"] -= 1
+        return res.wait()
+
+    return io_callback(cb, _callback_result_spec(like), ticket, ordered=True)
+
+
 # -- other collectives ------------------------------------------------------
 
 

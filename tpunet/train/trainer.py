@@ -15,6 +15,7 @@ Design (TPU-first):
 
 from __future__ import annotations
 
+import re
 from typing import Any, NamedTuple
 
 import jax
@@ -37,9 +38,81 @@ def create_train_state(model, rng, sample_input, tx) -> tuple[TrainState, Any]:
     return TrainState(params, opt_state, jnp.zeros((), jnp.int32)), model.apply
 
 
+def _backward_order_key(path_str: str):
+    """Sort key approximating backward completion order: output-side layers
+    (lm_head, final norm) first, transformer blocks in descending index,
+    embeddings last. A scheduling HINT only — each bucket's start callback
+    fires once its gradients exist, so matching the backward order maximizes
+    compute/transfer overlap, but correctness never depends on it."""
+    m = re.search(r"block(\d+)", path_str)
+    if m:
+        return (1, -int(m.group(1)), path_str)
+    if "embed" in path_str:
+        return (2, 0, path_str)
+    return (0, 0, path_str)
+
+
+def _bucketed_dcn_pmean(grads, bucket_bytes: int, compression: str | None, world: int):
+    """Mean-all-reduce the gradient pytree over DCN in byte-bounded buckets,
+    nonblocking: every bucket's reduction is SUBMITTED (dcn_all_reduce_start)
+    before any is WAITED (dcn_all_reduce_finish), so the native worker thread
+    reduces bucket k while XLA still computes the gradients feeding bucket
+    k+1 — the overlap that produced the reference's end-to-end VGG16 win
+    (reference README.md:52-84; request depth per cc/nccl_types.h:50)."""
+    from tpunet.interop import dcn_all_reduce_finish, dcn_all_reduce_start
+
+    leaves_with_path = jax.tree_util.tree_leaves_with_path(grads)
+    treedef = jax.tree_util.tree_structure(grads)
+    order = sorted(
+        range(len(leaves_with_path)),
+        key=lambda i: _backward_order_key(jax.tree_util.keystr(leaves_with_path[i][0])),
+    )
+
+    # Greedy byte-bounded buckets in backward order; same-dtype within a
+    # bucket (they concatenate into one flat vector).
+    buckets: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    for i in order:
+        leaf = leaves_with_path[i][1]
+        nb = leaf.size * leaf.dtype.itemsize
+        if cur and (
+            cur_bytes + nb > bucket_bytes
+            or leaf.dtype != leaves_with_path[cur[0]][1].dtype
+        ):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nb
+    if cur:
+        buckets.append(cur)
+
+    # Phase 1: submit every bucket. Phase 2: collect. The ordered-callback
+    # token chain keeps submission order identical on all ranks.
+    flats, tickets = [], []
+    for b in buckets:
+        flat = jnp.concatenate([leaves_with_path[i][1].reshape(-1) for i in b])
+        if compression == "bf16":
+            flat = flat.astype(jnp.bfloat16)
+        tickets.append(dcn_all_reduce_start(flat))
+        flats.append(flat)
+
+    new_leaves: list[Any] = [None] * len(leaves_with_path)
+    for b, flat, ticket in zip(buckets, flats, tickets):
+        reduced = dcn_all_reduce_finish(ticket, flat)
+        off = 0
+        for i in b:
+            leaf = leaves_with_path[i][1]
+            seg = reduced[off : off + leaf.size].astype(leaf.dtype)
+            new_leaves[i] = seg.reshape(leaf.shape) / world
+            off += leaf.size
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
 def make_train_step(model, tx, cross_host: bool = False, donate: bool = True,
                     grad_compression: str | None = None,
-                    moe_aux_weight: float = 0.01):
+                    moe_aux_weight: float = 0.01,
+                    bucket_bytes: int | None = None):
     """Build the jitted train step.
 
     cross_host=True adds the DCN gradient all-reduce tier (requires
@@ -56,16 +129,23 @@ def make_train_step(model, tx, cross_host: bool = False, donate: bool = True,
     sown load-balancing losses are collected via mutable=['intermediates']
     and added to the loss scaled by ``moe_aux_weight`` — without this term
     the router can collapse onto one expert and capacity-drop most tokens.
+
+    bucket_bytes (cross_host only): sync gradients in byte-bounded buckets
+    via NONBLOCKING all-reduces instead of one flat blocking vector, so DCN
+    transfer overlaps backward compute (see _bucketed_dcn_pmean). None keeps
+    the single-vector path.
     """
     if grad_compression not in (None, "bf16"):
         raise ValueError(f"unknown grad_compression {grad_compression!r}")
+    if bucket_bytes is not None and not cross_host:
+        raise ValueError("bucket_bytes requires cross_host=True")
     has_moe = getattr(model, "n_experts", 0) > 0
     if cross_host:
         # Import here so single-host training never touches the transport.
         from tpunet import distributed
         from tpunet.interop import dcn_pmean
 
-        distributed.world_size()  # raises early if initialize() was skipped
+        world = distributed.world_size()  # raises early if initialize() was skipped
 
     def train_step(state: TrainState, images, labels, dropout_rng):
         def loss_fn(p):
@@ -98,12 +178,15 @@ def make_train_step(model, tx, cross_host: bool = False, donate: bool = True,
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
 
         if cross_host:
-            flat, unravel = ravel_pytree(grads)
-            if grad_compression == "bf16":
-                reduced = dcn_pmean(flat.astype(jnp.bfloat16)).astype(flat.dtype)
+            if bucket_bytes is not None:
+                grads = _bucketed_dcn_pmean(grads, bucket_bytes, grad_compression, world)
             else:
-                reduced = dcn_pmean(flat)
-            grads = unravel(reduced)
+                flat, unravel = ravel_pytree(grads)
+                if grad_compression == "bf16":
+                    reduced = dcn_pmean(flat.astype(jnp.bfloat16)).astype(flat.dtype)
+                else:
+                    reduced = dcn_pmean(flat)
+                grads = unravel(reduced)
 
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
