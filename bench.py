@@ -9,15 +9,22 @@ reference README.md:50).
 
 Prints ONE JSON line:
   {"metric": "allreduce_busbw_128MiB", "value": <GB/s multi-stream>,
-   "unit": "GB/s", "vs_baseline": <multi-stream busbw / single-stream busbw>}
+   "unit": "GB/s", "vs_baseline": <multi-stream busbw / single-stream busbw>,
+   "model_tier": {"platform": "tpu"|"cpu", "tokens_per_s": N, "mfu": N,
+                  "vgg_img_per_s": N, ...}}
 
 busbw follows the nccl-tests definition for AllReduce: 2*(W-1)/W * bytes / t.
+The model tier (benchmarks.tpu_headline) runs in a subprocess on the real
+TPU chip — probed first with a hard timeout because a down tunnel hangs
+jax.devices() forever — and falls back to a CPU smoke config flagged by
+"platform": "cpu".
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -78,6 +85,48 @@ def _run_config(nstreams: int) -> float:
     return busbw_factor * NBYTES / best / 1e9
 
 
+def _tpu_alive(timeout_s: int = 90) -> bool:
+    """True iff jax can enumerate the TPU without hanging (down tunnel =
+    infinite hang, so this MUST be probed in a killable subprocess)."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices()[0]; print(d.platform)"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        return p.returncode == 0 and p.stdout.strip() == "tpu"
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _model_tier() -> dict | None:
+    """Run benchmarks.tpu_headline on the chip (or CPU fallback)."""
+    attempts = []
+    if _tpu_alive():
+        attempts.append(("tpu", 1200))
+    else:
+        print("[bench] TPU tunnel down; model tier falls back to CPU smoke",
+              file=sys.stderr)
+    attempts.append(("cpu", 900))
+    for platform, timeout_s in attempts:
+        try:
+            p = subprocess.run(
+                [sys.executable, "-m", "benchmarks.tpu_headline",
+                 "--platform", platform],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+        except subprocess.TimeoutExpired:
+            continue
+        if p.returncode == 0 and p.stdout.strip():
+            try:
+                return json.loads(p.stdout.strip().splitlines()[-1])
+            except json.JSONDecodeError:
+                pass
+        print(f"[bench] model tier ({platform}) failed: {p.stderr[-500:]}",
+              file=sys.stderr)
+    return None
+
+
 def main() -> None:
     # Make sure the native library exists before timing anything.
     from tpunet import _native
@@ -92,6 +141,9 @@ def main() -> None:
         f"({multi / baseline:.2f}x)",
         file=sys.stderr,
     )
+    model_tier = _model_tier()
+    if model_tier is not None:
+        print(f"[bench] model tier: {model_tier}", file=sys.stderr)
     print(
         json.dumps(
             {
@@ -99,6 +151,7 @@ def main() -> None:
                 "value": round(multi, 3),
                 "unit": "GB/s",
                 "vs_baseline": round(multi / baseline, 3),
+                "model_tier": model_tier,
             }
         )
     )

@@ -1,0 +1,172 @@
+"""Single-chip model-tier headline: Transformer tokens/s + MFU, VGG16 img/s.
+
+The reference's end-to-end validation was a real-hardware model benchmark
+(reference README.md:52-84: VGG16 synthetic img/s on V100s); this module is
+that tier for the TPU build, run by bench.py on the real chip. MFU uses the
+analytic transformer FLOP count (6N per token for the matmuls + 12*L*S*d
+for attention scores/values, Chinchilla-appendix convention, embedding
+lookup excluded) against the chip's peak bf16 FLOP/s by device kind.
+
+Prints ONE JSON line:
+  {"platform": "tpu"|"cpu", "device_kind": str, "tokens_per_s": N,
+   "mfu": N|null, "vgg_img_per_s": N}
+
+CPU fallback (TPU tunnel down) uses a smaller config and mfu=null — the
+numbers are then smoke-level, flagged by platform="cpu".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import statistics
+import time
+
+# (device_kind substring, peak bf16 FLOP/s per chip). Checked most-specific
+# first. Public numbers: v4 275T, v5e 197T, v5p 459T, v6e 918T.
+PEAK_FLOPS = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12 / 2),  # per-chip kind reports a 2-core board on v2/v3
+    ("v2", 45e12 / 2),
+]
+
+
+def _peak_for(kind: str) -> float | None:
+    k = kind.lower()
+    for sub, peak in PEAK_FLOPS:
+        if sub in k:
+            return peak
+    return None
+
+
+def _time_steps(step_fn, state, args, warmup: int, iters: int):
+    import jax
+
+    loss = None
+    for _ in range(warmup):
+        state, loss = step_fn(state, *args)
+    if loss is not None:
+        jax.block_until_ready(loss)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        state, loss = step_fn(state, *args)
+        jax.block_until_ready(loss)
+        times.append(time.perf_counter() - t0)
+    if not math.isfinite(float(loss)):
+        raise RuntimeError("non-finite loss in headline bench")
+    return times
+
+
+def transformer_bench(on_tpu: bool) -> tuple[float, float | None]:
+    """Returns (tokens_per_s, mfu|None). Flash attention + bf16 on TPU."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tpunet.models import Transformer
+    from tpunet.train import create_train_state, make_train_step
+
+    if on_tpu:
+        cfg = dict(vocab=32000, d_model=512, n_layers=8, n_heads=8, d_ff=2048)
+        batch, seq = 8, 1024
+        dtype = jnp.bfloat16
+        attn = "flash"
+    else:  # smoke-size: one CPU core must finish in seconds
+        cfg = dict(vocab=512, d_model=64, n_layers=2, n_heads=4, d_ff=128)
+        batch, seq = 2, 128
+        dtype = jnp.float32
+        attn = "reference"
+
+    model = Transformer(compute_dtype=dtype, attn_impl=attn, **cfg)
+    tx = optax.adamw(3e-4)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg["vocab"], (batch, seq)), jnp.int32)
+    labels = jnp.roll(tokens, -1, axis=1)
+    state, _ = create_train_state(model, jax.random.PRNGKey(0), tokens, tx)
+    step = make_train_step(model, tx, donate=False)
+
+    times = _time_steps(step, state, (tokens, labels, jax.random.PRNGKey(1)),
+                        warmup=2, iters=5)
+    dt = statistics.median(times)
+    tokens_per_s = batch * seq / dt
+
+    # Analytic FLOPs: 6*N per token over the matmul params (embedding table
+    # excluded — a lookup, not a matmul; lm_head included) + attention
+    # 12*L*S*d_model per token (QK^T and PV, fwd+bwd).
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+    n_embed = cfg["vocab"] * cfg["d_model"]
+    n_matmul = n_params - n_embed
+    flops_per_token = 6 * n_matmul + 12 * cfg["n_layers"] * seq * cfg["d_model"]
+    flops_per_step = flops_per_token * batch * seq
+    kind = jax.devices()[0].device_kind
+    peak = _peak_for(kind) if on_tpu else None
+    mfu = (flops_per_step / dt / peak) if peak else None
+    return tokens_per_s, mfu
+
+
+def vgg_bench(on_tpu: bool) -> float:
+    """VGG16 synthetic img/s — the reference's own end-to-end workload."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tpunet.models import vgg16
+    from tpunet.train import create_train_state, make_train_step, synthetic_batch
+
+    if on_tpu:
+        model = vgg16(num_classes=1000)
+        batch, size = 64, 224
+    else:
+        from tpunet.models import VGG
+
+        model = VGG(cfg=(8, "M", 16, "M"), num_classes=16, hidden=64)
+        batch, size = 8, 32
+
+    tx = optax.sgd(1e-2, momentum=0.9)
+    rng = np.random.default_rng(0)
+    images, labels = synthetic_batch(rng, batch, size, 1000 if on_tpu else 16)
+    images, labels = jnp.asarray(images), jnp.asarray(labels)
+    state, _ = create_train_state(model, jax.random.PRNGKey(0), images, tx)
+    step = make_train_step(model, tx, donate=False)
+
+    times = _time_steps(step, state, (images, labels, jax.random.PRNGKey(1)),
+                        warmup=2, iters=5)
+    return batch / statistics.median(times)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--platform", choices=["tpu", "cpu"], required=True)
+    args = ap.parse_args(argv)
+
+    if args.platform == "cpu":
+        from benchmarks import reassert_jax_platform
+
+        reassert_jax_platform("cpu")
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if args.platform == "tpu" and not on_tpu:
+        raise SystemExit(f"requested tpu, got {dev.platform}")
+
+    tokens_per_s, mfu = transformer_bench(on_tpu)
+    img_per_s = vgg_bench(on_tpu)
+    print(json.dumps({
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "tokens_per_s": round(tokens_per_s, 1),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "vgg_img_per_s": round(img_per_s, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -56,6 +56,64 @@ def test_flash_grad_matches_reference(causal):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5)
 
 
+@pytest.mark.parametrize("bq,bk", [(32, 16), (16, 16), (64, 32)])
+def test_flash_grad_unequal_blocks(bq, bk):
+    # The dkv kernel's causal q-block lower bound must be right for every
+    # block_q/block_k ratio the fwd accepts (block_q % block_k == 0).
+    q, k, v = _qkv(jax.random.PRNGKey(5), 1, 64, 2, 8)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, bq, bk) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5)
+
+
+def test_flash_causal_cross_attention_falls_back():
+    # sq != sk under causal would run the kernel's k-loop out of bounds;
+    # must take the reference path and stay correct.
+    ks = jax.random.split(jax.random.PRNGKey(8), 3)
+    q = jax.random.normal(ks[0], (1, 128, 2, 8))
+    k = jax.random.normal(ks[1], (1, 64, 2, 8))
+    v = jax.random.normal(ks[2], (1, 64, 2, 8))
+    out = flash_attention(q, k, v, True, block_q=32, block_k=32)
+    ref = attention_reference(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_grad_ragged_fallback():
+    # 100 doesn't tile: the VJP must take the einsum fallback and still match.
+    q, k, v = _qkv(jax.random.PRNGKey(6), 1, 100, 1, 8)
+    gf = jax.grad(lambda q: jnp.sum(flash_attention(q, k, v, True, 32, 32) ** 2))(q)
+    gr = jax.grad(lambda q: jnp.sum(attention_reference(q, k, v, True) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gr), atol=5e-5, rtol=5e-5)
+
+
+def test_flash_grad_bf16_under_jit():
+    q, k, v = _qkv(jax.random.PRNGKey(7), 1, 64, 2, 16, jnp.bfloat16)
+
+    @jax.jit
+    def g(q, k, v):
+        return jax.grad(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, True, 32, 32).astype(jnp.float32) ** 2
+        ), argnums=(0, 1, 2))(q, k, v)
+
+    gf = g(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(
+        attention_reference(q, k, v, True).astype(jnp.float32) ** 2
+    ), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), atol=1e-1, rtol=1e-1
+        )
+
+
 def test_flash_under_jit():
     q, k, v = _qkv(jax.random.PRNGKey(4), 1, 64, 1, 16)
     f = jax.jit(lambda q, k, v: flash_attention(q, k, v, True, 32, 32))

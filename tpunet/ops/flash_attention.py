@@ -1,4 +1,4 @@
-"""Flash attention — Pallas TPU kernel with online softmax.
+"""Flash attention — Pallas TPU kernels with online softmax, fwd + bwd.
 
 The reference repo (bagua-net) is pure transport and has no kernels; this op
 exists because our framework's model layer (transformer family, long-context
@@ -13,10 +13,12 @@ Design notes (TPU-first):
   * causal masking prunes the k-loop upper bound per q-block (no wasted
     MXU work on fully-masked blocks); the diagonal block is masked
     elementwise.
-  * backward pass: recompute-based `custom_vjp` — the canonical flash
-    strategy (store only q/k/v and the output statistics are recomputed).
-    We recompute via the reference einsum path, whose VJP XLA fuses well;
-    a dedicated backward kernel is a later optimization.
+  * backward pass: FlashAttention-2 style blockwise kernels. The forward
+    additionally emits the per-row logsumexp; the backward recomputes
+    P = exp(S - lse) within blocks (O(S) memory, no stored score matrix)
+    in two kernels — dQ (grid over q-blocks) and dK/dV (grid over k-blocks,
+    causal lower bound prunes fully-masked q-blocks). Training keeps the
+    flash memory win instead of falling back to the O(S^2) einsum VJP.
   * `interpret` defaults to "auto": the Pallas interpreter on CPU (tests),
     compiled Mosaic on TPU.
 """
@@ -48,10 +50,10 @@ def attention_reference(q, k, v, causal: bool = False):
     return o.astype(dt)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
-                  seq_k: int, causal: bool, scale: float):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
+                  block_k: int, seq_k: int, causal: bool, scale: float):
     """One (batch*head, q-block) program. Refs: q (1, block_q, D),
-    k/v (1, seq_k, D), o (1, block_q, D)."""
+    k/v (1, seq_k, D), o (1, block_q, D), lse (1, block_q)."""
     qi = pl.program_id(1)
     q = q_ref[0, :, :].astype(jnp.float32) * scale
     head_dim = q.shape[-1]
@@ -71,9 +73,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
             preferred_element_type=jnp.float32,
         )  # (block_q, block_k)
         if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            kpos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+            s = _causal_mask(s, qi * block_q, j * block_k, block_q, block_k)
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -87,12 +87,118 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
     acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, _, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
+    acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
     o_ref[0, :, :] = (acc / l).astype(o_ref.dtype)
+    # Per-row logsumexp: the only softmax state the backward needs.
+    lse_ref[0, :] = m[:, 0] + jnp.log(l[:, 0])
+
+
+def _causal_mask(s, q_start, k_start, block_q, block_k):
+    qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return jnp.where(qpos >= kpos, s, NEG_INF)
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                     *, block_q: int, block_k: int, seq_k: int, causal: bool,
+                     scale: float):
+    """dQ, one (batch*head, q-block) program: streams k/v blockwise and
+    accumulates dq = sum_j dS_ij @ K_j with P recomputed from the lse."""
+    qi = pl.program_id(1)
+    q = q_ref[0, :, :].astype(jnp.float32)
+    do = do_ref[0, :, :].astype(jnp.float32)
+    lse = lse_ref[0, :][:, None]
+    delta = delta_ref[0, :][:, None]
+    head_dim = q.shape[-1]
+
+    if causal:
+        num_kb = pl.cdiv((qi + 1) * block_q, block_k)
+    else:
+        num_kb = seq_k // block_k
+
+    def body(j, dq):
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, kb, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            s = _causal_mask(s, qi * block_q, j * block_k, block_q, block_k)
+        p = jnp.exp(s - lse)  # masked entries underflow to exactly 0
+        dp = jax.lax.dot_general(
+            do, vb, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(
+            ds, kb, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jax.lax.fori_loop(0, num_kb, body, jnp.zeros((block_q, head_dim), jnp.float32))
+    dq_ref[0, :, :] = dq.astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, *, block_q: int, block_k: int,
+                      seq_q: int, causal: bool, scale: float):
+    """dK/dV, one (batch*head, k-block) program: streams q/do blockwise.
+    dv = sum_i P_ij^T @ dO_i; dk = sum_i dS_ij^T @ Q_i."""
+    kj = pl.program_id(1)
+    kb = k_ref[0, :, :].astype(jnp.float32)
+    vb = v_ref[0, :, :].astype(jnp.float32)
+    head_dim = kb.shape[-1]
+    num_qb = seq_q // block_q
+    # First q-block with any row attending into this k-block.
+    i_start = (kj * block_k) // block_q if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse_i = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        delta_i = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        s = scale * jax.lax.dot_general(
+            qb, kb, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            s = _causal_mask(s, i * block_q, kj * block_k, block_q, block_k)
+        p = jnp.exp(s - lse_i)
+        dv = dv + jax.lax.dot_general(
+            p, dob, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            dob, vb, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_i) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, qb, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    zeros = jnp.zeros((block_k, head_dim), jnp.float32)
+    dk, dv = jax.lax.fori_loop(i_start, num_qb, body, (zeros, zeros))
+    dk_ref[0, :, :] = dk.astype(dk_ref.dtype)
+    dv_ref[0, :, :] = dv.astype(dv_ref.dtype)
 
 
 def _auto_interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+def _flatten_heads(x):
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _unflatten_heads(xf, b, h):
+    bh, s, d = xf.shape
+    return xf.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -104,29 +210,34 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
     tile evenly (ragged tails are a later kernel feature, not a behavioral
     gap — results are identical either way).
     """
-    return _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    o, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return o
 
 
 def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
+    """Returns (o, lse) — lse is None when the einsum fallback was taken."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
-    if sq % block_q or sk % block_k or (causal and block_q % block_k):
-        return attention_reference(q, k, v, causal)
+    # Fallback cases: ragged tiling, mixed block ratio under causal, and
+    # causal cross-attention (sq != sk) — the kernels' causal k-loop bound
+    # assumes aligned q/k positions and would run past the k blocks.
+    if sq % block_q or sk % block_k or (causal and (block_q % block_k or sq != sk)):
+        return attention_reference(q, k, v, causal), None
     if interpret is None:
         interpret = _auto_interpret()
 
     # (B, S, H, D) -> (B*H, S, D): grid programs are independent per head.
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    qf = _flatten_heads(q)
+    kf = _flatten_heads(k)
+    vf = _flatten_heads(v)
 
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, seq_k=sk,
         causal=causal, scale=1.0 / math.sqrt(d),
     )
-    of = pl.pallas_call(
+    of, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q),
         in_specs=[
@@ -134,22 +245,95 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
             pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
         interpret=interpret,
     )(qf, kf, vf)
-    return of.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return _unflatten_heads(of, b, h), lse
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    o = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
-    return o, (q, k, v)
+    o, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: attention_reference(q, k, v, causal), q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    if lse is None:  # forward took the einsum fallback (ragged shapes)
+        _, vjp = jax.vjp(lambda q, k, v: attention_reference(q, k, v, causal), q, k, v)
+        return vjp(g)
+    if interpret is None:
+        interpret = _auto_interpret()
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    scale = 1.0 / math.sqrt(d)
+
+    qf, kf, vf = _flatten_heads(q), _flatten_heads(k), _flatten_heads(v)
+    of, dof = _flatten_heads(o), _flatten_heads(g)
+    # delta_i = rowsum(dO_i * O_i): the softmax-jacobian correction term,
+    # cheap elementwise work XLA fuses — no kernel needed.
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+
+    dq_kernel = functools.partial(
+        _flash_dq_kernel, block_q=block_q, block_k=block_k, seq_k=sk,
+        causal=causal, scale=scale,
+    )
+    dqf = pl.pallas_call(
+        dq_kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
+            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _flash_dkv_kernel, block_q=block_q, block_k=block_k, seq_q=sq,
+        causal=causal, scale=scale,
+    )
+    dkf, dvf = pl.pallas_call(
+        dkv_kernel,
+        grid=(b * h, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, sq, d), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, sq), lambda bh, j: (bh, 0)),
+            pl.BlockSpec((1, sq), lambda bh, j: (bh, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    return (
+        _unflatten_heads(dqf, b, h),
+        _unflatten_heads(dkf, b, h),
+        _unflatten_heads(dvf, b, h),
+    )
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
