@@ -1,0 +1,273 @@
+"""Fault paths: dead peers, connect retry, and error surfacing into JAX.
+
+The reference's failure model was 108 unwrap-panics and silent hangs
+(SURVEY §5, reference nthread:396-401); these tests pin the build's
+contract instead: a peer dying mid-collective produces a bounded, typed
+error on the survivors — including through the io_callback seam into a
+jitted program — and transient rendezvous failures retry with backoff.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from conftest import free_port  # noqa: E402
+
+
+def _victim(rank: int, world: int, port: int, q) -> None:
+    # Rank 1 starts an allreduce and is SIGKILLed by the parent mid-flight.
+    from tpunet.collectives import Communicator
+
+    comm = Communicator(f"127.0.0.1:{port}", rank, world)
+    comm.barrier()
+    q.put((rank, "ready"))
+    arr = np.ones((64 << 20) // 4, np.float32)  # 64 MiB: long enough to die in
+    while True:  # loop until killed
+        comm.all_reduce(arr)
+
+
+def _survivor(rank: int, world: int, port: int, q) -> None:
+    try:
+        from tpunet.collectives import Communicator
+
+        comm = Communicator(f"127.0.0.1:{port}", rank, world)
+        comm.barrier()
+        q.put((rank, "ready"))
+        arr = np.ones((64 << 20) // 4, np.float32)
+        t0 = time.perf_counter()
+        try:
+            while True:
+                comm.all_reduce(arr)
+                if time.perf_counter() - t0 > 120:
+                    q.put((rank, "FAIL: no error after peer death"))
+                    return
+        except RuntimeError as e:
+            dt = time.perf_counter() - t0
+            q.put((rank, f"OK error after {dt:.1f}s: {str(e)[:80]}"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def test_peer_death_mid_allreduce_errors_cleanly():
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = free_port()
+    surv = ctx.Process(target=_survivor, args=(0, 2, port, q))
+    vict = ctx.Process(target=_victim, args=(1, 2, port, q))
+    surv.start()
+    vict.start()
+    ready = {q.get(timeout=120)[0], q.get(timeout=120)[0]}
+    assert ready == {0, 1}
+    time.sleep(0.3)  # let an allreduce get going
+    vict.kill()  # SIGKILL: no goodbye, sockets RST on close
+    rank, status = q.get(timeout=120)
+    surv.join(timeout=30)
+    vict.join(timeout=30)
+    assert rank == 0 and status.startswith("OK error"), status
+
+
+def _jax_survivor(rank: int, world: int, port: int, q) -> None:
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+
+        from tpunet import distributed
+        from tpunet.interop import dcn_psum
+
+        distributed.initialize(f"127.0.0.1:{port}", rank, world)
+        fn = jax.jit(dcn_psum)
+        x = jnp.ones((16 << 20) // 4, jnp.float32)  # 16 MiB
+        np.asarray(fn(x))  # warm compile + one good sync
+        q.put((rank, "ready"))
+        t0 = time.perf_counter()
+        try:
+            while True:
+                np.asarray(fn(x))
+                if time.perf_counter() - t0 > 120:
+                    q.put((rank, "FAIL: no exception after peer death"))
+                    return
+        except Exception as e:  # noqa: BLE001 — XlaRuntimeError wraps ours
+            q.put((rank, f"OK raised {type(e).__name__} after "
+                         f"{time.perf_counter() - t0:.1f}s"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def _jax_victim(rank: int, world: int, port: int, q) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from tpunet import distributed
+    from tpunet.interop import dcn_psum
+
+    distributed.initialize(f"127.0.0.1:{port}", rank, world)
+    fn = jax.jit(dcn_psum)
+    x = jnp.ones((16 << 20) // 4, jnp.float32)
+    np.asarray(fn(x))
+    q.put((rank, "ready"))
+    while True:
+        np.asarray(fn(x))
+
+
+def test_peer_death_surfaces_as_jax_exception():
+    # The io_callback seam must turn the transport error into a Python
+    # exception out of the jitted program — not a wedge.
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = free_port()
+    surv = ctx.Process(target=_jax_survivor, args=(0, 2, port, q))
+    vict = ctx.Process(target=_jax_victim, args=(1, 2, port, q))
+    surv.start()
+    vict.start()
+    ready = set()
+    for _ in range(2):
+        ready.add(q.get(timeout=240)[0])
+    assert ready == {0, 1}
+    time.sleep(0.3)
+    vict.kill()
+    rank, status = q.get(timeout=240)
+    surv.join(timeout=30)
+    vict.join(timeout=30)
+    assert rank == 0 and status.startswith("OK raised"), status
+
+
+def _async_survivor(rank: int, world: int, port: int, q) -> None:
+    # Nonblocking tickets in flight when the peer dies: the first failing
+    # wait raises, the REST are dropped un-waited. The AsyncResult finalizer
+    # must quiesce them so process exit doesn't free buffers under the
+    # native worker thread (regression: exit-time SIGSEGV).
+    try:
+        from tpunet.collectives import Communicator
+
+        comm = Communicator(f"127.0.0.1:{port}", rank, world)
+        comm.barrier()
+        q.put((rank, "ready"))
+        arr = np.ones((32 << 20) // 4, np.float32)
+        t0 = time.perf_counter()
+        try:
+            while time.perf_counter() - t0 < 120:
+                rs = [comm.iall_reduce(arr) for _ in range(3)]
+                for r in rs:
+                    r.wait()
+            q.put((rank, "FAIL: no error after peer death"))
+        except RuntimeError:
+            q.put((rank, "OK errored"))  # unwaited rs members drop here
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def _async_victim(rank: int, world: int, port: int, q) -> None:
+    from tpunet.collectives import Communicator
+
+    comm = Communicator(f"127.0.0.1:{port}", rank, world)
+    comm.barrier()
+    q.put((rank, "ready"))
+    arr = np.ones((32 << 20) // 4, np.float32)
+    while True:
+        comm.all_reduce(arr)
+
+
+def test_peer_death_with_unwaited_async_tickets_exits_cleanly():
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = free_port()
+    surv = ctx.Process(target=_async_survivor, args=(0, 2, port, q))
+    vict = ctx.Process(target=_async_victim, args=(1, 2, port, q))
+    surv.start()
+    vict.start()
+    ready = {q.get(timeout=120)[0], q.get(timeout=120)[0]}
+    assert ready == {0, 1}
+    time.sleep(0.5)
+    vict.kill()
+    rank, status = q.get(timeout=120)
+    assert rank == 0 and status == "OK errored", status
+    surv.join(timeout=60)
+    vict.join(timeout=30)
+    # The regression: survivor used to die with SIGSEGV (-11) at exit.
+    assert surv.exitcode == 0, f"survivor exitcode {surv.exitcode}"
+
+
+def _ipv4_handle(port: int) -> bytes:
+    # sockaddr_in marshaled as the 64-byte wire handle: family (host order),
+    # BE port, 127.0.0.1.
+    return (struct.pack("=H", socket.AF_INET) + struct.pack("!H", port)
+            + socket.inet_aton("127.0.0.1")).ljust(64, b"\0")
+
+
+def test_connect_retries_until_listener_appears():
+    # Nothing listens at connect() time; a plain acceptor shows up ~1s
+    # later. The engine's backoff retry must bridge the gap.
+    from tpunet.transport import Net
+
+    port = free_port()
+    accepted = {}
+
+    def late_listener():
+        time.sleep(1.0)
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", port))
+        s.listen(16)
+        conns = []
+        s.settimeout(20)
+        try:
+            while True:
+                c, _ = s.accept()
+                conns.append(c)
+                accepted["n"] = len(conns)
+        except TimeoutError:
+            pass
+        finally:
+            for c in conns:
+                c.close()
+            s.close()
+
+    th = threading.Thread(target=late_listener, daemon=True)
+    th.start()
+    os.environ["TPUNET_CONNECT_RETRY_MS"] = "15000"
+    try:
+        with Net() as net:
+            t0 = time.perf_counter()
+            sc = net.connect(_ipv4_handle(port))
+            dt = time.perf_counter() - t0
+            assert dt >= 0.8, f"connected before the listener existed? {dt}"
+            sc.close()
+    finally:
+        os.environ.pop("TPUNET_CONNECT_RETRY_MS", None)
+    assert accepted.get("n", 0) >= 1
+
+
+def test_connect_fails_cleanly_when_nothing_ever_listens():
+    from tpunet.transport import Net
+
+    os.environ["TPUNET_CONNECT_RETRY_MS"] = "1000"
+    try:
+        with Net() as net:
+            t0 = time.perf_counter()
+            with pytest.raises(RuntimeError, match="connect"):
+                net.connect(_ipv4_handle(free_port()))
+            assert time.perf_counter() - t0 < 10
+    finally:
+        os.environ.pop("TPUNET_CONNECT_RETRY_MS", None)

@@ -77,12 +77,32 @@ class AsyncResult:
     def wait(self) -> np.ndarray:
         """Block until complete; returns the result array. Idempotent."""
         if self._send is not None:
-            _native.check(
-                self._comm._lib.tpunet_comm_ticket_wait(self._comm._id, self._ticket),
-                "ticket_wait",
-            )
-            self._send = None
+            try:
+                _native.check(
+                    self._comm._lib.tpunet_comm_ticket_wait(self._comm._id, self._ticket),
+                    "ticket_wait",
+                )
+            finally:
+                # Error or not, a returned WaitTicket means the native job
+                # reached completion (or was dropped unstarted) — the worker
+                # thread no longer touches the buffers, so release the pins.
+                self._send = None
         return self._out
+
+    def __del__(self):
+        # Dropping an un-waited result must NOT free the buffers while the
+        # native worker thread may still be reducing into them (observed:
+        # exit-time SIGSEGV when a peer died with queued tickets). Quiesce
+        # first; after a comm error the remaining jobs fail fast, so this
+        # wait is bounded. Raw call, no check: errors here are expected
+        # (failed jobs, already-destroyed comm) and __del__ must not raise.
+        send = getattr(self, "_send", None)
+        if send is not None:
+            try:
+                self._comm._lib.tpunet_comm_ticket_wait(self._comm._id, self._ticket)
+            except Exception:  # noqa: BLE001 — interpreter teardown
+                pass
+            self._send = None
 
 
 class Communicator:

@@ -63,6 +63,9 @@ class Config:
     keepalive_idle_s: int = 30
     keepalive_intvl_s: int = 10
     keepalive_cnt: int = 3
+    # Transient connect failures retry with exponential backoff inside this
+    # window (ms; 0 = fail fast). Covers a peer restarting its listener.
+    connect_retry_ms: int = 10_000
 
     @staticmethod
     def from_env() -> "Config":
@@ -89,4 +92,5 @@ class Config:
             keepalive_idle_s=_env_int("TPUNET_KEEPALIVE_IDLE_S", 30),
             keepalive_intvl_s=_env_int("TPUNET_KEEPALIVE_INTVL_S", 10),
             keepalive_cnt=_env_int("TPUNET_KEEPALIVE_CNT", 3),
+            connect_retry_ms=_env_int("TPUNET_CONNECT_RETRY_MS", 10_000),
         )
