@@ -34,26 +34,38 @@ from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
 
+# Mosaic requires the last two dims of every block to be divisible by the
+# (8, 128) f32 tile (or to equal the full array dims). A natural (b*h, sq)
+# logsumexp with (1, block_q) blocks violates the sublane rule — the round-2
+# on-chip failure. We instead carry lse/delta as (b*h, LSE_SUBLANES, sq) with
+# the value broadcast across LSE_SUBLANES=8 sublanes: blocks are then
+# (1, 8, block_q) = exactly one legal tile, at 8x memory for a tiny array
+# (vs. the 128x lane-broadcast layout jax's reference kernel uses).
+LSE_SUBLANES = 8
+
 
 def attention_reference(q, k, v, causal: bool = False):
     """Plain softmax attention, f32 internally. Shapes (B, S, H, D)."""
     dt = q.dtype
     scale = 1.0 / math.sqrt(q.shape[-1])
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale, k.astype(jnp.float32))
+    prec = _dot_precision(dt)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32), precision=prec)
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
         qpos = jnp.arange(sq)[:, None]
         kpos = jnp.arange(sk)[None, :]
         s = jnp.where(qpos >= kpos, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32), precision=prec)
     return o.astype(dt)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
-                  block_k: int, seq_k: int, causal: bool, scale: float):
+                  block_k: int, seq_k: int, causal: bool, scale: float,
+                  precision):
     """One (batch*head, q-block) program. Refs: q (1, block_q, D),
-    k/v (1, seq_k, D), o (1, block_q, D), lse (1, block_q)."""
+    k/v (1, seq_k, D), o (1, block_q, D), lse (1, LSE_SUBLANES, block_q)."""
     qi = pl.program_id(1)
     q = q_ref[0, :, :].astype(jnp.float32) * scale
     head_dim = q.shape[-1]
@@ -70,7 +82,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
         vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, kb, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=precision,
         )  # (block_q, block_k)
         if causal:
             s = _causal_mask(s, qi * block_q, j * block_k, block_q, block_k)
@@ -80,7 +92,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
         l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
         acc_new = acc * alpha + jax.lax.dot_general(
             p, vb, dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=precision,
         )
         return acc_new, m_new, l_new
 
@@ -90,7 +102,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
     acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
     o_ref[0, :, :] = (acc / l).astype(o_ref.dtype)
     # Per-row logsumexp: the only softmax state the backward needs.
-    lse_ref[0, :] = m[:, 0] + jnp.log(l[:, 0])
+    lse_row = m[:, 0] + jnp.log(l[:, 0])  # (block_q,)
+    lse_ref[0, :, :] = jnp.broadcast_to(lse_row[None, :], (LSE_SUBLANES, block_q))
 
 
 def _causal_mask(s, q_start, k_start, block_q, block_k):
@@ -101,14 +114,14 @@ def _causal_mask(s, q_start, k_start, block_q, block_k):
 
 def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                      *, block_q: int, block_k: int, seq_k: int, causal: bool,
-                     scale: float):
+                     scale: float, precision):
     """dQ, one (batch*head, q-block) program: streams k/v blockwise and
     accumulates dq = sum_j dS_ij @ K_j with P recomputed from the lse."""
     qi = pl.program_id(1)
     q = q_ref[0, :, :].astype(jnp.float32)
     do = do_ref[0, :, :].astype(jnp.float32)
-    lse = lse_ref[0, :][:, None]
-    delta = delta_ref[0, :][:, None]
+    lse = lse_ref[0, 0, :][:, None]
+    delta = delta_ref[0, 0, :][:, None]
     head_dim = q.shape[-1]
 
     if causal:
@@ -121,19 +134,19 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = scale * jax.lax.dot_general(
             q, kb, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=precision,
         )
         if causal:
             s = _causal_mask(s, qi * block_q, j * block_k, block_q, block_k)
         p = jnp.exp(s - lse)  # masked entries underflow to exactly 0
         dp = jax.lax.dot_general(
             do, vb, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=precision,
         )
         ds = p * (dp - delta) * scale
         return dq + jax.lax.dot_general(
             ds, kb, dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=precision,
         )
 
     dq = jax.lax.fori_loop(0, num_kb, body, jnp.zeros((block_q, head_dim), jnp.float32))
@@ -142,7 +155,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       dk_ref, dv_ref, *, block_q: int, block_k: int,
-                      seq_q: int, causal: bool, scale: float):
+                      seq_q: int, causal: bool, scale: float, precision):
     """dK/dV, one (batch*head, k-block) program: streams q/do blockwise.
     dv = sum_i P_ij^T @ dO_i; dk = sum_i dS_ij^T @ Q_i."""
     kj = pl.program_id(1)
@@ -157,27 +170,27 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse_i = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
-        delta_i = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        lse_i = lse_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+        delta_i = delta_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
         s = scale * jax.lax.dot_general(
             qb, kb, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=precision,
         )
         if causal:
             s = _causal_mask(s, i * block_q, kj * block_k, block_q, block_k)
         p = jnp.exp(s - lse_i)
         dv = dv + jax.lax.dot_general(
             p, dob, dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=precision,
         )
         dp = jax.lax.dot_general(
             dob, vb, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=precision,
         )
         ds = p * (dp - delta_i) * scale
         dk = dk + jax.lax.dot_general(
             ds, qb, dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=precision,
         )
         return dk, dv
 
@@ -189,6 +202,38 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _auto_interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+def _dot_precision(dtype):
+    """MXU passes are bf16: f32 inputs need HIGHEST (multi-pass) to keep f32
+    accuracy vs the XLA reference; bf16 inputs carry no extra bits to keep."""
+    if dtype == jnp.float32:
+        return jax.lax.Precision.HIGHEST
+    return jax.lax.Precision.DEFAULT
+
+
+def _normalize_blocks(sq, sk, block_q, block_k, interpret, dtype):
+    """Clamp block sizes to Mosaic-legal values for compiled mode.
+
+    The lse/delta blocks put block_q on the LANE dim, so compiled kernels
+    need block_q % 128 == 0 or block_q == sq. block_k sits on the k/v
+    SUBLANE dim, whose min tile depends on dtype (8 f32 / 16 bf16 / 32
+    int8 — i.e. 32 bytes), so block_k must be a multiple of that or equal
+    sk. A block equal to the full array dim is always legal, so full-dim
+    blocks are the universal repair (at higher VMEM cost — only taken for
+    odd shapes). Interpret mode has no such constraints — tests
+    deliberately use tiny blocks there.
+    """
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if interpret:
+        return block_q, block_k
+    min_sublane = 32 // jnp.dtype(dtype).itemsize
+    if block_q % 128 and block_q != sq:
+        block_q = 128 if sq % 128 == 0 else sq
+    if block_k % min_sublane and block_k != sk:
+        block_k = 128 if sk % 128 == 0 else sk
+    return block_q, block_k
 
 
 def _flatten_heads(x):
@@ -218,15 +263,15 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
     """Returns (o, lse) — lse is None when the einsum fallback was taken."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    if interpret is None:
+        interpret = _auto_interpret()
+    block_q, block_k = _normalize_blocks(sq, sk, block_q, block_k, interpret, q.dtype)
     # Fallback cases: ragged tiling, mixed block ratio under causal, and
     # causal cross-attention (sq != sk) — the kernels' causal k-loop bound
     # assumes aligned q/k positions and would run past the k blocks.
-    if sq % block_q or sk % block_k or (causal and (block_q % block_k or sq != sk)):
+    if (sq % block_q or sk % block_k
+            or (causal and (block_q % block_k or sq != sk))):
         return attention_reference(q, k, v, causal), None
-    if interpret is None:
-        interpret = _auto_interpret()
 
     # (B, S, H, D) -> (B*H, S, D): grid programs are independent per head.
     qf = _flatten_heads(q)
@@ -235,7 +280,7 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, seq_k=sk,
-        causal=causal, scale=1.0 / math.sqrt(d),
+        causal=causal, scale=1.0 / math.sqrt(d), precision=_dot_precision(q.dtype),
     )
     of, lse = pl.pallas_call(
         kernel,
@@ -247,11 +292,11 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
+            pl.BlockSpec((1, LSE_SUBLANES, block_q), lambda bh, i: (bh, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, LSE_SUBLANES, sq), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)
@@ -273,19 +318,22 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
 
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    # Same normalization as the forward: the forward only saved an lse (vs
+    # taking the fallback) for shapes where this yields a legal tiling.
+    block_q, block_k = _normalize_blocks(sq, sk, block_q, block_k, interpret, q.dtype)
     scale = 1.0 / math.sqrt(d)
 
     qf, kf, vf = _flatten_heads(q), _flatten_heads(k), _flatten_heads(v)
     of, dof = _flatten_heads(o), _flatten_heads(g)
     # delta_i = rowsum(dO_i * O_i): the softmax-jacobian correction term,
-    # cheap elementwise work XLA fuses — no kernel needed.
+    # cheap elementwise work XLA fuses — no kernel needed. Broadcast into the
+    # same sublane-replicated layout the kernels require for lse.
     delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[:, None, :], (b * h, LSE_SUBLANES, sq))
 
     dq_kernel = functools.partial(
         _flash_dq_kernel, block_q=block_q, block_k=block_k, seq_k=sk,
-        causal=causal, scale=scale,
+        causal=causal, scale=scale, precision=_dot_precision(q.dtype),
     )
     dqf = pl.pallas_call(
         dq_kernel,
@@ -295,8 +343,8 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
             pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
             pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
-            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
+            pl.BlockSpec((1, LSE_SUBLANES, block_q), lambda bh, i: (bh, 0, i)),
+            pl.BlockSpec((1, LSE_SUBLANES, block_q), lambda bh, i: (bh, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
@@ -305,7 +353,7 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
 
     dkv_kernel = functools.partial(
         _flash_dkv_kernel, block_q=block_q, block_k=block_k, seq_q=sq,
-        causal=causal, scale=scale,
+        causal=causal, scale=scale, precision=_dot_precision(q.dtype),
     )
     dkf, dvf = pl.pallas_call(
         dkv_kernel,
@@ -315,8 +363,8 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
             pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
             pl.BlockSpec((1, sq, d), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, sq), lambda bh, j: (bh, 0)),
-            pl.BlockSpec((1, sq), lambda bh, j: (bh, 0)),
+            pl.BlockSpec((1, LSE_SUBLANES, sq), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, LSE_SUBLANES, sq), lambda bh, j: (bh, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
