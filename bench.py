@@ -108,31 +108,67 @@ def _tpu_alive(timeout_s: int = 90) -> bool:
         return False
 
 
-def _model_tier() -> dict | None:
-    """Run benchmarks.tpu_headline on the chip (or CPU fallback)."""
+def _run_json_tool(argv: list[str], timeout_s: int) -> tuple[dict | None, str]:
+    """Run a benchmark subprocess that prints one JSON line; returns
+    (parsed dict, "") or (None, error description)."""
+    try:
+        p = subprocess.run(
+            [sys.executable] + argv,
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timed out after {timeout_s}s"
+    if p.returncode == 0 and p.stdout.strip():
+        try:
+            return json.loads(p.stdout.strip().splitlines()[-1]), ""
+        except json.JSONDecodeError:
+            pass
+    return None, p.stderr[-500:]
+
+
+def _kernel_smoke(tpu_up: bool) -> dict | None:
+    """Per-kernel compile+run probe (benchmarks.kernel_smoke) in its own
+    subprocess, so a Mosaic rejection is a line item — not a model-tier
+    wipeout (the round-2 failure mode)."""
+    if not tpu_up:
+        return None
+    out, err = _run_json_tool(["-m", "benchmarks.kernel_smoke"], 600)
+    return out if out is not None else {"error": f"kernel smoke failed: {err}"}
+
+
+def _flash_smoke_ok(kernels: dict | None) -> bool:
+    """True only for a smoke that ran ON the chip and passed both kernels —
+    a CPU-fallback smoke trivially passes in interpret mode and proves
+    nothing about Mosaic."""
+    return (kernels is not None
+            and kernels.get("platform") == "tpu"
+            and kernels.get("flash_fwd") == "ok"
+            and kernels.get("flash_bwd") == "ok")
+
+
+def _model_tier(tpu_up: bool, kernels: dict | None) -> dict | None:
+    """Run benchmarks.tpu_headline on the chip (or CPU fallback). Kernels
+    that failed their smoke are individually dropped to their fallback impl
+    (per-kernel, not per-platform): a broken or even crashed smoke still
+    leaves the TPU attempt alive, just with reference attention."""
     attempts = []
-    if _tpu_alive():
-        attempts.append(("tpu", 1200))
+    if tpu_up:
+        flash_ok = _flash_smoke_ok(kernels)
+        if not flash_ok:
+            print("[bench] flash kernel smoke not ok; model tier uses "
+                  "reference attention on TPU", file=sys.stderr)
+        attempts.append(("tpu", "flash" if flash_ok else "reference", 1200))
     else:
         print("[bench] TPU tunnel down; model tier falls back to CPU smoke",
               file=sys.stderr)
-    attempts.append(("cpu", 900))
-    for platform, timeout_s in attempts:
-        try:
-            p = subprocess.run(
-                [sys.executable, "-m", "benchmarks.tpu_headline",
-                 "--platform", platform],
-                capture_output=True, text=True, timeout=timeout_s,
-            )
-        except subprocess.TimeoutExpired:
-            continue
-        if p.returncode == 0 and p.stdout.strip():
-            try:
-                return json.loads(p.stdout.strip().splitlines()[-1])
-            except json.JSONDecodeError:
-                pass
-        print(f"[bench] model tier ({platform}) failed: {p.stderr[-500:]}",
-              file=sys.stderr)
+    attempts.append(("cpu", "reference", 900))
+    for platform, attn, timeout_s in attempts:
+        out, err = _run_json_tool(
+            ["-m", "benchmarks.tpu_headline", "--platform", platform,
+             "--attn", attn], timeout_s)
+        if out is not None:
+            return out
+        print(f"[bench] model tier ({platform}) failed: {err}", file=sys.stderr)
     return None
 
 
@@ -166,7 +202,11 @@ def main() -> None:
         f"({multi / baseline:.2f}x); best {best_key} {best:.3f} GB/s",
         file=sys.stderr,
     )
-    model_tier = _model_tier()
+    tpu_up = _tpu_alive()
+    kernels = _kernel_smoke(tpu_up)
+    if kernels is not None:
+        print(f"[bench] kernel smoke: {kernels}", file=sys.stderr)
+    model_tier = _model_tier(tpu_up, kernels)
     if model_tier is not None:
         print(f"[bench] model tier: {model_tier}", file=sys.stderr)
     print(
@@ -179,6 +219,7 @@ def main() -> None:
                 "best_config": best_key,
                 "sweep": {k: round(v, 3) for k, v in sweep.items()},
                 "analysis": "PERF_NOTES.md",
+                "kernels": kernels,
                 "model_tier": model_tier,
             }
         )

@@ -1,0 +1,58 @@
+"""Per-kernel on-TPU compile+run smoke, run by bench.py before the model tier.
+
+Round-2 lesson: the model tier hardcoded flash attention, so one Mosaic
+rejection wiped out the whole hardware story (BENCH_r02 fell back to CPU
+with no per-kernel signal). This module compiles and runs each Pallas
+kernel on a tiny input and reports per-kernel status, so bench.py can
+(a) emit a "kernels" line item independent of the model tier, and
+(b) drop only the broken kernel to its fallback instead of leaving the chip.
+
+Prints ONE JSON line: {"flash_fwd": "ok"|"<error>", "flash_bwd": ...,
+"platform": str}. Exit code 0 as long as the probe itself ran.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def _short(e: Exception) -> str:
+    return f"{type(e).__name__}: {str(e).splitlines()[0][:300]}"
+
+
+def run_smoke() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from tpunet.ops.flash_attention import attention_reference, flash_attention
+
+    out: dict = {"platform": jax.default_backend()}
+    # Small but tile-shaped: block-sized seq, MXU-width head_dim, bf16 like
+    # the headline config (dtype changes the Mosaic tiling rules).
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 256, 4, 128), jnp.bfloat16)
+    ref = attention_reference(q, q, q, True)
+
+    try:
+        o = jax.jit(lambda x: flash_attention(x, x, x, True))(q)
+        err = float(jnp.max(jnp.abs(o.astype(jnp.float32) - ref.astype(jnp.float32))))
+        out["flash_fwd"] = "ok" if err < 0.1 else f"parity {err:.3e}"
+    except Exception as e:  # noqa: BLE001 — any failure is the signal here
+        out["flash_fwd"] = _short(e)
+
+    try:
+        g = jax.jit(jax.grad(lambda x: jnp.sum(flash_attention(x, x, x, True))))(q)
+        gr = jax.jit(jax.grad(lambda x: jnp.sum(attention_reference(x, x, x, True))))(q)
+        err = float(jnp.max(jnp.abs(g.astype(jnp.float32) - gr.astype(jnp.float32))))
+        out["flash_bwd"] = "ok" if err < 0.1 else f"parity {err:.3e}"
+    except Exception as e:  # noqa: BLE001
+        out["flash_bwd"] = _short(e)
+
+    return out
+
+
+def main() -> None:
+    print(json.dumps(run_smoke()))
+
+
+if __name__ == "__main__":
+    main()

@@ -20,27 +20,38 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import re
 import statistics
 import time
 
-# (device_kind substring, peak bf16 FLOP/s per chip). Checked most-specific
-# first. Public numbers: v4 275T, v5e 197T, v5p 459T, v6e 918T.
-PEAK_FLOPS = [
-    ("v6", 918e12),
-    ("v5p", 459e12),
-    ("v5", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12 / 2),  # per-chip kind reports a 2-core board on v2/v3
-    ("v2", 45e12 / 2),
-]
+# Exact device-kind -> peak bf16 FLOP/s per chip. jax reports kinds like
+# "TPU v4", "TPU v5 lite", "TPU v5p", "TPU v6 lite"; _peak_for normalizes
+# by stripping the "TPU " prefix and lowercasing, then requires an EXACT
+# match — substring matching silently misreported future variants (round-2
+# advisor finding). Unknown kind -> None -> mfu=null, which is honest.
+# Public numbers: v4 275T, v5e 197T, v5p 459T, v6e 918T.
+PEAK_FLOPS = {
+    "v2": 45e12 / 2,  # per-chip kind reports a 2-core board on v2/v3
+    "v3": 123e12 / 2,
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
 
 
 def _peak_for(kind: str) -> float | None:
-    k = kind.lower()
-    for sub, peak in PEAK_FLOPS:
-        if sub in k:
-            return peak
-    return None
+    k = kind.lower().strip()
+    if k.startswith("tpu"):
+        k = k[3:].strip()
+    if k in PEAK_FLOPS:
+        return PEAK_FLOPS[k]
+    # Tunneled chips suffix a tile index ("v5 lite0") — retry with the
+    # trailing integer run stripped. Only on a lookup miss, so a kind that
+    # legitimately ends in a digit ("v4") is never mangled.
+    return PEAK_FLOPS.get(re.sub(r"\d+$", "", k).strip())
 
 
 def _time_steps(step_fn, state, args, warmup: int, iters: int):
@@ -62,8 +73,10 @@ def _time_steps(step_fn, state, args, warmup: int, iters: int):
     return times
 
 
-def transformer_bench(on_tpu: bool) -> tuple[float, float | None]:
-    """Returns (tokens_per_s, mfu|None). Flash attention + bf16 on TPU."""
+def transformer_bench(on_tpu: bool, attn: str = "flash") -> tuple[float, float | None]:
+    """Returns (tokens_per_s, mfu|None). bf16 + `attn` attention on TPU —
+    bench.py passes attn="reference" when the flash kernel smoke failed,
+    so one broken kernel costs its fallback's speed, not the whole chip."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -76,7 +89,6 @@ def transformer_bench(on_tpu: bool) -> tuple[float, float | None]:
         cfg = dict(vocab=32000, d_model=512, n_layers=8, n_heads=8, d_ff=2048)
         batch, seq = 8, 1024
         dtype = jnp.bfloat16
-        attn = "flash"
     else:  # smoke-size: one CPU core must finish in seconds
         cfg = dict(vocab=512, d_model=64, n_layers=2, n_heads=4, d_ff=128)
         batch, seq = 2, 128
@@ -144,6 +156,9 @@ def vgg_bench(on_tpu: bool) -> float:
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--platform", choices=["tpu", "cpu"], required=True)
+    ap.add_argument("--attn", choices=["flash", "reference"], default="flash",
+                    help="attention impl for the TPU transformer tier "
+                         "(bench.py passes reference when the flash smoke fails)")
     args = ap.parse_args(argv)
 
     if args.platform == "cpu":
@@ -157,11 +172,12 @@ def main(argv=None) -> None:
     if args.platform == "tpu" and not on_tpu:
         raise SystemExit(f"requested tpu, got {dev.platform}")
 
-    tokens_per_s, mfu = transformer_bench(on_tpu)
+    tokens_per_s, mfu = transformer_bench(on_tpu, args.attn)
     img_per_s = vgg_bench(on_tpu)
     print(json.dumps({
         "platform": dev.platform,
         "device_kind": dev.device_kind,
+        "attn": args.attn if on_tpu else "reference",
         "tokens_per_s": round(tokens_per_s, 1),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "vgg_img_per_s": round(img_per_s, 2),

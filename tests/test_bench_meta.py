@@ -1,0 +1,112 @@
+"""Unit tests for benchmark metadata helpers (no hardware needed)."""
+
+from benchmarks.tpu_headline import PEAK_FLOPS, _peak_for
+
+
+def test_peak_exact_known_kinds():
+    assert _peak_for("TPU v4") == 275e12
+    assert _peak_for("TPU v5 lite") == 197e12
+    assert _peak_for("TPU v5p") == 459e12
+    assert _peak_for("TPU v6 lite") == 918e12
+    assert _peak_for("TPU v6e") == 918e12
+    assert _peak_for("TPU v3") == 123e12 / 2
+    assert _peak_for("TPU v2") == 45e12 / 2
+
+
+def test_peak_normalization():
+    # prefix strip + case-insensitive
+    assert _peak_for("tpu v5p") == 459e12
+    assert _peak_for("  TPU V4 ") == 275e12
+
+
+def test_peak_unknown_is_none():
+    # Unknown kinds must NOT substring-match onto a wrong row (the round-2
+    # failure mode: "v5" caught any future v5 variant).
+    assert _peak_for("TPU v7x") is None
+    assert _peak_for("TPU v5 mega") is None
+    assert _peak_for("gpu a100") is None
+
+
+def test_table_values_positive():
+    assert all(v > 0 for v in PEAK_FLOPS.values())
+
+
+def test_peak_tile_index_suffix_stripped():
+    # Axon-tunneled chips suffix a tile index onto the kind.
+    assert _peak_for("TPU v5 lite0") == 197e12
+    assert _peak_for("TPU v6 lite1") == 918e12
+    assert _peak_for("TPU v5p0") == 459e12
+    # A kind that legitimately ends in a digit is matched exactly first.
+    assert _peak_for("TPU v4") == 275e12
+
+
+def test_model_tier_gating():
+    import json
+    import unittest.mock as mock
+
+    import bench
+
+    calls = []
+
+    class _P:
+        returncode = 0
+        stdout = json.dumps({"platform": "x"})
+        stderr = ""
+
+    def record(cmd, **kw):
+        calls.append(cmd)
+        return _P
+
+    # Broken flash smoke still attempts the TPU tier, with reference attn.
+    with mock.patch("subprocess.run", side_effect=record):
+        bench._model_tier(True, {"platform": "tpu", "flash_fwd": "boom",
+                                 "flash_bwd": "ok"})
+    assert calls[0][calls[0].index("--attn") + 1] == "reference"
+    assert calls[0][calls[0].index("--platform") + 1] == "tpu"
+
+    # Smoke infra failure (error dict): TPU attempt survives.
+    calls.clear()
+    with mock.patch("subprocess.run", side_effect=record):
+        bench._model_tier(True, {"error": "kernel smoke failed: timeout"})
+    assert calls[0][calls[0].index("--platform") + 1] == "tpu"
+    assert calls[0][calls[0].index("--attn") + 1] == "reference"
+
+    # A smoke that silently ran on CPU must NOT green-light flash.
+    calls.clear()
+    with mock.patch("subprocess.run", side_effect=record):
+        bench._model_tier(True, {"platform": "cpu", "flash_fwd": "ok",
+                                 "flash_bwd": "ok"})
+    assert calls[0][calls[0].index("--attn") + 1] == "reference"
+
+    # All green on-chip: flash.
+    calls.clear()
+    with mock.patch("subprocess.run", side_effect=record):
+        bench._model_tier(True, {"platform": "tpu", "flash_fwd": "ok",
+                                 "flash_bwd": "ok"})
+    assert calls[0][calls[0].index("--attn") + 1] == "flash"
+
+    # TPU down: only the CPU attempt runs.
+    calls.clear()
+    with mock.patch("subprocess.run", side_effect=record):
+        bench._model_tier(False, None)
+    assert all(c[c.index("--platform") + 1] == "cpu" for c in calls)
+
+
+def test_finalize_drains_pending_async():
+    from conftest import free_port
+
+    from tpunet import distributed
+    from tpunet.interop import (
+        _register_pending,
+        dcn_async_stats,
+        dcn_async_stats_reset,
+    )
+    import numpy as np
+
+    dcn_async_stats_reset()
+    distributed.finalize()
+    comm = distributed.initialize(f"127.0.0.1:{free_port()}", 0, 1)
+    _register_pending(comm, comm.iall_reduce(np.ones(16, np.float32)))
+    assert dcn_async_stats()["in_flight"] == 1
+    distributed.finalize()  # must drop the stale entry, not leak it
+    assert dcn_async_stats()["in_flight"] == 0

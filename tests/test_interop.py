@@ -145,3 +145,33 @@ def test_psum_requires_initialize():
     distributed.finalize()
     with pytest.raises(RuntimeError, match="initialize"):
         dcn_psum(jnp.ones(4))
+
+
+def test_two_communicator_async_registry_no_collision():
+    """Two live Communicators issue native tickets that both count from 1;
+    the pending-async registry must key by (comm, ticket) so interleaved
+    start/finish pairs resolve to the right communicator's buffer."""
+    from tpunet.collectives import Communicator
+    from tpunet.interop import _pop_pending, _register_pending, dcn_async_stats
+
+    comm_a = Communicator(f"127.0.0.1:{free_port()}", 0, 1)
+    comm_b = Communicator(f"127.0.0.1:{free_port()}", 0, 1)
+    try:
+        xa = _rank_arr(0)
+        xb = -2.0 * _rank_arr(0)
+        ta = _register_pending(comm_a, comm_a.iall_reduce(xa.copy()))
+        tb = _register_pending(comm_b, comm_b.iall_reduce(xb.copy()))
+        # Native tickets are per-comm sequential: identical numerically.
+        assert ta == tb
+        assert dcn_async_stats()["in_flight"] >= 2
+        # Finish in reverse order; each must get its own comm's data.
+        np.testing.assert_array_equal(_pop_pending(comm_b, tb).wait(), xb)
+        np.testing.assert_array_equal(_pop_pending(comm_a, ta).wait(), xa)
+        # A finish against the wrong comm (stale ticket) fails loudly.
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="no pending async"):
+            _pop_pending(comm_a, ta)
+    finally:
+        comm_a.close()
+        comm_b.close()

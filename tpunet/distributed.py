@@ -11,6 +11,7 @@ cross-host TCP path (SURVEY §5 "Distributed communication backend").
 from __future__ import annotations
 
 import atexit
+import sys
 import threading
 
 from tpunet.collectives import Communicator
@@ -63,6 +64,12 @@ def finalize() -> None:
     global _comm, _comm_args
     with _lock:
         if _comm is not None:
+            # Drop any pending async tickets registered for this comm (only
+            # if interop was ever imported — keeps transport-only users free
+            # of the jax import interop pulls in).
+            interop = sys.modules.get("tpunet.interop")
+            if interop is not None:
+                interop._drop_pending_for(_comm)
             _comm.close()
             _comm = None
             _comm_args = None

@@ -74,11 +74,54 @@ def dcn_pmean(x):
 
 # -- nonblocking all-reduce (gradient-bucket overlap) -----------------------
 
-# Outstanding AsyncResults keyed by native ticket. The start callback pins
-# the buffers here; the finish callback releases them. max_in_flight is the
-# observable proof that buckets actually overlapped (tests assert on it).
-_async_pending: dict[int, Any] = {}
+# Outstanding AsyncResults keyed by (communicator identity, native ticket).
+# Native tickets are sequential per communicator, so two live Communicators
+# both count from 1 — a ticket-only key would silently pair a finish with
+# the wrong communicator's buffer. id(comm) is stable while any of its
+# results are pending (each AsyncResult holds a strong comm ref). The start
+# callback pins the buffers here; the finish callback releases them.
+# max_in_flight is the observable proof that buckets actually overlapped
+# (tests assert on it).
+_async_pending: dict[tuple[int, int], Any] = {}
 _async_stats = {"in_flight": 0, "max_in_flight": 0}
+
+
+def _register_pending(comm, res) -> int:
+    """Pin `res` until its finish callback; returns the uint32 wire ticket.
+    uint32 keeps the ticket jax-representable without x64; native tickets
+    are sequential from 1 so wraparound is out of reach."""
+    ticket = res._ticket & 0xFFFFFFFF
+    _async_pending[(id(comm), ticket)] = res
+    _async_stats["in_flight"] += 1
+    _async_stats["max_in_flight"] = max(
+        _async_stats["max_in_flight"], _async_stats["in_flight"]
+    )
+    return ticket
+
+
+def _pop_pending(comm, ticket: int):
+    try:
+        res = _async_pending.pop((id(comm), ticket))
+    except KeyError:
+        raise RuntimeError(
+            f"no pending async collective with ticket {ticket} on the current "
+            "global communicator — dcn_all_reduce_finish without a matching "
+            "start, or the communicator was re-initialized mid-flight"
+        ) from None
+    _async_stats["in_flight"] -= 1
+    return res
+
+
+def _drop_pending_for(comm) -> int:
+    """Forget every pending async op of `comm` (called by
+    distributed.finalize before closing it): the entries would otherwise be
+    unreachable — _pop_pending keys on the CURRENT global comm — pinning
+    their buffers and inflating in_flight for the process lifetime."""
+    stale = [k for k in _async_pending if k[0] == id(comm)]
+    for k in stale:
+        del _async_pending[k]
+        _async_stats["in_flight"] -= 1
+    return len(stale)
 
 
 def dcn_async_stats() -> dict[str, int]:
@@ -98,15 +141,8 @@ def dcn_all_reduce_start(x, op: str = "sum"):
     start and finish callbacks — the bucketed-gradient-overlap primitive."""
 
     def cb(a):
-        res = _comm().iall_reduce(np.asarray(a), op)
-        # uint32 keeps the ticket jax-representable without x64; native
-        # tickets are sequential from 1 so wraparound is out of reach.
-        _async_pending[res._ticket & 0xFFFFFFFF] = res
-        _async_stats["in_flight"] += 1
-        _async_stats["max_in_flight"] = max(
-            _async_stats["max_in_flight"], _async_stats["in_flight"]
-        )
-        return np.uint32(res._ticket & 0xFFFFFFFF)
+        c = _comm()
+        return np.uint32(_register_pending(c, c.iall_reduce(np.asarray(a), op)))
 
     return io_callback(cb, jax.ShapeDtypeStruct((), jnp.uint32), x, ordered=True)
 
@@ -116,9 +152,7 @@ def dcn_all_reduce_finish(ticket, like):
     array (shape/dtype of `like`, the array passed to the start call)."""
 
     def cb(t):
-        res = _async_pending.pop(int(t))
-        _async_stats["in_flight"] -= 1
-        return res.wait()
+        return _pop_pending(_comm(), int(t)).wait()
 
     return io_callback(cb, _callback_result_spec(like), ticket, ordered=True)
 

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from tpunet.parallel.smap import full_varying, shard_map
+from tpunet.parallel.smap import full_varying, shard_map, vma_of
 
 
 def stack_stage_params(param_trees):
@@ -50,10 +50,13 @@ def gpipe_stage_loop(stage_fn, stage_params, xs, axis_name: str):
     params = jax.tree.map(lambda a: a[0], stage_params)
     m = xs.shape[0]
 
-    # The carries become pp-varying through the stage params / axis_index;
-    # the replicated xs input can't seed that type, so cast explicitly.
-    out0 = full_varying(xs.shape, 0.0, xs.dtype, (axis_name,))
-    recv0 = full_varying(xs.shape[1:], 0.0, xs.dtype, (axis_name,))
+    # The carries become pp-varying through the stage params / axis_index —
+    # and additionally inherit whatever axes xs varies over (e.g. a dp axis
+    # when microbatch rows are data-sharded). Fresh literals can't seed that
+    # type, so cast explicitly to the union.
+    carry_vma = tuple(dict.fromkeys((axis_name,) + vma_of(xs)))
+    out0 = full_varying(xs.shape, 0.0, xs.dtype, carry_vma)
+    recv0 = full_varying(xs.shape[1:], 0.0, xs.dtype, carry_vma)
     perm = [(i, (i + 1) % w) for i in range(w)]
 
     def tick(carry, t):
@@ -85,10 +88,14 @@ def gpipe(
     mesh: Mesh,
     num_microbatches: int,
     pp_axis: str = "pp",
+    dp_axis: str | None = None,
 ):
     """Full-array entry point. stacked_params: pytree with leading stage dim
-    W == mesh.shape[pp_axis] (see `stack_stage_params`); x: (batch, ...)
-    replicated; returns (batch, ...) replicated."""
+    W == mesh.shape[pp_axis] (see `stack_stage_params`); x: (batch, ...);
+    returns (batch, ...). With `dp_axis`, each microbatch's row dim is
+    additionally sharded over that mesh axis (pipeline x data parallelism:
+    params stay dp-replicated, so shard_map's autodiff inserts the dp
+    gradient psum on the transpose automatically)."""
     w = mesh.shape[pp_axis]
     batch = x.shape[0]
     if batch % num_microbatches:
@@ -98,14 +105,20 @@ def gpipe(
             raise ValueError(
                 f"stacked param leading dim {leaf.shape[0]} != pp axis size {w}"
             )
-    xs = x.reshape((num_microbatches, batch // num_microbatches) + x.shape[1:])
+    mb = batch // num_microbatches
+    if dp_axis is not None and mb % mesh.shape[dp_axis]:
+        raise ValueError(
+            f"microbatch size {mb} not divisible by {dp_axis}={mesh.shape[dp_axis]}"
+        )
+    xs = x.reshape((num_microbatches, mb) + x.shape[1:])
 
     param_specs = jax.tree.map(lambda _: P(pp_axis), stacked_params)
+    data_spec = P(None, dp_axis) if dp_axis is not None else P()
     fn = shard_map(
         partial(gpipe_stage_loop, stage_fn, axis_name=pp_axis),
         mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(),
+        in_specs=(param_specs, data_spec),
+        out_specs=data_spec,
     )
     ys = fn(stacked_params, xs)
     return ys.reshape((batch,) + ys.shape[2:])
