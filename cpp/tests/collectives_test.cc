@@ -1,0 +1,175 @@
+// Collectives self-test: W ranks as THREADS of one process so the sanitizer
+// lanes (tsan/asan) can see every cross-rank interaction in the collectives
+// layer — ReducePool fork-join, the async ticket worker, comm teardown. The
+// Python suite runs these paths multi-process where TSAN is blind.
+//
+// Coverage: all_reduce (sum, with TPUNET_REDUCE_THREADS>1), reduce_scatter,
+// all_gather, broadcast, all_to_all, neighbor_exchange, barrier, and
+// overlapping iall_reduce tickets waited out of order, then teardown while
+// a ticket is still in flight on one rank (wait-then-destroy on the other).
+
+#include <atomic>
+#include <chrono>
+#include <cinttypes>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "tpunet/c_api.h"
+
+namespace {
+
+constexpr int kWorld = 3;
+constexpr uint64_t kCount = 40000;  // spans multiple ring chunks
+
+std::atomic<int> g_failures{0};
+
+#define CHECK_MSG(cond, ...)                                      \
+  do {                                                            \
+    if (!(cond)) {                                                \
+      std::fprintf(stderr, "FAIL %s:%d: ", __FILE__, __LINE__);   \
+      std::fprintf(stderr, __VA_ARGS__);                          \
+      std::fprintf(stderr, "\n");                                 \
+      g_failures.fetch_add(1);                                    \
+      return;                                                     \
+    }                                                             \
+  } while (0)
+
+#define CHECK_OK(expr) CHECK_MSG((expr) == 0, "%s -> %s", #expr, tpunet_c_last_error())
+
+void rank_main(int rank, const std::string& coordinator) {
+  uintptr_t comm = 0;
+  CHECK_OK(tpunet_comm_create(coordinator.c_str(), rank, kWorld, &comm));
+
+  // all_reduce(sum) f32, out-of-place + in-place.
+  std::vector<float> send(kCount), recv(kCount);
+  for (uint64_t i = 0; i < kCount; ++i) send[i] = float(rank + 1) + float(i % 7);
+  CHECK_OK(tpunet_comm_all_reduce(comm, send.data(), recv.data(), kCount, 0, 0));
+  for (uint64_t i = 0; i < kCount; ++i) {
+    float expect = float(kWorld * (kWorld + 1) / 2) + float(kWorld * (i % 7));
+    CHECK_MSG(std::fabs(recv[i] - expect) < 1e-3f, "all_reduce[%" PRIu64 "] %f != %f",
+              i, double(recv[i]), double(expect));
+  }
+  CHECK_OK(tpunet_comm_all_reduce(comm, send.data(), send.data(), kCount, 0, 0));
+  CHECK_MSG(std::fabs(send[0] - recv[0]) < 1e-3f, "in-place mismatch");
+
+  // reduce_scatter: world*rc elements -> rank's rc slice of the sum.
+  const uint64_t rc = 1024;
+  std::vector<float> rs_in(kWorld * rc), rs_out(rc);
+  for (uint64_t i = 0; i < rs_in.size(); ++i) rs_in[i] = float(rank) + float(i);
+  CHECK_OK(tpunet_comm_reduce_scatter(comm, rs_in.data(), rs_out.data(), rc, 0, 0));
+  for (uint64_t i = 0; i < rc; ++i) {
+    float expect = float(kWorld * (kWorld - 1) / 2) + float(kWorld) * float(rank * rc + i);
+    CHECK_MSG(std::fabs(rs_out[i] - expect) < 1e-2f, "reduce_scatter[%" PRIu64 "]", i);
+  }
+
+  // all_gather bytes.
+  std::vector<uint8_t> ag_in(512, uint8_t(0x40 + rank)), ag_out(kWorld * 512);
+  CHECK_OK(tpunet_comm_all_gather(comm, ag_in.data(), ag_out.data(), 512));
+  for (int r = 0; r < kWorld; ++r)
+    CHECK_MSG(ag_out[r * 512] == uint8_t(0x40 + r), "all_gather rank %d block", r);
+
+  // broadcast from root 1.
+  std::vector<uint8_t> bc(777, uint8_t(rank == 1 ? 0xAB : 0));
+  CHECK_OK(tpunet_comm_broadcast(comm, bc.data(), bc.size(), 1));
+  CHECK_MSG(bc[0] == 0xAB && bc[776] == 0xAB, "broadcast payload");
+
+  // all_to_all: block j for rank j.
+  std::vector<uint8_t> a2a_in(kWorld * 256), a2a_out(kWorld * 256);
+  for (int j = 0; j < kWorld; ++j)
+    std::memset(a2a_in.data() + j * 256, 0x10 * (rank + 1) + j, 256);
+  CHECK_OK(tpunet_comm_all_to_all(comm, a2a_in.data(), a2a_out.data(), 256));
+  for (int j = 0; j < kWorld; ++j)
+    CHECK_MSG(a2a_out[j * 256] == uint8_t(0x10 * (j + 1) + rank),
+              "all_to_all block from rank %d", j);
+
+  // neighbor exchange.
+  std::vector<uint8_t> ne_in(300, uint8_t(rank)), ne_out(400);
+  uint64_t got = 0;
+  CHECK_OK(tpunet_comm_neighbor_exchange(comm, ne_in.data(), ne_in.size(),
+                                         ne_out.data(), ne_out.size(), &got));
+  CHECK_MSG(got == 300 && ne_out[0] == uint8_t((rank + kWorld - 1) % kWorld),
+            "neighbor_exchange");
+
+  // Overlapping async tickets waited in reverse order.
+  const uint64_t ac = 8192;
+  std::vector<std::vector<float>> abufs;
+  std::vector<uint64_t> tickets;
+  for (int s = 0; s < 3; ++s) {
+    abufs.emplace_back(ac, float(rank + 1) * float(s + 1));
+    uint64_t t = 0;
+    CHECK_OK(tpunet_comm_iall_reduce(comm, abufs[s].data(), abufs[s].data(),
+                                     ac, 0, 0, &t));
+    tickets.push_back(t);
+  }
+  for (int s = 2; s >= 0; --s) {
+    CHECK_OK(tpunet_comm_ticket_wait(comm, tickets[s]));
+    float expect = float(kWorld * (kWorld + 1) / 2) * float(s + 1);
+    CHECK_MSG(std::fabs(abufs[s][0] - expect) < 1e-3f, "iall_reduce s=%d", s);
+  }
+
+  // ticket_test polling path.
+  uint64_t t = 0;
+  std::vector<float> last(ac, 1.0f);
+  CHECK_OK(tpunet_comm_iall_reduce(comm, last.data(), last.data(), ac, 0, 0, &t));
+  uint8_t done = 0;
+  CHECK_OK(tpunet_comm_ticket_test(comm, t, &done));  // may or may not be done
+  CHECK_OK(tpunet_comm_ticket_wait(comm, t));
+  CHECK_OK(tpunet_comm_barrier(comm));
+
+  // Teardown with a ticket still outstanding: destroy must terminate on
+  // every interleaving — job drained by the worker, failed while queued, or
+  // cut short by a peer's teardown (comm poisoning turns that into a typed
+  // error, not a hang; the main() watchdog converts any regression here
+  // into a test failure). Buffers stay alive across destroy per the
+  // contract. No wait: the ticket is abandoned deliberately.
+  uint64_t t2 = 0;
+  std::vector<float> tail(ac, 2.0f);
+  CHECK_OK(tpunet_comm_iall_reduce(comm, tail.data(), tail.data(), ac, 0, 0, &t2));
+  CHECK_OK(tpunet_comm_destroy(&comm));
+}
+
+}  // namespace
+
+int main() {
+  // Exercise the fork-join reduce pool under the sanitizer.
+  setenv("TPUNET_REDUCE_THREADS", "2", 1);
+  // Small ring chunks so the pipelined transfer||reduce path really cycles.
+  setenv("TPUNET_RING_CHUNKSIZE", "16384", 1);
+
+  const char* port_env = getenv("TPUNET_TEST_PORT");
+  std::string coordinator =
+      std::string("127.0.0.1:") + (port_env ? port_env : "29517");
+
+  // A failed check on one rank-thread leaves its peers blocked in the next
+  // collective (no data-plane timeout); without a watchdog that is a CI
+  // hang, not an exit-1.
+  std::atomic<bool> finished{false};
+  std::thread watchdog([&finished] {
+    for (int i = 0; i < 2400 && !finished.load(); ++i)
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    if (!finished.load()) {
+      std::fprintf(stderr, "FAILED: watchdog timeout (rank deadlock)\n");
+      std::_Exit(2);
+    }
+  });
+
+  std::vector<std::thread> ranks;
+  ranks.reserve(kWorld);
+  for (int r = 0; r < kWorld; ++r)
+    ranks.emplace_back(rank_main, r, coordinator);
+  for (auto& th : ranks) th.join();
+  finished.store(true);
+  watchdog.join();
+
+  if (g_failures.load() != 0) {
+    std::fprintf(stderr, "FAILED: %d check(s)\n", g_failures.load());
+    return 1;
+  }
+  std::printf("OK: all collectives tests passed (%d ranks in-process)\n", kWorld);
+  return 0;
+}
