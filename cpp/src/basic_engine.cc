@@ -53,18 +53,35 @@
 namespace tpunet {
 namespace {
 
+// Number of lazy recvs currently parked process-wide. Lets a send-side
+// wait() park on its condvar outright (no 50ms upgrade sweeps) when there
+// is nothing to upgrade. Global (not per-engine) so Comm::Shutdown can
+// maintain it; cross-engine conservatism is harmless.
+std::atomic<int> g_lazy_parked{0};
+
+bool DebugOn() {
+  static const bool on = GetEnvU64("TPUNET_DEBUG", 0) != 0;
+  return on;
+}
+#define TPUNET_DBG(...) do { if (DebugOn()) { fprintf(stderr, "[eng %d] ", (int)getpid()); fprintf(stderr, __VA_ARGS__); fprintf(stderr, "\n"); } } while (0)
+
 // MPSC blocking queue with close semantics (stands in for the reference's
 // flume channels, nthread:224-226). Pop returns false only when closed AND
 // drained, so close_send/close_recv still flush queued work.
 template <typename T>
 class Queue {
  public:
-  void Push(T t) {
+  // Returns false (and does not enqueue) once the queue is closed — the
+  // caller owns failing the item. This is how a poisoned comm rejects new
+  // messages without a parked fail-sink thread.
+  bool Push(T t) {
     {
       std::lock_guard<std::mutex> lk(mu_);
+      if (closed_) return false;
       q_.push_back(std::move(t));
     }
     cv_.notify_one();
+    return true;
   }
   bool Pop(T* out) {
     std::unique_lock<std::mutex> lk(mu_);
@@ -122,6 +139,27 @@ struct Comm {
   std::vector<std::unique_ptr<StreamWorker>> workers;
   Queue<Msg> msgs;
   std::unique_ptr<std::thread> scheduler;
+  // Inline fast path state (PERF_NOTES: caller->scheduler->worker hops cost
+  // ~0.4ms per 1MiB message on a 1-core host). `inflight` counts messages
+  // not yet fully settled; when it reads 0 the scheduler is idle and every
+  // prior byte is in the kernel, so the caller thread may take the
+  // scheduler's role for its own message (ctrl frame + chunk dispatch)
+  // without reordering the wire. `cursor` is the chunk->stream rotation,
+  // shared by scheduler and inline path — never concurrently: the inline
+  // path only runs at inflight==0, and the release/acquire pair on
+  // `inflight` orders the scheduler's last cursor write before the caller's
+  // read. Callers are single-threaded per comm (NCCL proxy contract; our
+  // collectives layer likewise).
+  std::atomic<uint64_t> inflight{0};
+  uint64_t cursor = 0;
+  // Lazy recv slot: an irecv posted on an idle comm parks here; its wait()
+  // executes the ctrl read + data read inline on the caller thread (saving
+  // two hops and the completion wakeup). test() or a later irecv upgrades
+  // it onto the scheduler queue instead.
+  std::mutex lazy_mu;
+  Msg lazy_msg;
+  bool has_lazy = false;
+  uint64_t lazy_req = 0;
   // Threads do not survive fork(): a mismatch means this comm's scheduler /
   // workers never existed in this process (see Shutdown and the engine's
   // isend/irecv fail-fast).
@@ -143,6 +181,20 @@ struct Comm {
   void Shutdown() {
     if (shut_) return;
     shut_ = true;
+    // A lazy recv parked here would otherwise never execute; fail it so a
+    // post-close wait() errors instead of hanging.
+    {
+      std::lock_guard<std::mutex> lk(lazy_mu);
+      if (has_lazy) {
+        lazy_msg.state->SetError("comm closed with pending lazy recv");
+        lazy_msg.state->total.store(0, std::memory_order_release);
+        inflight.fetch_sub(1, std::memory_order_release);
+        lazy_msg.state->NotifyIfSettled();
+        lazy_msg = Msg{};
+        has_lazy = false;
+        g_lazy_parked.fetch_sub(1, std::memory_order_relaxed);
+      }
+    }
     if (ForkGeneration() != fork_gen) {
       // Forked child: scheduler/worker pthreads never existed here and the
       // queue mutexes may have been captured mid-lock at fork. Leak the
@@ -187,6 +239,20 @@ using CommPtr = std::shared_ptr<Comm>;
 // ---------------------------------------------------------------------------
 // Worker / scheduler loops.
 
+// Chunk completion shared by both worker loops: the worker that settles the
+// message (last chunk) releases the comm's inflight slot, re-arming the
+// inline fast path.
+void FinishChunk(StreamWorker* w, ChunkTask& t) {
+  t.state->nbytes.fetch_add(t.len, std::memory_order_relaxed);
+  uint64_t prior = t.state->completed.fetch_add(1, std::memory_order_acq_rel);
+  uint64_t tot = t.state->total.load(std::memory_order_acquire);
+  TPUNET_DBG("chunk done len=%zu completed=%llu/%llu fail=%d", t.len, (unsigned long long)(prior+1), (unsigned long long)tot, (int)t.state->failed.load());
+  if (prior + 1 >= tot) {
+    w->comm->inflight.fetch_sub(1, std::memory_order_release);
+  }
+  t.state->NotifyIfSettled();
+}
+
 void SendWorkerLoop(StreamWorker* w, bool spin) {
   ChunkTask t;
   while (w->tasks.Pop(&t)) {
@@ -197,9 +263,7 @@ void SendWorkerLoop(StreamWorker* w, bool spin) {
     } else {
       Telemetry::Get().OnStreamBytes(true, w->idx, t.len);
     }
-    t.state->nbytes.fetch_add(t.len, std::memory_order_relaxed);
-    t.state->completed.fetch_add(1, std::memory_order_acq_rel);
-    t.state->NotifyIfSettled();
+    FinishChunk(w, t);
   }
 }
 
@@ -213,91 +277,190 @@ void RecvWorkerLoop(StreamWorker* w, bool spin) {
     } else {
       Telemetry::Get().OnStreamBytes(false, w->idx, t.len);
     }
-    t.state->nbytes.fetch_add(t.len, std::memory_order_relaxed);
-    t.state->completed.fetch_add(1, std::memory_order_acq_rel);
-    t.state->NotifyIfSettled();
+    FinishChunk(w, t);
   }
 }
 
-// Chunk a message and fan chunks out to stream workers round-robin from the
-// rotating cursor. Both sides run this exact function per message, keeping
-// chunk maps symmetric (SURVEY hard-part #2).
-void DispatchChunks(Comm* c, uint8_t* data, size_t len, const RequestPtr& state,
-                    uint64_t* cursor) {
+// Receiver-side: chunk a message and fan chunks out to stream workers
+// round-robin from the rotating cursor. The send side runs the same chunk
+// math + rotation inline in SendOneMsg (with ctrl-frame accounting on top),
+// keeping the two chunk maps symmetric (SURVEY hard-part #2).
+void DispatchChunks(Comm* c, uint8_t* data, size_t len, const RequestPtr& state) {
   size_t csize = ChunkSize(len, c->min_chunksize, c->nstreams);
   size_t nchunks = ChunkCount(len, csize);
   state->total.store(nchunks, std::memory_order_release);  // 0-byte msg: done now
+  if (nchunks == 0) {
+    c->inflight.fetch_sub(1, std::memory_order_release);
+    state->NotifyIfSettled();
+    return;
+  }
   state->NotifyIfSettled();
   size_t off = 0;
   for (size_t i = 0; i < nchunks; ++i) {
     size_t n = std::min(csize, len - off);
-    StreamWorker* w = c->workers[*cursor % c->nstreams].get();
-    *cursor += 1;  // persists across messages — fairness rotation
+    StreamWorker* w = c->workers[c->cursor % c->nstreams].get();
+    c->cursor += 1;  // persists across messages — fairness rotation
     w->tasks.Push(ChunkTask{data + off, n, state});
     off += n;
   }
 }
 
-void FailAndDrain(Comm* c, const RequestPtr& state, const std::string& msg) {
+// Fail a message that never dispatched any chunk (its inflight slot is
+// still held) and release the slot.
+void FailMsg(Comm* c, const RequestPtr& state, const std::string& msg) {
+  TPUNET_DBG("FailMsg: %s", msg.c_str());
   state->SetError(msg);
   state->total.store(0, std::memory_order_release);
+  c->inflight.fetch_sub(1, std::memory_order_release);
   state->NotifyIfSettled();
+}
+
+// Poison the comm and promptly fail everything queued (reference broke its
+// loop on ctrl error leaving queued requests to hang, nthread:396-401).
+// Close() first so Pop drains without blocking — this runs on the CALLER
+// thread via the inline fast path, not only on a dedicated scheduler that
+// could afford to park as a fail-sink. Post-close isend/irecv see the
+// closed queue (Push returns false) and fail their requests directly.
+void PoisonAndDrainQueue(Comm* c, const std::string& why) {
   c->AbortStreams();
-  // Reference breaks its loop on ctrl error leaving queued requests to hang
-  // (nthread:396-401); we fail them promptly instead.
+  c->msgs.Close();
   Msg m;
   while (c->msgs.Pop(&m)) {
-    m.state->SetError("comm broken by earlier ctrl-stream error: " + msg);
-    m.state->total.store(0, std::memory_order_release);
-    m.state->NotifyIfSettled();
+    FailMsg(c, m.state, "comm broken by earlier ctrl-stream error: " + why);
   }
+}
+
+void FailAndDrain(Comm* c, const RequestPtr& state, const std::string& msg) {
+  FailMsg(c, state, msg);
+  PoisonAndDrainQueue(c, msg);
+}
+
+// Per-message sender work: chunk dispatch + ctrl length frame. Runs on the
+// scheduler thread normally, or on the caller thread via the inline fast
+// path (never concurrently — see Comm::inflight).
+//
+// Order matters on a shared core: the ctrl frame is the receiver's wakeup
+// trigger (its ctrl read unblocks), and ctrl/data ride SEPARATE sockets, so
+// nothing requires the frame to precede the payload bytes. Dispatching the
+// chunks first means the receiver wakes to data already flowing instead of
+// waking early, read-blocking on an empty data stream, and ping-ponging
+// context switches with the sender's worker.
+//
+// The ctrl write is itself a completion unit (total = nchunks + 1): with
+// chunks dispatched first, chunk completion alone no longer implies the
+// frame is on the wire, and the inline fast path keys off "message fully
+// settled" (inflight==0) to take the scheduler's role — if inflight could
+// hit 0 with a scheduler ctrl write still pending, an inline frame could
+// overtake it and desynchronize the receiver's ctrl stream.
+bool SendOneMsg(Comm* c, const Msg& m) {
+  uint8_t hdr[8];
+  EncodeU64BE(m.len, hdr);
+  size_t csize = ChunkSize(m.len, c->min_chunksize, c->nstreams);
+  size_t nchunks = ChunkCount(m.len, csize);
+  m.state->total.store(nchunks + 1, std::memory_order_release);
+  size_t off = 0;
+  for (size_t i = 0; i < nchunks; ++i) {
+    size_t n = std::min(csize, m.len - off);
+    StreamWorker* w = c->workers[c->cursor % c->nstreams].get();
+    c->cursor += 1;  // persists across messages — fairness rotation
+    w->tasks.Push(ChunkTask{m.data + off, n, m.state});
+    off += n;
+  }
+  Status s = WriteAll(c->ctrl_fd, hdr, sizeof(hdr), c->spin);
+  if (!s.ok()) m.state->SetError(s.msg);
+  uint64_t prior = m.state->completed.fetch_add(1, std::memory_order_acq_rel);
+  if (prior + 1 >= nchunks + 1) {
+    c->inflight.fetch_sub(1, std::memory_order_release);
+  }
+  m.state->NotifyIfSettled();
+  if (!s.ok()) {
+    PoisonAndDrainQueue(c, s.msg);
+    return false;
+  }
+  return true;
 }
 
 void SendSchedulerLoop(Comm* c) {
-  uint64_t cursor = 0;
   Msg m;
   while (c->msgs.Pop(&m)) {
-    uint8_t hdr[8];
-    EncodeU64BE(m.len, hdr);
-    Status s = WriteAll(c->ctrl_fd, hdr, sizeof(hdr), c->spin);
-    if (!s.ok()) {
-      FailAndDrain(c, m.state, s.msg);
-      return;
-    }
-    DispatchChunks(c, m.data, m.len, m.state, &cursor);
+    if (!SendOneMsg(c, m)) return;
   }
 }
 
+// Per-message receiver ctrl-frame work; chunk handling differs between the
+// scheduler path (dispatch to workers) and the lazy path (caller reads).
+Status RecvCtrlFrame(Comm* c, const Msg& m, uint64_t* target) {
+  uint8_t hdr[8];
+  Status s = ReadExact(c->ctrl_fd, hdr, sizeof(hdr), c->spin);
+  if (!s.ok()) return s;
+  *target = DecodeU64BE(hdr);
+  if (*target > m.len) {
+    // Peer sent more than the posted buffer — unrecoverable protocol
+    // violation (the reference would panic slicing data[..target]).
+    return Status::Inner("incoming message (" + std::to_string(*target) +
+                         "B) exceeds posted recv buffer (" +
+                         std::to_string(m.len) + "B)");
+  }
+  return Status::Ok();
+}
+
 void RecvSchedulerLoop(Comm* c) {
-  uint64_t cursor = 0;
   Msg m;
   while (c->msgs.Pop(&m)) {
-    uint8_t hdr[8];
-    Status s = ReadExact(c->ctrl_fd, hdr, sizeof(hdr), c->spin);
+    uint64_t target = 0;
+    Status s = RecvCtrlFrame(c, m, &target);
     if (!s.ok()) {
       FailAndDrain(c, m.state, s.msg);
-      return;
-    }
-    uint64_t target = DecodeU64BE(hdr);
-    if (target > m.len) {
-      // Peer sent more than the posted buffer — unrecoverable protocol
-      // violation (the reference would panic slicing data[..target]).
-      FailAndDrain(c, m.state,
-                   "incoming message (" + std::to_string(target) +
-                       "B) exceeds posted recv buffer (" + std::to_string(m.len) + "B)");
       return;
     }
     // NCCL semantics: recv buffer may exceed the message; true size comes
     // from the ctrl frame (reference nthread:507).
-    DispatchChunks(c, m.data, static_cast<size_t>(target), m.state, &cursor);
+    DispatchChunks(c, m.data, static_cast<size_t>(target), m.state);
   }
+}
+
+// Lazy-recv execution on the caller thread (from wait()): ctrl read + data
+// read inline, no scheduler/worker hop and no completion wakeup. Only
+// single-chunk-eligible messages park lazily (see irecv), so one ReadExact
+// covers the payload. The owning worker thread is parked in Pop and never
+// touches its fd without a task, so reading it here is exclusive.
+void ExecuteLazyRecv(Comm* c, const Msg& m) {
+  uint64_t target = 0;
+  Status s = RecvCtrlFrame(c, m, &target);
+  if (!s.ok()) {
+    FailMsg(c, m.state, s.msg);
+    c->AbortStreams();
+    return;
+  }
+  size_t len = static_cast<size_t>(target);
+  size_t csize = ChunkSize(len, c->min_chunksize, c->nstreams);
+  size_t nchunks = ChunkCount(len, csize);
+  if (nchunks > 0) {
+    StreamWorker* w = c->workers[c->cursor % c->nstreams].get();
+    c->cursor += 1;  // same rotation the sender computes
+    Status rs = ReadExact(w->fd, m.data, len, c->spin);
+    if (!rs.ok()) {
+      FailMsg(c, m.state, rs.msg);
+      c->AbortStreams();
+      return;
+    }
+    Telemetry::Get().OnStreamBytes(false, w->idx, len);
+    m.state->nbytes.store(len, std::memory_order_relaxed);
+    m.state->completed.store(nchunks, std::memory_order_release);
+  }
+  m.state->total.store(nchunks, std::memory_order_release);
+  c->inflight.fetch_sub(1, std::memory_order_release);
+  m.state->NotifyIfSettled();
 }
 
 // ---------------------------------------------------------------------------
 
 class BasicEngine : public EngineBase {
  public:
-  BasicEngine() : spin_(GetEnvU64("TPUNET_SPIN", 0) != 0) {}
+  BasicEngine()
+      : spin_(GetEnvU64("TPUNET_SPIN", 0) != 0),
+        inline_send_(GetEnvU64("TPUNET_INLINE_SEND", 1) != 0),
+        lazy_recv_(GetEnvU64("TPUNET_LAZY_RECV", 1) != 0) {}
 
   ~BasicEngine() override {
     for (auto& c : send_comms_.DrainAll()) c->Shutdown();
@@ -365,7 +528,19 @@ class BasicEngine : public EngineBase {
     auto state = std::make_shared<RequestState>();
     uint64_t id = next_id_.fetch_add(1);
     requests_.Put(id, state);
-    c->msgs.Push(Msg{const_cast<uint8_t*>(static_cast<const uint8_t*>(data)), nbytes, state});
+    Msg m{const_cast<uint8_t*>(static_cast<const uint8_t*>(data)), nbytes, state};
+    // Inline fast path: on an idle comm the caller does the scheduler's
+    // per-message work itself (8B ctrl write + chunk pushes, all
+    // nonblocking-scale), skipping one thread hop per message. Data writes
+    // stay on the workers — a blocking inline write could deadlock a
+    // symmetric exchange once kernel socket buffers fill.
+    if (c->inflight.fetch_add(1, std::memory_order_acq_rel) == 0 && inline_send_) {
+      TPUNET_DBG("isend req=%llu len=%zu INLINE", (unsigned long long)id, nbytes);
+      SendOneMsg(c.get(), m);
+    } else {
+      TPUNET_DBG("isend req=%llu len=%zu queued", (unsigned long long)id, nbytes);
+      if (!c->msgs.Push(m)) FailMsg(c.get(), state, "send comm is poisoned");
+    }
     *request = id;
     return Status::Ok();
   }
@@ -381,12 +556,38 @@ class BasicEngine : public EngineBase {
     auto state = std::make_shared<RequestState>();
     uint64_t id = next_id_.fetch_add(1);
     requests_.Put(id, state);
-    c->msgs.Push(Msg{static_cast<uint8_t*>(data), nbytes, state});
+    Msg m{static_cast<uint8_t*>(data), nbytes, state};
+    // A lazy recv already parked must hit the scheduler before this newer
+    // message, or the ctrl frames would be consumed out of post order.
+    UpgradeLazy(c.get());
+    uint64_t prior = c->inflight.fetch_add(1, std::memory_order_acq_rel);
+    size_t csize = ChunkSize(nbytes, c->min_chunksize, c->nstreams);
+    bool single = ChunkCount(nbytes, csize) <= 1;
+    TPUNET_DBG("irecv req=%llu len=%zu prior=%llu single=%d", (unsigned long long)id, nbytes, (unsigned long long)prior, (int)single);
+    if (prior == 0 && single && lazy_recv_) {
+      // Park lazily: wait() executes the ctrl+data reads on the caller
+      // thread (no scheduler/worker hop, no completion wakeup). test()
+      // or a later irecv upgrades it onto the scheduler queue.
+      // Single-chunk eligibility from the posted size is conservative:
+      // the actual (<=posted) size can only have fewer chunks.
+      std::lock_guard<std::mutex> lk(c->lazy_mu);
+      c->lazy_msg = m;
+      c->has_lazy = true;
+      c->lazy_req = id;
+      g_lazy_parked.fetch_add(1, std::memory_order_relaxed);
+      lazy_recv_owners_.Put(id, c);
+    } else {
+      if (!c->msgs.Push(m)) FailMsg(c.get(), state, "recv comm is poisoned");
+    }
     *request = id;
     return Status::Ok();
   }
 
   Status test(uint64_t request, bool* done, size_t* nbytes) override {
+    // Pollers (the NCCL shim) never call wait(), so a lazy recv would
+    // starve: upgrade it onto the scheduler on the first poll.
+    CommPtr lc;
+    if (lazy_recv_owners_.Take(request, &lc)) UpgradeLazy(lc.get());
     RequestPtr state;
     if (!requests_.Get(request, &state)) {
       return Status::Invalid("unknown request " + std::to_string(request));
@@ -411,7 +612,56 @@ class BasicEngine : public EngineBase {
   }
 
   Status wait(uint64_t request, size_t* nbytes) override {
-    return WaitIn(requests_, request, nbytes);
+    TPUNET_DBG("wait req=%llu enter", (unsigned long long)request);
+    CommPtr c;
+    if (lazy_recv_owners_.Take(request, &c)) {
+      Msg m;
+      bool mine = false;
+      {
+        std::lock_guard<std::mutex> lk(c->lazy_mu);
+        if (c->has_lazy && c->lazy_req == request) {
+          m = c->lazy_msg;
+          c->lazy_msg = Msg{};
+          c->has_lazy = false;
+          g_lazy_parked.fetch_sub(1, std::memory_order_relaxed);
+          mine = true;
+        }
+      }
+      if (mine) {
+        // About to block in this comm's ctrl read: upgrade every OTHER
+        // parked lazy first, or a multi-comm wait order could deadlock
+        // against a lazy recv only this thread would have executed later.
+        if (g_lazy_parked.load(std::memory_order_relaxed) != 0) {
+          for (auto& lc : lazy_recv_owners_.DrainAll()) UpgradeLazy(lc.get());
+        }
+        ExecuteLazyRecv(c.get(), m);
+      }
+      Status st = WaitIn(requests_, request, nbytes);
+      TPUNET_DBG("wait req=%llu lazy-exit ok=%d", (unsigned long long)request, (int)st.ok());
+      return st;
+    }
+    // Non-lazy request: while it does not settle, keep upgrading every
+    // parked lazy recv in this process. Without this, two ranks could both
+    // park in a send-wait whose completion needs the peer's lazy recv to
+    // run — a deadlock no caller ordering should be able to create. The
+    // repeat (vs one-shot) covers a lazy parked by another thread after an
+    // earlier pass; each pass is a no-op on an empty map.
+    RequestPtr state;
+    if (!requests_.Get(request, &state)) {
+      return Status::Invalid("unknown request " + std::to_string(request));
+    }
+    int spins = 0;
+    while (g_lazy_parked.load(std::memory_order_relaxed) != 0 &&
+           !state->WaitSettledFor(50)) {
+      // A lazy parked AFTER we fall through is its poster's own problem:
+      // that thread's next wait/test upgrades it (every thread that parks
+      // a lazy eventually waits something).
+      for (auto& lc : lazy_recv_owners_.DrainAll()) UpgradeLazy(lc.get());
+      if (++spins % 40 == 0) TPUNET_DBG("wait req=%llu still unsettled after %d spins (total=%llu completed=%llu failed=%d)", (unsigned long long)request, spins, (unsigned long long)state->total.load(), (unsigned long long)state->completed.load(), (int)state->failed.load());
+    }
+    Status st = WaitIn(requests_, request, nbytes);
+    TPUNET_DBG("wait req=%llu exit ok=%d", (unsigned long long)request, (int)st.ok());
+    return st;
   }
 
   Status close_send(uint64_t send_comm) override {
@@ -433,6 +683,21 @@ class BasicEngine : public EngineBase {
   }
 
  private:
+  // Move a parked lazy recv onto the scheduler queue. The Push happens
+  // UNDER lazy_mu: with it outside, a cross-thread upgrade could be
+  // preempted between claim and push while the comm's caller posts (and
+  // queues) a newer irecv, enqueueing the older recv after the newer one
+  // and pairing ctrl frames with the wrong requests.
+  static void UpgradeLazy(Comm* c) {
+    std::lock_guard<std::mutex> lk(c->lazy_mu);
+    if (!c->has_lazy) return;
+    Msg m = c->lazy_msg;
+    c->lazy_msg = Msg{};
+    c->has_lazy = false;
+    g_lazy_parked.fetch_sub(1, std::memory_order_relaxed);
+    if (!c->msgs.Push(m)) FailMsg(c, m.state, "recv comm is poisoned");
+  }
+
   void StartThreads(Comm* c) {
     bool spin = c->spin;
     for (auto& w : c->workers) {
@@ -478,9 +743,15 @@ class BasicEngine : public EngineBase {
   }
 
   bool spin_;
+  bool inline_send_;
+  bool lazy_recv_;
   IdMap<CommPtr> send_comms_;
   IdMap<CommPtr> recv_comms_;
   IdMap<RequestPtr> requests_;
+  // request id -> comm whose lazy slot holds that request. Entries are
+  // claimed (Take) by exactly one of wait/test/drain; stale entries after
+  // an irecv-triggered upgrade are benign (claimer finds has_lazy false).
+  IdMap<CommPtr> lazy_recv_owners_;
 };
 
 }  // namespace

@@ -100,6 +100,15 @@ struct RequestState {
       cv.wait_for(lk, std::chrono::milliseconds(100));
     }
   }
+  // Bounded settle-wait; returns whether the request settled. Used by the
+  // BASIC engine's wait() to detect "not settling promptly" and break any
+  // cross-request coupling with parked lazy recvs.
+  bool WaitSettledFor(int ms) {
+    std::unique_lock<std::mutex> lk(err_mu);
+    if (Done() || failed.load(std::memory_order_acquire)) return true;
+    cv.wait_for(lk, std::chrono::milliseconds(ms));
+    return Done() || failed.load(std::memory_order_acquire);
+  }
 
   std::condition_variable cv;
 };
