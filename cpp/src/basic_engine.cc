@@ -585,9 +585,11 @@ class BasicEngine : public EngineBase {
 
   Status test(uint64_t request, bool* done, size_t* nbytes) override {
     // Pollers (the NCCL shim) never call wait(), so a lazy recv would
-    // starve: upgrade it onto the scheduler on the first poll.
+    // starve: upgrade it onto the scheduler on the first poll. Match on the
+    // request id — a stale owner entry (this request was already upgraded
+    // elsewhere) must not kick a NEWER lazy parked on the same comm.
     CommPtr lc;
-    if (lazy_recv_owners_.Take(request, &lc)) UpgradeLazy(lc.get());
+    if (lazy_recv_owners_.Take(request, &lc)) UpgradeLazyIf(lc.get(), request);
     RequestPtr state;
     if (!requests_.Get(request, &state)) {
       return Status::Invalid("unknown request " + std::to_string(request));
@@ -688,9 +690,14 @@ class BasicEngine : public EngineBase {
   // preempted between claim and push while the comm's caller posts (and
   // queues) a newer irecv, enqueueing the older recv after the newer one
   // and pairing ctrl frames with the wrong requests.
-  static void UpgradeLazy(Comm* c) {
+  static void UpgradeLazy(Comm* c) { UpgradeLazyIf(c, 0); }
+
+  // expect_req != 0 restricts the upgrade to that specific parked request
+  // (test()'s stale-entry guard); 0 upgrades whatever is parked.
+  static void UpgradeLazyIf(Comm* c, uint64_t expect_req) {
     std::lock_guard<std::mutex> lk(c->lazy_mu);
     if (!c->has_lazy) return;
+    if (expect_req != 0 && c->lazy_req != expect_req) return;
     Msg m = c->lazy_msg;
     c->lazy_msg = Msg{};
     c->has_lazy = false;

@@ -263,6 +263,12 @@ class RingCommunicator : public Communicator {
   ~RingCommunicator() override {
     StopAsyncWorker();
     if (net_) {
+      for (uint64_t c : mesh_send_) {
+        if (c) net_->close_send(c);
+      }
+      for (uint64_t c : mesh_recv_) {
+        if (c) net_->close_recv(c);
+      }
       if (send_comm_) net_->close_send(send_comm_);
       if (recv_comm_) net_->close_recv(recv_comm_);
       if (listen_comm_) net_->close_listen(listen_comm_);
@@ -287,11 +293,17 @@ class RingCommunicator : public Communicator {
     s = bootstrap_->AllGather(blob, kHandleSize, &all);
     if (!s.ok()) return s;
 
+    // Keep every rank's listen handle: the pairwise AllToAll mesh is wired
+    // lazily from these on first use (the listeners stay alive for the
+    // communicator's lifetime, so no bootstrap round is needed then).
+    all_handles_.resize(world_);
+    for (int r = 0; r < world_; ++r) {
+      memcpy(&all_handles_[r].addr, all.data() + r * kHandleSize, kHandleSize);
+      all_handles_[r].addrlen = 0;  // derived from family by the engine
+    }
+
     int next = (rank_ + 1) % world_;
-    SocketHandle next_handle;
-    memcpy(&next_handle.addr, all.data() + next * kHandleSize, kHandleSize);
-    next_handle.addrlen = 0;  // derived from family by the engine
-    s = ConnectAndWire(next_handle);
+    s = ConnectAndWire(all_handles_[next]);
     if (!s.ok()) return s;
     // The bootstrap's job is done once the ring is wired; dropping it frees
     // the coordinator port and rank 0's W-1 peer sockets so long-lived jobs
@@ -449,6 +461,21 @@ class RingCommunicator : public Communicator {
       memcpy(out + rank_ * B, in + rank_ * B, B);  // own block stays local
     }
     if (W == 1 || B == 0) return Status::Ok();
+    // Direct pairwise exchange by default: O(W*B) bytes on the wire per
+    // rank vs the ring relay's O(W^2*B/2) — the difference between usable
+    // and quadratic cross-host MoE dispatch / DCN-Ulysses at pod scale.
+    // TPUNET_A2A=ring keeps the relay (no extra comms; fine at tiny W).
+    // The mesh costs 2*(W-1) comms per rank, each nstreams+1 fds and
+    // nstreams+1 threads, so very large worlds fall back to the relay
+    // rather than exhausting fds/threads; raise TPUNET_A2A_MESH_MAX_WORLD
+    // on hosts provisioned for it (the long-term fix is single-stream
+    // mesh comms, which need a per-connect nstreams override in Net).
+    static const bool use_ring = GetEnv("TPUNET_A2A", "pairwise") == "ring";
+    static const uint64_t mesh_max_world =
+        GetEnvU64("TPUNET_A2A_MESH_MAX_WORLD", 32);
+    if (!use_ring && static_cast<uint64_t>(W) <= mesh_max_world) {
+      return PairwiseAllToAll(in, out, B);
+    }
 
     // Store-and-forward relay. Packet invariant at step s: the packet holds
     // nblk = W-1-s blocks; position p carries the block with nblk-p hops of
@@ -472,6 +499,123 @@ class RingCommunicator : public Communicator {
       std::swap(a2a_fwd_, a2a_rcv_);
     }
     return Status::Ok();
+  }
+
+  // Lazily wire one send + one recv comm per peer over the listeners whose
+  // handles Init gathered. Every rank first issues all its connects (TCP
+  // backlog + buffered preamble mean connect never blocks on the peer
+  // calling accept), sends an 8-byte rank hello on each new comm, then
+  // accepts its W-1 inbound comms and reads the hellos to key them by
+  // peer — no bootstrap round, no cross-rank ordering assumption.
+  Status EnsureMesh() {
+    if (!mesh_send_.empty()) return Status::Ok();
+    const int W = world_;
+    std::vector<uint64_t> msend(W, 0), mrecv(W, 0);
+    Status result = Status::Ok();
+    for (int p = 0; p < W && result.ok(); ++p) {
+      if (p == rank_) continue;
+      result = net_->connect(0, all_handles_[p], &msend[p]);
+      if (!result.ok()) break;
+      uint8_t hello[8];
+      EncodeU64BE(static_cast<uint64_t>(rank_), hello);
+      uint64_t req = 0;
+      result = net_->isend(msend[p], hello, sizeof(hello), &req);
+      if (result.ok()) result = net_->wait(req, nullptr);
+    }
+    for (int i = 0; i < W - 1 && result.ok(); ++i) {
+      uint64_t rc = 0;
+      result = net_->accept(listen_comm_, &rc);
+      if (!result.ok()) break;
+      uint8_t hello[8] = {0};
+      uint64_t req = 0;
+      size_t got = 0;
+      result = net_->irecv(rc, hello, sizeof(hello), &req);
+      if (result.ok()) result = net_->wait(req, &got);
+      if (result.ok() && got != sizeof(hello)) {
+        result = Status::Inner("mesh hello truncated");
+      }
+      if (result.ok()) {
+        uint64_t peer = DecodeU64BE(hello);
+        if (peer >= static_cast<uint64_t>(W) || peer == static_cast<uint64_t>(rank_) ||
+            mrecv[peer] != 0) {
+          result = Status::Inner("mesh hello names invalid peer rank " +
+                                 std::to_string(peer));
+        } else {
+          mrecv[peer] = rc;
+          rc = 0;
+        }
+      }
+      if (!result.ok() && rc) net_->close_recv(rc);
+    }
+    if (!result.ok()) {
+      for (uint64_t c : msend) {
+        if (c) net_->close_send(c);
+      }
+      for (uint64_t c : mrecv) {
+        if (c) net_->close_recv(c);
+      }
+      return result;
+    }
+    mesh_send_ = std::move(msend);
+    mesh_recv_ = std::move(mrecv);
+    return Status::Ok();
+  }
+
+  // One B-sized message to every peer, one from every peer, all posted
+  // up-front on dedicated per-peer comms (so no message queues behind
+  // another), then quiesced recv-first. O(W*B) wire bytes per rank.
+  Status PairwiseAllToAll(const uint8_t* in, uint8_t* out, size_t B) {
+    Status st = EnsureMesh();
+    if (!st.ok()) return st;
+    const int W = world_;
+    // In-place callers overwrite recv block p while block p is still being
+    // sent to peer p (send/recv blocks coincide in this collective) — stage
+    // the outgoing blocks.
+    const uint8_t* src = in;
+    if (in == out) {
+      a2a_fwd_.resize(static_cast<size_t>(W) * B);
+      memcpy(a2a_fwd_.data(), in, a2a_fwd_.size());
+      src = a2a_fwd_.data();
+    }
+    std::vector<uint64_t> rreqs, sreqs;
+    std::vector<int> rpeers, speers;
+    Status first = Status::Ok();
+    for (int s = 1; s < W; ++s) {
+      int to = (rank_ + s) % W;
+      int from = (rank_ - s + W) % W;
+      uint64_t rreq = 0, sreq = 0;
+      Status a = net_->irecv(mesh_recv_[from], out + from * B, B, &rreq);
+      if (a.ok()) {
+        rreqs.push_back(rreq);
+        rpeers.push_back(from);
+      } else if (first.ok()) {
+        first = a;
+      }
+      Status b = net_->isend(mesh_send_[to], src + to * B, B, &sreq);
+      if (b.ok()) {
+        sreqs.push_back(sreq);
+        speers.push_back(to);
+      } else if (first.ok()) {
+        first = b;
+      }
+    }
+    for (size_t i = 0; i < rreqs.size(); ++i) {
+      size_t got = 0;
+      Status a = net_->wait(rreqs[i], &got);
+      if (a.ok() && got != B) {
+        a = Status::Inner("all_to_all block from rank " + std::to_string(rpeers[i]) +
+                          ": got " + std::to_string(got) + "B, want " + std::to_string(B));
+      }
+      if (!a.ok() && first.ok()) first = a;
+    }
+    for (size_t i = 0; i < sreqs.size(); ++i) {
+      Status b = net_->wait(sreqs[i], nullptr);
+      if (!b.ok() && first.ok()) {
+        first = Status{b.kind, "all_to_all send to rank " +
+                                   std::to_string(speers[i]) + ": " + b.msg};
+      }
+    }
+    return first;
   }
 
   Status NeighborExchange(const void* sendbuf, size_t send_nbytes, void* recvbuf,
@@ -739,6 +883,11 @@ class RingCommunicator : public Communicator {
   uint64_t recv_comm_ = 0;
   // Scratch buffers reused across calls; a Communicator is not thread-safe
   // (one collective at a time, like an MPI communicator).
+  // Pairwise-mesh comms for AllToAll, keyed by peer rank (0 = unwired /
+  // self). Wired lazily by EnsureMesh from all_handles_.
+  std::vector<SocketHandle> all_handles_;
+  std::vector<uint64_t> mesh_send_;
+  std::vector<uint64_t> mesh_recv_;
   std::vector<uint8_t> scratch_;
   std::vector<uint8_t> work_;
   std::vector<uint8_t> barrier_scratch_;

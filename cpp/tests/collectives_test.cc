@@ -86,6 +86,11 @@ void rank_main(int rank, const std::string& coordinator) {
   for (int j = 0; j < kWorld; ++j)
     CHECK_MSG(a2a_out[j * 256] == uint8_t(0x10 * (j + 1) + rank),
               "all_to_all block from rank %d", j);
+  // In-place: sendbuf == recvbuf (pairwise path must stage outgoing blocks).
+  CHECK_OK(tpunet_comm_all_to_all(comm, a2a_in.data(), a2a_in.data(), 256));
+  for (int j = 0; j < kWorld; ++j)
+    CHECK_MSG(a2a_in[j * 256] == uint8_t(0x10 * (j + 1) + rank),
+              "in-place all_to_all block from rank %d", j);
 
   // neighbor exchange.
   std::vector<uint8_t> ne_in(300, uint8_t(rank)), ne_out(400);
