@@ -1,0 +1,53 @@
+"""Chip-sizing sweep for the TPU headline: chained-timing MFU per config."""
+import sys, time, json
+import jax, jax.numpy as jnp
+import numpy as np
+import optax
+
+from tpunet.models import Transformer
+from tpunet.train import create_train_state, make_train_step
+
+CONFIGS = [
+    # (d_model, layers, d_ff, heads, batch, seq, remat)
+    (2048, 12, 8192, 16, 8, 2048, True),
+    (2048, 12, 8192, 16, 16, 2048, True),
+    (2048, 16, 8192, 16, 8, 2048, True),
+    (4096, 4, 16384, 32, 8, 2048, True),
+]
+which = [int(x) for x in sys.argv[1:]] or list(range(len(CONFIGS)))
+
+for ci in which:
+    d, L, ff, h, b, s, remat = CONFIGS[ci]
+    cfg = dict(vocab=32000, d_model=d, n_layers=L, n_heads=h, d_ff=ff)
+    model = Transformer(compute_dtype=jnp.bfloat16, attn_impl="flash", remat=remat, **cfg)
+    tx = optax.adamw(3e-4)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg["vocab"], (b, s)), jnp.int32)
+    labels = jnp.roll(tokens, -1, axis=1)
+    try:
+        state, _ = create_train_state(model, jax.random.PRNGKey(0), tokens, tx)
+        step = make_train_step(model, tx)  # donate=True: real-training memory profile
+        key = jax.random.PRNGKey(1)
+        # warmup: compile + 1 run, hard-synced by transfer
+        state, loss = step(state, tokens, labels, key)
+        lv = float(loss)
+        K = 8
+        t0 = time.perf_counter()
+        for _ in range(K):
+            state, loss = step(state, tokens, labels, key)
+        lv = float(loss)  # single sync: loss depends on the whole chain via state
+        dt = (time.perf_counter() - t0) / K
+    except Exception as e:
+        print(json.dumps({"cfg": ci, "error": str(e)[:200]}), flush=True)
+        continue
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+    n_matmul = n_params - cfg["vocab"] * cfg["d_model"]
+    fpt = 6 * n_matmul + 12 * L * s * d
+    fps = fpt * b * s
+    mfu = fps / dt / 197e12
+    print(json.dumps({"cfg": ci, "d": d, "L": L, "ff": ff, "b": b, "s": s,
+                      "params_M": round(n_params / 1e6, 1),
+                      "step_s": round(dt, 4),
+                      "tok_s": round(b * s / dt, 1),
+                      "tflops": round(fps / dt / 1e12, 1),
+                      "mfu": round(mfu, 4), "loss": round(lv, 3)}), flush=True)

@@ -82,11 +82,15 @@ class Communicator {
   // The request-depth design the reference transport was built to serve
   // (NCCL keeps <=8 requests in flight per comm, reference
   // cc/nccl_types.h:50): IAllReduce enqueues the collective on the
-  // communicator's internal worker thread and returns a ticket immediately,
+  // communicator's internal worker threads and returns a ticket immediately,
   // so a trainer can overlap gradient-bucket reduction with backward
-  // compute. Jobs execute one at a time in submission order (every rank
-  // must submit the same collectives in the same order — MPI semantics);
-  // tickets may be waited in any order. The caller must keep sendbuf and
+  // compute. Tickets are dispatched round-robin over TPUNET_ASYNC_CHANNELS
+  // (default 2) independent ring channels, each its own comm pair + worker,
+  // so consecutive tickets also overlap each other on the wire (ticket k+1's
+  // transfer runs while ticket k reduces). Every rank must submit the same
+  // collectives in the same order (MPI semantics) and agree on the channel
+  // count — the ticket->channel map is how peers pair messages up; tickets
+  // may be waited in any order. The caller must keep sendbuf and
   // recvbuf alive until WaitTicket returns. Blocking collectives issued
   // while tickets are outstanding implicitly fence: they wait for the
   // async queue to drain first, so mixing is well-defined.

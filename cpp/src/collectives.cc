@@ -256,8 +256,24 @@ void Reduce(void* dst, const void* src, size_t n, DType dtype, RedOp op) {
 
 // --------------------------------------------------------------------------
 
+// Tag for the 8-byte hello a lazily-wired extra ring channel sends on its
+// first message, distinguishing it from a pairwise-mesh hello (a bare rank,
+// always < world) on the shared listener.
+constexpr uint64_t kRingHelloTag = 0x52494E47ull << 32;  // "RING"
+
 class RingCommunicator : public Communicator {
  public:
+  // A channel is one independent ring: a send comm to (rank+1)%W and a recv
+  // comm from (rank-1+W)%W, plus the scratch its pipelined reduce uses.
+  // Channel 0 is wired at Init and carries every blocking collective; extra
+  // channels exist so concurrent async tickets can overlap on the wire
+  // (ticket k+1's transfer no longer waits for ticket k's reduce).
+  struct RingChannel {
+    uint64_t send_comm = 0;
+    uint64_t recv_comm = 0;
+    std::vector<uint8_t> scratch;
+  };
+
   RingCommunicator(int rank, int world) : rank_(rank), world_(world) {}
 
   ~RingCommunicator() override {
@@ -269,14 +285,17 @@ class RingCommunicator : public Communicator {
       for (uint64_t c : mesh_recv_) {
         if (c) net_->close_recv(c);
       }
-      if (send_comm_) net_->close_send(send_comm_);
-      if (recv_comm_) net_->close_recv(recv_comm_);
+      for (RingChannel& ch : channels_) {
+        if (ch.send_comm) net_->close_send(ch.send_comm);
+        if (ch.recv_comm) net_->close_recv(ch.recv_comm);
+      }
       if (listen_comm_) net_->close_listen(listen_comm_);
     }
   }
 
   Status Init(const std::string& coordinator) {
     net_ = CreateEngine();
+    channels_.resize(1);
     Status s = Bootstrap::Create(coordinator, rank_, world_, &bootstrap_);
     if (!s.ok()) return s;
     if (world_ == 1) {
@@ -313,7 +332,7 @@ class RingCommunicator : public Communicator {
   }
 
   Status ConnectAndWire(const SocketHandle& next_handle) {
-    Status s = net_->connect(0, next_handle, &send_comm_);
+    Status s = net_->connect(0, next_handle, &channels_[0].send_comm);
     if (!s.ok()) return s;
     // Barrier BEFORE accept: once it passes, every rank has connected to its
     // next, so our prev's bundle is already inbound and accept() cannot
@@ -322,17 +341,17 @@ class RingCommunicator : public Communicator {
     // bootstrap and connect hung accept indefinitely).
     s = bootstrap_->Barrier();
     if (!s.ok()) return s;
-    return net_->accept(listen_comm_, &recv_comm_);
+    return net_->accept(listen_comm_, &channels_[0].recv_comm);
   }
 
   Status AllReduce(const void* sendbuf, void* recvbuf, size_t count, DType dtype,
                    RedOp op) override {
     FenceAsync();
-    return DoAllReduce(sendbuf, recvbuf, count, dtype, op);
+    return DoAllReduce(sendbuf, recvbuf, count, dtype, op, channels_[0]);
   }
 
   Status DoAllReduce(const void* sendbuf, void* recvbuf, size_t count, DType dtype,
-                     RedOp op) {
+                     RedOp op, RingChannel& ch) {
     size_t esize = DTypeSize(dtype);
     if (esize == 0) return Status::Invalid("bad dtype");
     if (count == 0) return Status::Ok();
@@ -352,7 +371,7 @@ class RingCommunicator : public Communicator {
       size_t sbytes = (off(sidx + 1) - off(sidx)) * esize;
       size_t rbytes = (off(ridx + 1) - off(ridx)) * esize;
       Status st = ExchangeReduce(data + off(sidx) * esize, sbytes,
-                                 data + off(ridx) * esize, rbytes, dtype, op);
+                                 data + off(ridx) * esize, rbytes, dtype, op, ch);
       if (!st.ok()) return st;
     }
     for (int s = 0; s < W - 1; ++s) {
@@ -360,7 +379,8 @@ class RingCommunicator : public Communicator {
       int ridx = (rank_ - s - 1 + W) % W;
       size_t sbytes = (off(sidx + 1) - off(sidx)) * esize;
       size_t rbytes = (off(ridx + 1) - off(ridx)) * esize;
-      Status st = Exchange(data + off(sidx) * esize, sbytes, data + off(ridx) * esize, rbytes, nullptr);
+      Status st = Exchange(data + off(sidx) * esize, sbytes, data + off(ridx) * esize,
+                           rbytes, nullptr, ch);
       if (!st.ok()) return st;
     }
     return Status::Ok();
@@ -388,7 +408,7 @@ class RingCommunicator : public Communicator {
       int sidx = (vr - s + W) % W;
       int ridx = (vr - s - 1 + W) % W;
       Status st = ExchangeReduce(work_.data() + sidx * block, block,
-                                 work_.data() + ridx * block, block, dtype, op);
+                                 work_.data() + ridx * block, block, dtype, op, channels_[0]);
       if (!st.ok()) return st;
     }
     memcpy(recvbuf, work_.data() + rank_ * block, block);
@@ -407,7 +427,7 @@ class RingCommunicator : public Communicator {
       int sidx = (rank_ - s + W) % W;
       int ridx = (rank_ - s - 1 + W) % W;
       Status st = Exchange(out + sidx * bytes_per_rank, bytes_per_rank,
-                           out + ridx * bytes_per_rank, bytes_per_rank, nullptr);
+                           out + ridx * bytes_per_rank, bytes_per_rank, nullptr, channels_[0]);
       if (!st.ok()) return st;
     }
     return Status::Ok();
@@ -432,7 +452,7 @@ class RingCommunicator : public Communicator {
       size_t clen = std::min(kBcastChunk, nbytes - coff);
       if (dist != 0) {
         uint64_t rreq = 0;
-        Status st = net_->irecv(recv_comm_, data + coff, clen, &rreq);
+        Status st = net_->irecv(channels_[0].recv_comm, data + coff, clen, &rreq);
         if (!st.ok()) return DrainSends(pending_sends, st);
         size_t got = 0;
         st = WaitRequest(rreq, &got);
@@ -443,7 +463,7 @@ class RingCommunicator : public Communicator {
       }
       if (!is_tail) {
         uint64_t sreq = 0;
-        Status st = net_->isend(send_comm_, data + coff, clen, &sreq);
+        Status st = net_->isend(channels_[0].send_comm, data + coff, clen, &sreq);
         if (!st.ok()) return DrainSends(pending_sends, st);
         pending_sends.push_back(sreq);
       }
@@ -492,7 +512,8 @@ class RingCommunicator : public Communicator {
     }
     for (int s = 0; s < W - 1; ++s) {
       size_t nblk = static_cast<size_t>(W - 1 - s);
-      Status st = Exchange(a2a_fwd_.data(), nblk * B, a2a_rcv_.data(), nblk * B, nullptr);
+      Status st = Exchange(a2a_fwd_.data(), nblk * B, a2a_rcv_.data(), nblk * B, nullptr,
+                           channels_[0]);
       if (!st.ok()) return st;
       int src = (rank_ - s - 1 + W) % W;
       memcpy(out + src * B, a2a_rcv_.data() + (nblk - 1) * B, B);
@@ -627,7 +648,7 @@ class RingCommunicator : public Communicator {
       if (got) *got = send_nbytes;
       return Status::Ok();
     }
-    return Exchange(sendbuf, send_nbytes, recvbuf, recv_nbytes, got);
+    return Exchange(sendbuf, send_nbytes, recvbuf, recv_nbytes, got, channels_[0]);
   }
 
   Status Barrier() override {
@@ -641,15 +662,29 @@ class RingCommunicator : public Communicator {
                     RedOp op, uint64_t* ticket) override {
     std::unique_lock<std::mutex> lk(async_mu_);
     if (!worker_started_) {
+      // First async collective: wire the extra channels and spawn one worker
+      // per channel. Safe to touch the listener here — the communicator runs
+      // one collective program, so every rank reaches its first IAllReduce at
+      // the same point of it and nothing else is mid-accept.
+      Status s = EnsureAsyncChannels(AsyncChannelCount());
+      if (!s.ok()) return s;
+      queues_.resize(channels_.size());
+      running_.assign(channels_.size(), 0);
       worker_started_ = true;
-      worker_ = std::thread([this] { AsyncWorkerLoop(); });
+      for (size_t c = 0; c < channels_.size(); ++c) {
+        workers_.emplace_back([this, c] { AsyncWorkerLoop(c); });
+      }
     }
     uint64_t t = next_ticket_++;
-    queue_.emplace_back(t, [this, sendbuf, recvbuf, count, dtype, op] {
-      return DoAllReduce(sendbuf, recvbuf, count, dtype, op);
+    // Deterministic ticket→channel map: submission order is already the
+    // cross-rank contract for nonblocking collectives, so every rank routes
+    // ticket t to the same ring and messages pair up peer-to-peer.
+    size_t ch = (t - 1) % queues_.size();
+    queues_[ch].emplace_back(t, [this, sendbuf, recvbuf, count, dtype, op, ch] {
+      return DoAllReduce(sendbuf, recvbuf, count, dtype, op, channels_[ch]);
     });
     *ticket = t;
-    work_cv_.notify_one();
+    work_cv_.notify_all();
     return Status::Ok();
   }
 
@@ -690,14 +725,14 @@ class RingCommunicator : public Communicator {
   // chunk i's Reduce overlaps chunk i+1's transfer. Double-buffered scratch;
   // all in-flight requests are quiesced before returning, even on error.
   Status ExchangeReduce(const uint8_t* sendbuf, size_t send_nbytes, uint8_t* accum,
-                        size_t recv_nbytes, DType dtype, RedOp op) {
+                        size_t recv_nbytes, DType dtype, RedOp op, RingChannel& ch) {
     size_t esize = DTypeSize(dtype);
     size_t chunk = RingChunkBytes() / esize * esize;
     if (chunk == 0 || (send_nbytes <= chunk && recv_nbytes <= chunk)) {
-      scratch_.resize(std::max(scratch_.size(), recv_nbytes));
-      Status st = Exchange(sendbuf, send_nbytes, scratch_.data(), recv_nbytes, nullptr);
+      ch.scratch.resize(std::max(ch.scratch.size(), recv_nbytes));
+      Status st = Exchange(sendbuf, send_nbytes, ch.scratch.data(), recv_nbytes, nullptr, ch);
       if (!st.ok()) return st;
-      Reduce(accum, scratch_.data(), recv_nbytes / esize, dtype, op);
+      Reduce(accum, ch.scratch.data(), recv_nbytes / esize, dtype, op);
       return Status::Ok();
     }
     // Send and recv slice sizes can differ (ring slices are count*i/W
@@ -707,7 +742,7 @@ class RingCommunicator : public Communicator {
     size_t ns = (send_nbytes + chunk - 1) / chunk;
     size_t nr = (recv_nbytes + chunk - 1) / chunk;
     size_t n = std::max(ns, nr);
-    scratch_.resize(2 * chunk);
+    ch.scratch.resize(2 * chunk);
     auto slen = [&](size_t i) { return std::min(chunk, send_nbytes - i * chunk); };
     auto rlen = [&](size_t i) { return std::min(chunk, recv_nbytes - i * chunk); };
 
@@ -716,12 +751,13 @@ class RingCommunicator : public Communicator {
     auto post = [&](size_t i) -> Status {
       int slot = i & 1;
       if (i < nr) {
-        Status st = net_->irecv(recv_comm_, scratch_.data() + slot * chunk, rlen(i), &rreq[slot]);
+        Status st =
+            net_->irecv(ch.recv_comm, ch.scratch.data() + slot * chunk, rlen(i), &rreq[slot]);
         if (!st.ok()) return st;
         rlive[slot] = true;
       }
       if (i < ns) {
-        Status st = net_->isend(send_comm_, sendbuf + i * chunk, slen(i), &sreq[slot]);
+        Status st = net_->isend(ch.send_comm, sendbuf + i * chunk, slen(i), &sreq[slot]);
         if (!st.ok()) return st;
         slive[slot] = true;
       }
@@ -757,7 +793,7 @@ class RingCommunicator : public Communicator {
         if (!st.ok()) return quiesce(st);
       }
       if (has_r) {
-        Reduce(accum + i * chunk, scratch_.data() + slot * chunk, rlen(i) / esize, dtype, op);
+        Reduce(accum + i * chunk, ch.scratch.data() + slot * chunk, rlen(i) / esize, dtype, op);
       }
       if (i < ns) {
         st = WaitRequest(sreq[slot], nullptr);
@@ -775,11 +811,11 @@ class RingCommunicator : public Communicator {
   // the step is fixed-size and a short receive (ranks disagreeing on counts)
   // is an error, not silent stale-tail corruption.
   Status Exchange(const void* sendbuf, size_t send_nbytes, void* recvbuf, size_t recv_nbytes,
-                  size_t* got) {
+                  size_t* got, RingChannel& ch) {
     uint64_t rreq = 0, sreq = 0;
-    Status st = net_->irecv(recv_comm_, recvbuf, recv_nbytes, &rreq);
+    Status st = net_->irecv(ch.recv_comm, recvbuf, recv_nbytes, &rreq);
     if (!st.ok()) return st;
-    st = net_->isend(send_comm_, sendbuf, send_nbytes, &sreq);
+    st = net_->isend(ch.send_comm, sendbuf, send_nbytes, &sreq);
     if (!st.ok()) {
       WaitRequest(rreq, nullptr);  // quiesce the posted recv before unwinding
       return st;
@@ -813,29 +849,103 @@ class RingCommunicator : public Communicator {
 
   // -- async worker machinery ---------------------------------------------
 
+  // Number of independent async ring channels (and worker threads). Each
+  // extra channel is one more comm pair per rank — with two, bucket k+1's
+  // ring transfer runs while bucket k reduces, and the two transfers share
+  // the NIC instead of serializing behind a single worker. Must agree across
+  // ranks (it changes how many wiring connects each peer expects).
+  static size_t AsyncChannelCount() {
+    static const size_t v = [] {
+      uint64_t n = GetEnvU64("TPUNET_ASYNC_CHANNELS", 2);
+      return static_cast<size_t>(std::min<uint64_t>(std::max<uint64_t>(n, 1), 8));
+    }();
+    return v;
+  }
+
+  // Wire ring channels [channels_.size(), nch): connect to next with a
+  // channel-tagged hello, then accept the matching connects from prev off
+  // the shared listener. Connect never blocks on the peer's accept (TCP
+  // backlog + the engine's buffered preamble), so connect-all-then-accept-all
+  // cannot deadlock; the hello keys each inbound comm to its channel so
+  // accept-order races cannot cross-wire rings. Runs once, on the caller
+  // thread of the first IAllReduce, before any worker exists.
+  Status EnsureAsyncChannels(size_t nch) {
+    if (!async_wire_status_.ok()) return async_wire_status_;
+    if (channels_.size() >= nch || world_ == 1) return Status::Ok();
+    const int next = (rank_ + 1) % world_;
+    const size_t base = channels_.size();
+    channels_.resize(nch);
+    Status result = Status::Ok();
+    for (size_t c = base; c < nch && result.ok(); ++c) {
+      result = net_->connect(0, all_handles_[next], &channels_[c].send_comm);
+      if (!result.ok()) break;
+      uint8_t hello[8];
+      EncodeU64BE(kRingHelloTag | c, hello);
+      uint64_t req = 0;
+      result = net_->isend(channels_[c].send_comm, hello, sizeof(hello), &req);
+      if (result.ok()) result = net_->wait(req, nullptr);
+    }
+    for (size_t i = base; i < nch && result.ok(); ++i) {
+      uint64_t rc = 0;
+      result = net_->accept(listen_comm_, &rc);
+      if (!result.ok()) break;
+      uint8_t hello[8] = {0};
+      uint64_t req = 0;
+      size_t got = 0;
+      result = net_->irecv(rc, hello, sizeof(hello), &req);
+      if (result.ok()) result = net_->wait(req, &got);
+      if (result.ok() && got != sizeof(hello)) {
+        result = Status::Inner("channel hello truncated");
+      }
+      if (result.ok()) {
+        uint64_t h = DecodeU64BE(hello);
+        uint64_t c = h & 0xFFFFFFFFull;
+        if ((h & ~0xFFFFFFFFull) != kRingHelloTag || c < base || c >= nch ||
+            channels_[c].recv_comm != 0) {
+          result = Status::Inner("unexpected channel hello " + std::to_string(h));
+        } else {
+          channels_[c].recv_comm = rc;
+          rc = 0;
+        }
+      }
+      if (!result.ok() && rc) net_->close_recv(rc);
+    }
+    if (!result.ok()) {
+      // Peers may have wired a subset — the communicator's channel state is
+      // inconsistent across ranks and cannot be retried; fail every later
+      // async call the same way. Partially-wired comms close in ~RingComm.
+      async_wire_status_ = result;
+    }
+    return result;
+  }
+
   // Caller holds async_mu_. A ticket is live (waitable) if it is queued,
   // currently executing, or completed-but-unclaimed.
   bool TicketLive(uint64_t ticket) {
     if (done_.count(ticket)) return true;
-    if (running_ticket_ == ticket) return true;
-    for (const auto& job : queue_) {
-      if (job.first == ticket) return true;
+    for (uint64_t r : running_) {
+      if (r == ticket) return true;
+    }
+    for (const auto& q : queues_) {
+      for (const auto& job : q) {
+        if (job.first == ticket) return true;
+      }
     }
     return false;
   }
 
-  void AsyncWorkerLoop() {
+  void AsyncWorkerLoop(size_t ch) {
     std::unique_lock<std::mutex> lk(async_mu_);
     while (true) {
-      work_cv_.wait(lk, [&] { return stop_ || !queue_.empty(); });
+      work_cv_.wait(lk, [&] { return stop_ || !queues_[ch].empty(); });
       if (stop_) return;
-      auto job = std::move(queue_.front());
-      queue_.pop_front();
-      running_ticket_ = job.first;
+      auto job = std::move(queues_[ch].front());
+      queues_[ch].pop_front();
+      running_[ch] = job.first;
       lk.unlock();
       Status s = job.second();  // the ring collective, off the caller thread
       lk.lock();
-      running_ticket_ = 0;
+      running_[ch] = 0;
       done_[job.first] = s;
       done_cv_.notify_all();  // wakes WaitTicket and FenceAsync
     }
@@ -846,7 +956,15 @@ class RingCommunicator : public Communicator {
   void FenceAsync() {
     std::unique_lock<std::mutex> lk(async_mu_);
     if (!worker_started_) return;
-    done_cv_.wait(lk, [&] { return queue_.empty() && running_ticket_ == 0; });
+    done_cv_.wait(lk, [&] {
+      for (const auto& q : queues_) {
+        if (!q.empty()) return false;
+      }
+      for (uint64_t r : running_) {
+        if (r != 0) return false;
+      }
+      return true;
+    });
   }
 
   void StopAsyncWorker() {
@@ -854,18 +972,20 @@ class RingCommunicator : public Communicator {
       std::unique_lock<std::mutex> lk(async_mu_);
       if (!worker_started_) return;
       // Destroying with queued work is a caller error (peers would be left
-      // mid-collective); the running job finishes, queued jobs fail their
+      // mid-collective); the running jobs finish, queued jobs fail their
       // tickets so any blocked WaitTicket returns an error instead of
       // sleeping forever.
       stop_ = true;
-      for (auto& job : queue_) {
-        done_[job.first] = Status::Inner("communicator destroyed with pending collectives");
+      for (auto& q : queues_) {
+        for (auto& job : q) {
+          done_[job.first] = Status::Inner("communicator destroyed with pending collectives");
+        }
+        q.clear();
       }
-      queue_.clear();
       work_cv_.notify_all();
       done_cv_.notify_all();
     }
-    worker_.join();
+    for (std::thread& w : workers_) w.join();
   }
 
   Status WaitRequest(uint64_t req, size_t* nbytes) {
@@ -879,8 +999,10 @@ class RingCommunicator : public Communicator {
   std::unique_ptr<Net> net_;
   std::unique_ptr<Bootstrap> bootstrap_;
   uint64_t listen_comm_ = 0;
-  uint64_t send_comm_ = 0;
-  uint64_t recv_comm_ = 0;
+  // channels_[0] is the Init-wired ring every blocking collective uses;
+  // channels_[1..] are wired by EnsureAsyncChannels for overlapping async
+  // tickets. Stable after the first IAllReduce (workers capture indices).
+  std::vector<RingChannel> channels_;
   // Scratch buffers reused across calls; a Communicator is not thread-safe
   // (one collective at a time, like an MPI communicator).
   // Pairwise-mesh comms for AllToAll, keyed by peer rank (0 = unwired /
@@ -888,22 +1010,22 @@ class RingCommunicator : public Communicator {
   std::vector<SocketHandle> all_handles_;
   std::vector<uint64_t> mesh_send_;
   std::vector<uint64_t> mesh_recv_;
-  std::vector<uint8_t> scratch_;
   std::vector<uint8_t> work_;
   std::vector<uint8_t> barrier_scratch_;
   std::vector<uint8_t> a2a_fwd_, a2a_rcv_;
-  // Async (nonblocking-collective) state; async_mu_ guards all of it. The
-  // worker thread is the only place async jobs touch the comms/scratch, and
-  // FenceAsync keeps the sync paths out while it runs.
+  // Async (nonblocking-collective) state; async_mu_ guards all of it. Worker
+  // c is the only place async jobs touch channel c's comms/scratch, and
+  // FenceAsync keeps the sync paths out while any job runs.
   std::mutex async_mu_;
   std::condition_variable work_cv_, done_cv_;
-  std::deque<std::pair<uint64_t, std::function<Status()>>> queue_;
+  std::vector<std::deque<std::pair<uint64_t, std::function<Status()>>>> queues_;
+  std::vector<uint64_t> running_;
   std::map<uint64_t, Status> done_;
+  Status async_wire_status_ = Status::Ok();
   uint64_t next_ticket_ = 1;
-  uint64_t running_ticket_ = 0;
   bool worker_started_ = false;
   bool stop_ = false;
-  std::thread worker_;
+  std::vector<std::thread> workers_;
 };
 
 }  // namespace

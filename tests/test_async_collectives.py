@@ -61,6 +61,23 @@ def test_iall_reduce_2proc():
     run_spawn_workers(_worker, 2)
 
 
+def test_iall_reduce_channel_sweep_2proc():
+    # The ticket->channel round-robin must agree across ranks for any channel
+    # count: run the same out-of-order-wait worker on a 1-ring (serial, the
+    # round-2 behavior) and a 4-ring communicator. Spawn children inherit the
+    # env; the C++ layer reads TPUNET_ASYNC_CHANNELS once per process.
+    for nch in ("1", "4"):
+        old = os.environ.get("TPUNET_ASYNC_CHANNELS")
+        os.environ["TPUNET_ASYNC_CHANNELS"] = nch
+        try:
+            run_spawn_workers(_worker, 2)
+        finally:
+            if old is None:
+                del os.environ["TPUNET_ASYNC_CHANNELS"]
+            else:
+                os.environ["TPUNET_ASYNC_CHANNELS"] = old
+
+
 def test_bogus_ticket_errors():
     from tpunet.collectives import Communicator
 

@@ -66,6 +66,20 @@ class Config:
     # Transient connect failures retry with exponential backoff inside this
     # window (ms; 0 = fail fast). Covers a peer restarting its listener.
     connect_retry_ms: int = 10_000
+    # Independent ring channels for nonblocking collectives: ticket t runs on
+    # channel (t-1) % async_channels, so consecutive gradient buckets overlap
+    # on the wire. Must agree across ranks.
+    async_channels: int = 2
+    # AllToAll algorithm: "pairwise" (direct per-peer comms, O(W*B) wire
+    # bytes) or "ring" (store-and-forward relay, no extra comms).
+    a2a: str = "pairwise"
+    # Worlds larger than this fall back to the ring relay rather than paying
+    # 2*(W-1) comm bundles of fds/threads per rank for the pairwise mesh.
+    a2a_mesh_max_world: int = 32
+    # BASIC-engine caller-thread fast paths (1 = on): inline isend dispatch
+    # on an idle comm, and lazily-parked irecv whose wait() runs inline.
+    inline_send: bool = True
+    lazy_recv: bool = True
 
     @staticmethod
     def from_env() -> "Config":
@@ -93,4 +107,9 @@ class Config:
             keepalive_intvl_s=_env_int("TPUNET_KEEPALIVE_INTVL_S", 10),
             keepalive_cnt=_env_int("TPUNET_KEEPALIVE_CNT", 3),
             connect_retry_ms=_env_int("TPUNET_CONNECT_RETRY_MS", 10_000),
+            async_channels=_env_int("TPUNET_ASYNC_CHANNELS", 2),
+            a2a=env.get("TPUNET_A2A", "pairwise"),
+            a2a_mesh_max_world=_env_int("TPUNET_A2A_MESH_MAX_WORLD", 32),
+            inline_send=env.get("TPUNET_INLINE_SEND", "1") not in ("", "0", "false"),
+            lazy_recv=env.get("TPUNET_LAZY_RECV", "1") not in ("", "0", "false"),
         )
