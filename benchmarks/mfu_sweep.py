@@ -1,0 +1,74 @@
+"""Chip-sizing sweep: chained-timing MFU per transformer config on the
+local accelerator. This is the tool that sized `tpu_headline`'s TPU config
+(round-3 numbers recorded in PERF_NOTES.md): run it when the bench hardware
+changes to re-pick the headline shape.
+
+Usage: python -m benchmarks.mfu_sweep [config indices...]
+Prints one JSON line per config: params, step time, tokens/s, TFLOP/s, MFU
+(against the device's peak bf16 FLOP/s; null off-TPU or unknown kind).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+CONFIGS = [
+    # (d_model, layers, d_ff, heads, batch, seq, remat)
+    (2048, 12, 8192, 16, 8, 2048, True),   # the round-3 v5e headline winner
+    (2048, 12, 8192, 16, 16, 2048, True),
+    (2048, 16, 8192, 16, 8, 2048, True),   # OOM on 16 GB v5e
+    (4096, 4, 16384, 32, 8, 2048, True),   # OOM on 16 GB v5e
+    (1024, 12, 4096, 16, 16, 2048, True),  # half-size, for smaller chips
+]
+
+
+def main(argv=None) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from benchmarks import chained_step_time
+    from benchmarks.tpu_headline import _peak_for, transformer_flops_per_token
+    from tpunet.models import Transformer
+    from tpunet.train import create_train_state, make_train_step
+
+    args = argv if argv is not None else sys.argv[1:]
+    which = [int(x) for x in args] or list(range(len(CONFIGS)))
+    dev = jax.devices()[0]
+    peak = _peak_for(dev.device_kind) if dev.platform == "tpu" else None
+
+    for ci in which:
+        d, n_layers, ff, heads, batch, seq, remat = CONFIGS[ci]
+        cfg = dict(vocab=32000, d_model=d, n_layers=n_layers, n_heads=heads, d_ff=ff)
+        model = Transformer(compute_dtype=jnp.bfloat16, attn_impl="flash",
+                            remat=remat, **cfg)
+        tx = optax.adamw(3e-4)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, cfg["vocab"], (batch, seq)), jnp.int32)
+        labels = jnp.roll(tokens, -1, axis=1)
+        try:
+            state, _ = create_train_state(model, jax.random.PRNGKey(0), tokens, tx)
+            n_params = sum(x.size for x in jax.tree.leaves(state.params))
+            step = make_train_step(model, tx)  # donated: real-training memory
+            dt = chained_step_time(step, state,
+                                   (tokens, labels, jax.random.PRNGKey(1)),
+                                   warmup=1, iters=8)
+        except Exception as e:  # noqa: BLE001 — a config OOMing is a result
+            print(json.dumps({"cfg": ci, "error": str(e)[:200]}), flush=True)
+            continue
+        fpt = transformer_flops_per_token(n_params, cfg["vocab"], d, n_layers, seq)
+        fps = fpt * batch * seq
+        print(json.dumps({
+            "cfg": ci, "d": d, "L": n_layers, "ff": ff, "b": batch, "s": seq,
+            "params_M": round(n_params / 1e6, 1),
+            "step_s": round(dt, 4),
+            "tok_s": round(batch * seq / dt, 1),
+            "tflops": round(fps / dt / 1e12, 1),
+            "mfu": round(fps / dt / peak, 4) if peak else None,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -19,10 +19,7 @@ from __future__ import annotations
 
 import argparse
 import json
-import math
 import re
-import statistics
-import time
 
 # Exact device-kind -> peak bf16 FLOP/s per chip. jax reports kinds like
 # "TPU v4", "TPU v5 lite", "TPU v5p", "TPU v6 lite"; _peak_for normalizes
@@ -42,6 +39,17 @@ PEAK_FLOPS = {
 }
 
 
+def transformer_flops_per_token(n_params: int, vocab: int, d_model: int,
+                                n_layers: int, seq: int) -> float:
+    """Analytic train-step FLOPs per token: 6*N over the matmul params
+    (embedding table excluded — a lookup, not a matmul; lm_head included)
+    + attention 12*L*S*d_model (QK^T and PV, fwd+bwd). Chinchilla-appendix
+    convention; shared with benchmarks.mfu_sweep so the sweep scores with
+    exactly the headline's accounting."""
+    n_matmul = n_params - vocab * d_model
+    return 6 * n_matmul + 12 * n_layers * seq * d_model
+
+
 def _peak_for(kind: str) -> float | None:
     k = kind.lower().strip()
     if k.startswith("tpu"):
@@ -54,25 +62,6 @@ def _peak_for(kind: str) -> float | None:
     return PEAK_FLOPS.get(re.sub(r"\d+$", "", k).strip())
 
 
-def _time_steps(step_fn, state, args, warmup: int, iters: int):
-    import jax
-
-    loss = None
-    for _ in range(warmup):
-        state, loss = step_fn(state, *args)
-    if loss is not None:
-        jax.block_until_ready(loss)
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        state, loss = step_fn(state, *args)
-        jax.block_until_ready(loss)
-        times.append(time.perf_counter() - t0)
-    if not math.isfinite(float(loss)):
-        raise RuntimeError("non-finite loss in headline bench")
-    return times
-
-
 def transformer_bench(on_tpu: bool, attn: str = "flash") -> tuple[float, float | None]:
     """Returns (tokens_per_s, mfu|None). bf16 + `attn` attention on TPU —
     bench.py passes attn="reference" when the flash kernel smoke failed,
@@ -82,39 +71,43 @@ def transformer_bench(on_tpu: bool, attn: str = "flash") -> tuple[float, float |
     import numpy as np
     import optax
 
+    from benchmarks import chained_step_time
     from tpunet.models import Transformer
     from tpunet.train import create_train_state, make_train_step
 
     if on_tpu:
-        cfg = dict(vocab=32000, d_model=512, n_layers=8, n_heads=8, d_ff=2048)
-        batch, seq = 8, 1024
+        # Sized to one v5e-class chip (benchmarks.mfu_sweep results in
+        # PERF_NOTES.md): ~735M params + f32 adamw fills most of HBM under
+        # donation; measured 0.41 MFU with flash + remat. The swept
+        # alternatives — batch 16 (0.40), L16 and d4096 (both OOM) — lost.
+        cfg = dict(vocab=32000, d_model=2048, n_layers=12, n_heads=16, d_ff=8192)
+        batch, seq = 8, 2048
         dtype = jnp.bfloat16
+        remat = True
     else:  # smoke-size: one CPU core must finish in seconds
         cfg = dict(vocab=512, d_model=64, n_layers=2, n_heads=4, d_ff=128)
         batch, seq = 2, 128
         dtype = jnp.float32
         attn = "reference"
+        remat = False
 
-    model = Transformer(compute_dtype=dtype, attn_impl=attn, **cfg)
+    model = Transformer(compute_dtype=dtype, attn_impl=attn, remat=remat, **cfg)
     tx = optax.adamw(3e-4)
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, cfg["vocab"], (batch, seq)), jnp.int32)
     labels = jnp.roll(tokens, -1, axis=1)
     state, _ = create_train_state(model, jax.random.PRNGKey(0), tokens, tx)
-    step = make_train_step(model, tx, donate=False)
+    # donate=True is the real-training memory profile — without it the chip
+    # must hold two optimizer states and the chip-sized config OOMs.
+    step = make_train_step(model, tx)
 
-    times = _time_steps(step, state, (tokens, labels, jax.random.PRNGKey(1)),
-                        warmup=2, iters=5)
-    dt = statistics.median(times)
+    dt = chained_step_time(step, state, (tokens, labels, jax.random.PRNGKey(1)),
+                           warmup=2, iters=8 if on_tpu else 5)
     tokens_per_s = batch * seq / dt
 
-    # Analytic FLOPs: 6*N per token over the matmul params (embedding table
-    # excluded — a lookup, not a matmul; lm_head included) + attention
-    # 12*L*S*d_model per token (QK^T and PV, fwd+bwd).
     n_params = sum(x.size for x in jax.tree.leaves(state.params))
-    n_embed = cfg["vocab"] * cfg["d_model"]
-    n_matmul = n_params - n_embed
-    flops_per_token = 6 * n_matmul + 12 * cfg["n_layers"] * seq * cfg["d_model"]
+    flops_per_token = transformer_flops_per_token(
+        n_params, cfg["vocab"], cfg["d_model"], cfg["n_layers"], seq)
     flops_per_step = flops_per_token * batch * seq
     kind = jax.devices()[0].device_kind
     peak = _peak_for(kind) if on_tpu else None
@@ -146,11 +139,13 @@ def vgg_bench(on_tpu: bool) -> float:
     images, labels = synthetic_batch(rng, batch, size, 1000 if on_tpu else 16)
     images, labels = jnp.asarray(images), jnp.asarray(labels)
     state, _ = create_train_state(model, jax.random.PRNGKey(0), images, tx)
-    step = make_train_step(model, tx, donate=False)
+    step = make_train_step(model, tx)
 
-    times = _time_steps(step, state, (images, labels, jax.random.PRNGKey(1)),
-                        warmup=2, iters=5)
-    return batch / statistics.median(times)
+    from benchmarks import chained_step_time
+
+    dt = chained_step_time(step, state, (images, labels, jax.random.PRNGKey(1)),
+                           warmup=2, iters=8 if on_tpu else 5)
+    return batch / dt
 
 
 def main(argv=None) -> None:

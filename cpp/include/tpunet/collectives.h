@@ -49,7 +49,10 @@ class Communicator {
   static Status Create(const std::string& coordinator, int rank, int world_size,
                        std::unique_ptr<Communicator>* out);
 
-  // sendbuf may equal recvbuf (in-place). count = elements.
+  // sendbuf may equal recvbuf (in-place). count = elements. Blocking
+  // AllReduce is exactly IAllReduce+WaitTicket (MPI/NCCL matching rule:
+  // one rank's blocking call pairs with another's nonblocking one), so both
+  // forms share one ticket sequence and channel schedule.
   virtual Status AllReduce(const void* sendbuf, void* recvbuf, size_t count,
                            DType dtype, RedOp op) = 0;
   // sendbuf holds world*recv_count elements; recvbuf gets this rank's

@@ -344,10 +344,30 @@ class RingCommunicator : public Communicator {
     return net_->accept(listen_comm_, &channels_[0].recv_comm);
   }
 
+  // Blocking AllReduce IS IAllReduce + WaitTicket. This is not a
+  // convenience: the cross-rank matching rule (MPI/NCCL semantics) lets one
+  // rank call AllReduce where another calls IAllReduce+wait for the same
+  // collective, so BOTH kinds must consume the same ticket sequence — the
+  // ticket->channel map is what pairs ring messages across ranks, and a
+  // blocking call that bypassed it would desync (and never wire channels on
+  // ranks that only ever call the blocking form).
   Status AllReduce(const void* sendbuf, void* recvbuf, size_t count, DType dtype,
                    RedOp op) override {
+    // Single-channel mode: everything rides channel 0 in submission order,
+    // so pairing cannot desync and the caller thread can run the ring
+    // directly (no worker hop) — also the kill switch for the ticketed path.
+    if (AsyncChannelCount() == 1) {
+      FenceAsync();
+      return DoAllReduce(sendbuf, recvbuf, count, dtype, op, channels_[0]);
+    }
+    // Fence first: the documented contract is that a blocking collective
+    // orders AFTER all outstanding tickets (callers rely on it for buffer
+    // reuse). Fencing consumes no ticket, so it cannot desync pairing.
     FenceAsync();
-    return DoAllReduce(sendbuf, recvbuf, count, dtype, op, channels_[0]);
+    uint64_t ticket = 0;
+    Status s = IAllReduce(sendbuf, recvbuf, count, dtype, op, &ticket);
+    if (!s.ok()) return s;
+    return WaitTicket(ticket);
   }
 
   Status DoAllReduce(const void* sendbuf, void* recvbuf, size_t count, DType dtype,
@@ -522,6 +542,42 @@ class RingCommunicator : public Communicator {
     return Status::Ok();
   }
 
+  // Accept one inbound comm off the shared listener and read its 8-byte
+  // identifying hello. On failure the comm (if any) is closed. Shared by
+  // the two lazy wiring paths (pairwise mesh, async ring channels), which
+  // differ only in how they encode/validate the hello.
+  Status AcceptHello(uint64_t* rc, uint64_t* hello) {
+    *rc = 0;
+    Status s = net_->accept(listen_comm_, rc);
+    if (!s.ok()) return s;
+    uint8_t buf[8] = {0};
+    uint64_t req = 0;
+    size_t got = 0;
+    s = net_->irecv(*rc, buf, sizeof(buf), &req);
+    if (s.ok()) s = net_->wait(req, &got);
+    if (s.ok() && got != sizeof(buf)) s = Status::Inner("wiring hello truncated");
+    if (!s.ok()) {
+      net_->close_recv(*rc);
+      *rc = 0;
+      return s;
+    }
+    *hello = DecodeU64BE(buf);
+    return Status::Ok();
+  }
+
+  // Connect to a peer's listener and identify the new comm with an 8-byte
+  // hello — the other half of AcceptHello.
+  Status ConnectHello(int peer, uint64_t hello, uint64_t* comm) {
+    Status s = net_->connect(0, all_handles_[peer], comm);
+    if (!s.ok()) return s;
+    uint8_t buf[8];
+    EncodeU64BE(hello, buf);
+    uint64_t req = 0;
+    s = net_->isend(*comm, buf, sizeof(buf), &req);
+    if (s.ok()) s = net_->wait(req, nullptr);
+    return s;
+  }
+
   // Lazily wire one send + one recv comm per peer over the listeners whose
   // handles Init gathered. Every rank first issues all its connects (TCP
   // backlog + buffered preamble mean connect never blocks on the peer
@@ -535,38 +591,20 @@ class RingCommunicator : public Communicator {
     Status result = Status::Ok();
     for (int p = 0; p < W && result.ok(); ++p) {
       if (p == rank_) continue;
-      result = net_->connect(0, all_handles_[p], &msend[p]);
-      if (!result.ok()) break;
-      uint8_t hello[8];
-      EncodeU64BE(static_cast<uint64_t>(rank_), hello);
-      uint64_t req = 0;
-      result = net_->isend(msend[p], hello, sizeof(hello), &req);
-      if (result.ok()) result = net_->wait(req, nullptr);
+      result = ConnectHello(p, static_cast<uint64_t>(rank_), &msend[p]);
     }
     for (int i = 0; i < W - 1 && result.ok(); ++i) {
-      uint64_t rc = 0;
-      result = net_->accept(listen_comm_, &rc);
+      uint64_t rc = 0, peer = 0;
+      result = AcceptHello(&rc, &peer);
       if (!result.ok()) break;
-      uint8_t hello[8] = {0};
-      uint64_t req = 0;
-      size_t got = 0;
-      result = net_->irecv(rc, hello, sizeof(hello), &req);
-      if (result.ok()) result = net_->wait(req, &got);
-      if (result.ok() && got != sizeof(hello)) {
-        result = Status::Inner("mesh hello truncated");
+      if (peer >= static_cast<uint64_t>(W) || peer == static_cast<uint64_t>(rank_) ||
+          mrecv[peer] != 0) {
+        net_->close_recv(rc);
+        result = Status::Inner("mesh hello names invalid peer rank " +
+                               std::to_string(peer));
+      } else {
+        mrecv[peer] = rc;
       }
-      if (result.ok()) {
-        uint64_t peer = DecodeU64BE(hello);
-        if (peer >= static_cast<uint64_t>(W) || peer == static_cast<uint64_t>(rank_) ||
-            mrecv[peer] != 0) {
-          result = Status::Inner("mesh hello names invalid peer rank " +
-                                 std::to_string(peer));
-        } else {
-          mrecv[peer] = rc;
-          rc = 0;
-        }
-      }
-      if (!result.ok() && rc) net_->close_recv(rc);
     }
     if (!result.ok()) {
       for (uint64_t c : msend) {
@@ -877,38 +915,31 @@ class RingCommunicator : public Communicator {
     channels_.resize(nch);
     Status result = Status::Ok();
     for (size_t c = base; c < nch && result.ok(); ++c) {
-      result = net_->connect(0, all_handles_[next], &channels_[c].send_comm);
-      if (!result.ok()) break;
-      uint8_t hello[8];
-      EncodeU64BE(kRingHelloTag | c, hello);
-      uint64_t req = 0;
-      result = net_->isend(channels_[c].send_comm, hello, sizeof(hello), &req);
-      if (result.ok()) result = net_->wait(req, nullptr);
+      result = ConnectHello(next, kRingHelloTag | c, &channels_[c].send_comm);
     }
     for (size_t i = base; i < nch && result.ok(); ++i) {
-      uint64_t rc = 0;
-      result = net_->accept(listen_comm_, &rc);
+      uint64_t rc = 0, h = 0;
+      result = AcceptHello(&rc, &h);
       if (!result.ok()) break;
-      uint8_t hello[8] = {0};
-      uint64_t req = 0;
-      size_t got = 0;
-      result = net_->irecv(rc, hello, sizeof(hello), &req);
-      if (result.ok()) result = net_->wait(req, &got);
-      if (result.ok() && got != sizeof(hello)) {
-        result = Status::Inner("channel hello truncated");
+      uint64_t c = h & 0xFFFFFFFFull;
+      if ((h & ~0xFFFFFFFFull) != kRingHelloTag || c < base || c >= nch ||
+          channels_[c].recv_comm != 0) {
+        net_->close_recv(rc);
+        result = Status::Inner("unexpected channel hello " + std::to_string(h));
+      } else {
+        channels_[c].recv_comm = rc;
       }
-      if (result.ok()) {
-        uint64_t h = DecodeU64BE(hello);
-        uint64_t c = h & 0xFFFFFFFFull;
-        if ((h & ~0xFFFFFFFFull) != kRingHelloTag || c < base || c >= nch ||
-            channels_[c].recv_comm != 0) {
-          result = Status::Inner("unexpected channel hello " + std::to_string(h));
-        } else {
-          channels_[c].recv_comm = rc;
-          rc = 0;
-        }
-      }
-      if (!result.ok() && rc) net_->close_recv(rc);
+    }
+    // Quiesce before returning: a rank whose wiring completes early (its
+    // accepts only need PREV to have started) must not race ahead — its next
+    // listener-touching op (EnsureMesh) could reach a peer still blocked in
+    // the accept loop above and be mistaken for a channel connect. W-1
+    // one-byte ring steps on channel 0: completing them implies every rank
+    // entered this quiesce, i.e. finished wiring. Direct Exchange, not
+    // Barrier() — that would re-lock async_mu_.
+    for (int s = 0; s < world_ - 1 && result.ok(); ++s) {
+      uint8_t token_out = 1, token_in = 0;
+      result = Exchange(&token_out, 1, &token_in, 1, nullptr, channels_[0]);
     }
     if (!result.ok()) {
       // Peers may have wired a subset — the communicator's channel state is

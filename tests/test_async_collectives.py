@@ -61,6 +61,35 @@ def test_iall_reduce_2proc():
     run_spawn_workers(_worker, 2)
 
 
+def _mixed_form_worker(rank: int, world: int, port: int, q) -> None:
+    # MPI/NCCL matching rule: a BLOCKING all_reduce on one rank pairs with a
+    # NONBLOCKING iall_reduce+wait on another. With multi-channel dispatch
+    # this only holds because the blocking form consumes the same ticket
+    # sequence (regression: rank 1's blocking-only loop never wired the
+    # async channels, deadlocking rank 0's channel wiring accept).
+    try:
+        from tpunet.collectives import Communicator
+
+        comm = Communicator(f"127.0.0.1:{port}", rank, world)
+        for s in range(5):
+            x = _rank_data(rank, 20_000, salt=s)
+            if rank % 2 == 0:
+                got = comm.iall_reduce(x).wait()
+            else:
+                got = comm.all_reduce(x)
+            expect = sum(_rank_data(r, 20_000, salt=s) for r in range(world))
+            np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+        comm.barrier()
+        comm.close()
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def test_mixed_blocking_async_pairing_2proc():
+    run_spawn_workers(_mixed_form_worker, 2)
+
+
 def test_iall_reduce_channel_sweep_2proc():
     # The ticket->channel round-robin must agree across ranks for any channel
     # count: run the same out-of-order-wait worker on a 1-ring (serial, the

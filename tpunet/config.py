@@ -110,6 +110,8 @@ class Config:
             async_channels=_env_int("TPUNET_ASYNC_CHANNELS", 2),
             a2a=env.get("TPUNET_A2A", "pairwise"),
             a2a_mesh_max_world=_env_int("TPUNET_A2A_MESH_MAX_WORLD", 32),
-            inline_send=env.get("TPUNET_INLINE_SEND", "1") not in ("", "0", "false"),
-            lazy_recv=env.get("TPUNET_LAZY_RECV", "1") not in ("", "0", "false"),
+            # Parsed to match the native consumer (GetEnvU64, default 1):
+            # only a numeric 0 disables; "false"/"" fall back to on.
+            inline_send=_env_int("TPUNET_INLINE_SEND", 1) != 0,
+            lazy_recv=_env_int("TPUNET_LAZY_RECV", 1) != 0,
         )

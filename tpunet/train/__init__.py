@@ -7,6 +7,12 @@ from tpunet.train.checkpoint import (  # noqa: F401
     restore_pytree,
     save_pytree,
 )
+from tpunet.train.elastic import (  # noqa: F401
+    is_comm_failure,
+    read_generation,
+    run_elastic,
+    write_generation,
+)
 from tpunet.train.trainer import (  # noqa: F401
     TrainState,
     create_train_state,

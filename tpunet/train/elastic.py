@@ -1,0 +1,157 @@
+"""Elastic recovery: rebuild the communicator around a replacement rank.
+
+The reference has no recovery story at all — a dead peer panics the job
+(SURVEY §5 "Failure detection — essentially absent": 108 unwrap sites, no
+retry/reconnect). tpunet already turns peer death into typed errors on every
+rank (tests/test_fault_paths.py); this module adds the missing half:
+survivors and a respawned replacement agree on a new *generation*, re-run
+rendezvous on a generation-derived coordinator port, and resume training
+from the latest checkpoint.
+
+Protocol (no side channel beyond the shared checkpoint/rendezvous dir that
+an elastic deployment already has):
+
+1. Generation g trains on coordinator ``host:(port+g)``.
+2. A rank dies. Every survivor's next collective raises a typed comm error
+   (the transport's keepalive/poisoning guarantees this — no hangs).
+3. Survivors: ``finalize()``, bump g, publish it to ``<dir>/GENERATION``
+   (atomic rename; last writer wins with the same value), rebuild at the new
+   port. The bootstrap blocks until all ``world_size`` ranks arrive.
+4. The replacement process (respawned by the job scheduler / supervisor)
+   reads ``GENERATION`` and joins. If it raced ahead of the survivors'
+   bump it fails rendezvous after TPUNET_BOOTSTRAP_TIMEOUT_MS, re-reads,
+   and retries — convergence needs no ordering between respawn and bump.
+5. Everyone restores the latest checkpoint and continues. Exact-resume is
+   the checkpoint layer's contract (tests/test_checkpoint.py), so a crashed
+   step is replayed, not lost.
+
+The train callback owns the step loop so it can checkpoint at its own
+cadence; ``run_elastic`` owns failure classification and the rebuild loop.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from pathlib import Path
+from typing import Any, Callable
+
+from tpunet import distributed
+from tpunet._native import NativeError
+from tpunet.collectives import Communicator
+
+GENERATION_FILE = "GENERATION"
+
+
+def read_generation(directory: str | Path) -> int:
+    """Current generation published in `directory` (0 if never written)."""
+    try:
+        return int((Path(directory) / GENERATION_FILE).read_text().strip())
+    except (FileNotFoundError, ValueError):
+        return 0
+
+
+def write_generation(directory: str | Path, generation: int) -> None:
+    """Atomically publish `generation` (rename; concurrent writers of the
+    same value — every survivor — are idempotent)."""
+    path = Path(directory) / GENERATION_FILE
+    tmp = path.with_name(f".{GENERATION_FILE}.{os.getpid()}.tmp")
+    tmp.write_text(f"{generation}\n")
+    os.replace(tmp, path)
+
+
+def is_comm_failure(exc: BaseException) -> bool:
+    """True when `exc` means the communicator (not the training math) broke:
+    a NativeError from the transport/collectives, or a wrapper carrying one
+    in its message or EXPLICIT cause chain (XlaRuntimeError from the
+    io_callback path stringifies the original NativeError; ``raise X from
+    err`` sets __cause__). Implicit __context__ is deliberately NOT walked:
+    an unrelated error raised while handling a comm error (say, a NaN-loss
+    ValueError inside an except block) must still propagate, not be
+    "recovered" into silent restarts."""
+    seen: set[int] = set()
+    cur: BaseException | None = exc
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        if isinstance(cur, NativeError):
+            return True
+        if "tpunet native" in str(cur):
+            return True
+        cur = cur.__cause__
+    return False
+
+
+def generation_coordinator(coordinator: str, generation: int) -> str:
+    host, port = coordinator.rsplit(":", 1)
+    return f"{host}:{int(port) + generation}"
+
+
+def run_elastic(
+    train_once: Callable[[Communicator, int], Any],
+    *,
+    coordinator: str,
+    rank: int,
+    world_size: int,
+    directory: str | Path,
+    max_restarts: int = 2,
+    generation: int | None = None,
+    rejoin_delay_s: float = 0.5,
+    join_timeout_s: float = 600.0,
+) -> Any:
+    """Run ``train_once(comm, generation)`` under elastic recovery.
+
+    Returns train_once's return value. Comm failures during TRAINING trigger
+    rebuild (up to ``max_restarts`` across the job's life in this process);
+    any other exception propagates immediately — a loss blowup must not be
+    "recovered" into silent data loss.
+
+    Rendezvous failures spend wall-clock, not restarts: the process re-reads
+    the published generation and retries until ``join_timeout_s`` elapses
+    without a successful join. Only processes that HELD a live communicator
+    bump and publish the generation (monotonically); a joiner that cannot
+    rendezvous never publishes — a replacement racing ahead of the
+    survivors' bump would otherwise publish generations nobody listens on
+    and strand the job.
+
+    ``generation=None`` starts from the published generation — what a
+    respawned replacement wants; survivors carry their generation forward
+    in-process.
+    """
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    g = read_generation(directory) if generation is None else generation
+    restarts = 0
+    join_deadline = time.monotonic() + join_timeout_s
+
+    while True:
+        comm = None
+        try:
+            distributed.finalize()  # no-op unless a previous comm is live
+            comm = distributed.initialize(
+                generation_coordinator(coordinator, g), rank, world_size
+            )
+            join_deadline = time.monotonic() + join_timeout_s
+            return train_once(comm, g)
+        except Exception as exc:  # noqa: BLE001 — classified below
+            if not is_comm_failure(exc):
+                raise
+            distributed.finalize()
+            if comm is None:
+                # Rendezvous failed — likely a stale generation (this is the
+                # replacement racing the survivors' bump, or the survivors
+                # already moved again). Adopt the published value and retry;
+                # never publish, never burn a restart.
+                if time.monotonic() > join_deadline:
+                    raise
+                published = read_generation(directory)
+                g = max(g, published)
+            else:
+                restarts += 1
+                if restarts > max_restarts:
+                    raise
+                # Sole publishers are ranks that lost a LIVE communicator;
+                # they agree on the increment, and max() keeps the published
+                # value monotonic even across overlapping failures.
+                g = max(g + 1, read_generation(directory))
+                write_generation(directory, g)
+            time.sleep(rejoin_delay_s)
