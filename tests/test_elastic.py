@@ -22,6 +22,67 @@ WORLD = 3
 NPARAMS = 256
 
 
+def _supervise_with_respawn(worker, world: int, victim: int, dirpath: str,
+                            deadline_s: float):
+    """Spawn `world` workers (victim gets die=True), respawn the victim once
+    after it dies (the job-scheduler half of elasticity), collect every
+    rank's queue payload. Returns {rank: payload}.
+
+    The rendezvous timing knobs matter: a replacement that read a stale
+    generation probes a dead coordinator port and must give up FAST (connect
+    retry), while survivors parked at the new generation's rendezvous must
+    wait LONGER than that probe (bootstrap timeout) — otherwise they burn
+    their restart budget bumping generations the replacement cannot catch.
+    """
+    import multiprocessing as mp
+    import queue as queue_mod
+    import time
+
+    os.environ["TPUNET_BOOTSTRAP_TIMEOUT_MS"] = "30000"
+    os.environ["TPUNET_CONNECT_RETRY_MS"] = "2000"
+    try:
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        port = free_port()
+        procs = {
+            r: ctx.Process(target=worker, args=(r, world, port, q, dirpath, r == victim))
+            for r in range(world)
+        }
+        for p in procs.values():
+            p.start()
+
+        respawned = False
+        results: dict = {}
+        deadline = time.time() + deadline_s
+        while len(results) < world and time.time() < deadline:
+            try:
+                rank, payload = q.get(timeout=1.0)
+                results[rank] = payload
+            except queue_mod.Empty:
+                pass
+            if not respawned and not procs[victim].is_alive() and victim not in results:
+                procs[victim].join()
+                assert procs[victim].exitcode == -signal.SIGKILL
+                procs[victim] = ctx.Process(
+                    target=worker, args=(victim, world, port, q, dirpath, False)
+                )
+                procs[victim].start()
+                respawned = True
+        for p in procs.values():
+            p.join(timeout=30)
+            if p.is_alive():
+                p.kill()
+
+        assert respawned, "victim never died — test exercised nothing"
+        assert len(results) == world, f"missing ranks: {sorted(results)}"
+        bad = {r: v for r, v in results.items() if v[0] != "OK"}
+        assert not bad, f"worker failures: {bad}"
+        return results
+    finally:
+        os.environ.pop("TPUNET_BOOTSTRAP_TIMEOUT_MS", None)
+        os.environ.pop("TPUNET_CONNECT_RETRY_MS", None)
+
+
 def _grad(step: int, rank: int) -> np.ndarray:
     rng = np.random.default_rng(7 * step + rank)
     return rng.standard_normal(NPARAMS).astype(np.float32)
@@ -86,81 +147,100 @@ def _expected_params() -> np.ndarray:
     return params
 
 
-def test_rank_death_rebuild_and_exact_resume(tmp_path):
-    import multiprocessing as mp
-
-    # Window ordering matters: a replacement that read a stale generation
-    # probes a dead coordinator port and must give up FAST (connect retry),
-    # while survivors parked at the new generation's rendezvous must wait
-    # LONGER than that probe (bootstrap timeout) — otherwise they burn their
-    # restart budget bumping generations the replacement can never catch.
-    os.environ["TPUNET_BOOTSTRAP_TIMEOUT_MS"] = "30000"
-    os.environ["TPUNET_CONNECT_RETRY_MS"] = "2000"
+def _jax_elastic_worker(rank: int, world: int, port: int, q, dirpath: str,
+                        die: bool) -> None:
+    # The full stack under elasticity: jitted cross-host train step (interop
+    # io_callback -> native ring), orbax checkpoints, and failure surfacing
+    # as XlaRuntimeError WRAPPING the native error — the string-match half of
+    # is_comm_failure, which the transport-level test never exercises.
     try:
-        ctx = mp.get_context("spawn")
-        q = ctx.Queue()
-        port = free_port()
-        procs = {
-            r: ctx.Process(
-                target=_elastic_worker,
-                args=(r, WORLD, port, q, str(tmp_path), r == 1),
-            )
-            for r in range(WORLD)
-        }
-        for p in procs.values():
-            p.start()
+        from pathlib import Path
 
-        # Supervise: when the victim exits without reporting, respawn it
-        # (without the die flag) — the job-scheduler half of elasticity.
-        respawned = False
-        results = {}
-        import queue as queue_mod
-        import time
+        import jax
 
-        deadline = time.time() + 240
-        while len(results) < WORLD and time.time() < deadline:
-            try:
-                rank, payload = q.get(timeout=1.0)
-                results[rank] = payload
-            except queue_mod.Empty:
-                pass
-            victim = procs[1]
-            if not respawned and not victim.is_alive() and 1 not in results:
-                victim.join()
-                assert victim.exitcode == -signal.SIGKILL
-                procs[1] = ctx.Process(
-                    target=_elastic_worker,
-                    args=(1, WORLD, port, q, str(tmp_path), False),
-                )
-                procs[1].start()
-                respawned = True
-        for p in procs.values():
-            p.join(timeout=30)
-            if p.is_alive():
-                p.kill()
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import optax
 
-        assert respawned, "victim never died — test exercised nothing"
-        assert len(results) == WORLD, f"missing ranks: {sorted(results)}"
-        bad = {r: v for r, v in results.items() if v[0] != "OK"}
-        assert not bad, f"worker failures: {bad}"
+        from tpunet.models import Transformer
+        from tpunet.train import (create_train_state, make_train_step,
+                                  restore_pytree, run_elastic, save_pytree)
 
-        # Recovery happened: the generation advanced past 0.
-        from tpunet.train.elastic import read_generation
+        ckpt = Path(dirpath)
+        steps = 8
+        model = Transformer(vocab=32, d_model=16, n_layers=2, n_heads=2,
+                            d_ff=32, compute_dtype=jnp.float32)
+        tx = optax.sgd(0.05)
+        toks = jax.random.randint(jax.random.PRNGKey(10 + rank), (2, 8), 0, 32)
+        labels = jnp.roll(toks, -1, axis=1)
 
-        assert read_generation(tmp_path) >= 1
+        def train_once(comm, gen):
+            state, _ = create_train_state(model, jax.random.PRNGKey(0), toks, tx)
+            done = [int(p.name.split("_")[1]) for p in ckpt.glob("jstep_*")]
+            start = max(done, default=-1) + 1
+            if start > 0:
+                state = restore_pytree(ckpt / f"jstep_{start - 1}", state)
+            step = make_train_step(model, tx, cross_host=True, donate=False)
+            for s in range(start, steps):
+                if die and s == 3:
+                    os.kill(os.getpid(), signal.SIGKILL)
+                state, loss = step(state, toks, labels, jax.random.PRNGKey(s))
+                assert np.isfinite(float(loss))
+                if rank == 0 and not (ckpt / f"jstep_{s}").exists():
+                    save_pytree(ckpt / f"jstep_{s}", state)
+                comm.barrier()
+            return state
 
-        # All ranks bitwise identical (lockstep held through the rebuild),
-        # and equal to the analytic trajectory to float32 rounding — the
-        # analytic sum orders additions differently than the ring (1-ulp
-        # noise), but a lost or double-replayed step would be off by ~0.1
-        # per step, 6 orders of magnitude beyond this tolerance.
-        expect = _expected_params()
-        final = {r: np.asarray(v[1], np.float32) for r, v in results.items()}
-        for r in range(1, WORLD):
-            np.testing.assert_array_equal(
-                final[r], final[0], err_msg=f"rank {r} != rank 0 after recovery"
-            )
-        np.testing.assert_allclose(final[0], expect, rtol=5e-6, atol=5e-7)
-    finally:
-        os.environ.pop("TPUNET_BOOTSTRAP_TIMEOUT_MS", None)
-        os.environ.pop("TPUNET_CONNECT_RETRY_MS", None)
+        state = run_elastic(
+            train_once,
+            coordinator=f"127.0.0.1:{port}",
+            rank=rank,
+            world_size=world,
+            directory=dirpath,
+            max_restarts=3,
+        )
+        from jax.flatten_util import ravel_pytree
+
+        flat = np.asarray(ravel_pytree(state.params)[0])
+        q.put((rank, ("OK", flat[:64].tolist())))
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        q.put((rank, (f"FAIL: {type(e).__name__}: {e}",
+                      traceback.format_exc()[-600:])))
+
+
+def test_jax_trainer_elastic_recovery(tmp_path):
+    results = _supervise_with_respawn(
+        _jax_elastic_worker, world=2, victim=1, dirpath=str(tmp_path),
+        deadline_s=300,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(results[0][1]), np.asarray(results[1][1]),
+        err_msg="ranks diverged after jax-trainer recovery",
+    )
+
+
+def test_rank_death_rebuild_and_exact_resume(tmp_path):
+    results = _supervise_with_respawn(
+        _elastic_worker, world=WORLD, victim=1, dirpath=str(tmp_path),
+        deadline_s=240,
+    )
+
+    # Recovery happened: the generation advanced past 0.
+    from tpunet.train.elastic import read_generation
+
+    assert read_generation(tmp_path) >= 1
+
+    # All ranks bitwise identical (lockstep held through the rebuild),
+    # and equal to the analytic trajectory to float32 rounding — the
+    # analytic sum orders additions differently than the ring (1-ulp
+    # noise), but a lost or double-replayed step would be off by ~0.1
+    # per step, 6 orders of magnitude beyond this tolerance.
+    expect = _expected_params()
+    final = {r: np.asarray(v[1], np.float32) for r, v in results.items()}
+    for r in range(1, WORLD):
+        np.testing.assert_array_equal(
+            final[r], final[0], err_msg=f"rank {r} != rank 0 after recovery"
+        )
+    np.testing.assert_allclose(final[0], expect, rtol=5e-6, atol=5e-7)

@@ -154,4 +154,8 @@ def run_elastic(
                 # value monotonic even across overlapping failures.
                 g = max(g + 1, read_generation(directory))
                 write_generation(directory, g)
+                # A fresh rebuild opens a fresh join window — without this, a
+                # failure arriving join_timeout_s after the last successful
+                # join would start the rendezvous retries already expired.
+                join_deadline = time.monotonic() + join_timeout_s
             time.sleep(rejoin_delay_s)
