@@ -157,7 +157,10 @@ def _model_tier(tpu_up: bool, kernels: dict | None) -> dict | None:
         if not flash_ok:
             print("[bench] flash kernel smoke not ok; model tier uses "
                   "reference attention on TPU", file=sys.stderr)
-        attempts.append(("tpu", "flash" if flash_ok else "reference", 1200))
+        # Generous: the chip-sized headline model (735M params) spends
+        # 2-4 min in XLA compile over the tunnel before its ~8s of steps,
+        # and a timeout here silently costs the whole hardware story.
+        attempts.append(("tpu", "flash" if flash_ok else "reference", 2400))
     else:
         print("[bench] TPU tunnel down; model tier falls back to CPU smoke",
               file=sys.stderr)

@@ -20,6 +20,9 @@ CONFIGS = [
     (2048, 16, 8192, 16, 8, 2048, True),   # OOM on 16 GB v5e
     (4096, 4, 16384, 32, 8, 2048, True),   # OOM on 16 GB v5e
     (1024, 12, 4096, 16, 16, 2048, True),  # half-size, for smaller chips
+    # Long-context: flash O(S) memory is what makes s8192 fit at all —
+    # reference attention would materialize b*h*S^2 scores (>8 GB here).
+    (2048, 12, 8192, 16, 2, 8192, True),
 ]
 
 

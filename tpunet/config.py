@@ -90,7 +90,8 @@ class Config:
             min_chunksize=_env_int(
                 "TPUNET_MIN_CHUNKSIZE", _env_int("BAGUA_NET_MIN_CHUNKSIZE", 1 << 20)
             ),
-            spin=env.get("TPUNET_SPIN", "0") not in ("", "0", "false"),
+            # GetEnvU64 semantics like the native reader: non-numeric -> 0.
+            spin=_env_int("TPUNET_SPIN", 0) != 0,
             socket_ifname=env.get(
                 "TPUNET_SOCKET_IFNAME", env.get("NCCL_SOCKET_IFNAME", "^docker,lo")
             ),
