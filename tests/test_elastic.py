@@ -23,10 +23,11 @@ NPARAMS = 256
 
 
 def _supervise_with_respawn(worker, world: int, victim: int, dirpath: str,
-                            deadline_s: float):
-    """Spawn `world` workers (victim gets die=True), respawn the victim once
-    after it dies (the job-scheduler half of elasticity), collect every
-    rank's queue payload. Returns {rank: payload}.
+                            deadline_s: float, respawn: bool = True):
+    """Spawn `world` workers (victim gets die=True); with `respawn`, restart
+    the victim once after it dies (the job-scheduler half of elasticity),
+    else leave it dead (shrink policy). Collects each expected rank's queue
+    payload and asserts none failed. Returns {rank: payload}.
 
     The rendezvous timing knobs matter: a replacement that read a stale
     generation probes a dead coordinator port and must give up FAST (connect
@@ -51,16 +52,18 @@ def _supervise_with_respawn(worker, world: int, victim: int, dirpath: str,
         for p in procs.values():
             p.start()
 
+        expected = set(range(world)) if respawn else set(range(world)) - {victim}
         respawned = False
         results: dict = {}
         deadline = time.time() + deadline_s
-        while len(results) < world and time.time() < deadline:
+        while len(expected - results.keys()) > 0 and time.time() < deadline:
             try:
                 rank, payload = q.get(timeout=1.0)
                 results[rank] = payload
             except queue_mod.Empty:
                 pass
-            if not respawned and not procs[victim].is_alive() and victim not in results:
+            if (respawn and not respawned and not procs[victim].is_alive()
+                    and victim not in results):
                 # A worker that failed (rather than SIGKILLed itself) queues
                 # its FAIL payload and exits 0 — drain before asserting the
                 # exitcode, or the traceback in the queue would be masked.
@@ -84,11 +87,19 @@ def _supervise_with_respawn(worker, world: int, victim: int, dirpath: str,
             if p.is_alive():
                 p.kill()
 
-        assert respawned, "victim never died — test exercised nothing"
-        missing = sorted(set(range(world)) - results.keys())
-        assert not missing, f"missing ranks: {missing}"
+        # Worker failures FIRST: their payload carries the real traceback,
+        # and any later assertion (respawned, missing) is usually downstream
+        # of the same root cause.
         bad = {r: v for r, v in results.items() if v[0] != "OK"}
         assert not bad, f"worker failures: {bad}"
+        if respawn:
+            assert respawned, "victim never died — test exercised nothing"
+        else:
+            assert procs[victim].exitcode == -signal.SIGKILL, (
+                f"victim exitcode {procs[victim].exitcode}"
+            )
+        missing = sorted(expected - results.keys())
+        assert not missing, f"missing ranks: {missing}"
         return results
     finally:
         os.environ.pop("TPUNET_BOOTSTRAP_TIMEOUT_MS", None)
@@ -157,6 +168,81 @@ def _expected_params() -> np.ndarray:
                    dtype=np.float32) / WORLD
         params = params - 0.1 * g
     return params
+
+
+def _shrink_worker(rank: int, world: int, port: int, q, dirpath: str,
+                   die: bool) -> None:
+    # Shrink policy: NO replacement ever comes; survivors must re-rank and
+    # continue at world-1. Gradients key off comm.rank (the per-generation
+    # rank), so the post-shrink trajectory is analytically reproducible.
+    try:
+        from pathlib import Path
+
+        from tpunet.train.elastic import run_elastic
+
+        ckpt = Path(dirpath)
+
+        def train_once(comm, gen):
+            w, r = comm.world_size, comm.rank
+            latest = _latest_step(ckpt)
+            if latest >= 0:
+                params = np.load(ckpt / f"step_{latest}.npy")
+                start = latest + 1
+            else:
+                params = np.zeros(NPARAMS, np.float32)
+                start = 0
+            for step in range(start, STEPS):
+                if die and step == DIE_STEP:
+                    os.kill(os.getpid(), signal.SIGKILL)
+                g = comm.all_reduce(_grad(step, r)) / w
+                params = params - 0.1 * g
+                if r == 0:
+                    tmp = ckpt / f".step_{step}.tmp.npy"
+                    np.save(tmp, params)
+                    os.replace(tmp, ckpt / f"step_{step}.npy")
+                comm.barrier()
+            return params, w
+
+        (params, final_world) = run_elastic(
+            train_once,
+            coordinator=f"127.0.0.1:{port}",
+            rank=rank,
+            world_size=world,
+            directory=dirpath,
+            max_restarts=3,
+            allow_shrink=True,
+            shrink_grace_s=3.0,
+            min_world=2,
+        )
+        q.put((rank, ("OK", params.tolist(), final_world)))
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        q.put((rank, (f"FAIL: {type(e).__name__}: {e}",
+                      traceback.format_exc()[-600:])))
+
+
+def test_shrink_to_survivors(tmp_path):
+    results = _supervise_with_respawn(
+        _shrink_worker, world=WORLD, victim=1, dirpath=str(tmp_path),
+        deadline_s=240, respawn=False,
+    )
+    assert results[0][2] == 2 and results[2][2] == 2, "world did not shrink to 2"
+
+    # Analytic two-phase trajectory: steps 0..DIE_STEP-1 averaged over 3
+    # ranks; steps DIE_STEP.. averaged over the re-ranked survivors
+    # {0,2} -> new ranks {0,1}. Ring sum order differs from np.sum by
+    # ~1 ulp, hence the tight-but-not-bitwise tolerance (a lost or
+    # double step would be ~0.1 off).
+    params = np.zeros(NPARAMS, np.float32)
+    for step in range(STEPS):
+        w = WORLD if step < DIE_STEP else 2
+        g = np.sum([_grad(step, r) for r in range(w)], axis=0,
+                   dtype=np.float32) / w
+        params = params - 0.1 * g
+    final = {r: np.asarray(v[1], np.float32) for r, v in results.items()}
+    np.testing.assert_array_equal(final[0], final[2])
+    np.testing.assert_allclose(final[0], params, rtol=5e-6, atol=5e-7)
 
 
 def _jax_elastic_worker(rank: int, world: int, port: int, q, dirpath: str,

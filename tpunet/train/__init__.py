@@ -8,6 +8,7 @@ from tpunet.train.checkpoint import (  # noqa: F401
     save_pytree,
 )
 from tpunet.train.elastic import (  # noqa: F401
+    ExcludedFromMembership,
     is_comm_failure,
     read_generation,
     run_elastic,

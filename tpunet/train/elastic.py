@@ -25,12 +25,17 @@ an elastic deployment already has):
    the checkpoint layer's contract (tests/test_checkpoint.py), so a crashed
    step is replayed, not lost.
 
+With ``allow_shrink=True`` steps 3-4 change policy: instead of waiting for
+a replacement, survivors seal a smaller membership after a grace window and
+continue at world-1 with re-assigned ranks (see _shrink_rendezvous).
+
 The train callback owns the step loop so it can checkpoint at its own
 cadence; ``run_elastic`` owns failure classification and the rebuild loop.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import time
 from pathlib import Path
@@ -86,6 +91,81 @@ def generation_coordinator(coordinator: str, generation: int) -> str:
     return f"{host}:{int(port) + generation}"
 
 
+class ExcludedFromMembership(RuntimeError):
+    """This process missed a shrink's grace window (or joined after the
+    membership doc was sealed) and is no longer part of the job."""
+
+
+def _shrink_rendezvous(directory: Path, generation: int, member_id: int,
+                       advertise_host: str, base_port: int,
+                       grace_s: float) -> tuple[str, int, int]:
+    """Agree on the surviving membership for `generation` and return
+    (coordinator, new_rank, new_world).
+
+    Every survivor writes a member file naming its advertise host, then the
+    LEADER — lowest member id present after the grace window — seals
+    ``MEMBERS.json`` exactly once (O_EXCL: a late lower id that lost the
+    race adopts the sealed doc rather than rewriting membership under
+    peers already rendezvousing). Member ids are the caller's stable ids,
+    not per-generation ranks; new ranks are the sealed members' sort order.
+    Survivors absent from the sealed doc raise ExcludedFromMembership —
+    the grace window IS the membership contract.
+    """
+    gdir = directory / f"g{generation}"
+    gdir.mkdir(parents=True, exist_ok=True)
+    # Atomic publish (tmp + replace): the sealing leader reads these files
+    # the moment they appear in its glob, and a torn/empty advertise host
+    # would be sealed into an immutable doc as a broken coordinator. The
+    # dot-prefixed tmp never matches the member_* glob.
+    tmp = gdir / f".member_{member_id}.{os.getpid()}.tmp"
+    tmp.write_text(advertise_host)
+    os.replace(tmp, gdir / f"member_{member_id}")
+    doc_path = gdir / "MEMBERS.json"
+
+    def members_present() -> list[int]:
+        return sorted(int(p.name.split("_", 1)[1]) for p in gdir.glob("member_*"))
+
+    deadline = time.monotonic() + grace_s
+    while not doc_path.exists():
+        present = members_present()
+        if present and present[0] == member_id and time.monotonic() >= deadline:
+            # Leader after a full grace window: seal what arrived.
+            sealed = {
+                "members": present,
+                "hosts": {str(m): (gdir / f"member_{m}").read_text()
+                          for m in present},
+            }
+            tmp = gdir / f".members.{os.getpid()}.tmp"
+            tmp.write_text(json.dumps(sealed))
+            try:
+                # Atomic exclusive publish of a COMPLETE file: link() fails
+                # with EEXIST if another leader sealed first (no TOCTOU, no
+                # torn reads) — the loser adopts the sealed doc below.
+                os.link(tmp, doc_path)
+            except FileExistsError:
+                pass
+            finally:
+                tmp.unlink(missing_ok=True)
+            break
+        if time.monotonic() > deadline + 4 * grace_s:
+            raise RuntimeError(
+                f"shrink membership for generation {generation} never sealed "
+                f"(leader {present[0] if present else '?'} missing?)"
+            )
+        time.sleep(0.1)
+
+    doc = json.loads(doc_path.read_text())
+    members: list[int] = doc["members"]
+    if member_id not in members:
+        raise ExcludedFromMembership(
+            f"member {member_id} missed generation {generation}'s grace window "
+            f"(sealed members: {members})"
+        )
+    new_rank = members.index(member_id)
+    coordinator = f"{doc['hosts'][str(members[0])]}:{base_port + generation}"
+    return coordinator, new_rank, len(members)
+
+
 def run_elastic(
     train_once: Callable[[Communicator, int], Any],
     *,
@@ -97,6 +177,10 @@ def run_elastic(
     generation: int | None = None,
     rejoin_delay_s: float = 0.5,
     join_timeout_s: float = 600.0,
+    allow_shrink: bool = False,
+    shrink_grace_s: float = 10.0,
+    min_world: int = 1,
+    advertise_host: str | None = None,
 ) -> Any:
     """Run ``train_once(comm, generation)`` under elastic recovery.
 
@@ -116,20 +200,36 @@ def run_elastic(
     ``generation=None`` starts from the published generation — what a
     respawned replacement wants; survivors carry their generation forward
     in-process.
+
+    ``allow_shrink=True`` switches recovery policy from
+    wait-for-a-replacement to CONTINUE WITHOUT THE DEAD RANK: survivors run
+    a grace-window membership rendezvous through the shared directory (see
+    _shrink_rendezvous) and rebuild with re-assigned ranks, a smaller world,
+    and a coordinator re-elected onto the lowest surviving member's
+    ``advertise_host`` (so losing rank 0's host is survivable; default: the
+    host part of ``coordinator``). ``rank`` doubles as the stable member id.
+    ``train_once`` must read its rank/world from the comm, not the closure.
+    Shrinking below ``min_world`` raises instead of limping on.
     """
     directory = Path(directory)
     directory.mkdir(parents=True, exist_ok=True)
     g = read_generation(directory) if generation is None else generation
+    member_id = rank
+    cur_coordinator = generation_coordinator(coordinator, g)
+    cur_rank, cur_world = rank, world_size
+    base_host, base_port = coordinator.rsplit(":", 1)
+    if advertise_host is None:
+        advertise_host = base_host
     restarts = 0
+    ever_joined = False
     join_deadline = time.monotonic() + join_timeout_s
 
     while True:
         comm = None
         try:
             distributed.finalize()  # no-op unless a previous comm is live
-            comm = distributed.initialize(
-                generation_coordinator(coordinator, g), rank, world_size
-            )
+            comm = distributed.initialize(cur_coordinator, cur_rank, cur_world)
+            ever_joined = True
             join_deadline = time.monotonic() + join_timeout_s
             return train_once(comm, g)
         except Exception as exc:  # noqa: BLE001 — classified below
@@ -137,14 +237,34 @@ def run_elastic(
                 raise
             distributed.finalize()
             if comm is None:
-                # Rendezvous failed — likely a stale generation (this is the
-                # replacement racing the survivors' bump, or the survivors
-                # already moved again). Adopt the published value and retry;
-                # never publish, never burn a restart.
+                # Rendezvous failed. Never burn a restart here; bound by
+                # wall-clock instead.
                 if time.monotonic() > join_deadline:
                     raise
-                published = read_generation(directory)
-                g = max(g, published)
+                g = max(g, read_generation(directory))
+                if not allow_shrink:
+                    # Replacement policy: adopt the published generation and
+                    # retry — the survivors' bump is what we're chasing.
+                    cur_coordinator = generation_coordinator(coordinator, g)
+                elif ever_joined:
+                    # Shrink policy, and this process WAS part of a running
+                    # job: a sealed generation that cannot assemble means a
+                    # member died between seal and rebuild. There is no
+                    # replacement to wait for — advance and re-run
+                    # membership without it. (Before the first successful
+                    # join, fall through and just retry: sealing at startup
+                    # could permanently exclude a healthy-but-slow rank.)
+                    g = max(g + 1, read_generation(directory))
+                    write_generation(directory, g)
+                    cur_coordinator, cur_rank, cur_world = _shrink_rendezvous(
+                        directory, g, member_id, advertise_host,
+                        int(base_port), shrink_grace_s,
+                    )
+                    if cur_world < min_world:
+                        raise RuntimeError(
+                            f"membership shrank to {cur_world} < min_world "
+                            f"{min_world}"
+                        )
             else:
                 restarts += 1
                 if restarts > max_restarts:
@@ -154,6 +274,18 @@ def run_elastic(
                 # value monotonic even across overlapping failures.
                 g = max(g + 1, read_generation(directory))
                 write_generation(directory, g)
+                if allow_shrink:
+                    cur_coordinator, cur_rank, cur_world = _shrink_rendezvous(
+                        directory, g, member_id, advertise_host,
+                        int(base_port), shrink_grace_s,
+                    )
+                    if cur_world < min_world:
+                        raise RuntimeError(
+                            f"membership shrank to {cur_world} < min_world "
+                            f"{min_world}"
+                        )
+                else:
+                    cur_coordinator = generation_coordinator(coordinator, g)
                 # A fresh rebuild opens a fresh join window — without this, a
                 # failure arriving join_timeout_s after the last successful
                 # join would start the rendezvous retries already expired.
