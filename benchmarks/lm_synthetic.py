@@ -31,7 +31,9 @@ def _build(args):
 
     from tpunet.models import Transformer, transformer_partition_rules
     from tpunet.parallel import make_named_mesh, replicated, shard_params
-    from tpunet.train import TrainState, create_train_state, make_train_step
+    from tpunet.train import (TrainState, create_train_state,
+                              create_zero_train_state, make_train_step,
+                              make_zero_train_step)
 
     use_mesh = args.sp > 1 or args.tp > 1
     mesh = None
@@ -52,7 +54,17 @@ def _build(args):
     toks = rng.integers(0, args.vocab, size=(args.batch_size, args.seq))
     tokens = jnp.asarray(toks, jnp.int32)
     labels = jnp.roll(tokens, -1, axis=1)
-    state, _ = create_train_state(model, jax.random.PRNGKey(0), tokens, tx)
+    if args.zero:
+        if not args.cross_host:
+            raise SystemExit("--zero requires --cross-host (it shards the "
+                             "optimizer over the DCN world)")
+        if args.bucket_bytes is not None:
+            raise SystemExit("--bucket-bytes applies to the all-reduce path; "
+                             "the ZeRO path syncs via reduce-scatter/all-gather "
+                             "(refusing to silently benchmark the wrong path)")
+        state, _ = create_zero_train_state(model, jax.random.PRNGKey(0), tokens, tx)
+    else:
+        state, _ = create_train_state(model, jax.random.PRNGKey(0), tokens, tx)
 
     if mesh is not None:
         rules = transformer_partition_rules(
@@ -67,10 +79,14 @@ def _build(args):
         tokens = jax.device_put(tokens, data_sh)
         labels = jax.device_put(labels, data_sh)
 
-    # Passed through unguarded: make_train_step rejects bucket_bytes without
-    # cross_host, which is better than silently benchmarking the wrong path.
-    step = make_train_step(model, tx, cross_host=args.cross_host, donate=True,
-                           bucket_bytes=args.bucket_bytes)
+    if args.zero:
+        step = make_zero_train_step(model, tx, donate=True)
+    else:
+        # Passed through unguarded: make_train_step rejects bucket_bytes
+        # without cross_host, which is better than silently benchmarking the
+        # wrong path.
+        step = make_train_step(model, tx, cross_host=args.cross_host, donate=True,
+                               bucket_bytes=args.bucket_bytes)
     return state, step, tokens, labels, mesh
 
 
@@ -138,6 +154,9 @@ def _parse(argv):
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--batches-per-iter", type=int, default=3)
     ap.add_argument("--cross-host", action="store_true")
+    ap.add_argument("--zero", action="store_true",
+                    help="ZeRO-1: shard optimizer state over the DCN world "
+                         "(reduce-scatter grads, all-gather params)")
     ap.add_argument("--bucket-bytes", type=int, default=None,
                     help="multi-rank only: nonblocking bucketed gradient sync "
                          "(overlaps DCN transfer with backward); bytes per bucket")

@@ -54,6 +54,7 @@ def _supervise_with_respawn(worker, world: int, victim: int, dirpath: str,
 
         expected = set(range(world)) if respawn else set(range(world)) - {victim}
         respawned = False
+        victim_died = False
         results: dict = {}
         deadline = time.time() + deadline_s
         while len(expected - results.keys()) > 0 and time.time() < deadline:
@@ -62,7 +63,7 @@ def _supervise_with_respawn(worker, world: int, victim: int, dirpath: str,
                 results[rank] = payload
             except queue_mod.Empty:
                 pass
-            if (respawn and not respawned and not procs[victim].is_alive()
+            if (not victim_died and not procs[victim].is_alive()
                     and victim not in results):
                 # A worker that failed (rather than SIGKILLed itself) queues
                 # its FAIL payload and exits 0 — drain before asserting the
@@ -76,28 +77,30 @@ def _supervise_with_respawn(worker, world: int, victim: int, dirpath: str,
                 if victim in results:
                     continue
                 procs[victim].join()
+                # Recorded BEFORE the cleanup loop's p.kill() can also
+                # produce -SIGKILL — this is the real "victim died" signal.
                 assert procs[victim].exitcode == -signal.SIGKILL
-                procs[victim] = ctx.Process(
-                    target=worker, args=(victim, world, port, q, dirpath, False)
-                )
-                procs[victim].start()
-                respawned = True
+                victim_died = True
+                if respawn:
+                    procs[victim] = ctx.Process(
+                        target=worker,
+                        args=(victim, world, port, q, dirpath, False),
+                    )
+                    procs[victim].start()
+                    respawned = True
         for p in procs.values():
             p.join(timeout=30)
             if p.is_alive():
                 p.kill()
 
         # Worker failures FIRST: their payload carries the real traceback,
-        # and any later assertion (respawned, missing) is usually downstream
-        # of the same root cause.
+        # and any later assertion (died, missing) is usually downstream of
+        # the same root cause.
         bad = {r: v for r, v in results.items() if v[0] != "OK"}
         assert not bad, f"worker failures: {bad}"
+        assert victim_died, "victim never died — test exercised nothing"
         if respawn:
-            assert respawned, "victim never died — test exercised nothing"
-        else:
-            assert procs[victim].exitcode == -signal.SIGKILL, (
-                f"victim exitcode {procs[victim].exitcode}"
-            )
+            assert respawned
         missing = sorted(expected - results.keys())
         assert not missing, f"missing ranks: {missing}"
         return results
@@ -220,6 +223,18 @@ def _shrink_worker(rank: int, world: int, port: int, q, dirpath: str,
 
         q.put((rank, (f"FAIL: {type(e).__name__}: {e}",
                       traceback.format_exc()[-600:])))
+
+
+def test_shrink_requires_advertise_host_on_nonloopback(tmp_path):
+    # Defaulting to the original coordinator's host would re-elect the new
+    # coordinator onto the machine whose death we are shrinking around.
+    import pytest
+
+    from tpunet.train.elastic import run_elastic
+
+    with pytest.raises(ValueError, match="advertise_host"):
+        run_elastic(lambda c, g: None, coordinator="10.0.0.1:29500", rank=0,
+                    world_size=2, directory=tmp_path, allow_shrink=True)
 
 
 def test_shrink_to_survivors(tmp_path):

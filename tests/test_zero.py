@@ -1,0 +1,108 @@
+"""ZeRO-1 optimizer-state sharding over the DCN tier: trajectory parity with
+the replicated cross-host path, and the memory claim (opt state / world).
+
+The reference transport carried whatever NCCL sent; its parent project's
+sharded/quantized optimizers lived a layer above (SURVEY §2.3). tpunet owns
+that layer, so the capability lands here: reduce-scatter grads, update a
+parameter shard, all-gather params (tpunet/train/trainer.py
+make_zero_train_step)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from conftest import run_spawn_workers  # noqa: E402
+
+
+def _worker(rank: int, world: int, port: int, q) -> None:
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import optax
+        from jax.flatten_util import ravel_pytree
+
+        from tpunet import distributed
+        from tpunet.models import Transformer
+        from tpunet.train import (create_train_state, create_zero_train_state,
+                                  make_train_step, make_zero_train_step)
+
+        distributed.initialize(f"127.0.0.1:{port}", rank, world)
+        model = Transformer(vocab=37, d_model=16, n_layers=2, n_heads=2,
+                            d_ff=32, compute_dtype=jnp.float32)
+        # adamw: params-dependent update (weight decay) + stateful moments —
+        # the hardest case for shard/full parity.
+        tx = optax.adamw(3e-3)
+        toks = jax.random.randint(jax.random.PRNGKey(100 + rank), (2, 8), 0, 37)
+        labels = jnp.roll(toks, -1, axis=1)
+
+        state_full, _ = create_train_state(model, jax.random.PRNGKey(0), toks, tx)
+        state_zero, _ = create_zero_train_state(model, jax.random.PRNGKey(0), toks, tx)
+        step_full = make_train_step(model, tx, cross_host=True, donate=False)
+        step_zero = make_zero_train_step(model, tx, donate=False)
+
+        # Optimizer-state memory actually shrinks by ~world (mod the count
+        # scalar and shard padding).
+        full_elems = sum(np.size(x) for x in jax.tree.leaves(state_full.opt_state))
+        zero_elems = sum(np.size(x) for x in jax.tree.leaves(state_zero.opt_state))
+        n_params = sum(np.size(x) for x in jax.tree.leaves(state_full.params))
+        assert zero_elems <= full_elems / world + world + 8, (
+            f"zero opt state {zero_elems} vs full {full_elems} (world {world}, "
+            f"params {n_params})"
+        )
+
+        for s in range(3):
+            state_full, loss_f = step_full(state_full, toks, labels,
+                                           jax.random.PRNGKey(s))
+            state_zero, loss_z = step_zero(state_zero, toks, labels,
+                                           jax.random.PRNGKey(s))
+            np.testing.assert_allclose(float(loss_f), float(loss_z), rtol=1e-6)
+
+        pf = np.asarray(ravel_pytree(state_full.params)[0])
+        pz = np.asarray(ravel_pytree(state_zero.params)[0])
+        np.testing.assert_allclose(pz, pf, rtol=2e-6, atol=2e-7)
+
+        # Ranks agree bitwise on the zero path's params (the all-gather is
+        # the only source of each rank's out-of-shard values).
+        from tpunet.interop import dcn_all_gather
+
+        allp = np.asarray(jax.jit(dcn_all_gather)(jnp.asarray(pz)))
+        for r in range(1, world):
+            np.testing.assert_array_equal(allp[0], allp[r])
+
+        distributed.finalize()
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}\n"
+                     f"{traceback.format_exc()[-500:]}"))
+
+
+def test_zero1_parity_2proc():
+    run_spawn_workers(_worker, 2)
+
+
+def test_zero1_parity_3proc():
+    # Odd world: exercises shard padding (param count % 3 != 0).
+    run_spawn_workers(_worker, 3)
+
+
+def test_zero_requires_distributed():
+    import optax
+    import pytest
+
+    from tpunet.models import Transformer
+    from tpunet.train import make_zero_train_step
+
+    model = Transformer(vocab=8, d_model=8, n_layers=1, n_heads=1, d_ff=8)
+    with pytest.raises(RuntimeError, match="initialize"):
+        make_zero_train_step(model, optax.sgd(0.1))

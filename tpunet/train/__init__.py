@@ -17,6 +17,8 @@ from tpunet.train.elastic import (  # noqa: F401
 from tpunet.train.trainer import (  # noqa: F401
     TrainState,
     create_train_state,
+    create_zero_train_state,
     make_train_step,
+    make_zero_train_step,
     synthetic_batch,
 )

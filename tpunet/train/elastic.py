@@ -206,8 +206,9 @@ def run_elastic(
     a grace-window membership rendezvous through the shared directory (see
     _shrink_rendezvous) and rebuild with re-assigned ranks, a smaller world,
     and a coordinator re-elected onto the lowest surviving member's
-    ``advertise_host`` (so losing rank 0's host is survivable; default: the
-    host part of ``coordinator``). ``rank`` doubles as the stable member id.
+    ``advertise_host`` (so losing rank 0's host is survivable — which is why
+    multi-host callers MUST pass their own reachable address; only loopback
+    setups may omit it). ``rank`` doubles as the stable member id.
     ``train_once`` must read its rank/world from the comm, not the closure.
     Shrinking below ``min_world`` raises instead of limping on.
     """
@@ -218,8 +219,19 @@ def run_elastic(
     cur_coordinator = generation_coordinator(coordinator, g)
     cur_rank, cur_world = rank, world_size
     base_host, base_port = coordinator.rsplit(":", 1)
-    if advertise_host is None:
-        advertise_host = base_host
+    if allow_shrink and advertise_host is None:
+        # No safe multi-host default exists: advertising the ORIGINAL
+        # coordinator's host would re-elect the new coordinator onto the
+        # very machine whose death we are shrinking around. Loopback dev
+        # setups are unambiguous; everyone else must say who they are.
+        if base_host in ("127.0.0.1", "localhost", "::1"):
+            advertise_host = base_host
+        else:
+            raise ValueError(
+                "allow_shrink=True on a non-loopback coordinator requires "
+                "advertise_host=<this machine's reachable address> — the "
+                "re-elected coordinator binds on a surviving member's host"
+            )
     restarts = 0
     ever_joined = False
     join_deadline = time.monotonic() + join_timeout_s

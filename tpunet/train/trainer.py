@@ -109,6 +109,38 @@ def _bucketed_dcn_pmean(grads, bucket_bytes: int, compression: str | None, world
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 
+def _make_loss_fn(model, images, labels, dropout_rng, moe_aux_weight: float):
+    """The train-step objective, shared by the replicated and ZeRO paths:
+    token/label cross-entropy plus (for MoE models) the Switch router's sown
+    load-balancing losses, collected via mutable=['intermediates'] — without
+    that term the router can collapse onto one expert."""
+    has_moe = getattr(model, "n_experts", 0) > 0
+
+    def loss_fn(p):
+        out = model.apply(
+            {"params": p}, images, train=True, rngs={"dropout": dropout_rng},
+            mutable=["intermediates"] if has_moe else False,
+        )
+        logits, mut = out if has_moe else (out, None)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        loss = loss.mean()
+        if has_moe:
+            # flax wraps sown values in tuples: sum leaves on matching paths
+            # and average over MoE blocks.
+            aux = [
+                leaf
+                for path, leaf in jax.tree_util.tree_leaves_with_path(
+                    mut.get("intermediates", {})
+                )
+                if any(getattr(k, "key", None) == "moe_aux_loss" for k in path)
+            ]
+            if aux:
+                loss = loss + moe_aux_weight * (sum(aux) / len(aux)).astype(loss.dtype)
+        return loss
+
+    return loss_fn
+
+
 def make_train_step(model, tx, cross_host: bool = False, donate: bool = True,
                     grad_compression: str | None = None,
                     moe_aux_weight: float = 0.01,
@@ -139,7 +171,6 @@ def make_train_step(model, tx, cross_host: bool = False, donate: bool = True,
         raise ValueError(f"unknown grad_compression {grad_compression!r}")
     if bucket_bytes is not None and not cross_host:
         raise ValueError("bucket_bytes requires cross_host=True")
-    has_moe = getattr(model, "n_experts", 0) > 0
     if cross_host:
         # Import here so single-host training never touches the transport.
         from tpunet import distributed
@@ -148,33 +179,7 @@ def make_train_step(model, tx, cross_host: bool = False, donate: bool = True,
         world = distributed.world_size()  # raises early if initialize() was skipped
 
     def train_step(state: TrainState, images, labels, dropout_rng):
-        def loss_fn(p):
-            out = model.apply(
-                {"params": p}, images, train=True, rngs={"dropout": dropout_rng},
-                mutable=["intermediates"] if has_moe else False,
-            )
-            logits, mut = out if has_moe else (out, None)
-            loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
-            loss = loss.mean()
-            if has_moe:
-                # Each MoeMlp sows one scalar under .../moe_aux_loss; flax
-                # wraps sown values in tuples, so sum all leaves on matching
-                # paths and average over MoE blocks.
-                aux = [
-                    leaf
-                    for path, leaf in jax.tree_util.tree_leaves_with_path(
-                        mut.get("intermediates", {})
-                    )
-                    if any(
-                        getattr(k, "key", None) == "moe_aux_loss" for k in path
-                    )
-                ]
-                if aux:
-                    loss = loss + moe_aux_weight * (
-                        sum(aux) / len(aux)
-                    ).astype(loss.dtype)
-            return loss
-
+        loss_fn = _make_loss_fn(model, images, labels, dropout_rng, moe_aux_weight)
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
 
         if cross_host:
@@ -190,6 +195,94 @@ def make_train_step(model, tx, cross_host: bool = False, donate: bool = True,
 
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return jax.jit(train_step, donate_argnums=(0,) if donate else ())
+
+
+def _zero_shard_geometry(n: int, world: int) -> tuple[int, int]:
+    """(padded_size, shard_size) for an n-element flat vector over `world`
+    equal shards."""
+    pad = (-n) % world
+    return n + pad, (n + pad) // world
+
+
+def create_zero_train_state(model, rng, sample_input, tx) -> tuple[TrainState, Any]:
+    """ZeRO-1 companion to create_train_state: the optimizer state is built
+    on THIS RANK's flat parameter shard (1/world of the elements), not the
+    full pytree — the memory that dominates adamw training (2 f32 moments
+    per parameter) shrinks by the DCN world size. Requires
+    tpunet.distributed.initialize() first; every rank must call it."""
+    from tpunet import distributed
+
+    world = distributed.world_size()
+    rank = distributed.rank()
+    params = model.init(rng, sample_input)["params"]
+    flat, _ = ravel_pytree(params)
+    padded, shard_n = _zero_shard_geometry(flat.size, world)
+    if padded != flat.size:
+        flat = jnp.concatenate([flat, jnp.zeros(padded - flat.size, flat.dtype)])
+    shard = jax.lax.dynamic_slice(flat, (rank * shard_n,), (shard_n,))
+    return TrainState(params, tx.init(shard), jnp.zeros((), jnp.int32)), model.apply
+
+
+def make_zero_train_step(model, tx, donate: bool = True,
+                         grad_compression: str | None = None,
+                         moe_aux_weight: float = 0.01):
+    """ZeRO-1 (optimizer-state sharding) cross-host train step.
+
+    Instead of all-reducing the full gradient and updating replicated
+    optimizer state (make_train_step cross_host=True), each step:
+      1. reduce-scatters the flat gradient over DCN — each rank receives the
+         MEAN of its 1/world shard (same wire bytes as ring all-reduce's RS
+         phase; the reference's parent project ships sharded optimizers a
+         layer above its transport — this is that capability here),
+      2. applies `tx` to the shard against the matching parameter shard
+         (update FLOPs and optimizer memory both /world),
+      3. all-gathers the updated parameter shards (the AG phase's bytes).
+    Total DCN traffic equals the all-reduce path; memory and update compute
+    drop by world. The trajectory matches the replicated path to float
+    rounding: the ring all-reduce computes each element's sum in exactly the
+    RS phase this path runs, and adamw/sgd are elementwise, so sharding the
+    vector does not reorder any per-element arithmetic.
+
+    State must come from create_zero_train_state (sharded opt_state).
+    grad_compression="bf16" halves the reduce-scatter bytes (the gather of
+    updated params stays full precision).
+    """
+    if grad_compression not in (None, "bf16"):
+        raise ValueError(f"unknown grad_compression {grad_compression!r}")
+    from tpunet import distributed
+    from tpunet.interop import dcn_all_gather, dcn_reduce_scatter
+
+    world = distributed.world_size()
+    rank = distributed.rank()
+
+    def train_step(state: TrainState, images, labels, dropout_rng):
+        loss_fn = _make_loss_fn(model, images, labels, dropout_rng, moe_aux_weight)
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+
+        gflat, _ = ravel_pytree(grads)
+        pflat, unravel = ravel_pytree(state.params)
+        n = pflat.size
+        padded, shard_n = _zero_shard_geometry(n, world)
+        if padded != n:
+            zpad = jnp.zeros(padded - n, gflat.dtype)
+            gflat = jnp.concatenate([gflat, zpad])
+            pflat = jnp.concatenate([pflat, zpad.astype(pflat.dtype)])
+
+        if grad_compression == "bf16":
+            gshard = dcn_reduce_scatter(gflat.astype(jnp.bfloat16))
+            gshard = gshard.astype(gflat.dtype) / world
+        else:
+            gshard = dcn_reduce_scatter(gflat) / world
+        pshard = jax.lax.dynamic_slice(pflat, (rank * shard_n,), (shard_n,))
+
+        updates, opt_state = tx.update(gshard, state.opt_state, pshard)
+        new_pshard = optax.apply_updates(pshard, updates)
+
+        gathered = dcn_all_gather(new_pshard).reshape(-1)[:n]
+        params = unravel(gathered)
         return TrainState(params, opt_state, state.step + 1), loss
 
     return jax.jit(train_step, donate_argnums=(0,) if donate else ())
