@@ -80,13 +80,15 @@ def _build(args):
         labels = jax.device_put(labels, data_sh)
 
     if args.zero:
-        step = make_zero_train_step(model, tx, donate=True)
+        step = make_zero_train_step(model, tx, donate=True,
+                                    fused_xent_block=args.fused_xent)
     else:
         # Passed through unguarded: make_train_step rejects bucket_bytes
         # without cross_host, which is better than silently benchmarking the
         # wrong path.
         step = make_train_step(model, tx, cross_host=args.cross_host, donate=True,
-                               bucket_bytes=args.bucket_bytes)
+                               bucket_bytes=args.bucket_bytes,
+                               fused_xent_block=args.fused_xent)
     return state, step, tokens, labels, mesh
 
 
@@ -154,6 +156,9 @@ def _parse(argv):
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--batches-per-iter", type=int, default=3)
     ap.add_argument("--cross-host", action="store_true")
+    ap.add_argument("--fused-xent", type=int, default=None, metavar="BLOCK",
+                    help="blockwise fused cross-entropy with this vocab block "
+                         "size (never materializes the full logits tensor)")
     ap.add_argument("--zero", action="store_true",
                     help="ZeRO-1: shard optimizer state over the DCN world "
                          "(reduce-scatter grads, all-gather params)")

@@ -240,7 +240,11 @@ class Transformer(nn.Module):
     tp_axis: str | None = None
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
+    def __call__(self, tokens, train: bool = False, features_only: bool = False):
+        # features_only: return the final normed hidden states (b, s, d) in
+        # compute_dtype instead of logits — the input the blockwise fused
+        # cross-entropy (tpunet.ops.blockwise_cross_entropy) pairs with the
+        # lm_head kernel so the (b, s, vocab) logits are never materialized.
         del train  # no dropout in this family; kept for trainer signature
         emb = self.param(
             "embed", nn.initializers.normal(0.02), (self.vocab, self.d_model)
@@ -262,6 +266,14 @@ class Transformer(nn.Module):
                 tp_axis=self.tp_axis, name=f"block{i}",
             )(x)
         x = RMSNorm(name="norm_f")(x)
+        if features_only:
+            # The lm_head param must still exist (callers read it from the
+            # params tree), so touch the module without the full matmul.
+            head = nn.Dense(self.vocab, use_bias=False,
+                            dtype=self.compute_dtype, name="lm_head")
+            if self.is_initializing():
+                head(x[..., :1, :])  # materialize the kernel param
+            return x.astype(self.compute_dtype)
         logits = nn.Dense(self.vocab, use_bias=False,
                           dtype=self.compute_dtype, name="lm_head")(x)
         return logits.astype(jnp.float32)

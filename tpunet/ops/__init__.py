@@ -1,12 +1,15 @@
-"""tpunet.ops — TPU kernels for the hot ops (Pallas).
+"""tpunet.ops — TPU kernels and memory-fused ops for the hot paths.
 
 The reference (bagua-net) has no compute kernels — it is a transport. This
 package holds the compute-side hot ops our framework's model layer needs so
 the end-to-end benchmarks (VGG16-class DP, long-context transformer) keep the
-MXU fed: a flash-attention kernel with an online-softmax inner loop, used both
-for local attention and as the per-block compute of ring attention.
+MXU fed: a flash-attention kernel (Pallas) with an online-softmax inner loop,
+used both for local attention and as the per-block compute of ring attention,
+and a blockwise fused cross-entropy (pure XLA scan) that never materializes
+the (tokens, vocab) logits.
 """
 
 from tpunet.ops.flash_attention import attention_reference, flash_attention
+from tpunet.ops.fused_xent import blockwise_cross_entropy
 
-__all__ = ["flash_attention", "attention_reference"]
+__all__ = ["flash_attention", "attention_reference", "blockwise_cross_entropy"]

@@ -109,21 +109,52 @@ def _bucketed_dcn_pmean(grads, bucket_bytes: int, compression: str | None, world
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 
-def _make_loss_fn(model, images, labels, dropout_rng, moe_aux_weight: float):
+def _make_loss_fn(model, images, labels, dropout_rng, moe_aux_weight: float,
+                  fused_xent_block: int | None = None):
     """The train-step objective, shared by the replicated and ZeRO paths:
     token/label cross-entropy plus (for MoE models) the Switch router's sown
     load-balancing losses, collected via mutable=['intermediates'] — without
-    that term the router can collapse onto one expert."""
-    has_moe = getattr(model, "n_experts", 0) > 0
+    that term the router can collapse onto one expert.
 
+    fused_xent_block: compute the cross-entropy blockwise over the vocab
+    (tpunet.ops.blockwise_cross_entropy) so the (batch, seq, vocab) logits
+    are never materialized — requires a model supporting
+    ``features_only=True`` (the Transformer family) whose lm head lives at
+    params['lm_head']['kernel']. KNOWN LIMIT: the fused path reads the head
+    kernel directly, so under Megatron TP (lm_head split over tp_axis) GSPMD
+    gathers the kernel and replicates the head compute — numerically fine,
+    but the head's TP speedup is lost; prefer the default path when the lm
+    head is tensor-parallel."""
+    has_moe = getattr(model, "n_experts", 0) > 0
+    if fused_xent_block is not None and getattr(model, "tp_axis", None):
+        import warnings
+
+        warnings.warn(
+            "fused_xent_block with a tensor-parallel lm head replicates the "
+            "head compute (kernel is gathered); the TP head speedup is lost",
+            stacklevel=3,
+        )
+
+    fused = fused_xent_block is not None
     def loss_fn(p):
         out = model.apply(
             {"params": p}, images, train=True, rngs={"dropout": dropout_rng},
             mutable=["intermediates"] if has_moe else False,
+            **({"features_only": True} if fused else {}),
         )
-        logits, mut = out if has_moe else (out, None)
-        loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
-        loss = loss.mean()
+        out, mut = out if has_moe else (out, None)
+        if fused:
+            from tpunet.ops import blockwise_cross_entropy
+
+            loss = blockwise_cross_entropy(
+                out.reshape(-1, out.shape[-1]),
+                p["lm_head"]["kernel"],
+                labels.reshape(-1),
+                block_vocab=fused_xent_block,
+            ).mean()
+        else:
+            loss = optax.softmax_cross_entropy_with_integer_labels(out, labels)
+            loss = loss.mean()
         if has_moe:
             # flax wraps sown values in tuples: sum leaves on matching paths
             # and average over MoE blocks.
@@ -144,7 +175,8 @@ def _make_loss_fn(model, images, labels, dropout_rng, moe_aux_weight: float):
 def make_train_step(model, tx, cross_host: bool = False, donate: bool = True,
                     grad_compression: str | None = None,
                     moe_aux_weight: float = 0.01,
-                    bucket_bytes: int | None = None):
+                    bucket_bytes: int | None = None,
+                    fused_xent_block: int | None = None):
     """Build the jitted train step.
 
     cross_host=True adds the DCN gradient all-reduce tier (requires
@@ -179,7 +211,8 @@ def make_train_step(model, tx, cross_host: bool = False, donate: bool = True,
         world = distributed.world_size()  # raises early if initialize() was skipped
 
     def train_step(state: TrainState, images, labels, dropout_rng):
-        loss_fn = _make_loss_fn(model, images, labels, dropout_rng, moe_aux_weight)
+        loss_fn = _make_loss_fn(model, images, labels, dropout_rng, moe_aux_weight,
+                                fused_xent_block)
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
 
         if cross_host:
@@ -228,7 +261,8 @@ def create_zero_train_state(model, rng, sample_input, tx) -> tuple[TrainState, A
 
 def make_zero_train_step(model, tx, donate: bool = True,
                          grad_compression: str | None = None,
-                         moe_aux_weight: float = 0.01):
+                         moe_aux_weight: float = 0.01,
+                         fused_xent_block: int | None = None):
     """ZeRO-1 (optimizer-state sharding) cross-host train step.
 
     Instead of all-reducing the full gradient and updating replicated
@@ -259,7 +293,8 @@ def make_zero_train_step(model, tx, donate: bool = True,
     rank = distributed.rank()
 
     def train_step(state: TrainState, images, labels, dropout_rng):
-        loss_fn = _make_loss_fn(model, images, labels, dropout_rng, moe_aux_weight)
+        loss_fn = _make_loss_fn(model, images, labels, dropout_rng, moe_aux_weight,
+                                fused_xent_block)
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
 
         gflat, _ = ravel_pytree(grads)
