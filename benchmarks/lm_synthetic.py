@@ -81,14 +81,16 @@ def _build(args):
 
     if args.zero:
         step = make_zero_train_step(model, tx, donate=True,
-                                    fused_xent_block=args.fused_xent)
+                                    fused_xent_block=args.fused_xent,
+                                    accum_steps=args.accum)
     else:
         # Passed through unguarded: make_train_step rejects bucket_bytes
         # without cross_host, which is better than silently benchmarking the
         # wrong path.
         step = make_train_step(model, tx, cross_host=args.cross_host, donate=True,
                                bucket_bytes=args.bucket_bytes,
-                               fused_xent_block=args.fused_xent)
+                               fused_xent_block=args.fused_xent,
+                               accum_steps=args.accum)
     return state, step, tokens, labels, mesh
 
 
@@ -156,6 +158,9 @@ def _parse(argv):
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--batches-per-iter", type=int, default=3)
     ap.add_argument("--cross-host", action="store_true")
+    ap.add_argument("--accum", type=int, default=None, metavar="K",
+                    help="gradient accumulation over K microbatches (batch "
+                         "size must divide by K)")
     ap.add_argument("--fused-xent", type=int, default=None, metavar="BLOCK",
                     help="blockwise fused cross-entropy with this vocab block "
                          "size (never materializes the full logits tensor)")

@@ -267,12 +267,12 @@ class Transformer(nn.Module):
             )(x)
         x = RMSNorm(name="norm_f")(x)
         if features_only:
-            # The lm_head param must still exist (callers read it from the
-            # params tree), so touch the module without the full matmul.
-            head = nn.Dense(self.vocab, use_bias=False,
-                            dtype=self.compute_dtype, name="lm_head")
             if self.is_initializing():
-                head(x[..., :1, :])  # materialize the kernel param
+                # The lm_head param must still exist (fused-xent callers
+                # read it from the params tree): materialize the kernel with
+                # a 1-token touch instead of the full matmul.
+                nn.Dense(self.vocab, use_bias=False, dtype=self.compute_dtype,
+                         name="lm_head")(x[..., :1, :])
             return x.astype(self.compute_dtype)
         logits = nn.Dense(self.vocab, use_bias=False,
                           dtype=self.compute_dtype, name="lm_head")(x)

@@ -30,7 +30,8 @@ def blockwise_cross_entropy(feats, kernel, labels, block_vocab: int = 8192):
     feats: (N, d) floating (bf16/f32) — final hidden states.
     kernel: (d, V) lm-head weights (cast to feats.dtype for the matmul;
         accumulation is f32 via preferred_element_type).
-    labels: (N,) int32 in [0, V).
+    labels: (N,) int32; negatives wrap python-style (-1 == V-1) and
+        labels >= V produce NaN, matching optax exactly.
     Returns (N,) f32 losses: logsumexp(logits) - logits[label].
 
     Matches optax.softmax_cross_entropy_with_integer_labels(feats @ kernel)
@@ -40,6 +41,11 @@ def blockwise_cross_entropy(feats, kernel, labels, block_vocab: int = 8192):
     vocab = kernel.shape[1]
     if labels.shape != (n_tokens,):
         raise ValueError(f"labels shape {labels.shape} != ({n_tokens},)")
+    # Mirror optax's out-of-range semantics exactly: negative labels wrap
+    # python-style (-1 == vocab-1); labels >= vocab yield NaN (loud, not a
+    # silently-degraded plain logsumexp).
+    labels = jnp.where(labels < 0, labels + vocab, labels)
+    valid = (labels >= 0) & (labels < vocab)
     block_vocab = min(block_vocab, vocab)
     n_blocks = -(-vocab // block_vocab)
     padded = n_blocks * block_vocab
@@ -81,4 +87,5 @@ def blockwise_cross_entropy(feats, kernel, labels, block_vocab: int = 8192):
     (run_max, run_sum, label_logit), _ = jax.lax.scan(
         jax.checkpoint(body), init, (blocks, starts)
     )
+    label_logit = jnp.where(valid, label_logit, jnp.nan)
     return (run_max + jnp.log(run_sum)) - label_logit

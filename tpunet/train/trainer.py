@@ -172,11 +172,51 @@ def _make_loss_fn(model, images, labels, dropout_rng, moe_aux_weight: float,
     return loss_fn
 
 
+def _value_and_grads(model, params, images, labels, dropout_rng,
+                     moe_aux_weight: float, fused_xent_block: int | None,
+                     accum_steps: int | None):
+    """(mean loss, mean grads) for the batch — in one backward, or (with
+    accum_steps=k) as a lax.scan over k microbatches whose activations are
+    freed between iterations: the throughput-neutral way to run a batch k×
+    larger than activation memory allows. For dense models equal
+    microbatches make the mean-of-means exactly the full-batch mean; MoE
+    models route and compute expert capacity PER MICROBATCH (capacity =
+    f(micro tokens), aux loss is batch-nonlinear), the standard practice but
+    a slightly different objective than one full-batch step."""
+    if accum_steps is None or accum_steps == 1:
+        loss_fn = _make_loss_fn(model, images, labels, dropout_rng,
+                                moe_aux_weight, fused_xent_block)
+        return jax.value_and_grad(loss_fn)(params)
+
+    batch = images.shape[0]
+    if batch % accum_steps != 0:
+        raise ValueError(f"batch {batch} not divisible by accum_steps {accum_steps}")
+    micro = batch // accum_steps
+    images_mb = images.reshape(accum_steps, micro, *images.shape[1:])
+    labels_mb = labels.reshape(accum_steps, micro, *labels.shape[1:])
+    keys = jax.random.split(dropout_rng, accum_steps)
+
+    def body(carry, xs):
+        loss_sum, grad_sum = carry
+        im, lb, key = xs
+        loss_fn = _make_loss_fn(model, im, lb, key, moe_aux_weight,
+                                fused_xent_block)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        return (loss_sum + loss, jax.tree.map(jnp.add, grad_sum, grads)), None
+
+    init = (jnp.zeros((), jnp.float32), jax.tree.map(jnp.zeros_like, params))
+    (loss_sum, grad_sum), _ = jax.lax.scan(body, init, (images_mb, labels_mb, keys))
+    return loss_sum / accum_steps, jax.tree.map(
+        lambda g: g / accum_steps, grad_sum
+    )
+
+
 def make_train_step(model, tx, cross_host: bool = False, donate: bool = True,
                     grad_compression: str | None = None,
                     moe_aux_weight: float = 0.01,
                     bucket_bytes: int | None = None,
-                    fused_xent_block: int | None = None):
+                    fused_xent_block: int | None = None,
+                    accum_steps: int | None = None):
     """Build the jitted train step.
 
     cross_host=True adds the DCN gradient all-reduce tier (requires
@@ -211,9 +251,9 @@ def make_train_step(model, tx, cross_host: bool = False, donate: bool = True,
         world = distributed.world_size()  # raises early if initialize() was skipped
 
     def train_step(state: TrainState, images, labels, dropout_rng):
-        loss_fn = _make_loss_fn(model, images, labels, dropout_rng, moe_aux_weight,
-                                fused_xent_block)
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        loss, grads = _value_and_grads(model, state.params, images, labels,
+                                       dropout_rng, moe_aux_weight,
+                                       fused_xent_block, accum_steps)
 
         if cross_host:
             if bucket_bytes is not None:
@@ -262,7 +302,8 @@ def create_zero_train_state(model, rng, sample_input, tx) -> tuple[TrainState, A
 def make_zero_train_step(model, tx, donate: bool = True,
                          grad_compression: str | None = None,
                          moe_aux_weight: float = 0.01,
-                         fused_xent_block: int | None = None):
+                         fused_xent_block: int | None = None,
+                         accum_steps: int | None = None):
     """ZeRO-1 (optimizer-state sharding) cross-host train step.
 
     Instead of all-reducing the full gradient and updating replicated
@@ -283,6 +324,12 @@ def make_zero_train_step(model, tx, donate: bool = True,
     State must come from create_zero_train_state (sharded opt_state).
     grad_compression="bf16" halves the reduce-scatter bytes (the gather of
     updated params stays full precision).
+
+    Elastic caveat: the opt-state shard geometry bakes in (rank, world) at
+    trace time, so after an elastic rebuild that CHANGES the world size
+    (allow_shrink) the sharded opt state is invalid — rebuild it with
+    create_zero_train_state and restore params (not opt state) from the
+    checkpoint. Fixed-world rebuilds (replacement policy) resume fine.
     """
     if grad_compression not in (None, "bf16"):
         raise ValueError(f"unknown grad_compression {grad_compression!r}")
@@ -293,9 +340,9 @@ def make_zero_train_step(model, tx, donate: bool = True,
     rank = distributed.rank()
 
     def train_step(state: TrainState, images, labels, dropout_rng):
-        loss_fn = _make_loss_fn(model, images, labels, dropout_rng, moe_aux_weight,
-                                fused_xent_block)
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        loss, grads = _value_and_grads(model, state.params, images, labels,
+                                       dropout_rng, moe_aux_weight,
+                                       fused_xent_block, accum_steps)
 
         gflat, _ = ravel_pytree(grads)
         pflat, unravel = ravel_pytree(state.params)
