@@ -60,6 +60,72 @@ def _survivor(rank: int, world: int, port: int, q) -> None:
         q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
 
 
+def _prewiring_victim(rank: int, world: int, port: int, q) -> None:
+    # Dies after Init but BEFORE the first allreduce — so the survivor's
+    # lazy channel wiring (first collective) must fail with a typed error
+    # when it connects to the dead peer's closed listener, never hang.
+    # `q` is the victim's OWN queue, not shared with the survivor: a
+    # SIGKILL landing between the feeder thread's pipe write and its
+    # release of the queue's cross-process write lock would wedge every
+    # other writer forever — and on a 1-core box the parent reliably wakes
+    # from q.get (the pipe write) BEFORE that release, so kill-after-get
+    # hits the window ~half the time. Dedicated queue = no shared lock.
+    from tpunet.collectives import Communicator
+
+    comm = Communicator(f"127.0.0.1:{port}", rank, world)
+    comm.barrier()
+    q.put((rank, "ready"))
+    time.sleep(600)  # parent SIGKILLs long before this
+
+
+def _prewiring_survivor(rank: int, world: int, port: int, q, go) -> None:
+    try:
+        os.environ["TPUNET_CONNECT_RETRY_MS"] = "3000"
+        from tpunet.collectives import Communicator
+
+        comm = Communicator(f"127.0.0.1:{port}", rank, world)
+        comm.barrier()
+        q.put((rank, "ready"))
+        # Block until the parent confirms the victim is DEAD — a sleep here
+        # races: wiring against a still-alive-but-about-to-die victim blocks
+        # in accept (its backlog accepts our connect, no reply ever comes)
+        # instead of exercising the connect-refused path this test pins.
+        go.get(timeout=120)
+        arr = np.ones(4096, np.float32)
+        t0 = time.perf_counter()
+        try:
+            comm.iall_reduce(arr).wait()
+            q.put((rank, "FAIL: no error from wiring against a dead peer"))
+        except RuntimeError as e:
+            q.put((rank, f"OK error after {time.perf_counter() - t0:.1f}s: "
+                         f"{str(e)[:80]}"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def test_peer_death_before_channel_wiring_errors_cleanly():
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    vq = ctx.Queue()  # victim-only: see _prewiring_victim on why not shared
+    go = ctx.Queue()
+    port = free_port()
+    surv = ctx.Process(target=_prewiring_survivor, args=(0, 2, port, q, go))
+    vict = ctx.Process(target=_prewiring_victim, args=(1, 2, port, vq))
+    surv.start()
+    vict.start()
+    ready = {q.get(timeout=120)[0], vq.get(timeout=120)[0]}
+    assert ready == {0, 1}
+    vict.kill()  # before the survivor's first collective wires channels
+    vict.join(timeout=30)
+    go.put("victim dead")  # release the survivor into channel wiring
+    rank, status = q.get(timeout=120)
+    surv.join(timeout=30)
+    vict.join(timeout=30)
+    assert rank == 0 and status.startswith("OK error"), status
+
+
 def test_peer_death_mid_allreduce_errors_cleanly():
     import multiprocessing as mp
 
