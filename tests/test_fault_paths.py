@@ -113,17 +113,24 @@ def test_peer_death_before_channel_wiring_errors_cleanly():
     port = free_port()
     surv = ctx.Process(target=_prewiring_survivor, args=(0, 2, port, q, go))
     vict = ctx.Process(target=_prewiring_victim, args=(1, 2, port, vq))
-    surv.start()
-    vict.start()
-    ready = {q.get(timeout=120)[0], vq.get(timeout=120)[0]}
-    assert ready == {0, 1}
-    vict.kill()  # before the survivor's first collective wires channels
-    vict.join(timeout=30)
-    go.put("victim dead")  # release the survivor into channel wiring
-    rank, status = q.get(timeout=120)
-    surv.join(timeout=30)
-    vict.join(timeout=30)
-    assert rank == 0 and status.startswith("OK error"), status
+    try:
+        surv.start()
+        vict.start()
+        ready = {q.get(timeout=120)[0], vq.get(timeout=120)[0]}
+        assert ready == {0, 1}
+        vict.kill()  # before the survivor's first collective wires channels
+        vict.join(timeout=30)
+        go.put("victim dead")  # release the survivor into channel wiring
+        rank, status = q.get(timeout=120)
+        surv.join(timeout=30)
+        assert rank == 0 and status.startswith("OK error"), status
+    finally:
+        # A startup failure must not leave the 600s-sleeping victim (or a
+        # wedged survivor) blocking pytest exit.
+        for p in (surv, vict):
+            if p.is_alive():
+                p.kill()
+            p.join(timeout=10)
 
 
 def test_peer_death_mid_allreduce_errors_cleanly():

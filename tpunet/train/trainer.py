@@ -192,8 +192,14 @@ def _value_and_grads(model, params, images, labels, dropout_rng,
     if batch % accum_steps != 0:
         raise ValueError(f"batch {batch} not divisible by accum_steps {accum_steps}")
     micro = batch // accum_steps
-    images_mb = images.reshape(accum_steps, micro, *images.shape[1:])
-    labels_mb = labels.reshape(accum_steps, micro, *labels.shape[1:])
+    # STRIDED microbatches (row r -> microbatch r % k), not contiguous
+    # blocks: under a dp-sharded batch axis, contiguous blocks would put a
+    # whole microbatch on a subset of dp ranks (idling the rest each scan
+    # step), while strided grouping keeps every rank's shard contributing
+    # rows to every microbatch. Any equal-size grouping preserves the
+    # mean-of-means identity, so numerics don't care.
+    images_mb = images.reshape(micro, accum_steps, *images.shape[1:]).swapaxes(0, 1)
+    labels_mb = labels.reshape(micro, accum_steps, *labels.shape[1:]).swapaxes(0, 1)
     keys = jax.random.split(dropout_rng, accum_steps)
 
     def body(carry, xs):
