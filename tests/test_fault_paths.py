@@ -128,6 +128,8 @@ def test_peer_death_before_channel_wiring_errors_cleanly():
         # A startup failure must not leave the 600s-sleeping victim (or a
         # wedged survivor) blocking pytest exit.
         for p in (surv, vict):
+            if p.pid is None:  # start() itself failed: nothing to reap
+                continue
             if p.is_alive():
                 p.kill()
             p.join(timeout=10)

@@ -44,7 +44,10 @@ def test_gpipe_matches_sequential(pp, microbatches):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5)
 
 
-def test_gpipe_grad_matches_sequential():
+@pytest.mark.parametrize("remat", [False, True])
+def test_gpipe_grad_matches_sequential(remat):
+    # remat_stages changes what the backward SAVES, never what it computes:
+    # gradients must match the sequential reference either way.
     pp = 4
     mesh = make_named_mesh({"pp": pp})
     stacked = stack_stage_params(
@@ -53,7 +56,10 @@ def test_gpipe_grad_matches_sequential():
     x = jax.random.normal(jax.random.PRNGKey(1), (8, D))
 
     def loss_pipe(p):
-        return jnp.sum(gpipe(_stage_fn, p, x, mesh, num_microbatches=4) ** 2)
+        return jnp.sum(
+            gpipe(_stage_fn, p, x, mesh, num_microbatches=4,
+                  remat_stages=remat) ** 2
+        )
 
     def loss_seq(p):
         return jnp.sum(_sequential(p, x) ** 2)

@@ -89,13 +89,21 @@ def gpipe(
     num_microbatches: int,
     pp_axis: str = "pp",
     dp_axis: str | None = None,
+    remat_stages: bool = False,
 ):
     """Full-array entry point. stacked_params: pytree with leading stage dim
     W == mesh.shape[pp_axis] (see `stack_stage_params`); x: (batch, ...);
     returns (batch, ...). With `dp_axis`, each microbatch's row dim is
     additionally sharded over that mesh axis (pipeline x data parallelism:
     params stay dp-replicated, so shard_map's autodiff inserts the dp
-    gradient psum on the transpose automatically)."""
+    gradient psum on the transpose automatically).
+
+    remat_stages: checkpoint the stage function, so the backward pipeline
+    recomputes each tick's internal activations from its input instead of
+    saving them — the scan otherwise stashes every tick's residuals
+    (M + W - 1 ticks of full stage internals), which defeats pipelining's
+    memory point for training. With it, per-device residency is the tick
+    INPUTS only (one microbatch each) plus one stage's recompute."""
     w = mesh.shape[pp_axis]
     batch = x.shape[0]
     if batch % num_microbatches:
@@ -112,6 +120,8 @@ def gpipe(
         )
     xs = x.reshape((num_microbatches, mb) + x.shape[1:])
 
+    if remat_stages:
+        stage_fn = jax.checkpoint(stage_fn)
     param_specs = jax.tree.map(lambda _: P(pp_axis), stacked_params)
     data_spec = P(None, dp_axis) if dp_axis is not None else P()
     fn = shard_map(
