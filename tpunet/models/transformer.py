@@ -37,15 +37,18 @@ from tpunet.parallel.ring_attention import ring_self_attention
 from tpunet.parallel.ulysses import ulysses_self_attention
 
 
-def rotary_embed(x, base: float = 10000.0, pos_offset: int = 0):
+def rotary_embed(x, base: float = 10000.0, pos_offset: int = 0, positions=None):
     """Rotary position embedding. x: (b, s, h, d). pos_offset shifts to
     global positions when x is a sequence shard (cross-host ring attention —
-    each process holds positions [offset, offset + s))."""
+    each process holds positions [offset, offset + s)). `positions`
+    overrides with an explicit (s,) global-position vector — what permuted
+    sequence layouts (zigzag context parallelism) need."""
     _, s, _, d = x.shape
     half = d // 2
     freqs = jnp.exp(-math.log(base) * jnp.arange(0, half, dtype=jnp.float32) / half)
-    positions = pos_offset + jnp.arange(s, dtype=jnp.float32)
-    angles = positions[:, None] * freqs[None, :]  # (s, half)
+    if positions is None:
+        positions = pos_offset + jnp.arange(s, dtype=jnp.float32)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (s, half)
     cos = jnp.cos(angles)[None, :, None, :]
     sin = jnp.sin(angles)[None, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
@@ -68,6 +71,7 @@ class SelfAttention(nn.Module):
     """Causal multi-head self-attention with pluggable impl.
 
     attn_impl: "reference" (einsum softmax), "flash" (Pallas kernel),
+    "zigzag" (balanced causal CP; feed tokens through to_zigzag),
     "ring" / "ulysses" (sequence-parallel attention over `sp_axis` of
     `mesh` — k/v ring rotation vs all-to-all head re-sharding), or
     "dcn_ring" / "dcn_ulysses" (sequence sharded across PROCESSES over the
@@ -93,16 +97,35 @@ class SelfAttention(nn.Module):
         k = proj("k")(x).reshape(b, s, h, dh)
         v = proj("v")(x).reshape(b, s, h, dh)
         pos_offset = 0
+        positions = None
         if self.attn_impl in ("dcn_ring", "dcn_ulysses"):
             # The per-process model sees only its sequence shard; rotary
             # must use global positions for the ring to be coherent.
             from tpunet import distributed
 
             pos_offset = distributed.rank() * s
-        q = rotary_embed(q, pos_offset=pos_offset)
-        k = rotary_embed(k, pos_offset=pos_offset)
+        elif self.attn_impl == "zigzag":
+            # The WHOLE sequence axis is in zigzag chunk order (tokens fed
+            # through to_zigzag); rotary needs each row's natural position.
+            from tpunet.parallel.zigzag_attention import to_zigzag
 
-        if self.attn_impl in ("ring", "ulysses"):
+            if self.mesh is None:
+                raise ValueError("attn_impl='zigzag' requires a mesh")
+            positions = to_zigzag(
+                jnp.arange(s, dtype=jnp.float32),
+                self.mesh.shape[self.sp_axis], axis=0,
+            )
+        q = rotary_embed(q, pos_offset=pos_offset, positions=positions)
+        k = rotary_embed(k, pos_offset=pos_offset, positions=positions)
+
+        if self.attn_impl == "zigzag":
+            from tpunet.parallel.zigzag_attention import zigzag_self_attention
+
+            o = zigzag_self_attention(
+                q, k, v, self.mesh,
+                dp_axis=self.dp_axis, sp_axis=self.sp_axis, tp_axis=self.tp_axis,
+            )
+        elif self.attn_impl in ("ring", "ulysses"):
             if self.mesh is None:
                 raise ValueError(f"attn_impl={self.attn_impl!r} requires a mesh")
             sp_fn = ring_self_attention if self.attn_impl == "ring" else ulysses_self_attention

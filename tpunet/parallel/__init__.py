@@ -27,6 +27,13 @@ from tpunet.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ring_self_attention,
 )
+from tpunet.parallel.zigzag_attention import (  # noqa: F401
+    from_zigzag,
+    to_zigzag,
+    zigzag_positions,
+    zigzag_ring_attention,
+    zigzag_self_attention,
+)
 from tpunet.parallel.ulysses import (  # noqa: F401
     dcn_ulysses_attention,
     ulysses_attention,
