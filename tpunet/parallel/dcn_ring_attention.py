@@ -49,11 +49,18 @@ def dcn_ring_attention(q, k, v, causal: bool = False):
     # originated at rank (my - t) mod w; blocks travel rank -> rank+1.
     for t in range(w):
         src = (my - t) % w
-        acc, m, l = _block_update(
-            q, kc, vc, acc, m, l,
-            q_start=my * s_local, k_start=src * s_local,
-            causal=causal, scale=scale,
-        )
+        if causal and src > my:
+            # Fully-masked future block: the exchange must still happen (the
+            # ring is collective) but the einsums are skipped — and since
+            # src/my are Python ints here, the skip costs nothing at trace
+            # time (the ICI tier needs a lax.switch for the same schedule).
+            pass
+        else:
+            acc, m, l = _block_update(
+                q, kc, vc, acc, m, l,
+                q_start=my * s_local, k_start=src * s_local,
+                causal=causal, scale=scale,
+            )
         if t + 1 < w:
             kc = dcn_neighbor_exchange(kc)
             vc = dcn_neighbor_exchange(vc)

@@ -64,6 +64,33 @@ def _block_update(q, k, v, acc, m, l, q_start, k_start, causal: bool, scale: flo
     return acc_new, m_new, l_new
 
 
+def causal_block_mode(k_chunk, q_chunk):
+    """0=full (strictly past), 1=diagonal (same chunk), 2=skip (future),
+    comparing chunk/block indices. Traced scalars are fine."""
+    return jnp.where(k_chunk < q_chunk, 0, jnp.where(k_chunk == q_chunk, 1, 2))
+
+
+def switched_block_update(q, k, v, state, mode, scale: float):
+    """Fold one K/V block into the online-softmax `state` under a causal
+    block schedule: `mode` selects a full unmasked update, a same-chunk
+    diagonal update (offsets cancel, so 0/0 masks correctly), or a skip
+    whose einsums never execute. Branches carry no collectives, so
+    per-device divergence is SPMD-legal. Shared by the contiguous and
+    zigzag ring schedules."""
+    acc, m, l = state
+
+    def full(_):
+        return _block_update(q, k, v, acc, m, l, 0, 0, causal=False, scale=scale)
+
+    def diag(_):
+        return _block_update(q, k, v, acc, m, l, 0, 0, causal=True, scale=scale)
+
+    def skip(_):
+        return acc, m, l
+
+    return jax.lax.switch(mode, (full, diag, skip), None)
+
+
 def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     """Per-shard ring attention; call inside `shard_map` (or pmap).
 
@@ -96,11 +123,21 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         src = (my - t) % w  # whose block we currently hold
-        acc, m, l = _block_update(
-            q, k_cur, v_cur, acc, m, l,
-            q_start=my * s_local, k_start=src * s_local,
-            causal=causal, scale=scale,
-        )
+
+        if causal:
+            # Causal block-granular schedule: a held block entirely in this
+            # rank's future is fully masked — skip its einsums instead of
+            # computing then discarding them (halves total causal FLOPs;
+            # NOTE the contiguous layout still concentrates the remaining
+            # work on high ranks — zigzag_attention.py is the balanced
+            # variant that also cuts the critical path).
+            mode = causal_block_mode(src, my)
+            acc, m, l = switched_block_update(
+                q, k_cur, v_cur, (acc, m, l), mode, scale
+            )
+        else:
+            acc, m, l = _block_update(q, k_cur, v_cur, acc, m, l, 0, 0,
+                                      causal=False, scale=scale)
         return (k_nxt, v_nxt, acc, m, l), None
 
     (_, _, acc, _, l), _ = jax.lax.scan(body, (k, v, acc0, m0, l0), jnp.arange(w))

@@ -38,7 +38,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from tpunet.parallel.ring_attention import NEG_INF, _block_update
+from tpunet.parallel.ring_attention import (NEG_INF, _block_update,
+                                            causal_block_mode,
+                                            switched_block_update)
 from tpunet.parallel.smap import full_varying, shard_map, vma_of
 
 
@@ -109,26 +111,6 @@ def zigzag_ring_attention(q, k, v, axis_name: str):
             full_varying(shape + (1,), 0.0, jnp.float32, vma),
         )
 
-    def _pair(state, qh, kh, vh, mode):
-        """mode: traced 0=full block, 1=diagonal (causal within chunk),
-        2=skip. The branches carry no collectives, so per-device divergence
-        is SPMD-legal; skipped branches cost nothing at runtime."""
-        acc, m, l = state
-
-        def full(_):
-            return _block_update(qh, kh, vh, acc, m, l, 0, 0, causal=False,
-                                 scale=scale)
-
-        def diag(_):
-            # Same chunk on both sides: offsets cancel, 0/0 works.
-            return _block_update(qh, kh, vh, acc, m, l, 0, 0, causal=True,
-                                 scale=scale)
-
-        def skip(_):
-            return acc, m, l
-
-        return jax.lax.switch(mode, (full, diag, skip), None)
-
     def body(carry, t):
         k_cur, v_cur, st_lo, st_hi = carry
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
@@ -142,11 +124,12 @@ def zigzag_ring_attention(q, k, v, axis_name: str):
         st_hi = _block_update(q_hi, k_lo, v_lo, acc, m, l, 0, 0, causal=False,
                               scale=scale)
         # a_lo x b_lo: full iff src < my, diag iff src == my, else skip.
-        mode_lo = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
-        st_lo = _pair(st_lo, q_lo, k_lo, v_lo, mode_lo)
-        # a_hi x b_hi: full iff src > my, diag iff src == my, else skip.
-        mode_hi = jnp.where(src > my, 0, jnp.where(src == my, 1, 2))
-        st_hi = _pair(st_hi, q_hi, k_hi, v_hi, mode_hi)
+        st_lo = switched_block_update(q_lo, k_lo, v_lo, st_lo,
+                                      causal_block_mode(src, my), scale)
+        # a_hi x b_hi: chunk ids 2w-1-src vs 2w-1-my reverse the order —
+        # full iff src > my, diag iff src == my, else skip.
+        st_hi = switched_block_update(q_hi, k_hi, v_hi, st_hi,
+                                      causal_block_mode(my, src), scale)
         # (a_lo x b_hi never computes: b_hi >= W > a_lo for every step.)
         return (k_nxt, v_nxt, st_lo, st_hi), None
 
