@@ -46,7 +46,8 @@ def _build(args):
         vocab=args.vocab, d_model=args.d_model, n_layers=args.layers,
         n_heads=args.heads, d_ff=4 * args.d_model, n_experts=args.experts,
         compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
-        attn_impl="ring" if args.sp > 1 else "reference",
+        attn_impl=("zigzag" if args.zigzag else "ring") if args.sp > 1
+        else "reference",
         mesh=mesh, tp_axis="mdl" if args.tp > 1 else None,
     )
     tx = optax.adamw(3e-4)
@@ -54,6 +55,13 @@ def _build(args):
     toks = rng.integers(0, args.vocab, size=(args.batch_size, args.seq))
     tokens = jnp.asarray(toks, jnp.int32)
     labels = jnp.roll(tokens, -1, axis=1)
+    if args.zigzag:
+        from tpunet.parallel import to_zigzag
+
+        # The whole pipeline runs in zigzag sequence order; labels are
+        # next-token in NATURAL order, permuted the same way.
+        tokens = to_zigzag(tokens, args.sp)
+        labels = to_zigzag(labels, args.sp)
     if args.zero:
         if not args.cross_host:
             raise SystemExit("--zero requires --cross-host (it shards the "
@@ -151,6 +159,9 @@ def _parse(argv):
     ap.add_argument("--heads", type=int, default=8)
     ap.add_argument("--experts", type=int, default=0)
     ap.add_argument("--sp", type=int, default=1, help="sequence-parallel axis size")
+    ap.add_argument("--zigzag", action="store_true",
+                    help="balanced causal context parallelism (zigzag layout) "
+                         "instead of the contiguous ring; requires --sp > 1")
     ap.add_argument("--tp", type=int, default=1, help="tensor-parallel axis size")
     ap.add_argument("--bf16", action="store_true", default=True)
     ap.add_argument("--no-bf16", dest="bf16", action="store_false")
@@ -176,6 +187,12 @@ def _parse(argv):
 def main(argv=None):
     args = _parse(argv)
     need = args.sp * args.tp
+    if args.zigzag and args.sp <= 1:
+        # Validated BEFORE any worker spawns: a SystemExit inside a spawned
+        # worker escapes its `except Exception` reporter and would leave the
+        # parent blocking on the result queue instead of printing this.
+        raise SystemExit("--zigzag requires --sp > 1 (it is the balanced "
+                         "causal layout for sequence parallelism)")
     if args.world > 1 and need > 1:
         # Loopback ranks are single-device; silently downgrading sp/tp would
         # report tokens/s for a configuration the user didn't ask for.

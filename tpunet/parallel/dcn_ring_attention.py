@@ -56,10 +56,14 @@ def dcn_ring_attention(q, k, v, causal: bool = False):
             # time (the ICI tier needs a lax.switch for the same schedule).
             pass
         else:
+            # Strictly-past blocks (src < my) are entirely unmasked — only
+            # the diagonal needs the elementwise causal mask. Free at trace
+            # time (src/my are Python ints), mirroring the ICI tier's
+            # full/diag split.
             acc, m, l = _block_update(
                 q, kc, vc, acc, m, l,
                 q_start=my * s_local, k_start=src * s_local,
-                causal=causal, scale=scale,
+                causal=causal and src == my, scale=scale,
             )
         if t + 1 < w:
             kc = dcn_neighbor_exchange(kc)
