@@ -105,6 +105,20 @@ def test_transformer_zigzag_matches_reference():
     )
 
 
+def test_zigzag_with_dp_axis():
+    # dp x sp mesh: batch sharded over dp while the zigzag ring runs over sp.
+    w = 4
+    mesh = make_named_mesh({"dp": 2, "sp": w})
+    seq = 4 * 2 * w
+    q, k, v = _qkv(jax.random.PRNGKey(9), seq)
+    want = attention_reference(q, k, v, causal=True)
+    qz, kz, vz = (to_zigzag(x, w) for x in (q, k, v))
+    out = zigzag_self_attention(qz, kz, vz, mesh, dp_axis="dp", sp_axis="sp")
+    np.testing.assert_allclose(
+        np.asarray(from_zigzag(out, w)), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
 def test_rejects_odd_shard():
     mesh = make_named_mesh({"sp": 2})
     q, k, v = _qkv(jax.random.PRNGKey(1), 6)  # 3 per shard: not a pair
