@@ -212,6 +212,27 @@ def main() -> None:
     model_tier = _model_tier(tpu_up, kernels)
     if model_tier is not None:
         print(f"[bench] model tier: {model_tier}", file=sys.stderr)
+
+    # The axon tunnel flaps for hours at a time. When it is down at bench
+    # time, attach the round's committed real-chip measurement with explicit
+    # provenance (its own timestamp + config + note) — clearly labeled
+    # replay, never merged into the live fields — so a flap does not erase
+    # the hardware validation this round's code actually has.
+    tpu_last_measured = None
+    if not tpu_up or (model_tier or {}).get("platform") != "tpu":
+        try:
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "benchmarks", "tpu_measured.json")) as f:
+                loaded = json.load(f)
+            if isinstance(loaded, dict):
+                tpu_last_measured = loaded
+                print("[bench] TPU tier unavailable now; attaching committed "
+                      f"measurement from {loaded.get('measured_at')} "
+                      f"(code as of {loaded.get('measured_commit')} — compare "
+                      "against HEAD before trusting it for NEWER kernel/model "
+                      "changes)", file=sys.stderr)
+        except (OSError, ValueError):
+            pass
     print(
         json.dumps(
             {
@@ -224,6 +245,8 @@ def main() -> None:
                 "analysis": "PERF_NOTES.md",
                 "kernels": kernels,
                 "model_tier": model_tier,
+                **({"tpu_last_measured": tpu_last_measured}
+                   if tpu_last_measured else {}),
             }
         )
     )
