@@ -74,8 +74,11 @@ class SelfAttention(nn.Module):
     "zigzag" (balanced causal CP; feed tokens through to_zigzag),
     "ring" / "ulysses" (sequence-parallel attention over `sp_axis` of
     `mesh` — k/v ring rotation vs all-to-all head re-sharding), or
-    "dcn_ring" / "dcn_ulysses" (sequence sharded across PROCESSES over the
-    tpunet DCN transport — requires tpunet.distributed.initialize()).
+    "dcn_ring" / "dcn_ulysses" / "dcn_zigzag" (sequence sharded across
+    PROCESSES over the tpunet DCN transport — requires
+    tpunet.distributed.initialize(); dcn_zigzag additionally expects each
+    process's shard to be its zigzag chunk pair, i.e. tokens fed through
+    to_zigzag, and is the balanced-causal variant of dcn_ring).
     """
 
     n_heads: int
@@ -104,6 +107,16 @@ class SelfAttention(nn.Module):
             from tpunet import distributed
 
             pos_offset = distributed.rank() * s
+        elif self.attn_impl == "dcn_zigzag":
+            # Per-process shard = zigzag chunk pair of the global sequence.
+            from tpunet import distributed
+            from tpunet.parallel.zigzag_attention import zigzag_positions
+
+            positions = zigzag_positions(
+                distributed.world_size(),
+                distributed.world_size() * s,
+                distributed.rank(),
+            ).astype(jnp.float32)
         elif self.attn_impl == "zigzag":
             # The WHOLE sequence axis is in zigzag chunk order (tokens fed
             # through to_zigzag); rotary needs each row's natural position.
@@ -137,6 +150,10 @@ class SelfAttention(nn.Module):
             from tpunet.parallel.dcn_ring_attention import dcn_ring_attention
 
             o = dcn_ring_attention(q, k, v, causal=True)
+        elif self.attn_impl == "dcn_zigzag":
+            from tpunet.parallel.dcn_ring_attention import dcn_zigzag_attention
+
+            o = dcn_zigzag_attention(q, k, v)
         elif self.attn_impl == "dcn_ulysses":
             from tpunet.parallel.ulysses import dcn_ulysses_attention
 

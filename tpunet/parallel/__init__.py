@@ -18,6 +18,7 @@ from tpunet.parallel.mesh import (  # noqa: F401
 )
 from tpunet.parallel.dcn_ring_attention import (  # noqa: F401
     dcn_ring_attention,
+    dcn_zigzag_attention,
 )
 from tpunet.parallel.pipeline import (  # noqa: F401
     gpipe,

@@ -69,3 +69,67 @@ def dcn_ring_attention(q, k, v, causal: bool = False):
             kc = dcn_neighbor_exchange(kc)
             vc = dcn_neighbor_exchange(vc)
     return (acc / l).astype(q.dtype)
+
+
+def dcn_zigzag_attention(q, k, v):
+    """Cross-host ZIGZAG causal attention: the balanced-schedule sibling of
+    `dcn_ring_attention`, mirroring the ICI pair
+    (`ring_attention`/`zigzag_ring_attention`). Each process holds sequence
+    chunks (rank, 2W-1-rank) of a `to_zigzag`-permuted global sequence, so
+    every process does ~the same causal work per ring step instead of the
+    last rank carrying W full blocks. The whole schedule is TRACE-TIME
+    static here (rank/world are Python ints), so skipped chunk-pairs emit no
+    ops at all. Causal only — that is the imbalance being fixed.
+
+    q/k/v: (batch, 2c, heads, head_dim), this process's zigzag chunk pair.
+    Positions for rotary: `zigzag_positions(world, world*2c, rank)`.
+    """
+    from tpunet import distributed
+    from tpunet.interop import dcn_neighbor_exchange
+
+    w = distributed.world_size()
+    my = distributed.rank()
+    if q.shape[1] % 2:
+        raise ValueError("zigzag shard length must be even (a chunk pair)")
+    c = q.shape[1] // 2
+    scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def _init(qh):
+        return (
+            jnp.zeros(qh.shape[:3] + (v.shape[-1],), jnp.float32),
+            jnp.full(qh.shape[:3] + (1,), NEG_INF, jnp.float32),
+            jnp.zeros(qh.shape[:3] + (1,), jnp.float32),
+        )
+
+    q_lo, q_hi = q[:, :c], q[:, c:]
+    st_lo, st_hi = _init(q_lo), _init(q_hi)
+    kc, vc = k, v
+    for t in range(w):
+        src = (my - t) % w  # holder of chunks (src, 2w-1-src) this step
+        k_lo, v_lo = kc[:, :c], vc[:, :c]
+        k_hi, v_hi = kc[:, c:], vc[:, c:]
+        # a_hi x b_lo: always a full unmasked block (b_lo < W <= a_hi).
+        st_hi = _block_update(q_hi, k_lo, v_lo, *st_hi, 0, 0,
+                              causal=False, scale=scale)
+        # a_lo x b_lo: full iff src < my, diagonal iff equal, else nothing.
+        if src < my:
+            st_lo = _block_update(q_lo, k_lo, v_lo, *st_lo, 0, 0,
+                                  causal=False, scale=scale)
+        elif src == my:
+            st_lo = _block_update(q_lo, k_lo, v_lo, *st_lo, 0, 0,
+                                  causal=True, scale=scale)
+        # a_hi x b_hi: chunk order reverses — full iff src > my.
+        if src > my:
+            st_hi = _block_update(q_hi, k_hi, v_hi, *st_hi, 0, 0,
+                                  causal=False, scale=scale)
+        elif src == my:
+            st_hi = _block_update(q_hi, k_hi, v_hi, *st_hi, 0, 0,
+                                  causal=True, scale=scale)
+        # (a_lo x b_hi never computes: b_hi >= W > a_lo.)
+        if t + 1 < w:
+            kc = dcn_neighbor_exchange(kc)
+            vc = dcn_neighbor_exchange(vc)
+    out = jnp.concatenate(
+        [st_lo[0] / st_lo[2], st_hi[0] / st_hi[2]], axis=1
+    )
+    return out.astype(q.dtype)
