@@ -237,6 +237,114 @@ def test_shrink_requires_advertise_host_on_nonloopback(tmp_path):
                     world_size=2, directory=tmp_path, allow_shrink=True)
 
 
+def _cascade_worker(rank: int, world: int, port: int, q, dirpath: str,
+                    die_step) -> None:
+    # die_step: step at which THIS member SIGKILLs itself (None = survivor).
+    # Gradients key off comm.rank, so each membership phase is analytic.
+    try:
+        from pathlib import Path
+
+        from tpunet.train.elastic import run_elastic
+
+        ckpt = Path(dirpath)
+
+        def train_once(comm, gen):
+            w, r = comm.world_size, comm.rank
+            latest = _latest_step(ckpt)
+            params = (np.load(ckpt / f"step_{latest}.npy") if latest >= 0
+                      else np.zeros(NPARAMS, np.float32))
+            for step in range(latest + 1, STEPS):
+                if die_step is not None and step == die_step:
+                    os.kill(os.getpid(), signal.SIGKILL)
+                g = comm.all_reduce(_grad(step, r)) / w
+                params = params - 0.1 * g
+                if r == 0:
+                    tmp = ckpt / f".step_{step}.tmp.npy"
+                    np.save(tmp, params)
+                    os.replace(tmp, ckpt / f"step_{step}.npy")
+                comm.barrier()
+            return params, w
+
+        params, final_world = run_elastic(
+            train_once,
+            coordinator=f"127.0.0.1:{port}",
+            rank=rank,
+            world_size=world,
+            directory=dirpath,
+            max_restarts=4,
+            allow_shrink=True,
+            shrink_grace_s=3.0,
+            min_world=1,
+        )
+        q.put((rank, ("OK", params.tolist(), final_world)))
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        q.put((rank, (f"FAIL: {type(e).__name__}: {e}",
+                      traceback.format_exc()[-600:])))
+
+
+def test_cascading_shrink_to_solo(tmp_path):
+    # Two failures in sequence: 3 ranks -> rank 1 dies at step 5 (shrink to
+    # world 2) -> member 2 dies at step 8 (shrink to world 1) -> member 0
+    # finishes SOLO on the exact three-phase analytic trajectory.
+    import multiprocessing as mp
+    import queue as queue_mod
+    import time
+
+    os.environ["TPUNET_BOOTSTRAP_TIMEOUT_MS"] = "30000"
+    os.environ["TPUNET_CONNECT_RETRY_MS"] = "2000"
+    try:
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        # Dedicated queue per victim (mp.Queue SIGKILL write-lock hazard —
+        # see _prewiring_victim in test_fault_paths.py).
+        vq1, vq2 = ctx.Queue(), ctx.Queue()
+        port = free_port()
+        procs = {
+            0: ctx.Process(target=_cascade_worker,
+                           args=(0, WORLD, port, q, str(tmp_path), None)),
+            1: ctx.Process(target=_cascade_worker,
+                           args=(1, WORLD, port, vq1, str(tmp_path), 5)),
+            2: ctx.Process(target=_cascade_worker,
+                           args=(2, WORLD, port, vq2, str(tmp_path), 8)),
+        }
+        for p in procs.values():
+            p.start()
+        result = None
+        deadline = time.time() + 240
+        while result is None and time.time() < deadline:
+            try:
+                result = q.get(timeout=1.0)
+            except queue_mod.Empty:
+                pass
+        for p in procs.values():
+            p.join(timeout=30)
+            if p.is_alive():
+                p.kill()
+
+        assert result is not None, "survivor never reported"
+        rank, payload = result
+        assert rank == 0 and payload[0] == "OK", payload
+        assert payload[2] == 1, f"final world {payload[2]} != 1 (solo)"
+        assert procs[1].exitcode == -signal.SIGKILL
+        assert procs[2].exitcode == -signal.SIGKILL
+
+        # Three-phase analytic trajectory: W=3 for steps 0-4, W=2 (members
+        # {0,2} -> ranks {0,1}) for 5-7, W=1 for 8-11.
+        params = np.zeros(NPARAMS, np.float32)
+        for step in range(STEPS):
+            w = 3 if step < 5 else (2 if step < 8 else 1)
+            g = np.sum([_grad(step, r) for r in range(w)], axis=0,
+                       dtype=np.float32) / w
+            params = params - 0.1 * g
+        np.testing.assert_allclose(np.asarray(payload[1], np.float32), params,
+                                   rtol=5e-6, atol=5e-7)
+    finally:
+        os.environ.pop("TPUNET_BOOTSTRAP_TIMEOUT_MS", None)
+        os.environ.pop("TPUNET_CONNECT_RETRY_MS", None)
+
+
 def test_shrink_to_survivors(tmp_path):
     results = _supervise_with_respawn(
         _shrink_worker, world=WORLD, victim=1, dirpath=str(tmp_path),
