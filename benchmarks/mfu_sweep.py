@@ -14,7 +14,7 @@ import json
 import sys
 
 CONFIGS = [
-    # (d_model, layers, d_ff, heads, batch, seq, remat)
+    # (d_model, layers, d_ff, heads, batch, seq, remat[, remat_policy])
     (2048, 12, 8192, 16, 8, 2048, True),   # the round-3 v5e headline winner
     (2048, 12, 8192, 16, 16, 2048, True),
     (2048, 16, 8192, 16, 8, 2048, True),   # OOM on 16 GB v5e
@@ -23,6 +23,12 @@ CONFIGS = [
     # Long-context: flash O(S) memory is what makes s8192 fit at all —
     # reference attention would materialize b*h*S^2 scores (>8 GB here).
     (2048, 12, 8192, 16, 2, 8192, True),
+    # Selective remat: full-block remat re-executes the forward (~8ND run vs
+    # 6ND counted -> MFU ceiling 0.75); "dots" saves matmul outputs and
+    # recomputes only elementwise, trading HBM back for recompute FLOPs.
+    (2048, 12, 8192, 16, 8, 2048, True, "dots"),
+    (2048, 12, 8192, 16, 8, 2048, False),  # no remat at all (OOM probe)
+    (2048, 12, 8192, 16, 4, 2048, True, "dots"),  # dots at half batch
 ]
 
 # Fused blockwise cross-entropy (tpunet.ops.blockwise_cross_entropy) per
@@ -48,10 +54,11 @@ def main(argv=None) -> None:
     peak = _peak_for(dev.device_kind) if dev.platform == "tpu" else None
 
     for ci in which:
-        d, n_layers, ff, heads, batch, seq, remat = CONFIGS[ci]
+        d, n_layers, ff, heads, batch, seq, remat, *rest = CONFIGS[ci]
+        policy = rest[0] if rest else None
         cfg = dict(vocab=32000, d_model=d, n_layers=n_layers, n_heads=heads, d_ff=ff)
         model = Transformer(compute_dtype=jnp.bfloat16, attn_impl="flash",
-                            remat=remat, **cfg)
+                            remat=remat, remat_policy=policy, **cfg)
         tx = optax.adamw(3e-4)
         rng = np.random.default_rng(0)
         tokens = jnp.asarray(rng.integers(0, cfg["vocab"], (batch, seq)), jnp.int32)
@@ -71,6 +78,8 @@ def main(argv=None) -> None:
         fps = fpt * batch * seq
         print(json.dumps({
             "cfg": ci, "d": d, "L": n_layers, "ff": ff, "b": batch, "s": seq,
+            **({"remat_policy": policy} if policy else {}),
+            **({} if remat else {"remat": False}),
             "params_M": round(n_params / 1e6, 1),
             "step_s": round(dt, 4),
             "tok_s": round(batch * seq / dt, 1),

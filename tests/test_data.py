@@ -1,0 +1,138 @@
+"""Input-pipeline tests: .bin packing, windowing, dp-sharded batching,
+host->device prefetch."""
+
+import numpy as np
+import pytest
+
+from tpunet.data import TokenDataset, pack_documents, prefetch_to_device, token_batches
+
+
+@pytest.fixture()
+def bin_path(tmp_path):
+    path = str(tmp_path / "toks.bin")
+    docs = [list(range(1, 8)), list(range(10, 14)), list(range(20, 30))]
+    total = pack_documents(iter(docs), path, vocab=64, eos_id=0)
+    assert total == 7 + 4 + 10 + 3  # + one eos per doc
+    return path
+
+
+def test_pack_and_window_layout(bin_path):
+    ds = TokenDataset(bin_path, seq=4, vocab=64)
+    # Flat stream: 1..7,0,10..13,0,20..29,0 -> 24 tokens -> 5 windows of 4+1.
+    assert ds.n_windows == 5
+    np.testing.assert_array_equal(ds.window(0), [1, 2, 3, 4, 5])
+    np.testing.assert_array_equal(ds.window(1), [5, 6, 7, 0, 10])
+    inputs, labels = ds.batch(np.array([0, 1]))
+    np.testing.assert_array_equal(inputs, [[1, 2, 3, 4], [5, 6, 7, 0]])
+    np.testing.assert_array_equal(labels, [[2, 3, 4, 5], [6, 7, 0, 10]])
+
+
+def test_pack_rejects_out_of_vocab(tmp_path):
+    with pytest.raises(ValueError, match="outside"):
+        pack_documents(iter([[70]]), str(tmp_path / "bad.bin"), vocab=64)
+
+
+def test_pack_rejects_ids_that_would_wrap_in_storage_dtype(tmp_path):
+    # vocab 60000 selects uint16 storage; 70000 would wrap to 4464 and pass
+    # a post-cast check. The range check must run on the un-cast values.
+    with pytest.raises(ValueError, match="outside"):
+        pack_documents(iter([[70000]]), str(tmp_path / "w.bin"), vocab=60000)
+    with pytest.raises(ValueError, match="outside"):
+        pack_documents(iter([[-1]]), str(tmp_path / "n.bin"), vocab=60000)
+    with pytest.raises(ValueError, match="eos_id"):
+        pack_documents(iter([[1]]), str(tmp_path / "e.bin"), vocab=64, eos_id=64)
+
+
+def test_dp_sharded_batches_disjoint_and_covering(bin_path):
+    ds = TokenDataset(bin_path, seq=4, vocab=64)  # 5 windows
+    seen = []
+    for rank in range(2):
+        for inputs, labels in token_batches(
+            ds, batch=1, rank=rank, world=2, seed=7, epochs=1
+        ):
+            assert inputs.shape == (1, 4) and labels.shape == (1, 4)
+            seen.append(inputs[0].tolist())
+    # 2 ranks x 2 batches of 1 = 4 of the 5 windows, all distinct.
+    assert len(seen) == 4
+    assert len({tuple(r) for r in seen}) == 4
+
+
+def test_batches_deterministic_from_seed(bin_path):
+    ds = TokenDataset(bin_path, seq=4, vocab=64)
+    a = [x[0].tolist() for x in token_batches(ds, 2, seed=3, epochs=2)]
+    b = [x[0].tolist() for x in token_batches(ds, 2, seed=3, epochs=2)]
+    assert a == b
+    c = [x[0].tolist() for x in token_batches(ds, 2, seed=4, epochs=2)]
+    assert a != c
+
+
+def test_epochs_reshuffle(bin_path):
+    ds = TokenDataset(bin_path, seq=4, vocab=64)
+    per_epoch = [x[0].tolist() for x in token_batches(ds, 2, seed=0, epochs=2)]
+    assert len(per_epoch) == 4  # 2 per epoch (5 windows // batch 2)
+    assert per_epoch[:2] != per_epoch[2:]  # epoch feeds the permutation
+
+
+def test_prefetch_matches_plain_iteration(bin_path):
+    ds = TokenDataset(bin_path, seq=4, vocab=64)
+    plain = list(token_batches(ds, 1, seed=1, epochs=2))
+    pre = list(prefetch_to_device(token_batches(ds, 1, seed=1, epochs=2), size=2))
+    assert len(pre) == len(plain)
+    for (pi, pl), (qi, ql) in zip(plain, pre):
+        np.testing.assert_array_equal(pi, np.asarray(qi))
+        np.testing.assert_array_equal(pl, np.asarray(ql))
+    # device-resident output
+    assert hasattr(pre[0][0], "devices")
+
+
+def test_prefetch_abandoned_consumer_releases_worker(bin_path):
+    import threading
+    import time
+
+    ds = TokenDataset(bin_path, seq=4, vocab=64)
+    # Infinite source, tiny queue: without the stop signal the worker would
+    # block forever on the full queue after the consumer walks away.
+    it = prefetch_to_device(token_batches(ds, 1, seed=1), size=1)
+    next(it)
+    before = {t.name for t in threading.enumerate()}
+    assert any(n.startswith("tpunet-prefetch") for n in before)
+    it.close()  # GeneratorExit -> finally -> stop + drain
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        alive = [
+            t for t in threading.enumerate()
+            if t.name.startswith("tpunet-prefetch") and t.is_alive()
+        ]
+        if not alive:
+            break
+        time.sleep(0.05)
+    assert not alive
+
+
+def test_prefetch_propagates_source_errors():
+    def bad():
+        yield np.zeros((2, 2))
+        raise RuntimeError("loader exploded")
+
+    it = prefetch_to_device(bad(), size=1)
+    next(it)
+    with pytest.raises(RuntimeError, match="loader exploded"):
+        next(it)
+
+
+def test_prefetch_with_sharding(bin_path):
+    import jax
+    from tpunet.parallel import batch_sharding, make_named_mesh
+
+    mesh = make_named_mesh({"dp": 2})
+    ds = TokenDataset(bin_path, seq=4, vocab=64)
+    out = list(
+        prefetch_to_device(
+            token_batches(ds, 2, seed=1, epochs=1),
+            size=2,
+            sharding=batch_sharding(mesh),
+        )
+    )
+    assert out
+    inputs, _ = out[0]
+    assert len(inputs.sharding.device_set) == 2

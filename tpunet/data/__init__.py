@@ -1,0 +1,24 @@
+"""Input pipeline: memmapped token datasets, dp-sharded batching, and
+host->device prefetch.
+
+The reference repo ships no data layer (it is a transport; training data
+was nccl-tests/Bagua's synthetic generators — reference README.md:20-52).
+A complete training framework needs one, built TPU-first:
+
+  * The loader never touches the accelerator on the iteration path —
+    batches are cut from a numpy memmap (no tokenization at train time;
+    tokens are preprocessed once into a flat .bin).
+  * `prefetch_to_device` overlaps the NEXT batch's host->HBM transfer with
+    the CURRENT step's compute from a background thread, the host-side
+    mirror of the DCN tier's transfer/compute overlap.
+  * dp sharding happens at the INDEX level (rank r reads row r, r+W, ...),
+    so every rank IO-reads only its own rows — no broadcast, no redundant
+    reads, deterministic across ranks from the shared seed.
+"""
+
+from tpunet.data.tokens import (  # noqa: F401
+    TokenDataset,
+    pack_documents,
+    token_batches,
+)
+from tpunet.data.prefetch import prefetch_to_device  # noqa: F401

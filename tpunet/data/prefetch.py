@@ -1,0 +1,91 @@
+"""Host->device prefetch: overlap the next batch's transfer with the
+current step's compute.
+
+A background thread pulls from the host iterator, calls `jax.device_put`
+(optionally with a sharding, so multi-device placement happens off the
+critical path too), and parks up to `size` in-flight batches in a bounded
+queue. The training loop then always finds its next batch already resident
+— the host-side analogue of the DCN tier's transfer/compute overlap
+(tpunet.train.trainer bucketed nonblocking all-reduce).
+
+device_put is async (returns immediately, transfer proceeds in the
+runtime), so the thread's job is just to keep `size` transfers in flight
+ahead of consumption; size=2 (double buffering) is enough to hide a
+transfer that takes less than a step.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import jax
+
+
+def prefetch_to_device(iterator, size: int = 2, sharding=None):
+    """Wrap `iterator` (yielding pytrees of numpy arrays) so batches arrive
+    already device-resident, `size` batches ahead.
+
+    sharding: optional jax.sharding.Sharding (or pytree of them) passed to
+    device_put — e.g. `batch_sharding(mesh)` to land rows pre-sharded over
+    dp. None = default device placement.
+
+    The worker thread is a daemon and stops at source exhaustion or when
+    the consumer drops the generator (GeneratorExit closes the queue).
+    """
+    if size < 1:
+        raise ValueError("size must be >= 1")
+    q: queue.Queue = queue.Queue(maxsize=size)
+    _END = object()
+    # Bound locally: the worker can outlive user code into interpreter
+    # shutdown, when the `queue` module global may be torn down to None.
+    _full = queue.Full
+    stop = threading.Event()
+
+    def worker():
+        try:
+            for item in iterator:
+                put = (
+                    jax.device_put(item, sharding)
+                    if sharding is not None
+                    else jax.device_put(item)
+                )
+                # Bounded put that also watches for consumer abandonment,
+                # so a dropped generator can't leave this thread pinned on
+                # a full queue holding device buffers forever.
+                while not stop.is_set():
+                    try:
+                        q.put(put, timeout=0.1)
+                        break
+                    except _full:
+                        continue
+                if stop.is_set():
+                    return
+        except Exception as e:  # surface source errors to the consumer
+            q.put(e)
+            return
+        q.put(_END)
+
+    t = threading.Thread(target=worker, daemon=True, name="tpunet-prefetch")
+    t.start()
+
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, Exception):
+                raise item
+            yield item
+    finally:
+        # Consumer closed (break / GeneratorExit / error): release the
+        # worker and drop any parked batches. Best-effort by design: this
+        # can run at interpreter shutdown when the queue module's own
+        # globals are already torn down (get_nowait then raises TypeError
+        # instead of Empty), so any exception just ends the drain.
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except BaseException:  # noqa: BLE001 — Empty normally; shutdown junk
+            pass

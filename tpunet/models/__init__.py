@@ -11,6 +11,7 @@ shard_map/ppermute or cross-host over the DCN transport), and a
 Switch-style MoE with expert-parallel sharding.
 """
 
+from tpunet.models.generate import generate, init_cache  # noqa: F401
 from tpunet.models.transformer import (  # noqa: F401
     Transformer,
     transformer_partition_rules,

@@ -1,0 +1,99 @@
+"""Autoregressive generation with a per-layer KV cache.
+
+TPU-first inference loop for the Transformer family: one prefill call
+scores the whole prompt (MXU-sized matmuls, causal), then a `lax.scan`
+decodes token-by-token against the flax "cache" collection that
+`SelfAttention(decode=True)` maintains (ring buffers updated with
+`dynamic_update_slice` — static shapes, so the whole loop jits and the
+per-step executable is reused). GQA models cache only n_kv_heads, so the
+cache — the resident that limits batch at inference — shrinks by
+n_heads/n_kv_heads.
+
+The reference repo has no inference path at all (it is a transport;
+SURVEY §2.3); this is framework capability above it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init_cache(model, batch: int, max_len: int):
+    """Allocate the decode cache for `batch` sequences of capacity
+    `max_len` (prompt + generated). Shapes come from `eval_shape` — no
+    second parameter set is materialized and no forward FLOPs run (a real
+    init would execute a full (batch, max_len) causal forward, O(max_len²)
+    attention memory, just to throw the result away)."""
+    dm = model.clone(decode=True)
+    shapes = jax.eval_shape(
+        dm.init, jax.random.PRNGKey(0), jnp.zeros((batch, max_len), jnp.int32)
+    )
+    return jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"]
+    )
+
+
+def generate(
+    model,
+    params,
+    prompt,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    rng=None,
+    eos_id: int | None = None,
+):
+    """Generate `max_new_tokens` continuations of `prompt` (b, p) int32.
+
+    temperature 0.0 = greedy argmax; otherwise softmax sampling at the
+    given temperature (one PRNG key per step, split from `rng`). After a
+    sequence emits `eos_id` every later position is pinned to `eos_id`.
+    Returns (b, p + max_new_tokens) int32 — prompt included.
+
+    Jit-friendly: callers can `jax.jit(partial(generate, model),
+    static_argnames="max_new_tokens")`; shapes are static throughout.
+    """
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    b, p = prompt.shape
+    dm = model.clone(decode=True)
+    cache = init_cache(model, b, p + max_new_tokens)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def sample(last_logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, last_logits / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    # Prefill: one call over the whole prompt fills cache[0:p] and yields
+    # the first next-token distribution from the final prompt position.
+    logits, mut = dm.apply(
+        {"params": params, "cache": cache}, prompt, mutable=["cache"]
+    )
+    cache = mut["cache"]
+    key0, rng = jax.random.split(rng)
+    tok = sample(logits[:, -1, :], key0)
+    done = (tok == eos_id) if eos_id is not None else jnp.zeros((b,), bool)
+
+    def body(carry, key):
+        cache, tok, done = carry
+        logits, mut = dm.apply(
+            {"params": params, "cache": cache}, tok[:, None], mutable=["cache"]
+        )
+        nxt = sample(logits[:, -1, :], key)
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+            done = done | (nxt == eos_id)
+        return (mut["cache"], nxt, done), nxt
+
+    keys = jax.random.split(rng, max_new_tokens - 1)
+    _, rest = jax.lax.scan(body, (cache, tok, done), keys)
+    return jnp.concatenate(
+        [prompt.astype(jnp.int32), tok[:, None]]
+        + ([rest.swapaxes(0, 1)] if max_new_tokens > 1 else []),
+        axis=1,
+    )

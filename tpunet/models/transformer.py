@@ -79,6 +79,16 @@ class SelfAttention(nn.Module):
     tpunet.distributed.initialize(); dcn_zigzag additionally expects each
     process's shard to be its zigzag chunk pair, i.e. tokens fed through
     to_zigzag, and is the balanced-causal variant of dcn_ring).
+
+    n_kv_heads < n_heads is grouped-query attention: k/v are projected to
+    n_kv_heads and broadcast to the query heads after rotary — the kv
+    projection params/FLOPs and (in decode) the KV cache shrink by
+    n_heads/n_kv_heads while every attn impl sees ordinary MHA tensors.
+
+    decode=True switches to autoregressive inference: a "cache" collection
+    holds cached_key/cached_value ring buffers sized by the INIT input's
+    sequence length (init with a max-length dummy), and each apply consumes
+    the next s tokens (usually 1), attending over the filled prefix.
     """
 
     n_heads: int
@@ -89,16 +99,78 @@ class SelfAttention(nn.Module):
     dp_axis: str | None = "dp"
     sp_axis: str = "sp"
     tp_axis: str | None = None
+    n_kv_heads: int | None = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x):
         b, s, _ = x.shape
         h, dh = self.n_heads, self.head_dim
+        kv = self.n_kv_heads or h
+        if h % kv:
+            raise ValueError(f"n_heads {h} not divisible by n_kv_heads {kv}")
         dt = self.compute_dtype
-        proj = lambda name: nn.Dense(h * dh, use_bias=False, dtype=dt, name=name)
-        q = proj("q")(x).reshape(b, s, h, dh)
-        k = proj("k")(x).reshape(b, s, h, dh)
-        v = proj("v")(x).reshape(b, s, h, dh)
+        proj = lambda nh, name: nn.Dense(nh * dh, use_bias=False, dtype=dt, name=name)
+        q = proj(h, "q")(x).reshape(b, s, h, dh)
+        k = proj(kv, "k")(x).reshape(b, s, kv, dh)
+        v = proj(kv, "v")(x).reshape(b, s, kv, dh)
+
+        if self.decode:
+            # The cached step below is dense local attention — correct for
+            # "reference"/"flash" (same math), semantically WRONG for the
+            # sequence-parallel impls (sharded/permuted inputs, cross-device
+            # k/v). Fail loud rather than generate silent garbage.
+            if self.attn_impl not in ("reference", "flash"):
+                raise ValueError(
+                    f"decode=True does not support attn_impl="
+                    f"{self.attn_impl!r}; decode on the full sequence with "
+                    "attn_impl='reference' (e.g. model.clone("
+                    "attn_impl='reference') before generate())"
+                )
+            # flax decode-cache pattern: the variables are CREATED on the
+            # init call (whose input sets the cache capacity = its seq len)
+            # which otherwise runs the ordinary causal path below; every
+            # later apply with mutable=["cache"] takes the step branch.
+            filled = self.has_variable("cache", "cached_key")
+            ckey = self.variable("cache", "cached_key", jnp.zeros, k.shape, k.dtype)
+            cval = self.variable("cache", "cached_value", jnp.zeros, v.shape, v.dtype)
+            cidx = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            if filled:
+                idx = cidx.value
+                cap = ckey.value.shape[1]
+                # Past-capacity steps would clamp the dynamic_update_slice
+                # start and silently corrupt the tail; idx is traced, so the
+                # jit-compatible hard failure is poisoning the output to NaN
+                # the moment idx + s overflows — loud at the first sample.
+                overflow = idx + s > cap
+                step_pos = (idx + jnp.arange(s)).astype(jnp.float32)
+                q = rotary_embed(q, positions=step_pos)
+                k = rotary_embed(k, positions=step_pos)
+                ckey.value = jax.lax.dynamic_update_slice(
+                    ckey.value, k, (0, idx, 0, 0)
+                )
+                cval.value = jax.lax.dynamic_update_slice(
+                    cval.value, v, (0, idx, 0, 0)
+                )
+                cidx.value = idx + s
+                kf = jnp.repeat(ckey.value, h // kv, axis=2)
+                vf = jnp.repeat(cval.value, h // kv, axis=2)
+                # (b, s, h, cap) scores over the whole ring buffer; mask to
+                # keys at global positions <= each query's position.
+                scores = jnp.einsum(
+                    "bqhd,bkhd->bhqk", q.astype(jnp.float32), kf.astype(jnp.float32)
+                ) / math.sqrt(dh)
+                key_pos = jnp.arange(cap)[None, None, None, :]
+                q_pos = (idx + jnp.arange(s))[None, None, :, None]
+                scores = jnp.where(key_pos <= q_pos, scores, -jnp.inf)
+                probs = jax.nn.softmax(scores, axis=-1)
+                o = jnp.einsum("bhqk,bkhd->bqhd", probs, vf.astype(jnp.float32))
+                o = jnp.where(overflow, jnp.nan, o)
+                o = o.astype(dt).reshape(b, s, h * dh)
+                return nn.Dense(x.shape[-1], use_bias=False, dtype=dt, name="out")(o)
+
         pos_offset = 0
         positions = None
         if self.attn_impl in ("dcn_ring", "dcn_ulysses"):
@@ -130,6 +202,12 @@ class SelfAttention(nn.Module):
             )
         q = rotary_embed(q, pos_offset=pos_offset, positions=positions)
         k = rotary_embed(k, pos_offset=pos_offset, positions=positions)
+        if kv != h:
+            # GQA broadcast AFTER rotary (rotary runs on the kv heads): the
+            # projection savings are already banked; every impl below then
+            # sees plain MHA shapes. XLA fuses the repeat into the consumer.
+            k = jnp.repeat(k, h // kv, axis=2)
+            v = jnp.repeat(v, h // kv, axis=2)
 
         if self.attn_impl == "zigzag":
             from tpunet.parallel.zigzag_attention import zigzag_self_attention
@@ -168,14 +246,26 @@ class SelfAttention(nn.Module):
 
 
 class Mlp(nn.Module):
+    """Dense MLP: "gelu" (up→gelu→down) or "swiglu" (silu(gate)·up→down,
+    the LLaMA-family FFN). Both keep every kernel bias-free and 2-D so the
+    Megatron TP rules (up/gate column-parallel, down row-parallel) apply."""
+
     d_ff: int
     compute_dtype: jnp.dtype = jnp.bfloat16
+    mlp_impl: str = "gelu"
 
     @nn.compact
     def __call__(self, x):
         dt = self.compute_dtype
-        h = nn.Dense(self.d_ff, use_bias=False, dtype=dt, name="up")(x)
-        h = nn.gelu(h)
+        if self.mlp_impl == "swiglu":
+            g = nn.Dense(self.d_ff, use_bias=False, dtype=dt, name="gate")(x)
+            h = nn.Dense(self.d_ff, use_bias=False, dtype=dt, name="up")(x)
+            h = nn.silu(g) * h
+        elif self.mlp_impl == "gelu":
+            h = nn.Dense(self.d_ff, use_bias=False, dtype=dt, name="up")(x)
+            h = nn.gelu(h)
+        else:
+            raise ValueError(f"unknown mlp_impl {self.mlp_impl!r}")
         return nn.Dense(x.shape[-1], use_bias=False, dtype=dt, name="down")(h)
 
 
@@ -245,18 +335,22 @@ class Block(nn.Module):
     dp_axis: str | None = "dp"
     sp_axis: str = "sp"
     tp_axis: str | None = None
+    n_kv_heads: int | None = None
+    mlp_impl: str = "gelu"
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x):
         x = x + SelfAttention(
             self.n_heads, self.head_dim, self.compute_dtype, self.attn_impl,
-            self.mesh, self.dp_axis, self.sp_axis, self.tp_axis, name="attn",
+            self.mesh, self.dp_axis, self.sp_axis, self.tp_axis,
+            n_kv_heads=self.n_kv_heads, decode=self.decode, name="attn",
         )(RMSNorm(name="norm1")(x))
         if self.n_experts > 0:
             mlp = MoeMlp(self.n_experts, self.d_ff, self.capacity_factor,
                          self.compute_dtype, name="moe")
         else:
-            mlp = Mlp(self.d_ff, self.compute_dtype, name="mlp")
+            mlp = Mlp(self.d_ff, self.compute_dtype, self.mlp_impl, name="mlp")
         return x + mlp(RMSNorm(name="norm2")(x))
 
 
@@ -273,11 +367,17 @@ class Transformer(nn.Module):
     capacity_factor: float = 1.25
     compute_dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False           # rematerialize blocks: trade FLOPs for HBM
+    remat_policy: str | None = None  # None=save nothing; "dots" saves matmul
+    #   outputs (recompute only cheap elementwise — less HBM relief, near-zero
+    #   recompute FLOPs); "dots_no_batch" saves weight-stationary dots only.
     attn_impl: str = "reference"
     mesh: Mesh | None = None
     dp_axis: str | None = "dp"
     sp_axis: str = "sp"
     tp_axis: str | None = None
+    n_kv_heads: int | None = None  # < n_heads = grouped-query attention
+    mlp_impl: str = "gelu"         # "swiglu" = LLaMA-family FFN
+    decode: bool = False           # KV-cache autoregressive inference mode
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, features_only: bool = False):
@@ -294,7 +394,21 @@ class Transformer(nn.Module):
         # remat drops block activations in the forward pass and recomputes
         # them in the backward — the standard long-context memory lever
         # (sequence activations dominate HBM; FLOPs are MXU-cheap).
-        block_cls = nn.remat(Block) if self.remat else Block
+        policies = {
+            None: None,
+            "dots": jax.checkpoint_policies.dots_saveable,
+            "dots_no_batch":
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        }
+        if self.remat_policy not in policies:
+            # Validated even when remat is off / decoding — a typo'd policy
+            # silently doing nothing would corrupt memory-sweep conclusions.
+            raise ValueError(f"unknown remat_policy {self.remat_policy!r}")
+        if self.decode or not self.remat:
+            block_cls = Block
+        else:
+            pol = policies[self.remat_policy]
+            block_cls = nn.remat(Block, policy=pol) if pol else nn.remat(Block)
         for i in range(self.n_layers):
             moe = self.n_experts > 0 and (i + 1) % self.moe_every == 0
             x = block_cls(
@@ -303,7 +417,8 @@ class Transformer(nn.Module):
                 capacity_factor=self.capacity_factor,
                 compute_dtype=self.compute_dtype, attn_impl=self.attn_impl,
                 mesh=self.mesh, dp_axis=self.dp_axis, sp_axis=self.sp_axis,
-                tp_axis=self.tp_axis, name=f"block{i}",
+                tp_axis=self.tp_axis, n_kv_heads=self.n_kv_heads,
+                mlp_impl=self.mlp_impl, decode=self.decode, name=f"block{i}",
             )(x)
         x = RMSNorm(name="norm_f")(x)
         if features_only:
@@ -329,7 +444,7 @@ def transformer_partition_rules(
     return [
         (r".*attn/(q|k|v)/kernel", P(None, tp_axis)),
         (r".*attn/out/kernel", P(tp_axis, None)),
-        (r".*mlp/up/kernel", P(None, tp_axis)),
+        (r".*mlp/(up|gate)/kernel", P(None, tp_axis)),
         (r".*mlp/down/kernel", P(tp_axis, None)),
         (r".*moe/router", P()),
         (r".*moe/wi", P(ep, None, tp_axis)),
