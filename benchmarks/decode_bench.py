@@ -1,0 +1,88 @@
+"""Autoregressive decode throughput: tokens/s out of `tpunet.models.generate`.
+
+The training headline (`tpu_headline`) measures MXU-bound step throughput;
+this measures the inference regime the KV cache exists for — one token per
+step, attention against the cached prefix, batch as the only MXU feeder.
+GQA directly scales this bench: the KV cache (the HBM resident that limits
+batch) shrinks by n_heads/n_kv_heads.
+
+The whole generate() call — prefill + lax.scan decode — is wrapped in ONE
+jit, so the timed region is a single executable; syncing happens by
+transferring the token matrix to host (correct on the axon tunnel, where
+block_until_ready does not sync — PERF_NOTES.md).
+
+Usage: python -m benchmarks.decode_bench [--platform cpu|tpu] [--kv-heads K]
+Prints one JSON line: config, prefill+decode wall, decode tokens/s.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--platform", default="cpu", choices=["cpu", "tpu"])
+    p.add_argument("--d", type=int, default=1024)
+    p.add_argument("--layers", type=int, default=12)
+    p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--ff", type=int, default=4096)
+    p.add_argument("--vocab", type=int, default=32000)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--prompt", type=int, default=128)
+    p.add_argument("--new", type=int, default=128)
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="grouped-query kv heads (default: = heads)")
+    p.add_argument("--iters", type=int, default=3)
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.platform == "cpu":
+        # The axon sitecustomize pins jax_platforms at interpreter start;
+        # env alone cannot override it (verify skill, session-2 notes).
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpunet.models import Transformer, generate
+
+    model = Transformer(
+        vocab=args.vocab, d_model=args.d, n_layers=args.layers,
+        n_heads=args.heads, d_ff=args.ff, n_kv_heads=args.kv_heads,
+        compute_dtype=jnp.bfloat16,
+    )
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(0, args.vocab, (args.batch, args.prompt)), jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+
+    gen = jax.jit(
+        lambda params, prompt: generate(model, params, prompt, args.new)
+    )
+    out = np.asarray(gen(params, prompt))  # compile + warm
+    assert out.shape == (args.batch, args.prompt + args.new)
+
+    times = []
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        np.asarray(gen(params, prompt))  # host transfer = the sync point
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    print(json.dumps({
+        "platform": jax.devices()[0].platform,
+        "d": args.d, "L": args.layers, "heads": args.heads,
+        "kv_heads": args.kv_heads or args.heads,
+        "params_M": round(n_params / 1e6, 1),
+        "batch": args.batch, "prompt": args.prompt, "new": args.new,
+        "wall_s": round(best, 4),
+        "decode_tok_s": round(args.batch * args.new / best, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -110,3 +110,19 @@ def test_finalize_drains_pending_async():
     assert dcn_async_stats()["in_flight"] == 1
     distributed.finalize()  # must drop the stale entry, not leak it
     assert dcn_async_stats()["in_flight"] == 0
+
+
+def test_decode_bench_cli(capsys):
+    import json
+
+    from benchmarks.decode_bench import main as decode_main
+
+    decode_main([
+        "--d", "64", "--layers", "2", "--heads", "4", "--ff", "128",
+        "--vocab", "256", "--batch", "2", "--prompt", "8", "--new", "4",
+        "--kv-heads", "2", "--iters", "1",
+    ])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["decode_tok_s"] > 0
+    assert out["kv_heads"] == 2
+    assert out["platform"] == "cpu"
