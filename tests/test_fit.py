@@ -1,0 +1,90 @@
+"""fit() driver tests: schedule counting, checkpoint cadence, exact resume,
+data-pipeline composition."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpunet.data import TokenDataset, pack_documents, token_batches
+from tpunet.models import Transformer
+from tpunet.train import CheckpointManager, create_train_state, fit, make_train_step
+
+
+@pytest.fixture()
+def setup(tmp_path):
+    path = str(tmp_path / "toks.bin")
+    rng = np.random.default_rng(0)
+    pack_documents(iter([rng.integers(0, 64, 600).tolist()]), path, vocab=64)
+    ds = TokenDataset(path, seq=16, vocab=64)
+    model = Transformer(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                        compute_dtype=jnp.float32)
+    tx = optax.adamw(1e-3)
+    first, _ = next(token_batches(ds, batch=4, seed=0))
+    state, _ = create_train_state(model, jax.random.PRNGKey(0),
+                                  jnp.asarray(first), tx)
+    step = make_train_step(model, tx, donate=False)
+    return ds, state, step
+
+
+def _batches(ds):
+    return token_batches(ds, batch=4, seed=0)
+
+
+def test_fit_runs_schedule_and_checkpoints(setup, tmp_path):
+    ds, state, step = setup
+    out = fit(state, step, _batches(ds), steps=7,
+              checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=3)
+    assert int(out.step) == 7
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    try:
+        # Saves at 3, 6 and the forced final at 7.
+        assert mgr.latest_step() == 7
+        assert 3 in mgr.all_steps() and 6 in mgr.all_steps()
+    finally:
+        mgr.close()
+
+
+def test_fit_resume_counts_total_schedule_not_additional(setup, tmp_path):
+    ds, state, step = setup
+    ck = str(tmp_path / "ck")
+    mid = fit(state, step, _batches(ds), steps=4, checkpoint_dir=ck)
+    assert int(mid.step) == 4
+    # Re-enter with the SAME schedule: resumes at 4, runs only 4..6.
+    out = fit(state, step, _batches(ds), steps=6, checkpoint_dir=ck)
+    assert int(out.step) == 6
+
+
+def test_fit_resume_trajectory_matches_uninterrupted(setup, tmp_path):
+    ds, state, step = setup
+    straight = fit(state, step, _batches(ds), steps=6)
+    ck = str(tmp_path / "ck2")
+    fit(state, step, _batches(ds), steps=3, checkpoint_dir=ck)
+    # skip_batches_on_resume lines the deterministic stream up with the
+    # interrupted position, so the resumed trajectory is EXACTLY the
+    # uninterrupted one (same batches, same fold_in(rng, step) keys).
+    resumed = fit(state, step, _batches(ds), steps=6, checkpoint_dir=ck,
+                  skip_batches_on_resume=True)
+    assert int(resumed.step) == int(straight.step) == 6
+    for a, b in zip(jax.tree.leaves(resumed.params),
+                    jax.tree.leaves(straight.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-6)
+
+
+def test_fit_stops_at_data_exhaustion(setup):
+    import itertools
+
+    ds, state, step = setup
+    few = list(itertools.islice(_batches(ds), 2))  # the stream is infinite
+    out = fit(state, step, iter(few), steps=100)
+    assert int(out.step) == 2
+
+
+def test_fit_logs(setup):
+    ds, state, step = setup
+    seen = []
+    fit(state, step, _batches(ds), steps=4, log_every=2, log_fn=seen.append)
+    assert [m["step"] for m in seen] == [2, 4]
+    assert all(np.isfinite(m["loss"]) for m in seen)

@@ -7,6 +7,7 @@ from tpunet.train.checkpoint import (  # noqa: F401
     restore_pytree,
     save_pytree,
 )
+from tpunet.train.fit import fit  # noqa: F401
 from tpunet.train.elastic import (  # noqa: F401
     ExcludedFromMembership,
     is_comm_failure,

@@ -1,0 +1,118 @@
+"""High-level training driver: data -> step -> checkpoint -> resume.
+
+`fit()` is the convenience loop tying the framework's pieces together the
+way the benchmarks do by hand: a (possibly prefetched) batch iterator, the
+jitted train step from `make_train_step`, periodic orbax checkpoints with
+exact resume, and a metrics hook. It stays deliberately thin — every
+capability (DCN tier, ZeRO, accumulation, fused xent) is configured on the
+step function itself, so fit() composes with all of them instead of
+re-exposing their knobs.
+
+The reference has no trainer at all (it is a transport; its end-to-end
+validation drove an external synthetic benchmark — reference
+README.md:52-84). This is framework capability above it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Iterable
+
+import jax
+
+from tpunet.train.checkpoint import CheckpointManager
+from tpunet.train.trainer import TrainState
+
+
+def fit(
+    state: TrainState,
+    train_step: Callable,
+    batches: Iterable,
+    *,
+    steps: int,
+    rng=None,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 0,
+    max_to_keep: int = 3,
+    log_every: int = 0,
+    log_fn: Callable[[dict[str, Any]], None] | None = None,
+    skip_batches_on_resume: bool = False,
+) -> TrainState:
+    """Run `steps` optimizer steps (counted by state.step, so a resumed run
+    finishes the SAME total schedule, not `steps` more).
+
+    state: from create_train_state (resume is handled here when
+        checkpoint_dir holds a checkpoint — the freshly-initialized state
+        supplies structure and shardings for the restore).
+    train_step: make_train_step(...)-style (state, inputs, labels, rng) ->
+        (state, loss).
+    batches: yields (inputs, labels); wrap with
+        tpunet.data.prefetch_to_device to overlap host->HBM transfer.
+    rng: PRNGKey folded with the step counter for per-step dropout keys.
+    checkpoint_every: save every k steps (and once at the end) when
+        checkpoint_dir is set; 0 = only the final save.
+    log_fn: called with {"step", "loss", "steps_per_s"} every `log_every`
+        steps (default print). Loss is fetched to host ONLY at log/final
+        steps — fetching every step would serialize dispatch (and on the
+        tunneled TPU platform per-step sync is wrong anyway, PERF_NOTES).
+    skip_batches_on_resume: when resuming at step k, first discard k
+        batches from the iterator, so a deterministic stream (e.g.
+        token_batches with a fixed seed) lines up exactly where the
+        interrupted run left off and the resumed trajectory matches an
+        uninterrupted one. Leave False for stateful/streaming sources that
+        manage their own position.
+    """
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    mgr = (
+        CheckpointManager(checkpoint_dir, max_to_keep=max_to_keep)
+        if checkpoint_dir
+        else None
+    )
+    try:
+        if mgr is not None:
+            restored = mgr.restore_latest(state)
+            if restored is not None:
+                state = restored
+
+        log = log_fn or (lambda m: print(
+            f"[fit] step {m['step']} loss {m['loss']:.4f} "
+            f"({m['steps_per_s']:.2f} steps/s)", flush=True))
+        it = iter(batches)
+        loss = None
+        t0 = time.perf_counter()
+        # Host-side mirror of state.step: reading the device scalar every
+        # iteration (int(state.step)) would sync per step and serialize
+        # dispatch — fetched ONCE here (post-restore), then incremented
+        # locally in lockstep with the step function's step+1.
+        done = int(state.step)
+        window_start = done
+        if skip_batches_on_resume and done:
+            for _ in range(done):
+                next(it, None)
+        while done < steps:
+            try:
+                inputs, labels = next(it)
+            except StopIteration:
+                break  # finite dataset exhausted before the schedule
+            step_rng = jax.random.fold_in(rng, done)
+            state, loss = train_step(state, inputs, labels, step_rng)
+            done += 1
+            if log_every and done % log_every == 0:
+                dt = time.perf_counter() - t0
+                log({
+                    "step": done,
+                    "loss": float(loss),  # host transfer = the sync point
+                    "steps_per_s": (done - window_start) / dt if dt > 0 else 0.0,
+                })
+                t0 = time.perf_counter()
+                window_start = done
+            if mgr is not None and checkpoint_every and done % checkpoint_every == 0:
+                mgr.save(done, state)
+        if mgr is not None and loss is not None:
+            mgr.save(done, state, force=True)
+            mgr.wait_until_finished()
+    finally:
+        if mgr is not None:
+            mgr.close()
+    return state
