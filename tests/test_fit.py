@@ -73,6 +73,27 @@ def test_fit_resume_trajectory_matches_uninterrupted(setup, tmp_path):
                                    atol=1e-6, rtol=1e-6)
 
 
+def test_fit_final_step_on_cadence_does_not_crash(setup, tmp_path):
+    # steps divisible by checkpoint_every: the in-loop save already wrote
+    # the final step; the forced final save must not re-save it (orbax
+    # raises StepAlreadyExistsError on duplicates even with force=True).
+    ds, state, step = setup
+    out = fit(state, step, _batches(ds), steps=6,
+              checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=3)
+    assert int(out.step) == 6
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    try:
+        assert mgr.latest_step() == 6
+    finally:
+        mgr.close()
+
+
+def test_fit_prefetch_param(setup):
+    ds, state, step = setup
+    out = fit(state, step, _batches(ds), steps=3, prefetch=2)
+    assert int(out.step) == 3
+
+
 def test_fit_stops_at_data_exhaustion(setup):
     import itertools
 

@@ -219,3 +219,55 @@ def test_swiglu_tp_rules_cover_gate():
     rules = transformer_partition_rules(tp_axis="mdl")
     path = "block0/mlp/gate/kernel"
     assert any(re.fullmatch(pat, path) for pat, _ in rules)
+
+
+def test_sliding_window_model_flash_matches_reference():
+    ref = _tiny(attn_window=12, attn_impl="reference")
+    fla = _tiny(attn_window=12, attn_impl="flash")
+    params, toks = _params(ref, b=1, s=128)
+    np.testing.assert_allclose(
+        np.asarray(fla.apply({"params": params}, toks)),
+        np.asarray(ref.apply({"params": params}, toks)),
+        atol=2e-2, rtol=2e-2,
+    )
+
+
+def test_sliding_window_limits_receptive_field():
+    # With window=4 and 2 layers, logits at position p depend on at most the
+    # previous 2*(4-1) positions; perturbing an older token changes nothing.
+    model = _tiny(attn_window=4)
+    params, toks = _params(model, b=1, s=24)
+    base = model.apply({"params": params}, toks)
+    far = toks.at[0, 2].set((toks[0, 2] + 1) % 64)
+    pert = model.apply({"params": params}, far)
+    np.testing.assert_allclose(
+        np.asarray(base[0, -1]), np.asarray(pert[0, -1]), atol=1e-5
+    )
+
+
+def test_sliding_window_decode_matches_full_forward():
+    model = _tiny(attn_window=6)
+    params, toks = _params(model)
+    full = model.apply({"params": params}, toks)
+    dm = model.clone(decode=True)
+    cache = init_cache(model, toks.shape[0], toks.shape[1])
+    for i in range(toks.shape[1]):
+        step, mut = dm.apply(
+            {"params": params, "cache": cache}, toks[:, i : i + 1],
+            mutable=["cache"],
+        )
+        cache = mut["cache"]
+        np.testing.assert_allclose(
+            np.asarray(step[:, 0]), np.asarray(full[:, i]),
+            atol=2e-4, rtol=2e-4,
+        )
+
+
+def test_sliding_window_rejects_sequence_parallel_impls():
+    from tpunet.parallel import make_named_mesh
+
+    mesh = make_named_mesh({"sp": 2})
+    model = _tiny(attn_impl="ring", mesh=mesh, attn_window=8)
+    toks = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 0, 64)
+    with pytest.raises(ValueError, match="attn_window"):
+        model.init(jax.random.PRNGKey(1), toks)

@@ -122,3 +122,119 @@ def test_flash_under_jit():
         np.asarray(attention_reference(q, k, v, True)),
         atol=2e-5, rtol=2e-5,
     )
+
+
+def _gqa_ref(q, k, v, causal):
+    group = q.shape[2] // k.shape[2]
+    return attention_reference(
+        q, jnp.repeat(k, group, axis=2), jnp.repeat(v, group, axis=2), causal
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gqa_matches_repeated_reference(causal):
+    rng = jax.random.split(jax.random.PRNGKey(10), 3)
+    q = jax.random.normal(rng[0], (2, 128, 8, 16))
+    k = jax.random.normal(rng[1], (2, 128, 2, 16))
+    v = jax.random.normal(rng[2], (2, 128, 2, 16))
+    out = flash_attention(q, k, v, causal, block_q=32, block_k=32)
+    ref = _gqa_ref(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gqa_grads_match_repeated_reference(causal):
+    rng = jax.random.split(jax.random.PRNGKey(11), 3)
+    q = jax.random.normal(rng[0], (1, 64, 4, 8))
+    k = jax.random.normal(rng[1], (1, 64, 2, 8))
+    v = jax.random.normal(rng[2], (1, 64, 2, 8))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal, 32, 32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_gqa_ref(q, k, v, causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    assert gf[1].shape == k.shape and gf[2].shape == v.shape
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5)
+
+
+def test_flash_gqa_ragged_falls_back():
+    rng = jax.random.split(jax.random.PRNGKey(12), 3)
+    q = jax.random.normal(rng[0], (1, 100, 4, 8))  # 100: no tiling
+    k = jax.random.normal(rng[1], (1, 100, 2, 8))
+    v = jax.random.normal(rng[2], (1, 100, 2, 8))
+
+    out = flash_attention(q, k, v, True, block_q=32, block_k=32)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_gqa_ref(q, k, v, True)), atol=2e-5, rtol=2e-5
+    )
+    g = jax.grad(lambda k: jnp.sum(flash_attention(q, k, v, True, 32, 32)))(k)
+    gr = jax.grad(lambda k: jnp.sum(_gqa_ref(q, k, v, True)))(k)
+    assert g.shape == k.shape
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=5e-5, rtol=5e-5)
+
+
+def test_flash_rejects_indivisible_heads():
+    rng = jax.random.split(jax.random.PRNGKey(13), 3)
+    q = jax.random.normal(rng[0], (1, 64, 4, 8))
+    k = jax.random.normal(rng[1], (1, 64, 3, 8))
+    v = jax.random.normal(rng[2], (1, 64, 3, 8))
+    with pytest.raises(ValueError, match="divisible"):
+        flash_attention(q, k, v, True)
+
+
+@pytest.mark.parametrize("window", [1, 7, 32, 64, 200])
+def test_flash_window_matches_reference(window):
+    q, k, v = _qkv(jax.random.PRNGKey(20), 1, 128, 2, 16)
+    out = flash_attention(q, k, v, True, 32, 32, window=window)
+    ref = attention_reference(q, k, v, True, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("window", [16, 48])
+def test_flash_window_grads_match_reference(window):
+    q, k, v = _qkv(jax.random.PRNGKey(21), 1, 128, 2, 8)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, 32, 32,
+                                       window=window) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, True, window=window) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5)
+
+
+def test_flash_window_with_gqa():
+    rng = jax.random.split(jax.random.PRNGKey(22), 3)
+    q = jax.random.normal(rng[0], (1, 128, 4, 8))
+    k = jax.random.normal(rng[1], (1, 128, 2, 8))
+    v = jax.random.normal(rng[2], (1, 128, 2, 8))
+    out = flash_attention(q, k, v, True, 32, 32, window=40)
+    ref = attention_reference(q, jnp.repeat(k, 2, axis=2),
+                              jnp.repeat(v, 2, axis=2), True, window=40)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    gk = jax.grad(lambda k: jnp.sum(
+        flash_attention(q, k, v, True, 32, 32, window=40)))(k)
+    gkr = jax.grad(lambda k: jnp.sum(attention_reference(
+        q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2), True,
+        window=40)))(k)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(gkr),
+                               atol=5e-5, rtol=5e-5)
+
+
+def test_flash_window_requires_causal():
+    q, k, v = _qkv(jax.random.PRNGKey(23), 1, 64, 1, 8)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, False, window=8)

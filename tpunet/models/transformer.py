@@ -81,9 +81,11 @@ class SelfAttention(nn.Module):
     to_zigzag, and is the balanced-causal variant of dcn_ring).
 
     n_kv_heads < n_heads is grouped-query attention: k/v are projected to
-    n_kv_heads and broadcast to the query heads after rotary — the kv
-    projection params/FLOPs and (in decode) the KV cache shrink by
-    n_heads/n_kv_heads while every attn impl sees ordinary MHA tensors.
+    n_kv_heads — the kv projection params/FLOPs and (in decode) the KV
+    cache shrink by n_heads/n_kv_heads. The flash impl consumes the
+    kv-head tensors natively (in-kernel GQA: K/V stream at 1/group
+    bandwidth); every other impl receives a post-rotary broadcast to
+    ordinary MHA shapes.
 
     decode=True switches to autoregressive inference: a "cache" collection
     holds cached_key/cached_value ring buffers sized by the INIT input's
@@ -101,6 +103,7 @@ class SelfAttention(nn.Module):
     tp_axis: str | None = None
     n_kv_heads: int | None = None
     decode: bool = False
+    attn_window: int | None = None  # sliding-window causal (flash/reference)
 
     @nn.compact
     def __call__(self, x):
@@ -109,6 +112,13 @@ class SelfAttention(nn.Module):
         kv = self.n_kv_heads or h
         if h % kv:
             raise ValueError(f"n_heads {h} not divisible by n_kv_heads {kv}")
+        if self.attn_window is not None and self.attn_impl not in (
+            "reference", "flash"
+        ):
+            raise ValueError(
+                f"attn_window is only supported by attn_impl 'reference'/"
+                f"'flash', not {self.attn_impl!r}"
+            )
         dt = self.compute_dtype
         proj = lambda nh, name: nn.Dense(nh * dh, use_bias=False, dtype=dt, name=name)
         q = proj(h, "q")(x).reshape(b, s, h, dh)
@@ -164,7 +174,10 @@ class SelfAttention(nn.Module):
                 ) / math.sqrt(dh)
                 key_pos = jnp.arange(cap)[None, None, None, :]
                 q_pos = (idx + jnp.arange(s))[None, None, :, None]
-                scores = jnp.where(key_pos <= q_pos, scores, -jnp.inf)
+                keep = key_pos <= q_pos
+                if self.attn_window is not None:
+                    keep &= (q_pos - key_pos) < self.attn_window
+                scores = jnp.where(keep, scores, -jnp.inf)
                 probs = jax.nn.softmax(scores, axis=-1)
                 o = jnp.einsum("bhqk,bkhd->bqhd", probs, vf.astype(jnp.float32))
                 o = jnp.where(overflow, jnp.nan, o)
@@ -202,10 +215,13 @@ class SelfAttention(nn.Module):
             )
         q = rotary_embed(q, pos_offset=pos_offset, positions=positions)
         k = rotary_embed(k, pos_offset=pos_offset, positions=positions)
-        if kv != h:
+        if kv != h and self.attn_impl != "flash":
             # GQA broadcast AFTER rotary (rotary runs on the kv heads): the
             # projection savings are already banked; every impl below then
             # sees plain MHA shapes. XLA fuses the repeat into the consumer.
+            # The flash kernel is EXCLUDED: it consumes kv-head tensors
+            # natively (per-head BlockSpec index_map), streaming K/V at
+            # 1/group the HBM bandwidth instead of reading a repeat.
             k = jnp.repeat(k, h // kv, axis=2)
             v = jnp.repeat(v, h // kv, axis=2)
 
@@ -237,9 +253,9 @@ class SelfAttention(nn.Module):
 
             o = dcn_ulysses_attention(q, k, v, causal=True)
         elif self.attn_impl == "flash":
-            o = flash_attention(q, k, v, True)
+            o = flash_attention(q, k, v, True, window=self.attn_window)
         else:
-            o = attention_reference(q, k, v, True)
+            o = attention_reference(q, k, v, True, window=self.attn_window)
 
         o = o.reshape(b, s, h * dh)
         return nn.Dense(x.shape[-1], use_bias=False, dtype=dt, name="out")(o)
@@ -338,13 +354,15 @@ class Block(nn.Module):
     n_kv_heads: int | None = None
     mlp_impl: str = "gelu"
     decode: bool = False
+    attn_window: int | None = None
 
     @nn.compact
     def __call__(self, x):
         x = x + SelfAttention(
             self.n_heads, self.head_dim, self.compute_dtype, self.attn_impl,
             self.mesh, self.dp_axis, self.sp_axis, self.tp_axis,
-            n_kv_heads=self.n_kv_heads, decode=self.decode, name="attn",
+            n_kv_heads=self.n_kv_heads, decode=self.decode,
+            attn_window=self.attn_window, name="attn",
         )(RMSNorm(name="norm1")(x))
         if self.n_experts > 0:
             mlp = MoeMlp(self.n_experts, self.d_ff, self.capacity_factor,
@@ -378,6 +396,9 @@ class Transformer(nn.Module):
     n_kv_heads: int | None = None  # < n_heads = grouped-query attention
     mlp_impl: str = "gelu"         # "swiglu" = LLaMA-family FFN
     decode: bool = False           # KV-cache autoregressive inference mode
+    attn_window: int | None = None  # sliding-window causal attention (Mistral
+    #   -style): each token sees the window most recent positions; flash
+    #   kernels prune to O(S*window) FLOPs. reference/flash impls only.
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, features_only: bool = False):
@@ -418,7 +439,8 @@ class Transformer(nn.Module):
                 compute_dtype=self.compute_dtype, attn_impl=self.attn_impl,
                 mesh=self.mesh, dp_axis=self.dp_axis, sp_axis=self.sp_axis,
                 tp_axis=self.tp_axis, n_kv_heads=self.n_kv_heads,
-                mlp_impl=self.mlp_impl, decode=self.decode, name=f"block{i}",
+                mlp_impl=self.mlp_impl, decode=self.decode,
+                attn_window=self.attn_window, name=f"block{i}",
             )(x)
         x = RMSNorm(name="norm_f")(x)
         if features_only:

@@ -31,6 +31,7 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
@@ -44,18 +45,26 @@ NEG_INF = -1e30
 LSE_SUBLANES = 8
 
 
-def attention_reference(q, k, v, causal: bool = False):
-    """Plain softmax attention, f32 internally. Shapes (B, S, H, D)."""
+def attention_reference(q, k, v, causal: bool = False,
+                        window: int | None = None):
+    """Plain softmax attention, f32 internally. Shapes (B, S, H, D).
+    window (requires causal): each query attends only the `window` most
+    recent positions including itself — q_pos - k_pos < window."""
     dt = q.dtype
     scale = 1.0 / math.sqrt(q.shape[-1])
     prec = _dot_precision(dt)
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
                    k.astype(jnp.float32), precision=prec)
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
         qpos = jnp.arange(sq)[:, None]
         kpos = jnp.arange(sk)[None, :]
-        s = jnp.where(qpos >= kpos, s, NEG_INF)
+        keep = qpos >= kpos
+        if window is not None:
+            keep &= (qpos - kpos) < window
+        s = jnp.where(keep, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32), precision=prec)
     return o.astype(dt)
@@ -63,7 +72,7 @@ def attention_reference(q, k, v, causal: bool = False):
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
                   block_k: int, seq_k: int, causal: bool, scale: float,
-                  precision):
+                  precision, window: int | None = None):
     """One (batch*head, q-block) program. Refs: q (1, block_q, D),
     k/v (1, seq_k, D), o (1, block_q, D), lse (1, LSE_SUBLANES, block_q)."""
     qi = pl.program_id(1)
@@ -75,6 +84,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
         num_kb = pl.cdiv((qi + 1) * block_q, block_k)
     else:
         num_kb = seq_k // block_k
+    # Sliding window: first k-block any row of this q-block still sees
+    # (oldest position the LAST row attends is qi*bq + bq-1 - (window-1)...
+    # the FIRST row's oldest is qi*bq - (window-1) — the loop lower bound
+    # must cover the first row, the elementwise mask trims the rest).
+    j_start = (
+        jnp.maximum(qi * block_q - (window - 1), 0) // block_k
+        if (causal and window is not None) else 0
+    )
 
     def body(j, carry):
         acc, m, l = carry
@@ -85,7 +102,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
             preferred_element_type=jnp.float32, precision=precision,
         )  # (block_q, block_k)
         if causal:
-            s = _causal_mask(s, qi * block_q, j * block_k, block_q, block_k)
+            s = _causal_mask(s, qi * block_q, j * block_k, block_q, block_k,
+                             window)
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -99,22 +117,25 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
     acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
+    acc, m, l = jax.lax.fori_loop(j_start, num_kb, body, (acc0, m0, l0))
     o_ref[0, :, :] = (acc / l).astype(o_ref.dtype)
     # Per-row logsumexp: the only softmax state the backward needs.
     lse_row = m[:, 0] + jnp.log(l[:, 0])  # (block_q,)
     lse_ref[0, :, :] = jnp.broadcast_to(lse_row[None, :], (LSE_SUBLANES, block_q))
 
 
-def _causal_mask(s, q_start, k_start, block_q, block_k):
+def _causal_mask(s, q_start, k_start, block_q, block_k, window=None):
     qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
     kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    return jnp.where(qpos >= kpos, s, NEG_INF)
+    keep = qpos >= kpos
+    if window is not None:
+        keep &= (qpos - kpos) < window
+    return jnp.where(keep, s, NEG_INF)
 
 
 def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                      *, block_q: int, block_k: int, seq_k: int, causal: bool,
-                     scale: float, precision):
+                     scale: float, precision, window: int | None = None):
     """dQ, one (batch*head, q-block) program: streams k/v blockwise and
     accumulates dq = sum_j dS_ij @ K_j with P recomputed from the lse."""
     qi = pl.program_id(1)
@@ -128,6 +149,10 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         num_kb = pl.cdiv((qi + 1) * block_q, block_k)
     else:
         num_kb = seq_k // block_k
+    j_start = (
+        jnp.maximum(qi * block_q - (window - 1), 0) // block_k
+        if (causal and window is not None) else 0
+    )
 
     def body(j, dq):
         kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
@@ -137,7 +162,8 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32, precision=precision,
         )
         if causal:
-            s = _causal_mask(s, qi * block_q, j * block_k, block_q, block_k)
+            s = _causal_mask(s, qi * block_q, j * block_k, block_q, block_k,
+                             window)
         p = jnp.exp(s - lse)  # masked entries underflow to exactly 0
         dp = jax.lax.dot_general(
             do, vb, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -149,22 +175,42 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32, precision=precision,
         )
 
-    dq = jax.lax.fori_loop(0, num_kb, body, jnp.zeros((block_q, head_dim), jnp.float32))
+    dq = jax.lax.fori_loop(j_start, num_kb, body,
+                           jnp.zeros((block_q, head_dim), jnp.float32))
     dq_ref[0, :, :] = dq.astype(dq_ref.dtype)
 
 
 def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dk_ref, dv_ref, *, block_q: int, block_k: int,
-                      seq_q: int, causal: bool, scale: float, precision):
-    """dK/dV, one (batch*head, k-block) program: streams q/do blockwise.
-    dv = sum_i P_ij^T @ dO_i; dk = sum_i dS_ij^T @ Q_i."""
+                      dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
+                      block_k: int, seq_q: int, causal: bool, scale: float,
+                      precision, group: int = 1, window: int | None = None):
+    """dK/dV, one (batch*KV-head, k-block, group-member) program: streams
+    q/do blockwise. dv = sum_i P_ij^T @ dO_i; dk = sum_i dS_ij^T @ Q_i.
+
+    Under GQA the third grid axis walks the `group` of q heads sharing this
+    kv head — the repeat-then-sum transpose of the forward's broadcast,
+    computed without materializing group-repeated K/V and WITHOUT staging
+    the whole group in VMEM at once (a (group, sq, d) block at group=8,
+    sq=8k, bf16 would be 16 MB — over VMEM; per-program blocks here stay
+    single-head). g is the fastest axis, so the dk/dv output blocks are
+    revisited consecutively; f32 VMEM scratch carries the partial sums
+    across the g-steps and the output is written once, on the last member
+    (full precision regardless of the output dtype)."""
     kj = pl.program_id(1)
+    g = pl.program_id(2)
     kb = k_ref[0, :, :].astype(jnp.float32)
     vb = v_ref[0, :, :].astype(jnp.float32)
-    head_dim = kb.shape[-1]
     num_qb = seq_q // block_q
     # First q-block with any row attending into this k-block.
     i_start = (kj * block_k) // block_q if causal else 0
+    # Sliding window also bounds ABOVE: the newest query still seeing this
+    # k-block's oldest position kj*bk is kj*bk + window - 1.
+    if causal and window is not None:
+        i_end = jnp.minimum(
+            num_qb, pl.cdiv(kj * block_k + block_k - 1 + window, block_q)
+        )
+    else:
+        i_end = num_qb
 
     def body(i, carry):
         dk, dv = carry
@@ -177,7 +223,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32, precision=precision,
         )
         if causal:
-            s = _causal_mask(s, i * block_q, kj * block_k, block_q, block_k)
+            s = _causal_mask(s, i * block_q, kj * block_k, block_q, block_k,
+                             window)
         p = jnp.exp(s - lse_i)
         dv = dv + jax.lax.dot_general(
             p, dob, dimension_numbers=(((0,), (0,)), ((), ())),
@@ -194,10 +241,23 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         )
         return dk, dv
 
-    zeros = jnp.zeros((block_k, head_dim), jnp.float32)
-    dk, dv = jax.lax.fori_loop(i_start, num_qb, body, (zeros, zeros))
-    dk_ref[0, :, :] = dk.astype(dk_ref.dtype)
-    dv_ref[0, :, :] = dv.astype(dv_ref.dtype)
+    zeros = jnp.zeros((kb.shape[0], kb.shape[1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(i_start, i_end, body, (zeros, zeros))
+
+    @pl.when(g == 0)
+    def _init():
+        dk_acc[...] = dk
+        dv_acc[...] = dv
+
+    @pl.when(g > 0)
+    def _accum():
+        dk_acc[...] += dk
+        dv_acc[...] += dv
+
+    @pl.when(g == group - 1)
+    def _flush():
+        dk_ref[0, :, :] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, :, :] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _auto_interpret() -> bool:
@@ -246,23 +306,52 @@ def _unflatten_heads(xf, b, h):
     return xf.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
-                    block_k: int = 128, interpret: bool | None = None):
-    """Flash attention. q/k/v: (batch, seq, heads, head_dim); returns q-shaped.
+                    block_k: int = 128, interpret: bool | None = None,
+                    window: int | None = None):
+    """Flash attention. q: (batch, seq, heads, head_dim); k/v may carry
+    FEWER heads (grouped-query attention — heads % kv_heads == 0): each
+    q-head program's K/V BlockSpec index_map points at its kv head
+    (bh // group), so the group-repeated K/V never exists in HBM — the kv
+    tensors stream at 1/group the bandwidth of the MHA equivalent. Returns
+    q-shaped output.
 
-    Falls back to the reference einsum path when the sequence lengths don't
-    tile evenly (ragged tails are a later kernel feature, not a behavioral
-    gap — results are identical either way).
+    window (requires causal): sliding-window attention — each query sees
+    only the `window` most recent positions including itself. The kernels
+    prune the k-loop at BOTH ends (and the dK/dV q-loop symmetrically), so
+    compute scales O(S·window) instead of O(S²/2) — the long-context FLOPs
+    lever when full attention isn't needed.
+
+    Falls back to the reference einsum path (with an explicit kv repeat for
+    GQA) when the sequence lengths don't tile evenly — ragged tails are a
+    later kernel feature, not a behavioral gap; results are identical
+    either way.
     """
-    o, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    o, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
+                           window)
     return o
 
 
-def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
+def _repeat_kv(x, group: int):
+    return jnp.repeat(x, group, axis=2) if group > 1 else x
+
+
+def _gqa_group(q, k):
+    h, hk = q.shape[2], k.shape[2]
+    if h % hk:
+        raise ValueError(f"q heads {h} not divisible by kv heads {hk}")
+    return h // hk
+
+
+def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
+                    window=None):
     """Returns (o, lse) — lse is None when the einsum fallback was taken."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    group = _gqa_group(q, k)
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal=True and window >= 1")
     if interpret is None:
         interpret = _auto_interpret()
     block_q, block_k = _normalize_blocks(sq, sk, block_q, block_k, interpret, q.dtype)
@@ -271,24 +360,26 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
     # assumes aligned q/k positions and would run past the k blocks.
     if (sq % block_q or sk % block_k
             or (causal and (block_q % block_k or sq != sk))):
-        return attention_reference(q, k, v, causal), None
+        return attention_reference(q, _repeat_kv(k, group),
+                                   _repeat_kv(v, group), causal, window), None
 
     # (B, S, H, D) -> (B*H, S, D): grid programs are independent per head.
     qf = _flatten_heads(q)
-    kf = _flatten_heads(k)
+    kf = _flatten_heads(k)  # (B*Hkv, S, D) under GQA
     vf = _flatten_heads(v)
 
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, seq_k=sk,
         causal=causal, scale=1.0 / math.sqrt(d), precision=_dot_precision(q.dtype),
+        window=window,
     )
     of, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh // group, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh // group, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
@@ -303,21 +394,29 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
     return _unflatten_heads(of, b, h), lse
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    o, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, window=None):
+    o, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
+                             window)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, block_q, block_k, interpret, window, res, g):
     q, k, v, o, lse = res
+    group = _gqa_group(q, k)
     if lse is None:  # forward took the einsum fallback (ragged shapes)
-        _, vjp = jax.vjp(lambda q, k, v: attention_reference(q, k, v, causal), q, k, v)
+        def ref(q, k, v):
+            return attention_reference(
+                q, _repeat_kv(k, group), _repeat_kv(v, group), causal, window
+            )  # vjp of the repeat sums each kv head's group automatically
+
+        _, vjp = jax.vjp(ref, q, k, v)
         return vjp(g)
     if interpret is None:
         interpret = _auto_interpret()
 
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    hk = k.shape[2]
     # Same normalization as the forward: the forward only saved an lse (vs
     # taking the fallback) for shapes where this yields a legal tiling.
     block_q, block_k = _normalize_blocks(sq, sk, block_q, block_k, interpret, q.dtype)
@@ -334,14 +433,15 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
     dq_kernel = functools.partial(
         _flash_dq_kernel, block_q=block_q, block_k=block_k, seq_k=sk,
         causal=causal, scale=scale, precision=_dot_precision(q.dtype),
+        window=window,
     )
     dqf = pl.pallas_call(
         dq_kernel,
         grid=(b * h, sq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh // group, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh // group, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
             pl.BlockSpec((1, LSE_SUBLANES, block_q), lambda bh, i: (bh, 0, i)),
             pl.BlockSpec((1, LSE_SUBLANES, block_q), lambda bh, i: (bh, 0, i)),
@@ -354,33 +454,45 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
     dkv_kernel = functools.partial(
         _flash_dkv_kernel, block_q=block_q, block_k=block_k, seq_q=sq,
         causal=causal, scale=scale, precision=_dot_precision(q.dtype),
+        group=group, window=window,
     )
+    # Grid over KV heads x k-blocks x group members (g fastest, so each
+    # dk/dv output block's revisits are consecutive and the VMEM scratch
+    # accumulates across them). Each program stages ONE q head's rows —
+    # q-head row for member g of kv head bkv is bkv*group + g in the
+    # head-flattened layout (a batch's heads are adjacent).
     dkf, dvf = pl.pallas_call(
         dkv_kernel,
-        grid=(b * h, sk // block_k),
+        grid=(b * hk, sk // block_k, group),
         in_specs=[
-            pl.BlockSpec((1, sq, d), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, sq, d), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, LSE_SUBLANES, sq), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, LSE_SUBLANES, sq), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, sq, d), lambda bkv, j, g: (bkv * group + g, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bkv, j, g: (bkv, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bkv, j, g: (bkv, j, 0)),
+            pl.BlockSpec((1, sq, d), lambda bkv, j, g: (bkv * group + g, 0, 0)),
+            pl.BlockSpec((1, LSE_SUBLANES, sq),
+                         lambda bkv, j, g: (bkv * group + g, 0, 0)),
+            pl.BlockSpec((1, LSE_SUBLANES, sq),
+                         lambda bkv, j, g: (bkv * group + g, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bkv, j, g: (bkv, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bkv, j, g: (bkv, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((b * hk, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * hk, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
 
     return (
         _unflatten_heads(dqf, b, h),
-        _unflatten_heads(dkf, b, h),
-        _unflatten_heads(dvf, b, h),
+        _unflatten_heads(dkf, b, hk),
+        _unflatten_heads(dvf, b, hk),
     )
 
 

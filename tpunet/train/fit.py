@@ -37,6 +37,8 @@ def fit(
     log_every: int = 0,
     log_fn: Callable[[dict[str, Any]], None] | None = None,
     skip_batches_on_resume: bool = False,
+    prefetch: int = 0,
+    prefetch_sharding=None,
 ) -> TrainState:
     """Run `steps` optimizer steps (counted by state.step, so a resumed run
     finishes the SAME total schedule, not `steps` more).
@@ -46,8 +48,9 @@ def fit(
         supplies structure and shardings for the restore).
     train_step: make_train_step(...)-style (state, inputs, labels, rng) ->
         (state, loss).
-    batches: yields (inputs, labels); wrap with
-        tpunet.data.prefetch_to_device to overlap host->HBM transfer.
+    batches: yields (inputs, labels); pass `prefetch=2` to overlap
+        host->HBM transfer (fit wraps the stream itself, after any resume
+        skip).
     rng: PRNGKey folded with the step counter for per-step dropout keys.
     checkpoint_every: save every k steps (and once at the end) when
         checkpoint_dir is set; 0 = only the final save.
@@ -61,6 +64,13 @@ def fit(
         interrupted run left off and the resumed trajectory matches an
         uninterrupted one. Leave False for stateful/streaming sources that
         manage their own position.
+    prefetch: when > 0, wrap the batch stream in
+        tpunet.data.prefetch_to_device(size=prefetch) — HERE, after the
+        resume skip, so skipped batches are a cheap host-side index
+        advance, never materialized or transferred. Prefer this over
+        wrapping `batches` yourself when also using
+        skip_batches_on_resume. prefetch_sharding is passed through
+        (e.g. batch_sharding(mesh)).
     """
     if rng is None:
         rng = jax.random.PRNGKey(0)
@@ -90,6 +100,11 @@ def fit(
         if skip_batches_on_resume and done:
             for _ in range(done):
                 next(it, None)
+        if prefetch > 0:
+            from tpunet.data import prefetch_to_device
+
+            it = prefetch_to_device(it, size=prefetch,
+                                    sharding=prefetch_sharding)
         while done < steps:
             try:
                 inputs, labels = next(it)
@@ -110,7 +125,11 @@ def fit(
             if mgr is not None and checkpoint_every and done % checkpoint_every == 0:
                 mgr.save(done, state)
         if mgr is not None and loss is not None:
-            mgr.save(done, state, force=True)
+            # Skip when the cadence already saved this exact step: orbax's
+            # force=True bypasses the save-interval policy but still raises
+            # StepAlreadyExistsError on a duplicate step.
+            if mgr.latest_step() != done:
+                mgr.save(done, state, force=True)
             mgr.wait_until_finished()
     finally:
         if mgr is not None:
