@@ -146,6 +146,48 @@ def _flash_smoke_ok(kernels: dict | None) -> bool:
             and kernels.get("flash_bwd") == "ok")
 
 
+# The committed-measurement replay is only trustworthy while the code it
+# measured is the code at HEAD. These are the paths whose changes invalidate
+# the model-tier numbers: kernels, model defs, the train-step builder and
+# optimizer plumbing, and the timing harness (chained_step_time lives in
+# benchmarks/__init__.py).
+MEASURED_PATHS = ("tpunet/ops", "tpunet/models", "tpunet/train",
+                  "benchmarks/tpu_headline.py", "benchmarks/__init__.py")
+
+
+def _measurement_staleness(measured_commit: str | None) -> dict:
+    """Self-checking replay provenance: diff the measured commit against HEAD
+    over the measured code paths and report `stale` mechanically, instead of
+    asserting freshness in a static file (which is guaranteed to rot).
+    Uncommitted edits to those paths also count as stale."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    parts = (measured_commit or "").split()
+    commit = parts[0] if parts else ""
+    if not commit:
+        return {"stale": None, "error": "no measured_commit recorded"}
+    try:
+        p = subprocess.run(
+            ["git", "diff", "--name-only", f"{commit}..HEAD", "--",
+             *MEASURED_PATHS],
+            capture_output=True, text=True, timeout=30, cwd=repo)
+        if p.returncode != 0:
+            return {"stale": None,
+                    "error": (p.stderr.strip() or "git diff failed")[-200:]}
+        changed = sorted({ln.strip() for ln in p.stdout.splitlines()
+                          if ln.strip()})
+        st = subprocess.run(
+            ["git", "status", "--porcelain", "--", *MEASURED_PATHS],
+            capture_output=True, text=True, timeout=30, cwd=repo)
+        dirty = sorted({ln[3:].strip() for ln in st.stdout.splitlines()
+                        if ln.strip()}) if st.returncode == 0 else []
+        out = {"stale": bool(changed or dirty), "changed_files": changed}
+        if dirty:
+            out["uncommitted_files"] = dirty
+        return out
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return {"stale": None, "error": repr(e)[-200:]}
+
+
 def _model_tier(tpu_up: bool, kernels: dict | None) -> dict | None:
     """Run benchmarks.tpu_headline on the chip (or CPU fallback). Kernels
     that failed their smoke are individually dropped to their fallback impl
@@ -226,11 +268,21 @@ def main() -> None:
                 loaded = json.load(f)
             if isinstance(loaded, dict):
                 tpu_last_measured = loaded
+                staleness = _measurement_staleness(
+                    loaded.get("measured_commit"))
+                tpu_last_measured["staleness"] = staleness
+                stale_note = (
+                    "STALE — measured paths changed since: "
+                    + ", ".join(staleness.get("changed_files", [])
+                                + staleness.get("uncommitted_files", []))
+                    if staleness.get("stale")
+                    else "fresh (measured paths unchanged at HEAD)"
+                    if staleness.get("stale") is False
+                    else f"staleness unknown: {staleness.get('error')}")
                 print("[bench] TPU tier unavailable now; attaching committed "
                       f"measurement from {loaded.get('measured_at')} "
-                      f"(code as of {loaded.get('measured_commit')} — compare "
-                      "against HEAD before trusting it for NEWER kernel/model "
-                      "changes)", file=sys.stderr)
+                      f"(commit {loaded.get('measured_commit')}; "
+                      f"{stale_note})", file=sys.stderr)
         except (OSError, ValueError):
             pass
     print(

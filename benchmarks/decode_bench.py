@@ -35,6 +35,8 @@ def main(argv=None) -> None:
     p.add_argument("--new", type=int, default=128)
     p.add_argument("--kv-heads", type=int, default=None,
                    help="grouped-query kv heads (default: = heads)")
+    p.add_argument("--window", type=int, default=None,
+                   help="sliding-window attention span (default: full causal)")
     p.add_argument("--iters", type=int, default=3)
     args = p.parse_args(argv)
 
@@ -52,7 +54,7 @@ def main(argv=None) -> None:
     model = Transformer(
         vocab=args.vocab, d_model=args.d, n_layers=args.layers,
         n_heads=args.heads, d_ff=args.ff, n_kv_heads=args.kv_heads,
-        compute_dtype=jnp.bfloat16,
+        attn_window=args.window, compute_dtype=jnp.bfloat16,
     )
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(
@@ -77,6 +79,7 @@ def main(argv=None) -> None:
         "platform": jax.devices()[0].platform,
         "d": args.d, "L": args.layers, "heads": args.heads,
         "kv_heads": args.kv_heads or args.heads,
+        "window": args.window,
         "params_M": round(n_params / 1e6, 1),
         "batch": args.batch, "prompt": args.prompt, "new": args.new,
         "wall_s": round(best, 4),

@@ -83,6 +83,30 @@ def run_smoke() -> dict:
     except Exception as e:  # noqa: BLE001
         out["flash_gqa_bwd"] = _short(e)
 
+    # Sliding window: the k-block loop gains a LOWER bound in fwd
+    # (j_start from qi*bq - (window-1)) and an UPPER bound in the dK/dV
+    # pass — new Mosaic programs reachable from the public model API
+    # (attn_window=), so they get their own line items. window=192 with
+    # S=256, bk=128 exercises both a fully-inside and a partially-masked
+    # k-block on each side of the boundary.
+    wref = attention_reference(q, q, q, True, window=192)
+    try:
+        o = jax.jit(lambda x: flash_attention(x, x, x, True, window=192))(q)
+        err = _parity(o, wref)
+        out["flash_window_fwd"] = "ok" if err < 0.02 else f"parity {err:.3e}"
+    except Exception as e:  # noqa: BLE001
+        out["flash_window_fwd"] = _short(e)
+
+    try:
+        g = jax.jit(jax.grad(
+            lambda x: jnp.sum(flash_attention(x, x, x, True, window=192))))(q)
+        gr = jax.jit(jax.grad(lambda x: jnp.sum(
+            attention_reference(x, x, x, True, window=192))))(q)
+        err = _parity(g, gr)
+        out["flash_window_bwd"] = "ok" if err < 0.06 else f"parity {err:.3e}"
+    except Exception as e:  # noqa: BLE001
+        out["flash_window_bwd"] = _short(e)
+
     return out
 
 

@@ -136,3 +136,24 @@ def test_prefetch_with_sharding(bin_path):
     assert out
     inputs, _ = out[0]
     assert len(inputs.sharding.device_set) == 2
+
+
+def test_batch_rejects_out_of_range_ids(bin_path):
+    ds = TokenDataset(bin_path, seq=4, vocab=64)
+    with pytest.raises(IndexError):
+        ds.batch(np.array([0, ds.n_windows]))
+    with pytest.raises(IndexError):
+        ds.batch(np.array([-1]))
+    with pytest.raises(ValueError):
+        ds.batch(np.array([[0, 1]]))  # not 1-D
+
+
+def test_batch_matches_per_window_gather(bin_path):
+    # The vectorized fancy-index gather must agree with window() row by row.
+    ds = TokenDataset(bin_path, seq=4, vocab=64)
+    idx = np.array([3, 0, 2])
+    inputs, labels = ds.batch(idx)
+    for row, i in enumerate(idx):
+        w = ds.window(int(i))
+        np.testing.assert_array_equal(inputs[row], w[:-1])
+        np.testing.assert_array_equal(labels[row], w[1:])

@@ -109,3 +109,17 @@ def test_fit_logs(setup):
     fit(state, step, _batches(ds), steps=4, log_every=2, log_fn=seen.append)
     assert [m["step"] for m in seen] == [2, 4]
     assert all(np.isfinite(m["loss"]) for m in seen)
+
+
+def test_fit_zero_steps_still_checkpoints(setup, tmp_path):
+    ds, state, step = setup
+    ck = str(tmp_path / "ck")
+    with pytest.warns(UserWarning, match="0 steps"):
+        out = fit(state, step, iter([]), steps=5, checkpoint_dir=ck)
+    assert int(out.step) == 0
+    mgr = CheckpointManager(ck)
+    try:
+        # The degenerate run must leave a detectable artifact, not nothing.
+        assert mgr.latest_step() == 0
+    finally:
+        mgr.close()

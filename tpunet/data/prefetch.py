@@ -42,6 +42,18 @@ def prefetch_to_device(iterator, size: int = 2, sharding=None):
     _full = queue.Full
     stop = threading.Event()
 
+    def _put_or_abandon(item) -> bool:
+        """Bounded put that also watches for consumer abandonment, so a
+        dropped generator can't leave this thread pinned on a full queue
+        holding device buffers forever. True = delivered."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except _full:
+                continue
+        return False
+
     def worker():
         try:
             for item in iterator:
@@ -50,21 +62,12 @@ def prefetch_to_device(iterator, size: int = 2, sharding=None):
                     if sharding is not None
                     else jax.device_put(item)
                 )
-                # Bounded put that also watches for consumer abandonment,
-                # so a dropped generator can't leave this thread pinned on
-                # a full queue holding device buffers forever.
-                while not stop.is_set():
-                    try:
-                        q.put(put, timeout=0.1)
-                        break
-                    except _full:
-                        continue
-                if stop.is_set():
+                if not _put_or_abandon(put):
                     return
         except Exception as e:  # surface source errors to the consumer
-            q.put(e)
+            _put_or_abandon(e)
             return
-        q.put(_END)
+        _put_or_abandon(_END)
 
     t = threading.Thread(target=worker, daemon=True, name="tpunet-prefetch")
     t.start()

@@ -80,7 +80,19 @@ class TokenDataset:
     def batch(self, idx: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """(inputs, labels) int32 of shape (len(idx), seq) for window ids
         `idx` — labels are inputs shifted by one inside each window."""
-        rows = np.stack([self.window(int(i)) for i in idx])
+        idx = np.asarray(idx, dtype=np.int64)
+        if idx.ndim != 1:
+            raise ValueError(f"idx must be 1-D, got shape {idx.shape}")
+        if idx.size and (idx.min() < 0 or idx.max() >= self.n_windows):
+            raise IndexError(
+                f"window ids [{idx.min()}, {idx.max()}] outside "
+                f"[0, {self.n_windows})"
+            )
+        # One vectorized gather for the whole (batch, seq+1) block — the
+        # memmap fancy-index reads each window's pages directly, with no
+        # per-row Python loop on the training hot path.
+        gather = idx[:, None] * self.seq + np.arange(self.seq + 1)
+        rows = np.asarray(self._mm[gather], dtype=np.int32)
         return rows[:, :-1], rows[:, 1:]
 
 

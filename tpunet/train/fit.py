@@ -96,6 +96,7 @@ def fit(
         # dispatch — fetched ONCE here (post-restore), then incremented
         # locally in lockstep with the step function's step+1.
         done = int(state.step)
+        start_step = done
         window_start = done
         if skip_batches_on_resume and done:
             for _ in range(done):
@@ -124,7 +125,21 @@ def fit(
                 window_start = done
             if mgr is not None and checkpoint_every and done % checkpoint_every == 0:
                 mgr.save(done, state)
-        if mgr is not None and loss is not None:
+        if mgr is not None:
+            if done == start_step and start_step < steps:
+                # The schedule wanted more steps but the stream yielded
+                # none: still leave an artifact — a silent no-op run with a
+                # configured checkpoint_dir would otherwise be undetectable.
+                # (A re-invoked COMPLETED run — start_step >= steps — is a
+                # legitimate no-op, not this case.)
+                import warnings
+
+                warnings.warn(
+                    f"fit() ran 0 steps (state.step={done}, steps={steps}): "
+                    "the batch stream was empty; ensuring a checkpoint "
+                    "exists for the current state",
+                    stacklevel=2,
+                )
             # Skip when the cadence already saved this exact step: orbax's
             # force=True bypasses the save-interval policy but still raises
             # StepAlreadyExistsError on a duplicate step.
