@@ -1,0 +1,164 @@
+"""Engine A/B: BASIC vs EPOLL point-to-point latency and throughput.
+
+The BASIC engine grew caller-thread fast paths in round 3 (inline send +
+lazy recv); round 4 gives EPOLL its epoll-native equivalent (idle-comm
+inline dispatch + immediate IO pass, epoll_engine.cc). This bench measures
+what those paths exist for — per-message round-trip latency at small/medium
+sizes and sustained throughput at large sizes — for both engines with one
+command, so "EPOLL within noise of BASIC" is a number, not a claim.
+
+Method: two spawned processes over `tpunet.transport.Net` on loopback.
+For each size: ping-pong (send then recv back) `iters` times, take the
+best iteration (kernel-noise floor, nccl-tests convention). Throughput is
+unidirectional bytes / (round-trip / 2). Engine is selected via
+TPUNET_IMPLEMENT in the child env BEFORE the native lib loads.
+
+1-core caveat (PERF_NOTES.md): both processes share the core, so absolute
+GB/s sits below the 2-socket ceiling; the A/B *ratio* is the signal.
+
+Usage: python -m benchmarks.engine_p2p [--sizes 1048576 134217728]
+       [--iters 8] [--nstreams 4] [--engines BASIC EPOLL]
+Prints ONE JSON line: {engine: {size: {rtt_ms, gbps}}, ratios: {...}}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _peer(rank: int, q, engine: str, nstreams: int,
+          sizes: list, iters: int) -> None:
+    try:
+        os.environ["TPUNET_IMPLEMENT"] = engine
+        os.environ["TPUNET_NSTREAMS"] = str(nstreams)
+        import numpy as np
+
+        from tpunet.transport import Net
+
+        net = Net()
+        # Rank 0 listens and ships the handle via the bootstrap queue; the
+        # queue is only used for rendezvous, never timing.
+        if rank == 0:
+            listen = net.listen(0)
+            q.put(("handle", bytes(listen.handle)))
+            rc = listen.accept()
+            # Accept side also connects back for the return path.
+            while True:
+                item = q.get(timeout=60)
+                if item[0] == "handle2":
+                    sc = net.connect(item[1])
+                    break
+                q.put(item)
+        else:
+            while True:
+                item = q.get(timeout=60)
+                if item[0] == "handle":
+                    sc = net.connect(item[1])
+                    break
+                q.put(item)
+            listen = net.listen(0)
+            q.put(("handle2", bytes(listen.handle)))
+            rc = listen.accept()
+
+        out = {}
+        for size in sizes:
+            buf_tx = np.frombuffer(bytes(range(256)) * ((size // 256) + 1),
+                                   dtype=np.uint8)[:size].copy()
+            buf_rx = np.zeros(size, dtype=np.uint8)
+            times = []
+            for it in range(2 + iters):  # 2 warmup
+                t0 = time.perf_counter()
+                if rank == 0:
+                    sc.send(buf_tx, timeout=120)
+                    rc.recv(buf_rx, timeout=120)
+                else:
+                    rc.recv(buf_rx, timeout=120)
+                    sc.send(buf_tx, timeout=120)
+                dt = time.perf_counter() - t0
+                if it >= 2:
+                    times.append(dt)
+            if size and not np.array_equal(buf_rx, buf_tx):
+                raise RuntimeError(f"payload corrupt at size {size}")
+            best = min(times)
+            out[size] = {"rtt_ms": round(best * 1e3, 4),
+                         "gbps": round(size / (best / 2) / 1e9, 3) if size else None}
+        sc.close()
+        rc.close()
+        listen.close()
+        net.close()
+        q.put((f"result{rank}", out))
+    except Exception as e:  # noqa: BLE001
+        q.put((f"result{rank}", f"ERR: {e!r}"))
+
+
+def run_engine(engine: str, nstreams: int, sizes: list, iters: int) -> dict:
+    import multiprocessing as mp
+
+    import queue as queue_mod
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_peer, args=(r, q, engine, nstreams,
+                                             sizes, iters))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        deadline = time.time() + 600
+        while len(results) < 2 and time.time() < deadline:
+            try:
+                tag, payload = q.get(timeout=max(1, deadline - time.time()))
+            except queue_mod.Empty:
+                break
+            if tag.startswith("result"):
+                results[tag] = payload
+            else:
+                q.put((tag, payload))
+                time.sleep(0.01)
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.kill()
+    for r, p in enumerate(procs):
+        if f"result{r}" not in results:
+            raise SystemExit(
+                f"{engine} rank {r} died without reporting "
+                f"(exitcode {p.exitcode}) — native-layer crash?")
+    for tag, payload in results.items():
+        if isinstance(payload, str):
+            raise SystemExit(f"{engine} {tag} failed: {payload}")
+    # Rank 0's clock covers the same round trips; use it.
+    return results["result0"]
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sizes", type=int, nargs="+",
+                    default=[4096, 1 << 20, 128 << 20])
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--nstreams", type=int, default=4)
+    ap.add_argument("--engines", nargs="+", default=["BASIC", "EPOLL"])
+    args = ap.parse_args(argv)
+
+    out = {"nstreams": args.nstreams, "engines": {}}
+    for eng in args.engines:
+        out["engines"][eng] = run_engine(eng, args.nstreams, args.sizes,
+                                         args.iters)
+        print(f"[engine_p2p] {eng}: {out['engines'][eng]}", file=sys.stderr)
+    if "BASIC" in out["engines"] and "EPOLL" in out["engines"]:
+        out["epoll_over_basic_rtt"] = {
+            str(s): round(out["engines"]["BASIC"][s]["rtt_ms"]
+                          / out["engines"]["EPOLL"][s]["rtt_ms"], 3)
+            for s in args.sizes
+        }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,13 @@
 // segment queue on EPOLLIN/EPOLLOUT readiness, toggling interest so an idle
 // fd costs nothing. Completion accounting is the shared RequestState; a
 // request is done when its ctrl frame AND all its chunks have been moved.
+//
+// Inline fast path (TPUNET_EPOLL_INLINE=0 to disable): on an idle comm the
+// caller thread dispatches its own message under the per-comm mutex and
+// runs an immediate nonblocking IO pass, so small/buffered messages never
+// touch the loop thread at all — the epoll-native analogue of BASIC's
+// inline-send + lazy-recv (basic_engine.cc), closing the submit→loop-hop
+// latency gap between the engines.
 #include <errno.h>
 #include <string.h>
 #include <sys/epoll.h>
@@ -92,6 +99,18 @@ struct EComm {
   size_t hdr_done = 0;
   bool failed = false;
   std::string fail_msg;
+  // Inline fast path (caller-thread IO; see Loop::TryInline). `mu` guards
+  // ALL mutable comm state above, taken by the loop thread at each entry
+  // point and by the caller thread in TryInline — uncontended in steady
+  // state, so the common cost is one atomic pair per entry. `attached`
+  // flips once on the loop thread after epoll registration (fds are
+  // nonblocking only from then on). `queued` counts kMsg commands posted
+  // to the loop but not yet fully dispatched; TryInline requires 0 so an
+  // inline message can never overtake a queued one on the wire (the loop
+  // decrements only AFTER StartMsgLocked finishes, under mu).
+  std::mutex mu;
+  bool attached = false;
+  std::atomic<uint64_t> queued{0};
 };
 
 struct Command {
@@ -166,8 +185,44 @@ class Loop {
     FailCommand(c, "epoll loop unavailable");
   }
 
+  // Caller-thread fast path: when the comm is verifiably idle — attached,
+  // healthy, no queued commands, every segment queue empty — the caller
+  // takes the loop's role for this one message under the comm mutex:
+  // StartMsgLocked dispatches it AND runs an immediate nonblocking IO pass,
+  // so a message that fits the kernel socket buffers (send) or has already
+  // arrived (recv) completes with zero loop-thread hops and zero epoll
+  // round-trips. Residue is armed via epoll_ctl, which is thread-safe
+  // against the loop's epoll_wait; the loop finishes the tail as usual.
+  // Returns false when not idle — caller falls back to Post(kMsg).
+  // Wire-order safety: inline requires queued==0 AND empty segment queues,
+  // i.e. every prior message's bytes are already in the kernel, so this
+  // message cannot overtake anything. Callers are single-threaded per comm
+  // (NCCL proxy contract), so the idle check cannot race another submitter.
+  bool TryInline(EComm* c, uint8_t* data, size_t len, const RequestPtr& state) {
+    // Same fork guard as Post(): in a forked child the comm's fds are
+    // SHARED with the parent — inline IO here would interleave bytes with
+    // the parent's loop thread (and c->mu may have been captured locked at
+    // fork). Decline; the caller falls through to Post(), whose guard
+    // fails the request with the canonical before-fork error.
+    if (ForkGeneration() != fork_gen_) return false;
+    std::lock_guard<std::mutex> lk(c->mu);
+    if (!c->attached && !c->failed) return false;
+    if (c->queued.load(std::memory_order_acquire) != 0) return false;
+    if (!c->ctrl.segs.empty() || !c->pending.empty()) return false;
+    for (auto& s : c->streams) {
+      if (!s->segs.empty()) return false;
+    }
+    // A failed comm takes the inline path too: StartMsgLocked fails the
+    // request immediately, sparing the hop through a loop that may be gone.
+    StartMsgLocked(c, data, len, state);
+    return true;
+  }
+
  private:
   static void FailCommand(Command& c, const std::string& why) {
+    if (c.kind == Command::kMsg && c.comm) {
+      c.comm->queued.fetch_sub(1, std::memory_order_acq_rel);
+    }
     if (c.state) {
       c.state->SetError(why);
       c.state->total.store(0, std::memory_order_release);
@@ -209,7 +264,10 @@ class Loop {
     // mark the loop dead and drain late commands so Post() never strands a
     // caller (kClose acks are signaled, kMsg requests are failed).
     for (auto& kv : comms_) FailComm(kv.second.get(), "engine shut down");
-    for (auto& kv : comms_) CloseFds(kv.second.get());
+    for (auto& kv : comms_) {
+      std::lock_guard<std::mutex> lk(kv.second->mu);
+      CloseFds(kv.second.get());
+    }
     comms_.clear();
     graveyard_.clear();
     std::deque<Command> late;
@@ -233,9 +291,16 @@ class Loop {
         case Command::kAttach:
           Attach(c.comm);
           break;
-        case Command::kMsg:
-          StartMsg(c.comm.get(), c.data, c.len, c.state);
+        case Command::kMsg: {
+          EComm* ec = c.comm.get();
+          std::lock_guard<std::mutex> lk(ec->mu);
+          StartMsgLocked(ec, c.data, c.len, c.state);
+          // Decrement only now, under mu: TryInline observing queued==0
+          // then implies this message's segments are already dispatched
+          // (and its idle check sees them), so inline can't overtake it.
+          ec->queued.fetch_sub(1, std::memory_order_acq_rel);
           break;
+        }
         case Command::kClose:
           Detach(c.comm);
           if (c.ack) c.ack->set_value();
@@ -250,13 +315,17 @@ class Loop {
 
   void Attach(const std::shared_ptr<EComm>& comm) {
     comms_[comm.get()] = comm;
+    std::lock_guard<std::mutex> lk(comm->mu);
     bool ok = Register(&comm->ctrl);
     for (auto& s : comm->streams) ok = Register(s.get()) && ok;
     if (!ok) {
       // A comm with unwatched fds would never progress and never error;
       // fail it now so its requests surface the problem via test().
-      FailComm(comm.get(), "epoll registration failed: " + std::string(strerror(errno)));
+      FailCommLocked(comm.get(),
+                     "epoll registration failed: " + std::string(strerror(errno)));
+      return;
     }
+    comm->attached = true;  // TryInline may take the fast path from here on
   }
 
   bool Register(FdState* fs) {
@@ -275,9 +344,10 @@ class Loop {
     // surfaces an error instead of polling forever (BASIC flushes queued
     // work on close for the same reason).
     EComm* c = comm.get();
+    std::lock_guard<std::mutex> lk(c->mu);
     bool leftovers = !c->ctrl.segs.empty() || !c->pending.empty();
     for (auto& s : c->streams) leftovers = leftovers || !s->segs.empty();
-    if (leftovers) FailComm(c, "comm closed with requests in flight");
+    if (leftovers) FailCommLocked(c, "comm closed with requests in flight");
     CloseFds(comm.get());
     comms_.erase(comm.get());
     // Keep the comm alive until the current event batch has fully drained —
@@ -298,6 +368,9 @@ class Loop {
   }
 
   // Set epoll interest on fs to `want` (EPOLLIN or EPOLLOUT or 0).
+  // epoll_ctl is thread-safe against the loop's epoll_wait, so this is
+  // callable from the caller thread's inline path; fs->armed is guarded by
+  // the comm mutex all callers hold.
   void Arm(FdState* fs, uint32_t want) {
     if (fs->armed == want || fs->fd < 0) return;
     epoll_event ev{};
@@ -307,7 +380,7 @@ class Loop {
     fs->armed = want;
   }
 
-  void WantIO(FdState* fs) {
+  void WantIOLocked(FdState* fs) {
     uint32_t dir = fs->comm->is_send ? static_cast<uint32_t>(EPOLLOUT)
                                      : static_cast<uint32_t>(EPOLLIN);
     // Recv-side ctrl arms EPOLLIN while a posted recv awaits its frame.
@@ -320,9 +393,9 @@ class Loop {
     Arm(fs, fs->segs.empty() ? 0 : dir);
   }
 
-  // ----- message start ------------------------------------------------------
+  // ----- message start (comm mutex held) -----------------------------------
 
-  void StartMsg(EComm* c, uint8_t* data, size_t len, const RequestPtr& state) {
+  void StartMsgLocked(EComm* c, uint8_t* data, size_t len, const RequestPtr& state) {
     if (c->failed) {
       state->SetError("comm broken by earlier error: " + c->fail_msg);
       state->total.store(0, std::memory_order_release);
@@ -343,15 +416,26 @@ class Loop {
       hdr.counts_bytes = false;
       hdr.state = state;
       c->ctrl.segs.push_back(std::move(hdr));
-      WantIO(&c->ctrl);
-      DispatchChunks(c, data, len, state);
+      DispatchChunksLocked(c, data, len, state);
+      // Immediate IO pass (ctrl frame first): a message that fits the
+      // kernel socket buffers completes right here with interest left at 0
+      // — no epoll round-trip at all. Residue arms itself in AdvanceFd.
+      AdvanceFdLocked(&c->ctrl);
+      for (auto& s : c->streams) {
+        if (c->failed) break;
+        if (!s->segs.empty()) AdvanceFdLocked(s.get());
+      }
     } else {
       c->pending.push_back(PendingRecv{data, len, state});
-      WantIO(&c->ctrl);
+      // Immediate pass: the frame (and often the payload) may already sit
+      // in the kernel buffer — AdvanceRecvCtrl consumes it and advances
+      // the data fds without waiting for a readiness event.
+      AdvanceRecvCtrlLocked(c);
     }
   }
 
-  void DispatchChunks(EComm* c, uint8_t* data, size_t len, const RequestPtr& state) {
+  void DispatchChunksLocked(EComm* c, uint8_t* data, size_t len,
+                            const RequestPtr& state) {
     size_t csize = ChunkSize(len, c->min_chunksize, c->nstreams);
     size_t nchunks = ChunkCount(len, csize);
     size_t off = 0;
@@ -364,18 +448,26 @@ class Loop {
       seg.len = n;
       seg.state = state;
       fs->segs.push_back(std::move(seg));
-      WantIO(fs);
+      WantIOLocked(fs);
       off += n;
     }
   }
 
   // ----- readiness ----------------------------------------------------------
 
+  // Loop-thread entry for epoll events; the inline path enters via
+  // StartMsgLocked with the same mutex held, so fd/segment state is only
+  // ever touched under c->mu.
   void Advance(FdState* fs) {
+    std::lock_guard<std::mutex> lk(fs->comm->mu);
+    AdvanceFdLocked(fs);
+  }
+
+  void AdvanceFdLocked(FdState* fs) {
     EComm* c = fs->comm;
     if (c->failed || fs->fd < 0) return;
     if (!c->is_send && fs->is_ctrl) {
-      AdvanceRecvCtrl(c);
+      AdvanceRecvCtrlLocked(c);
       return;
     }
     while (!fs->segs.empty()) {
@@ -401,19 +493,20 @@ class Loop {
         continue;  // partial move; kernel may have more room/bytes
       }
       if (m == 0) {  // EOF on recv
-        FailComm(c, "peer closed data stream mid-message");
+        FailCommLocked(c, "peer closed data stream mid-message");
         return;
       }
       if (errno == EAGAIN || errno == EWOULDBLOCK) break;
       if (errno == EINTR) continue;
-      FailComm(c, std::string(c->is_send ? "send" : "recv") + " failed: " + strerror(errno));
+      FailCommLocked(c, std::string(c->is_send ? "send" : "recv") + " failed: " + strerror(errno));
       return;
     }
-    WantIO(fs);
+    WantIOLocked(fs);
   }
 
-  void AdvanceRecvCtrl(EComm* c) {
+  void AdvanceRecvCtrlLocked(EComm* c) {
     FdState* fs = &c->ctrl;
+    bool dispatched = false;
     while (!c->pending.empty()) {
       ssize_t m = ::recv(fs->fd, c->hdr + c->hdr_done, 8 - c->hdr_done, MSG_DONTWAIT);
       if (m > 0) {
@@ -424,7 +517,7 @@ class Loop {
         PendingRecv pr = c->pending.front();
         c->pending.pop_front();
         if (target > pr.len) {
-          FailComm(c, "incoming message (" + std::to_string(target) +
+          FailCommLocked(c, "incoming message (" + std::to_string(target) +
                           "B) exceeds posted recv buffer (" + std::to_string(pr.len) + "B)");
           return;
         }
@@ -434,19 +527,29 @@ class Loop {
         pr.state->total.store(1 + nchunks, std::memory_order_release);
         pr.state->completed.fetch_add(1, std::memory_order_acq_rel);
         pr.state->NotifyIfSettled();  // 0-byte message: settled right here
-        DispatchChunks(c, pr.data, static_cast<size_t>(target), pr.state);
+        DispatchChunksLocked(c, pr.data, static_cast<size_t>(target), pr.state);
+        dispatched = true;
         continue;
       }
       if (m == 0) {
-        FailComm(c, "peer closed ctrl stream");
+        FailCommLocked(c, "peer closed ctrl stream");
         return;
       }
       if (errno == EAGAIN || errno == EWOULDBLOCK) break;
       if (errno == EINTR) continue;
-      FailComm(c, std::string("ctrl recv failed: ") + strerror(errno));
+      FailCommLocked(c, std::string("ctrl recv failed: ") + strerror(errno));
       return;
     }
-    WantIO(fs);
+    WantIOLocked(fs);
+    if (dispatched) {
+      // Eager data pass: when the frame was readable, the payload usually
+      // is too — drain what's buffered now instead of paying a readiness
+      // round-trip per data fd.
+      for (auto& s : c->streams) {
+        if (c->failed) break;
+        if (!s->segs.empty()) AdvanceFdLocked(s.get());
+      }
+    }
   }
 
   void CompleteSegment(Segment& seg) {
@@ -457,10 +560,16 @@ class Loop {
     seg.state->NotifyIfSettled();
   }
 
-  // Fail every in-flight and future request on the comm. Buffers are safe to
-  // release immediately: segments are dropped here on the only thread that
-  // ever touches them.
+  // Loop-thread entry (EPOLLERR/EPOLLHUP and Run-exit paths).
   void FailComm(EComm* c, const std::string& msg) {
+    std::lock_guard<std::mutex> lk(c->mu);
+    FailCommLocked(c, msg);
+  }
+
+  // Fail every in-flight and future request on the comm. Buffers are safe to
+  // release immediately: segments are dropped under the comm mutex, which
+  // every toucher (loop thread and inline caller) holds.
+  void FailCommLocked(EComm* c, const std::string& msg) {
     if (c->failed) return;
     c->failed = true;
     c->fail_msg = msg;
@@ -509,7 +618,8 @@ struct CommHandle {
 
 class EpollEngine : public EngineBase {
  public:
-  EpollEngine() {
+  EpollEngine()
+      : inline_io_(GetEnvU64("TPUNET_EPOLL_INLINE", 1) != 0) {
     size_t nloops = GetEnvU64("TPUNET_EPOLL_THREADS", 2);
     if (nloops == 0) nloops = 1;
     for (size_t i = 0; i < nloops; ++i) loops_.emplace_back(std::make_unique<Loop>());
@@ -633,7 +743,12 @@ class EpollEngine : public EngineBase {
     auto state = std::make_shared<RequestState>();
     uint64_t id = next_id_.fetch_add(1);
     requests_.Put(id, state);
-    h.loop->Post(Command{Command::kMsg, h.comm, data, nbytes, state, nullptr});
+    // Caller-thread fast path on an idle comm (see Loop::TryInline): the
+    // message is dispatched — often fully moved — before this call returns.
+    if (!inline_io_ || !h.loop->TryInline(h.comm.get(), data, nbytes, state)) {
+      h.comm->queued.fetch_add(1, std::memory_order_acq_rel);
+      h.loop->Post(Command{Command::kMsg, h.comm, data, nbytes, state, nullptr});
+    }
     *request = id;
     return Status::Ok();
   }
@@ -645,6 +760,7 @@ class EpollEngine : public EngineBase {
     fut.wait();
   }
 
+  const bool inline_io_;
   std::vector<std::unique_ptr<Loop>> loops_;
   std::atomic<uint64_t> next_loop_{0};
   IdMap<CommHandle> send_comms_;

@@ -236,3 +236,57 @@ def test_double_close_rejected():
         listen.close()
         with pytest.raises(_native.NativeError):
             listen.close()
+
+
+def _epoll_inline_receiver(conn, inline: str) -> None:
+    os.environ["TPUNET_IMPLEMENT"] = "EPOLL"
+    os.environ["TPUNET_EPOLL_INLINE"] = inline
+    from tpunet.transport import Net
+
+    net = Net()
+    listen = net.listen(0)
+    conn.send(listen.handle)
+    rc = listen.accept()
+    ok = True
+    for i, size in enumerate([0, 8, 4096, 1 << 20, (1 << 22) + 5]):
+        buf = np.zeros(size + 32, dtype=np.uint8)
+        got = rc.recv(buf, timeout=60)
+        expect = _pattern(size, seed=4000 + i)
+        if got != size or not np.array_equal(buf[:size], expect):
+            ok = False
+            break
+    conn.send("OK" if ok else "CORRUPT")
+    rc.close()
+    listen.close()
+    net.close()
+
+
+@pytest.mark.parametrize("inline", ["0", "1"])
+def test_epoll_inline_on_and_off(inline, monkeypatch):
+    """The EPOLL inline fast path AND its escape hatch
+    (TPUNET_EPOLL_INLINE=0, the pure event-loop path) both move a size
+    sweep correctly — inline-off is the documented fallback for inline
+    bugs, so it gets CI coverage too."""
+    ctx = mp.get_context("spawn")
+    parent, child = ctx.Pipe()
+    proc = ctx.Process(target=_epoll_inline_receiver, args=(child, inline))
+    proc.start()
+    try:
+        handle = parent.recv()
+        monkeypatch.setenv("TPUNET_IMPLEMENT", "EPOLL")
+        monkeypatch.setenv("TPUNET_EPOLL_INLINE", inline)
+        from tpunet.transport import Net
+
+        net = Net()
+        sc = net.connect(handle)
+        for i, size in enumerate([0, 8, 4096, 1 << 20, (1 << 22) + 5]):
+            assert sc.send(_pattern(size, seed=4000 + i), timeout=60) == size
+        assert parent.recv() == "OK"
+        sc.close()
+        net.close()
+    finally:
+        proc.join(timeout=30)
+        if proc.is_alive():
+            proc.kill()
+            pytest.fail("receiver process hung")
+    assert proc.exitcode == 0
