@@ -30,7 +30,7 @@ import sys
 import time
 
 
-def _peer(rank: int, q, engine: str, nstreams: int,
+def _peer(rank: int, conn, q, engine: str, nstreams: int,
           sizes: list, iters: int) -> None:
     try:
         os.environ["TPUNET_IMPLEMENT"] = engine
@@ -40,29 +40,13 @@ def _peer(rank: int, q, engine: str, nstreams: int,
         from tpunet.transport import Net
 
         net = Net()
-        # Rank 0 listens and ships the handle via the bootstrap queue; the
-        # queue is only used for rendezvous, never timing.
-        if rank == 0:
-            listen = net.listen(0)
-            q.put(("handle", bytes(listen.handle)))
-            rc = listen.accept()
-            # Accept side also connects back for the return path.
-            while True:
-                item = q.get(timeout=60)
-                if item[0] == "handle2":
-                    sc = net.connect(item[1])
-                    break
-                q.put(item)
-        else:
-            while True:
-                item = q.get(timeout=60)
-                if item[0] == "handle":
-                    sc = net.connect(item[1])
-                    break
-                q.put(item)
-            listen = net.listen(0)
-            q.put(("handle2", bytes(listen.handle)))
-            rc = listen.accept()
+        # Rendezvous over this peer's dedicated pipe (parent relays the
+        # handles); the queue carries results only — never timing, never
+        # rendezvous (tests/test_transport.py pattern).
+        listen = net.listen(0)
+        conn.send(bytes(listen.handle))
+        sc = net.connect(conn.recv())
+        rc = listen.accept()
 
         out = {}
         for size in sizes:
@@ -102,24 +86,27 @@ def run_engine(engine: str, nstreams: int, sizes: list, iters: int) -> dict:
 
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
-    procs = [ctx.Process(target=_peer, args=(r, q, engine, nstreams,
-                                             sizes, iters))
+    pipes = [ctx.Pipe() for _ in range(2)]
+    procs = [ctx.Process(target=_peer, args=(r, pipes[r][1], q, engine,
+                                             nstreams, sizes, iters))
              for r in range(2)]
     for p in procs:
         p.start()
     results = {}
     try:
+        # Relay each peer's listen handle to the other (dedicated pipes;
+        # the queue is results-only).
+        h0 = pipes[0][0].recv()
+        h1 = pipes[1][0].recv()
+        pipes[0][0].send(h1)
+        pipes[1][0].send(h0)
         deadline = time.time() + 600
         while len(results) < 2 and time.time() < deadline:
             try:
                 tag, payload = q.get(timeout=max(1, deadline - time.time()))
             except queue_mod.Empty:
                 break
-            if tag.startswith("result"):
-                results[tag] = payload
-            else:
-                q.put((tag, payload))
-                time.sleep(0.01)
+            results[tag] = payload
     finally:
         for p in procs:
             p.join(timeout=30)

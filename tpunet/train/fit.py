@@ -82,7 +82,12 @@ def fit(
     try:
         if mgr is not None:
             restored = mgr.restore_latest(state)
-            if restored is not None:
+            # Adopt the checkpoint only when it is AHEAD of the caller's
+            # state: a caller that already restored a newer state from
+            # elsewhere (e.g. elastic recovery choosing the most advanced
+            # member checkpoint) must not be silently rolled back by an
+            # older local checkpoint. Explicit rollback = restore manually.
+            if restored is not None and int(restored.step) > int(state.step):
                 state = restored
 
         log = log_fn or (lambda m: print(
