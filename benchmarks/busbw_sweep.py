@@ -10,6 +10,8 @@ Modes:
   --op allreduce      ring AllReduce        (busbw = algbw * 2(W-1)/W)
   --op allgather      ring AllGather        (busbw = algbw * (W-1)/W)
   --op reducescatter  ring ReduceScatter    (busbw = algbw * (W-1)/W)
+  --op alltoall       AllToAll (TPUNET_A2A=pairwise|ring picks the impl;
+                      busbw = algbw * (W-1)/W, alltoall_perf convention)
 
 Launching:
   Local loopback (spawns -n worker processes itself):
@@ -52,7 +54,9 @@ def sweep_sizes(begin: int, end: int, factor: int) -> list[int]:
 def _busbw_factor(op: str, world: int) -> float:
     if op in ("allreduce", "psum"):  # psum = the jit(dcn_psum) sweep
         return 2.0 * (world - 1) / world
-    if op in ("allgather", "reducescatter"):
+    if op in ("allgather", "reducescatter", "alltoall"):
+        # alltoall: each rank ships (W-1)/W of its S bytes off-node
+        # (nccl-tests alltoall_perf convention).
         return float(world - 1) / world
     return 1.0  # p2p
 
@@ -73,6 +77,16 @@ def _run_collective_rank(rank, world, coordinator, args, emit):
             shard = np.full(max(count // world, 1), float(rank + 1), np.float32)
             count = shard.size * world
             run = lambda: comm.all_gather(shard)
+        elif args.op == "alltoall":
+            # Per-(source, block) values so the provenance assert catches
+            # block-slot permutation bugs, not just wrong-source ones.
+            blocks = np.stack([
+                np.full(max(count // world, 1), float(rank * world + j),
+                        np.float32)
+                for j in range(world)
+            ])
+            count = blocks.size
+            run = lambda: comm.all_to_all(blocks)
         elif args.op == "reducescatter":
             big = np.full(max(count // world, 1) * world, float(rank + 1), np.float32)
             count = big.size
@@ -92,6 +106,11 @@ def _run_collective_rank(rank, world, coordinator, args, emit):
         if args.op == "allreduce":
             expect = sum(r + 1 for r in range(world))
             assert out[0] == expect, f"bad allreduce result {out[0]} != {expect}"
+        elif args.op == "alltoall":
+            for j in range(world):  # block j = source j's block FOR this rank
+                expect = float(j * world + rank)
+                assert out[j][0] == expect, \
+                    f"bad alltoall block {j} at rank {rank}: {out[j][0]} != {expect}"
         rows.append((count * 4, count, dt))
     comm.close()
     if rank == 0:
@@ -198,7 +217,8 @@ def _worker(rank, world, port, q, args):
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--op", default="allreduce",
-                    choices=["p2p", "allreduce", "allgather", "reducescatter"])
+                    choices=["p2p", "allreduce", "allgather", "reducescatter",
+                             "alltoall"])
     ap.add_argument("-b", "--begin", type=parse_size, default=8)
     ap.add_argument("-e", "--end", type=parse_size, default=128 << 20)
     ap.add_argument("-f", "--factor", type=int, default=2)

@@ -37,34 +37,31 @@ def _worker(rank, world, port, q, args):
         net = Net()
         listen = net.listen()
         handles = boot.all_gather(np.frombuffer(listen.handle, np.uint8))
-        peer = bytes(handles[1 - rank].tobytes())
-        if rank == 0:
-            send = net.connect(peer)
-            boot.barrier()
-            recv = listen.accept()
-        else:
-            boot.barrier()
-            recv = listen.accept()
-            send = net.connect(peer)
+        # Ring topology (world=2 degenerates to the classic pair): every
+        # rank SENDS to (rank+1)%W and receives from (rank-1)%W, so at
+        # W>2 all ranks stripe concurrently — fairness under contention,
+        # not just on a quiet box.
+        peer = bytes(handles[(rank + 1) % world].tobytes())
+        send = net.connect(peer)
+        boot.barrier()
+        recv = listen.accept()
 
         buf = np.ones(args.size, np.uint8)
         out = np.empty(args.size, np.uint8)
-        if rank == 0:
-            pending = []
-            for _ in range(args.messages):
-                pending.append(send.isend(buf))
-                if len(pending) >= 8:
-                    pending.pop(0).wait()
-            for r in pending:
-                r.wait()
-        else:
-            for _ in range(args.messages):
-                recv.irecv(out).wait()
+        pending = []
+        for _ in range(args.messages):
+            pending.append(send.isend(buf))
+            if len(pending) >= 8:
+                pending.pop(0).wait()
+            # Interleave one recv per send so no ring neighbor stalls on a
+            # full socket buffer.
+            recv.irecv(out).wait()
+        for r in pending:
+            r.wait()
         boot.barrier()
 
-        counter = "tpunet_stream_tx_bytes" if rank == 0 else "tpunet_stream_rx_bytes"
         per_stream = {}
-        for labels, value in metrics().get(counter, {}).items():
+        for labels, value in metrics().get("tpunet_stream_tx_bytes", {}).items():
             stream = next(
                 (l.split("=")[1].strip('"') for l in labels if l.startswith("stream=")),
                 None,
@@ -72,7 +69,7 @@ def _worker(rank, world, port, q, args):
             if stream is not None:
                 per_stream[int(stream)] = int(value)
         if not per_stream:
-            raise RuntimeError(f"no {counter} samples in telemetry output")
+            raise RuntimeError("no tpunet_stream_tx_bytes samples in telemetry")
         send.close(); recv.close(); listen.close(); net.close(); boot.close()
         q.put((rank, ("OK", per_stream)))
     except Exception as e:  # noqa: BLE001
@@ -91,25 +88,30 @@ def main(argv=None):
     ap.add_argument("--nstreams", type=int, default=4)
     ap.add_argument("--messages", type=int, default=2000)
     ap.add_argument("--size", type=int, default=8192, help="bytes per message")
+    ap.add_argument("-n", "--world", type=int, default=2,
+                    help="ring size; >2 = all ranks stripe concurrently")
     args = ap.parse_args(argv)
 
     from benchmarks import check_rank_results, spawn_ranks
 
     results = check_rank_results(
-        spawn_ranks(_worker, 2, extra_args=(args,), timeout=1800)
+        spawn_ranks(_worker, args.world, extra_args=(args,), timeout=1800)
     )
-    tx = results[0]
-    counts = [tx.get(i, 0) for i in range(args.nstreams)]
-    j = jain(counts)
-    total = sum(counts)
-    print(f"# tpunet stream fairness  nstreams={args.nstreams} "
-          f"messages={args.messages} size={args.size}B (single-chunk)")
-    for i, c in enumerate(counts):
-        pct = 100.0 * c / total if total else 0.0
-        print(f"  stream {i}: {c:>12} B  {pct:5.1f}%")
-    print(f"  Jain fairness index: {j:.4f}  (1.0 = perfectly fair, "
-          f"{1.0 / args.nstreams:.2f} = one stream hogs)")
-    return j
+    print(f"# tpunet stream fairness  world={args.world} "
+          f"nstreams={args.nstreams} messages={args.messages} "
+          f"size={args.size}B (single-chunk)")
+    worst = 1.0
+    for rank in sorted(results):
+        counts = [results[rank].get(i, 0) for i in range(args.nstreams)]
+        j = jain(counts)
+        worst = min(worst, j)
+        total = sum(counts)
+        pcts = " ".join(f"{100.0 * c / total if total else 0.0:5.1f}%"
+                        for c in counts)
+        print(f"  rank {rank} tx: {pcts}  Jain {j:.4f}")
+    print(f"  worst-rank Jain fairness index: {worst:.4f}  (1.0 = perfectly "
+          f"fair, {1.0 / args.nstreams:.2f} = one stream hogs)")
+    return worst
 
 
 if __name__ == "__main__":
