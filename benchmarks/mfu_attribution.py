@@ -298,6 +298,12 @@ def run_block_sweep(cfg: dict, blocks: list[int], warmup: int,
         for bk in blocks:
             if bq > cfg["seq"] or bk > cfg["seq"]:
                 continue
+            # Untileable pairs silently fall back to the reference einsum
+            # inside flash_attention — timing that would crown a fake
+            # "best". Same rule the model-level knob enforces.
+            if cfg["seq"] % bq or cfg["seq"] % bk or bq % bk:
+                grid[f"bq{bq}_bk{bk}"] = {"skipped": "untileable (causal)"}
+                continue
             segs = segments(cfg, block_q=bq, block_k=bk)
             _, fwdbwd, _, _ = segs["attn"]
             try:

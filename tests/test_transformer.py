@@ -192,3 +192,35 @@ def test_train_step_loss_decreases(n_experts):
         losses.append(float(loss))
     assert losses[-1] < losses[0]
     assert all(np.isfinite(l) for l in losses)
+
+
+def test_flash_block_size_plumbing():
+    """Non-default flash tile sizes thread Transformer -> Block ->
+    SelfAttention -> flash_attention and keep parity with the reference
+    path (the knob exists so an on-chip block sweep can be APPLIED —
+    256 is Mosaic-legal on compiled TPU, unlike sub-128 tiles)."""
+    kw = dict(vocab=64, d_model=128, n_layers=1, n_heads=2, d_ff=128,
+              compute_dtype=jnp.bfloat16)
+    m = Transformer(attn_impl="flash", flash_block_q=256, flash_block_k=256,
+                    **kw)
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, (1, 256)), jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), toks)
+    ref = Transformer(attn_impl="reference", **kw)
+    a, b = m.apply(params, toks), ref.apply(params, toks)
+    err = float(jnp.max(jnp.abs(a - b)) / jnp.maximum(jnp.max(jnp.abs(b)), 1.0))
+    assert err < 0.03, f"flash block_q/k=256 parity {err}"
+
+
+def test_flash_block_size_validation():
+    """Explicit tile sizes that would be silently ignored (untileable ->
+    reference fallback; non-lane-aligned -> Mosaic clamp) fail loud."""
+    kw = dict(vocab=64, d_model=128, n_layers=1, n_heads=2, d_ff=128,
+              attn_impl="flash", compute_dtype=jnp.bfloat16)
+    toks = jnp.zeros((1, 256), jnp.int32)
+    with pytest.raises(ValueError, match="reference path"):
+        Transformer(flash_block_q=128, flash_block_k=256, **kw).init(
+            jax.random.PRNGKey(0), toks)  # bq % bk != 0
+    with pytest.raises(ValueError, match="Mosaic-legal"):
+        Transformer(flash_block_q=64, flash_block_k=64, **kw).init(
+            jax.random.PRNGKey(0), toks)  # bq not a multiple of 128

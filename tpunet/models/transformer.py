@@ -104,6 +104,12 @@ class SelfAttention(nn.Module):
     n_kv_heads: int | None = None
     decode: bool = False
     attn_window: int | None = None  # sliding-window causal (flash/reference)
+    # Flash kernel tile sizes (attn_impl="flash" only). 128 matches the MXU/
+    # lane width and is the measured round-3 default; expose them so an
+    # on-chip block sweep (benchmarks.mfu_attribution --sweep-blocks) can be
+    # applied to the model without editing kernel code.
+    flash_block_q: int = 128
+    flash_block_k: int = 128
 
     @nn.compact
     def __call__(self, x):
@@ -112,6 +118,29 @@ class SelfAttention(nn.Module):
         kv = self.n_kv_heads or h
         if h % kv:
             raise ValueError(f"n_heads {h} not divisible by n_kv_heads {kv}")
+        if (self.attn_impl == "flash"
+                and (self.flash_block_q, self.flash_block_k) != (128, 128)):
+            # Explicit (non-default) tile sizes must actually be honored:
+            # flash_attention silently falls back to the O(S^2) reference
+            # einsum for untileable shapes, and compiled Mosaic silently
+            # clamps non-lane-aligned block_q to 128 — either would make a
+            # swept "faster" block size a fiction. Fail loud instead.
+            bq, bk = self.flash_block_q, self.flash_block_k
+            if s % bq or s % bk or bq % bk:
+                raise ValueError(
+                    f"flash_block_q/k=({bq},{bk}) do not tile seq {s} under "
+                    "the causal kernel (need s%bq==0, s%bk==0, bq%bk==0) — "
+                    "flash_attention would silently take the reference path"
+                )
+            min_sublane = 32 // jnp.dtype(self.compute_dtype).itemsize
+            if (bq % 128 and bq != s) or (bk % min_sublane and bk != s):
+                raise ValueError(
+                    f"flash_block_q/k=({bq},{bk}) are not Mosaic-legal for "
+                    f"{jnp.dtype(self.compute_dtype).name} on compiled TPU "
+                    f"(block_q: multiple of 128 or full seq; block_k: "
+                    f"multiple of {min_sublane}) — the kernel would silently "
+                    "clamp them"
+                )
         if self.attn_window is not None and self.attn_impl not in (
             "reference", "flash"
         ):
@@ -253,7 +282,9 @@ class SelfAttention(nn.Module):
 
             o = dcn_ulysses_attention(q, k, v, causal=True)
         elif self.attn_impl == "flash":
-            o = flash_attention(q, k, v, True, window=self.attn_window)
+            o = flash_attention(q, k, v, True, block_q=self.flash_block_q,
+                                block_k=self.flash_block_k,
+                                window=self.attn_window)
         else:
             o = attention_reference(q, k, v, True, window=self.attn_window)
 
@@ -355,6 +386,8 @@ class Block(nn.Module):
     mlp_impl: str = "gelu"
     decode: bool = False
     attn_window: int | None = None
+    flash_block_q: int = 128
+    flash_block_k: int = 128
 
     @nn.compact
     def __call__(self, x):
@@ -362,7 +395,9 @@ class Block(nn.Module):
             self.n_heads, self.head_dim, self.compute_dtype, self.attn_impl,
             self.mesh, self.dp_axis, self.sp_axis, self.tp_axis,
             n_kv_heads=self.n_kv_heads, decode=self.decode,
-            attn_window=self.attn_window, name="attn",
+            attn_window=self.attn_window,
+            flash_block_q=self.flash_block_q,
+            flash_block_k=self.flash_block_k, name="attn",
         )(RMSNorm(name="norm1")(x))
         if self.n_experts > 0:
             mlp = MoeMlp(self.n_experts, self.d_ff, self.capacity_factor,
@@ -399,6 +434,8 @@ class Transformer(nn.Module):
     attn_window: int | None = None  # sliding-window causal attention (Mistral
     #   -style): each token sees the window most recent positions; flash
     #   kernels prune to O(S*window) FLOPs. reference/flash impls only.
+    flash_block_q: int = 128       # flash kernel tile sizes; sweep with
+    flash_block_k: int = 128       #   benchmarks.mfu_attribution --sweep-blocks
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, features_only: bool = False):
@@ -440,7 +477,9 @@ class Transformer(nn.Module):
                 mesh=self.mesh, dp_axis=self.dp_axis, sp_axis=self.sp_axis,
                 tp_axis=self.tp_axis, n_kv_heads=self.n_kv_heads,
                 mlp_impl=self.mlp_impl, decode=self.decode,
-                attn_window=self.attn_window, name=f"block{i}",
+                attn_window=self.attn_window,
+                flash_block_q=self.flash_block_q,
+                flash_block_k=self.flash_block_k, name=f"block{i}",
             )(x)
         x = RMSNorm(name="norm_f")(x)
         if features_only:
