@@ -111,19 +111,10 @@ def _tpu_alive(timeout_s: int = 90) -> bool:
 def _run_json_tool(argv: list[str], timeout_s: int) -> tuple[dict | None, str]:
     """Run a benchmark subprocess that prints one JSON line; returns
     (parsed dict, "") or (None, error description)."""
-    try:
-        p = subprocess.run(
-            [sys.executable] + argv,
-            capture_output=True, text=True, timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired:
-        return None, f"timed out after {timeout_s}s"
-    if p.returncode == 0 and p.stdout.strip():
-        try:
-            return json.loads(p.stdout.strip().splitlines()[-1]), ""
-        except json.JSONDecodeError:
-            pass
-    return None, p.stderr[-500:]
+    from benchmarks import run_json_lines
+
+    rows, err = run_json_lines(argv, timeout_s)
+    return (rows[-1], "") if rows else (None, err)
 
 
 def _kernel_smoke(tpu_up: bool) -> dict | None:
@@ -254,37 +245,57 @@ def main() -> None:
     model_tier = _model_tier(tpu_up, kernels)
     if model_tier is not None:
         print(f"[bench] model tier: {model_tier}", file=sys.stderr)
+    # Inference tier: one on-chip decode number (GQA, the KV-cache
+    # capability's headline config). The full decode/attribution set is
+    # benchmarks.chip_session's job; bench carries one live datapoint.
+    decode = None
+    if tpu_up and (model_tier or {}).get("platform") == "tpu":
+        decode, err = _run_json_tool(
+            ["-m", "benchmarks.decode_bench", "--platform", "tpu",
+             "--d", "2048", "--layers", "12", "--heads", "16", "--ff", "8192",
+             "--batch", "8", "--prompt", "512", "--new", "128",
+             "--kv-heads", "4"], 1500)
+        if decode is None:
+            print(f"[bench] decode tier failed: {err}", file=sys.stderr)
+        elif decode.get("platform") != "tpu":
+            # Tunnel dropped between tiers: decode_bench silently fell back
+            # to CPU — a CPU number must not pose as the on-chip datapoint.
+            print(f"[bench] decode tier ran on {decode.get('platform')}, "
+                  "not tpu; dropping it", file=sys.stderr)
+            decode = None
+        else:
+            print(f"[bench] decode tier: {decode}", file=sys.stderr)
 
-    # The axon tunnel flaps for hours at a time. When it is down at bench
-    # time, attach the round's committed real-chip measurement with explicit
-    # provenance (its own timestamp + config + note) — clearly labeled
-    # replay, never merged into the live fields — so a flap does not erase
-    # the hardware validation this round's code actually has.
+    # The committed real-chip measurement (benchmarks.chip_session output)
+    # is attached UNCONDITIONALLY with explicit provenance and a mechanical
+    # staleness stamp — when the tunnel is down it is the round's hardware
+    # story; when live numbers exist it adds the depth (decode set,
+    # per-segment attribution, block sweeps) a single bench run doesn't
+    # re-measure. Clearly labeled, never merged into the live fields.
     tpu_last_measured = None
-    if not tpu_up or (model_tier or {}).get("platform") != "tpu":
-        try:
-            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                   "benchmarks", "tpu_measured.json")) as f:
-                loaded = json.load(f)
-            if isinstance(loaded, dict):
-                tpu_last_measured = loaded
-                staleness = _measurement_staleness(
-                    loaded.get("measured_commit"))
-                tpu_last_measured["staleness"] = staleness
-                stale_note = (
-                    "STALE — measured paths changed since: "
-                    + ", ".join(staleness.get("changed_files", [])
-                                + staleness.get("uncommitted_files", []))
-                    if staleness.get("stale")
-                    else "fresh (measured paths unchanged at HEAD)"
-                    if staleness.get("stale") is False
-                    else f"staleness unknown: {staleness.get('error')}")
-                print("[bench] TPU tier unavailable now; attaching committed "
-                      f"measurement from {loaded.get('measured_at')} "
-                      f"(commit {loaded.get('measured_commit')}; "
-                      f"{stale_note})", file=sys.stderr)
-        except (OSError, ValueError):
-            pass
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "benchmarks", "tpu_measured.json")) as f:
+            loaded = json.load(f)
+        if isinstance(loaded, dict):
+            tpu_last_measured = loaded
+            staleness = _measurement_staleness(
+                loaded.get("measured_commit"))
+            tpu_last_measured["staleness"] = staleness
+            stale_note = (
+                "STALE — measured paths changed since: "
+                + ", ".join(staleness.get("changed_files", [])
+                            + staleness.get("uncommitted_files", []))
+                if staleness.get("stale")
+                else "fresh (measured paths unchanged at HEAD)"
+                if staleness.get("stale") is False
+                else f"staleness unknown: {staleness.get('error')}")
+            print("[bench] attaching committed chip measurement from "
+                  f"{loaded.get('measured_at')} "
+                  f"(commit {loaded.get('measured_commit')}; "
+                  f"{stale_note})", file=sys.stderr)
+    except (OSError, ValueError):
+        pass
     print(
         json.dumps(
             {
@@ -297,6 +308,7 @@ def main() -> None:
                 "analysis": "PERF_NOTES.md",
                 "kernels": kernels,
                 "model_tier": model_tier,
+                **({"decode": decode} if decode else {}),
                 **({"tpu_last_measured": tpu_last_measured}
                    if tpu_last_measured else {}),
             }

@@ -37,6 +37,38 @@ def chained_step_time(step_fn, state, args, warmup: int, iters: int) -> float:
     return dt
 
 
+def run_json_lines(argv: list, timeout_s: float,
+                   cwd: str | None = None) -> tuple[list, str]:
+    """Run `python <argv...>` and parse every JSON-object line it printed.
+
+    Returns (rows, "") on success or ([], error-tail) when the tool timed
+    out, exited nonzero, or printed no JSON. Shared by bench.py (one-line
+    tools) and benchmarks.chip_session (mfu_sweep prints one line per
+    config) so the subprocess/timeout/parse contract cannot drift.
+    """
+    import json
+    import subprocess
+    import sys
+
+    try:
+        p = subprocess.run([sys.executable] + list(argv), capture_output=True,
+                           text=True, timeout=timeout_s, cwd=cwd)
+    except subprocess.TimeoutExpired:
+        return [], f"timed out after {timeout_s}s"
+    rows = []
+    if p.returncode == 0 and p.stdout.strip():
+        for line in p.stdout.strip().splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    rows.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    if not rows:
+        return [], (p.stderr or "no JSON output")[-500:]
+    return rows, ""
+
+
 def reassert_jax_platform(platform: str | None = None) -> None:
     """Make JAX_PLATFORMS actually win: an axon-style sitecustomize pins
     jax_platforms via jax.config at interpreter start, so the env var alone

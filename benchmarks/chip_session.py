@@ -1,0 +1,224 @@
+"""One-command TPU measurement session — run the moment the tunnel is up.
+
+The axon tunnel flaps for hours; when it comes back it may not stay long.
+This driver runs every on-chip measurement the round needs, IN PRIORITY
+ORDER, each in its own killable subprocess with a hard timeout, and
+persists results INCREMENTALLY — after every step it rewrites
+``benchmarks/tpu_measured.json`` (the file bench.py replays when the
+tunnel is down) with everything captured so far, stamped with the current
+HEAD commit. A tunnel death mid-session therefore keeps all completed
+measurements; re-running resumes the full list.
+
+Priority order (round-4 verdict):
+  1. kernel_smoke        — all flash kernel variants on real Mosaic (gate)
+  2. tpu_headline        — tokens/s + MFU + VGG img/s at the headline shape
+  3. decode_bench x3     — MHA, GQA (kv4), sliding-window decode tokens/s
+  4. mfu_attribution     — per-segment breakdown of the headline step
+  5. block sweep s2048   — flash tile grid at the headline seq
+  6. block sweep s8192   — flash tile grid at long context
+  7. mfu_sweep 5         — long-context s8192 MFU (fused-xent config)
+  8. mfu_sweep 7         — remat_policy="dots" A/B at the headline shape
+
+Usage: python -m benchmarks.chip_session [--only 1 2 3] [--skip-probe]
+Writes benchmarks/tpu_measured.json + benchmarks/chip_session_raw.json.
+Prints one summary JSON line at the end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MEASURED = os.path.join(REPO, "benchmarks", "tpu_measured.json")
+RAW = os.path.join(REPO, "benchmarks", "chip_session_raw.json")
+
+
+def _run_json(argv: list[str], timeout_s: int) -> tuple[dict | None, str]:
+    """Collect every JSON line the tool printed (shared helper): single-line
+    tools return that object; multi-line tools (mfu_sweep prints one line
+    per config) return {"rows": [...]}."""
+    from benchmarks import run_json_lines
+
+    rows, err = run_json_lines(argv, timeout_s, cwd=REPO)
+    if not rows:
+        return None, err
+    return (rows[0] if len(rows) == 1 else {"rows": rows}), ""
+
+
+def _head_commit() -> str:
+    p = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                       capture_output=True, text=True, cwd=REPO)
+    return p.stdout.strip() or "unknown"
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+
+
+# (key, argv, timeout_s) — priority order. Generous timeouts: first compile
+# of the chip-sized model takes minutes over the tunnel.
+STEPS: list[tuple[str, list[str], int]] = [
+    ("kernels", ["-m", "benchmarks.kernel_smoke"], 900),
+    ("headline", ["-m", "benchmarks.tpu_headline", "--platform", "tpu"], 2400),
+    ("decode_mha", ["-m", "benchmarks.decode_bench", "--platform", "tpu",
+                    "--d", "2048", "--layers", "12", "--heads", "16",
+                    "--ff", "8192", "--batch", "8", "--prompt", "512",
+                    "--new", "256"], 1800),
+    ("decode_gqa", ["-m", "benchmarks.decode_bench", "--platform", "tpu",
+                    "--d", "2048", "--layers", "12", "--heads", "16",
+                    "--ff", "8192", "--batch", "8", "--prompt", "512",
+                    "--new", "256", "--kv-heads", "4"], 1800),
+    ("decode_window", ["-m", "benchmarks.decode_bench", "--platform", "tpu",
+                       "--d", "2048", "--layers", "12", "--heads", "16",
+                       "--ff", "8192", "--batch", "8", "--prompt", "512",
+                       "--new", "256", "--window", "256"], 1800),
+    ("attribution", ["-m", "benchmarks.mfu_attribution"], 2400),
+    ("block_sweep_s2048", ["-m", "benchmarks.mfu_attribution",
+                           "--sweep-blocks", "--blocks", "128", "256", "512"],
+     1800),
+    ("block_sweep_s8192", ["-m", "benchmarks.mfu_attribution",
+                           "--sweep-blocks", "--seq", "8192", "--batch", "2",
+                           "--blocks", "128", "256", "512"], 1800),
+    ("longctx_s8192", ["-m", "benchmarks.mfu_sweep", "5"], 2400),
+    ("remat_dots_ab", ["-m", "benchmarks.mfu_sweep", "0", "7"], 2400),
+]
+
+
+def _tpu_alive(timeout_s: int = 90) -> bool:
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices()[0]; print(d.platform)"],
+            capture_output=True, text=True, timeout=timeout_s)
+        return p.returncode == 0 and p.stdout.strip() == "tpu"
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _write_measured(raw: dict) -> None:
+    """Distill the raw session results into the bench.py replay file. Only
+    fields actually measured are written — a partial session yields a
+    partial but HONEST measured file (bare commit hash, no prose claims).
+    A session with NOTHING real captured (all steps errored) writes
+    nothing, so a dead tunnel can't clobber the previous good file; the
+    first real write this session backs the old file up alongside."""
+    if not any(isinstance(v, dict) and "error" not in v for v in raw.values()):
+        return
+    if os.path.exists(MEASURED):
+        try:
+            with open(MEASURED) as f:
+                prev = json.load(f)
+            if prev.get("measured_commit") != _head_commit():
+                with open(MEASURED.replace(".json", "_prev.json"), "w") as f:
+                    json.dump(prev, f, indent=2)
+                    f.write("\n")
+        except (OSError, ValueError):
+            pass
+    out: dict = {
+        "measured_at": _now(),
+        "measured_commit": _head_commit(),
+        "platform": "tpu",
+    }
+    head = raw.get("headline") or {}
+    if head.get("platform") == "tpu":
+        out.update({
+            "device_kind": head.get("device_kind"),
+            "attn": head.get("attn"),
+            "tokens_per_s": head.get("tokens_per_s"),
+            "mfu": head.get("mfu"),
+            "vgg_img_per_s": head.get("vgg_img_per_s"),
+            "config": "d2048 L12 ff8192 h16, batch 8 x seq 2048, bf16 + "
+                      "flash + remat, donated adamw; chained timing "
+                      "(benchmarks.chained_step_time)",
+        })
+    if isinstance(raw.get("kernels"), dict) and "error" not in raw["kernels"]:
+        out["kernels"] = {k: v for k, v in raw["kernels"].items()
+                          if k != "platform"}
+        out["kernels_platform"] = raw["kernels"].get("platform")
+    decode = {}
+    for key in ("decode_mha", "decode_gqa", "decode_window"):
+        d = raw.get(key)
+        if isinstance(d, dict) and d.get("platform") == "tpu":
+            decode[key] = {k: d[k] for k in
+                           ("decode_tok_s", "wall_s", "kv_heads", "window",
+                            "batch", "prompt", "new") if k in d}
+    if decode:
+        out["decode"] = decode
+    if (isinstance(raw.get("attribution"), dict)
+            and "error" not in raw["attribution"]):
+        a = raw["attribution"]
+        out["attribution"] = {k: a.get(k) for k in
+                              ("segments", "full_step_ms", "mfu",
+                               "expected_full_ms", "residual_ms")}
+    for key in ("block_sweep_s2048", "block_sweep_s8192", "longctx_s8192",
+                "remat_dots_ab"):
+        if isinstance(raw.get(key), dict) and "error" not in raw[key]:
+            out[key] = raw[key]
+    out["note"] = ("Captured by benchmarks.chip_session while the tunnel "
+                   "was up; bench.py replays this file (with a mechanical "
+                   "staleness stamp) when the tunnel is down at bench time.")
+    tmp = MEASURED + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    os.replace(tmp, MEASURED)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--only", nargs="+", type=int,
+                    help="1-based step indices to run (default: all)")
+    ap.add_argument("--skip-probe", action="store_true")
+    args = ap.parse_args(argv)
+
+    if not args.skip_probe and not _tpu_alive():
+        print(json.dumps({"error": "TPU tunnel down — nothing measured"}))
+        raise SystemExit(1)
+
+    raw: dict = {}
+    if os.path.exists(RAW):
+        try:
+            with open(RAW) as f:
+                prev = json.load(f)
+            if prev.get("commit") == _head_commit():
+                raw = prev.get("results", {})  # resume same-commit session
+        except (OSError, ValueError):
+            pass
+
+    which = (set(args.only) if args.only
+             else set(range(1, len(STEPS) + 1)))
+    status: dict = {}
+    for i, (key, cmd, timeout_s) in enumerate(STEPS, start=1):
+        if i not in which:
+            continue
+        if key in raw and isinstance(raw[key], dict) and "error" not in raw[key]:
+            status[key] = "cached"
+            continue
+        print(f"[chip_session] {i}/{len(STEPS)} {key} ...", file=sys.stderr)
+        out, err = _run_json(cmd, timeout_s)
+        if out is None:
+            raw[key] = {"error": err}
+            status[key] = f"FAILED: {err[:120]}"
+        else:
+            raw[key] = out
+            status[key] = "ok"
+        # Persist after EVERY step: a tunnel death loses nothing captured.
+        with open(RAW + ".tmp", "w") as f:
+            json.dump({"commit": _head_commit(), "measured_at": _now(),
+                       "results": raw}, f, indent=2)
+        os.replace(RAW + ".tmp", RAW)
+        _write_measured(raw)
+        print(f"[chip_session]   {key}: {status[key]}", file=sys.stderr)
+
+    print(json.dumps({"commit": _head_commit(), "status": status,
+                      "measured_file": MEASURED}))
+
+
+if __name__ == "__main__":
+    main()

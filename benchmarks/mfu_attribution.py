@@ -299,10 +299,18 @@ def run_block_sweep(cfg: dict, blocks: list[int], warmup: int,
             if bq > cfg["seq"] or bk > cfg["seq"]:
                 continue
             # Untileable pairs silently fall back to the reference einsum
-            # inside flash_attention — timing that would crown a fake
-            # "best". Same rule the model-level knob enforces.
+            # inside flash_attention, and compiled Mosaic silently clamps
+            # non-lane-aligned blocks (_normalize_blocks) — timing either
+            # would crown a fake "best". Both rules the model-level knob
+            # enforces (transformer.py SelfAttention validation).
             if cfg["seq"] % bq or cfg["seq"] % bk or bq % bk:
                 grid[f"bq{bq}_bk{bk}"] = {"skipped": "untileable (causal)"}
+                continue
+            min_sublane = 16 if cfg["bf16"] else 8
+            if ((bq % 128 and bq != cfg["seq"])
+                    or (bk % min_sublane and bk != cfg["seq"])):
+                grid[f"bq{bq}_bk{bk}"] = {
+                    "skipped": "not Mosaic-legal (would be clamped)"}
                 continue
             segs = segments(cfg, block_q=bq, block_k=bk)
             _, fwdbwd, _, _ = segs["attn"]

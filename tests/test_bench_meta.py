@@ -274,3 +274,44 @@ def test_kernel_smoke_window_entries_cpu():
     for k in ("flash_fwd", "flash_bwd", "flash_gqa_fwd", "flash_gqa_bwd",
               "flash_window_fwd", "flash_window_bwd"):
         assert out[k] == "ok", f"{k}: {out[k]}"
+
+
+def test_chip_session_measured_distillation(tmp_path, monkeypatch):
+    import json
+
+    from benchmarks import chip_session as cs
+
+    measured = tmp_path / "tpu_measured.json"
+    monkeypatch.setattr(cs, "MEASURED", str(measured))
+
+    # All-error session must write NOTHING (a dead tunnel cannot clobber
+    # the previous good measurement).
+    cs._write_measured({"kernels": {"error": "tunnel died"}})
+    assert not measured.exists()
+
+    # A real partial session writes the fields it has, bare commit hash.
+    raw = {
+        "kernels": {"platform": "tpu", "flash_fwd": "ok", "flash_bwd": "ok",
+                    "flash_window_fwd": "ok"},
+        "headline": {"platform": "tpu", "device_kind": "TPU v5 lite",
+                     "attn": "flash", "tokens_per_s": 17000.0, "mfu": 0.41,
+                     "vgg_img_per_s": 950.0},
+        "decode_gqa": {"platform": "tpu", "decode_tok_s": 1234.5,
+                       "wall_s": 1.2, "kv_heads": 4, "window": None,
+                       "batch": 8, "prompt": 512, "new": 256},
+        "block_sweep_s2048": {"error": "timed out after 1800s"},
+    }
+    cs._write_measured(raw)
+    out = json.loads(measured.read_text())
+    assert out["tokens_per_s"] == 17000.0
+    assert out["kernels"]["flash_window_fwd"] == "ok"
+    assert out["decode"]["decode_gqa"]["decode_tok_s"] == 1234.5
+    assert "block_sweep_s2048" not in out  # errored steps are not measured
+    assert " " not in out["measured_commit"]  # bare hash, no prose
+
+    # Overwrite at a different commit backs the old file up first.
+    prev_commit = out["measured_commit"]
+    monkeypatch.setattr(cs, "_head_commit", lambda: "fffffff")
+    cs._write_measured(raw)
+    backup = json.loads((tmp_path / "tpu_measured_prev.json").read_text())
+    assert backup["measured_commit"] == prev_commit

@@ -224,3 +224,20 @@ def test_flash_block_size_validation():
     with pytest.raises(ValueError, match="Mosaic-legal"):
         Transformer(flash_block_q=64, flash_block_k=64, **kw).init(
             jax.random.PRNGKey(0), toks)  # bq not a multiple of 128
+
+
+def test_flash_block_size_decode_exempt():
+    """decode=True never routes cached steps through the flash kernel, so
+    swept tile sizes must not break generation (s=1 steps and arbitrary
+    prompt lengths are legal there)."""
+    from tpunet.models import generate
+
+    m = Transformer(vocab=64, d_model=64, n_layers=1, n_heads=2, d_ff=64,
+                    attn_impl="flash", flash_block_q=256, flash_block_k=256,
+                    compute_dtype=jnp.float32)
+    # Params come from a tileable training-shape init (real usage: train at
+    # the swept seq, then decode arbitrary prompts).
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 256), jnp.int32))["params"]
+    prompt = jnp.zeros((1, 5), jnp.int32)  # length 5: untileable on purpose
+    out = generate(m, params, prompt, 3)
+    assert out.shape == (1, 8)

@@ -118,13 +118,16 @@ class SelfAttention(nn.Module):
         kv = self.n_kv_heads or h
         if h % kv:
             raise ValueError(f"n_heads {h} not divisible by n_kv_heads {kv}")
-        if (self.attn_impl == "flash"
+        if (self.attn_impl == "flash" and not self.decode
                 and (self.flash_block_q, self.flash_block_k) != (128, 128)):
             # Explicit (non-default) tile sizes must actually be honored:
             # flash_attention silently falls back to the O(S^2) reference
             # einsum for untileable shapes, and compiled Mosaic silently
             # clamps non-lane-aligned block_q to 128 — either would make a
             # swept "faster" block size a fiction. Fail loud instead.
+            # decode=True is exempt: cached steps never reach the flash
+            # kernel (dense-einsum branch below) and prefill prompts have
+            # arbitrary lengths, where the reference fallback is the point.
             bq, bk = self.flash_block_q, self.flash_block_k
             if s % bq or s % bk or bq % bk:
                 raise ValueError(
