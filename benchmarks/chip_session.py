@@ -30,6 +30,7 @@ import argparse
 import datetime
 import json
 import os
+import re
 import subprocess
 import sys
 
@@ -90,6 +91,17 @@ STEPS: list[tuple[str, list[str], int]] = [
 ]
 
 
+def _persist(raw: dict) -> None:
+    """Atomically write the resume log AND refresh the distilled measured
+    file — the one persistence path both the step loop and the tuned pass
+    use."""
+    with open(RAW + ".tmp", "w") as f:
+        json.dump({"commit": _head_commit(), "measured_at": _now(),
+                   "results": raw}, f, indent=2)
+    os.replace(RAW + ".tmp", RAW)
+    _write_measured(raw)
+
+
 def _tpu_alive(timeout_s: int = 90) -> bool:
     try:
         p = subprocess.run(
@@ -141,6 +153,10 @@ def _write_measured(raw: dict) -> None:
         out["kernels"] = {k: v for k, v in raw["kernels"].items()
                           if k != "platform"}
         out["kernels_platform"] = raw["kernels"].get("platform")
+    tuned = raw.get("headline_tuned")
+    if (isinstance(tuned, dict) and "error" not in tuned
+            and tuned.get("platform") == "tpu"):
+        out["headline_tuned"] = tuned
     decode = {}
     for key in ("decode_mha", "decode_gqa", "decode_window"):
         d = raw.get(key)
@@ -209,12 +225,33 @@ def main(argv=None) -> None:
             raw[key] = out
             status[key] = "ok"
         # Persist after EVERY step: a tunnel death loses nothing captured.
-        with open(RAW + ".tmp", "w") as f:
-            json.dump({"commit": _head_commit(), "measured_at": _now(),
-                       "results": raw}, f, indent=2)
-        os.replace(RAW + ".tmp", RAW)
-        _write_measured(raw)
+        _persist(raw)
         print(f"[chip_session]   {key}: {status[key]}", file=sys.stderr)
+
+    # Apply-the-sweep pass: if the s2048 block sweep crowned a non-default
+    # tile config, re-measure the headline WITH it — the sweep exists to
+    # move the headline number, not to sit in a table. Scoped like a
+    # follow-on of the sweep step (skipped under an --only that excludes
+    # it); a previously-errored attempt is retried like any other step.
+    sweep_step = next(i for i, (k, _, _) in enumerate(STEPS, start=1)
+                      if k == "block_sweep_s2048")
+    bs = raw.get("block_sweep_s2048")
+    tuned_prev = raw.get("headline_tuned")
+    if (sweep_step in which
+            and isinstance(bs, dict) and bs.get("best")
+            and bs["best"] != "bq128_bk128"
+            and (tuned_prev is None or "error" in tuned_prev)):
+        m = re.match(r"bq(\d+)_bk(\d+)", bs["best"])
+        if m:
+            print(f"[chip_session] re-measuring headline with swept blocks "
+                  f"{bs['best']} ...", file=sys.stderr)
+            out, err = _run_json(
+                ["-m", "benchmarks.tpu_headline", "--platform", "tpu",
+                 "--block-q", m.group(1), "--block-k", m.group(2)], 2400)
+            raw["headline_tuned"] = out if out is not None else {"error": err}
+            status["headline_tuned"] = ("ok" if out is not None
+                                        else f"FAILED: {err[:120]}")
+            _persist(raw)
 
     print(json.dumps({"commit": _head_commit(), "status": status,
                       "measured_file": MEASURED}))

@@ -300,10 +300,15 @@ def test_chip_session_measured_distillation(tmp_path, monkeypatch):
                        "wall_s": 1.2, "kv_heads": 4, "window": None,
                        "batch": 8, "prompt": 512, "new": 256},
         "block_sweep_s2048": {"error": "timed out after 1800s"},
+        "headline_tuned": {"platform": "tpu", "tokens_per_s": 18000.0,
+                           "mfu": 0.43, "block_q": 256, "block_k": 256},
+        "attribution": {"error": "timed out after 2400s"},
     }
     cs._write_measured(raw)
     out = json.loads(measured.read_text())
     assert out["tokens_per_s"] == 17000.0
+    assert out["headline_tuned"]["mfu"] == 0.43
+    assert "attribution" not in out  # errored steps never leak
     assert out["kernels"]["flash_window_fwd"] == "ok"
     assert out["decode"]["decode_gqa"]["decode_tok_s"] == 1234.5
     assert "block_sweep_s2048" not in out  # errored steps are not measured
