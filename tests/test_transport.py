@@ -290,3 +290,65 @@ def test_epoll_inline_on_and_off(inline, monkeypatch):
             proc.kill()
             pytest.fail("receiver process hung")
     assert proc.exitcode == 0
+
+
+def _epoll_pipeline_receiver(conn, sizes) -> None:
+    os.environ["TPUNET_IMPLEMENT"] = "EPOLL"
+    from tpunet.transport import Net
+
+    net = Net()
+    listen = net.listen(0)
+    conn.send(listen.handle)
+    rc = listen.accept()
+    ok = True
+    for i, size in enumerate(sizes):
+        buf = np.zeros(size + 16, dtype=np.uint8)
+        got = rc.recv(buf, timeout=120)
+        expect = _pattern(size, seed=9000 + i)
+        if got != size or not np.array_equal(buf[:size], expect):
+            ok = False
+            break
+    conn.send("OK" if ok else f"CORRUPT at {i}")
+    rc.close()
+    listen.close()
+    net.close()
+
+
+def test_epoll_inline_queued_ordering_under_pipeline(monkeypatch):
+    """Hammer the inline<->queued transition: a deep pipeline of
+    random-size isends means some messages start inline (comm idle), some
+    queue behind in-flight ones, and some start inline again after a
+    drain. Ctrl-frame order MUST match post order throughout — the
+    receiver verifies every payload against its posted sequence."""
+    rng = np.random.default_rng(42)
+    sizes = [int(s) for s in rng.integers(0, 1 << 18, size=60)]
+    sizes[7] = 0  # zero-byte in the middle of the stream
+    ctx = mp.get_context("spawn")
+    parent, child = ctx.Pipe()
+    proc = ctx.Process(target=_epoll_pipeline_receiver, args=(child, sizes))
+    proc.start()
+    try:
+        handle = parent.recv()
+        monkeypatch.setenv("TPUNET_IMPLEMENT", "EPOLL")
+        from tpunet.transport import Net
+
+        net = Net()
+        sc = net.connect(handle)
+        pending = []
+        for i, size in enumerate(sizes):
+            pending.append(sc.isend(_pattern(size, seed=9000 + i)))
+            if i % 9 == 8:  # periodic drain: the NEXT send goes inline again
+                for r in pending:
+                    r.wait(timeout=120)
+                pending.clear()
+        for r in pending:
+            r.wait(timeout=120)
+        assert parent.recv() == "OK"
+        sc.close()
+        net.close()
+    finally:
+        proc.join(timeout=30)
+        if proc.is_alive():
+            proc.kill()
+            pytest.fail("receiver process hung")
+    assert proc.exitcode == 0
