@@ -238,27 +238,33 @@ def test_double_close_rejected():
             listen.close()
 
 
-def _epoll_inline_receiver(conn, inline: str) -> None:
+def _epoll_receiver(conn, sizes, seed_base: int, env: dict) -> None:
+    """Shared EPOLL-engine receiver: verify `sizes` messages against their
+    posted-order patterns under the given env (one helper for the inline
+    on/off sweep and the pipelined-ordering stress test)."""
     os.environ["TPUNET_IMPLEMENT"] = "EPOLL"
-    os.environ["TPUNET_EPOLL_INLINE"] = inline
+    os.environ.update(env)
     from tpunet.transport import Net
 
     net = Net()
     listen = net.listen(0)
     conn.send(listen.handle)
     rc = listen.accept()
-    ok = True
-    for i, size in enumerate([0, 8, 4096, 1 << 20, (1 << 22) + 5]):
+    ok = "OK"
+    for i, size in enumerate(sizes):
         buf = np.zeros(size + 32, dtype=np.uint8)
-        got = rc.recv(buf, timeout=60)
-        expect = _pattern(size, seed=4000 + i)
+        got = rc.recv(buf, timeout=120)
+        expect = _pattern(size, seed=seed_base + i)
         if got != size or not np.array_equal(buf[:size], expect):
-            ok = False
+            ok = f"CORRUPT at {i}"
             break
-    conn.send("OK" if ok else "CORRUPT")
+    conn.send(ok)
     rc.close()
     listen.close()
     net.close()
+
+
+INLINE_SWEEP_SIZES = [0, 8, 4096, 1 << 20, (1 << 22) + 5]
 
 
 @pytest.mark.parametrize("inline", ["0", "1"])
@@ -269,7 +275,10 @@ def test_epoll_inline_on_and_off(inline, monkeypatch):
     bugs, so it gets CI coverage too."""
     ctx = mp.get_context("spawn")
     parent, child = ctx.Pipe()
-    proc = ctx.Process(target=_epoll_inline_receiver, args=(child, inline))
+    proc = ctx.Process(
+        target=_epoll_receiver,
+        args=(child, INLINE_SWEEP_SIZES, 4000,
+              {"TPUNET_EPOLL_INLINE": inline}))
     proc.start()
     try:
         handle = parent.recv()
@@ -279,7 +288,7 @@ def test_epoll_inline_on_and_off(inline, monkeypatch):
 
         net = Net()
         sc = net.connect(handle)
-        for i, size in enumerate([0, 8, 4096, 1 << 20, (1 << 22) + 5]):
+        for i, size in enumerate(INLINE_SWEEP_SIZES):
             assert sc.send(_pattern(size, seed=4000 + i), timeout=60) == size
         assert parent.recv() == "OK"
         sc.close()
@@ -290,28 +299,6 @@ def test_epoll_inline_on_and_off(inline, monkeypatch):
             proc.kill()
             pytest.fail("receiver process hung")
     assert proc.exitcode == 0
-
-
-def _epoll_pipeline_receiver(conn, sizes) -> None:
-    os.environ["TPUNET_IMPLEMENT"] = "EPOLL"
-    from tpunet.transport import Net
-
-    net = Net()
-    listen = net.listen(0)
-    conn.send(listen.handle)
-    rc = listen.accept()
-    ok = True
-    for i, size in enumerate(sizes):
-        buf = np.zeros(size + 16, dtype=np.uint8)
-        got = rc.recv(buf, timeout=120)
-        expect = _pattern(size, seed=9000 + i)
-        if got != size or not np.array_equal(buf[:size], expect):
-            ok = False
-            break
-    conn.send("OK" if ok else f"CORRUPT at {i}")
-    rc.close()
-    listen.close()
-    net.close()
 
 
 def test_epoll_inline_queued_ordering_under_pipeline(monkeypatch):
@@ -325,7 +312,7 @@ def test_epoll_inline_queued_ordering_under_pipeline(monkeypatch):
     sizes[7] = 0  # zero-byte in the middle of the stream
     ctx = mp.get_context("spawn")
     parent, child = ctx.Pipe()
-    proc = ctx.Process(target=_epoll_pipeline_receiver, args=(child, sizes))
+    proc = ctx.Process(target=_epoll_receiver, args=(child, sizes, 9000, {}))
     proc.start()
     try:
         handle = parent.recv()
