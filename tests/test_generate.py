@@ -31,9 +31,15 @@ def _params(model, b=2, s=24, seed=1):
     return model.init(jax.random.PRNGKey(seed), toks)["params"], toks
 
 
-@pytest.mark.parametrize("n_kv_heads", [None, 2])
-def test_decode_cache_matches_full_forward(n_kv_heads):
-    model = _tiny(n_kv_heads=n_kv_heads)
+@pytest.mark.parametrize("n_kv_heads,attn_window", [
+    (None, None),
+    (2, None),
+    # GQA's grouped-einsum decode (the cache is contracted directly, never
+    # group-repeated in HBM) composed with the sliding-window mask.
+    (2, 6),
+])
+def test_decode_cache_matches_full_forward(n_kv_heads, attn_window):
+    model = _tiny(n_kv_heads=n_kv_heads, attn_window=attn_window)
     params, toks = _params(model)
     full = model.apply({"params": params}, toks)  # (b, s, vocab)
 

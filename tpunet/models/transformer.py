@@ -197,21 +197,28 @@ class SelfAttention(nn.Module):
                     cval.value, v, (0, idx, 0, 0)
                 )
                 cidx.value = idx + s
-                kf = jnp.repeat(ckey.value, h // kv, axis=2)
-                vf = jnp.repeat(cval.value, h // kv, axis=2)
-                # (b, s, h, cap) scores over the whole ring buffer; mask to
-                # keys at global positions <= each query's position.
+                # Grouped einsum: q reshaped to (b, s, kv, group, dh)
+                # contracts DIRECTLY against the (b, cap, kv, dh) cache —
+                # the group-repeated K/V never exists in HBM. This is the
+                # point of GQA at decode time: the cache read per step is
+                # kv/h of the MHA equivalent, and materializing a repeat
+                # would hand that bandwidth win straight back.
+                qg = q.reshape(b, s, kv, h // kv, dh).astype(jnp.float32)
+                # (b, kv, group, s, cap) scores over the whole ring buffer;
+                # mask to keys at global positions <= each query's position.
                 scores = jnp.einsum(
-                    "bqhd,bkhd->bhqk", q.astype(jnp.float32), kf.astype(jnp.float32)
+                    "bqhgd,bkhd->bhgqk", qg, ckey.value.astype(jnp.float32)
                 ) / math.sqrt(dh)
-                key_pos = jnp.arange(cap)[None, None, None, :]
-                q_pos = (idx + jnp.arange(s))[None, None, :, None]
+                key_pos = jnp.arange(cap)[None, None, None, None, :]
+                q_pos = (idx + jnp.arange(s))[None, None, None, :, None]
                 keep = key_pos <= q_pos
                 if self.attn_window is not None:
                     keep &= (q_pos - key_pos) < self.attn_window
                 scores = jnp.where(keep, scores, -jnp.inf)
                 probs = jax.nn.softmax(scores, axis=-1)
-                o = jnp.einsum("bhqk,bkhd->bqhd", probs, vf.astype(jnp.float32))
+                o = jnp.einsum(
+                    "bhgqk,bkhd->bqhgd", probs, cval.value.astype(jnp.float32)
+                ).reshape(b, s, h, dh)
                 o = jnp.where(overflow, jnp.nan, o)
                 o = o.astype(dt).reshape(b, s, h * dh)
                 return nn.Dense(x.shape[-1], use_bias=False, dtype=dt, name="out")(o)
