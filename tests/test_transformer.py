@@ -33,8 +33,10 @@ def test_forward_shapes_dense():
     assert bool(jnp.all(jnp.isfinite(logits)))
 
 
-def test_forward_moe_and_aux_loss():
-    model = _tiny(n_experts=4, moe_every=1)
+@pytest.mark.parametrize("moe_top_k,capacity_factor", [(1, 1.25), (2, 2.0)])
+def test_forward_moe_and_aux_loss(moe_top_k, capacity_factor):
+    model = _tiny(n_experts=4, moe_every=1, moe_top_k=moe_top_k,
+                  capacity_factor=capacity_factor)
     toks = _tokens(jax.random.PRNGKey(0), 2, 16)
     params = model.init(jax.random.PRNGKey(1), toks)["params"]
     logits, state = model.apply({"params": params}, toks, mutable=["intermediates"])
@@ -100,10 +102,12 @@ def test_tp_sharded_forward_matches():
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=1e-4, rtol=1e-4)
 
 
-def test_ep_sharded_moe_forward_matches():
+@pytest.mark.parametrize("moe_top_k,capacity_factor", [(1, 1.25), (2, 2.0)])
+def test_ep_sharded_moe_forward_matches(moe_top_k, capacity_factor):
     # Expert weights over ep axis; dispatch einsums become all-to-alls.
     mesh = make_named_mesh({"dp": 2, "ep": 4})
-    model = _tiny(n_experts=4, moe_every=1)
+    model = _tiny(n_experts=4, moe_every=1, moe_top_k=moe_top_k,
+                  capacity_factor=capacity_factor)
     toks = _tokens(jax.random.PRNGKey(0), 2, 16)
     params = model.init(jax.random.PRNGKey(1), toks)["params"]
     expected = model.apply({"params": params}, toks)
@@ -177,9 +181,10 @@ def test_train_step_includes_moe_aux_loss():
     assert losses[10.0] > losses[0.0] + 1.0
 
 
-@pytest.mark.parametrize("n_experts", [0, 4])
-def test_train_step_loss_decreases(n_experts):
-    model = _tiny(n_experts=n_experts)
+@pytest.mark.parametrize("n_experts,moe_top_k", [(0, 1), (4, 1), (4, 2)])
+def test_train_step_loss_decreases(n_experts, moe_top_k):
+    model = _tiny(n_experts=n_experts, moe_top_k=moe_top_k,
+                  capacity_factor=2.0 if moe_top_k > 1 else 1.25)
     tx = optax.adam(1e-2)
     toks = _tokens(jax.random.PRNGKey(0), 4, 16)
     labels = jnp.roll(toks, -1, axis=1)
@@ -241,3 +246,39 @@ def test_flash_block_size_decode_exempt():
     prompt = jnp.zeros((1, 5), jnp.int32)  # length 5: untileable on purpose
     out = generate(m, params, prompt, 3)
     assert out.shape == (1, 8)
+
+
+
+def test_moe_top_k_equals_experts_is_dense_mixture():
+    """Closed form: with top_k == n_experts and ample capacity nothing is
+    dropped and the renormalized gates ARE the softmax probs, so the MoE
+    output must equal the dense probs-weighted mixture of every expert."""
+    from tpunet.models.transformer import MoeMlp
+
+    e, d, f = 3, 8, 16
+    m = MoeMlp(n_experts=e, d_ff=f, capacity_factor=float(e),
+               compute_dtype=jnp.float32, top_k=e)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 5, d), jnp.float32)
+    variables = m.init(jax.random.PRNGKey(1), x)
+    y = m.apply(variables, x)
+
+    p = variables["params"]
+    xt = x.reshape(-1, d)
+    probs = jax.nn.softmax(xt @ p["router"], axis=-1)  # (t, e)
+    dense = jnp.zeros_like(xt)
+    for j in range(e):
+        hj = jax.nn.gelu(xt @ p["wi"][j])
+        dense = dense + probs[:, j:j + 1] * (hj @ p["wo"][j])
+    np.testing.assert_allclose(
+        np.asarray(y.reshape(-1, d)), np.asarray(dense), atol=1e-5, rtol=1e-5)
+
+
+
+def test_moe_top_k_validation():
+    from tpunet.models.transformer import MoeMlp
+
+    x = jnp.zeros((1, 4, 8), jnp.float32)
+    with pytest.raises(ValueError, match="top_k"):
+        MoeMlp(n_experts=4, d_ff=8, top_k=5).init(jax.random.PRNGKey(0), x)
+    with pytest.raises(ValueError, match="top_k"):
+        MoeMlp(n_experts=4, d_ff=8, top_k=0).init(jax.random.PRNGKey(0), x)

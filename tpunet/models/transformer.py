@@ -327,26 +327,37 @@ class Mlp(nn.Module):
 
 
 class MoeMlp(nn.Module):
-    """Switch-style top-1 MoE with capacity-bounded one-hot einsum dispatch.
+    """Top-k MoE with capacity-bounded one-hot einsum dispatch (top_k=1 is
+    Switch routing — the default; top_k=2 is the GShard/Mixtral family).
 
     Expert weights carry a leading expert dim — shard it over the `ep` mesh
     axis (`transformer_partition_rules`) and XLA turns the dispatch/combine
     einsums into all-to-alls. Tokens over capacity are dropped (residual
-    passes them through unchanged), the standard Switch behavior. The router
-    load-balancing loss is sown under `intermediates/moe_aux_loss`.
+    passes them through unchanged), the standard Switch behavior; capacity
+    scales with top_k (cap = ceil(k·t/e · capacity_factor)) and slots are
+    granted choice-major, so a token's SECONDARY expert overflowing can
+    never evict another token's primary assignment. Combine weights are the
+    chosen probs (top_k=1, Switch) or the probs renormalized over the
+    chosen set (top_k>1, Mixtral convention). The router load-balancing
+    loss — primary-assignment fractions, reducing to the Switch formula at
+    k=1 — is sown under `intermediates/moe_aux_loss`.
     """
 
     n_experts: int
     d_ff: int
     capacity_factor: float = 1.25
     compute_dtype: jnp.dtype = jnp.bfloat16
+    top_k: int = 1
 
     @nn.compact
     def __call__(self, x):
         b, s, d = x.shape
         e, f, dt = self.n_experts, self.d_ff, self.compute_dtype
+        k = self.top_k
+        if not 1 <= k <= e:
+            raise ValueError(f"top_k {k} outside [1, n_experts={e}]")
         t = b * s
-        cap = max(1, int(math.ceil(t / e * self.capacity_factor)))
+        cap = max(1, int(math.ceil(k * t / e * self.capacity_factor)))
 
         wg = self.param("router", nn.initializers.lecun_normal(), (d, e))
         wi = self.param("wi", nn.initializers.lecun_normal(), (e, d, f))
@@ -355,28 +366,37 @@ class MoeMlp(nn.Module):
         xt = x.reshape(t, d)
         logits = jnp.einsum("td,de->te", xt.astype(jnp.float32), wg.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)
-        gate = jnp.max(probs, axis=-1)            # (t,)
-        expert = jnp.argmax(probs, axis=-1)       # (t,)
-        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # (t, e)
+        gates, experts = jax.lax.top_k(probs, k)  # (t, k) each, best first
+        if k > 1:
+            gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+        onehot = jax.nn.one_hot(experts, e, dtype=jnp.float32)  # (t, k, e)
 
-        # Switch load-balancing aux loss: e * sum_e(frac_tokens * frac_prob).
-        frac_tokens = jnp.mean(onehot, axis=0)
+        # Load-balancing aux loss over the PRIMARY assignment:
+        # e * sum_e(frac_tokens * frac_prob) — the Switch formula at k=1.
+        frac_tokens = jnp.mean(onehot[:, 0, :], axis=0)
         frac_probs = jnp.mean(probs, axis=0)
         self.sow("intermediates", "moe_aux_loss", e * jnp.sum(frac_tokens * frac_probs))
 
-        # Position of each token within its expert's capacity buffer.
-        pos = jnp.cumsum(onehot, axis=0) * onehot          # 1-based
+        # Position of each (token, choice) within its expert's capacity
+        # buffer. The cumsum runs CHOICE-MAJOR (all choice-0 rows before any
+        # choice-1 row): primary assignments claim slots first.
+        oh_flat = onehot.transpose(1, 0, 2).reshape(k * t, e)
+        pos = jnp.cumsum(oh_flat, axis=0) * oh_flat        # 1-based
         keep = (pos > 0) & (pos <= cap)
         slot = jnp.clip(pos - 1, 0, cap - 1).astype(jnp.int32)
-        slot_oh = jax.nn.one_hot(jnp.sum(slot * onehot.astype(jnp.int32), axis=-1), cap,
-                                 dtype=jnp.float32)
-        dispatch = (onehot * keep)[:, :, None] * slot_oh[:, None, :]  # (t, e, c)
+        slot_oh = jax.nn.one_hot(
+            jnp.sum(slot * oh_flat.astype(jnp.int32), axis=-1), cap,
+            dtype=jnp.float32)
+        dispatch = ((oh_flat * keep)[:, :, None] * slot_oh[:, None, :]
+                    ).reshape(k, t, e, cap).transpose(1, 0, 2, 3)  # (t,k,e,c)
 
-        xe = jnp.einsum("tec,td->ecd", dispatch.astype(dt), xt.astype(dt))
+        xe = jnp.einsum("tkec,td->ecd", dispatch.astype(dt), xt.astype(dt))
         hdn = nn.gelu(jnp.einsum("ecd,edf->ecf", xe, wi.astype(dt)))
         ye = jnp.einsum("ecf,efd->ecd", hdn, wo.astype(dt))
-        yt = jnp.einsum("tec,ecd->td", dispatch.astype(dt), ye)
-        yt = yt * gate[:, None].astype(dt)
+        # Combine weighted by each choice's gate; dropped (over-capacity)
+        # choices contribute nothing, matching the dispatch side.
+        combine = dispatch * gates[:, :, None, None].astype(dispatch.dtype)
+        yt = jnp.einsum("tkec,ecd->td", combine.astype(dt), ye)
         return yt.reshape(b, s, d)
 
 
@@ -398,6 +418,7 @@ class Block(nn.Module):
     attn_window: int | None = None
     flash_block_q: int = 128
     flash_block_k: int = 128
+    moe_top_k: int = 1
 
     @nn.compact
     def __call__(self, x):
@@ -411,7 +432,7 @@ class Block(nn.Module):
         )(RMSNorm(name="norm1")(x))
         if self.n_experts > 0:
             mlp = MoeMlp(self.n_experts, self.d_ff, self.capacity_factor,
-                         self.compute_dtype, name="moe")
+                         self.compute_dtype, top_k=self.moe_top_k, name="moe")
         else:
             mlp = Mlp(self.d_ff, self.compute_dtype, self.mlp_impl, name="mlp")
         return x + mlp(RMSNorm(name="norm2")(x))
@@ -427,6 +448,7 @@ class Transformer(nn.Module):
     d_ff: int = 2048
     n_experts: int = 0            # 0 = dense MLP in every block
     moe_every: int = 2            # every k-th block is MoE (when n_experts>0)
+    moe_top_k: int = 1            # experts per token: 1 = Switch, 2 = GShard/Mixtral
     capacity_factor: float = 1.25
     compute_dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False           # rematerialize blocks: trade FLOPs for HBM
@@ -483,6 +505,7 @@ class Transformer(nn.Module):
                 self.n_heads, head_dim, self.d_ff,
                 n_experts=self.n_experts if moe else 0,
                 capacity_factor=self.capacity_factor,
+                moe_top_k=self.moe_top_k,
                 compute_dtype=self.compute_dtype, attn_impl=self.attn_impl,
                 mesh=self.mesh, dp_axis=self.dp_axis, sp_axis=self.sp_axis,
                 tp_axis=self.tp_axis, n_kv_heads=self.n_kv_heads,
