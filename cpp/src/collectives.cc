@@ -81,28 +81,32 @@ inline uint16_t F32ToBf16(float f) {
   return static_cast<uint16_t>(rounded >> 16);
 }
 
+// 3-operand kernels: dst[i] = a[i] op b[i]. The common in-place reduce is
+// the a == dst degenerate case; the out-of-place collectives pass a =
+// caller's sendbuf so the staging copy never has to exist.
 template <typename T>
-void ReduceTyped(T* dst, const T* src, size_t n, RedOp op) {
+void ReduceTyped(T* dst, const T* a, const T* b, size_t n, RedOp op) {
   switch (op) {
     case RedOp::kSum:
-      for (size_t i = 0; i < n; ++i) dst[i] = dst[i] + src[i];
+      for (size_t i = 0; i < n; ++i) dst[i] = a[i] + b[i];
       break;
     case RedOp::kProd:
-      for (size_t i = 0; i < n; ++i) dst[i] = dst[i] * src[i];
+      for (size_t i = 0; i < n; ++i) dst[i] = a[i] * b[i];
       break;
     case RedOp::kMin:
-      for (size_t i = 0; i < n; ++i) dst[i] = std::min(dst[i], src[i]);
+      for (size_t i = 0; i < n; ++i) dst[i] = std::min(a[i], b[i]);
       break;
     case RedOp::kMax:
-      for (size_t i = 0; i < n; ++i) dst[i] = std::max(dst[i], src[i]);
+      for (size_t i = 0; i < n; ++i) dst[i] = std::max(a[i], b[i]);
       break;
   }
 }
 
-void ReduceBf16(uint16_t* dst, const uint16_t* src, size_t n, RedOp op) {
+void ReduceBf16(uint16_t* dst, const uint16_t* asrc, const uint16_t* bsrc,
+                size_t n, RedOp op) {
   for (size_t i = 0; i < n; ++i) {
-    float a = Bf16ToF32(dst[i]);
-    float b = Bf16ToF32(src[i]);
+    float a = Bf16ToF32(asrc[i]);
+    float b = Bf16ToF32(bsrc[i]);
     float r = 0;
     switch (op) {
       case RedOp::kSum:
@@ -122,25 +126,32 @@ void ReduceBf16(uint16_t* dst, const uint16_t* src, size_t n, RedOp op) {
   }
 }
 
-void ReduceSerial(void* dst, const void* src, size_t n, DType dtype, RedOp op) {
+void ReduceSerial(void* dst, const void* a, const void* b, size_t n, DType dtype,
+                  RedOp op) {
   switch (dtype) {
     case DType::kF32:
-      ReduceTyped(static_cast<float*>(dst), static_cast<const float*>(src), n, op);
+      ReduceTyped(static_cast<float*>(dst), static_cast<const float*>(a),
+                  static_cast<const float*>(b), n, op);
       break;
     case DType::kF64:
-      ReduceTyped(static_cast<double*>(dst), static_cast<const double*>(src), n, op);
+      ReduceTyped(static_cast<double*>(dst), static_cast<const double*>(a),
+                  static_cast<const double*>(b), n, op);
       break;
     case DType::kBF16:
-      ReduceBf16(static_cast<uint16_t*>(dst), static_cast<const uint16_t*>(src), n, op);
+      ReduceBf16(static_cast<uint16_t*>(dst), static_cast<const uint16_t*>(a),
+                 static_cast<const uint16_t*>(b), n, op);
       break;
     case DType::kI32:
-      ReduceTyped(static_cast<int32_t*>(dst), static_cast<const int32_t*>(src), n, op);
+      ReduceTyped(static_cast<int32_t*>(dst), static_cast<const int32_t*>(a),
+                  static_cast<const int32_t*>(b), n, op);
       break;
     case DType::kI64:
-      ReduceTyped(static_cast<int64_t*>(dst), static_cast<const int64_t*>(src), n, op);
+      ReduceTyped(static_cast<int64_t*>(dst), static_cast<const int64_t*>(a),
+                  static_cast<const int64_t*>(b), n, op);
       break;
     case DType::kU8:
-      ReduceTyped(static_cast<uint8_t*>(dst), static_cast<const uint8_t*>(src), n, op);
+      ReduceTyped(static_cast<uint8_t*>(dst), static_cast<const uint8_t*>(a),
+                  static_cast<const uint8_t*>(b), n, op);
       break;
   }
 }
@@ -234,22 +245,26 @@ class ReducePool {
   std::vector<std::thread> threads_;
 };
 
-// Parallel reduce: split [0, n) into per-core ranges when the chunk is big
-// enough to amortize the fork-join (>= 4 MiB) and cores are available.
-void Reduce(void* dst, const void* src, size_t n, DType dtype, RedOp op) {
+// Parallel reduce (dst = a op b): split [0, n) into per-core ranges when the
+// chunk is big enough to amortize the fork-join (>= 4 MiB) and cores are
+// available.
+void Reduce(void* dst, const void* a, const void* b, size_t n, DType dtype,
+            RedOp op) {
   size_t esize = DTypeSize(dtype);
   ReducePool& pool = ReducePool::Get();
   size_t nshards = pool.nworkers() + 1;
   if (nshards <= 1 || n * esize < (4u << 20)) {
-    ReduceSerial(dst, src, n, dtype, op);
+    ReduceSerial(dst, a, b, n, dtype, op);
     return;
   }
   auto* d8 = static_cast<uint8_t*>(dst);
-  const auto* s8 = static_cast<const uint8_t*>(src);
+  const auto* a8 = static_cast<const uint8_t*>(a);
+  const auto* b8 = static_cast<const uint8_t*>(b);
   pool.Run(
       [&](size_t i) {
         size_t lo = n * i / nshards, hi = n * (i + 1) / nshards;
-        ReduceSerial(d8 + lo * esize, s8 + lo * esize, hi - lo, dtype, op);
+        ReduceSerial(d8 + lo * esize, a8 + lo * esize, b8 + lo * esize,
+                     hi - lo, dtype, op);
       },
       nshards);
 }
@@ -375,10 +390,29 @@ class RingCommunicator : public Communicator {
     size_t esize = DTypeSize(dtype);
     if (esize == 0) return Status::Invalid("bad dtype");
     if (count == 0) return Status::Ok();
-    if (sendbuf != recvbuf) memcpy(recvbuf, sendbuf, count * esize);
-    if (world_ == 1) return Status::Ok();
-
+    if (world_ == 1) {
+      if (sendbuf != recvbuf) memcpy(recvbuf, sendbuf, count * esize);
+      return Status::Ok();
+    }
+    const uint8_t* src = static_cast<const uint8_t*>(sendbuf);
     uint8_t* data = static_cast<uint8_t*>(recvbuf);
+    // Out-of-place with DISJOINT buffers needs no staging copy at all:
+    // round 0 sends from the caller's sendbuf, later rounds send the slice
+    // reduced the previous round (already in recvbuf), and every reduce
+    // reads its local operand from sendbuf while writing into recvbuf —
+    // every recvbuf slice is written (by RS or AG) before anything reads
+    // it, so the caller's input never needs to be there. Measured 2x
+    // on the 128 MiB out-of-place path (PERF_NOTES round 4): the memcpy
+    // plus first-touch faulting of a cold 128 MiB destination was as
+    // expensive as the whole ring on a 1-core host. Partially-overlapping
+    // buffers (C-ABI callers only; the Python binding never does this)
+    // keep the safe copy path.
+    bool oop = sendbuf != recvbuf;
+    if (oop && src < data + count * esize && data < src + count * esize) {
+      // Overlapping: stage (memmove — the ranges provably overlap).
+      memmove(recvbuf, sendbuf, count * esize);
+      oop = false;
+    }
     const int W = world_;
     auto off = [&](int i) { return (count * static_cast<size_t>(i)) / W; };
 
@@ -390,8 +424,13 @@ class RingCommunicator : public Communicator {
       int ridx = (vr - s - 1 + W) % W;
       size_t sbytes = (off(sidx + 1) - off(sidx)) * esize;
       size_t rbytes = (off(ridx + 1) - off(ridx)) * esize;
-      Status st = ExchangeReduce(data + off(sidx) * esize, sbytes,
-                                 data + off(ridx) * esize, rbytes, dtype, op, ch);
+      // Round s sends the slice reduced in round s-1; only round 0's send
+      // operand still lives in sendbuf on the no-copy path.
+      const uint8_t* sptr =
+          ((oop && s == 0) ? src : data) + off(sidx) * esize;
+      Status st = ExchangeReduce(sptr, sbytes, data + off(ridx) * esize,
+                                 rbytes, dtype, op, ch,
+                                 oop ? src + off(ridx) * esize : nullptr);
       if (!st.ok()) return st;
     }
     for (int s = 0; s < W - 1; ++s) {
@@ -417,21 +456,45 @@ class RingCommunicator : public Communicator {
       if (sendbuf != recvbuf) memcpy(recvbuf, sendbuf, recv_count * esize);
       return Status::Ok();
     }
-    // Working copy of the whole W*recv_count input; the RS ring reduces
-    // blocks in place as they circulate.
     size_t block = recv_count * esize;
-    work_.resize(static_cast<size_t>(W) * block);
-    memcpy(work_.data(), sendbuf, work_.size());
-
+    const uint8_t* src = static_cast<const uint8_t*>(sendbuf);
+    uint8_t* out = static_cast<uint8_t*>(recvbuf);
+    if (out < src + static_cast<size_t>(W) * block && src < out + block) {
+      // Overlapping C-ABI buffers: keep the safe full-copy path.
+      work_.resize(static_cast<size_t>(W) * block);
+      memcpy(work_.data(), sendbuf, work_.size());
+      const int vr0 = (rank_ + W - 1) % W;
+      for (int s = 0; s < W - 1; ++s) {
+        int sidx = (vr0 - s + W) % W;
+        int ridx = (vr0 - s - 1 + W) % W;
+        Status st = ExchangeReduce(work_.data() + sidx * block, block,
+                                   work_.data() + ridx * block, block, dtype, op, channels_[0]);
+        if (!st.ok()) return st;
+      }
+      memcpy(recvbuf, work_.data() + rank_ * block, block);
+      return Status::Ok();
+    }
+    // No staging copy of the W-block input: each round's reduce reads its
+    // local operand from the caller's sendbuf; partials land in a 2-block
+    // ping-pong scratch (a round's output is the NEXT round's send
+    // operand), and the final round — whose target is this rank's owned
+    // block — writes straight into recvbuf. Scratch is 2 blocks instead of
+    // the previous W, and the O(W·B) memcpy is gone. W=2's single round
+    // goes sendbuf->recvbuf directly and needs no scratch at all (resizing
+    // it would zero-fill + fault pages for nothing — the cost class this
+    // path exists to avoid).
+    if (W > 2) work_.resize(2 * block);
+    uint8_t* pb[2] = {work_.data(), work_.data() + block};
     const int vr = (rank_ + W - 1) % W;
     for (int s = 0; s < W - 1; ++s) {
       int sidx = (vr - s + W) % W;
       int ridx = (vr - s - 1 + W) % W;
-      Status st = ExchangeReduce(work_.data() + sidx * block, block,
-                                 work_.data() + ridx * block, block, dtype, op, channels_[0]);
+      const uint8_t* sptr = (s == 0) ? src + sidx * block : pb[(s - 1) & 1];
+      uint8_t* optr = (s == W - 2) ? out : pb[s & 1];
+      Status st = ExchangeReduce(sptr, block, optr, block, dtype, op,
+                                 channels_[0], src + ridx * block);
       if (!st.ok()) return st;
     }
-    memcpy(recvbuf, work_.data() + rank_ * block, block);
     return Status::Ok();
   }
 
@@ -762,15 +825,21 @@ class RingCommunicator : public Communicator {
   // into `accum` (element count = slice bytes / esize) as soon as it lands —
   // chunk i's Reduce overlaps chunk i+1's transfer. Double-buffered scratch;
   // all in-flight requests are quiesced before returning, even on error.
+  // `local` is the left operand of the reduce (accum = local op incoming);
+  // nullptr = accum itself (the classic in-place accumulate). A distinct
+  // local lets out-of-place collectives read the caller's sendbuf directly
+  // and write partials straight into recvbuf — no staging copy anywhere.
   Status ExchangeReduce(const uint8_t* sendbuf, size_t send_nbytes, uint8_t* accum,
-                        size_t recv_nbytes, DType dtype, RedOp op, RingChannel& ch) {
+                        size_t recv_nbytes, DType dtype, RedOp op, RingChannel& ch,
+                        const uint8_t* local = nullptr) {
+    if (local == nullptr) local = accum;
     size_t esize = DTypeSize(dtype);
     size_t chunk = RingChunkBytes() / esize * esize;
     if (chunk == 0 || (send_nbytes <= chunk && recv_nbytes <= chunk)) {
       ch.scratch.resize(std::max(ch.scratch.size(), recv_nbytes));
       Status st = Exchange(sendbuf, send_nbytes, ch.scratch.data(), recv_nbytes, nullptr, ch);
       if (!st.ok()) return st;
-      Reduce(accum, ch.scratch.data(), recv_nbytes / esize, dtype, op);
+      Reduce(accum, local, ch.scratch.data(), recv_nbytes / esize, dtype, op);
       return Status::Ok();
     }
     // Send and recv slice sizes can differ (ring slices are count*i/W
@@ -831,7 +900,8 @@ class RingCommunicator : public Communicator {
         if (!st.ok()) return quiesce(st);
       }
       if (has_r) {
-        Reduce(accum + i * chunk, ch.scratch.data() + slot * chunk, rlen(i) / esize, dtype, op);
+        Reduce(accum + i * chunk, local + i * chunk,
+               ch.scratch.data() + slot * chunk, rlen(i) / esize, dtype, op);
       }
       if (i < ns) {
         st = WaitRequest(sreq[slot], nullptr);
