@@ -483,8 +483,12 @@ class RingCommunicator : public Communicator {
     // goes sendbuf->recvbuf directly and needs no scratch at all (resizing
     // it would zero-fill + fault pages for nothing — the cost class this
     // path exists to avoid).
-    if (W > 2) work_.resize(2 * block);
-    uint8_t* pb[2] = {work_.data(), work_.data() + block};
+    uint8_t* pb[2] = {nullptr, nullptr};
+    if (W > 2) {
+      work_.resize(2 * block);
+      pb[0] = work_.data();
+      pb[1] = work_.data() + block;
+    }  // W==2: single round goes sendbuf->recvbuf, pb never read
     const int vr = (rank_ + W - 1) % W;
     for (int s = 0; s < W - 1; ++s) {
       int sidx = (vr - s + W) % W;

@@ -197,3 +197,44 @@ def test_all_to_all_modes(world, mode):
     # Pairwise (direct per-peer mesh, O(W*B) wire bytes) must match the
     # ring-relay fallback bit for bit; W=3 exercises the odd-world mesh.
     run_spawn_workers(_a2a_worker, world, extra_args=({"TPUNET_A2A": mode},))
+
+
+def _oop_multichunk_worker(rank: int, world: int, port: int, q, env) -> None:
+    try:
+        import os
+
+        for k, v in env.items():
+            os.environ[k] = v
+        from tpunet.collectives import Communicator
+
+        comm = Communicator(f"127.0.0.1:{port}", rank, world)
+        # 2 MiB with a 64 KiB ring chunk: every ring slice is many pipelined
+        # chunks, exercising the chunked ExchangeReduce with a DISTINCT
+        # local operand (the zero-staging out-of-place path) at W>2 —
+        # including the ReduceScatter partial ping-pong.
+        n = (2 << 20) // 4
+        mine = _rank_data(rank, n, np.float32)
+        orig = mine.copy()
+        got = comm.all_reduce(mine, "sum")  # out-of-place
+        expect = sum(_rank_data(r, n, np.float32) for r in range(world))
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(mine, orig)  # input untouched
+
+        rs_n = n - (n % world)
+        got = comm.reduce_scatter(mine[:rs_n], "sum")
+        shard = rs_n // world
+        np.testing.assert_allclose(
+            got, expect[:rs_n].reshape(world, shard)[rank],
+            rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(mine, orig)
+        comm.close()
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+@pytest.mark.parametrize("world", [3, 4])
+def test_out_of_place_multichunk_ring(world):
+    run_spawn_workers(
+        _oop_multichunk_worker, world,
+        extra_args=({"TPUNET_RING_CHUNKSIZE": str(64 << 10)},))

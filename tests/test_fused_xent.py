@@ -119,3 +119,21 @@ def test_no_full_logits_in_jaxpr():
     assert (n_tok, vocab) not in set(shapes(jaxpr.jaxpr)), (
         "fused path materialized full logits"
     )
+
+
+def test_return_lse_matches_dense_logsumexp():
+    import jax
+
+    feats = jax.random.normal(jax.random.PRNGKey(0), (12, 16), jnp.float32)
+    kernel = jax.random.normal(jax.random.PRNGKey(1), (16, 50), jnp.float32) * 0.1
+    labels = jax.random.randint(jax.random.PRNGKey(2), (12,), 0, 50)
+    nll, lse = blockwise_cross_entropy(feats, kernel, labels, block_vocab=16,
+                                       return_lse=True)
+    dense = jax.scipy.special.logsumexp(feats @ kernel, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(dense),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(nll),
+        np.asarray(blockwise_cross_entropy(feats, kernel, labels,
+                                           block_vocab=16)),
+        atol=1e-6)

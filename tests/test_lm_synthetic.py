@@ -14,3 +14,32 @@ def test_single_process_tiny():
     rates = run_benchmark(args, emit=lambda *_: None)
     assert len(rates) == 2
     assert all(r > 0 for r in rates)
+
+
+def test_z_loss_increases_loss_and_matches_across_paths():
+    """z_loss adds z*mean(lse^2) on BOTH the plain and fused paths — the
+    two must agree to rounding, and the term must be visible."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tpunet.models import Transformer
+    from tpunet.train import create_train_state, make_train_step
+
+    model = Transformer(vocab=96, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                        compute_dtype=jnp.float32)
+    tx = optax.sgd(1e-2)
+    toks = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, 96)
+    labels = jnp.roll(toks, -1, axis=1)
+    state, _ = create_train_state(model, jax.random.PRNGKey(1), toks, tx)
+
+    losses = {}
+    for name, kw in [("plain", {}), ("plain_z", {"z_loss": 1e-2}),
+                     ("fused_z", {"z_loss": 1e-2, "fused_xent_block": 32})]:
+        step = make_train_step(model, tx, donate=False, **kw)
+        _, loss = step(state, toks, labels, jax.random.PRNGKey(0))
+        losses[name] = float(loss)
+    assert losses["plain_z"] > losses["plain"]
+    np.testing.assert_allclose(losses["fused_z"], losses["plain_z"],
+                               rtol=1e-5)

@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 
 
-def blockwise_cross_entropy(feats, kernel, labels, block_vocab: int = 8192):
+def blockwise_cross_entropy(feats, kernel, labels, block_vocab: int = 8192,
+                            return_lse: bool = False):
     """Exact per-token negative log-likelihood without full logits.
 
     feats: (N, d) floating (bf16/f32) — final hidden states.
@@ -32,7 +33,10 @@ def blockwise_cross_entropy(feats, kernel, labels, block_vocab: int = 8192):
         accumulation is f32 via preferred_element_type).
     labels: (N,) int32; negatives wrap python-style (-1 == V-1) and
         labels >= V produce NaN, matching optax exactly.
-    Returns (N,) f32 losses: logsumexp(logits) - logits[label].
+    Returns (N,) f32 losses: logsumexp(logits) - logits[label]; with
+    return_lse=True, (losses, lse) — the online logsumexp is computed
+    anyway, and exposing it gives z-loss regularization for free (the
+    logits still never materialize).
 
     Matches optax.softmax_cross_entropy_with_integer_labels(feats @ kernel)
     to f32 rounding; peak memory is O(N * block_vocab) instead of O(N * V).
@@ -88,4 +92,5 @@ def blockwise_cross_entropy(feats, kernel, labels, block_vocab: int = 8192):
         jax.checkpoint(body), init, (blocks, starts)
     )
     label_logit = jnp.where(valid, label_logit, jnp.nan)
-    return (run_max + jnp.log(run_sum)) - label_logit
+    lse = run_max + jnp.log(run_sum)
+    return (lse - label_logit, lse) if return_lse else lse - label_logit

@@ -110,7 +110,8 @@ def _bucketed_dcn_pmean(grads, bucket_bytes: int, compression: str | None, world
 
 
 def _make_loss_fn(model, images, labels, dropout_rng, moe_aux_weight: float,
-                  fused_xent_block: int | None = None):
+                  fused_xent_block: int | None = None,
+                  z_loss: float = 0.0):
     """The train-step objective, shared by the replicated and ZeRO paths:
     token/label cross-entropy plus (for MoE models) the Switch router's sown
     load-balancing losses, collected via mutable=['intermediates'] — without
@@ -146,12 +147,25 @@ def _make_loss_fn(model, images, labels, dropout_rng, moe_aux_weight: float,
         if fused:
             from tpunet.ops import blockwise_cross_entropy
 
-            loss = blockwise_cross_entropy(
+            nll, lse = blockwise_cross_entropy(
                 out.reshape(-1, out.shape[-1]),
                 p["lm_head"]["kernel"],
                 labels.reshape(-1),
                 block_vocab=fused_xent_block,
-            ).mean()
+                return_lse=True,
+            )
+            loss = nll.mean()
+            if z_loss:
+                loss = loss + z_loss * jnp.mean(jnp.square(lse))
+        elif z_loss:
+            # Single pass over the logits: lse feeds BOTH the nll
+            # (lse - picked, optax's own identity) and the z term — no
+            # second logsumexp, no second full-logits read.
+            out32 = out.astype(jnp.float32)
+            lse = jax.scipy.special.logsumexp(out32, axis=-1)
+            picked = jnp.take_along_axis(
+                out32, labels[..., None], axis=-1)[..., 0]
+            loss = (lse - picked).mean() + z_loss * jnp.mean(jnp.square(lse))
         else:
             loss = optax.softmax_cross_entropy_with_integer_labels(out, labels)
             loss = loss.mean()
@@ -174,7 +188,7 @@ def _make_loss_fn(model, images, labels, dropout_rng, moe_aux_weight: float,
 
 def _value_and_grads(model, params, images, labels, dropout_rng,
                      moe_aux_weight: float, fused_xent_block: int | None,
-                     accum_steps: int | None):
+                     accum_steps: int | None, z_loss: float = 0.0):
     """(mean loss, mean grads) for the batch — in one backward, or (with
     accum_steps=k) as a lax.scan over k microbatches whose activations are
     freed between iterations: the throughput-neutral way to run a batch k×
@@ -185,7 +199,7 @@ def _value_and_grads(model, params, images, labels, dropout_rng,
     a slightly different objective than one full-batch step."""
     if accum_steps is None or accum_steps == 1:
         loss_fn = _make_loss_fn(model, images, labels, dropout_rng,
-                                moe_aux_weight, fused_xent_block)
+                                moe_aux_weight, fused_xent_block, z_loss)
         return jax.value_and_grad(loss_fn)(params)
 
     batch = images.shape[0]
@@ -206,7 +220,7 @@ def _value_and_grads(model, params, images, labels, dropout_rng,
         loss_sum, grad_sum = carry
         im, lb, key = xs
         loss_fn = _make_loss_fn(model, im, lb, key, moe_aux_weight,
-                                fused_xent_block)
+                                fused_xent_block, z_loss)
         loss, grads = jax.value_and_grad(loss_fn)(params)
         return (loss_sum + loss, jax.tree.map(jnp.add, grad_sum, grads)), None
 
@@ -222,7 +236,8 @@ def make_train_step(model, tx, cross_host: bool = False, donate: bool = True,
                     moe_aux_weight: float = 0.01,
                     bucket_bytes: int | None = None,
                     fused_xent_block: int | None = None,
-                    accum_steps: int | None = None):
+                    accum_steps: int | None = None,
+                    z_loss: float = 0.0):
     """Build the jitted train step.
 
     cross_host=True adds the DCN gradient all-reduce tier (requires
@@ -259,7 +274,7 @@ def make_train_step(model, tx, cross_host: bool = False, donate: bool = True,
     def train_step(state: TrainState, images, labels, dropout_rng):
         loss, grads = _value_and_grads(model, state.params, images, labels,
                                        dropout_rng, moe_aux_weight,
-                                       fused_xent_block, accum_steps)
+                                       fused_xent_block, accum_steps, z_loss)
 
         if cross_host:
             if bucket_bytes is not None:
@@ -309,7 +324,8 @@ def make_zero_train_step(model, tx, donate: bool = True,
                          grad_compression: str | None = None,
                          moe_aux_weight: float = 0.01,
                          fused_xent_block: int | None = None,
-                         accum_steps: int | None = None):
+                         accum_steps: int | None = None,
+                         z_loss: float = 0.0):
     """ZeRO-1 (optimizer-state sharding) cross-host train step.
 
     Instead of all-reducing the full gradient and updating replicated
@@ -348,7 +364,7 @@ def make_zero_train_step(model, tx, donate: bool = True,
     def train_step(state: TrainState, images, labels, dropout_rng):
         loss, grads = _value_and_grads(model, state.params, images, labels,
                                        dropout_rng, moe_aux_weight,
-                                       fused_xent_block, accum_steps)
+                                       fused_xent_block, accum_steps, z_loss)
 
         gflat, _ = ravel_pytree(grads)
         pflat, unravel = ravel_pytree(state.params)
