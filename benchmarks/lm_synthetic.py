@@ -45,6 +45,7 @@ def _build(args):
     model = Transformer(
         vocab=args.vocab, d_model=args.d_model, n_layers=args.layers,
         n_heads=args.heads, d_ff=4 * args.d_model, n_experts=args.experts,
+        moe_top_k=args.moe_top_k, capacity_factor=args.capacity_factor,
         compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         attn_impl=("zigzag" if args.zigzag else "ring") if args.sp > 1
         else "reference",
@@ -158,6 +159,10 @@ def _parse(argv):
     ap.add_argument("--layers", type=int, default=8)
     ap.add_argument("--heads", type=int, default=8)
     ap.add_argument("--experts", type=int, default=0)
+    ap.add_argument("--moe-top-k", type=int, default=1,
+                    help="experts per token (2 = GShard/Mixtral routing); "
+                         "the model already scales expert capacity by k")
+    ap.add_argument("--capacity-factor", type=float, default=1.25)
     ap.add_argument("--sp", type=int, default=1, help="sequence-parallel axis size")
     ap.add_argument("--zigzag", action="store_true",
                     help="balanced causal context parallelism (zigzag layout) "

@@ -43,3 +43,16 @@ def test_z_loss_increases_loss_and_matches_across_paths():
     assert losses["plain_z"] > losses["plain"]
     np.testing.assert_allclose(losses["fused_z"], losses["plain_z"],
                                rtol=1e-5)
+
+
+def test_single_process_moe_top2():
+    args = _parse(
+        [
+            "--d-model", "32", "--layers", "2", "--heads", "2", "--vocab", "64",
+            "--seq", "32", "--batch-size", "2", "--iters", "2",
+            "--batches-per-iter", "1", "--warmup", "1", "--no-bf16",
+            "--experts", "4", "--moe-top-k", "2",
+        ]
+    )
+    rates = run_benchmark(args, emit=lambda *_: None)
+    assert len(rates) == 2 and all(r > 0 for r in rates)

@@ -158,14 +158,15 @@ def _make_loss_fn(model, images, labels, dropout_rng, moe_aux_weight: float,
             if z_loss:
                 loss = loss + z_loss * jnp.mean(jnp.square(lse))
         elif z_loss:
-            # Single pass over the logits: lse feeds BOTH the nll
-            # (lse - picked, optax's own identity) and the z term — no
-            # second logsumexp, no second full-logits read.
-            out32 = out.astype(jnp.float32)
-            lse = jax.scipy.special.logsumexp(out32, axis=-1)
+            # Single pass over the logits IN THEIR OWN DTYPE: lse feeds
+            # BOTH the nll (lse - picked, optax's own identity, same dtype
+            # semantics as the z=0 branch) and the z term — no second
+            # logsumexp, no upcast copy of the logits tensor.
+            lse = jax.scipy.special.logsumexp(out, axis=-1)
             picked = jnp.take_along_axis(
-                out32, labels[..., None], axis=-1)[..., 0]
-            loss = (lse - picked).mean() + z_loss * jnp.mean(jnp.square(lse))
+                out, labels[..., None], axis=-1)[..., 0]
+            loss = (lse - picked).mean() + z_loss * jnp.mean(
+                jnp.square(lse.astype(jnp.float32)))
         else:
             loss = optax.softmax_cross_entropy_with_integer_labels(out, labels)
             loss = loss.mean()
