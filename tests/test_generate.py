@@ -277,3 +277,45 @@ def test_sliding_window_rejects_sequence_parallel_impls():
     toks = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 0, 64)
     with pytest.raises(ValueError, match="attn_window"):
         model.init(jax.random.PRNGKey(1), toks)
+
+
+def test_top_k_sampling_restricts_support():
+    # With top_k=1, sampling at any temperature IS greedy: every draw must
+    # equal the argmax continuation.
+    model = _tiny()
+    params, toks = _params(model)
+    prompt = toks[:, :8]
+    greedy = generate(model, params, prompt, 6)
+    for seed in range(3):
+        out = generate(model, params, prompt, 6, temperature=1.5,
+                       top_k=1, rng=jax.random.PRNGKey(seed))
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(greedy))
+
+
+def test_top_p_keeps_top_token_and_restricts_support():
+    # top_p -> 0 keeps only the nucleus head: again greedy, at any
+    # temperature and seed (the top token must always survive the mask).
+    model = _tiny()
+    params, toks = _params(model)
+    prompt = toks[:, :8]
+    greedy = generate(model, params, prompt, 6)
+    for seed in range(3):
+        out = generate(model, params, prompt, 6, temperature=2.0,
+                       top_p=1e-6, rng=jax.random.PRNGKey(seed))
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(greedy))
+    # And a loose-p run still produces valid tokens.
+    out = generate(model, params, prompt, 6, temperature=1.0, top_p=0.9,
+                   rng=jax.random.PRNGKey(7))
+    assert int(out.max()) < model.vocab and int(out.min()) >= 0
+
+
+def test_sampling_knob_validation():
+    model = _tiny()
+    params, toks = _params(model)
+    prompt = toks[:, :4]
+    with pytest.raises(ValueError, match="temperature"):
+        generate(model, params, prompt, 2, top_k=5)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(model, params, prompt, 2, temperature=1.0, top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, 2, temperature=1.0, top_p=0.0)

@@ -41,21 +41,35 @@ def generate(
     max_new_tokens: int,
     *,
     temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
     rng=None,
     eos_id: int | None = None,
 ):
     """Generate `max_new_tokens` continuations of `prompt` (b, p) int32.
 
     temperature 0.0 = greedy argmax; otherwise softmax sampling at the
-    given temperature (one PRNG key per step, split from `rng`). After a
+    given temperature (one PRNG key per step, split from `rng`),
+    optionally restricted to the `top_k` highest-probability tokens
+    and/or the nucleus of cumulative probability `top_p` (both masks
+    compose: k first, then p — the common serving convention). After a
     sequence emits `eos_id` every later position is pinned to `eos_id`.
     Returns (b, p + max_new_tokens) int32 — prompt included.
 
     Jit-friendly: callers can `jax.jit(partial(generate, model),
-    static_argnames="max_new_tokens")`; shapes are static throughout.
+    static_argnames=("max_new_tokens", "temperature", "top_k", "top_p"))`;
+    shapes are static throughout (the sampling knobs are trace-time
+    constants baked into the sampler, so they must be static too).
     """
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
+    if (top_k is not None or top_p is not None) and temperature == 0.0:
+        raise ValueError("top_k/top_p require temperature > 0 (greedy "
+                         "decoding ignores them silently otherwise)")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     b, p = prompt.shape
     dm = model.clone(decode=True)
     cache = init_cache(model, b, p + max_new_tokens)
@@ -65,9 +79,28 @@ def generate(
     def sample(last_logits, key):
         if temperature == 0.0:
             return jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            key, last_logits / temperature, axis=-1
-        ).astype(jnp.int32)
+        logits = last_logits / temperature
+        rows = jnp.arange(logits.shape[0])[:, None]
+        if top_k is not None and top_k < logits.shape[-1]:
+            # Rank-exact: exactly top_k survivors even under tied logits
+            # (lax.top_k breaks ties deterministically), and no full sort
+            # in the per-token decode loop.
+            _, idx = jax.lax.top_k(logits, top_k)
+            keep = jnp.zeros(logits.shape, bool).at[rows, idx].set(True)
+            logits = jnp.where(keep, logits, -jnp.inf)
+        if top_p is not None and top_p < 1.0:
+            # Nucleus, rank-exact: ONE descending argsort; keep the
+            # smallest prefix whose cumulative probability reaches top_p
+            # (exclusive prefix sum — the top token always survives), then
+            # scatter the rank-space mask back to vocab positions.
+            order = jnp.argsort(-logits, axis=-1)
+            sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive prefix sum
+            keep = jnp.zeros(logits.shape, bool).at[rows, order].set(
+                cum < top_p)
+            logits = jnp.where(keep, logits, -jnp.inf)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     # Prefill: one call over the whole prompt fills cache[0:p] and yields
     # the first next-token distribution from the final prompt position.
