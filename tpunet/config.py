@@ -70,8 +70,10 @@ class Config:
     # channel (t-1) % async_channels, so consecutive gradient buckets overlap
     # on the wire. Must agree across ranks.
     async_channels: int = 2
-    # AllToAll algorithm: "pairwise" (direct per-peer comms, O(W*B) wire
-    # bytes) or "ring" (store-and-forward relay, no extra comms).
+    # AllToAll algorithm: "pairwise" (direct per-peer comms — the
+    # minimum wire bytes, measured (W-1)/W x S per rank) or "ring"
+    # (store-and-forward relay: no extra comms, but each block travels
+    # multiple hops — 2x the bytes at W=4).
     a2a: str = "pairwise"
     # Worlds larger than this fall back to the ring relay rather than paying
     # 2*(W-1) comm bundles of fds/threads per rank for the pairwise mesh.
@@ -80,6 +82,10 @@ class Config:
     # on an idle comm, and lazily-parked irecv whose wait() runs inline.
     inline_send: bool = True
     lazy_recv: bool = True
+    # EPOLL engine: event-loop threads per engine, and the caller-thread
+    # inline dispatch + immediate-IO fast path (0 = pure event loop).
+    epoll_threads: int = 2
+    epoll_inline: bool = True
 
     @staticmethod
     def from_env() -> "Config":
@@ -115,4 +121,6 @@ class Config:
             # only a numeric 0 disables; "false"/"" fall back to on.
             inline_send=_env_int("TPUNET_INLINE_SEND", 1) != 0,
             lazy_recv=_env_int("TPUNET_LAZY_RECV", 1) != 0,
+            epoll_threads=_env_int("TPUNET_EPOLL_THREADS", 2),
+            epoll_inline=_env_int("TPUNET_EPOLL_INLINE", 1) != 0,
         )
