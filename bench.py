@@ -128,13 +128,9 @@ def _kernel_smoke(tpu_up: bool) -> dict | None:
 
 
 def _flash_smoke_ok(kernels: dict | None) -> bool:
-    """True only for a smoke that ran ON the chip and passed both kernels —
-    a CPU-fallback smoke trivially passes in interpret mode and proves
-    nothing about Mosaic."""
-    return (kernels is not None
-            and kernels.get("platform") == "tpu"
-            and kernels.get("flash_fwd") == "ok"
-            and kernels.get("flash_bwd") == "ok")
+    from benchmarks import flash_smoke_ok
+
+    return flash_smoke_ok(kernels)
 
 
 # The committed-measurement replay is only trustworthy while the code it

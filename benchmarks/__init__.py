@@ -37,6 +37,18 @@ def chained_step_time(step_fn, state, args, warmup: int, iters: int) -> float:
     return dt
 
 
+def flash_smoke_ok(kernels) -> bool:
+    """True only for a kernel smoke that ran ON the chip and passed the
+    core flash kernels — a CPU-fallback smoke trivially passes in interpret
+    mode and proves nothing about Mosaic; a parity failure is just as
+    disqualifying as a crash. Shared by bench.py and chip_session so the
+    smoke's key contract lives in one place."""
+    return (isinstance(kernels, dict)
+            and kernels.get("platform") == "tpu"
+            and kernels.get("flash_fwd") == "ok"
+            and kernels.get("flash_bwd") == "ok")
+
+
 def run_json_lines(argv: list, timeout_s: float,
                    cwd: str | None = None) -> tuple[list, str]:
     """Run `python <argv...>` and parse every JSON-object line it printed.

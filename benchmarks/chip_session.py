@@ -145,9 +145,9 @@ def _write_measured(raw: dict) -> None:
             "tokens_per_s": head.get("tokens_per_s"),
             "mfu": head.get("mfu"),
             "vgg_img_per_s": head.get("vgg_img_per_s"),
-            "config": "d2048 L12 ff8192 h16, batch 8 x seq 2048, bf16 + "
-                      "flash + remat, donated adamw; chained timing "
-                      "(benchmarks.chained_step_time)",
+            "config": (f"d2048 L12 ff8192 h16, batch 8 x seq 2048, bf16 + "
+                       f"{head.get('attn', 'flash')} + remat, donated adamw; "
+                       "chained timing (benchmarks.chained_step_time)"),
         })
     if isinstance(raw.get("kernels"), dict) and "error" not in raw["kernels"]:
         out["kernels"] = {k: v for k, v in raw["kernels"].items()
@@ -217,6 +217,20 @@ def main(argv=None) -> None:
             status[key] = "cached"
             continue
         print(f"[chip_session] {i}/{len(STEPS)} {key} ...", file=sys.stderr)
+        if key == "headline":
+            # Same per-kernel degradation bench.py applies, decided BEFORE
+            # the run (a parity-failing kernel completes without crashing —
+            # its numbers must never be published as flash): an on-chip
+            # smoke that didn't pass the flash kernels drops the headline
+            # to reference attention up front.
+            from benchmarks import flash_smoke_ok
+
+            k = raw.get("kernels")
+            if (isinstance(k, dict) and k.get("platform") == "tpu"
+                    and not flash_smoke_ok(k)):
+                print("[chip_session]   flash smoke not ok; headline uses "
+                      "reference attention", file=sys.stderr)
+                cmd = cmd + ["--attn", "reference"]
         out, err = _run_json(cmd, timeout_s)
         if out is None:
             raw[key] = {"error": err}

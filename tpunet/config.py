@@ -121,6 +121,8 @@ class Config:
             # only a numeric 0 disables; "false"/"" fall back to on.
             inline_send=_env_int("TPUNET_INLINE_SEND", 1) != 0,
             lazy_recv=_env_int("TPUNET_LAZY_RECV", 1) != 0,
-            epoll_threads=_env_int("TPUNET_EPOLL_THREADS", 2),
+            # The native engine clamps 0 -> 1 loop thread; mirror it so
+            # the inventory reports the thread count that actually runs.
+            epoll_threads=max(1, _env_int("TPUNET_EPOLL_THREADS", 2)),
             epoll_inline=_env_int("TPUNET_EPOLL_INLINE", 1) != 0,
         )
