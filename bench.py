@@ -127,12 +127,6 @@ def _kernel_smoke(tpu_up: bool) -> dict | None:
     return out if out is not None else {"error": f"kernel smoke failed: {err}"}
 
 
-def _flash_smoke_ok(kernels: dict | None) -> bool:
-    from benchmarks import flash_smoke_ok
-
-    return flash_smoke_ok(kernels)
-
-
 # The committed-measurement replay is only trustworthy while the code it
 # measured is the code at HEAD. These are the paths whose changes invalidate
 # the model-tier numbers: kernels, model defs, the train-step builder and
@@ -180,9 +174,11 @@ def _model_tier(tpu_up: bool, kernels: dict | None) -> dict | None:
     that failed their smoke are individually dropped to their fallback impl
     (per-kernel, not per-platform): a broken or even crashed smoke still
     leaves the TPU attempt alive, just with reference attention."""
+    from benchmarks import flash_smoke_ok
+
     attempts = []
     if tpu_up:
-        flash_ok = _flash_smoke_ok(kernels)
+        flash_ok = flash_smoke_ok(kernels)
         if not flash_ok:
             print("[bench] flash kernel smoke not ok; model tier uses "
                   "reference attention on TPU", file=sys.stderr)

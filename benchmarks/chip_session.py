@@ -220,16 +220,16 @@ def main(argv=None) -> None:
         if key == "headline":
             # Same per-kernel degradation bench.py applies, decided BEFORE
             # the run (a parity-failing kernel completes without crashing —
-            # its numbers must never be published as flash): an on-chip
-            # smoke that didn't pass the flash kernels drops the headline
-            # to reference attention up front.
+            # its numbers must never be published as flash): anything short
+            # of an on-chip all-ok smoke — parity failure, errored/timed-out
+            # smoke, or a smoke skipped via --only — drops the headline to
+            # reference attention, exactly like bench.py's gate. To measure
+            # flash, run the smoke step in the same session.
             from benchmarks import flash_smoke_ok
 
-            k = raw.get("kernels")
-            if (isinstance(k, dict) and k.get("platform") == "tpu"
-                    and not flash_smoke_ok(k)):
-                print("[chip_session]   flash smoke not ok; headline uses "
-                      "reference attention", file=sys.stderr)
+            if not flash_smoke_ok(raw.get("kernels")):
+                print("[chip_session]   flash smoke not ok (or not run); "
+                      "headline uses reference attention", file=sys.stderr)
                 cmd = cmd + ["--attn", "reference"]
         out, err = _run_json(cmd, timeout_s)
         if out is None:
@@ -247,11 +247,15 @@ def main(argv=None) -> None:
     # move the headline number, not to sit in a table. Scoped like a
     # follow-on of the sweep step (skipped under an --only that excludes
     # it); a previously-errored attempt is retried like any other step.
+    from benchmarks import flash_smoke_ok as _fso
+
     sweep_step = next(i for i, (k, _, _) in enumerate(STEPS, start=1)
                       if k == "block_sweep_s2048")
     bs = raw.get("block_sweep_s2048")
     tuned_prev = raw.get("headline_tuned")
     if (sweep_step in which
+            and _fso(raw.get("kernels"))  # tuned tiles ARE flash tiles —
+            # never publish a tuned flash headline past a failed smoke
             and isinstance(bs, dict) and bs.get("best")
             and bs["best"] != "bq128_bk128"
             and (tuned_prev is None or "error" in tuned_prev)):

@@ -320,3 +320,15 @@ def test_chip_session_measured_distillation(tmp_path, monkeypatch):
     cs._write_measured(raw)
     backup = json.loads((tmp_path / "tpu_measured_prev.json").read_text())
     assert backup["measured_commit"] == prev_commit
+
+
+def test_profile_capture_cpu(tmp_path, capsys):
+    import json
+
+    from benchmarks.profile_capture import main as prof_main
+
+    prof_main(["--out", str(tmp_path / "tr"), "--steps", "2"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["platform"] == "cpu"
+    assert out["files"] >= 1  # the runtime wrote trace artifacts
+    assert out["step_ms"] > 0
