@@ -107,6 +107,32 @@ def run_smoke() -> dict:
     except Exception as e:  # noqa: BLE001
         out["flash_window_bwd"] = _short(e)
 
+    # GQA x window COMBINED: the kv-head index maps and the window's k-loop
+    # bounds compose in one kernel — reachable from the public API
+    # (n_kv_heads + attn_window together), and a combination Mosaic could
+    # reject even when each passes alone.
+    gwref = attention_reference(
+        q, jnp.repeat(kv, 2, axis=2), jnp.repeat(kv, 2, axis=2), True,
+        window=192)
+    try:
+        o = jax.jit(lambda q, kv: flash_attention(q, kv, kv, True,
+                                                  window=192))(q, kv)
+        err = _parity(o, gwref)
+        out["flash_gqa_window_fwd"] = "ok" if err < 0.02 else f"parity {err:.3e}"
+    except Exception as e:  # noqa: BLE001
+        out["flash_gqa_window_fwd"] = _short(e)
+
+    try:
+        g = jax.jit(jax.grad(
+            lambda kv: jnp.sum(flash_attention(q, kv, kv, True, window=192))))(kv)
+        gr = jax.jit(jax.grad(lambda kv: jnp.sum(attention_reference(
+            q, jnp.repeat(kv, 2, axis=2), jnp.repeat(kv, 2, axis=2), True,
+            window=192))))(kv)
+        err = _parity(g, gr)
+        out["flash_gqa_window_bwd"] = "ok" if err < 0.06 else f"parity {err:.3e}"
+    except Exception as e:  # noqa: BLE001
+        out["flash_gqa_window_bwd"] = _short(e)
+
     return out
 
 

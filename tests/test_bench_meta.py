@@ -272,7 +272,8 @@ def test_kernel_smoke_window_entries_cpu():
 
     out = run_smoke()
     for k in ("flash_fwd", "flash_bwd", "flash_gqa_fwd", "flash_gqa_bwd",
-              "flash_window_fwd", "flash_window_bwd"):
+              "flash_window_fwd", "flash_window_bwd",
+              "flash_gqa_window_fwd", "flash_gqa_window_bwd"):
         assert out[k] == "ok", f"{k}: {out[k]}"
 
 

@@ -319,3 +319,16 @@ def test_sampling_knob_validation():
         generate(model, params, prompt, 2, temperature=1.0, top_k=0)
     with pytest.raises(ValueError, match="top_p"):
         generate(model, params, prompt, 2, temperature=1.0, top_p=0.0)
+
+
+def test_gqa_sliding_window_flash_matches_reference():
+    # The combined kernel program (kv-head index maps + window k-loop
+    # bounds) — parity at the model level, matching the new smoke entry.
+    ref = _tiny(n_kv_heads=2, attn_window=12, attn_impl="reference")
+    fla = _tiny(n_kv_heads=2, attn_window=12, attn_impl="flash")
+    params, toks = _params(ref, b=1, s=128)
+    np.testing.assert_allclose(
+        np.asarray(fla.apply({"params": params}, toks)),
+        np.asarray(ref.apply({"params": params}, toks)),
+        atol=2e-2, rtol=2e-2,
+    )
