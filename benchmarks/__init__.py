@@ -119,6 +119,8 @@ def spawn_ranks(target, world: int, extra_args=(), timeout: float = 600.0) -> di
     {rank: payload}. Workers are always joined/killed, even if a rank dies
     without reporting (a native-layer crash posts nothing).
     """
+    import queue as queue_mod
+
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
     port = free_port()
@@ -131,11 +133,21 @@ def spawn_ranks(target, world: int, extra_args=(), timeout: float = 600.0) -> di
     results: dict = {}
     try:
         for _ in range(world):
-            rank, payload = q.get(timeout=timeout)
+            try:
+                rank, payload = q.get(timeout=timeout)
+            except queue_mod.Empty:
+                break  # diagnosed below with exit codes, not a raw traceback
             results[rank] = payload
     finally:
         for p in procs:
             p.join(timeout=30)
             if p.is_alive():
                 p.kill()
+                p.join()  # reap, so exitcode below reads -SIGKILL, not None
+    if len(results) < world:
+        missing = sorted(set(range(world)) - results.keys())
+        codes = {r: procs[r].exitcode for r in missing}
+        raise SystemExit(
+            f"ranks {missing} never reported within {timeout}s "
+            f"(exit codes {codes}) — native-layer crash or hang?")
     return results

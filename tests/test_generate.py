@@ -337,10 +337,12 @@ def test_gqa_sliding_window_flash_matches_reference():
 def test_generate_tp_dp_sharded_matches_replicated():
     """Multi-chip inference: generate() jitted over a dp x mdl mesh with
     Megatron-sharded params (and a GQA cache sharded along with its kv
-    heads) must match the replicated run EXACTLY — greedy decoding has one
-    right answer. GSPMD propagates the param shardings through prefill,
-    the cache update loop, and the lm head; no inference-specific
-    partition code exists or is needed."""
+    heads). GSPMD propagates the param shardings through prefill, the
+    cache update loop, and the lm head; no inference-specific partition
+    code exists or is needed. The mdl all-reduce reassociates float sums
+    (~1e-6 logit noise), so — like the greedy oracle test above — the
+    assertion is tie-tolerant: every sharded token must be a NEAR-argmax
+    of the replicated model's logits on the sharded run's own prefix."""
     from functools import partial
 
     from tpunet.models import transformer_partition_rules
@@ -360,4 +362,12 @@ def test_generate_tp_dp_sharded_matches_replicated():
     with mesh:
         got = jax.jit(partial(generate, model, max_new_tokens=6))(
             params_sh, toks_sh)
-    np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+    assert got.shape == expected.shape
+    np.testing.assert_array_equal(np.asarray(got[:, :12]), np.asarray(toks))
+    for i in range(6):
+        logits = model.apply({"params": params}, got[:, : 12 + i])[:, -1, :]
+        chosen = np.take_along_axis(
+            np.asarray(logits), np.asarray(got[:, 12 + i])[:, None], axis=1
+        )[:, 0]
+        np.testing.assert_allclose(
+            chosen, np.max(np.asarray(logits), axis=1), atol=1e-3)
