@@ -200,6 +200,31 @@ def _model_tier(tpu_up: bool, kernels: dict | None) -> dict | None:
     return None
 
 
+def _decode_tier(tpu_up: bool, model_tier: dict | None) -> dict | None:
+    """Inference tier: one on-chip decode number (GQA, the KV-cache
+    capability's headline config). The full decode/attribution set is
+    benchmarks.chip_session's job; bench carries one live datapoint.
+    Returns None unless the result actually ran on the chip — a tunnel
+    drop between tiers makes decode_bench silently fall back to CPU, and
+    a CPU number must not pose as the on-chip datapoint."""
+    if not tpu_up or (model_tier or {}).get("platform") != "tpu":
+        return None
+    decode, err = _run_json_tool(
+        ["-m", "benchmarks.decode_bench", "--platform", "tpu",
+         "--d", "2048", "--layers", "12", "--heads", "16", "--ff", "8192",
+         "--batch", "8", "--prompt", "512", "--new", "128",
+         "--kv-heads", "4"], 1500)
+    if decode is None:
+        print(f"[bench] decode tier failed: {err}", file=sys.stderr)
+        return None
+    if decode.get("platform") != "tpu":
+        print(f"[bench] decode tier ran on {decode.get('platform')}, "
+              "not tpu; dropping it", file=sys.stderr)
+        return None
+    print(f"[bench] decode tier: {decode}", file=sys.stderr)
+    return decode
+
+
 def main() -> None:
     # Make sure the native library exists before timing anything.
     from tpunet import _native
@@ -237,26 +262,7 @@ def main() -> None:
     model_tier = _model_tier(tpu_up, kernels)
     if model_tier is not None:
         print(f"[bench] model tier: {model_tier}", file=sys.stderr)
-    # Inference tier: one on-chip decode number (GQA, the KV-cache
-    # capability's headline config). The full decode/attribution set is
-    # benchmarks.chip_session's job; bench carries one live datapoint.
-    decode = None
-    if tpu_up and (model_tier or {}).get("platform") == "tpu":
-        decode, err = _run_json_tool(
-            ["-m", "benchmarks.decode_bench", "--platform", "tpu",
-             "--d", "2048", "--layers", "12", "--heads", "16", "--ff", "8192",
-             "--batch", "8", "--prompt", "512", "--new", "128",
-             "--kv-heads", "4"], 1500)
-        if decode is None:
-            print(f"[bench] decode tier failed: {err}", file=sys.stderr)
-        elif decode.get("platform") != "tpu":
-            # Tunnel dropped between tiers: decode_bench silently fell back
-            # to CPU — a CPU number must not pose as the on-chip datapoint.
-            print(f"[bench] decode tier ran on {decode.get('platform')}, "
-                  "not tpu; dropping it", file=sys.stderr)
-            decode = None
-        else:
-            print(f"[bench] decode tier: {decode}", file=sys.stderr)
+    decode = _decode_tier(tpu_up, model_tier)
 
     # The committed real-chip measurement (benchmarks.chip_session output)
     # is attached UNCONDITIONALLY with explicit provenance and a mechanical

@@ -333,3 +333,34 @@ def test_profile_capture_cpu(tmp_path, capsys):
     assert out["platform"] == "cpu"
     assert out["files"] >= 1  # the runtime wrote trace artifacts
     assert out["step_ms"] > 0
+
+
+def test_decode_tier_gating():
+    import json
+    import unittest.mock as mock
+
+    import bench
+
+    def fake(stdout):
+        class _P:
+            returncode = 0
+            stderr = ""
+        _P.stdout = json.dumps(stdout)
+        return lambda *a, **k: _P
+
+    # Chip up + TPU model tier + TPU decode: kept.
+    with mock.patch("subprocess.run",
+                    side_effect=fake({"platform": "tpu", "decode_tok_s": 9})):
+        out = bench._decode_tier(True, {"platform": "tpu"})
+    assert out["decode_tok_s"] == 9
+
+    # decode_bench silently fell back to CPU (tunnel dropped mid-bench):
+    # the datapoint must be DROPPED, not published as on-chip.
+    with mock.patch("subprocess.run",
+                    side_effect=fake({"platform": "cpu", "decode_tok_s": 9})):
+        assert bench._decode_tier(True, {"platform": "tpu"}) is None
+
+    # No TPU model tier -> never even attempts the subprocess.
+    with mock.patch("subprocess.run", side_effect=AssertionError):
+        assert bench._decode_tier(True, {"platform": "cpu"}) is None
+        assert bench._decode_tier(False, None) is None
