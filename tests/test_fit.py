@@ -123,3 +123,40 @@ def test_fit_zero_steps_still_checkpoints(setup, tmp_path):
         assert mgr.latest_step() == 0
     finally:
         mgr.close()
+
+
+def test_fit_eval_hook_cadence_and_final(setup):
+    ds, state, step = setup
+    records = []
+
+    def eval_fn(st):
+        # A real eval: loss on one held-out batch via the model apply.
+        return {"seen_step": int(st.step)}
+
+    fit(state, step, _batches(ds), steps=7, eval_every=3,
+        eval_fn=eval_fn, log_fn=records.append)
+    evals = [m for m in records if "eval" in m]
+    # Cadence at 3 and 6, final at 7 — the eval sees the CURRENT state.
+    assert [m["step"] for m in evals] == [3, 6, 7]
+    assert all(m["eval"]["seen_step"] == m["step"] for m in evals)
+
+
+def test_fit_eval_fn_final_only(setup):
+    ds, state, step = setup
+    records = []
+    fit(state, step, _batches(ds), steps=4, eval_fn=lambda st: {"ok": 1},
+        log_fn=records.append)
+    evals = [m for m in records if "eval" in m]
+    assert [m["step"] for m in evals] == [4]
+
+
+def test_fit_eval_no_double_eval_on_exhaustion(setup):
+    import itertools
+
+    ds, state, step = setup
+    records = []
+    few = list(itertools.islice(_batches(ds), 6))  # exhausts AT an eval point
+    fit(state, step, iter(few), steps=10, eval_every=3,
+        eval_fn=lambda st: {"n": 1}, log_fn=records.append)
+    evals = [m["step"] for m in records if "eval" in m]
+    assert evals == [3, 6]  # step 6: cadence eval only, not a duplicate final

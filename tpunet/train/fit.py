@@ -36,6 +36,8 @@ def fit(
     max_to_keep: int = 3,
     log_every: int = 0,
     log_fn: Callable[[dict[str, Any]], None] | None = None,
+    eval_every: int = 0,
+    eval_fn: Callable[[TrainState], dict[str, Any]] | None = None,
     skip_batches_on_resume: bool = False,
     prefetch: int = 0,
     prefetch_sharding=None,
@@ -55,9 +57,18 @@ def fit(
     checkpoint_every: save every k steps (and once at the end) when
         checkpoint_dir is set; 0 = only the final save.
     log_fn: called with {"step", "loss", "steps_per_s"} every `log_every`
-        steps (default print). Loss is fetched to host ONLY at log/final
-        steps — fetching every step would serialize dispatch (and on the
-        tunneled TPU platform per-step sync is wrong anyway, PERF_NOTES).
+        steps (default print), AND — when eval_fn is set — with
+        {"step", "eval": {...}} records at eval points: log_fn
+        implementations must dispatch on the presence of the "eval" key.
+        Loss is fetched to host ONLY at log/final steps — fetching every
+        step would serialize dispatch (and on the tunneled TPU platform
+        per-step sync is wrong anyway, PERF_NOTES).
+    eval_fn: called with the CURRENT state every `eval_every` steps (and
+        once after the final step); its returned metrics dict is passed to
+        log_fn with the step under {"step", "eval": {...}}. Run your eval
+        set inside it with a jitted eval step — fit() stays agnostic to
+        what "evaluation" means. eval_every=0 with an eval_fn set means
+        final-step evaluation only.
     skip_batches_on_resume: when resuming at step k, first discard k
         batches from the iterator, so a deterministic stream (e.g.
         token_batches with a fixed seed) lines up exactly where the
@@ -90,9 +101,14 @@ def fit(
             if restored is not None and int(restored.step) > int(state.step):
                 state = restored
 
-        log = log_fn or (lambda m: print(
-            f"[fit] step {m['step']} loss {m['loss']:.4f} "
-            f"({m['steps_per_s']:.2f} steps/s)", flush=True))
+        def _default_log(m):
+            if "eval" in m:
+                print(f"[fit] step {m['step']} eval {m['eval']}", flush=True)
+            else:
+                print(f"[fit] step {m['step']} loss {m['loss']:.4f} "
+                      f"({m['steps_per_s']:.2f} steps/s)", flush=True)
+
+        log = log_fn or _default_log
         it = iter(batches)
         loss = None
         t0 = time.perf_counter()
@@ -103,6 +119,7 @@ def fit(
         done = int(state.step)
         start_step = done
         window_start = done
+        last_eval_step = -1
         if skip_batches_on_resume and done:
             for _ in range(done):
                 next(it, None)
@@ -128,8 +145,22 @@ def fit(
                 })
                 t0 = time.perf_counter()
                 window_start = done
+            if (eval_fn is not None and eval_every
+                    and done % eval_every == 0 and done < steps):
+                log({"step": done, "eval": eval_fn(state)})
+                last_eval_step = done
+                # Eval wall time must not deflate the NEXT window's
+                # steps_per_s: restart the throughput window after it.
+                t0 = time.perf_counter()
+                window_start = done
             if mgr is not None and checkpoint_every and done % checkpoint_every == 0:
                 mgr.save(done, state)
+        if eval_fn is not None and done > start_step and done != last_eval_step:
+            # Final evaluation on the finished state (also covers runs whose
+            # stream ended early) — skipped for pure no-op re-invocations and
+            # when the cadence already evaluated this exact step (a stream
+            # exhausted right at an eval point must not eval twice).
+            log({"step": done, "eval": eval_fn(state)})
         if mgr is not None:
             if done == start_step and start_step < steps:
                 # The schedule wanted more steps but the stream yielded
