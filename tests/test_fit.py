@@ -130,7 +130,8 @@ def test_fit_eval_hook_cadence_and_final(setup):
     records = []
 
     def eval_fn(st):
-        # A real eval: loss on one held-out batch via the model apply.
+        # Minimal probe: records WHICH state the hook saw (cadence is the
+        # property under test; real callers run a jitted eval step here).
         return {"seen_step": int(st.step)}
 
     fit(state, step, _batches(ds), steps=7, eval_every=3,
