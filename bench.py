@@ -135,12 +135,44 @@ def _kernel_smoke(tpu_up: bool) -> dict | None:
 MEASURED_PATHS = ("tpunet/ops", "tpunet/models", "tpunet/train",
                   "benchmarks/tpu_headline.py", "benchmarks/__init__.py")
 
+# Step scripts whose edits invalidate the OTHER fields a chip_session
+# writes into the measured file (decode set, attribution, sweeps) —
+# chip_session.py itself is deliberately absent: pure orchestration changes
+# re-measure nothing, and its parameter table is covered by the
+# steps_fingerprint chip_session records. One constant, shared by the
+# replay stamp below and chip_session's resume check, so the two can't
+# disagree about what "the measured code" means.
+SESSION_SCRIPT_PATHS = ("benchmarks/kernel_smoke.py",
+                        "benchmarks/decode_bench.py",
+                        "benchmarks/mfu_attribution.py",
+                        "benchmarks/mfu_sweep.py")
 
-def _measurement_staleness(measured_commit: str | None) -> dict:
+
+def _dirty_paths(paths: tuple, repo: str | None = None) -> list[str] | None:
+    """Uncommitted (incl. untracked) files under `paths`, or None when
+    undecidable (git failed/timed out) — callers must treat None
+    conservatively, not as clean."""
+    repo = repo or os.path.dirname(os.path.abspath(__file__))
+    try:
+        st = subprocess.run(
+            ["git", "status", "--porcelain", "--", *paths],
+            capture_output=True, text=True, timeout=30, cwd=repo)
+        if st.returncode != 0:
+            return None
+        return sorted({ln[3:].strip() for ln in st.stdout.splitlines()
+                       if ln.strip()})
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+
+
+def _measurement_staleness(measured_commit: str | None,
+                           paths: tuple = MEASURED_PATHS) -> dict:
     """Self-checking replay provenance: diff the measured commit against HEAD
     over the measured code paths and report `stale` mechanically, instead of
     asserting freshness in a static file (which is guaranteed to rot).
-    Uncommitted edits to those paths also count as stale."""
+    Uncommitted edits to those paths also count as stale. `paths` lets
+    callers with a wider validity surface (chip_session resume adds its
+    step scripts) reuse this one audited implementation."""
     repo = os.path.dirname(os.path.abspath(__file__))
     parts = (measured_commit or "").split()
     commit = parts[0] if parts else ""
@@ -149,18 +181,20 @@ def _measurement_staleness(measured_commit: str | None) -> dict:
     try:
         p = subprocess.run(
             ["git", "diff", "--name-only", f"{commit}..HEAD", "--",
-             *MEASURED_PATHS],
+             *paths],
             capture_output=True, text=True, timeout=30, cwd=repo)
         if p.returncode != 0:
             return {"stale": None,
                     "error": (p.stderr.strip() or "git diff failed")[-200:]}
         changed = sorted({ln.strip() for ln in p.stdout.splitlines()
                           if ln.strip()})
-        st = subprocess.run(
-            ["git", "status", "--porcelain", "--", *MEASURED_PATHS],
-            capture_output=True, text=True, timeout=30, cwd=repo)
-        dirty = sorted({ln[3:].strip() for ln in st.stdout.splitlines()
-                        if ln.strip()}) if st.returncode == 0 else []
+        dirty = _dirty_paths(paths, repo)
+        if dirty is None:
+            # Committed history may already prove staleness; only a CLEAN
+            # verdict needs the working-tree scan to have succeeded.
+            if changed:
+                return {"stale": True, "changed_files": changed}
+            return {"stale": None, "error": "git status failed"}
         out = {"stale": bool(changed or dirty), "changed_files": changed}
         if dirty:
             out["uncommitted_files"] = dirty
@@ -277,13 +311,27 @@ def main() -> None:
             loaded = json.load(f)
         if isinstance(loaded, dict):
             tpu_last_measured = loaded
+            # The file carries more than the model tier (decode set,
+            # attribution, sweeps), so its validity surface is the session
+            # scripts too — same path set chip_session's resume check uses.
             staleness = _measurement_staleness(
-                loaded.get("measured_commit"))
+                loaded.get("measured_commit"),
+                paths=MEASURED_PATHS + SESSION_SCRIPT_PATHS)
+            dirty_at_measure = loaded.get("uncommitted_at_measurement")
+            if dirty_at_measure:
+                # Measured with uncommitted edits: unreproducible from the
+                # stamped commit no matter what HEAD looks like now.
+                staleness = {**staleness, "stale": True,
+                             "dirty_at_measurement": dirty_at_measure}
             tpu_last_measured["staleness"] = staleness
             stale_note = (
-                "STALE — measured paths changed since: "
-                + ", ".join(staleness.get("changed_files", [])
-                            + staleness.get("uncommitted_files", []))
+                "STALE — "
+                + ("measured with uncommitted edits: "
+                   + ", ".join(dirty_at_measure)
+                   if dirty_at_measure else
+                   "measured paths changed since: "
+                   + ", ".join(staleness.get("changed_files", [])
+                               + staleness.get("uncommitted_files", [])))
                 if staleness.get("stale")
                 else "fresh (measured paths unchanged at HEAD)"
                 if staleness.get("stale") is False

@@ -91,15 +91,61 @@ STEPS: list[tuple[str, list[str], int]] = [
 ]
 
 
-def _persist(raw: dict) -> None:
+def _persist(raw: dict, launch_dirty=None) -> None:
     """Atomically write the resume log AND refresh the distilled measured
     file — the one persistence path both the step loop and the tuned pass
-    use."""
+    use. Provenance recorded alongside the results: the STEPS fingerprint
+    (so a later edit to a step's argv — batch size, seq, flags — can't
+    silently reuse results measured under the old parameters) and any
+    uncommitted edits to the measured paths (so a bare commit hash never
+    misrepresents a dirty-tree measurement). `launch_dirty` carries dirt
+    observed when steps LAUNCHED — an edit present at launch is what the
+    subprocess imported and measured, even if reverted before the step
+    finished; persist-time sampling alone would record it clean."""
+    dirty = sorted(set(_dirty_measured_paths()) | set(launch_dirty or ()))
+    rec = {"commit": _head_commit(), "measured_at": _now(),
+           "steps_fingerprint": _steps_fingerprint(), "results": raw}
+    if dirty:
+        rec["dirty"] = dirty
     with open(RAW + ".tmp", "w") as f:
-        json.dump({"commit": _head_commit(), "measured_at": _now(),
-                   "results": raw}, f, indent=2)
+        json.dump(rec, f, indent=2)
     os.replace(RAW + ".tmp", RAW)
-    _write_measured(raw)
+    _write_measured(raw, dirty)
+
+
+# The tuned-pass argv template (sweep winner's tiles substituted in) and the
+# failed-smoke fallback flags — lifted to constants so the fingerprint can
+# cover EVERY argv this module launches, including the two built outside
+# STEPS in main().
+TUNED_HEADLINE_ARGV = ["-m", "benchmarks.tpu_headline", "--platform", "tpu",
+                       "--block-q", "{bq}", "--block-k", "{bk}"]
+ATTN_FALLBACK_FLAGS = ["--attn", "reference"]
+
+
+def _steps_fingerprint() -> str:
+    """Hash of every measurement parameter this module can launch: the
+    STEPS argvs (timeouts excluded — a timeout bump is pure orchestration
+    and must not discard a session) plus the dynamically-built tuned-pass
+    and smoke-fallback argvs."""
+    import hashlib
+
+    surface = ([[k, a] for k, a, _ in STEPS]
+               + [TUNED_HEADLINE_ARGV, ATTN_FALLBACK_FLAGS])
+    return hashlib.sha256(
+        json.dumps(surface, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def _dirty_measured_paths() -> list[str]:
+    """Uncommitted (incl. untracked) files under the measurement-validity
+    paths. Undecidable (git failure) records an explicit sentinel — a
+    failure must not block persisting results, but it must not record
+    clean provenance either (the sentinel blocks resume and is surfaced in
+    the measured file like any dirty entry)."""
+    import bench
+
+    dirty = bench._dirty_paths(
+        bench.MEASURED_PATHS + bench.SESSION_SCRIPT_PATHS, repo=REPO)
+    return dirty if dirty is not None else ["<undecidable: git status failed>"]
 
 
 def _tpu_alive(timeout_s: int = 90) -> bool:
@@ -113,7 +159,7 @@ def _tpu_alive(timeout_s: int = 90) -> bool:
         return False
 
 
-def _write_measured(raw: dict) -> None:
+def _write_measured(raw: dict, dirty: list[str] | None = None) -> None:
     """Distill the raw session results into the bench.py replay file. Only
     fields actually measured are written — a partial session yields a
     partial but HONEST measured file (bare commit hash, no prose claims).
@@ -122,21 +168,14 @@ def _write_measured(raw: dict) -> None:
     first real write this session backs the old file up alongside."""
     if not any(isinstance(v, dict) and "error" not in v for v in raw.values()):
         return
-    if os.path.exists(MEASURED):
-        try:
-            with open(MEASURED) as f:
-                prev = json.load(f)
-            if prev.get("measured_commit") != _head_commit():
-                with open(MEASURED.replace(".json", "_prev.json"), "w") as f:
-                    json.dump(prev, f, indent=2)
-                    f.write("\n")
-        except (OSError, ValueError):
-            pass
     out: dict = {
         "measured_at": _now(),
         "measured_commit": _head_commit(),
         "platform": "tpu",
     }
+    if dirty:
+        # The hash alone would misrepresent a dirty-tree measurement.
+        out["uncommitted_at_measurement"] = dirty
     head = raw.get("headline") or {}
     if head.get("platform") == "tpu":
         out.update({
@@ -179,11 +218,57 @@ def _write_measured(raw: dict) -> None:
     out["note"] = ("Captured by benchmarks.chip_session while the tunnel "
                    "was up; bench.py replays this file (with a mechanical "
                    "staleness stamp) when the tunnel is down at bench time.")
+    if os.path.exists(MEASURED):
+        # Back up the previous file whenever this write changes its
+        # provenance or loses measured fields — "same commit" does NOT
+        # imply "same provenance" (a dirty-tree partial re-run at the same
+        # commit must not silently clobber a clean complete session).
+        try:
+            with open(MEASURED) as f:
+                prev = json.load(f)
+            volatile = {"measured_at", "staleness", "note"}
+            if (prev.get("measured_commit") != out["measured_commit"]
+                    or prev.get("uncommitted_at_measurement")
+                    != out.get("uncommitted_at_measurement")
+                    or (set(prev) - set(out) - volatile)):
+                with open(MEASURED.replace(".json", "_prev.json"), "w") as f:
+                    json.dump(prev, f, indent=2)
+                    f.write("\n")
+        except (OSError, ValueError):
+            pass
     tmp = MEASURED + ".tmp"
     with open(tmp, "w") as f:
         json.dump(out, f, indent=2)
         f.write("\n")
     os.replace(tmp, MEASURED)
+
+
+def _resume_ok(prev: dict) -> bool:
+    """Resume a prior session's results iff what they measured is what a
+    fresh run would measure.
+
+    Commit-hash equality was the round-4 first cut, but it discards a whole
+    session the moment ANY commit lands — including the commit that records
+    the session's own measurements. Three checks replace it:
+    - the STEPS fingerprint matches (a parameter edit — batch, seq, flags —
+      invalidates; a pure orchestration edit does not; a legacy raw file
+      without a fingerprint never resumes),
+    - the prior session's tree was clean over the measured paths (results
+      measured with uncommitted kernel edits are unreproducible — the edit
+      may since have been reverted with no diff to show for it),
+    - bench.py's staleness check over the measured code paths + step
+      scripts reads clean; `stale is None` (bad commit, git failure or
+      timeout) means provenance is undecidable — no resume, re-measure."""
+    import bench
+
+    if prev.get("steps_fingerprint") != _steps_fingerprint():
+        return False
+    if prev.get("dirty"):
+        return False
+    st = bench._measurement_staleness(
+        prev.get("commit"),
+        paths=bench.MEASURED_PATHS + bench.SESSION_SCRIPT_PATHS)
+    return st.get("stale") is False
 
 
 def main(argv=None) -> None:
@@ -202,14 +287,15 @@ def main(argv=None) -> None:
         try:
             with open(RAW) as f:
                 prev = json.load(f)
-            if prev.get("commit") == _head_commit():
-                raw = prev.get("results", {})  # resume same-commit session
+            if _resume_ok(prev):
+                raw = prev.get("results", {})
         except (OSError, ValueError):
             pass
 
     which = (set(args.only) if args.only
              else set(range(1, len(STEPS) + 1)))
     status: dict = {}
+    launch_dirty: set = set()  # dirt observed at any step launch, sticky
     for i, (key, cmd, timeout_s) in enumerate(STEPS, start=1):
         if i not in which:
             continue
@@ -230,7 +316,10 @@ def main(argv=None) -> None:
             if not flash_smoke_ok(raw.get("kernels")):
                 print("[chip_session]   flash smoke not ok (or not run); "
                       "headline uses reference attention", file=sys.stderr)
-                cmd = cmd + ["--attn", "reference"]
+                cmd = cmd + ATTN_FALLBACK_FLAGS
+        # Sample dirt at LAUNCH: the subprocess imports the tree as it is
+        # now — an edit reverted mid-step must still taint this session.
+        launch_dirty |= set(_dirty_measured_paths())
         out, err = _run_json(cmd, timeout_s)
         if out is None:
             raw[key] = {"error": err}
@@ -239,7 +328,7 @@ def main(argv=None) -> None:
             raw[key] = out
             status[key] = "ok"
         # Persist after EVERY step: a tunnel death loses nothing captured.
-        _persist(raw)
+        _persist(raw, launch_dirty)
         print(f"[chip_session]   {key}: {status[key]}", file=sys.stderr)
 
     # Apply-the-sweep pass: if the s2048 block sweep crowned a non-default
@@ -263,13 +352,14 @@ def main(argv=None) -> None:
         if m:
             print(f"[chip_session] re-measuring headline with swept blocks "
                   f"{bs['best']} ...", file=sys.stderr)
+            launch_dirty |= set(_dirty_measured_paths())
             out, err = _run_json(
-                ["-m", "benchmarks.tpu_headline", "--platform", "tpu",
-                 "--block-q", m.group(1), "--block-k", m.group(2)], 2400)
+                [arg.format(bq=m.group(1), bk=m.group(2))
+                 for arg in TUNED_HEADLINE_ARGV], 2400)
             raw["headline_tuned"] = out if out is not None else {"error": err}
             status["headline_tuned"] = ("ok" if out is not None
                                         else f"FAILED: {err[:120]}")
-            _persist(raw)
+            _persist(raw, launch_dirty)
 
     print(json.dumps({"commit": _head_commit(), "status": status,
                       "measured_file": MEASURED}))

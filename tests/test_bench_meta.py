@@ -323,6 +323,127 @@ def test_chip_session_measured_distillation(tmp_path, monkeypatch):
     assert backup["measured_commit"] == prev_commit
 
 
+def test_chip_session_resume_survives_artifact_commits(monkeypatch):
+    """A commit that only records measurement artifacts must NOT invalidate
+    the session cache (the first cut compared commit hashes, so committing
+    a session's own results discarded the session); an edit to the measured
+    code or a step script must."""
+    from benchmarks import chip_session as cs
+
+    import bench
+
+    fp = cs._steps_fingerprint()
+    good = {"commit": "abc1234", "steps_fingerprint": fp, "results": {}}
+
+    # The fingerprint covers argvs (incl. the tuned-pass template) but NOT
+    # timeouts — a timeout bump is orchestration, not a measured parameter.
+    orig_steps, orig_tuned = cs.STEPS, cs.TUNED_HEADLINE_ARGV
+    k0, a0, t0 = orig_steps[0]
+    monkeypatch.setattr(cs, "STEPS", [(k0, a0, t0 + 1)] + orig_steps[1:])
+    assert cs._steps_fingerprint() == fp
+    monkeypatch.setattr(cs, "STEPS",
+                        [(k0, a0 + ["--x"], t0)] + orig_steps[1:])
+    assert cs._steps_fingerprint() != fp
+    monkeypatch.setattr(cs, "STEPS", orig_steps)
+    monkeypatch.setattr(cs, "TUNED_HEADLINE_ARGV",
+                        orig_tuned + ["--seq", "8192"])
+    assert cs._steps_fingerprint() != fp
+    monkeypatch.setattr(cs, "TUNED_HEADLINE_ARGV", orig_tuned)
+    assert cs._steps_fingerprint() == fp
+
+    assert cs._resume_ok({}) is False  # legacy file: no fingerprint
+    assert cs._resume_ok({"steps_fingerprint": fp}) is False  # bad commit
+
+    # The staleness check must run over bench's paths PLUS the step
+    # scripts — a decode_bench.py edit invalidates cached decode numbers
+    # even though bench.py's replay wouldn't care.
+    seen = {}
+
+    def fake_staleness(commit, paths=bench.MEASURED_PATHS):
+        seen["commit"], seen["paths"] = commit, paths
+        return {"stale": False, "changed_files": []}
+
+    monkeypatch.setattr(bench, "_measurement_staleness", fake_staleness)
+    assert cs._resume_ok(good) is True  # clean -> resume, any commit
+    assert seen["commit"] == "abc1234"
+    assert "benchmarks/decode_bench.py" in seen["paths"]
+    assert set(bench.MEASURED_PATHS) <= set(seen["paths"])
+
+    # A STEPS-argv edit (different fingerprint) or a session measured with
+    # a dirty tree never resumes, even when git reads clean NOW.
+    assert cs._resume_ok({**good, "steps_fingerprint": "0" * 16}) is False
+    assert cs._resume_ok(
+        {**good, "dirty": ["tpunet/ops/flash_attention.py"]}) is False
+
+    # Any reported staleness (or undecidable None) breaks resume.
+    monkeypatch.setattr(
+        bench, "_measurement_staleness",
+        lambda c, paths=None: {"stale": True,
+                               "changed_files": ["tpunet/ops/x.py"]})
+    assert cs._resume_ok(good) is False
+    monkeypatch.setattr(
+        bench, "_measurement_staleness",
+        lambda c, paths=None: {"stale": None, "error": "git timeout"})
+    assert cs._resume_ok(good) is False
+
+
+def test_chip_session_dirty_tree_is_recorded(tmp_path, monkeypatch):
+    """_persist must record uncommitted measured-path edits and the
+    measured file must surface them — a bare hash alone would claim clean
+    provenance for a dirty-tree measurement."""
+    import json
+
+    from benchmarks import chip_session as cs
+
+    monkeypatch.setattr(cs, "RAW", str(tmp_path / "raw.json"))
+    monkeypatch.setattr(cs, "MEASURED", str(tmp_path / "measured.json"))
+    monkeypatch.setattr(cs, "_dirty_measured_paths",
+                        lambda: ["tpunet/ops/flash_attention.py"])
+    raw = {"headline": {"platform": "tpu", "device_kind": "TPU v5 lite",
+                        "attn": "flash", "tokens_per_s": 1.0, "mfu": 0.1,
+                        "vgg_img_per_s": 1.0}}
+    cs._persist(raw)
+    rec = json.loads((tmp_path / "raw.json").read_text())
+    assert rec["dirty"] == ["tpunet/ops/flash_attention.py"]
+    assert rec["steps_fingerprint"] == cs._steps_fingerprint()
+    assert cs._resume_ok(rec) is False
+    measured = json.loads((tmp_path / "measured.json").read_text())
+    assert measured["uncommitted_at_measurement"] == [
+        "tpunet/ops/flash_attention.py"]
+
+    # Clean tree: no dirty key, resume allowed (staleness permitting).
+    monkeypatch.setattr(cs, "_dirty_measured_paths", lambda: [])
+    cs._persist(raw)
+    rec = json.loads((tmp_path / "raw.json").read_text())
+    assert "dirty" not in rec
+    measured = json.loads((tmp_path / "measured.json").read_text())
+    assert "uncommitted_at_measurement" not in measured
+    # The dirty->clean provenance flip must have backed the old file up.
+    backup = json.loads((tmp_path / "measured_prev.json").read_text())
+    assert backup["uncommitted_at_measurement"] == [
+        "tpunet/ops/flash_attention.py"]
+
+
+def test_dirty_scan_undecidable_is_conservative(monkeypatch):
+    """git failure during the dirty scan must record a sentinel (blocks
+    resume, surfaces in the measured file) and must not let
+    _measurement_staleness report a clean verdict."""
+    import bench
+    from benchmarks import chip_session as cs
+
+    monkeypatch.setattr(bench, "_dirty_paths", lambda paths, repo=None: None)
+    dirty = cs._dirty_measured_paths()
+    assert dirty and "undecidable" in dirty[0]
+
+    head = bench.subprocess.run(
+        ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+        cwd=bench.os.path.dirname(bench.os.path.abspath(bench.__file__)),
+    ).stdout.strip()
+    out = bench._measurement_staleness(head)
+    assert out["stale"] is None  # clean diff + failed scan = undecidable
+    assert "status" in out["error"]
+
+
 def test_profile_capture_cpu(tmp_path, capsys):
     import json
 
