@@ -1,0 +1,137 @@
+"""Schedule-accounting evidence for the zigzag balance claim.
+
+The 1-chip sandbox serializes ring ranks, so contiguous and zigzag causal
+ring attention show the same wall-clock there (both layouts compute the
+same total FLOPs). The claim that zigzag cuts the MULTI-chip critical path
+is pure lockstep-schedule structure; these tests pin it mechanically —
+under BOTH cost models (executed-dense, the wall-clock one; useful-FLOPs,
+the idealized one) — and bind the accounting to the mode function the
+real kernels branch on.
+"""
+
+import jax.numpy as jnp
+import pytest
+
+from benchmarks.cp_balance import (chunk_flops, compare, layout_chunks,
+                                   step_work, summarize)
+
+WORLDS = [2, 4, 8, 16]
+
+
+@pytest.mark.parametrize("world", WORLDS)
+def test_flops_total_is_layout_invariant(world):
+    """Both layouts compute the same causal mask — identical useful FLOPs
+    (2W^2 chunk-units: C(2W,2) full pairs + 2W half-diagonals). The
+    layouts differ only in who does the work when."""
+    cont = summarize(world, "contiguous", "flops")
+    zig = summarize(world, "zigzag", "flops")
+    assert cont["total_units"] == zig["total_units"] == 2.0 * world * world
+
+
+@pytest.mark.parametrize("world", WORLDS)
+@pytest.mark.parametrize("cost", ["executed", "flops"])
+def test_zigzag_is_balanced(world, cost):
+    """Useful FLOPs: exactly 2.0 units per rank per step (the four-case
+    table in zigzag_attention.py's docstring) — the slowest rank IS the
+    mean rank. Executed-dense: the same plus ONE extra unit on each rank's
+    own diagonal step (both diagonal chunk-blocks dispatch dense), so rank
+    totals are all 2W+1 — balanced to within that single unit."""
+    per_step = step_work(world, "zigzag", cost)
+    if cost == "flops":
+        assert all(u == 2.0 for row in per_step for u in row)
+    else:
+        for i, row in enumerate(per_step):
+            # Rank i holds its own shard at step t=0 (src == my).
+            assert row[0] == 3.0
+            assert all(u == 2.0 for u in row[1:])
+    zig = summarize(world, "zigzag", cost)
+    assert zig["slowest_over_mean"] == 1.0
+
+
+@pytest.mark.parametrize("world", WORLDS)
+def test_contiguous_concentrates_on_the_last_rank(world):
+    """No rank skips its own diagonal step, so executed totals are
+    4, 8, ... 4W (the kernel dispatches the diagonal shard-block dense);
+    useful-FLOP totals are 2, 6, ... 4W-2 (half the diagonal is masked).
+    Either way the last rank does ~W times the first rank's work — the
+    imbalance the zigzag layout exists to fix."""
+    cont_x = summarize(world, "contiguous", "executed")
+    assert cont_x["rank_work_units"] == [4.0 * (i + 1) for i in range(world)]
+    cont_f = summarize(world, "contiguous", "flops")
+    assert cont_f["rank_work_units"] == [4.0 * i + 2.0 for i in range(world)]
+
+
+@pytest.mark.parametrize("world", WORLDS)
+def test_critical_path_cut(world):
+    """Executed-dense (wall-clock-proportional): contiguous pays a dense
+    shard-block every step = 4W units; zigzag pays 2W+1. The cut
+    4W/(2W+1) is what a multi-chip wall-clock A/B of THESE kernels would
+    measure: 1.6x at W=2, 1.78x at W=4, 2x from below as W grows.
+    Useful-FLOPs (idealized diagonal kernel): (4W-2)/2W = 2 - 1/W."""
+    cx = compare(world, "executed")
+    assert cx["contiguous"]["critical_path_units"] == 4.0 * world
+    assert cx["zigzag"]["critical_path_units"] == 2.0 * world + 1.0
+    assert cx["critical_path_cut"] == pytest.approx(
+        4.0 * world / (2.0 * world + 1.0), abs=1e-4)
+    cf = compare(world, "flops")
+    assert cf["contiguous"]["critical_path_units"] == 4.0 * world - 2.0
+    assert cf["zigzag"]["critical_path_units"] == 2.0 * world
+    assert cf["critical_path_cut"] == pytest.approx(2.0 - 1.0 / world)
+
+
+@pytest.mark.parametrize("world", WORLDS)
+def test_contiguous_accounting_matches_kernel_mode_function(world):
+    """Bind the accounting to the code: at shard granularity the
+    contiguous schedule dispatches exactly what
+    ring_attention.causal_block_mode selects — and the executed cost is
+    the dispatch structure itself (full and diag BOTH run the dense
+    shard-block: 4 chunk-units; only skip runs nothing), while the
+    useful-FLOP cost halves the diagonal (full=4, diag=2, skip=0)."""
+    from tpunet.parallel.ring_attention import causal_block_mode
+
+    per_step_x = step_work(world, "contiguous", "executed")
+    per_step_f = step_work(world, "contiguous", "flops")
+    executed_units = {0: 4.0, 1: 4.0, 2: 0.0}
+    flops_units = {0: 4.0, 1: 2.0, 2: 0.0}
+    for i in range(world):
+        for t in range(world):
+            s = (i - t) % world
+            mode = int(causal_block_mode(jnp.int32(s), jnp.int32(i)))
+            assert per_step_x[i][t] == executed_units[mode]
+            assert per_step_f[i][t] == flops_units[mode]
+
+
+@pytest.mark.parametrize("world", WORLDS)
+def test_zigzag_static_skip_case(world):
+    """The a_lo x b_hi quadrant NEVER computes (zigzag_attention.py's
+    trace-time skip): rank i's early chunk vs any held late chunk is
+    always fully in the future."""
+    chunks = layout_chunks(world, "zigzag")
+    for i in range(world):
+        a_lo = chunks[i][0]
+        for s in range(world):
+            b_hi = chunks[s][1]
+            assert chunk_flops(a_lo, b_hi) == 0.0
+
+
+def test_layout_chunks_match_zigzag_order():
+    """The accounting's chunk assignment is the real layout: pairs (i,
+    2W-1-i) in exactly zigzag_chunk_order's interleaving."""
+    from tpunet.parallel.zigzag_attention import zigzag_chunk_order
+
+    for world in WORLDS:
+        flat = [c for pair in layout_chunks(world, "zigzag") for c in pair]
+        assert flat == zigzag_chunk_order(world)
+
+
+def test_cli_prints_one_json_line(capsys):
+    import json
+
+    from benchmarks.cp_balance import main
+
+    main(["--worlds", "4"])
+    out = json.loads(capsys.readouterr().out.strip())
+    by = {(c["cost"], c["world"]): c for c in out["comparisons"]}
+    assert by[("executed", 4)]["critical_path_cut"] == pytest.approx(16 / 9,
+                                                                     abs=1e-4)
+    assert by[("flops", 4)]["critical_path_cut"] == 1.75
