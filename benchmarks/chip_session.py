@@ -12,7 +12,7 @@ measurements; re-running resumes the full list.
 Priority order (round-4 verdict):
   1. kernel_smoke        — all flash kernel variants on real Mosaic (gate)
   2. tpu_headline        — tokens/s + MFU + VGG img/s at the headline shape
-  3. decode_bench x3     — MHA, GQA (kv4), sliding-window decode tokens/s
+  3. decode_bench x4     — MHA, GQA (kv4), sliding-window, speculative
   4. mfu_attribution     — per-segment breakdown of the headline step
   5. block sweep s2048   — flash tile grid at the headline seq
   6. block sweep s8192   — flash tile grid at long context
@@ -79,6 +79,11 @@ STEPS: list[tuple[str, list[str], int]] = [
                        "--d", "2048", "--layers", "12", "--heads", "16",
                        "--ff", "8192", "--batch", "8", "--prompt", "512",
                        "--new", "256", "--window", "256"], 1800),
+    ("decode_spec", ["-m", "benchmarks.decode_bench", "--platform", "tpu",
+                     "--d", "2048", "--layers", "12", "--heads", "16",
+                     "--ff", "8192", "--batch", "8", "--prompt", "512",
+                     "--new", "256", "--spec-gamma", "4",
+                     "--draft-layers", "2"], 2400),
     ("attribution", ["-m", "benchmarks.mfu_attribution"], 2400),
     ("block_sweep_s2048", ["-m", "benchmarks.mfu_attribution",
                            "--sweep-blocks", "--blocks", "128", "256", "512"],
@@ -104,7 +109,7 @@ def _persist(raw: dict, launch_dirty=None) -> None:
     finished; persist-time sampling alone would record it clean."""
     dirty = sorted(set(_dirty_measured_paths()) | set(launch_dirty or ()))
     rec = {"commit": _head_commit(), "measured_at": _now(),
-           "steps_fingerprint": _steps_fingerprint(), "results": raw}
+           "step_fps": _step_fingerprints(), "results": raw}
     if dirty:
         rec["dirty"] = dirty
     with open(RAW + ".tmp", "w") as f:
@@ -122,17 +127,31 @@ TUNED_HEADLINE_ARGV = ["-m", "benchmarks.tpu_headline", "--platform", "tpu",
 ATTN_FALLBACK_FLAGS = ["--attn", "reference"]
 
 
-def _steps_fingerprint() -> str:
-    """Hash of every measurement parameter this module can launch: the
-    STEPS argvs (timeouts excluded — a timeout bump is pure orchestration
-    and must not discard a session) plus the dynamically-built tuned-pass
-    and smoke-fallback argvs."""
+def _fp(obj) -> str:
     import hashlib
 
-    surface = ([[k, a] for k, a, _ in STEPS]
-               + [TUNED_HEADLINE_ARGV, ATTN_FALLBACK_FLAGS])
     return hashlib.sha256(
-        json.dumps(surface, sort_keys=True).encode()).hexdigest()[:16]
+        json.dumps(obj, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def _step_fingerprints() -> dict:
+    """PER-STEP hashes of every measurement parameter this module can
+    launch, keyed by result key. Timeouts are excluded — a timeout bump is
+    pure orchestration and must not discard a session — and so is the
+    step LIST itself: adding a new step must not invalidate the other
+    steps' cached results (the round-4 global fingerprint did exactly
+    that). The headline's hash folds in the smoke-fallback flags (they
+    rewrite its argv when the smoke fails); headline_tuned is keyed by the
+    tuned-pass template."""
+    argvs = {k: a for k, a, _ in STEPS}
+    fps = {k: _fp(a) for k, a in argvs.items()}
+    fps["headline"] = _fp([argvs["headline"], ATTN_FALLBACK_FLAGS])
+    # The tuned headline derives from the s2048 sweep's winner, so its
+    # cache validity depends on the sweep's parameters too (and the tuned
+    # pass itself re-checks the crowned tiles against the cached result).
+    fps["headline_tuned"] = _fp([TUNED_HEADLINE_ARGV,
+                                 argvs["block_sweep_s2048"]])
+    return fps
 
 
 def _dirty_measured_paths() -> list[str]:
@@ -197,12 +216,13 @@ def _write_measured(raw: dict, dirty: list[str] | None = None) -> None:
             and tuned.get("platform") == "tpu"):
         out["headline_tuned"] = tuned
     decode = {}
-    for key in ("decode_mha", "decode_gqa", "decode_window"):
+    for key in ("decode_mha", "decode_gqa", "decode_window", "decode_spec"):
         d = raw.get(key)
         if isinstance(d, dict) and d.get("platform") == "tpu":
             decode[key] = {k: d[k] for k in
                            ("decode_tok_s", "wall_s", "kv_heads", "window",
-                            "batch", "prompt", "new") if k in d}
+                            "batch", "prompt", "new", "speculative")
+                           if k in d}
     if decode:
         out["decode"] = decode
     if (isinstance(raw.get("attribution"), dict)
@@ -243,32 +263,36 @@ def _write_measured(raw: dict, dirty: list[str] | None = None) -> None:
     os.replace(tmp, MEASURED)
 
 
-def _resume_ok(prev: dict) -> bool:
-    """Resume a prior session's results iff what they measured is what a
-    fresh run would measure.
+def _resumable_results(prev: dict) -> dict:
+    """The subset of a prior session's results a fresh run may reuse.
 
     Commit-hash equality was the round-4 first cut, but it discards a whole
     session the moment ANY commit lands — including the commit that records
     the session's own measurements. Three checks replace it:
-    - the STEPS fingerprint matches (a parameter edit — batch, seq, flags —
-      invalidates; a pure orchestration edit does not; a legacy raw file
-      without a fingerprint never resumes),
-    - the prior session's tree was clean over the measured paths (results
-      measured with uncommitted kernel edits are unreproducible — the edit
-      may since have been reverted with no diff to show for it),
-    - bench.py's staleness check over the measured code paths + step
-      scripts reads clean; `stale is None` (bad commit, git failure or
-      timeout) means provenance is undecidable — no resume, re-measure."""
+    - session-wide: the prior session's tree was clean over the measured
+      paths (results measured with uncommitted kernel edits are
+      unreproducible — the edit may since have been reverted with no diff
+      to show for it), and bench.py's staleness check over the measured
+      code paths + step scripts reads clean; `stale is None` (bad commit,
+      git failure or timeout) means provenance is undecidable — no resume,
+      re-measure;
+    - per-step: the step's recorded argv fingerprint matches the current
+      one (a parameter edit — batch, seq, flags — invalidates THAT step
+      only; adding a new step or editing another step's argv leaves it
+      cached; a legacy raw file without fingerprints never resumes)."""
     import bench
 
-    if prev.get("steps_fingerprint") != _steps_fingerprint():
-        return False
     if prev.get("dirty"):
-        return False
+        return {}
     st = bench._measurement_staleness(
         prev.get("commit"),
         paths=bench.MEASURED_PATHS + bench.SESSION_SCRIPT_PATHS)
-    return st.get("stale") is False
+    if st.get("stale") is not False:
+        return {}
+    prev_fps = prev.get("step_fps") or {}
+    now_fps = _step_fingerprints()
+    return {k: v for k, v in (prev.get("results") or {}).items()
+            if prev_fps.get(k) and prev_fps.get(k) == now_fps.get(k)}
 
 
 def main(argv=None) -> None:
@@ -287,8 +311,7 @@ def main(argv=None) -> None:
         try:
             with open(RAW) as f:
                 prev = json.load(f)
-            if _resume_ok(prev):
-                raw = prev.get("results", {})
+            raw = _resumable_results(prev)
         except (OSError, ValueError):
             pass
 
@@ -341,14 +364,24 @@ def main(argv=None) -> None:
     sweep_step = next(i for i, (k, _, _) in enumerate(STEPS, start=1)
                       if k == "block_sweep_s2048")
     bs = raw.get("block_sweep_s2048")
+    best = bs.get("best") if isinstance(bs, dict) else None
     tuned_prev = raw.get("headline_tuned")
+    # A cached tuned headline is valid only while its tiles ARE the sweep's
+    # current winner — a re-run sweep that crowns different tiles (or
+    # reverts to the default) voids it, or the published tuned number
+    # would contradict the sweep table sitting next to it.
+    if (isinstance(tuned_prev, dict) and "error" not in tuned_prev and best
+            and f"bq{tuned_prev.get('block_q')}_bk{tuned_prev.get('block_k')}"
+            != best):
+        raw.pop("headline_tuned")
+        tuned_prev = None
+        _persist(raw, launch_dirty)
     if (sweep_step in which
             and _fso(raw.get("kernels"))  # tuned tiles ARE flash tiles —
             # never publish a tuned flash headline past a failed smoke
-            and isinstance(bs, dict) and bs.get("best")
-            and bs["best"] != "bq128_bk128"
+            and best and best != "bq128_bk128"
             and (tuned_prev is None or "error" in tuned_prev)):
-        m = re.match(r"bq(\d+)_bk(\d+)", bs["best"])
+        m = re.match(r"bq(\d+)_bk(\d+)", best)
         if m:
             print(f"[chip_session] re-measuring headline with swept blocks "
                   f"{bs['best']} ...", file=sys.stderr)

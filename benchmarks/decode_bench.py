@@ -38,6 +38,12 @@ def main(argv=None) -> None:
     p.add_argument("--window", type=int, default=None,
                    help="sliding-window attention span (default: full causal)")
     p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--spec-gamma", type=int, default=None,
+                   help="also bench speculative decoding with this draft "
+                        "block length")
+    p.add_argument("--draft-layers", type=int, default=2,
+                   help="draft model depth for --spec-gamma (same d/heads/"
+                        "vocab; random weights)")
     args = p.parse_args(argv)
 
     import jax
@@ -75,6 +81,48 @@ def main(argv=None) -> None:
         times.append(time.perf_counter() - t0)
     best = min(times)
     n_params = sum(x.size for x in jax.tree.leaves(params))
+
+    spec = None
+    if args.spec_gamma is not None:
+        # An UNTRAINED draft can't agree with an untrained target, so the
+        # measured tokens/s here is the acceptance FLOOR. But a round is
+        # the same static program whatever gets accepted — acceptance only
+        # changes how many rounds run — so the same run also yields the
+        # round cost, and with it the perfect-draft CEILING
+        # (gamma+1 committed tokens per round). A real (distilled/trained)
+        # draft lands between floor and ceiling by its acceptance rate;
+        # both bounds are measured hardware numbers, not projections.
+        from tpunet.models import speculative_generate
+
+        draft = model.clone(n_layers=args.draft_layers)
+        draft_params = draft.init(jax.random.PRNGKey(1), prompt)["params"]
+        sgen = jax.jit(
+            lambda params, dparams, prompt: speculative_generate(
+                model, params, draft, dparams, prompt, args.new,
+                gamma=args.spec_gamma, return_stats=True))
+        out, stats = sgen(params, draft_params, prompt)  # compile + warm
+        np.asarray(out)
+        stimes = []
+        for _ in range(args.iters):
+            t0 = time.perf_counter()
+            out, stats = sgen(params, draft_params, prompt)
+            np.asarray(out)  # host transfer = the sync point
+            stimes.append(time.perf_counter() - t0)
+        sbest = min(stimes)
+        rounds = int(stats["rounds"])
+        round_s = sbest / rounds
+        spec = {
+            "gamma": args.spec_gamma,
+            "draft_layers": args.draft_layers,
+            "wall_s": round(sbest, 4),
+            "rounds": rounds,
+            "accept_rate_floor": round(float(stats["draft_accept_rate"]), 4),
+            "spec_tok_s_floor": round(args.batch * args.new / sbest, 1),
+            "round_s": round(round_s, 5),
+            "spec_tok_s_ceiling": round(
+                args.batch * (args.spec_gamma + 1) / round_s, 1),
+        }
+
     print(json.dumps({
         "platform": jax.devices()[0].platform,
         "d": args.d, "L": args.layers, "heads": args.heads,
@@ -84,6 +132,7 @@ def main(argv=None) -> None:
         "batch": args.batch, "prompt": args.prompt, "new": args.new,
         "wall_s": round(best, 4),
         "decode_tok_s": round(args.batch * args.new / best, 1),
+        **({"speculative": spec} if spec is not None else {}),
     }))
 
 

@@ -250,6 +250,26 @@ def test_decode_bench_window(capsys):
     assert out["window"] == 6
 
 
+def test_decode_bench_speculative(capsys):
+    import json
+
+    from benchmarks.decode_bench import main as decode_main
+
+    decode_main([
+        "--d", "64", "--layers", "2", "--heads", "4", "--ff", "128",
+        "--vocab", "256", "--batch", "2", "--prompt", "8", "--new", "6",
+        "--iters", "1", "--spec-gamma", "2", "--draft-layers", "1",
+    ])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    spec = out["speculative"]
+    assert spec["gamma"] == 2 and spec["draft_layers"] == 1
+    assert spec["spec_tok_s_floor"] > 0
+    # The ceiling commits gamma+1 tokens per round by construction.
+    assert spec["spec_tok_s_ceiling"] >= spec["spec_tok_s_floor"]
+    assert 0.0 <= spec["accept_rate_floor"] <= 1.0
+    assert spec["rounds"] >= 1
+
+
 def test_mfu_attribution_cpu_smoke(capsys):
     import json
 
@@ -332,31 +352,36 @@ def test_chip_session_resume_survives_artifact_commits(monkeypatch):
 
     import bench
 
-    fp = cs._steps_fingerprint()
-    good = {"commit": "abc1234", "steps_fingerprint": fp, "results": {}}
+    fps = cs._step_fingerprints()
+    results = {"kernels": {"flash_fwd": "ok"},
+               "decode_mha": {"decode_tok_s": 1.0}}
+    good = {"commit": "abc1234", "step_fps": dict(fps), "results": results}
 
-    # The fingerprint covers argvs (incl. the tuned-pass template) but NOT
-    # timeouts — a timeout bump is orchestration, not a measured parameter.
+    # Per-step fingerprints: timeouts excluded (orchestration), the step
+    # LIST excluded (adding a step must not discard other steps' cache),
+    # argv edits invalidate only their own step.
     orig_steps, orig_tuned = cs.STEPS, cs.TUNED_HEADLINE_ARGV
     k0, a0, t0 = orig_steps[0]
     monkeypatch.setattr(cs, "STEPS", [(k0, a0, t0 + 1)] + orig_steps[1:])
-    assert cs._steps_fingerprint() == fp
+    assert cs._step_fingerprints() == fps  # timeout bump: no change
     monkeypatch.setattr(cs, "STEPS",
                         [(k0, a0 + ["--x"], t0)] + orig_steps[1:])
-    assert cs._steps_fingerprint() != fp
+    fps2 = cs._step_fingerprints()
+    assert fps2[k0] != fps[k0]
+    assert {k: v for k, v in fps2.items() if k != k0} == \
+           {k: v for k, v in fps.items() if k != k0}
     monkeypatch.setattr(cs, "STEPS", orig_steps)
     monkeypatch.setattr(cs, "TUNED_HEADLINE_ARGV",
                         orig_tuned + ["--seq", "8192"])
-    assert cs._steps_fingerprint() != fp
+    fps3 = cs._step_fingerprints()
+    assert fps3["headline_tuned"] != fps["headline_tuned"]
+    assert fps3["kernels"] == fps["kernels"]
     monkeypatch.setattr(cs, "TUNED_HEADLINE_ARGV", orig_tuned)
-    assert cs._steps_fingerprint() == fp
+    assert cs._step_fingerprints() == fps
 
-    assert cs._resume_ok({}) is False  # legacy file: no fingerprint
-    assert cs._resume_ok({"steps_fingerprint": fp}) is False  # bad commit
-
-    # The staleness check must run over bench's paths PLUS the step
-    # scripts — a decode_bench.py edit invalidates cached decode numbers
-    # even though bench.py's replay wouldn't care.
+    # Session-wide gates: legacy file (no fps) and dirty-at-measurement
+    # resume nothing; staleness must be checked over bench's paths PLUS
+    # the step scripts.
     seen = {}
 
     def fake_staleness(commit, paths=bench.MEASURED_PATHS):
@@ -364,27 +389,29 @@ def test_chip_session_resume_survives_artifact_commits(monkeypatch):
         return {"stale": False, "changed_files": []}
 
     monkeypatch.setattr(bench, "_measurement_staleness", fake_staleness)
-    assert cs._resume_ok(good) is True  # clean -> resume, any commit
+    assert cs._resumable_results(good) == results  # clean -> all resume
     assert seen["commit"] == "abc1234"
     assert "benchmarks/decode_bench.py" in seen["paths"]
     assert set(bench.MEASURED_PATHS) <= set(seen["paths"])
+    assert cs._resumable_results({"commit": "abc1234",
+                                  "results": results}) == {}  # legacy
+    assert cs._resumable_results(
+        {**good, "dirty": ["tpunet/ops/flash_attention.py"]}) == {}
 
-    # A STEPS-argv edit (different fingerprint) or a session measured with
-    # a dirty tree never resumes, even when git reads clean NOW.
-    assert cs._resume_ok({**good, "steps_fingerprint": "0" * 16}) is False
-    assert cs._resume_ok(
-        {**good, "dirty": ["tpunet/ops/flash_attention.py"]}) is False
+    # A single step's stale fingerprint drops THAT step only.
+    one_off = {**good, "step_fps": {**fps, "decode_mha": "0" * 16}}
+    assert cs._resumable_results(one_off) == {"kernels": results["kernels"]}
 
-    # Any reported staleness (or undecidable None) breaks resume.
+    # Any reported staleness (or undecidable None) resumes nothing.
     monkeypatch.setattr(
         bench, "_measurement_staleness",
         lambda c, paths=None: {"stale": True,
                                "changed_files": ["tpunet/ops/x.py"]})
-    assert cs._resume_ok(good) is False
+    assert cs._resumable_results(good) == {}
     monkeypatch.setattr(
         bench, "_measurement_staleness",
         lambda c, paths=None: {"stale": None, "error": "git timeout"})
-    assert cs._resume_ok(good) is False
+    assert cs._resumable_results(good) == {}
 
 
 def test_chip_session_dirty_tree_is_recorded(tmp_path, monkeypatch):
@@ -405,8 +432,8 @@ def test_chip_session_dirty_tree_is_recorded(tmp_path, monkeypatch):
     cs._persist(raw)
     rec = json.loads((tmp_path / "raw.json").read_text())
     assert rec["dirty"] == ["tpunet/ops/flash_attention.py"]
-    assert rec["steps_fingerprint"] == cs._steps_fingerprint()
-    assert cs._resume_ok(rec) is False
+    assert rec["step_fps"] == cs._step_fingerprints()
+    assert cs._resumable_results(rec) == {}
     measured = json.loads((tmp_path / "measured.json").read_text())
     assert measured["uncommitted_at_measurement"] == [
         "tpunet/ops/flash_attention.py"]

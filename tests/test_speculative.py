@@ -1,0 +1,198 @@
+"""Speculative decoding — exactness and mechanics.
+
+Three layers of evidence that `speculative_generate` preserves the target
+model's distribution:
+1. the core accept/residual rule is Monte-Carlo-verified to reproduce the
+   target distribution exactly (the Leviathan identity), independent of
+   any model;
+2. greedy end-to-end output is bitwise `generate`'s, for arbitrary-quality
+   drafts (draft quality must affect only throughput);
+3. a draft identical to the target accepts every proposal (accept rate 1),
+   pinning the acceptance plumbing.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpunet.models import Transformer, generate, speculative_generate
+from tpunet.models.generate import (_leading_accepts, _residual_probs,
+                                    filtered_logits)
+
+
+def _tiny(**kw):
+    kw.setdefault("vocab", 64)
+    kw.setdefault("d_model", 32)
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("n_heads", 4)
+    kw.setdefault("d_ff", 64)
+    kw.setdefault("compute_dtype", jnp.float32)
+    return Transformer(**kw)
+
+
+def _params(model, b=2, s=24, seed=1):
+    toks = jax.random.randint(jax.random.PRNGKey(0), (b, s), 0, model.vocab)
+    return model.init(jax.random.PRNGKey(seed), toks)["params"], toks
+
+
+def test_accept_residual_rule_reproduces_target_exactly():
+    """The identity min(q, p) + (1 - sum min(p, q)) * residual = p, run as
+    the actual sampled process: draft from q, accept with prob min(1,
+    p/q), else sample the residual. Empirical marginal must match p to
+    Monte-Carlo accuracy — this is the theorem the whole scheme rests on,
+    tested with no model in the loop."""
+    v = 5
+    rng = np.random.default_rng(0)
+    p = rng.dirichlet(np.ones(v))
+    q = rng.dirichlet(np.ones(v))
+    n = 200_000
+    key = jax.random.PRNGKey(1)
+    kd, ka, kr = jax.random.split(key, 3)
+    draft = jax.random.categorical(kd, jnp.log(jnp.asarray(q))[None, :],
+                                   shape=(n,))
+    u = jax.random.uniform(ka, (n,))
+    accept = u * jnp.asarray(q)[draft] < jnp.asarray(p)[draft]
+    res = _residual_probs(jnp.asarray(p)[None, :], jnp.asarray(q)[None, :])
+    resample = jax.random.categorical(kr, jnp.log(res), shape=(n,))
+    tok = jnp.where(accept, draft, resample)
+    emp = np.bincount(np.asarray(tok), minlength=v) / n
+    np.testing.assert_allclose(emp, p, atol=5e-3)
+    # Acceptance rate matches its closed form sum min(p, q).
+    assert np.asarray(accept).mean() == pytest.approx(
+        np.minimum(p, q).sum(), abs=5e-3)
+
+
+def test_residual_probs_identical_dists_falls_back_to_p():
+    p = jnp.asarray([[0.5, 0.25, 0.25]])
+    np.testing.assert_allclose(np.asarray(_residual_probs(p, p)), p)
+
+
+def test_leading_accepts():
+    acc = jnp.asarray([[True, True, False, True],
+                       [False, True, True, True],
+                       [True, True, True, True]])
+    assert _leading_accepts(acc).tolist() == [2, 0, 4]
+
+
+@pytest.mark.parametrize("gamma", [1, 2, 4])
+@pytest.mark.parametrize("draft_kind", ["smaller", "different"])
+def test_greedy_bitwise_matches_generate(gamma, draft_kind):
+    """Greedy speculative output == ancestral greedy, token for token, for
+    drafts of arbitrary quality — a bad draft may only slow things down."""
+    model = _tiny()
+    params, prompt = _params(model)
+    if draft_kind == "smaller":
+        draft = _tiny(n_layers=1)
+        draft_params, _ = _params(draft, seed=7)
+    else:  # same shape, unrelated weights: a pathologically bad draft
+        draft = _tiny()
+        draft_params, _ = _params(draft, seed=99)
+    want = generate(model, params, prompt, 12)
+    got = speculative_generate(model, params, draft, draft_params, prompt,
+                               12, gamma=gamma)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_self_draft_accepts_everything():
+    """draft == target => p == q at every position => accept prob 1: every
+    round commits gamma+1 tokens and the accept rate reads 1.0."""
+    model = _tiny()
+    params, prompt = _params(model)
+    gamma, new = 3, 13
+    out, stats = speculative_generate(
+        model, params, model, params, prompt, new, gamma=gamma,
+        temperature=0.8, rng=jax.random.PRNGKey(5), return_stats=True)
+    assert out.shape == (prompt.shape[0], prompt.shape[1] + new)
+    assert int(stats["rounds"]) == -(-(new - 1) // (gamma + 1))  # ceil
+    assert float(stats["draft_accept_rate"]) == 1.0
+    assert (np.asarray(out) < model.vocab).all() and (np.asarray(out) >= 0).all()
+
+
+def test_sampled_marginal_matches_generate():
+    """Distributional end-to-end check: over a large batch of identical
+    prompts, the marginal distribution of each generated position must
+    match ancestral sampling's (total variation within Monte-Carlo
+    noise), with an imperfect draft forcing real rejections."""
+    model = _tiny(vocab=16, d_model=16, n_layers=1, n_heads=2, d_ff=32)
+    draft = _tiny(vocab=16, d_model=16, n_layers=1, n_heads=2, d_ff=32)
+    params, _ = _params(model, b=1, s=4)
+    draft_params, _ = _params(draft, b=1, s=4, seed=123)
+    b = 4096
+    prompt = jnp.tile(jnp.asarray([[3, 1, 2, 7]], jnp.int32), (b, 1))
+    new, t = 3, 1.0
+    anc = generate(model, params, prompt, new, temperature=t,
+                   rng=jax.random.PRNGKey(11))
+    spec = speculative_generate(model, params, draft, draft_params, prompt,
+                                new, gamma=2, temperature=t,
+                                rng=jax.random.PRNGKey(22))
+    for pos in range(new):
+        a = np.bincount(np.asarray(anc)[:, 4 + pos], minlength=16) / b
+        s = np.bincount(np.asarray(spec)[:, 4 + pos], minlength=16) / b
+        tvd = 0.5 * np.abs(a - s).sum()
+        assert tvd < 0.05, f"position {pos}: TVD {tvd}"
+
+
+def test_eos_pins_tail():
+    """Once a row emits eos, everything after is eos — including tokens
+    committed in the same speculative block."""
+    model = _tiny(vocab=8)
+    params, _ = _params(model, b=3, s=6)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (3, 6), 0, 8)
+    draft = _tiny(vocab=8, n_layers=1)
+    draft_params, _ = _params(draft, b=3, s=6, seed=9)
+    out = np.asarray(speculative_generate(
+        model, params, draft, draft_params, prompt, 16, gamma=3, eos_id=5))
+    for row in out:
+        gen = row[6:]
+        hits = np.nonzero(gen == 5)[0]
+        if hits.size:
+            assert (gen[hits[0]:] == 5).all()
+    # And greedy-with-eos still matches ancestral greedy-with-eos.
+    want = np.asarray(generate(model, params, prompt, 16, eos_id=5))
+    np.testing.assert_array_equal(out, want)
+
+
+def test_gqa_window_draft_composes():
+    """Speculative decode composes with the GQA + sliding-window cache
+    variants (the decode block step handles both)."""
+    model = _tiny(n_kv_heads=2, attn_window=8)
+    params, prompt = _params(model)
+    draft = _tiny(n_layers=1, n_kv_heads=2, attn_window=8)
+    draft_params, _ = _params(draft, seed=3)
+    want = generate(model, params, prompt, 10)
+    got = speculative_generate(model, params, draft, draft_params, prompt,
+                               10, gamma=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_validation_errors():
+    model = _tiny()
+    params, prompt = _params(model)
+    with pytest.raises(ValueError, match="gamma"):
+        speculative_generate(model, params, model, params, prompt, 4, gamma=0)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        speculative_generate(model, params, model, params, prompt, 0)
+    with pytest.raises(ValueError, match="top_k"):
+        speculative_generate(model, params, model, params, prompt, 4, top_k=3)
+
+
+def test_filtered_logits_shared_helper():
+    """generate() and speculative_generate() must sample through the SAME
+    filter chain — pin the helper's semantics: top-k keeps exactly k,
+    top-p keeps the smallest prefix reaching p, composed k-then-p."""
+    logits = jnp.asarray([[2.0, 1.0, 0.5, 0.0, -1.0]])
+    out = filtered_logits(logits, 1.0, 3, None)
+    assert (np.asarray(out[0]) == -np.inf).sum() == 2
+    out = filtered_logits(logits, 1.0, None, 0.6)
+    keep = np.isfinite(np.asarray(out[0]))
+    probs = np.asarray(jax.nn.softmax(logits[0]))
+    order = np.argsort(-probs)
+    cum = 0.0
+    expect = np.zeros(5, bool)
+    for i in order:
+        expect[i] = True
+        cum += probs[i]
+        if cum >= 0.6:
+            break
+    np.testing.assert_array_equal(keep, expect)

@@ -11,7 +11,11 @@ shard_map/ppermute or cross-host over the DCN transport), and a
 Switch-style MoE with expert-parallel sharding.
 """
 
-from tpunet.models.generate import generate, init_cache  # noqa: F401
+from tpunet.models.generate import (  # noqa: F401
+    generate,
+    init_cache,
+    speculative_generate,
+)
 from tpunet.models.transformer import (  # noqa: F401
     Transformer,
     transformer_partition_rules,

@@ -34,6 +34,47 @@ def init_cache(model, batch: int, max_len: int):
     )
 
 
+def _validate_sampling(temperature: float, top_k, top_p) -> None:
+    if (top_k is not None or top_p is not None) and temperature == 0.0:
+        raise ValueError("top_k/top_p require temperature > 0 (greedy "
+                         "decoding ignores them silently otherwise)")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+
+def filtered_logits(logits, temperature: float, top_k, top_p):
+    """The sampling distribution as masked/scaled logits: temperature,
+    then top-k, then nucleus top-p (the serving convention). greedy
+    (temperature == 0) is the caller's branch — this requires T > 0.
+    Shared by ancestral sampling (`generate`) and speculative decoding,
+    where the SAME filtered distribution must be used for drafting,
+    acceptance ratios, and residual sampling for the scheme to be exact."""
+    logits = logits / temperature
+    rows = jnp.arange(logits.shape[0])[:, None]
+    if top_k is not None and top_k < logits.shape[-1]:
+        # Rank-exact: exactly top_k survivors even under tied logits
+        # (lax.top_k breaks ties deterministically), and no full sort
+        # in the per-token decode loop.
+        _, idx = jax.lax.top_k(logits, top_k)
+        keep = jnp.zeros(logits.shape, bool).at[rows, idx].set(True)
+        logits = jnp.where(keep, logits, -jnp.inf)
+    if top_p is not None and top_p < 1.0:
+        # Nucleus, rank-exact: ONE descending argsort; keep the
+        # smallest prefix whose cumulative probability reaches top_p
+        # (exclusive prefix sum — the top token always survives), then
+        # scatter the rank-space mask back to vocab positions.
+        order = jnp.argsort(-logits, axis=-1)
+        sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive prefix sum
+        keep = jnp.zeros(logits.shape, bool).at[rows, order].set(
+            cum < top_p)
+        logits = jnp.where(keep, logits, -jnp.inf)
+    return logits
+
+
 def generate(
     model,
     params,
@@ -63,13 +104,7 @@ def generate(
     """
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
-    if (top_k is not None or top_p is not None) and temperature == 0.0:
-        raise ValueError("top_k/top_p require temperature > 0 (greedy "
-                         "decoding ignores them silently otherwise)")
-    if top_k is not None and top_k < 1:
-        raise ValueError(f"top_k must be >= 1, got {top_k}")
-    if top_p is not None and not 0.0 < top_p <= 1.0:
-        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    _validate_sampling(temperature, top_k, top_p)
     b, p = prompt.shape
     dm = model.clone(decode=True)
     cache = init_cache(model, b, p + max_new_tokens)
@@ -79,27 +114,7 @@ def generate(
     def sample(last_logits, key):
         if temperature == 0.0:
             return jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
-        logits = last_logits / temperature
-        rows = jnp.arange(logits.shape[0])[:, None]
-        if top_k is not None and top_k < logits.shape[-1]:
-            # Rank-exact: exactly top_k survivors even under tied logits
-            # (lax.top_k breaks ties deterministically), and no full sort
-            # in the per-token decode loop.
-            _, idx = jax.lax.top_k(logits, top_k)
-            keep = jnp.zeros(logits.shape, bool).at[rows, idx].set(True)
-            logits = jnp.where(keep, logits, -jnp.inf)
-        if top_p is not None and top_p < 1.0:
-            # Nucleus, rank-exact: ONE descending argsort; keep the
-            # smallest prefix whose cumulative probability reaches top_p
-            # (exclusive prefix sum — the top token always survives), then
-            # scatter the rank-space mask back to vocab positions.
-            order = jnp.argsort(-logits, axis=-1)
-            sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
-            probs = jax.nn.softmax(sorted_logits, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive prefix sum
-            keep = jnp.zeros(logits.shape, bool).at[rows, order].set(
-                cum < top_p)
-            logits = jnp.where(keep, logits, -jnp.inf)
+        logits = filtered_logits(last_logits, temperature, top_k, top_p)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     # Prefill: one call over the whole prompt fills cache[0:p] and yields
@@ -130,3 +145,249 @@ def generate(
         + ([rest.swapaxes(0, 1)] if max_new_tokens > 1 else []),
         axis=1,
     )
+
+
+def _leading_accepts(accept) -> jnp.ndarray:
+    """(b, g) bool -> (b,) count of leading True per row: the number of
+    draft tokens accepted before the first rejection."""
+    return jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)
+
+
+def _residual_probs(p, q):
+    """The rejection-sampling residual norm(max(p - q, 0)): sampling from
+    it after rejecting a draft from q makes the combined marginal exactly
+    p (speculative decoding's correctness identity:
+    q·min(1, p/q) + (1 - Σ min(p, q))·residual = p). Where p == q the
+    residual has zero mass (rejection probability is 0, so the branch is
+    never taken); fall back to p so categorical stays well-defined under
+    vmap/where."""
+    r = jnp.maximum(p - q, 0.0)
+    z = r.sum(axis=-1, keepdims=True)
+    return jnp.where(z > 0, r / jnp.maximum(z, 1e-30), p)
+
+
+def speculative_generate(
+    model,
+    params,
+    draft_model,
+    draft_params,
+    prompt,
+    max_new_tokens: int,
+    *,
+    gamma: int = 4,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    rng=None,
+    eos_id: int | None = None,
+    return_stats: bool = False,
+):
+    """Speculative decoding: draft `gamma` tokens with the cheap
+    `draft_model`, verify them all in ONE target forward, keep the
+    accepted prefix — exact with respect to the target's sampling
+    distribution (greedy output is bitwise `generate`'s; sampled output
+    follows the identical per-position distribution via the
+    accept/residual rule of `_residual_probs`).
+
+    TPU-first shape discipline: every round runs the same static program —
+    gamma single-token draft steps (small-model scan) and one
+    (b, gamma+1)-token target verify (MXU-batched, reusing the decode
+    cache's block step) — inside a `lax.while_loop`. The batch commits in
+    LOCKSTEP: n = min over sequences of each row's accepted-prefix length,
+    and every sequence advances n+1 tokens (its own accepted draft token,
+    or its residual/bonus sample, at position n). Truncating at a
+    cross-batch stopping time discards only later coin flips, so each
+    row's kept tokens still follow the exact per-position scheme; the cost
+    is throughput (min over the batch), not correctness. Both KV caches
+    roll back by simply writing `cache_index` — entries beyond it are
+    masked by the decode step's `key_pos <= q_pos` and overwritten by the
+    next round's block write.
+
+    The draft model trades acceptance rate for speed (same tokenizer/vocab
+    required); its quality affects ONLY throughput, never the output
+    distribution. Returns (b, p + max_new_tokens) int32 like `generate`;
+    with return_stats=True, also a dict with `rounds` and
+    `draft_accept_rate` (diagnostics for tuning gamma).
+    """
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    _validate_sampling(temperature, top_k, top_p)
+    b, p = prompt.shape
+    cap = p + max_new_tokens + gamma  # verify may overshoot max_new by < gamma
+    tm = model.clone(decode=True)
+    dm = draft_model.clone(decode=True)
+    t_cache = init_cache(model, b, cap)
+    d_cache = init_cache(draft_model, b, cap)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    greedy = temperature == 0.0
+
+    def probs_of(logits):
+        return jax.nn.softmax(
+            filtered_logits(logits, temperature, top_k, top_p), axis=-1)
+
+    # Prefill both models on the prompt; the first committed token comes
+    # from the TARGET (position p is an ordinary target sample — the
+    # speculative scheme only covers positions the draft proposed).
+    t_logits, mut = tm.apply(
+        {"params": params, "cache": t_cache}, prompt, mutable=["cache"])
+    t_cache = mut["cache"]
+    _, mut = dm.apply(
+        {"params": draft_params, "cache": d_cache}, prompt, mutable=["cache"])
+    d_cache = mut["cache"]
+    key0, rng = jax.random.split(rng)
+    last = t_logits[:, -1, :]
+    tok0 = (jnp.argmax(last, axis=-1) if greedy
+            else jax.random.categorical(
+                key0, filtered_logits(last, temperature, top_k, top_p),
+                axis=-1)).astype(jnp.int32)
+    done0 = (tok0 == eos_id) if eos_id is not None else jnp.zeros((b,), bool)
+
+    out0 = jnp.zeros((b, cap), jnp.int32)
+    out0 = jax.lax.dynamic_update_slice(out0, prompt.astype(jnp.int32), (0, 0))
+    out0 = out0.at[:, p].set(tok0)
+
+    def draft_step(carry, key):
+        d_cache, tok = carry
+        logits, mut = dm.apply(
+            {"params": draft_params, "cache": d_cache}, tok[:, None],
+            mutable=["cache"])
+        row = logits[:, -1, :]
+        if greedy:
+            nxt = jnp.argmax(row, axis=-1).astype(jnp.int32)
+            q = jax.nn.one_hot(nxt, row.shape[-1], dtype=jnp.float32)
+        else:
+            q = probs_of(row)
+            nxt = jax.random.categorical(
+                key, jnp.log(jnp.maximum(q, 1e-30)), axis=-1
+            ).astype(jnp.int32)
+        return (mut["cache"], nxt), (nxt, q)
+
+    def round_body(state):
+        out, n_out, t_cache, d_cache, done, rng, rounds, acc_sum, prop_sum = state
+        L = p + n_out  # committed tokens so far; cache holds [0, L-1)
+        last_tok = jax.lax.dynamic_slice(out, (0, L - 1), (b, 1))[:, 0]
+        rng, k_draft, k_accept, k_fix = jax.random.split(rng, 4)
+
+        # 1. Draft gamma tokens (small model, sequential scan) — plus ONE
+        # extra step whose sampled token is discarded: it exists to feed
+        # d_gamma back through the draft so its K/V lands in the draft
+        # cache. Without it, a fully-accepted round (n == gamma) leaves
+        # the committed frontier's last token MISSING from the draft cache
+        # (the draft never consumed its own final sample), and every
+        # later round drafts against a zero K/V slot — silently wrong
+        # q, collapsing the acceptance rate.
+        (d_cache, _), (d_toks, q_probs) = jax.lax.scan(
+            draft_step, (d_cache, last_tok),
+            jax.random.split(k_draft, gamma + 1))
+        d_toks = d_toks.swapaxes(0, 1)[:, :gamma]       # (b, gamma)
+        q_probs = q_probs.swapaxes(0, 1)[:, :gamma]     # (b, gamma, V)
+
+        # 2. Verify: ONE target forward over [last, d_1..d_gamma] — row j
+        # scores draft position j, row gamma is the bonus distribution.
+        block = jnp.concatenate([last_tok[:, None], d_toks], axis=1)
+        t_logits, mut = tm.apply(
+            {"params": params, "cache": t_cache}, block, mutable=["cache"])
+        t_cache = mut["cache"]
+
+        # 3. Accept/reject each draft position against the target.
+        if greedy:
+            t_argmax = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+            accept = d_toks == t_argmax[:, :gamma]
+        else:
+            p_probs = probs_of(
+                t_logits.reshape(b * (gamma + 1), -1)
+            ).reshape(b, gamma + 1, -1)
+            rows = jnp.arange(b)[:, None]
+            cols = jnp.arange(gamma)[None, :]
+            p_tok = p_probs[rows, cols, d_toks]
+            q_tok = q_probs[rows, cols, d_toks]
+            u = jax.random.uniform(k_accept, (b, gamma))
+            accept = u * q_tok < p_tok
+        n_rows = _leading_accepts(accept)         # (b,)
+        # A finished row must not hold the batch back (its output is
+        # pinned to eos regardless of what its branch computes).
+        n_rows = jnp.where(done, gamma, n_rows)
+        n = jnp.min(n_rows)
+
+        # 4. The (n+1)-th token of the round, per row: its own accepted
+        # draft token when its rejection came later (the coin already
+        # accepted position n), else the residual sample (exactness
+        # partner of the rejection), else — when the whole block was
+        # accepted — a bonus sample from the target's row gamma.
+        fix_rows = jnp.arange(b)
+        if greedy:
+            fix_tok = t_argmax[fix_rows, n]
+        else:
+            p_n = p_probs[fix_rows, n, :]
+            q_n = q_probs[
+                fix_rows, jnp.minimum(n, gamma - 1), :]  # row gamma: unused
+            res = _residual_probs(p_n, q_n)
+            bonus_or_res = jnp.where(n >= gamma, p_n, res)
+            fix_tok = jax.random.categorical(
+                k_fix, jnp.log(jnp.maximum(bonus_or_res, 1e-30)), axis=-1
+            ).astype(jnp.int32)
+        keep_own = (n_rows > n) & (n < gamma)
+        e_tok = jnp.where(keep_own, d_toks[:, jnp.minimum(n, gamma - 1)],
+                          fix_tok).astype(jnp.int32)
+
+        # 5. Commit the block into `out` (static-width write; entries past
+        # n+1 are junk that the next round — or the final slice —
+        # overwrites/drops), with eos pinning threaded through it.
+        w = jnp.concatenate([d_toks, e_tok[:, None]], axis=1)  # (b, gamma+1)
+        offs = jnp.arange(gamma + 1)[None, :]
+        w = jnp.where(offs == n, e_tok[:, None], w)
+        if eos_id is not None:
+            seen = done
+            cols_list = []
+            for j in range(gamma + 1):
+                wj = jnp.where(seen, jnp.int32(eos_id), w[:, j])
+                seen = seen | (wj == eos_id)
+                cols_list.append(wj)
+            w = jnp.stack(cols_list, axis=1)
+            committed_mask = offs <= n
+            done = done | jnp.any((w == eos_id) & committed_mask, axis=1)
+        out = jax.lax.dynamic_update_slice(out, w, (0, L))
+
+        # 6. Roll both caches back to the committed frontier: correct K/V
+        # exists for [0, L + n) (verify/draft wrote the accepted tokens);
+        # the freshly emitted token at L + n enters the caches as the next
+        # round's first input. Stale tail entries are masked and later
+        # overwritten.
+        t_cache = _set_cache_index(t_cache, L + n)
+        d_cache = _set_cache_index(d_cache, L + n)
+        return (out, n_out + n + 1, t_cache, d_cache, done, rng,
+                rounds + 1, acc_sum + n, prop_sum + gamma)
+
+    def round_cond(state):
+        return state[1] < max_new_tokens
+
+    state = (out0, jnp.int32(1), t_cache, d_cache, done0, rng,
+             jnp.int32(0), jnp.int32(0), jnp.int32(0))
+    out, n_out, *_, rounds, acc_sum, prop_sum = jax.lax.while_loop(
+        round_cond, round_body, state)
+    result = jax.lax.slice(out, (0, 0), (b, p + max_new_tokens))
+    if not return_stats:
+        return result
+    return result, {
+        "rounds": rounds,
+        "tokens": n_out,
+        "draft_accept_rate": acc_sum / jnp.maximum(prop_sum, 1),
+    }
+
+
+def _set_cache_index(cache, idx):
+    """Rewrite every layer's cache_index leaf to `idx` — the rollback
+    primitive speculative decoding relies on: the decode step masks keys
+    at positions > its running index and block-writes from it, so moving
+    the index IS the rollback."""
+
+    def fix(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name == "cache_index":
+            return jnp.full(leaf.shape, idx, leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
