@@ -166,6 +166,42 @@ def test_gqa_window_draft_composes():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+@pytest.mark.parametrize("chunk", [4, 5, 12, 100])
+def test_chunked_prefill_parity(chunk):
+    """prefill_chunk re-blocks the same computation: bitwise-equal output
+    for dividing chunks (4 and 12 — both end the scan on rem == 0), a
+    non-dividing chunk (5, remainder block), and an oversized chunk (100
+    >= p, the unchunked fast path), on both generators, incl. a
+    GQA+window model."""
+    model = _tiny(n_kv_heads=2, attn_window=10)
+    params, prompt = _params(model)  # p = 24
+    draft = _tiny(n_layers=1, n_kv_heads=2, attn_window=10)
+    draft_params, _ = _params(draft, seed=3)
+
+    want = generate(model, params, prompt, 8)
+    got = generate(model, params, prompt, 8, prefill_chunk=chunk)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    want_s = generate(model, params, prompt, 8, temperature=0.7,
+                      rng=jax.random.PRNGKey(4))
+    got_s = generate(model, params, prompt, 8, temperature=0.7,
+                     rng=jax.random.PRNGKey(4), prefill_chunk=chunk)
+    np.testing.assert_array_equal(np.asarray(got_s), np.asarray(want_s))
+
+    want_sp = speculative_generate(model, params, draft, draft_params,
+                                   prompt, 8, gamma=2)
+    got_sp = speculative_generate(model, params, draft, draft_params,
+                                  prompt, 8, gamma=2, prefill_chunk=chunk)
+    np.testing.assert_array_equal(np.asarray(got_sp), np.asarray(want_sp))
+
+
+def test_prefill_chunk_validation():
+    model = _tiny()
+    params, prompt = _params(model)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        generate(model, params, prompt, 4, prefill_chunk=0)
+
+
 def test_validation_errors():
     model = _tiny()
     params, prompt = _params(model)

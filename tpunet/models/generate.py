@@ -75,6 +75,46 @@ def filtered_logits(logits, temperature: float, top_k, top_p):
     return logits
 
 
+def _prefill(dm, params, cache, prompt, chunk: int | None):
+    """Fill the decode cache with the prompt and return (cache, logits of
+    the last prompt position). `chunk=None` scores the whole prompt in one
+    block step — O(p · cap) attention-score memory. A chunk size C runs a
+    `lax.scan` over ⌊p/C⌋ C-token blocks plus one remainder block: peak
+    score memory drops to O(C · cap) while each block stays an MXU-sized
+    matmul — the long-prompt prefill mode. Chunking changes only the
+    blocking of the same block-causal computation, so outputs are
+    identical (parity-tested bitwise)."""
+    b, p = prompt.shape
+    if chunk is None or chunk >= p:
+        logits, mut = dm.apply(
+            {"params": params, "cache": cache}, prompt, mutable=["cache"])
+        return mut["cache"], logits[:, -1, :]
+    if chunk < 1:
+        raise ValueError(f"prefill_chunk must be >= 1, got {chunk}")
+    k, rem = divmod(p, chunk)
+
+    def step(cache, toks):
+        logits, mut = dm.apply(
+            {"params": params, "cache": cache}, toks, mutable=["cache"])
+        return mut["cache"], logits[:, -1, :]
+
+    def scan_step(carry, toks):
+        cache, _ = carry
+        cache, row = step(cache, toks)
+        # Last row rides the CARRY, not the stacked ys: stacking would
+        # hold a (p/C, b, vocab) buffer live through the scan — an
+        # O(p)-sized allocation on the path whose purpose is bounding
+        # peak memory.
+        return (cache, row), None
+
+    chunks = prompt[:, :k * chunk].reshape(b, k, chunk).swapaxes(0, 1)
+    last0 = jnp.zeros((b, dm.vocab), jnp.float32)
+    (cache, last_row), _ = jax.lax.scan(scan_step, (cache, last0), chunks)
+    if rem:
+        cache, last_row = step(cache, prompt[:, k * chunk:])
+    return cache, last_row
+
+
 def generate(
     model,
     params,
@@ -86,6 +126,7 @@ def generate(
     top_p: float | None = None,
     rng=None,
     eos_id: int | None = None,
+    prefill_chunk: int | None = None,
 ):
     """Generate `max_new_tokens` continuations of `prompt` (b, p) int32.
 
@@ -98,9 +139,10 @@ def generate(
     Returns (b, p + max_new_tokens) int32 — prompt included.
 
     Jit-friendly: callers can `jax.jit(partial(generate, model),
-    static_argnames=("max_new_tokens", "temperature", "top_k", "top_p"))`;
-    shapes are static throughout (the sampling knobs are trace-time
-    constants baked into the sampler, so they must be static too).
+    static_argnames=("max_new_tokens", "temperature", "top_k", "top_p",
+    "prefill_chunk"))`; shapes are static throughout (the sampling knobs
+    are trace-time constants baked into the sampler, and prefill_chunk
+    sets the prefill scan's block shape, so they must all be static).
     """
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
@@ -117,14 +159,12 @@ def generate(
         logits = filtered_logits(last_logits, temperature, top_k, top_p)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
-    # Prefill: one call over the whole prompt fills cache[0:p] and yields
-    # the first next-token distribution from the final prompt position.
-    logits, mut = dm.apply(
-        {"params": params, "cache": cache}, prompt, mutable=["cache"]
-    )
-    cache = mut["cache"]
+    # Prefill: fill cache[0:p] and take the first next-token distribution
+    # from the final prompt position (chunked when prefill_chunk is set —
+    # long prompts without O(p^2) score memory).
+    cache, last = _prefill(dm, params, cache, prompt, prefill_chunk)
     key0, rng = jax.random.split(rng)
-    tok = sample(logits[:, -1, :], key0)
+    tok = sample(last, key0)
     done = (tok == eos_id) if eos_id is not None else jnp.zeros((b,), bool)
 
     def body(carry, key):
@@ -180,6 +220,7 @@ def speculative_generate(
     top_p: float | None = None,
     rng=None,
     eos_id: int | None = None,
+    prefill_chunk: int | None = None,
     return_stats: bool = False,
 ):
     """Speculative decoding: draft `gamma` tokens with the cheap
@@ -231,14 +272,9 @@ def speculative_generate(
     # Prefill both models on the prompt; the first committed token comes
     # from the TARGET (position p is an ordinary target sample — the
     # speculative scheme only covers positions the draft proposed).
-    t_logits, mut = tm.apply(
-        {"params": params, "cache": t_cache}, prompt, mutable=["cache"])
-    t_cache = mut["cache"]
-    _, mut = dm.apply(
-        {"params": draft_params, "cache": d_cache}, prompt, mutable=["cache"])
-    d_cache = mut["cache"]
+    t_cache, last = _prefill(tm, params, t_cache, prompt, prefill_chunk)
+    d_cache, _ = _prefill(dm, draft_params, d_cache, prompt, prefill_chunk)
     key0, rng = jax.random.split(rng)
-    last = t_logits[:, -1, :]
     tok0 = (jnp.argmax(last, axis=-1) if greedy
             else jax.random.categorical(
                 key0, filtered_logits(last, temperature, top_k, top_p),
