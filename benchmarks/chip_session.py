@@ -12,7 +12,7 @@ measurements; re-running resumes the full list.
 Priority order (round-4 verdict):
   1. kernel_smoke        — all flash kernel variants on real Mosaic (gate)
   2. tpu_headline        — tokens/s + MFU + VGG img/s at the headline shape
-  3. decode_bench x4     — MHA, GQA (kv4), sliding-window, speculative
+  3. decode_bench x5     — MHA, GQA (kv4), window, speculative, int8+quant-draft
   4. mfu_attribution     — per-segment breakdown of the headline step
   5. block sweep s2048   — flash tile grid at the headline seq
   6. block sweep s8192   — flash tile grid at long context
@@ -84,6 +84,11 @@ STEPS: list[tuple[str, list[str], int]] = [
                      "--ff", "8192", "--batch", "8", "--prompt", "512",
                      "--new", "256", "--spec-gamma", "4",
                      "--draft-layers", "2"], 2400),
+    ("decode_quant", ["-m", "benchmarks.decode_bench", "--platform", "tpu",
+                      "--d", "2048", "--layers", "12", "--heads", "16",
+                      "--ff", "8192", "--batch", "8", "--prompt", "512",
+                      "--new", "256", "--quant", "int8", "--spec-gamma", "4",
+                      "--spec-draft", "quant"], 2400),
     ("attribution", ["-m", "benchmarks.mfu_attribution"], 2400),
     ("block_sweep_s2048", ["-m", "benchmarks.mfu_attribution",
                            "--sweep-blocks", "--blocks", "128", "256", "512"],
@@ -216,12 +221,13 @@ def _write_measured(raw: dict, dirty: list[str] | None = None) -> None:
             and tuned.get("platform") == "tpu"):
         out["headline_tuned"] = tuned
     decode = {}
-    for key in ("decode_mha", "decode_gqa", "decode_window", "decode_spec"):
+    for key in ("decode_mha", "decode_gqa", "decode_window", "decode_spec",
+                "decode_quant"):
         d = raw.get(key)
         if isinstance(d, dict) and d.get("platform") == "tpu":
             decode[key] = {k: d[k] for k in
                            ("decode_tok_s", "wall_s", "kv_heads", "window",
-                            "batch", "prompt", "new", "speculative")
+                            "batch", "prompt", "new", "quant", "speculative")
                            if k in d}
     if decode:
         out["decode"] = decode

@@ -270,6 +270,26 @@ def test_decode_bench_speculative(capsys):
     assert spec["rounds"] >= 1
 
 
+def test_decode_bench_quant_and_quant_draft(capsys):
+    import json
+
+    from benchmarks.decode_bench import main as decode_main
+
+    decode_main([
+        "--d", "64", "--layers", "2", "--heads", "4", "--ff", "128",
+        "--vocab", "256", "--batch", "2", "--prompt", "8", "--new", "6",
+        "--iters", "1", "--quant", "int8", "--spec-gamma", "2",
+        "--spec-draft", "quant",
+    ])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["quant"]["dtype"] == "int8"
+    assert out["quant"]["decode_tok_s"] > 0
+    spec = out["speculative"]
+    assert spec["draft"] == "quant" and "draft_layers" not in spec
+    assert "accept_rate" in spec and "accept_rate_floor" not in spec
+    assert spec["spec_tok_s"] > 0 and spec["vs_plain"] > 0
+
+
 def test_mfu_attribution_cpu_smoke(capsys):
     import json
 

@@ -16,6 +16,10 @@ from tpunet.models.generate import (  # noqa: F401
     init_cache,
     speculative_generate,
 )
+from tpunet.models.quant import (  # noqa: F401
+    dequantize_kernel,
+    quantize_params,
+)
 from tpunet.models.transformer import (  # noqa: F401
     Transformer,
     transformer_partition_rules,

@@ -1,0 +1,63 @@
+"""Post-training weight-only int8 quantization for the Transformer family.
+
+`quantize_params(params)` converts a trained fp param tree into the tree a
+`Transformer(weight_quant="int8")` clone consumes: every Dense kernel dict
+{"kernel": (in, out)} becomes {"q": int8 (in, out), "scale": f32 (out,)}
+with symmetric per-output-channel absmax scaling (w ≈ q · scale,
+q ∈ [-127, 127]). Everything that is not a Dense kernel — the embedding
+table, RMSNorm scales — passes through untouched; the module names are
+identical, so the swap is purely at the leaf level.
+
+Why weight-only, and why per-output-channel: decode streams every weight
+matrix from HBM once per token while activations stay tiny, so weights are
+the bandwidth bill — int8 halves it vs bf16 without touching the
+activation path's numerics. Per-output-channel scales cost (out,) f32 —
+noise next to the kernel — and cut quantization error by the column
+dynamic range, and because the scale is per-COLUMN it commutes with the
+matmul: x @ (q·scale) == (x @ q) · scale, which is exactly how QuantDense
+applies it (the int8 tensor is what streams; the dequant is a fused cast).
+
+Scope: single-replica inference (the TP partition rules match fp kernel
+names, not q/scale). The MoE expert einsum weights are not covered —
+`Transformer(weight_quant=...)` rejects MoE configs loudly.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def quantize_kernel(w) -> dict:
+    """One (in, out) fp kernel -> {"q": int8, "scale": f32 (out,)}."""
+    w = np.asarray(w, np.float32)
+    if w.ndim != 2:
+        raise ValueError(f"expected a 2-D kernel, got shape {w.shape}")
+    absmax = np.abs(w).max(axis=0)
+    scale = np.maximum(absmax, 1e-8) / 127.0
+    q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
+    return {"q": jnp.asarray(q), "scale": jnp.asarray(scale, jnp.float32)}
+
+
+def quantize_params(params):
+    """fp param tree -> the weight_quant="int8" tree (same module paths).
+
+    A Dense is recognized structurally: a dict whose ONLY entry is a 2-D
+    "kernel" (this family's Denses are all bias-free). Anything else —
+    embed (raw leaf), RMSNorm ({"scale"}), nested module dicts — recurses
+    or passes through unchanged."""
+    if isinstance(params, Mapping):
+        keys = set(params.keys())
+        if keys == {"kernel"} and getattr(params["kernel"], "ndim", 0) == 2:
+            return quantize_kernel(params["kernel"])
+        return {k: quantize_params(v) for k, v in params.items()}
+    return params
+
+
+def dequantize_kernel(qdict) -> jnp.ndarray:
+    """The fp reconstruction q · scale — what QuantDense's matmul sees;
+    round-trip error is bounded by scale/2 per element (half a quantization
+    step). Exposed for tests and for exporting back to fp."""
+    return qdict["q"].astype(jnp.float32) * qdict["scale"][None, :]

@@ -67,6 +67,39 @@ class RMSNorm(nn.Module):
         return (norm * scale).astype(x.dtype)
 
 
+class QuantDense(nn.Module):
+    """Weight-only int8 Dense: kernel stored int8 with a per-output-channel
+    f32 scale (w ≈ q · scale, symmetric absmax). Decode is weight-HBM-
+    bandwidth-bound, so halving the kernel bytes is a direct tokens/s
+    lever; the dequant is a cast + column scale that XLA fuses around the
+    dot, so the int8 tensor is what actually streams from HBM. Params come
+    from `tpunet.models.quantize_params` on a trained fp tree — a fresh
+    init is a zero skeleton (shape/dtype template only). Inference path;
+    int8 params take no gradients."""
+
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        q = self.param("q", nn.initializers.zeros,
+                       (x.shape[-1], self.features), jnp.int8)
+        scale = self.param("scale", nn.initializers.ones,
+                           (self.features,), jnp.float32)
+        y = x.astype(self.dtype) @ q.astype(self.dtype)
+        return y * scale.astype(self.dtype)
+
+
+def _dense(features, dtype, name, weight_quant):
+    """The Dense factory every matmul in this family goes through: fp by
+    default, QuantDense under weight_quant="int8" — SAME module names, so
+    the quantized param tree is the fp tree with each kernel dict swapped
+    for {q, scale} (what quantize_params produces)."""
+    if weight_quant is None:
+        return nn.Dense(features, use_bias=False, dtype=dtype, name=name)
+    return QuantDense(features, dtype=dtype, name=name)
+
+
 class SelfAttention(nn.Module):
     """Causal multi-head self-attention with pluggable impl.
 
@@ -110,6 +143,7 @@ class SelfAttention(nn.Module):
     # applied to the model without editing kernel code.
     flash_block_q: int = 128
     flash_block_k: int = 128
+    weight_quant: str | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -152,7 +186,7 @@ class SelfAttention(nn.Module):
                 f"'flash', not {self.attn_impl!r}"
             )
         dt = self.compute_dtype
-        proj = lambda nh, name: nn.Dense(nh * dh, use_bias=False, dtype=dt, name=name)
+        proj = lambda nh, name: _dense(nh * dh, dt, name, self.weight_quant)
         q = proj(h, "q")(x).reshape(b, s, h, dh)
         k = proj(kv, "k")(x).reshape(b, s, kv, dh)
         v = proj(kv, "v")(x).reshape(b, s, kv, dh)
@@ -221,7 +255,7 @@ class SelfAttention(nn.Module):
                 ).reshape(b, s, h, dh)
                 o = jnp.where(overflow, jnp.nan, o)
                 o = o.astype(dt).reshape(b, s, h * dh)
-                return nn.Dense(x.shape[-1], use_bias=False, dtype=dt, name="out")(o)
+                return _dense(x.shape[-1], dt, "out", self.weight_quant)(o)
 
         pos_offset = 0
         positions = None
@@ -299,7 +333,7 @@ class SelfAttention(nn.Module):
             o = attention_reference(q, k, v, True, window=self.attn_window)
 
         o = o.reshape(b, s, h * dh)
-        return nn.Dense(x.shape[-1], use_bias=False, dtype=dt, name="out")(o)
+        return _dense(x.shape[-1], dt, "out", self.weight_quant)(o)
 
 
 class Mlp(nn.Module):
@@ -310,20 +344,22 @@ class Mlp(nn.Module):
     d_ff: int
     compute_dtype: jnp.dtype = jnp.bfloat16
     mlp_impl: str = "gelu"
+    weight_quant: str | None = None
 
     @nn.compact
     def __call__(self, x):
         dt = self.compute_dtype
+        wq = self.weight_quant
         if self.mlp_impl == "swiglu":
-            g = nn.Dense(self.d_ff, use_bias=False, dtype=dt, name="gate")(x)
-            h = nn.Dense(self.d_ff, use_bias=False, dtype=dt, name="up")(x)
+            g = _dense(self.d_ff, dt, "gate", wq)(x)
+            h = _dense(self.d_ff, dt, "up", wq)(x)
             h = nn.silu(g) * h
         elif self.mlp_impl == "gelu":
-            h = nn.Dense(self.d_ff, use_bias=False, dtype=dt, name="up")(x)
+            h = _dense(self.d_ff, dt, "up", wq)(x)
             h = nn.gelu(h)
         else:
             raise ValueError(f"unknown mlp_impl {self.mlp_impl!r}")
-        return nn.Dense(x.shape[-1], use_bias=False, dtype=dt, name="down")(h)
+        return _dense(x.shape[-1], dt, "down", wq)(h)
 
 
 class MoeMlp(nn.Module):
@@ -419,6 +455,7 @@ class Block(nn.Module):
     flash_block_q: int = 128
     flash_block_k: int = 128
     moe_top_k: int = 1
+    weight_quant: str | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -428,13 +465,15 @@ class Block(nn.Module):
             n_kv_heads=self.n_kv_heads, decode=self.decode,
             attn_window=self.attn_window,
             flash_block_q=self.flash_block_q,
-            flash_block_k=self.flash_block_k, name="attn",
+            flash_block_k=self.flash_block_k,
+            weight_quant=self.weight_quant, name="attn",
         )(RMSNorm(name="norm1")(x))
         if self.n_experts > 0:
             mlp = MoeMlp(self.n_experts, self.d_ff, self.capacity_factor,
                          self.compute_dtype, top_k=self.moe_top_k, name="moe")
         else:
-            mlp = Mlp(self.d_ff, self.compute_dtype, self.mlp_impl, name="mlp")
+            mlp = Mlp(self.d_ff, self.compute_dtype, self.mlp_impl,
+                      weight_quant=self.weight_quant, name="mlp")
         return x + mlp(RMSNorm(name="norm2")(x))
 
 
@@ -468,6 +507,9 @@ class Transformer(nn.Module):
     #   kernels prune to O(S*window) FLOPs. reference/flash impls only.
     flash_block_q: int = 128       # flash kernel tile sizes; sweep with
     flash_block_k: int = 128       #   benchmarks.mfu_attribution --sweep-blocks
+    weight_quant: str | None = None  # "int8" = weight-only quantized matmuls
+    #   (inference: pair with tpunet.models.quantize_params on a trained
+    #   fp tree; halves the weight HBM traffic decode is bound by)
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, features_only: bool = False):
@@ -476,6 +518,24 @@ class Transformer(nn.Module):
         # cross-entropy (tpunet.ops.blockwise_cross_entropy) pairs with the
         # lm_head kernel so the (b, s, vocab) logits are never materialized.
         del train  # no dropout in this family; kept for trainer signature
+        if self.weight_quant not in (None, "int8"):
+            raise ValueError(f"unknown weight_quant {self.weight_quant!r}")
+        if self.weight_quant is not None:
+            if self.n_experts > 0:
+                raise ValueError(
+                    "weight_quant does not cover MoE expert einsum weights; "
+                    "use a dense model or weight_quant=None")
+            if features_only:
+                raise ValueError(
+                    "weight_quant is incompatible with features_only: the "
+                    "blockwise fused cross-entropy reads an fp lm_head "
+                    "kernel from the params tree")
+            if self.tp_axis is not None:
+                raise ValueError(
+                    "weight_quant is single-replica inference: the TP "
+                    "partition rules match fp kernel names, so q/scale "
+                    "would silently replicate — drop tp_axis or "
+                    "weight_quant")
         emb = self.param(
             "embed", nn.initializers.normal(0.02), (self.vocab, self.d_model)
         )
@@ -512,7 +572,8 @@ class Transformer(nn.Module):
                 mlp_impl=self.mlp_impl, decode=self.decode,
                 attn_window=self.attn_window,
                 flash_block_q=self.flash_block_q,
-                flash_block_k=self.flash_block_k, name=f"block{i}",
+                flash_block_k=self.flash_block_k,
+                weight_quant=self.weight_quant, name=f"block{i}",
             )(x)
         x = RMSNorm(name="norm_f")(x)
         if features_only:
@@ -523,8 +584,8 @@ class Transformer(nn.Module):
                 nn.Dense(self.vocab, use_bias=False, dtype=self.compute_dtype,
                          name="lm_head")(x[..., :1, :])
             return x.astype(self.compute_dtype)
-        logits = nn.Dense(self.vocab, use_bias=False,
-                          dtype=self.compute_dtype, name="lm_head")(x)
+        logits = _dense(self.vocab, self.compute_dtype, "lm_head",
+                        self.weight_quant)(x)
         return logits.astype(jnp.float32)
 
 
