@@ -17,8 +17,11 @@ dynamic range, and because the scale is per-COLUMN it commutes with the
 matmul: x @ (q·scale) == (x @ q) · scale, which is exactly how QuantDense
 applies it (the int8 tensor is what streams; the dequant is a fused cast).
 
-Scope: single-replica inference (the TP partition rules match fp kernel
-names, not q/scale). The MoE expert einsum weights are not covered —
+Scope: composes with Megatron TP — `transformer_partition_rules` shards
+`q` exactly like its kernel and the per-column `scale` with the output
+dim (the scale distributes over the row-parallel psum, so sharded and
+single-replica runs agree to all-reduce reassociation noise; parity test
+on the virtual mesh). The MoE expert einsum weights are not covered —
 `Transformer(weight_quant=...)` rejects MoE configs loudly.
 """
 

@@ -530,12 +530,6 @@ class Transformer(nn.Module):
                     "weight_quant is incompatible with features_only: the "
                     "blockwise fused cross-entropy reads an fp lm_head "
                     "kernel from the params tree")
-            if self.tp_axis is not None:
-                raise ValueError(
-                    "weight_quant is single-replica inference: the TP "
-                    "partition rules match fp kernel names, so q/scale "
-                    "would silently replicate — drop tp_axis or "
-                    "weight_quant")
         emb = self.param(
             "embed", nn.initializers.normal(0.02), (self.vocab, self.d_model)
         )
@@ -606,4 +600,20 @@ def transformer_partition_rules(
         (r".*moe/wo", P(ep, tp_axis, None)),
         (r".*embed", P(tp_axis, None)),
         (r".*lm_head/kernel", P(None, tp_axis)),
+        # weight_quant="int8" trees: q shards exactly like its kernel; the
+        # per-output-channel scale shards with the OUTPUT dim — along
+        # tp_axis for column-parallel kernels, replicated for row-parallel
+        # ones (whose output dim is unsharded). Correctness under TP is
+        # free either way: the scale is per-column, so it distributes over
+        # the row-parallel psum — (Σ_p x_p @ q_p) · s == Σ_p (x_p @ q_p · s).
+        (r".*attn/(q|k|v)/q", P(None, tp_axis)),
+        (r".*attn/(q|k|v)/scale", P(tp_axis)),
+        (r".*attn/out/q", P(tp_axis, None)),
+        (r".*attn/out/scale", P()),
+        (r".*mlp/(up|gate)/q", P(None, tp_axis)),
+        (r".*mlp/(up|gate)/scale", P(tp_axis)),
+        (r".*mlp/down/q", P(tp_axis, None)),
+        (r".*mlp/down/scale", P()),
+        (r".*lm_head/q", P(None, tp_axis)),
+        (r".*lm_head/scale", P(tp_axis)),
     ]
