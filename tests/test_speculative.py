@@ -195,6 +195,37 @@ def test_chunked_prefill_parity(chunk):
     np.testing.assert_array_equal(np.asarray(got_sp), np.asarray(want_sp))
 
 
+def test_flash_prefill_matches_reference_prefill():
+    """attn_impl="flash" routes the empty-cache prefill through the Pallas
+    kernel (interpreted on CPU); generation must agree with the reference-
+    impl model token-for-token at a tileable prompt length — the two
+    prefills differ only in attention blocking."""
+    ref = _tiny(n_kv_heads=2)
+    fla = _tiny(n_kv_heads=2, attn_impl="flash")
+    params, _ = _params(ref, s=128)
+    prompt = jax.random.randint(jax.random.PRNGKey(0), (2, 128), 0, 64)
+    want = generate(ref, params, prompt, 6)
+    got = generate(fla, params, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_prefill_mode_poisons_on_nonempty_cache():
+    """prefill=True is an empty-cache contract: applying a prefill clone
+    to a cache mid-stream computes block-only attention that ignores the
+    committed context — poisoned to NaN, same discipline as overflow."""
+    from tpunet.models import init_cache
+
+    model = _tiny()
+    params, toks = _params(model)
+    pm = model.clone(decode=True, prefill=True)
+    cache = init_cache(model, 2, 40)
+    _, mut = pm.apply({"params": params, "cache": cache}, toks,
+                      mutable=["cache"])  # idx 0: fine
+    logits, _ = pm.apply({"params": params, "cache": mut["cache"]},
+                         toks[:, :4], mutable=["cache"])  # idx 24: poisoned
+    assert np.isnan(np.asarray(logits)).all()
+
+
 def test_prefill_chunk_validation():
     model = _tiny()
     params, prompt = _params(model)

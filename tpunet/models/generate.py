@@ -77,41 +77,49 @@ def filtered_logits(logits, temperature: float, top_k, top_p):
 
 def _prefill(dm, params, cache, prompt, chunk: int | None):
     """Fill the decode cache with the prompt and return (cache, logits of
-    the last prompt position). `chunk=None` scores the whole prompt in one
-    block step — O(p · cap) attention-score memory. A chunk size C runs a
-    `lax.scan` over ⌊p/C⌋ C-token blocks plus one remainder block: peak
-    score memory drops to O(C · cap) while each block stays an MXU-sized
-    matmul — the long-prompt prefill mode. Chunking changes only the
-    blocking of the same block-causal computation, so outputs are
-    identical (parity-tested bitwise)."""
-    b, p = prompt.shape
-    if chunk is None or chunk >= p:
-        logits, mut = dm.apply(
-            {"params": params, "cache": cache}, prompt, mutable=["cache"])
-        return mut["cache"], logits[:, -1, :]
-    if chunk < 1:
-        raise ValueError(f"prefill_chunk must be >= 1, got {chunk}")
-    k, rem = divmod(p, chunk)
+    the last prompt position).
 
-    def step(cache, toks):
-        logits, mut = dm.apply(
+    The FIRST block always goes through a `prefill=True` clone: an empty
+    cache means the block attends only within itself — plain causal
+    self-attention — so the model routes it through its configured kernel
+    (flash on chip: O(p) score memory, MXU tiles) instead of the s × cap
+    masked dense einsum, while still writing the cache. `chunk=None`
+    covers the whole prompt that way. A chunk size C additionally scans
+    ⌊p/C⌋ C-token blocks (first via the kernel, the rest — which need
+    cache context — via the dense step, O(C · cap) scores) plus one
+    remainder block. Chunking changes only the blocking of the same
+    block-causal computation, so outputs are identical (parity-tested)."""
+    b, p = prompt.shape
+    pm = dm.clone(prefill=True)
+
+    def step(m, cache, toks):
+        logits, mut = m.apply(
             {"params": params, "cache": cache}, toks, mutable=["cache"])
         return mut["cache"], logits[:, -1, :]
 
+    if chunk is None or chunk >= p:
+        return step(pm, cache, prompt)
+    if chunk < 1:
+        raise ValueError(f"prefill_chunk must be >= 1, got {chunk}")
+    k, rem = divmod(p, chunk)
+    cache, last_row = step(pm, cache, prompt[:, :chunk])
+
     def scan_step(carry, toks):
         cache, _ = carry
-        cache, row = step(cache, toks)
+        cache, row = step(dm, cache, toks)
         # Last row rides the CARRY, not the stacked ys: stacking would
         # hold a (p/C, b, vocab) buffer live through the scan — an
         # O(p)-sized allocation on the path whose purpose is bounding
         # peak memory.
         return (cache, row), None
 
-    chunks = prompt[:, :k * chunk].reshape(b, k, chunk).swapaxes(0, 1)
-    last0 = jnp.zeros((b, dm.vocab), jnp.float32)
-    (cache, last_row), _ = jax.lax.scan(scan_step, (cache, last0), chunks)
+    if k > 1:
+        chunks = prompt[:, chunk:k * chunk].reshape(
+            b, k - 1, chunk).swapaxes(0, 1)
+        (cache, last_row), _ = jax.lax.scan(
+            scan_step, (cache, last_row), chunks)
     if rem:
-        cache, last_row = step(cache, prompt[:, k * chunk:])
+        cache, last_row = step(dm, cache, prompt[:, k * chunk:])
     return cache, last_row
 
 

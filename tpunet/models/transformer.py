@@ -100,6 +100,23 @@ def _dense(features, dtype, name, weight_quant):
     return QuantDense(features, dtype=dtype, name=name)
 
 
+def _causal_kernel_attention(q, k, v, attn_impl, window, block_q, block_k):
+    """The flash/reference causal-attention pair on rotary'd (b, s, heads,
+    dh) tensors — ONE dispatch shared by the ordinary forward and the
+    kernel-routed prefill, so window handling and the GQA convention can't
+    diverge between them: flash consumes kv-head tensors natively; the
+    reference einsum gets a (fused) group repeat, a no-op when k/v already
+    carry full heads."""
+    if attn_impl == "flash":
+        return flash_attention(q, k, v, True, block_q=block_q,
+                               block_k=block_k, window=window)
+    from tpunet.ops.flash_attention import _repeat_kv
+
+    group = q.shape[2] // k.shape[2]
+    return attention_reference(q, _repeat_kv(k, group), _repeat_kv(v, group),
+                               True, window=window)
+
+
 class SelfAttention(nn.Module):
     """Causal multi-head self-attention with pluggable impl.
 
@@ -144,6 +161,9 @@ class SelfAttention(nn.Module):
     flash_block_q: int = 128
     flash_block_k: int = 128
     weight_quant: str | None = None
+    prefill: bool = False  # decode=True only: first fill of an EMPTY cache
+    #   runs block-causal attention through the configured kernel (flash on
+    #   chip) instead of the s x cap masked dense einsum below
 
     @nn.compact
     def __call__(self, x):
@@ -231,6 +251,23 @@ class SelfAttention(nn.Module):
                     cval.value, v, (0, idx, 0, 0)
                 )
                 cidx.value = idx + s
+                if self.prefill:
+                    # First fill of an EMPTY cache: the block attends only
+                    # within itself, which is plain causal self-attention —
+                    # run it through the configured kernel (flash: O(s)
+                    # memory, MXU-tiled; untileable prompt lengths fall
+                    # back to the reference einsum over s x s, still
+                    # smaller than the s x cap masked dense below). The
+                    # cache write above is all decode needs later. Only
+                    # valid at idx == 0 — poisoned to NaN otherwise, same
+                    # discipline as the overflow guard.
+                    o = _causal_kernel_attention(
+                        q, k, v, self.attn_impl, self.attn_window,
+                        self.flash_block_q, self.flash_block_k)
+                    bad = overflow | (idx != 0)
+                    o = jnp.where(bad, jnp.nan, o).astype(dt)
+                    o = o.reshape(b, s, h * dh)
+                    return _dense(x.shape[-1], dt, "out", self.weight_quant)(o)
                 # Grouped einsum: q reshaped to (b, s, kv, group, dh)
                 # contracts DIRECTLY against the (b, cap, kv, dh) cache —
                 # the group-repeated K/V never exists in HBM. This is the
@@ -325,12 +362,10 @@ class SelfAttention(nn.Module):
             from tpunet.parallel.ulysses import dcn_ulysses_attention
 
             o = dcn_ulysses_attention(q, k, v, causal=True)
-        elif self.attn_impl == "flash":
-            o = flash_attention(q, k, v, True, block_q=self.flash_block_q,
-                                block_k=self.flash_block_k,
-                                window=self.attn_window)
-        else:
-            o = attention_reference(q, k, v, True, window=self.attn_window)
+        else:  # flash / reference — k/v are pre-broadcast for non-flash
+            o = _causal_kernel_attention(
+                q, k, v, self.attn_impl, self.attn_window,
+                self.flash_block_q, self.flash_block_k)
 
         o = o.reshape(b, s, h * dh)
         return _dense(x.shape[-1], dt, "out", self.weight_quant)(o)
@@ -456,6 +491,7 @@ class Block(nn.Module):
     flash_block_k: int = 128
     moe_top_k: int = 1
     weight_quant: str | None = None
+    prefill: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -466,7 +502,8 @@ class Block(nn.Module):
             attn_window=self.attn_window,
             flash_block_q=self.flash_block_q,
             flash_block_k=self.flash_block_k,
-            weight_quant=self.weight_quant, name="attn",
+            weight_quant=self.weight_quant, prefill=self.prefill,
+            name="attn",
         )(RMSNorm(name="norm1")(x))
         if self.n_experts > 0:
             mlp = MoeMlp(self.n_experts, self.d_ff, self.capacity_factor,
@@ -510,6 +547,10 @@ class Transformer(nn.Module):
     weight_quant: str | None = None  # "int8" = weight-only quantized matmuls
     #   (inference: pair with tpunet.models.quantize_params on a trained
     #   fp tree; halves the weight HBM traffic decode is bound by)
+    prefill: bool = False          # decode=True: route the FIRST cache fill
+    #   through the configured attention kernel (flash: O(s) memory, MXU
+    #   tiles) instead of the s x cap masked dense einsum; generate() uses a
+    #   prefill clone for the whole-prompt call automatically
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, features_only: bool = False):
@@ -567,7 +608,8 @@ class Transformer(nn.Module):
                 attn_window=self.attn_window,
                 flash_block_q=self.flash_block_q,
                 flash_block_k=self.flash_block_k,
-                weight_quant=self.weight_quant, name=f"block{i}",
+                weight_quant=self.weight_quant, prefill=self.prefill,
+                name=f"block{i}",
             )(x)
         x = RMSNorm(name="norm_f")(x)
         if features_only:
