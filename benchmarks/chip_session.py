@@ -12,7 +12,9 @@ measurements; re-running resumes the full list.
 Priority order (round-4 verdict):
   1. kernel_smoke        — all flash kernel variants on real Mosaic (gate)
   2. tpu_headline        — tokens/s + MFU + VGG img/s at the headline shape
-  3. decode_bench x5     — MHA, GQA (kv4), window, speculative, int8+quant-draft
+  3. decode_bench x7     — MHA, GQA (kv4), window, speculative,
+                           int8+quant-draft, and the TTFT prefill pair
+                           (reference vs flash kernel at p=4096)
   4. mfu_attribution     — per-segment breakdown of the headline step
   5. block sweep s2048   — flash tile grid at the headline seq
   6. block sweep s8192   — flash tile grid at long context
@@ -89,6 +91,20 @@ STEPS: list[tuple[str, list[str], int]] = [
                       "--ff", "8192", "--batch", "8", "--prompt", "512",
                       "--new", "256", "--quant", "int8", "--spec-gamma", "4",
                       "--spec-draft", "quant"], 2400),
+    # Time-to-first-token pair: long prompt, few new tokens. The flash
+    # variant routes the empty-cache prefill through the Mosaic kernel
+    # (O(p) score memory, K/V streamed at kv-head width); the reference
+    # variant pays the p x p reference einsum with a materialized GQA
+    # repeat. Same GQA shape otherwise.
+    ("prefill_ttft_ref", ["-m", "benchmarks.decode_bench", "--platform",
+                          "tpu", "--d", "2048", "--layers", "12", "--heads",
+                          "16", "--ff", "8192", "--batch", "2", "--prompt",
+                          "4096", "--new", "16", "--kv-heads", "4"], 1800),
+    ("prefill_ttft_flash", ["-m", "benchmarks.decode_bench", "--platform",
+                            "tpu", "--d", "2048", "--layers", "12",
+                            "--heads", "16", "--ff", "8192", "--batch", "2",
+                            "--prompt", "4096", "--new", "16", "--kv-heads",
+                            "4", "--attn", "flash"], 1800),
     ("attribution", ["-m", "benchmarks.mfu_attribution"], 2400),
     ("block_sweep_s2048", ["-m", "benchmarks.mfu_attribution",
                            "--sweep-blocks", "--blocks", "128", "256", "512"],
@@ -222,13 +238,13 @@ def _write_measured(raw: dict, dirty: list[str] | None = None) -> None:
         out["headline_tuned"] = tuned
     decode = {}
     for key in ("decode_mha", "decode_gqa", "decode_window", "decode_spec",
-                "decode_quant"):
+                "decode_quant", "prefill_ttft_ref", "prefill_ttft_flash"):
         d = raw.get(key)
         if isinstance(d, dict) and d.get("platform") == "tpu":
             decode[key] = {k: d[k] for k in
                            ("decode_tok_s", "wall_s", "kv_heads", "window",
-                            "batch", "prompt", "new", "quant", "speculative")
-                           if k in d}
+                            "batch", "prompt", "new", "attn", "quant",
+                            "speculative") if k in d}
     if decode:
         out["decode"] = decode
     if (isinstance(raw.get("attribution"), dict)
@@ -267,6 +283,26 @@ def _write_measured(raw: dict, dirty: list[str] | None = None) -> None:
         json.dump(out, f, indent=2)
         f.write("\n")
     os.replace(tmp, MEASURED)
+
+
+def _wanted_attn(key: str, cmd: list) -> str | None:
+    """The attention impl a step WANTS: flash for the headline (its
+    default; demotion appends the fallback flags) or whatever --attn
+    names; None when the step has no attn axis to check."""
+    if key == "headline":
+        return "flash"
+    if "--attn" in cmd:
+        return cmd[cmd.index("--attn") + 1]
+    return None
+
+
+def _cache_satisfies(want_attn: str | None, cached) -> bool:
+    """A cached result is reusable iff it is error-free AND ran with the
+    attn the step wants — a demoted (reference-fallback) run must not
+    satisfy a flash step forever once the smoke recovers."""
+    if not (isinstance(cached, dict) and "error" not in cached):
+        return False
+    return want_attn is None or cached.get("attn") == want_attn
 
 
 def _resumable_results(prev: dict) -> dict:
@@ -328,24 +364,35 @@ def main(argv=None) -> None:
     for i, (key, cmd, timeout_s) in enumerate(STEPS, start=1):
         if i not in which:
             continue
-        if key in raw and isinstance(raw[key], dict) and "error" not in raw[key]:
+        want_attn = _wanted_attn(key, cmd)
+        if _cache_satisfies(want_attn, raw.get(key)):
             status[key] = "cached"
             continue
+        # A previously DEMOTED result (smoke failed that session, the step
+        # ran with reference attention) must not satisfy a flash-wanting
+        # step forever — drop it and let this session's gate decide.
+        raw.pop(key, None)
         print(f"[chip_session] {i}/{len(STEPS)} {key} ...", file=sys.stderr)
-        if key == "headline":
+        if want_attn == "flash":
             # Same per-kernel degradation bench.py applies, decided BEFORE
             # the run (a parity-failing kernel completes without crashing —
             # its numbers must never be published as flash): anything short
             # of an on-chip all-ok smoke — parity failure, errored/timed-out
-            # smoke, or a smoke skipped via --only — drops the headline to
+            # smoke, or a smoke skipped via --only — drops the step to
             # reference attention, exactly like bench.py's gate. To measure
-            # flash, run the smoke step in the same session.
+            # flash, run the smoke step in the same session. The demotion
+            # targets the --attn value specifically; the step's output JSON
+            # echoes the attn that RAN.
             from benchmarks import flash_smoke_ok
 
             if not flash_smoke_ok(raw.get("kernels")):
-                print("[chip_session]   flash smoke not ok (or not run); "
-                      "headline uses reference attention", file=sys.stderr)
-                cmd = cmd + ATTN_FALLBACK_FLAGS
+                print(f"[chip_session]   flash smoke not ok (or not run); "
+                      f"{key} uses reference attention", file=sys.stderr)
+                if key == "headline":
+                    cmd = cmd + ATTN_FALLBACK_FLAGS
+                else:
+                    cmd = list(cmd)
+                    cmd[cmd.index("--attn") + 1] = "reference"
         # Sample dirt at LAUNCH: the subprocess imports the tree as it is
         # now — an edit reverted mid-step must still taint this session.
         launch_dirty |= set(_dirty_measured_paths())

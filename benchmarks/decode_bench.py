@@ -53,6 +53,13 @@ def main(argv=None) -> None:
     p.add_argument("--quant", choices=["int8"], default=None,
                    help="also bench the int8 weight-only model's decode "
                         "tokens/s (halved weight HBM traffic)")
+    p.add_argument("--attn", choices=["reference", "flash"],
+                   default="reference",
+                   help="attention impl: decode steps always use the cached "
+                        "dense path, but the EMPTY-CACHE prefill routes "
+                        "through this kernel — flash makes time-to-first-"
+                        "token O(p) memory and MXU-tiled (chip_session "
+                        "gates it on the kernel smoke, like the headline)")
     args = p.parse_args(argv)
 
     import jax
@@ -70,6 +77,7 @@ def main(argv=None) -> None:
         vocab=args.vocab, d_model=args.d, n_layers=args.layers,
         n_heads=args.heads, d_ff=args.ff, n_kv_heads=args.kv_heads,
         attn_window=args.window, compute_dtype=jnp.bfloat16,
+        attn_impl=args.attn,
     )
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(
@@ -183,6 +191,7 @@ def main(argv=None) -> None:
 
     print(json.dumps({
         "platform": jax.devices()[0].platform,
+        "attn": args.attn,
         "d": args.d, "L": args.layers, "heads": args.heads,
         "kv_heads": args.kv_heads or args.heads,
         "window": args.window,

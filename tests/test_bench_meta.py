@@ -434,6 +434,29 @@ def test_chip_session_resume_survives_artifact_commits(monkeypatch):
     assert cs._resumable_results(good) == {}
 
 
+def test_chip_session_demoted_cache_does_not_stick():
+    """A step that wants flash but cached a smoke-demoted reference run
+    must re-measure; matching attn (or no attn axis) stays cached."""
+    from benchmarks import chip_session as cs
+
+    flash_step = next((k, c) for k, c, _ in cs.STEPS
+                      if k == "prefill_ttft_flash")
+    ref_step = next((k, c) for k, c, _ in cs.STEPS
+                    if k == "prefill_ttft_ref")
+    assert cs._wanted_attn(*flash_step) == "flash"
+    assert cs._wanted_attn(*ref_step) is None  # no --attn flag: unchecked
+    assert cs._wanted_attn("headline", ["-m", "x"]) == "flash"
+    assert cs._wanted_attn("decode_mha", ["-m", "x"]) is None
+
+    demoted = {"platform": "tpu", "attn": "reference", "decode_tok_s": 1.0}
+    good = {"platform": "tpu", "attn": "flash", "decode_tok_s": 1.0}
+    assert cs._cache_satisfies("flash", demoted) is False
+    assert cs._cache_satisfies("flash", good) is True
+    assert cs._cache_satisfies(None, demoted) is True
+    assert cs._cache_satisfies("flash", {"error": "boom"}) is False
+    assert cs._cache_satisfies("flash", None) is False
+
+
 def test_chip_session_dirty_tree_is_recorded(tmp_path, monkeypatch):
     """_persist must record uncommitted measured-path edits and the
     measured file must surface them — a bare hash alone would claim clean
