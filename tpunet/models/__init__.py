@@ -20,6 +20,7 @@ from tpunet.models.quant import (  # noqa: F401
     dequantize_kernel,
     quantize_params,
 )
+from tpunet.models.serve import BatchServer  # noqa: F401
 from tpunet.models.transformer import (  # noqa: F401
     Transformer,
     transformer_partition_rules,

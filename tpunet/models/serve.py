@@ -1,0 +1,200 @@
+"""Continuous batching — a slot server over the per-row decode cache.
+
+`generate()` advances one batch in lockstep: every sequence prefills
+together and the call returns when the LAST one finishes, so short
+requests wait on long ones and finished rows burn MXU cycles. The
+`BatchServer` removes both: the model runs with `per_row_cache=True`
+(each batch row carries its own `cache_index`), so rows are independent
+sequences — a finished row's slot is re-prefilled for the next queued
+request while the other rows keep decoding, and nothing ever waits.
+
+TPU-first shape discipline: the decode step is ONE jitted program of
+static shape (slots, 1) regardless of which slots are live — occupancy
+changes never recompile. Slot refill is a second jitted program per
+distinct prompt length (row slice → reset index → kernel-routed prefill
+→ row write-back); bucket or pad prompts to a few lengths to bound
+retraces, exactly like any static-shape serving stack. Idle rows decode
+garbage tokens into their own dead cache rows — per-row masking keeps
+them from touching live rows, a refill resets the row's index to 0, and
+the stale K/V above the new sequence's frontier is masked until
+overwritten (`key_pos <= q_pos`, the same argument that makes
+speculative rollback sound).
+
+The reference repo has no inference path at all (it is a transport;
+SURVEY §2.3); this is framework capability above it.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from itertools import count
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpunet.models.generate import (_prefill, _set_cache_index,
+                                    _validate_sampling, filtered_logits,
+                                    init_cache)
+
+
+class BatchServer:
+    """Continuous-batching decode server.
+
+    submit() enqueues a request (assigned to a slot immediately when one
+    is free); step() advances every live slot one token and returns the
+    requests that finished. Greedy by default; temperature/top-k/top-p
+    sample per-row with a fresh fold of `rng` each step.
+    """
+
+    def __init__(self, model, params, *, slots: int, max_len: int,
+                 temperature: float = 0.0, top_k: int | None = None,
+                 top_p: float | None = None, eos_id: int | None = None,
+                 rng=None, prefill_chunk: int | None = None):
+        _validate_sampling(temperature, top_k, top_p)
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if getattr(model, "n_experts", 0):
+            # MoE capacity is computed batch-wide (t = b*s slots claimed by
+            # a cross-row cumsum), so other rows' tokens - including idle
+            # garbage - change which of a live row's tokens get dropped:
+            # the per-slot parity contract cannot hold. Reject loudly.
+            raise ValueError(
+                "BatchServer requires a dense model: MoE capacity couples "
+                "rows (batch-wide expert slots), breaking per-slot "
+                "independence")
+        self.model = model
+        self.params = params
+        self.slots, self.max_len = slots, max_len
+        self.eos_id = eos_id
+        self._sampling = (temperature, top_k, top_p)
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._prefill_chunk = prefill_chunk
+        self._dm = model.clone(decode=True, per_row_cache=True)
+        self._cache = init_cache(self._dm, slots, max_len)
+        self._free = list(range(slots))
+        self._live: dict[int, dict] = {}       # slot -> request record
+        self._pending: list[dict] = []
+        self._ids = count()
+        self._last_tok = np.zeros(slots, np.int32)
+        self._done_buffer: list[dict] = []  # finished before step() drained
+
+        def sample(logits, key):
+            if temperature == 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(
+                key, filtered_logits(logits, temperature, top_k, top_p),
+                axis=-1).astype(jnp.int32)
+
+        # The cache is the dominant inference resident (slots x max_len x
+        # layers); donating it keeps ONE buffer alive across the per-token
+        # step instead of copy-in/copy-out each call (generate() gets this
+        # for free by scanning inside one jit; the server's step is the
+        # jit boundary). Donation is a no-op on CPU.
+        @partial(jax.jit, donate_argnums=(1,))
+        def decode_step(params, cache, toks, key):
+            logits, mut = self._dm.apply(
+                {"params": params, "cache": cache}, toks[:, None],
+                mutable=["cache"])
+            return mut["cache"], sample(logits[:, -1, :], key)
+
+        @partial(jax.jit, donate_argnums=(1,), static_argnames=("chunk",))
+        def prefill_slot(params, cache, prompt, r, key, chunk):
+            # Row surgery: slice slot r out of every cache leaf, reset its
+            # index (the row may hold a dead sequence's frontier), prefill
+            # through the shared kernel-routed path, write the row back.
+            row = jax.tree.map(
+                lambda a: jax.lax.dynamic_slice_in_dim(a, r, 1, 0),
+                cache)
+            row = _set_cache_index(row, 0)
+            row, last = _prefill(self._dm, params, row, prompt, chunk)
+            cache = jax.tree.map(
+                lambda a, rw: jax.lax.dynamic_update_slice_in_dim(
+                    a, rw, r, 0),
+                cache, row)
+            return cache, sample(last, key)
+
+        self._decode_step = decode_step
+        self._prefill_slot = prefill_slot
+
+    def _next_key(self):
+        self._rng, key = jax.random.split(self._rng)
+        return key
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        """Enqueue one request; returns its id. Assigned to a slot now if
+        one is free, otherwise when step() frees one."""
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1 or prompt.size < 1:
+            raise ValueError(f"prompt must be 1-D non-empty, got "
+                             f"shape {prompt.shape}")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.size + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new ({max_new_tokens}) "
+                f"exceeds max_len {self.max_len}")
+        req = {"id": next(self._ids), "prompt": prompt,
+               "max_new": max_new_tokens, "out": []}
+        self._pending.append(req)
+        self._fill_slots()
+        return req["id"]
+
+    def _fill_slots(self) -> None:
+        while self._free and self._pending:
+            req = self._pending.pop(0)
+            r = self._free.pop()
+            self._cache, tok = self._prefill_slot(
+                self.params, self._cache, jnp.asarray(req["prompt"][None]),
+                jnp.int32(r), self._next_key(), self._prefill_chunk)
+            first = int(tok[0])
+            req["out"].append(first)
+            self._last_tok[r] = first
+            self._live[r] = req
+            self._retire_if_done(r)
+
+    def _retire_if_done(self, r: int) -> None:
+        # A request can finish at ANY commit point — including its very
+        # first token, sampled during prefill — so retirement lands in a
+        # buffer that step() drains, not in step()'s local list.
+        req = self._live[r]
+        if (len(req["out"]) >= req["max_new"]
+                or (self.eos_id is not None
+                    and req["out"][-1] == self.eos_id)):
+            del self._live[r]
+            self._free.append(r)
+            self._done_buffer.append(
+                {"id": req["id"], "prompt": req["prompt"],
+                 "tokens": np.asarray(req["out"], np.int32)})
+
+    def step(self) -> list[dict]:
+        """Advance every live slot one token; returns the requests that
+        finished this step as {"id", "prompt", "tokens"} dicts (freed
+        slots are immediately refilled from the queue)."""
+        if not self._live and self._pending:
+            self._fill_slots()
+        if self._live:
+            toks = jnp.asarray(self._last_tok)  # idle rows decode garbage
+            self._cache, nxt = self._decode_step(
+                self.params, self._cache, toks, self._next_key())
+            nxt = np.asarray(nxt)
+            for r in list(self._live):
+                tok = int(nxt[r])
+                self._live[r]["out"].append(tok)
+                self._last_tok[r] = tok
+                self._retire_if_done(r)
+            self._fill_slots()
+        finished, self._done_buffer = self._done_buffer, []
+        return finished
+
+    def run(self) -> dict[int, np.ndarray]:
+        """Drive step() until every submitted request finishes; returns
+        {request_id: generated tokens}."""
+        results = {}
+        # _done_buffer may already hold requests that retired during
+        # submit()'s prefill (max_new=1, or an eos first token) - step()
+        # drains it even when nothing is live.
+        while self._live or self._pending or self._done_buffer:
+            for rec in self.step():
+                results[rec["id"]] = rec["tokens"]
+        return results

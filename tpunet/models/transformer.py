@@ -41,16 +41,19 @@ def rotary_embed(x, base: float = 10000.0, pos_offset: int = 0, positions=None):
     """Rotary position embedding. x: (b, s, h, d). pos_offset shifts to
     global positions when x is a sequence shard (cross-host ring attention —
     each process holds positions [offset, offset + s)). `positions`
-    overrides with an explicit (s,) global-position vector — what permuted
-    sequence layouts (zigzag context parallelism) need."""
+    overrides with an explicit global-position vector: (s,) shared across
+    the batch, or (b, s) per-row — what the per-row decode cache needs,
+    where each batch slot sits at its own sequence offset."""
     _, s, _, d = x.shape
     half = d // 2
     freqs = jnp.exp(-math.log(base) * jnp.arange(0, half, dtype=jnp.float32) / half)
     if positions is None:
         positions = pos_offset + jnp.arange(s, dtype=jnp.float32)
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (s, half)
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions.astype(jnp.float32)[..., :, None] * freqs  # (…, s, half)
+    if angles.ndim == 2:
+        angles = angles[None]  # shared positions -> one broadcast batch row
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
     rot = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return rot.astype(x.dtype)
@@ -164,6 +167,8 @@ class SelfAttention(nn.Module):
     prefill: bool = False  # decode=True only: first fill of an EMPTY cache
     #   runs block-causal attention through the configured kernel (flash on
     #   chip) instead of the s x cap masked dense einsum below
+    per_row_cache: bool = False  # decode=True: cache_index is (b,) — each
+    #   batch slot advances independently (continuous batching)
 
     @nn.compact
     def __call__(self, x):
@@ -231,25 +236,37 @@ class SelfAttention(nn.Module):
             ckey = self.variable("cache", "cached_key", jnp.zeros, k.shape, k.dtype)
             cval = self.variable("cache", "cached_value", jnp.zeros, v.shape, v.dtype)
             cidx = self.variable(
-                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+                "cache", "cache_index",
+                lambda: jnp.zeros((b,) if self.per_row_cache else (),
+                                  jnp.int32)
             )
             if filled:
                 idx = cidx.value
                 cap = ckey.value.shape[1]
-                # Past-capacity steps would clamp the dynamic_update_slice
-                # start and silently corrupt the tail; idx is traced, so the
+                # Past-capacity steps would clamp the write start and
+                # silently corrupt the tail; idx is traced, so the
                 # jit-compatible hard failure is poisoning the output to NaN
                 # the moment idx + s overflows — loud at the first sample.
+                # Per-row mode: everything here is (b,)-shaped — each batch
+                # slot sits at its own sequence offset (continuous
+                # batching), overflow poisons only its own row, and the
+                # cache write is a per-row scatter instead of one slice.
                 overflow = idx + s > cap
-                step_pos = (idx + jnp.arange(s)).astype(jnp.float32)
+                step_pos = (idx[..., None] + jnp.arange(s)).astype(jnp.float32)
                 q = rotary_embed(q, positions=step_pos)
                 k = rotary_embed(k, positions=step_pos)
-                ckey.value = jax.lax.dynamic_update_slice(
-                    ckey.value, k, (0, idx, 0, 0)
-                )
-                cval.value = jax.lax.dynamic_update_slice(
-                    cval.value, v, (0, idx, 0, 0)
-                )
+                if self.per_row_cache:
+                    rows = jnp.arange(b)[:, None]
+                    pos_i = idx[:, None] + jnp.arange(s)  # (b, s)
+                    ckey.value = ckey.value.at[rows, pos_i].set(k)
+                    cval.value = cval.value.at[rows, pos_i].set(v)
+                else:
+                    ckey.value = jax.lax.dynamic_update_slice(
+                        ckey.value, k, (0, idx, 0, 0)
+                    )
+                    cval.value = jax.lax.dynamic_update_slice(
+                        cval.value, v, (0, idx, 0, 0)
+                    )
                 cidx.value = idx + s
                 if self.prefill:
                     # First fill of an EMPTY cache: the block attends only
@@ -265,6 +282,8 @@ class SelfAttention(nn.Module):
                         q, k, v, self.attn_impl, self.attn_window,
                         self.flash_block_q, self.flash_block_k)
                     bad = overflow | (idx != 0)
+                    if self.per_row_cache:
+                        bad = bad[:, None, None, None]  # poison own row only
                     o = jnp.where(bad, jnp.nan, o).astype(dt)
                     o = o.reshape(b, s, h * dh)
                     return _dense(x.shape[-1], dt, "out", self.weight_quant)(o)
@@ -281,7 +300,13 @@ class SelfAttention(nn.Module):
                     "bqhgd,bkhd->bhgqk", qg, ckey.value.astype(jnp.float32)
                 ) / math.sqrt(dh)
                 key_pos = jnp.arange(cap)[None, None, None, None, :]
-                q_pos = (idx + jnp.arange(s))[None, None, None, :, None]
+                pos = idx[..., None] + jnp.arange(s)  # (s,) or (b, s)
+                if self.per_row_cache:
+                    q_pos = pos[:, None, None, :, None]
+                    row_overflow = overflow[:, None, None, None]
+                else:
+                    q_pos = pos[None, None, None, :, None]
+                    row_overflow = overflow
                 keep = key_pos <= q_pos
                 if self.attn_window is not None:
                     keep &= (q_pos - key_pos) < self.attn_window
@@ -290,7 +315,7 @@ class SelfAttention(nn.Module):
                 o = jnp.einsum(
                     "bhgqk,bkhd->bqhgd", probs, cval.value.astype(jnp.float32)
                 ).reshape(b, s, h, dh)
-                o = jnp.where(overflow, jnp.nan, o)
+                o = jnp.where(row_overflow, jnp.nan, o)
                 o = o.astype(dt).reshape(b, s, h * dh)
                 return _dense(x.shape[-1], dt, "out", self.weight_quant)(o)
 
@@ -492,6 +517,7 @@ class Block(nn.Module):
     moe_top_k: int = 1
     weight_quant: str | None = None
     prefill: bool = False
+    per_row_cache: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -503,7 +529,7 @@ class Block(nn.Module):
             flash_block_q=self.flash_block_q,
             flash_block_k=self.flash_block_k,
             weight_quant=self.weight_quant, prefill=self.prefill,
-            name="attn",
+            per_row_cache=self.per_row_cache, name="attn",
         )(RMSNorm(name="norm1")(x))
         if self.n_experts > 0:
             mlp = MoeMlp(self.n_experts, self.d_ff, self.capacity_factor,
@@ -551,6 +577,8 @@ class Transformer(nn.Module):
     #   through the configured attention kernel (flash: O(s) memory, MXU
     #   tiles) instead of the s x cap masked dense einsum; generate() uses a
     #   prefill clone for the whole-prompt call automatically
+    per_row_cache: bool = False    # decode=True: per-slot (b,) cache index —
+    #   the continuous-batching substrate (tpunet.models.serve.BatchServer)
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, features_only: bool = False):
@@ -609,7 +637,7 @@ class Transformer(nn.Module):
                 flash_block_q=self.flash_block_q,
                 flash_block_k=self.flash_block_k,
                 weight_quant=self.weight_quant, prefill=self.prefill,
-                name=f"block{i}",
+                per_row_cache=self.per_row_cache, name=f"block{i}",
             )(x)
         x = RMSNorm(name="norm_f")(x)
         if features_only:
