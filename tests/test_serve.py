@@ -58,14 +58,17 @@ def test_per_row_cache_matches_scalar_when_aligned():
     assert m2["cache"]["block0"]["attn"]["cache_index"].shape == (3,)
 
 
-def test_server_matches_generate_mixed_lengths():
+@pytest.mark.parametrize("steps_per_call", [1, 4, 16])
+def test_server_matches_generate_mixed_lengths(steps_per_call):
     """Slots running DIFFERENT prompt lengths concurrently each reproduce
-    their own single-sequence generate() output."""
+    their own single-sequence generate() output — at every window size
+    (steps_per_call coarsens scheduling granularity, never tokens)."""
     model, params = _setup(n_kv_heads=2)
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, 64, n).astype(np.int32)
                for n in (5, 9, 13)]
-    srv = BatchServer(model, params, slots=3, max_len=32)
+    srv = BatchServer(model, params, slots=3, max_len=40,
+                      steps_per_call=steps_per_call)
     ids = [srv.submit(p, 8) for p in prompts]
     results = srv.run()
     assert sorted(results) == sorted(ids)
@@ -134,6 +137,21 @@ def test_run_returns_requests_finished_at_prefill():
     rid = srv.submit(p, 1)
     results = srv.run()
     np.testing.assert_array_equal(results[rid], _oracle(model, params, p, 1))
+
+
+def test_serve_bench_cli(capsys):
+    from benchmarks.serve_bench import main as bench_main
+
+    bench_main(["--requests", "4", "--slots", "2", "--prompt", "8",
+                "--new-min", "2", "--new-max", "6", "--steps-per-call", "4",
+                "--d", "32", "--layers", "1", "--heads", "2", "--ff", "64",
+                "--vocab", "64"])
+    import json
+
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["serve_tok_s"] > 0 and out["lockstep_tok_s"] > 0
+    assert out["serve_micro_steps"] > 0
+    assert out["sched_win"] > 0
 
 
 def test_server_validation():

@@ -123,6 +123,22 @@ def _prefill(dm, params, cache, prompt, chunk: int | None):
     return cache, last_row
 
 
+def make_sampler(temperature: float, top_k, top_p):
+    """(logits (b, V), key) -> (b,) int32 tokens: argmax at temperature 0,
+    else categorical over the filtered distribution. The ONE sampler both
+    `generate` and the BatchServer draw through, so their outputs can't
+    diverge in sampling semantics."""
+
+    def sample(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, filtered_logits(logits, temperature, top_k, top_p),
+            axis=-1).astype(jnp.int32)
+
+    return sample
+
+
 def generate(
     model,
     params,
@@ -161,11 +177,7 @@ def generate(
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
-    def sample(last_logits, key):
-        if temperature == 0.0:
-            return jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
-        logits = filtered_logits(last_logits, temperature, top_k, top_p)
-        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    sample = make_sampler(temperature, top_k, top_p)
 
     # Prefill: fill cache[0:p] and take the first next-token distribution
     # from the final prompt position (chunked when prefill_chunk is set —

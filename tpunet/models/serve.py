@@ -34,8 +34,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpunet.models.generate import (_prefill, _set_cache_index,
-                                    _validate_sampling, filtered_logits,
-                                    init_cache)
+                                    _validate_sampling, init_cache,
+                                    make_sampler)
 
 
 class BatchServer:
@@ -50,10 +50,14 @@ class BatchServer:
     def __init__(self, model, params, *, slots: int, max_len: int,
                  temperature: float = 0.0, top_k: int | None = None,
                  top_p: float | None = None, eos_id: int | None = None,
-                 rng=None, prefill_chunk: int | None = None):
+                 rng=None, prefill_chunk: int | None = None,
+                 steps_per_call: int = 1):
         _validate_sampling(temperature, top_k, top_p)
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        if steps_per_call < 1:
+            raise ValueError(
+                f"steps_per_call must be >= 1, got {steps_per_call}")
         if getattr(model, "n_experts", 0):
             # MoE capacity is computed batch-wide (t = b*s slots claimed by
             # a cross-row cumsum), so other rows' tokens - including idle
@@ -78,25 +82,36 @@ class BatchServer:
         self._ids = count()
         self._last_tok = np.zeros(slots, np.int32)
         self._done_buffer: list[dict] = []  # finished before step() drained
+        self.stats = {"decode_windows": 0, "prefills": 0}
 
-        def sample(logits, key):
-            if temperature == 0.0:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return jax.random.categorical(
-                key, filtered_logits(logits, temperature, top_k, top_p),
-                axis=-1).astype(jnp.int32)
+        sample = make_sampler(temperature, top_k, top_p)
 
         # The cache is the dominant inference resident (slots x max_len x
         # layers); donating it keeps ONE buffer alive across the per-token
         # step instead of copy-in/copy-out each call (generate() gets this
         # for free by scanning inside one jit; the server's step is the
         # jit boundary). Donation is a no-op on CPU.
+        #
+        # steps_per_call > 1 scans that many micro-steps INSIDE the jit
+        # (one dispatch + one host sync per window instead of per token) —
+        # the lever that amortizes host-loop overhead at small step costs.
+        # The scheduling granularity coarsens with it: retirements and
+        # refills land at window boundaries, and a row that finishes
+        # mid-window decodes garbage for the remainder (discarded; its
+        # refill resets the row).
         @partial(jax.jit, donate_argnums=(1,))
         def decode_step(params, cache, toks, key):
-            logits, mut = self._dm.apply(
-                {"params": params, "cache": cache}, toks[:, None],
-                mutable=["cache"])
-            return mut["cache"], sample(logits[:, -1, :], key)
+            def body(carry, key):
+                cache, tok = carry
+                logits, mut = self._dm.apply(
+                    {"params": params, "cache": cache}, tok[:, None],
+                    mutable=["cache"])
+                nxt = sample(logits[:, -1, :], key)
+                return (mut["cache"], nxt), nxt
+
+            (cache, _), toks_out = jax.lax.scan(
+                body, (cache, toks), jax.random.split(key, steps_per_call))
+            return cache, toks_out.swapaxes(0, 1)  # (slots, window)
 
         @partial(jax.jit, donate_argnums=(1,), static_argnames=("chunk",))
         def prefill_slot(params, cache, prompt, r, key, chunk):
@@ -147,6 +162,7 @@ class BatchServer:
             self._cache, tok = self._prefill_slot(
                 self.params, self._cache, jnp.asarray(req["prompt"][None]),
                 jnp.int32(r), self._next_key(), self._prefill_chunk)
+            self.stats["prefills"] += 1
             first = int(tok[0])
             req["out"].append(first)
             self._last_tok[r] = first
@@ -175,14 +191,18 @@ class BatchServer:
             self._fill_slots()
         if self._live:
             toks = jnp.asarray(self._last_tok)  # idle rows decode garbage
-            self._cache, nxt = self._decode_step(
+            self._cache, window = self._decode_step(
                 self.params, self._cache, toks, self._next_key())
-            nxt = np.asarray(nxt)
+            self.stats["decode_windows"] += 1
+            window = np.asarray(window)  # (slots, steps_per_call)
             for r in list(self._live):
-                tok = int(nxt[r])
-                self._live[r]["out"].append(tok)
-                self._last_tok[r] = tok
-                self._retire_if_done(r)
+                req = self._live[r]
+                for tok in window[r]:
+                    req["out"].append(int(tok))
+                    self._last_tok[r] = int(tok)
+                    self._retire_if_done(r)
+                    if r not in self._live:
+                        break  # rest of this row's window is garbage
             self._fill_slots()
         finished, self._done_buffer = self._done_buffer, []
         return finished
