@@ -157,3 +157,32 @@ def test_batch_matches_per_window_gather(bin_path):
         w = ds.window(int(i))
         np.testing.assert_array_equal(inputs[row], w[:-1])
         np.testing.assert_array_equal(labels[row], w[1:])
+
+
+def test_byte_tokenizer_roundtrip_and_packing(tmp_path):
+    """Lossless on arbitrary UTF-8, specials above the byte range, and the
+    full text -> pack_documents -> TokenDataset -> decode loop closes."""
+    from tpunet.data import ByteTokenizer, TokenDataset, pack_documents
+
+    tok = ByteTokenizer()
+    texts = ["hello world", "ünïcödé 漢字 🙂", ""]
+    for t in texts:
+        assert tok.decode(tok.encode(t)) == t
+    ids = tok.encode("hi", eos=True)
+    assert ids.tolist() == [104, 105, tok.eos_id]
+    assert tok.decode(ids) == "hi"  # specials dropped on decode
+    bos = ByteTokenizer(add_bos=True).encode("a")
+    assert bos.tolist() == [256, 97]
+    # Out-of-range ids (a sampler under a larger model vocab) are dropped.
+    assert tok.decode(np.asarray([300, 104, -1, 105])) == "hi"
+
+    path = str(tmp_path / "corpus.bin")
+    n = pack_documents((tok.encode(t) for t in texts if t), path,
+                       vocab=tok.vocab, eos_id=tok.eos_id)
+    ds = TokenDataset(path, seq=8, vocab=tok.vocab)
+    # window(i) is (seq+1,) with a one-token label overlap — drop it when
+    # reassembling the stream.
+    flat = np.concatenate([ds.window(i)[:-1] for i in range(ds.n_windows)])
+    assert n >= flat.size
+    text = tok.decode(flat)
+    assert "hello world" in text and "漢字" in text

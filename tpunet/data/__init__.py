@@ -22,3 +22,4 @@ from tpunet.data.tokens import (  # noqa: F401
     token_batches,
 )
 from tpunet.data.prefetch import prefetch_to_device  # noqa: F401
+from tpunet.data.text import ByteTokenizer  # noqa: F401
