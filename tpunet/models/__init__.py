@@ -16,6 +16,12 @@ from tpunet.models.generate import (  # noqa: F401
     init_cache,
     speculative_generate,
 )
+from tpunet.models.lora import (  # noqa: F401
+    graft_base,
+    lora_mask,
+    lora_optimizer,
+    merge_lora,
+)
 from tpunet.models.quant import (  # noqa: F401
     dequantize_kernel,
     quantize_params,

@@ -1,0 +1,112 @@
+"""LoRA adapter utilities: masking, base grafting, merging.
+
+The model side is `Transformer(lora_rank=r)` (every Dense becomes a
+`LoraDense`: base under the "base" submodule, `lora_a`/`lora_b`
+alongside). These helpers supply the workflow around it:
+
+  graft_base(adapted_init, base_params)  load a trained base checkpoint
+      (fp kernels or quantize_params output) into a fresh adapted tree —
+      adapters keep their fresh init (B = 0, so the grafted model is
+      bitwise the base model before training).
+  lora_mask(params)                      pytree of bools, True only on
+      lora_a/lora_b (inspection / custom optimizer wiring).
+  lora_optimizer(tx, params)             the canonical frozen-base
+      optimizer: tx on the adapters, set_to_zero on everything else.
+      (NOT `optax.masked(tx, mask)` alone — masked leaves the unmasked
+      updates as RAW GRADIENTS, which apply_updates would add to the
+      "frozen" base; the classic footgun this helper exists to bury.)
+  merge_lora(params, alpha=None)         fold A @ B · (alpha/r) into each
+      fp base kernel and return a PLAIN tree for `Transformer(lora_rank=0)`
+      — zero inference overhead once training is done. Quantized bases
+      are rejected (int8 + fp delta cannot fold losslessly; keep serving
+      the adapted model, which is the QLoRA deployment mode anyway).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _is_lora_node(node) -> bool:
+    return isinstance(node, Mapping) and "lora_a" in node and "base" in node
+
+
+def lora_mask(params):
+    """Bool pytree: True exactly on lora_a/lora_b leaves (the trainable
+    set for optax.masked / optax.multi_transform)."""
+
+    def walk(node):
+        if isinstance(node, Mapping):
+            return {k: (True if k in ("lora_a", "lora_b")
+                        and not isinstance(v, Mapping) else walk(v))
+                    for k, v in node.items()}
+        return False
+
+    return walk(params)
+
+
+def lora_optimizer(tx, params):
+    """optax transform training ONLY the adapters: `tx` where lora_mask is
+    True, set_to_zero everywhere else (embed, norms, base kernels stay
+    bitwise frozen)."""
+    import jax
+    import optax
+
+    labels = jax.tree.map(lambda m: "train" if m else "freeze",
+                          lora_mask(params))
+    return optax.multi_transform(
+        {"train": tx, "freeze": optax.set_to_zero()}, labels)
+
+
+def graft_base(adapted_init, base_params):
+    """Fresh `Transformer(lora_rank=r).init` tree + trained base tree ->
+    adapted tree with the base's weights. Wherever the adapted tree has a
+    LoraDense node, the base tree holds the corresponding Dense dict at
+    the SAME path (minus the "base" nesting); everything else (embed,
+    norms) is taken from the base tree directly."""
+
+    def walk(a_node, b_node):
+        if _is_lora_node(a_node):
+            return {**a_node, "base": b_node}
+        if isinstance(a_node, Mapping):
+            if not isinstance(b_node, Mapping):
+                raise ValueError(
+                    f"tree mismatch: adapted node has keys "
+                    f"{sorted(a_node)} but base node is a leaf")
+            return {k: walk(v, b_node[k]) for k, v in a_node.items()}
+        return b_node
+
+    return walk(adapted_init, base_params)
+
+
+def merge_lora(params, alpha: float | None = None):
+    """Adapted tree -> plain tree with A @ B · (alpha/r) folded into each
+    base kernel (use with the lora_rank=0 model). The rank is read off
+    each node's lora_a (a caller-supplied rank that disagreed with the
+    params would silently mis-scale the merge). Pass the SAME alpha the
+    model was built with; None means alpha = rank (scale 1), matching
+    LoraDense's default. fp bases only."""
+
+    def walk(node):
+        if _is_lora_node(node):
+            base = node["base"]
+            if "kernel" not in base:
+                raise ValueError(
+                    "merge_lora requires an fp base (int8 bases can't "
+                    "absorb an fp delta losslessly) — serve the adapted "
+                    "model instead")
+            a = np.asarray(node["lora_a"], np.float32)
+            b = np.asarray(node["lora_b"], np.float32)
+            rank = a.shape[1]
+            scale = (alpha if alpha is not None else rank) / rank
+            w = np.asarray(base["kernel"], np.float32)
+            return {"kernel": jnp.asarray(w + (a @ b) * scale,
+                                          base["kernel"].dtype)}
+        if isinstance(node, Mapping):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(params)

@@ -93,11 +93,52 @@ class QuantDense(nn.Module):
         return y * scale.astype(self.dtype)
 
 
-def _dense(features, dtype, name, weight_quant):
+class LoraDense(nn.Module):
+    """Dense with a rank-r LoRA adapter: y = base(x) + (x @ A) @ B ·
+    (alpha/r). B initializes to ZERO, so a freshly-adapted model is
+    bitwise the base model; training typically updates only A/B
+    (`tpunet.models.lora_optimizer` — NOT bare optax.masked, which passes
+    raw gradients through to the "frozen" base) — the base stays frozen,
+    which is the parameter-efficient point. `quant=True` puts the base in int8
+    (QLoRA-style: frozen quantized weights stream at half bandwidth,
+    trainable adapters stay fp). Base params live under the "base"
+    submodule with their ordinary leaf names (kernel, or q/scale);
+    `tpunet.models.lora.graft_base` maps a base checkpoint /
+    quantize_params output into the adapted tree."""
+
+    features: int
+    rank: int
+    dtype: jnp.dtype = jnp.bfloat16
+    alpha: float | None = None  # None -> rank (scale 1)
+    quant: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        base = (QuantDense(self.features, dtype=self.dtype, name="base")
+                if self.quant else
+                nn.Dense(self.features, use_bias=False, dtype=self.dtype,
+                         name="base"))
+        y = base(x)
+        a = self.param("lora_a", nn.initializers.normal(0.02),
+                       (x.shape[-1], self.rank), jnp.float32)
+        bmat = self.param("lora_b", nn.initializers.zeros,
+                          (self.rank, self.features), jnp.float32)
+        scale = (self.alpha if self.alpha is not None else self.rank
+                 ) / self.rank
+        delta = (x.astype(self.dtype) @ a.astype(self.dtype)
+                 ) @ bmat.astype(self.dtype)
+        return y + delta * jnp.asarray(scale, self.dtype)
+
+
+def _dense(features, dtype, name, weight_quant, lora_rank=0, lora_alpha=None):
     """The Dense factory every matmul in this family goes through: fp by
     default, QuantDense under weight_quant="int8" — SAME module names, so
     the quantized param tree is the fp tree with each kernel dict swapped
-    for {q, scale} (what quantize_params produces)."""
+    for {q, scale} (what quantize_params produces) — and LoraDense when
+    lora_rank > 0 (base params nested under "base", adapters alongside)."""
+    if lora_rank > 0:
+        return LoraDense(features, lora_rank, dtype=dtype, alpha=lora_alpha,
+                         quant=weight_quant is not None, name=name)
     if weight_quant is None:
         return nn.Dense(features, use_bias=False, dtype=dtype, name=name)
     return QuantDense(features, dtype=dtype, name=name)
@@ -169,6 +210,8 @@ class SelfAttention(nn.Module):
     #   chip) instead of the s x cap masked dense einsum below
     per_row_cache: bool = False  # decode=True: cache_index is (b,) — each
     #   batch slot advances independently (continuous batching)
+    lora_rank: int = 0
+    lora_alpha: float | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -211,7 +254,7 @@ class SelfAttention(nn.Module):
                 f"'flash', not {self.attn_impl!r}"
             )
         dt = self.compute_dtype
-        proj = lambda nh, name: _dense(nh * dh, dt, name, self.weight_quant)
+        proj = lambda nh, name: _dense(nh * dh, dt, name, self.weight_quant, self.lora_rank, self.lora_alpha)
         q = proj(h, "q")(x).reshape(b, s, h, dh)
         k = proj(kv, "k")(x).reshape(b, s, kv, dh)
         v = proj(kv, "v")(x).reshape(b, s, kv, dh)
@@ -286,7 +329,8 @@ class SelfAttention(nn.Module):
                         bad = bad[:, None, None, None]  # poison own row only
                     o = jnp.where(bad, jnp.nan, o).astype(dt)
                     o = o.reshape(b, s, h * dh)
-                    return _dense(x.shape[-1], dt, "out", self.weight_quant)(o)
+                    return _dense(x.shape[-1], dt, "out", self.weight_quant,
+                                  self.lora_rank, self.lora_alpha)(o)
                 # Grouped einsum: q reshaped to (b, s, kv, group, dh)
                 # contracts DIRECTLY against the (b, cap, kv, dh) cache —
                 # the group-repeated K/V never exists in HBM. This is the
@@ -317,7 +361,8 @@ class SelfAttention(nn.Module):
                 ).reshape(b, s, h, dh)
                 o = jnp.where(row_overflow, jnp.nan, o)
                 o = o.astype(dt).reshape(b, s, h * dh)
-                return _dense(x.shape[-1], dt, "out", self.weight_quant)(o)
+                return _dense(x.shape[-1], dt, "out", self.weight_quant,
+                              self.lora_rank, self.lora_alpha)(o)
 
         pos_offset = 0
         positions = None
@@ -393,7 +438,8 @@ class SelfAttention(nn.Module):
                 self.flash_block_q, self.flash_block_k)
 
         o = o.reshape(b, s, h * dh)
-        return _dense(x.shape[-1], dt, "out", self.weight_quant)(o)
+        return _dense(x.shape[-1], dt, "out", self.weight_quant,
+                      self.lora_rank, self.lora_alpha)(o)
 
 
 class Mlp(nn.Module):
@@ -405,21 +451,23 @@ class Mlp(nn.Module):
     compute_dtype: jnp.dtype = jnp.bfloat16
     mlp_impl: str = "gelu"
     weight_quant: str | None = None
+    lora_rank: int = 0
+    lora_alpha: float | None = None
 
     @nn.compact
     def __call__(self, x):
         dt = self.compute_dtype
-        wq = self.weight_quant
+        wq, lr, la = self.weight_quant, self.lora_rank, self.lora_alpha
         if self.mlp_impl == "swiglu":
-            g = _dense(self.d_ff, dt, "gate", wq)(x)
-            h = _dense(self.d_ff, dt, "up", wq)(x)
+            g = _dense(self.d_ff, dt, "gate", wq, lr, la)(x)
+            h = _dense(self.d_ff, dt, "up", wq, lr, la)(x)
             h = nn.silu(g) * h
         elif self.mlp_impl == "gelu":
-            h = _dense(self.d_ff, dt, "up", wq)(x)
+            h = _dense(self.d_ff, dt, "up", wq, lr, la)(x)
             h = nn.gelu(h)
         else:
             raise ValueError(f"unknown mlp_impl {self.mlp_impl!r}")
-        return _dense(x.shape[-1], dt, "down", wq)(h)
+        return _dense(x.shape[-1], dt, "down", wq, lr, la)(h)
 
 
 class MoeMlp(nn.Module):
@@ -518,6 +566,8 @@ class Block(nn.Module):
     weight_quant: str | None = None
     prefill: bool = False
     per_row_cache: bool = False
+    lora_rank: int = 0
+    lora_alpha: float | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -529,14 +579,17 @@ class Block(nn.Module):
             flash_block_q=self.flash_block_q,
             flash_block_k=self.flash_block_k,
             weight_quant=self.weight_quant, prefill=self.prefill,
-            per_row_cache=self.per_row_cache, name="attn",
+            per_row_cache=self.per_row_cache, lora_rank=self.lora_rank,
+            lora_alpha=self.lora_alpha, name="attn",
         )(RMSNorm(name="norm1")(x))
         if self.n_experts > 0:
             mlp = MoeMlp(self.n_experts, self.d_ff, self.capacity_factor,
                          self.compute_dtype, top_k=self.moe_top_k, name="moe")
         else:
             mlp = Mlp(self.d_ff, self.compute_dtype, self.mlp_impl,
-                      weight_quant=self.weight_quant, name="mlp")
+                      weight_quant=self.weight_quant,
+                      lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
+                      name="mlp")
         return x + mlp(RMSNorm(name="norm2")(x))
 
 
@@ -579,6 +632,11 @@ class Transformer(nn.Module):
     #   prefill clone for the whole-prompt call automatically
     per_row_cache: bool = False    # decode=True: per-slot (b,) cache index —
     #   the continuous-batching substrate (tpunet.models.serve.BatchServer)
+    lora_rank: int = 0             # > 0: rank-r LoRA adapters on every Dense
+    #   (tpunet.models.lora: lora_mask to train only A/B, graft_base to
+    #   load a base checkpoint, merge_lora to fold back); composes with
+    #   weight_quant="int8" (QLoRA: frozen int8 base + fp adapters)
+    lora_alpha: float | None = None
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, features_only: bool = False):
@@ -599,6 +657,13 @@ class Transformer(nn.Module):
                     "weight_quant is incompatible with features_only: the "
                     "blockwise fused cross-entropy reads an fp lm_head "
                     "kernel from the params tree")
+        if self.lora_rank > 0 and features_only:
+            raise ValueError(
+                "lora_rank is incompatible with features_only: the "
+                "blockwise fused cross-entropy reads params['lm_head']"
+                "['kernel'], but the adapted tree nests it under 'base' "
+                "(and the lm_head adapters would be silently dropped) - "
+                "merge_lora first, or train without fused xent")
         emb = self.param(
             "embed", nn.initializers.normal(0.02), (self.vocab, self.d_model)
         )
@@ -637,7 +702,9 @@ class Transformer(nn.Module):
                 flash_block_q=self.flash_block_q,
                 flash_block_k=self.flash_block_k,
                 weight_quant=self.weight_quant, prefill=self.prefill,
-                per_row_cache=self.per_row_cache, name=f"block{i}",
+                per_row_cache=self.per_row_cache,
+                lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
+                name=f"block{i}",
             )(x)
         x = RMSNorm(name="norm_f")(x)
         if features_only:
@@ -649,7 +716,8 @@ class Transformer(nn.Module):
                          name="lm_head")(x[..., :1, :])
             return x.astype(self.compute_dtype)
         logits = _dense(self.vocab, self.compute_dtype, "lm_head",
-                        self.weight_quant)(x)
+                        self.weight_quant, self.lora_rank,
+                        self.lora_alpha)(x)
         return logits.astype(jnp.float32)
 
 
@@ -686,4 +754,29 @@ def transformer_partition_rules(
         (r".*mlp/down/scale", P()),
         (r".*lm_head/q", P(None, tp_axis)),
         (r".*lm_head/scale", P(tp_axis)),
+        # lora_rank>0 trees: base kernels nest one level deeper ("base/"),
+        # same specs as their plain forms. Adapters follow the Megatron
+        # LoRA convention: for a column-parallel W, A (in, r) replicates
+        # and B (r, out) shards its output dim; for a row-parallel W,
+        # A (in, r) shards its input dim and B replicates - each adapter
+        # matmul then lives on the same shards as its base matmul.
+        (r".*attn/(q|k|v)/base/kernel", P(None, tp_axis)),
+        (r".*attn/out/base/kernel", P(tp_axis, None)),
+        (r".*mlp/(up|gate)/base/kernel", P(None, tp_axis)),
+        (r".*mlp/down/base/kernel", P(tp_axis, None)),
+        (r".*lm_head/base/kernel", P(None, tp_axis)),
+        (r".*attn/(q|k|v)/base/q", P(None, tp_axis)),
+        (r".*attn/(q|k|v)/base/scale", P(tp_axis)),
+        (r".*attn/out/base/q", P(tp_axis, None)),
+        (r".*attn/out/base/scale", P()),
+        (r".*mlp/(up|gate)/base/q", P(None, tp_axis)),
+        (r".*mlp/(up|gate)/base/scale", P(tp_axis)),
+        (r".*mlp/down/base/q", P(tp_axis, None)),
+        (r".*mlp/down/base/scale", P()),
+        (r".*lm_head/base/q", P(None, tp_axis)),
+        (r".*lm_head/base/scale", P(tp_axis)),
+        (r".*(attn/(q|k|v)|mlp/(up|gate)|lm_head)/lora_a", P()),
+        (r".*(attn/(q|k|v)|mlp/(up|gate)|lm_head)/lora_b", P(None, tp_axis)),
+        (r".*(attn/out|mlp/down)/lora_a", P(tp_axis, None)),
+        (r".*(attn/out|mlp/down)/lora_b", P()),
     ]
