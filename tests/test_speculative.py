@@ -263,3 +263,20 @@ def test_filtered_logits_shared_helper():
         if cum >= 0.6:
             break
     np.testing.assert_array_equal(keep, expect)
+
+
+def test_all_inference_features_compose_greedy_exact():
+    """The whole inference feature matrix in ONE configuration: GQA x
+    sliding window x chunked prefill x speculative decoding with an int8
+    quantized self-draft - greedy output must still be bitwise the plain
+    fp generate()'s."""
+    from tpunet.models import quantize_params
+
+    model = _tiny(n_kv_heads=2, attn_window=12)
+    params, prompt = _params(model)
+    qdraft = model.clone(weight_quant="int8")
+    qp = quantize_params(params)
+    want = generate(model, params, prompt, 10)
+    got = speculative_generate(
+        model, params, qdraft, qp, prompt, 10, gamma=3, prefill_chunk=7)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
