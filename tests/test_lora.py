@@ -212,3 +212,64 @@ def test_lora_with_fit_and_checkpoint(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(resumed.params["block0"]["attn"]["q"]["lora_b"]),
         trained_b)
+
+
+def test_qlora_training_step_with_float0():
+    """The QLoRA gradient/apply path: allow_int gives float0 grads for the
+    int8 base; lora_apply_updates leaves those leaves alone while the
+    adapters move (plain optax.apply_updates would crash on float0)."""
+    from tpunet.models import lora_apply_updates
+
+    base_model, base_params, toks = _base()
+    qlmodel = base_model.clone(weight_quant="int8", lora_rank=4)
+    qinit = qlmodel.init(jax.random.PRNGKey(2), toks)["params"]
+    params = graft_base(qinit, quantize_params(base_params))
+    base_q = np.asarray(params["block0"]["attn"]["q"]["base"]["q"])
+    tx = lora_optimizer(optax.adam(1e-2), params)
+    opt_state = tx.init(params)
+    labels = jnp.roll(toks, -1, axis=1)
+
+    def loss_fn(p):
+        logits = qlmodel.apply({"params": p}, toks)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    for _ in range(5):
+        loss, g = jax.value_and_grad(loss_fn, allow_int=True)(params)
+        updates, opt_state = tx.update(g, opt_state, params)
+        params = lora_apply_updates(params, updates)
+    node = params["block0"]["attn"]["q"]
+    np.testing.assert_array_equal(np.asarray(node["base"]["q"]), base_q)
+    assert node["base"]["q"].dtype == jnp.int8
+    assert not (np.asarray(node["lora_b"]) == 0).all()
+
+
+def test_qlora_trains_through_fit():
+    """QLoRA through the standard driver: make_train_step differentiates a
+    tree containing frozen int8 leaves (allow_int -> float0) and applies
+    updates without touching them; fit() runs it. Covers both the single
+    backward and the accum_steps scan."""
+    from tpunet.train import TrainState, fit, make_train_step
+
+    base_model, base_params, toks = _base()
+    qlmodel = base_model.clone(weight_quant="int8", lora_rank=4)
+    qinit = qlmodel.init(jax.random.PRNGKey(2), toks)["params"]
+    qbase = quantize_params(base_params)
+    params = graft_base(qinit, qbase)
+    base_q = np.asarray(params["block0"]["attn"]["q"]["base"]["q"])
+    tx = lora_optimizer(optax.adam(1e-2), params)
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       opt_state=tx.init(params))
+    labels = jnp.roll(toks, -1, axis=1)
+
+    def batches():
+        while True:
+            yield toks, labels
+
+    for accum in (None, 2):
+        step = make_train_step(qlmodel, tx, accum_steps=accum)
+        state = fit(state, step, batches(), steps=int(state.step) + 4)
+        node = state.params["block0"]["attn"]["q"]
+        np.testing.assert_array_equal(np.asarray(node["base"]["q"]), base_q)
+        assert node["base"]["q"].dtype == jnp.int8
+        assert not (np.asarray(node["lora_b"]) == 0).all()

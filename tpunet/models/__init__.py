@@ -18,6 +18,7 @@ from tpunet.models.generate import (  # noqa: F401
 )
 from tpunet.models.lora import (  # noqa: F401
     graft_base,
+    lora_apply_updates,
     lora_mask,
     lora_optimizer,
     merge_lora,

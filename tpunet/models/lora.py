@@ -12,6 +12,11 @@ alongside). These helpers supply the workflow around it:
       lora_a/lora_b (inspection / custom optimizer wiring).
   lora_optimizer(tx, params)             the canonical frozen-base
       optimizer: tx on the adapters, set_to_zero on everything else.
+      QLoRA note: differentiate with `jax.value_and_grad(loss, allow_int=
+      True)` (the int8 base is inside params; its grads come back as
+      float0) and apply with `lora_apply_updates` (plain
+      optax.apply_updates can't add float0; the helper treats it as
+      "leave the leaf alone").
       (NOT `optax.masked(tx, mask)` alone — masked leaves the unmasked
       updates as RAW GRADIENTS, which apply_updates would add to the
       "frozen" base; the classic footgun this helper exists to bury.)
@@ -59,6 +64,20 @@ def lora_optimizer(tx, params):
                           lora_mask(params))
     return optax.multi_transform(
         {"train": tx, "freeze": optax.set_to_zero()}, labels)
+
+
+def lora_apply_updates(params, updates):
+    """optax.apply_updates that passes float0 updates through unchanged —
+    the QLoRA apply step for hand-rolled loops. Under `allow_int=True`
+    the frozen int8 base's gradients come back as float0 (a zero-size
+    dtype no arithmetic accepts), and plain apply_updates crashes adding
+    them; a float0 update means "leave the leaf alone", which is exactly
+    the frozen-base contract. make_train_step/fit() use the same
+    semantics internally, so QLoRA trains through the standard driver
+    too."""
+    from tpunet.train.trainer import _apply_updates
+
+    return _apply_updates(params, updates)
 
 
 def graft_base(adapted_init, base_params):

@@ -187,6 +187,32 @@ def _make_loss_fn(model, images, labels, dropout_rng, moe_aux_weight: float,
     return loss_fn
 
 
+def _grad_zeros(p):
+    """Zero gradient accumulator for one param leaf: ordinary zeros for
+    inexact dtypes, a float0 placeholder for integer leaves (QLoRA's
+    frozen int8 base) — float0 is what allow_int gradients produce, and
+    it never accumulates or divides."""
+    import numpy as np
+
+    if jnp.issubdtype(p.dtype, jnp.inexact):
+        return jnp.zeros_like(p)
+    return np.zeros(p.shape, jax.dtypes.float0)
+
+
+def _grad_add(acc, g):
+    return acc if acc.dtype == jax.dtypes.float0 else jnp.add(acc, g)
+
+
+def _apply_updates(params, updates):
+    """optax.apply_updates with float0 pass-through: a float0 update
+    (integer leaf under allow_int — QLoRA's frozen int8 base) leaves the
+    leaf untouched; fp updates apply with the usual cast back to the
+    param dtype."""
+    return jax.tree.map(
+        lambda p, u: p if u.dtype == jax.dtypes.float0
+        else jnp.asarray(p + u, p.dtype), params, updates)
+
+
 def _value_and_grads(model, params, images, labels, dropout_rng,
                      moe_aux_weight: float, fused_xent_block: int | None,
                      accum_steps: int | None, z_loss: float = 0.0):
@@ -201,7 +227,11 @@ def _value_and_grads(model, params, images, labels, dropout_rng,
     if accum_steps is None or accum_steps == 1:
         loss_fn = _make_loss_fn(model, images, labels, dropout_rng,
                                 moe_aux_weight, fused_xent_block, z_loss)
-        return jax.value_and_grad(loss_fn)(params)
+        # allow_int: identical for ordinary fp trees, and lets a QLoRA
+        # tree (frozen int8 base leaves inside params) differentiate —
+        # the int leaves come back as float0, which _apply_updates and
+        # the float0-aware accumulation below treat as "frozen".
+        return jax.value_and_grad(loss_fn, allow_int=True)(params)
 
     batch = images.shape[0]
     if batch % accum_steps != 0:
@@ -222,13 +252,15 @@ def _value_and_grads(model, params, images, labels, dropout_rng,
         im, lb, key = xs
         loss_fn = _make_loss_fn(model, im, lb, key, moe_aux_weight,
                                 fused_xent_block, z_loss)
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        return (loss_sum + loss, jax.tree.map(jnp.add, grad_sum, grads)), None
+        loss, grads = jax.value_and_grad(loss_fn, allow_int=True)(params)
+        return (loss_sum + loss,
+                jax.tree.map(_grad_add, grad_sum, grads)), None
 
-    init = (jnp.zeros((), jnp.float32), jax.tree.map(jnp.zeros_like, params))
+    init = (jnp.zeros((), jnp.float32), jax.tree.map(_grad_zeros, params))
     (loss_sum, grad_sum), _ = jax.lax.scan(body, init, (images_mb, labels_mb, keys))
     return loss_sum / accum_steps, jax.tree.map(
-        lambda g: g / accum_steps, grad_sum
+        lambda g: g if g.dtype == jax.dtypes.float0 else g / accum_steps,
+        grad_sum
     )
 
 
@@ -289,7 +321,7 @@ def make_train_step(model, tx, cross_host: bool = False, donate: bool = True,
                 grads = unravel(reduced)
 
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        params = _apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), loss
 
     return jax.jit(train_step, donate_argnums=(0,) if donate else ())
