@@ -90,7 +90,7 @@ STEPS: list[tuple[str, list[str], int]] = [
                       "--d", "2048", "--layers", "12", "--heads", "16",
                       "--ff", "8192", "--batch", "8", "--prompt", "512",
                       "--new", "256", "--quant", "int8", "--spec-gamma", "4",
-                      "--spec-draft", "quant"], 2400),
+                      "--spec-draft", "quant", "--spec-per-row"], 2400),
     # Time-to-first-token pair: long prompt, few new tokens. The flash
     # variant routes the empty-cache prefill through the Mosaic kernel
     # (O(p) score memory, K/V streamed at kv-head width); the reference

@@ -44,6 +44,9 @@ def main(argv=None) -> None:
     p.add_argument("--draft-layers", type=int, default=2,
                    help="draft model depth for --spec-gamma shallow mode "
                         "(same d/heads/vocab; random weights)")
+    p.add_argument("--spec-per-row", action="store_true",
+                   help="per-row speculative commits (each row keeps its "
+                        "own accepted prefix; lockstep min otherwise)")
     p.add_argument("--spec-draft", choices=["shallow", "quant"],
                    default="shallow",
                    help="shallow = random small draft (acceptance floor + "
@@ -155,7 +158,8 @@ def main(argv=None) -> None:
         sgen = jax.jit(
             lambda params, dparams, prompt: speculative_generate(
                 model, params, draft, dparams, prompt, args.new,
-                gamma=args.spec_gamma, return_stats=True))
+                gamma=args.spec_gamma, per_row=args.spec_per_row,
+                return_stats=True))
         out, stats = sgen(params, draft_params, prompt)  # compile + warm
         np.asarray(out)
         stimes = []
@@ -170,6 +174,7 @@ def main(argv=None) -> None:
         spec = {
             "gamma": args.spec_gamma,
             "draft": args.spec_draft,
+            "per_row": args.spec_per_row,
             **({"draft_layers": args.draft_layers}
                if args.spec_draft == "shallow" else {}),
             "wall_s": round(sbest, 4),

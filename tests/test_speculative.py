@@ -280,3 +280,61 @@ def test_all_inference_features_compose_greedy_exact():
     got = speculative_generate(
         model, params, qdraft, qp, prompt, 10, gamma=3, prefill_chunk=7)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_per_row_speculative_bitwise_and_fewer_rounds():
+    """per_row=True: every row commits its OWN accepted prefix - output
+    still bitwise generate()'s, and (greedy being deterministic) the
+    round count can only improve on lockstep (lockstep progress per round
+    is the batch min, per-row progress is each row's own)."""
+    model = _tiny()
+    params, _ = _params(model, b=3)
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (3, 24), 0, 64)
+    draft = _tiny(n_layers=1)
+    draft_params, _ = _params(draft, seed=7)
+    want = generate(model, params, prompt, 14)
+    got_ls, st_ls = speculative_generate(
+        model, params, draft, draft_params, prompt, 14, gamma=3,
+        return_stats=True)
+    got_pr, st_pr = speculative_generate(
+        model, params, draft, draft_params, prompt, 14, gamma=3,
+        per_row=True, return_stats=True)
+    np.testing.assert_array_equal(np.asarray(got_ls), np.asarray(want))
+    np.testing.assert_array_equal(np.asarray(got_pr), np.asarray(want))
+    assert int(st_pr["rounds"]) <= int(st_ls["rounds"])
+    assert 0.0 <= float(st_pr["draft_accept_rate"]) <= 1.0
+
+
+def test_per_row_speculative_eos_and_sampling():
+    """per_row composes with eos pinning (bitwise vs the eos oracle in
+    greedy) and runs in sampling mode with in-vocab output."""
+    model = _tiny(vocab=8)
+    params, _ = _params(model, b=3, s=6)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (3, 6), 0, 8)
+    draft = _tiny(vocab=8, n_layers=1)
+    draft_params, _ = _params(draft, b=3, s=6, seed=9)
+    want = np.asarray(generate(model, params, prompt, 12, eos_id=5))
+    got = np.asarray(speculative_generate(
+        model, params, draft, draft_params, prompt, 12, gamma=3,
+        eos_id=5, per_row=True))
+    np.testing.assert_array_equal(got, want)
+
+    out = speculative_generate(
+        model, params, draft, draft_params, prompt, 9, gamma=2,
+        temperature=0.8, per_row=True, rng=jax.random.PRNGKey(3))
+    o = np.asarray(out)
+    assert o.shape == (3, 15) and ((o >= 0) & (o < 8)).all()
+
+
+def test_per_row_speculative_with_quant_draft_and_chunked_prefill():
+    """per_row x int8 self-draft x chunked prefill: still bitwise."""
+    from tpunet.models import quantize_params
+
+    model = _tiny(n_kv_heads=2)
+    params, prompt = _params(model)
+    qdraft = model.clone(weight_quant="int8")
+    qp = quantize_params(params)
+    want = generate(model, params, prompt, 10)
+    got = speculative_generate(model, params, qdraft, qp, prompt, 10,
+                               gamma=3, per_row=True, prefill_chunk=7)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -241,6 +241,7 @@ def speculative_generate(
     rng=None,
     eos_id: int | None = None,
     prefill_chunk: int | None = None,
+    per_row: bool = False,
     return_stats: bool = False,
 ):
     """Speculative decoding: draft `gamma` tokens with the cheap
@@ -253,22 +254,33 @@ def speculative_generate(
     TPU-first shape discipline: every round runs the same static program —
     gamma single-token draft steps (small-model scan) and one
     (b, gamma+1)-token target verify (MXU-batched, reusing the decode
-    cache's block step) — inside a `lax.while_loop`. The batch commits in
-    LOCKSTEP: n = min over sequences of each row's accepted-prefix length,
-    and every sequence advances n+1 tokens (its own accepted draft token,
-    or its residual/bonus sample, at position n). Truncating at a
-    cross-batch stopping time discards only later coin flips, so each
-    row's kept tokens still follow the exact per-position scheme; the cost
-    is throughput (min over the batch), not correctness. Both KV caches
-    roll back by simply writing `cache_index` — entries beyond it are
-    masked by the decode step's `key_pos <= q_pos` and overwritten by the
-    next round's block write.
+    cache's block step) — inside a `lax.while_loop`. By default the batch
+    commits in LOCKSTEP: n = min over sequences of each row's
+    accepted-prefix length, and every sequence advances n+1 tokens (its
+    own accepted draft token, or its residual/bonus sample, at position
+    n). Truncating at a cross-batch stopping time discards only later
+    coin flips, so each row's kept tokens still follow the exact
+    per-position scheme; the cost is throughput (min over the batch), not
+    correctness. Both KV caches roll back by simply writing
+    `cache_index` — entries beyond it are masked by the decode step's
+    `key_pos <= q_pos` and overwritten by the next round's block write.
+
+    `per_row=True` removes the lockstep throughput cost: the models run
+    with per-row cache indexes (the continuous-batching substrate), so
+    EVERY row commits its own full accepted prefix each round — the
+    min-over-batch existed only because a scalar cache index forces one
+    shared frontier. Rows that reach max_new_tokens early keep
+    drafting/verifying garbage into their own (bounded, frozen-frontier)
+    cache tail until the slowest row finishes — wasted compute, identical
+    outputs; the same static-shape trade the BatchServer makes.
 
     The draft model trades acceptance rate for speed (same tokenizer/vocab
     required); its quality affects ONLY throughput, never the output
     distribution. Returns (b, p + max_new_tokens) int32 like `generate`;
     with return_stats=True, also a dict with `rounds` and
-    `draft_accept_rate` (diagnostics for tuning gamma).
+    `draft_accept_rate` (acceptance over rows still doing real work —
+    eos-finished and schedule-frozen rows are excluded; diagnostics for
+    tuning gamma).
     """
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
@@ -276,11 +288,14 @@ def speculative_generate(
         raise ValueError(f"gamma must be >= 1, got {gamma}")
     _validate_sampling(temperature, top_k, top_p)
     b, p = prompt.shape
-    cap = p + max_new_tokens + gamma  # verify may overshoot max_new by < gamma
-    tm = model.clone(decode=True)
-    dm = draft_model.clone(decode=True)
-    t_cache = init_cache(model, b, cap)
-    d_cache = init_cache(draft_model, b, cap)
+    # Slack past max_new: the verify block overshoots by < gamma, and in
+    # per-row mode a finished row's frozen frontier rewrites one more
+    # block-width each extra round.
+    cap = p + max_new_tokens + gamma + 1
+    tm = model.clone(decode=True, per_row_cache=per_row)
+    dm = draft_model.clone(decode=True, per_row_cache=per_row)
+    t_cache = init_cache(tm, b, cap)
+    d_cache = init_cache(dm, b, cap)
     if rng is None:
         rng = jax.random.PRNGKey(0)
     greedy = temperature == 0.0
@@ -321,10 +336,12 @@ def speculative_generate(
             ).astype(jnp.int32)
         return (mut["cache"], nxt), (nxt, q)
 
+    rows_i = jnp.arange(b)
+
     def round_body(state):
         out, n_out, t_cache, d_cache, done, rng, rounds, acc_sum, prop_sum = state
-        L = p + n_out  # committed tokens so far; cache holds [0, L-1)
-        last_tok = jax.lax.dynamic_slice(out, (0, L - 1), (b, 1))[:, 0]
+        L_rows = p + n_out            # (b,) committed tokens per row
+        last_tok = out[rows_i, L_rows - 1]
         rng, k_draft, k_accept, k_fix = jax.random.split(rng, 4)
 
         # 1. Draft gamma tokens (small model, sequential scan) — plus ONE
@@ -363,38 +380,50 @@ def speculative_generate(
             u = jax.random.uniform(k_accept, (b, gamma))
             accept = u * q_tok < p_tok
         n_rows = _leading_accepts(accept)         # (b,)
+        # Diagnostic accounting BEFORE the done/frozen forcing below: only
+        # rows still doing real work count, or eos-finished and
+        # schedule-frozen rows (forced to gamma / drafting garbage) would
+        # inflate the reported acceptance toward 1.0.
+        active = (n_out < max_new_tokens) & ~done
+        acc_sum = acc_sum + jnp.sum(jnp.where(active, n_rows, 0))
+        prop_sum = prop_sum + gamma * jnp.sum(active)
         # A finished row must not hold the batch back (its output is
         # pinned to eos regardless of what its branch computes).
         n_rows = jnp.where(done, gamma, n_rows)
-        n = jnp.min(n_rows)
+        # The round's effective accepted-prefix length per row: its OWN
+        # acceptance in per_row mode; the batch min under a shared scalar
+        # cache index (one frontier forces one commit length).
+        n_eff = n_rows if per_row else jnp.broadcast_to(
+            jnp.min(n_rows), (b,))
 
-        # 4. The (n+1)-th token of the round, per row: its own accepted
-        # draft token when its rejection came later (the coin already
-        # accepted position n), else the residual sample (exactness
-        # partner of the rejection), else — when the whole block was
-        # accepted — a bonus sample from the target's row gamma.
-        fix_rows = jnp.arange(b)
+        # 4. The (n_eff+1)-th token of the round, per row: its own
+        # accepted draft token when its rejection came later (lockstep
+        # only — the coin already accepted position n_eff), else the
+        # residual sample at its own rejection point (exactness partner of
+        # the rejection), else — whole block accepted — a bonus sample
+        # from the target's row gamma.
         if greedy:
-            fix_tok = t_argmax[fix_rows, n]
+            fix_tok = t_argmax[rows_i, n_eff]
         else:
-            p_n = p_probs[fix_rows, n, :]
+            p_n = p_probs[rows_i, n_eff, :]
             q_n = q_probs[
-                fix_rows, jnp.minimum(n, gamma - 1), :]  # row gamma: unused
+                rows_i, jnp.minimum(n_eff, gamma - 1), :]  # row gamma: unused
             res = _residual_probs(p_n, q_n)
-            bonus_or_res = jnp.where(n >= gamma, p_n, res)
+            bonus_or_res = jnp.where((n_eff >= gamma)[:, None], p_n, res)
             fix_tok = jax.random.categorical(
                 k_fix, jnp.log(jnp.maximum(bonus_or_res, 1e-30)), axis=-1
             ).astype(jnp.int32)
-        keep_own = (n_rows > n) & (n < gamma)
-        e_tok = jnp.where(keep_own, d_toks[:, jnp.minimum(n, gamma - 1)],
+        keep_own = (n_rows > n_eff) & (n_eff < gamma)
+        e_tok = jnp.where(keep_own,
+                          d_toks[rows_i, jnp.minimum(n_eff, gamma - 1)],
                           fix_tok).astype(jnp.int32)
 
         # 5. Commit the block into `out` (static-width write; entries past
-        # n+1 are junk that the next round — or the final slice —
+        # n_eff+1 are junk the next round — or the final slice —
         # overwrites/drops), with eos pinning threaded through it.
         w = jnp.concatenate([d_toks, e_tok[:, None]], axis=1)  # (b, gamma+1)
         offs = jnp.arange(gamma + 1)[None, :]
-        w = jnp.where(offs == n, e_tok[:, None], w)
+        w = jnp.where(offs == n_eff[:, None], e_tok[:, None], w)
         if eos_id is not None:
             seen = done
             cols_list = []
@@ -403,25 +432,34 @@ def speculative_generate(
                 seen = seen | (wj == eos_id)
                 cols_list.append(wj)
             w = jnp.stack(cols_list, axis=1)
-            committed_mask = offs <= n
+            committed_mask = offs <= n_eff[:, None]
             done = done | jnp.any((w == eos_id) & committed_mask, axis=1)
-        out = jax.lax.dynamic_update_slice(out, w, (0, L))
+        # Per-row scatter (rows sit at different offsets; finished rows'
+        # writes land in the slack columns past max_new and are sliced
+        # off). mode="drop" guards the clamped-frontier overshoot.
+        out = out.at[rows_i[:, None], L_rows[:, None] + offs].set(
+            w, mode="drop")
 
-        # 6. Roll both caches back to the committed frontier: correct K/V
-        # exists for [0, L + n) (verify/draft wrote the accepted tokens);
-        # the freshly emitted token at L + n enters the caches as the next
-        # round's first input. Stale tail entries are masked and later
-        # overwritten.
-        t_cache = _set_cache_index(t_cache, L + n)
-        d_cache = _set_cache_index(d_cache, L + n)
-        return (out, n_out + n + 1, t_cache, d_cache, done, rng,
-                rounds + 1, acc_sum + n, prop_sum + gamma)
+        # 6. Advance each row (clamped at the schedule — a finished row's
+        # frontier freezes, bounding its garbage tail) and roll both
+        # caches to the committed frontier: correct K/V exists for
+        # [0, commit_len - 1); the last committed token enters the caches
+        # as the next round's first input. Stale tail entries are masked
+        # and later overwritten.
+        n_out_new = jnp.minimum(n_out + n_eff + 1, max_new_tokens)
+        cidx = p + n_out_new - 1
+        if not per_row:
+            cidx = cidx[0]  # scalar-cache models need a scalar index
+        t_cache = _set_cache_index(t_cache, cidx)
+        d_cache = _set_cache_index(d_cache, cidx)
+        return (out, n_out_new, t_cache, d_cache, done, rng,
+                rounds + 1, acc_sum, prop_sum)
 
     def round_cond(state):
-        return state[1] < max_new_tokens
+        return jnp.min(state[1]) < max_new_tokens
 
-    state = (out0, jnp.int32(1), t_cache, d_cache, done0, rng,
-             jnp.int32(0), jnp.int32(0), jnp.int32(0))
+    state = (out0, jnp.full((b,), 1, jnp.int32), t_cache, d_cache, done0,
+             rng, jnp.int32(0), jnp.int32(0), jnp.int32(0))
     out, n_out, *_, rounds, acc_sum, prop_sum = jax.lax.while_loop(
         round_cond, round_body, state)
     result = jax.lax.slice(out, (0, 0), (b, p + max_new_tokens))
@@ -429,7 +467,6 @@ def speculative_generate(
         return result
     return result, {
         "rounds": rounds,
-        "tokens": n_out,
         "draft_accept_rate": acc_sum / jnp.maximum(prop_sum, 1),
     }
 
@@ -438,12 +475,14 @@ def _set_cache_index(cache, idx):
     """Rewrite every layer's cache_index leaf to `idx` — the rollback
     primitive speculative decoding relies on: the decode step masks keys
     at positions > its running index and block-writes from it, so moving
-    the index IS the rollback."""
+    the index IS the rollback. `idx` may be a scalar (broadcast to every
+    leaf shape) or a (b,) vector for per-row caches."""
 
     def fix(path, leaf):
         name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
         if name == "cache_index":
-            return jnp.full(leaf.shape, idx, leaf.dtype)
+            return jnp.broadcast_to(
+                jnp.asarray(idx, leaf.dtype), leaf.shape)
         return leaf
 
     return jax.tree_util.tree_map_with_path(fix, cache)
