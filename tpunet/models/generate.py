@@ -3,11 +3,12 @@
 TPU-first inference loop for the Transformer family: one prefill call
 scores the whole prompt (MXU-sized matmuls, causal), then a `lax.scan`
 decodes token-by-token against the flax "cache" collection that
-`SelfAttention(decode=True)` maintains (ring buffers updated with
-`dynamic_update_slice` — static shapes, so the whole loop jits and the
-per-step executable is reused). GQA models cache only n_kv_heads, so the
-cache — the resident that limits batch at inference — shrinks by
-n_heads/n_kv_heads.
+`SelfAttention(decode=True)` maintains (full-capacity buffers updated with
+`dynamic_update_slice`; windowed models default to a TRUE rolling ring
+buffer sized min(window, cap), written by modular scatter — either way
+static shapes, so the whole loop jits and the per-step executable is
+reused). GQA models cache only n_kv_heads, so the cache — the resident
+that limits batch at inference — shrinks by n_heads/n_kv_heads.
 
 The reference repo has no inference path at all (it is a transport;
 SURVEY §2.3); this is framework capability above it.
@@ -86,7 +87,8 @@ def _prefill(dm, params, cache, prompt, chunk: int | None):
     masked dense einsum, while still writing the cache. `chunk=None`
     covers the whole prompt that way. A chunk size C additionally scans
     ⌊p/C⌋ C-token blocks (first via the kernel, the rest — which need
-    cache context — via the dense step, O(C · cap) scores) plus one
+    cache context — via the dense step: O(C · cap) scores, or
+    O(C · (window + C)) under a windowed model's ring cache) plus one
     remainder block. Chunking changes only the blocking of the same
     block-causal computation, so outputs are identical (parity-tested)."""
     b, p = prompt.shape
@@ -292,8 +294,14 @@ def speculative_generate(
     # per-row mode a finished row's frozen frontier rewrites one more
     # block-width each extra round.
     cap = p + max_new_tokens + gamma + 1
-    tm = model.clone(decode=True, per_row_cache=per_row)
-    dm = draft_model.clone(decode=True, per_row_cache=per_row)
+    # decode_ring_cache=False: rejection rolls the caches back by simply
+    # rewriting cache_index (entries beyond it are masked) — a rolling ring
+    # buffer would have OVERWRITTEN in-window history with rejected-token
+    # K/V, so windowed models speculate against the full masked cache.
+    tm = model.clone(decode=True, per_row_cache=per_row,
+                     decode_ring_cache=False)
+    dm = draft_model.clone(decode=True, per_row_cache=per_row,
+                           decode_ring_cache=False)
     t_cache = init_cache(tm, b, cap)
     d_cache = init_cache(dm, b, cap)
     if rng is None:

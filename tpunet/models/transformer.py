@@ -182,9 +182,16 @@ class SelfAttention(nn.Module):
     ordinary MHA shapes.
 
     decode=True switches to autoregressive inference: a "cache" collection
-    holds cached_key/cached_value ring buffers sized by the INIT input's
+    holds cached_key/cached_value buffers sized by the INIT input's
     sequence length (init with a max-length dummy), and each apply consumes
     the next s tokens (usually 1), attending over the filled prefix.
+
+    attn_window + decode + decode_ring_cache (the default) makes the cache
+    a TRUE rolling ring buffer (Mistral-style): leaves are sized
+    min(window, capacity), writes land at position mod window, and each
+    decode step contracts over window (+ s) entries instead of the full
+    capacity — sliding-window attention as a *serving* feature (bounded
+    memory, O(window) decode compute), not just a masking pattern.
     """
 
     n_heads: int
@@ -210,6 +217,12 @@ class SelfAttention(nn.Module):
     #   chip) instead of the s x cap masked dense einsum below
     per_row_cache: bool = False  # decode=True: cache_index is (b,) — each
     #   batch slot advances independently (continuous batching)
+    decode_ring_cache: bool = True  # attn_window + decode: the cache is a
+    #   rolling ring buffer — leaves sized min(window, capacity), O(window)
+    #   decode contraction. False keeps the full-capacity masked cache,
+    #   which speculative decoding REQUIRES: its rollback just rewrites
+    #   cache_index, and a ring overwrite destroys the history a rollback
+    #   re-exposes.
     lora_rank: int = 0
     lora_alpha: float | None = None
 
@@ -275,9 +288,15 @@ class SelfAttention(nn.Module):
             # init call (whose input sets the cache capacity = its seq len)
             # which otherwise runs the ordinary causal path below; every
             # later apply with mutable=["cache"] takes the step branch.
+            ring = self.attn_window is not None and self.decode_ring_cache
+            # Ring mode sizes the leaves at min(window, capacity) — the
+            # init call's s IS the capacity (init with a max-length dummy),
+            # so eval_shape-based init_cache allocates O(window) for free.
+            cshape = ((b, min(self.attn_window, s), kv, dh) if ring
+                      else k.shape)
             filled = self.has_variable("cache", "cached_key")
-            ckey = self.variable("cache", "cached_key", jnp.zeros, k.shape, k.dtype)
-            cval = self.variable("cache", "cached_value", jnp.zeros, v.shape, v.dtype)
+            ckey = self.variable("cache", "cached_key", jnp.zeros, cshape, k.dtype)
+            cval = self.variable("cache", "cached_value", jnp.zeros, cshape, v.dtype)
             cidx = self.variable(
                 "cache", "cache_index",
                 lambda: jnp.zeros((b,) if self.per_row_cache else (),
@@ -286,30 +305,58 @@ class SelfAttention(nn.Module):
             if filled:
                 idx = cidx.value
                 cap = ckey.value.shape[1]
-                # Past-capacity steps would clamp the write start and
-                # silently corrupt the tail; idx is traced, so the
-                # jit-compatible hard failure is poisoning the output to NaN
-                # the moment idx + s overflows — loud at the first sample.
-                # Per-row mode: everything here is (b,)-shaped — each batch
-                # slot sits at its own sequence offset (continuous
-                # batching), overflow poisons only its own row, and the
-                # cache write is a per-row scatter instead of one slice.
-                overflow = idx + s > cap
                 step_pos = (idx[..., None] + jnp.arange(s)).astype(jnp.float32)
                 q = rotary_embed(q, positions=step_pos)
                 k = rotary_embed(k, positions=step_pos)
-                if self.per_row_cache:
-                    rows = jnp.arange(b)[:, None]
-                    pos_i = idx[:, None] + jnp.arange(s)  # (b, s)
-                    ckey.value = ckey.value.at[rows, pos_i].set(k)
-                    cval.value = cval.value.at[rows, pos_i].set(v)
+                rows = jnp.arange(b)[:, None]
+                if ring:
+                    # A full-width ring never overflows: writes land at pos
+                    # mod cap and the window mask only addresses the last
+                    # `window` positions, all resident. But when the cache
+                    # was allocated SMALLER than the window (cap < window),
+                    # the ring wraps before the window does — eviction would
+                    # silently corrupt in-window history, so keep the loud
+                    # NaN-poison past capacity. Both sizes are static.
+                    if cap < self.attn_window:
+                        overflow = idx + s > cap
+                    else:
+                        overflow = jnp.zeros(idx.shape, bool)
+                    # Attention reads the PRE-write ring (positions < idx)
+                    # plus the in-step k/v — exact for s > 1 too, where a
+                    # post-write ring would have overwritten entries the
+                    # step's earlier queries still see.
+                    ring_k, ring_v = ckey.value, cval.value
+                    m = min(s, cap)  # static: a step writes its last m
+                    wpos = idx[..., None] + jnp.arange(s - m, s)
+                    slot = jnp.mod(wpos, cap)  # (m,) or (b, m), all distinct
+                    if self.per_row_cache:
+                        ckey.value = ckey.value.at[rows, slot].set(k[:, s - m:])
+                        cval.value = cval.value.at[rows, slot].set(v[:, s - m:])
+                    else:
+                        ckey.value = ckey.value.at[:, slot].set(k[:, s - m:])
+                        cval.value = cval.value.at[:, slot].set(v[:, s - m:])
                 else:
-                    ckey.value = jax.lax.dynamic_update_slice(
-                        ckey.value, k, (0, idx, 0, 0)
-                    )
-                    cval.value = jax.lax.dynamic_update_slice(
-                        cval.value, v, (0, idx, 0, 0)
-                    )
+                    # Past-capacity steps would clamp the write start and
+                    # silently corrupt the tail; idx is traced, so the
+                    # jit-compatible hard failure is poisoning the output to
+                    # NaN the moment idx + s overflows — loud at the first
+                    # sample. Per-row mode: everything here is (b,)-shaped —
+                    # each batch slot sits at its own sequence offset
+                    # (continuous batching), overflow poisons only its own
+                    # row, and the cache write is a per-row scatter instead
+                    # of one slice.
+                    overflow = idx + s > cap
+                    if self.per_row_cache:
+                        pos_i = idx[:, None] + jnp.arange(s)  # (b, s)
+                        ckey.value = ckey.value.at[rows, pos_i].set(k)
+                        cval.value = cval.value.at[rows, pos_i].set(v)
+                    else:
+                        ckey.value = jax.lax.dynamic_update_slice(
+                            ckey.value, k, (0, idx, 0, 0)
+                        )
+                        cval.value = jax.lax.dynamic_update_slice(
+                            cval.value, v, (0, idx, 0, 0)
+                        )
                 cidx.value = idx + s
                 if self.prefill:
                     # First fill of an EMPTY cache: the block attends only
@@ -332,18 +379,40 @@ class SelfAttention(nn.Module):
                     return _dense(x.shape[-1], dt, "out", self.weight_quant,
                                   self.lora_rank, self.lora_alpha)(o)
                 # Grouped einsum: q reshaped to (b, s, kv, group, dh)
-                # contracts DIRECTLY against the (b, cap, kv, dh) cache —
+                # contracts DIRECTLY against the (b, K, kv, dh) cache —
                 # the group-repeated K/V never exists in HBM. This is the
                 # point of GQA at decode time: the cache read per step is
                 # kv/h of the MHA equivalent, and materializing a repeat
                 # would hand that bandwidth win straight back.
+                if ring:
+                    # Contract over [pre-write ring | in-step k/v]:
+                    # K = window + s entries, not the full capacity. Ring
+                    # slot j's global position is the largest p < idx with
+                    # p ≡ j (mod cap); p < 0 means never written (or the
+                    # previous occupant of a recycled serve slot — idx was
+                    # reset, so stale entries are unaddressable by
+                    # construction).
+                    att_k = jnp.concatenate([ring_k, k], axis=1)
+                    att_v = jnp.concatenate([ring_v, v], axis=1)
+                    j = jnp.arange(cap)
+                    p_ring = (idx[..., None] - 1
+                              - jnp.mod(idx[..., None] - 1 - j, cap))
+                    p_step = idx[..., None] + jnp.arange(s)
+                    key_pos = jnp.concatenate(
+                        [jnp.broadcast_to(p_ring, idx.shape + (cap,)),
+                         jnp.broadcast_to(p_step, idx.shape + (s,))],
+                        axis=-1)  # (K,) or (b, K)
+                else:
+                    att_k, att_v = ckey.value, cval.value
+                    key_pos = jnp.arange(cap)
                 qg = q.reshape(b, s, kv, h // kv, dh).astype(jnp.float32)
-                # (b, kv, group, s, cap) scores over the whole ring buffer;
-                # mask to keys at global positions <= each query's position.
+                # (b, kv, group, s, K) scores; mask to keys at valid global
+                # positions <= each query's position (and in-window).
                 scores = jnp.einsum(
-                    "bqhgd,bkhd->bhgqk", qg, ckey.value.astype(jnp.float32)
+                    "bqhgd,bkhd->bhgqk", qg, att_k.astype(jnp.float32)
                 ) / math.sqrt(dh)
-                key_pos = jnp.arange(cap)[None, None, None, None, :]
+                kp = (key_pos[:, None, None, None, :] if key_pos.ndim == 2
+                      else key_pos[None, None, None, None, :])
                 pos = idx[..., None] + jnp.arange(s)  # (s,) or (b, s)
                 if self.per_row_cache:
                     q_pos = pos[:, None, None, :, None]
@@ -351,13 +420,13 @@ class SelfAttention(nn.Module):
                 else:
                     q_pos = pos[None, None, None, :, None]
                     row_overflow = overflow
-                keep = key_pos <= q_pos
+                keep = (kp >= 0) & (kp <= q_pos)
                 if self.attn_window is not None:
-                    keep &= (q_pos - key_pos) < self.attn_window
+                    keep &= (q_pos - kp) < self.attn_window
                 scores = jnp.where(keep, scores, -jnp.inf)
                 probs = jax.nn.softmax(scores, axis=-1)
                 o = jnp.einsum(
-                    "bhgqk,bkhd->bqhgd", probs, cval.value.astype(jnp.float32)
+                    "bhgqk,bkhd->bqhgd", probs, att_v.astype(jnp.float32)
                 ).reshape(b, s, h, dh)
                 o = jnp.where(row_overflow, jnp.nan, o)
                 o = o.astype(dt).reshape(b, s, h * dh)
@@ -566,6 +635,7 @@ class Block(nn.Module):
     weight_quant: str | None = None
     prefill: bool = False
     per_row_cache: bool = False
+    decode_ring_cache: bool = True
     lora_rank: int = 0
     lora_alpha: float | None = None
 
@@ -579,7 +649,9 @@ class Block(nn.Module):
             flash_block_q=self.flash_block_q,
             flash_block_k=self.flash_block_k,
             weight_quant=self.weight_quant, prefill=self.prefill,
-            per_row_cache=self.per_row_cache, lora_rank=self.lora_rank,
+            per_row_cache=self.per_row_cache,
+            decode_ring_cache=self.decode_ring_cache,
+            lora_rank=self.lora_rank,
             lora_alpha=self.lora_alpha, name="attn",
         )(RMSNorm(name="norm1")(x))
         if self.n_experts > 0:
@@ -632,6 +704,10 @@ class Transformer(nn.Module):
     #   prefill clone for the whole-prompt call automatically
     per_row_cache: bool = False    # decode=True: per-slot (b,) cache index —
     #   the continuous-batching substrate (tpunet.models.serve.BatchServer)
+    decode_ring_cache: bool = True  # attn_window + decode: rolling ring-
+    #   buffer KV cache, leaves sized min(window, cap) — bounded memory and
+    #   O(window) decode contraction. speculative_generate turns it off
+    #   (rollback needs the full masked cache).
     lora_rank: int = 0             # > 0: rank-r LoRA adapters on every Dense
     #   (tpunet.models.lora: lora_mask to train only A/B, graft_base to
     #   load a base checkpoint, merge_lora to fold back); composes with
@@ -703,6 +779,7 @@ class Transformer(nn.Module):
                 flash_block_k=self.flash_block_k,
                 weight_quant=self.weight_quant, prefill=self.prefill,
                 per_row_cache=self.per_row_cache,
+                decode_ring_cache=self.decode_ring_cache,
                 lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
                 name=f"block{i}",
             )(x)
