@@ -145,7 +145,8 @@ MEASURED_PATHS = ("tpunet/ops", "tpunet/models", "tpunet/train",
 SESSION_SCRIPT_PATHS = ("benchmarks/kernel_smoke.py",
                         "benchmarks/decode_bench.py",
                         "benchmarks/mfu_attribution.py",
-                        "benchmarks/mfu_sweep.py")
+                        "benchmarks/mfu_sweep.py",
+                        "benchmarks/serve_bench.py")
 
 
 def _dirty_paths(paths: tuple, repo: str | None = None) -> list[str] | None:

@@ -114,6 +114,18 @@ STEPS: list[tuple[str, list[str], int]] = [
                            "--blocks", "128", "256", "512"], 1800),
     ("longctx_s8192", ["-m", "benchmarks.mfu_sweep", "5"], 2400),
     ("remat_dots_ab", ["-m", "benchmarks.mfu_sweep", "0", "7"], 2400),
+    # Continuous batching vs lockstep ON CHIP: the regime the component
+    # exists for — step compute runs on the TPU while the host absorbs and
+    # refills (pipeline=2 keeps a window in flight), so the dispatch
+    # overhead that dominates the single-core CPU toy hides under device
+    # time. GQA kv4 = the serving cache regime.
+    ("serve", ["-m", "benchmarks.serve_bench", "--platform", "tpu",
+               "--d", "2048", "--layers", "12", "--heads", "16",
+               "--ff", "8192", "--vocab", "32000", "--kv-heads", "4",
+               "--slots", "4", "--requests", "12", "--prompt", "256",
+               "--new-min", "32", "--new-max", "128",
+               "--steps-per-call", "16", "--pipeline", "2",
+               "--reps", "3"], 2400),
 ]
 
 
@@ -254,7 +266,7 @@ def _write_measured(raw: dict, dirty: list[str] | None = None) -> None:
                               ("segments", "full_step_ms", "mfu",
                                "expected_full_ms", "residual_ms")}
     for key in ("block_sweep_s2048", "block_sweep_s8192", "longctx_s8192",
-                "remat_dots_ab"):
+                "remat_dots_ab", "serve"):
         if isinstance(raw.get(key), dict) and "error" not in raw[key]:
             out[key] = raw[key]
     out["note"] = ("Captured by benchmarks.chip_session while the tunnel "

@@ -140,12 +140,14 @@ def test_run_returns_requests_finished_at_prefill():
 
 
 def test_serve_bench_cli(capsys):
+    # --reps 1: the median/IQR code path is identical at any reps;
+    # 7 interleaved passes would add CI time with no assertion power.
     from benchmarks.serve_bench import main as bench_main
 
     bench_main(["--requests", "4", "--slots", "2", "--prompt", "8",
                 "--new-min", "2", "--new-max", "6", "--steps-per-call", "4",
                 "--d", "32", "--layers", "1", "--heads", "2", "--ff", "64",
-                "--vocab", "64"])
+                "--vocab", "64", "--reps", "1"])
     import json
 
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
@@ -218,3 +220,48 @@ def test_text_in_text_out_end_to_end(tmp_path):
     rid = srv.submit(tok.encode("abcdefghabc"), 8)
     out = srv.run()[rid]
     assert tok.decode(out) == "defghabc"  # exact byte continuation
+
+
+def test_run_pipeline_and_coalesce_match_default():
+    # pipeline>=2 (in-flight windows + dispatch-time occupancy snapshots +
+    # deferred prefill tokens) and refill_coalesce>1 (held refills) must
+    # not change greedy outputs — each request's tokens depend only on its
+    # own prefix. This is the parity the chip serve step (pipeline=2)
+    # leans on.
+    model, params = _setup()
+    prompts = [np.arange(1, 7 + i) % 50 for i in range(5)]
+    news = [3, 9, 5, 12, 1]
+
+    def serve(pipeline, coalesce):
+        srv = BatchServer(model, params, slots=2, max_len=24,
+                          temperature=0.0, steps_per_call=4,
+                          refill_coalesce=coalesce)
+        ids = [srv.submit(p, n) for p, n in zip(prompts, news)]
+        res = srv.run(pipeline=pipeline)
+        return [res[i].tolist() for i in ids]
+
+    base = serve(1, 1)
+    assert serve(2, 1) == base
+    assert serve(3, 1) == base
+    assert serve(1, 2) == base
+    assert serve(2, 2) == base
+
+
+def test_run_pipeline_with_eos_matches_default():
+    model, params = _setup()
+    eos = 7
+    prompts = [np.arange(2, 8), np.arange(3, 9), np.arange(1, 7)]
+
+    def serve(pipeline):
+        srv = BatchServer(model, params, slots=2, max_len=30,
+                          temperature=0.0, steps_per_call=4, eos_id=eos,
+                          refill_coalesce=pipeline)  # exercise both knobs
+        ids = [srv.submit(p, 12) for p in prompts]
+        res = srv.run(pipeline=pipeline)
+        return [res[i].tolist() for i in ids]
+
+    base = serve(1)
+    out2 = serve(2)
+    assert out2 == base
+    for toks in base:
+        assert eos not in toks[:-1]  # nothing after a (possible) eos

@@ -479,6 +479,19 @@ def speculative_generate(
     }
 
 
+def _map_cache_index(cache, fn):
+    """Apply `fn` to every cache_index leaf, other leaves untouched — the
+    one place that knows how flax names the decode-cache index, shared by
+    the rollback (_set_cache_index) and the serve-side idle clamp so the
+    leaf-matching can't drift apart."""
+
+    def fix(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        return fn(leaf) if name == "cache_index" else leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
 def _set_cache_index(cache, idx):
     """Rewrite every layer's cache_index leaf to `idx` — the rollback
     primitive speculative decoding relies on: the decode step masks keys

@@ -26,6 +26,7 @@ SURVEY §2.3); this is framework capability above it.
 
 from __future__ import annotations
 
+from collections import deque
 from functools import partial
 from itertools import count
 
@@ -33,31 +34,50 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpunet.models.generate import (_prefill, _set_cache_index,
-                                    _validate_sampling, init_cache,
-                                    make_sampler)
+from tpunet.models.generate import (_map_cache_index, _prefill,
+                                    _set_cache_index, _validate_sampling,
+                                    init_cache, make_sampler)
+
+
+def _clamp_cache_index(cache, cap):
+    """Clamp every cache_index leaf to cap. Idle (freed, not-yet-refilled)
+    slots keep decoding garbage every window and their per-row index would
+    otherwise grow without bound — int32-wrapping after ~2^31 idle steps
+    and leaning on scatter out-of-bounds drop semantics for an unbounded
+    range of positions. Clamped, an idle row's index parks at cap: its
+    (single, constant) write position cap is one-past-end (dropped), the
+    overflow NaN-poison still marks the row's output as garbage, and a
+    refill resets the index anyway. Live rows are unaffected — submit()
+    bounds prompt + max_new <= max_len, so a live row's index never
+    exceeds cap."""
+    return _map_cache_index(cache, lambda leaf: jnp.minimum(leaf, cap))
 
 
 class BatchServer:
     """Continuous-batching decode server.
 
-    submit() enqueues a request (assigned to a slot immediately when one
-    is free); step() advances every live slot one token and returns the
-    requests that finished. Greedy by default; temperature/top-k/top-p
-    sample per-row with a fresh fold of `rng` each step.
+    submit() enqueues a request; slots are assigned at the next
+    step()/run() boundary, so a burst of submissions prefills as one
+    batched dispatch. step() advances every live slot one token and
+    returns the requests that finished. Greedy by default;
+    temperature/top-k/top-p sample per-row from the device-carried key
+    chain.
     """
 
     def __init__(self, model, params, *, slots: int, max_len: int,
                  temperature: float = 0.0, top_k: int | None = None,
                  top_p: float | None = None, eos_id: int | None = None,
                  rng=None, prefill_chunk: int | None = None,
-                 steps_per_call: int = 1):
+                 steps_per_call: int = 1, refill_coalesce: int = 1):
         _validate_sampling(temperature, top_k, top_p)
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if steps_per_call < 1:
             raise ValueError(
                 f"steps_per_call must be >= 1, got {steps_per_call}")
+        if refill_coalesce < 1:
+            raise ValueError(
+                f"refill_coalesce must be >= 1, got {refill_coalesce}")
         if getattr(model, "n_experts", 0):
             # MoE capacity is computed batch-wide (t = b*s slots claimed by
             # a cross-row cumsum), so other rows' tokens - including idle
@@ -70,9 +90,19 @@ class BatchServer:
         self.model = model
         self.params = params
         self.slots, self.max_len = slots, max_len
+        # Refill batching: a freed slot is NOT refilled until at least
+        # this many slots are free (or nothing is decoding, or the queue
+        # would drain anyway). Singleton (1, p) prefills waste matmul
+        # width (measured at d256: 12 singles ~100 ms vs 4 batched (4, p)
+        # ~53 ms), BUT holding a slot costs idle decode windows until a
+        # partner frees, and when retirements are spread in time that
+        # idleness exceeds the batching gain (measured: coalesce=2 LOST
+        # 3-6% end-to-end on both toy and d256 configs). Default 1 =
+        # refill immediately; raise it only when retirements cluster
+        # (uniform max_new, bursty arrivals).
+        self.refill_coalesce = min(refill_coalesce, slots)
         self.eos_id = eos_id
         self._sampling = (temperature, top_k, top_p)
-        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._prefill_chunk = prefill_chunk
         self._dm = model.clone(decode=True, per_row_cache=True)
         self._cache = init_cache(self._dm, slots, max_len)
@@ -80,7 +110,13 @@ class BatchServer:
         self._live: dict[int, dict] = {}       # slot -> request record
         self._pending: list[dict] = []
         self._ids = count()
-        self._last_tok = np.zeros(slots, np.int32)
+        # Device-resident loop state: the per-slot last tokens and the rng
+        # key live ON DEVICE and are donated through every jitted call —
+        # the host never re-uploads them and never dispatches a bare
+        # jax.random.split between steps. The only host<->device traffic
+        # on the decode path is the one necessary window readback.
+        self._toks = jnp.zeros(slots, jnp.int32)
+        self._key = rng if rng is not None else jax.random.PRNGKey(0)
         self._done_buffer: list[dict] = []  # finished before step() drained
         self.stats = {"decode_windows": 0, "prefills": 0}
 
@@ -99,46 +135,58 @@ class BatchServer:
         # refills land at window boundaries, and a row that finishes
         # mid-window decodes garbage for the remainder (discarded; its
         # refill resets the row).
-        @partial(jax.jit, donate_argnums=(1,))
-        def decode_step(params, cache, toks, key):
-            def body(carry, key):
+        max_len_cap = max_len
+
+        # Both jits CLOSE OVER params: the server's weights are fixed at
+        # construction, and passing the 10s-of-leaves param tree through
+        # every call costs a flatten + cache lookup per dispatch — real
+        # money when the step itself is ~1 ms.
+        params_c = params
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def decode_step(cache, toks, key):
+            key, sub = jax.random.split(key)
+
+            def body(carry, k):
                 cache, tok = carry
                 logits, mut = self._dm.apply(
-                    {"params": params, "cache": cache}, tok[:, None],
+                    {"params": params_c, "cache": cache}, tok[:, None],
                     mutable=["cache"])
-                nxt = sample(logits[:, -1, :], key)
+                nxt = sample(logits[:, -1, :], k)
                 return (mut["cache"], nxt), nxt
 
-            (cache, _), toks_out = jax.lax.scan(
-                body, (cache, toks), jax.random.split(key, steps_per_call))
-            return cache, toks_out.swapaxes(0, 1)  # (slots, window)
+            (cache, toks), toks_out = jax.lax.scan(
+                body, (cache, toks), jax.random.split(sub, steps_per_call))
+            cache = _clamp_cache_index(cache, max_len_cap)
+            # (slots, window) readback + the carried device state.
+            return cache, toks, toks_out.swapaxes(0, 1), key
 
-        @partial(jax.jit, donate_argnums=(1,), static_argnames=("chunk",))
-        def prefill_slot(params, cache, prompt, r, key, chunk):
-            # Row surgery: slice slot r out of every cache leaf, reset its
-            # index (the row may hold a dead sequence's frontier), prefill
-            # through the shared kernel-routed path, write the row back.
-            row = jax.tree.map(
-                lambda a: jax.lax.dynamic_slice_in_dim(a, r, 1, 0),
-                cache)
+        @partial(jax.jit, donate_argnums=(0, 1), static_argnames=("chunk",))
+        def prefill_slots(cache, toks, prompts, rows, key, chunk):
+            # Row surgery, n rows at once: gather the claimed slots out of
+            # every cache leaf, reset their indexes (the rows may hold
+            # dead sequences' frontiers), prefill the (n, p) prompts
+            # through the shared kernel-routed path, scatter the rows
+            # back. One dispatch per same-length refill group.
+            key, sub = jax.random.split(key)
+            row = jax.tree.map(lambda a: a[rows], cache)
             row = _set_cache_index(row, 0)
-            row, last = _prefill(self._dm, params, row, prompt, chunk)
+            row, last = _prefill(self._dm, params_c, row, prompts, chunk)
             cache = jax.tree.map(
-                lambda a, rw: jax.lax.dynamic_update_slice_in_dim(
-                    a, rw, r, 0),
-                cache, row)
-            return cache, sample(last, key)
+                lambda a, rw: a.at[rows].set(rw), cache, row)
+            tok = sample(last, sub)  # (n,)
+            toks = toks.at[rows].set(tok)
+            return cache, toks, tok, key
 
         self._decode_step = decode_step
-        self._prefill_slot = prefill_slot
-
-    def _next_key(self):
-        self._rng, key = jax.random.split(self._rng)
-        return key
+        self._prefill_slots = prefill_slots
 
     def submit(self, prompt, max_new_tokens: int) -> int:
-        """Enqueue one request; returns its id. Assigned to a slot now if
-        one is free, otherwise when step() frees one."""
+        """Enqueue one request; returns its id. Slot assignment happens at
+        the next step()/run() boundary — deferring it there lets a burst
+        of submissions prefill as ONE batched (n, p) dispatch instead of n
+        singletons (submit-time assignment made the documented startup
+        batching unreachable)."""
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1 or prompt.size < 1:
             raise ValueError(f"prompt must be 1-D non-empty, got "
@@ -149,72 +197,164 @@ class BatchServer:
             raise ValueError(
                 f"prompt ({prompt.size}) + max_new ({max_new_tokens}) "
                 f"exceeds max_len {self.max_len}")
+        # Upload at submit time (async): the refill dispatch later reads
+        # a device array instead of paying a device_put on the refill
+        # path — the host-side equivalent of pinning the request queue.
         req = {"id": next(self._ids), "prompt": prompt,
-               "max_new": max_new_tokens, "out": []}
+               "prompt_dev": jnp.asarray(prompt[None]),
+               "max_new": max_new_tokens, "chunks": [], "n_out": 0}
         self._pending.append(req)
-        self._fill_slots()
         return req["id"]
 
-    def _fill_slots(self) -> None:
+    def _fill_slots(self, defer: bool = False) -> None:
+        if not (self._free and self._pending):
+            return
+        if (len(self._free) < self.refill_coalesce and self._live
+                and len(self._pending) > len(self._free)):
+            return  # hold out for a batched refill (see refill_coalesce)
+        # Claim every (request, slot) pair now, then prefill all claims of
+        # the SAME prompt length in ONE batched dispatch (n-row gather ->
+        # reset -> (n, p) prefill -> n-row scatter). Startup fills all
+        # slots in one call instead of `slots`; steady-state refills are
+        # usually singletons. Retraces are bounded by distinct (n, p)
+        # pairs — bucket prompt lengths as with any static-shape stack.
+        claims = []
         while self._free and self._pending:
-            req = self._pending.pop(0)
-            r = self._free.pop()
-            self._cache, tok = self._prefill_slot(
-                self.params, self._cache, jnp.asarray(req["prompt"][None]),
-                jnp.int32(r), self._next_key(), self._prefill_chunk)
-            self.stats["prefills"] += 1
-            first = int(tok[0])
-            req["out"].append(first)
-            self._last_tok[r] = first
-            self._live[r] = req
-            self._retire_if_done(r)
+            claims.append((self._pending.pop(0), self._free.pop()))
+        by_len: dict[int, list] = {}
+        for req, r in claims:
+            by_len.setdefault(req["prompt"].size, []).append((req, r))
+        for group in by_len.values():
+            reqs = [q for q, _ in group]
+            rows = jnp.asarray(np.array([r for _, r in group], np.int32))
+            prompts = (reqs[0]["prompt_dev"] if len(reqs) == 1
+                       else jnp.concatenate(
+                           [q["prompt_dev"] for q in reqs], axis=0))
+            self._cache, self._toks, tok, self._key = self._prefill_slots(
+                self._cache, self._toks, prompts, rows,
+                self._key, self._prefill_chunk)
+            self.stats["prefills"] += len(group)
+            if defer:
+                # Pipelined mode: don't sync on the prefill's sampled
+                # tokens (that would drain every in-flight window behind
+                # them). Hold the device vector; the next absorb resolves
+                # it BEFORE appending that window's tokens, so outputs and
+                # retirement decisions are unchanged — only their
+                # host-side timing shifts to the next window boundary.
+                holder = {"dev": tok, "np": None}  # one readback, shared
+                for i, (req, r) in enumerate(group):
+                    self._live[r] = req
+                    req["_pending"] = (holder, i)
+            else:
+                arr = np.asarray(tok)
+                for i, (req, r) in enumerate(group):
+                    self._live[r] = req
+                    self._append_tokens(r, req, arr[i: i + 1])
 
-    def _retire_if_done(self, r: int) -> None:
-        # A request can finish at ANY commit point — including its very
-        # first token, sampled during prefill — so retirement lands in a
-        # buffer that step() drains, not in step()'s local list.
-        req = self._live[r]
-        if (len(req["out"]) >= req["max_new"]
-                or (self.eos_id is not None
-                    and req["out"][-1] == self.eos_id)):
+    def _append_tokens(self, r: int, req: dict, toks_np) -> None:
+        """Commit a window's tokens to a request — vectorized: cut at
+        max_new, then at the first eos, in one numpy pass instead of a
+        Python loop per token. Retires the request (freeing its slot into
+        the done buffer) when either bound is hit; a request can finish at
+        ANY commit point, including its first prefill-sampled token."""
+        take = min(req["max_new"] - req["n_out"], len(toks_np))
+        chunk = toks_np[:take]
+        if self.eos_id is not None:
+            hits = np.nonzero(chunk == self.eos_id)[0]
+            if hits.size:
+                chunk = chunk[: hits[0] + 1]  # keep the eos itself
+        req["chunks"].append(chunk)
+        req["n_out"] += len(chunk)
+        if (req["n_out"] >= req["max_new"]
+                or (self.eos_id is not None and chunk.size
+                    and chunk[-1] == self.eos_id)):
             del self._live[r]
             self._free.append(r)
             self._done_buffer.append(
                 {"id": req["id"], "prompt": req["prompt"],
-                 "tokens": np.asarray(req["out"], np.int32)})
+                 "tokens": np.concatenate(req["chunks"]).astype(np.int32)})
+
+    def _dispatch_window(self):
+        """Issue one decode window WITHOUT reading it back; returns the
+        device window plus a {slot: request_id} snapshot of occupancy at
+        dispatch time (a later refill recycles the slot for a different
+        request — that window's tokens for the slot are garbage)."""
+        self._cache, self._toks, window, self._key = self._decode_step(
+            self._cache, self._toks, self._key)
+        self.stats["decode_windows"] += 1
+        return window, {r: req["id"] for r, req in self._live.items()}
+
+    def _absorb_window(self, window, ids_at_dispatch) -> None:
+        window = np.asarray(window)  # (slots, steps_per_call) readback
+        for r, rid in ids_at_dispatch.items():
+            req = self._live.get(r)
+            if req is None or req["id"] != rid:
+                continue  # retired or recycled since this window launched
+            if "_pending" in req:
+                # Deferred prefill token: by now its compute long finished
+                # (it was dispatched before this window). The group's
+                # token vector is read back once and shared.
+                holder, i = req.pop("_pending")
+                if holder["np"] is None:
+                    holder["np"] = np.asarray(holder["dev"])
+                self._append_tokens(r, req, holder["np"][i: i + 1])
+                if r not in self._live:
+                    continue
+            self._append_tokens(r, req, window[r])
 
     def step(self) -> list[dict]:
         """Advance every live slot one token; returns the requests that
         finished this step as {"id", "prompt", "tokens"} dicts (freed
         slots are immediately refilled from the queue)."""
-        if not self._live and self._pending:
-            self._fill_slots()
+        self._fill_slots()
         if self._live:
-            toks = jnp.asarray(self._last_tok)  # idle rows decode garbage
-            self._cache, window = self._decode_step(
-                self.params, self._cache, toks, self._next_key())
-            self.stats["decode_windows"] += 1
-            window = np.asarray(window)  # (slots, steps_per_call)
-            for r in list(self._live):
-                req = self._live[r]
-                for tok in window[r]:
-                    req["out"].append(int(tok))
-                    self._last_tok[r] = int(tok)
-                    self._retire_if_done(r)
-                    if r not in self._live:
-                        break  # rest of this row's window is garbage
+            window, ids = self._dispatch_window()
+            self._absorb_window(window, ids)
             self._fill_slots()
         finished, self._done_buffer = self._done_buffer, []
         return finished
 
-    def run(self) -> dict[int, np.ndarray]:
-        """Drive step() until every submitted request finishes; returns
-        {request_id: generated tokens}."""
+    def run(self, *, pipeline: int = 1) -> dict[int, np.ndarray]:
+        """Drive the server until every submitted request finishes;
+        returns {request_id: generated tokens}.
+
+        `pipeline` keeps that many decode windows in flight: window k+1 is
+        dispatched BEFORE window k's readback, so host bookkeeping (token
+        appends, retirement, refill decisions) overlaps device compute
+        instead of serializing with it. A window launched before a refill
+        simply decodes garbage in the recycled slot (discarded via the
+        dispatch-time occupancy snapshot) and the refilled request joins
+        one window later — greedy outputs are unchanged (each request's
+        tokens depend only on its own prefix); with temperature > 0 the
+        carried key chain advances differently across pipeline settings,
+        so sampled outputs are schedule-dependent (still exactly
+        distributed). pipeline=1 (the default) is the strict
+        alternate-dispatch-absorb loop — right for single-core hosts and
+        CPU testing, where host and compute serialize anyway and extra
+        in-flight windows just waste micro-steps. pipeline=2 is the TPU
+        serving setting: compute runs on the chip, so the host's
+        absorb/refill work for window k hides entirely under window k+1's
+        device time."""
+        if pipeline < 1:
+            raise ValueError(f"pipeline must be >= 1, got {pipeline}")
         results = {}
-        # _done_buffer may already hold requests that retired during
-        # submit()'s prefill (max_new=1, or an eos first token) - step()
-        # drains it even when nothing is live.
-        while self._live or self._pending or self._done_buffer:
-            for rec in self.step():
+        inflight = deque()
+        # defer only when windows are actually kept in flight: at
+        # pipeline=1 nothing is behind the prefill to stall, and the
+        # immediate readback lets a request that finishes on its
+        # prefill-sampled token (max_new=1, eos first) retire with ZERO
+        # decode windows; deferred it would cost a whole discarded window.
+        defer = pipeline >= 2
+        while (self._live or self._pending or self._done_buffer
+               or inflight):
+            finished, self._done_buffer = self._done_buffer, []
+            for rec in finished:
                 results[rec["id"]] = rec["tokens"]
+            self._fill_slots(defer=defer)  # no-op without free+pending
+            while self._live and len(inflight) < pipeline:
+                inflight.append(self._dispatch_window())
+            if inflight:
+                window, ids = inflight.popleft()
+                self._absorb_window(window, ids)
+                self._fill_slots(defer=defer)
         return results
