@@ -273,3 +273,72 @@ def test_qlora_trains_through_fit():
         np.testing.assert_array_equal(np.asarray(node["base"]["q"]), base_q)
         assert node["base"]["q"].dtype == jnp.int8
         assert not (np.asarray(node["lora_b"]) == 0).all()
+
+
+def _qlora_cross_host_worker(rank: int, world: int, port: int, q) -> None:
+    # QLoRA + cross_host (ADVICE r4 #2): gradients contain float0 leaves
+    # (frozen int8 base under allow_int) which the DCN tier must pass
+    # through — both the single-vector ravel path and the bucketed path
+    # used to crash at trace time on ravel/concatenate of float0.
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from tpunet import distributed
+        from tpunet.models import (Transformer, graft_base, lora_optimizer,
+                                   quantize_params)
+        from tpunet.train import TrainState, make_train_step
+
+        distributed.initialize(f"127.0.0.1:{port}", rank, world)
+        base_model = Transformer(vocab=32, d_model=16, n_layers=1, n_heads=2,
+                                 d_ff=32, compute_dtype=jnp.float32)
+        toks = jax.random.randint(jax.random.PRNGKey(10 + rank), (2, 8), 0, 32)
+        labels = jnp.roll(toks, -1, axis=1)
+        base_params = base_model.init(jax.random.PRNGKey(0), toks)["params"]
+        qmodel = base_model.clone(weight_quant="int8", lora_rank=4)
+        qinit = qmodel.init(jax.random.PRNGKey(2), toks)["params"]
+        params = graft_base(qinit, quantize_params(base_params))
+        frozen_q = np.asarray(params["block0"]["attn"]["q"]["base"]["q"])
+        tx = lora_optimizer(optax.adam(5e-3), params)
+        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                           opt_state=tx.init(params))
+        for bucket_bytes in (None, 1 << 10):
+            step = make_train_step(qmodel, tx, cross_host=True, donate=False,
+                                   bucket_bytes=bucket_bytes)
+            s = state
+            losses = []
+            for i in range(3):
+                s, loss = step(s, toks, labels, jax.random.PRNGKey(i))
+                losses.append(float(loss))
+            assert all(np.isfinite(l) for l in losses), (bucket_bytes, losses)
+            assert losses[-1] < losses[0], (bucket_bytes, losses)
+            # Frozen int8 base must be bit-identical after training.
+            np.testing.assert_array_equal(
+                np.asarray(s.params["block0"]["attn"]["q"]["base"]["q"]),
+                frozen_q)
+            # Adapters must be identical across ranks (coupled by the
+            # reduced gradient).
+            from jax.flatten_util import ravel_pytree
+
+            from tpunet.interop import dcn_all_gather
+
+            flat = ravel_pytree(
+                [s.params["block0"]["attn"]["q"]["lora_a"],
+                 s.params["block0"]["attn"]["q"]["lora_b"]])[0]
+            gathered = np.asarray(jax.jit(dcn_all_gather)(flat))
+            for r in range(1, world):
+                np.testing.assert_array_equal(gathered[0], gathered[r])
+        distributed.finalize()
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def test_qlora_cross_host_training_2proc():
+    from conftest import run_spawn_workers
+
+    run_spawn_workers(_qlora_cross_host_worker, 2)

@@ -339,8 +339,11 @@ def speculative_generate(
             q = jax.nn.one_hot(nxt, row.shape[-1], dtype=jnp.float32)
         else:
             q = probs_of(row)
+            # where(q > 0, log q, -inf), not log(max(q, eps)): a top-k/p
+            # filtered-out token must have EXACTLY zero draw probability,
+            # or the scheme's support can leak outside generate()'s.
             nxt = jax.random.categorical(
-                key, jnp.log(jnp.maximum(q, 1e-30)), axis=-1
+                key, jnp.where(q > 0, jnp.log(q), -jnp.inf), axis=-1
             ).astype(jnp.int32)
         return (mut["cache"], nxt), (nxt, q)
 
@@ -419,7 +422,9 @@ def speculative_generate(
             res = _residual_probs(p_n, q_n)
             bonus_or_res = jnp.where((n_eff >= gamma)[:, None], p_n, res)
             fix_tok = jax.random.categorical(
-                k_fix, jnp.log(jnp.maximum(bonus_or_res, 1e-30)), axis=-1
+                k_fix,
+                jnp.where(bonus_or_res > 0, jnp.log(bonus_or_res),
+                          -jnp.inf), axis=-1
             ).astype(jnp.int32)
         keep_own = (n_rows > n_eff) & (n_eff < gamma)
         e_tok = jnp.where(keep_own,
@@ -498,12 +503,7 @@ def _set_cache_index(cache, idx):
     at positions > its running index and block-writes from it, so moving
     the index IS the rollback. `idx` may be a scalar (broadcast to every
     leaf shape) or a (b,) vector for per-row caches."""
-
-    def fix(path, leaf):
-        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-        if name == "cache_index":
-            return jnp.broadcast_to(
-                jnp.asarray(idx, leaf.dtype), leaf.shape)
-        return leaf
-
-    return jax.tree_util.tree_map_with_path(fix, cache)
+    return _map_cache_index(
+        cache,
+        lambda leaf: jnp.broadcast_to(jnp.asarray(idx, leaf.dtype),
+                                      leaf.shape))

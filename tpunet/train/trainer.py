@@ -63,8 +63,13 @@ def _bucketed_dcn_pmean(grads, bucket_bytes: int, compression: str | None, world
 
     leaves_with_path = jax.tree_util.tree_leaves_with_path(grads)
     treedef = jax.tree_util.tree_structure(grads)
+    # float0 leaves (frozen integer params under allow_int — QLoRA's int8
+    # base) carry no gradient to reduce and cannot be concatenated; they
+    # pass straight through to the reconstruction below.
+    reducible = [i for i, (_, leaf) in enumerate(leaves_with_path)
+                 if leaf.dtype != jax.dtypes.float0]
     order = sorted(
-        range(len(leaves_with_path)),
+        reducible,
         key=lambda i: _backward_order_key(jax.tree_util.keystr(leaves_with_path[i][0])),
     )
 
@@ -97,7 +102,9 @@ def _bucketed_dcn_pmean(grads, bucket_bytes: int, compression: str | None, world
         tickets.append(dcn_all_reduce_start(flat))
         flats.append(flat)
 
-    new_leaves: list[Any] = [None] * len(leaves_with_path)
+    new_leaves: list[Any] = [leaf if leaf.dtype == jax.dtypes.float0
+                             else None
+                             for _, leaf in leaves_with_path]
     for b, flat, ticket in zip(buckets, flats, tickets):
         reduced = dcn_all_reduce_finish(ticket, flat)
         off = 0
@@ -313,12 +320,22 @@ def make_train_step(model, tx, cross_host: bool = False, donate: bool = True,
             if bucket_bytes is not None:
                 grads = _bucketed_dcn_pmean(grads, bucket_bytes, grad_compression, world)
             else:
-                flat, unravel = ravel_pytree(grads)
+                # ravel_pytree cannot flatten float0 leaves (QLoRA's frozen
+                # int8 base under allow_int): partition them out, reduce
+                # the inexact vector, reinsert the placeholders.
+                leaves, treedef = jax.tree_util.tree_flatten(grads)
+                f0 = [leaf.dtype == jax.dtypes.float0 for leaf in leaves]
+                flat, unravel = ravel_pytree(
+                    [leaf for leaf, skip in zip(leaves, f0) if not skip])
                 if grad_compression == "bf16":
                     reduced = dcn_pmean(flat.astype(jnp.bfloat16)).astype(flat.dtype)
                 else:
                     reduced = dcn_pmean(flat)
-                grads = unravel(reduced)
+                it = iter(unravel(reduced))
+                grads = jax.tree_util.tree_unflatten(
+                    treedef,
+                    [leaf if skip else next(it)
+                     for leaf, skip in zip(leaves, f0)])
 
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = _apply_updates(state.params, updates)
