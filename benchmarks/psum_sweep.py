@@ -2,9 +2,11 @@
 
 BASELINE config 2 ("JAX pmap(lax.psum)-style AllReduce sweep 8 B - 128 MB
 over the new DCN transport"): measures the full path a training step pays —
-jitted program -> io_callback host staging -> ring collectives -> multi-
-stream engine — vs `benchmarks.busbw_sweep --op allreduce`, which measures
-the native collectives alone; the difference is the JAX-integration tax.
+jitted program -> XLA FFI custom call (zero-copy; round 5) -> ring
+collectives -> multi-stream engine — vs `benchmarks.busbw_sweep --op
+allreduce`, which measures the native collectives alone; the difference is
+the JAX-integration tax. --no-ffi forces the legacy io_callback bridge
+(the round-4 path: ~3 full-buffer staging copies per call) for A/B.
 
     python -m benchmarks.psum_sweep -n 2 --nstreams 4 -b 1K -e 64M
 """
@@ -26,6 +28,8 @@ def _worker(rank, world, port, q, args):
 
         reassert_jax_platform("cpu")  # loopback ranks cannot share one TPU
         os.environ["TPUNET_NSTREAMS"] = str(args.nstreams)
+        if args.no_ffi:
+            os.environ["TPUNET_FFI_COLLECTIVES"] = "0"
         import jax
         import jax.numpy as jnp
 
@@ -71,6 +75,9 @@ def main(argv=None):
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--warmup", type=int, default=1)
     ap.add_argument("--json", default="", help="also dump rows to this file")
+    ap.add_argument("--no-ffi", action="store_true",
+                    help="force the io_callback bridge instead of the "
+                         "zero-copy XLA FFI custom call (A/B baseline)")
     args = ap.parse_args(argv)
 
     from benchmarks import check_rank_results

@@ -93,6 +93,12 @@ const char* tpunet_c_last_error(void);
  * must call the same collectives in the same order. */
 int32_t tpunet_comm_create(const char* coordinator, int32_t rank, int32_t world_size,
                            uintptr_t* comm);
+/* Process-default communicator for callers that cannot thread a handle —
+ * the XLA FFI custom-call collectives look it up at CALL time so elastic
+ * recovery can re-point it under already-compiled executables. set(0)
+ * clears. get returns 0 when unset. */
+int32_t tpunet_comm_set_default(uintptr_t comm);
+uintptr_t tpunet_comm_get_default(void);
 int32_t tpunet_comm_destroy(uintptr_t* comm);
 int32_t tpunet_comm_rank(uintptr_t comm, int32_t* rank, int32_t* world_size);
 /* sendbuf may equal recvbuf (in-place). count = elements. */

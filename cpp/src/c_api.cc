@@ -247,6 +247,11 @@ std::shared_ptr<tpunet::Communicator> GetComm(uintptr_t id) {
 bool ValidDType(int32_t d) { return d >= 0 && d <= 5; }
 bool ValidOp(int32_t o) { return o >= 0 && o <= 3; }
 
+// Process-default communicator id (0 = unset). The FFI custom-call
+// collectives read it at call time so elastic recovery can swap the
+// communicator under already-compiled executables.
+std::atomic<uintptr_t> g_default_comm{0};
+
 }  // namespace
 
 extern "C" {
@@ -267,9 +272,25 @@ int32_t tpunet_comm_destroy(uintptr_t* comm) {
   if (!comm) return Fail(TPUNET_ERR_NULL, "comm is null");
   std::shared_ptr<tpunet::Communicator> c;
   if (!g_comms.Take(*comm, &c)) return Fail(TPUNET_ERR_INVALID, "unknown comm");
+  // A destroyed comm must not remain the process default — a racing FFI
+  // call would fetch a dead id (GetComm then fails loudly, but clear it
+  // so the precondition error is the one callers see).
+  uintptr_t expect = *comm;
+  g_default_comm.compare_exchange_strong(expect, 0);
   *comm = 0;
   return TPUNET_OK;
 }
+
+int32_t tpunet_comm_set_default(uintptr_t comm) {
+  if (comm != 0) {
+    std::shared_ptr<tpunet::Communicator> c;
+    if (!g_comms.Get(comm, &c)) return Fail(TPUNET_ERR_INVALID, "unknown comm");
+  }
+  g_default_comm.store(comm);
+  return TPUNET_OK;
+}
+
+uintptr_t tpunet_comm_get_default(void) { return g_default_comm.load(); }
 
 int32_t tpunet_comm_rank(uintptr_t comm, int32_t* rank, int32_t* world_size) {
   auto c = GetComm(comm);

@@ -1,0 +1,73 @@
+// XLA FFI custom-call collectives: the DCN allreduce as a zero-copy CPU
+// custom call.
+//
+// The io_callback bridge (tpunet/interop.py) costs ~3 full-buffer memcpys
+// per call on top of the native reduce (measured round 5: identity
+// io_callback 0.48 s for 128 MiB where the reduce itself is 0.24 s) —
+// XLA stages the callback operand, the host result, and the copy back
+// into a device buffer. An XLA FFI handler instead receives the XLA CPU
+// buffers DIRECTLY: the ring reads the operand buffer and writes the
+// result buffer in place, zero host staging. The handler is header-only
+// (xla/ffi/api/ffi.h resolves everything through the call frame's API
+// table at runtime), so libtpunet.so gains no link dependency on XLA;
+// builds without jaxlib headers simply omit this object (Makefile guard).
+//
+// The communicator is looked up through the process-default registry
+// (tpunet_comm_set_default) at CALL time, not baked into the executable:
+// elastic recovery replaces the communicator under the same jitted step
+// (tpunet/distributed.initialize re-points the default), and stale ids
+// in cached executables would otherwise dereference a destroyed comm.
+//
+// Reference analogue: none — the reference's torch tier binds NCCL
+// through torch.distributed; this is the jax-native equivalent tier.
+
+#include <cstdint>
+#include <string>
+
+#include "tpunet/c_api.h"
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+namespace {
+
+ffi::Error ToError(int32_t rc, const char* what) {
+  if (rc == 0) return ffi::Error::Success();
+  // Mirror the ctypes binding's NativeError text ("tpunet native <op>
+  // failed (code N): <detail>"): elastic recovery classifies comm
+  // failures by that marker in the stringified XlaRuntimeError
+  // (tpunet/train/elastic.py is_comm_failure), and the FFI path must
+  // stay classifiable the way the io_callback path was.
+  const char* detail = tpunet_c_last_error();
+  return ffi::Error(ffi::ErrorCode::kInternal,
+                    std::string("tpunet native ") + what + " failed (code " +
+                        std::to_string(rc) + "): " +
+                        (detail ? detail : ""));
+}
+
+ffi::Error AllReduceImpl(int64_t dtype, int64_t op, ffi::AnyBuffer x,
+                         ffi::Result<ffi::AnyBuffer> out) {
+  uintptr_t comm = tpunet_comm_get_default();
+  if (comm == 0) {
+    return ffi::Error(
+        ffi::ErrorCode::kFailedPrecondition,
+        "no default communicator: call tpunet.distributed.initialize() "
+        "before running FFI collectives");
+  }
+  const uint64_t n = static_cast<uint64_t>(x.element_count());
+  return ToError(
+      tpunet_comm_all_reduce(comm, n ? x.untyped_data() : nullptr,
+                             n ? out->untyped_data() : nullptr, n,
+                             static_cast<int32_t>(dtype),
+                             static_cast<int32_t>(op)),
+      "all_reduce");
+}
+
+}  // namespace
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(TpunetFfiAllReduce, AllReduceImpl,
+                              ffi::Ffi::Bind()
+                                  .Attr<int64_t>("dtype")
+                                  .Attr<int64_t>("op")
+                                  .Arg<ffi::AnyBuffer>()
+                                  .Ret<ffi::AnyBuffer>());

@@ -144,6 +144,10 @@ def load() -> ctypes.CDLL:
     lib.tpunet_comm_rank.restype = i32
     lib.tpunet_comm_all_reduce.argtypes = [u, ctypes.c_void_p, ctypes.c_void_p, u64, i32, i32]
     lib.tpunet_comm_all_reduce.restype = i32
+    lib.tpunet_comm_set_default.argtypes = [u]
+    lib.tpunet_comm_set_default.restype = i32
+    lib.tpunet_comm_get_default.argtypes = []
+    lib.tpunet_comm_get_default.restype = u
     lib.tpunet_comm_reduce_scatter.argtypes = [u, ctypes.c_void_p, ctypes.c_void_p, u64, i32, i32]
     lib.tpunet_comm_reduce_scatter.restype = i32
     lib.tpunet_comm_all_gather.argtypes = [u, ctypes.c_void_p, ctypes.c_void_p, u64]

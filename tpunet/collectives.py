@@ -279,6 +279,14 @@ class Communicator:
     def barrier(self) -> None:
         _native.check(self._lib.tpunet_comm_barrier(self._id), "barrier")
 
+    def set_as_default(self) -> None:
+        """Make this the process-default communicator — the handle the XLA
+        FFI custom-call collectives (tpunet.interop) resolve at CALL time,
+        so elastic recovery can swap the communicator under
+        already-compiled executables (comm_destroy clears it)."""
+        _native.check(
+            self._lib.tpunet_comm_set_default(self._id), "comm_set_default")
+
     def close(self) -> None:
         if self._id:
             cid = ctypes.c_size_t(self._id)

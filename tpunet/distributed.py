@@ -36,6 +36,7 @@ def initialize(
     with _lock:
         if _comm is None:
             _comm = Communicator(coordinator, rank, world_size)
+            _comm.set_as_default()  # FFI collectives resolve it at call time
             _comm_args = (coordinator, rank, world_size)
         elif (coordinator, rank, world_size) != _comm_args and any(
             a is not None for a in (coordinator, rank, world_size)
