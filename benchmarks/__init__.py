@@ -95,6 +95,18 @@ def reassert_jax_platform(platform: str | None = None) -> None:
     jax.config.update("jax_platforms", platform)
 
 
+def iqr(xs) -> float | None:
+    """Interquartile range, np.percentile linear-interpolation definition
+    — THE one definition every benchmark reports (serve_bench, engine_p2p,
+    bench.py), so cross-bench IQR columns are comparable. None when fewer
+    than 4 samples (a 'spread' of 2-3 points is noise about noise)."""
+    import numpy as np
+
+    if len(xs) < 4:
+        return None
+    return float(np.percentile(xs, 75) - np.percentile(xs, 25))
+
+
 def free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))

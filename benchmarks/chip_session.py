@@ -12,9 +12,10 @@ measurements; re-running resumes the full list.
 Priority order (round-4 verdict):
   1. kernel_smoke        — all flash kernel variants on real Mosaic (gate)
   2. tpu_headline        — tokens/s + MFU + VGG img/s at the headline shape
-  3. decode_bench x7     — MHA, GQA (kv4), window, speculative,
-                           int8+quant-draft, and the TTFT prefill pair
-                           (reference vs flash kernel at p=4096)
+  3. decode_bench x10    — MHA, GQA (kv4), window, speculative
+                           (gamma 2/4/8 + per-row), int8+quant-draft, and
+                           the TTFT prefill pair (reference vs flash
+                           kernel at p=4096)
   4. mfu_attribution     — per-segment breakdown of the headline step
   5. block sweep s2048   — flash tile grid at the headline seq
   6. block sweep s8192   — flash tile grid at long context
@@ -86,6 +87,28 @@ STEPS: list[tuple[str, list[str], int]] = [
                      "--ff", "8192", "--batch", "8", "--prompt", "512",
                      "--new", "256", "--spec-gamma", "4",
                      "--draft-layers", "2"], 2400),
+    # Gamma sweep (round-4 verdict item 4: "report ... tok/s vs plain
+    # decode at the headline shape for gamma in {2,4,8}"): same shape and
+    # draft as decode_spec, the speculative depth alone varies.
+    ("decode_spec_g2", ["-m", "benchmarks.decode_bench", "--platform",
+                        "tpu", "--d", "2048", "--layers", "12", "--heads",
+                        "16", "--ff", "8192", "--batch", "8", "--prompt",
+                        "512", "--new", "256", "--spec-gamma", "2",
+                        "--draft-layers", "2"], 2400),
+    ("decode_spec_g8", ["-m", "benchmarks.decode_bench", "--platform",
+                        "tpu", "--d", "2048", "--layers", "12", "--heads",
+                        "16", "--ff", "8192", "--batch", "8", "--prompt",
+                        "512", "--new", "256", "--spec-gamma", "8",
+                        "--draft-layers", "2"], 2400),
+    # Per-row (continuous-commit) speculative at the same shape: the
+    # lockstep-vs-per-row half of the verdict table (decode_quant covers
+    # per-row + int8 draft; this isolates per-row with the fp draft).
+    ("decode_spec_per_row", ["-m", "benchmarks.decode_bench", "--platform",
+                             "tpu", "--d", "2048", "--layers", "12",
+                             "--heads", "16", "--ff", "8192", "--batch",
+                             "8", "--prompt", "512", "--new", "256",
+                             "--spec-gamma", "4", "--draft-layers", "2",
+                             "--spec-per-row"], 2400),
     ("decode_quant", ["-m", "benchmarks.decode_bench", "--platform", "tpu",
                       "--d", "2048", "--layers", "12", "--heads", "16",
                       "--ff", "8192", "--batch", "8", "--prompt", "512",
@@ -250,6 +273,7 @@ def _write_measured(raw: dict, dirty: list[str] | None = None) -> None:
         out["headline_tuned"] = tuned
     decode = {}
     for key in ("decode_mha", "decode_gqa", "decode_window", "decode_spec",
+                "decode_spec_g2", "decode_spec_g8", "decode_spec_per_row",
                 "decode_quant", "prefill_ttft_ref", "prefill_ttft_flash"):
         d = raw.get(key)
         if isinstance(d, dict) and d.get("platform") == "tpu":

@@ -25,6 +25,13 @@ import json
 import time
 
 
+def _iqr4(xs):
+    from benchmarks import iqr
+
+    spread = iqr(xs)
+    return round(spread, 4) if spread is not None else None
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--platform", default="cpu", choices=["cpu", "tpu"])
@@ -154,9 +161,6 @@ def main(argv=None) -> None:
     serve_s = float(np.median(serve_walls))
     lockstep_s = float(np.median(lockstep_walls))
 
-    def iqr(xs):
-        return round(float(np.percentile(xs, 75) - np.percentile(xs, 25)), 4)
-
     print(json.dumps({
         "platform": jax.devices()[0].platform,
         "slots": args.slots, "requests": args.requests,
@@ -168,8 +172,8 @@ def main(argv=None) -> None:
         "reps": args.reps,
         "serve_wall_s": round(serve_s, 3),
         "lockstep_wall_s": round(lockstep_s, 3),
-        "serve_iqr_s": iqr(serve_walls),
-        "lockstep_iqr_s": iqr(lockstep_walls),
+        "serve_iqr_s": _iqr4(serve_walls),
+        "lockstep_iqr_s": _iqr4(lockstep_walls),
         "serve_tok_s": round(total_tokens / serve_s, 1),
         "lockstep_tok_s": round(total_tokens / lockstep_s, 1),
         "vs_lockstep": round(lockstep_s / serve_s, 3),
