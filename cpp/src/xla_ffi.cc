@@ -22,6 +22,7 @@
 // through torch.distributed; this is the jax-native equivalent tier.
 
 #include <cstdint>
+#include <cstring>
 #include <string>
 
 #include "tpunet/c_api.h"
@@ -45,15 +46,19 @@ ffi::Error ToError(int32_t rc, const char* what) {
                         (detail ? detail : ""));
 }
 
+// Every handler takes trailing "ordering operands" (ffi::RemainingArgs,
+// ignored): a data-independent collective that must run AFTER another one
+// passes the earlier result as an extra operand (interop's `after=`).
+// An operand of an opaque side-effecting custom call is a dependency no
+// XLA pass can dissolve — unlike stablehlo.optimization_barrier, which
+// the pipeline expanded away and reordered in practice (round-5 bug:
+// rank-asymmetric ring traces cross-matched their k/v exchanges).
+ffi::Error DefaultComm(uintptr_t* comm);
+
 ffi::Error AllReduceImpl(int64_t dtype, int64_t op, ffi::AnyBuffer x,
-                         ffi::Result<ffi::AnyBuffer> out) {
-  uintptr_t comm = tpunet_comm_get_default();
-  if (comm == 0) {
-    return ffi::Error(
-        ffi::ErrorCode::kFailedPrecondition,
-        "no default communicator: call tpunet.distributed.initialize() "
-        "before running FFI collectives");
-  }
+                         ffi::RemainingArgs, ffi::Result<ffi::AnyBuffer> out) {
+  uintptr_t comm;
+  if (auto err = DefaultComm(&comm); err.failure()) return err;
   const uint64_t n = static_cast<uint64_t>(x.element_count());
   return ToError(
       tpunet_comm_all_reduce(comm, n ? x.untyped_data() : nullptr,
@@ -70,4 +75,131 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(TpunetFfiAllReduce, AllReduceImpl,
                                   .Attr<int64_t>("dtype")
                                   .Attr<int64_t>("op")
                                   .Arg<ffi::AnyBuffer>()
+                                  .RemainingArgs()
+                                  .Ret<ffi::AnyBuffer>());
+
+namespace {
+
+ffi::Error DefaultComm(uintptr_t* comm) {
+  *comm = tpunet_comm_get_default();
+  if (*comm == 0) {
+    return ffi::Error(
+        ffi::ErrorCode::kFailedPrecondition,
+        "no default communicator: call tpunet.distributed.initialize() "
+        "before running FFI collectives");
+  }
+  return ffi::Error::Success();
+}
+
+ffi::Error AllGatherImpl(ffi::AnyBuffer x, ffi::RemainingArgs,
+                         ffi::Result<ffi::AnyBuffer> out) {
+  uintptr_t comm;
+  if (auto err = DefaultComm(&comm); err.failure()) return err;
+  return ToError(tpunet_comm_all_gather(comm, x.untyped_data(),
+                                        out->untyped_data(), x.size_bytes()),
+                 "all_gather");
+}
+
+ffi::Error ReduceScatterImpl(int64_t dtype, int64_t op, ffi::AnyBuffer x,
+                             ffi::RemainingArgs,
+                             ffi::Result<ffi::AnyBuffer> out) {
+  uintptr_t comm;
+  if (auto err = DefaultComm(&comm); err.failure()) return err;
+  return ToError(
+      tpunet_comm_reduce_scatter(comm, x.untyped_data(), out->untyped_data(),
+                                 out->element_count(),
+                                 static_cast<int32_t>(dtype),
+                                 static_cast<int32_t>(op)),
+      "reduce_scatter");
+}
+
+ffi::Error BroadcastImpl(int64_t root, ffi::AnyBuffer x,
+                         ffi::RemainingArgs,
+                         ffi::Result<ffi::AnyBuffer> out) {
+  uintptr_t comm;
+  if (auto err = DefaultComm(&comm); err.failure()) return err;
+  // The C API broadcasts in place; the result buffer doubles as the
+  // working buffer (one memcpy of this rank's payload — still two fewer
+  // copies than the io_callback bridge).
+  if (x.size_bytes()) {
+    std::memcpy(out->untyped_data(), x.untyped_data(), x.size_bytes());
+  }
+  return ToError(tpunet_comm_broadcast(comm, out->untyped_data(),
+                                       x.size_bytes(),
+                                       static_cast<int32_t>(root)),
+                 "broadcast");
+}
+
+ffi::Error AllToAllImpl(ffi::AnyBuffer x, ffi::RemainingArgs,
+                        ffi::Result<ffi::AnyBuffer> out) {
+  uintptr_t comm;
+  if (auto err = DefaultComm(&comm); err.failure()) return err;
+  int32_t rank = 0, world = 0;
+  if (auto err = ToError(tpunet_comm_rank(comm, &rank, &world), "comm_rank");
+      err.failure()) {
+    return err;
+  }
+  if (world <= 0 || x.size_bytes() % world) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "all_to_all payload not divisible by world size");
+  }
+  return ToError(tpunet_comm_all_to_all(comm, x.untyped_data(),
+                                        out->untyped_data(),
+                                        x.size_bytes() / world),
+                 "all_to_all");
+}
+
+ffi::Error NeighborExchangeImpl(ffi::AnyBuffer x, ffi::RemainingArgs,
+                                ffi::Result<ffi::AnyBuffer> out) {
+  uintptr_t comm;
+  if (auto err = DefaultComm(&comm); err.failure()) return err;
+  uint64_t got = 0;
+  auto err = ToError(
+      tpunet_comm_neighbor_exchange(comm, x.untyped_data(), x.size_bytes(),
+                                    out->untyped_data(), x.size_bytes(),
+                                    &got),
+      "neighbor_exchange");
+  if (err.failure()) return err;
+  if (got != x.size_bytes()) {
+    return ffi::Error(ffi::ErrorCode::kInternal,
+                      "tpunet native neighbor_exchange failed (short "
+                      "message): got " + std::to_string(got) + " of " +
+                          std::to_string(x.size_bytes()) + " bytes");
+  }
+  return ffi::Error::Success();
+}
+
+}  // namespace
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(TpunetFfiAllGather, AllGatherImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::AnyBuffer>()
+                                  .RemainingArgs()
+                                  .Ret<ffi::AnyBuffer>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(TpunetFfiReduceScatter, ReduceScatterImpl,
+                              ffi::Ffi::Bind()
+                                  .Attr<int64_t>("dtype")
+                                  .Attr<int64_t>("op")
+                                  .Arg<ffi::AnyBuffer>()
+                                  .RemainingArgs()
+                                  .Ret<ffi::AnyBuffer>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(TpunetFfiBroadcast, BroadcastImpl,
+                              ffi::Ffi::Bind()
+                                  .Attr<int64_t>("root")
+                                  .Arg<ffi::AnyBuffer>()
+                                  .RemainingArgs()
+                                  .Ret<ffi::AnyBuffer>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(TpunetFfiAllToAll, AllToAllImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::AnyBuffer>()
+                                  .RemainingArgs()
+                                  .Ret<ffi::AnyBuffer>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(TpunetFfiNeighborExchange, NeighborExchangeImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::AnyBuffer>()
+                                  .RemainingArgs()
                                   .Ret<ffi::AnyBuffer>());

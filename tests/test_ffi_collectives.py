@@ -182,6 +182,40 @@ def _ordering_worker(rank: int, world: int, port: int, q) -> None:
         np.testing.assert_array_equal(np.asarray(g),
                                       np.full(64, float(world)))
 
+        # Every OTHER FFI collective in one jit, interleaved — the full
+        # zoo must stay order-coherent across ranks too.
+        from tpunet.interop import (dcn_all_gather, dcn_all_to_all,
+                                    dcn_broadcast, dcn_neighbor_exchange,
+                                    dcn_reduce_scatter)
+
+        v = jnp.arange(2 * world * 3, dtype=jnp.float32).reshape(
+            2 * world, 3) * (rank + 1)
+
+        @jax.jit
+        def zoo(v):
+            g1 = dcn_all_gather(v[0])            # (world, 3)
+            rs = dcn_reduce_scatter(v)           # (2, 3) summed shard
+            bc = dcn_broadcast(v[1], root=0)
+            ne = dcn_neighbor_exchange(v[2])
+            a2a = dcn_all_to_all(v[:world])
+            return g1, rs, bc, ne, a2a
+
+        g1, rs, bc, ne, a2a = zoo(v)
+        base = np.arange(2 * world * 3, dtype=np.float32).reshape(
+            2 * world, 3)
+        np.testing.assert_allclose(
+            np.asarray(g1), np.stack([base[0] * (r + 1)
+                                      for r in range(world)]))
+        tot = sum(range(1, world + 1))
+        np.testing.assert_allclose(
+            np.asarray(rs), base[2 * rank: 2 * rank + 2] * tot)
+        np.testing.assert_allclose(np.asarray(bc), base[1] * 1.0)  # root 0
+        prev = (rank - 1 + world) % world
+        np.testing.assert_allclose(np.asarray(ne), base[2] * (prev + 1))
+        np.testing.assert_allclose(
+            np.asarray(a2a), np.stack([base[rank] * (r + 1)
+                                       for r in range(world)]))
+
         distributed.finalize()
         q.put((rank, "OK"))
     except Exception as e:  # noqa: BLE001
@@ -213,3 +247,78 @@ def test_ffi_error_is_classified_as_comm_failure():
         assert is_comm_failure(ei.value), str(ei.value)
     finally:
         distributed.finalize()
+
+
+def test_ffi_every_target_in_lowering():
+    # Each dcn_* must lower to ITS custom call on the CPU backend — a
+    # silent fall-through to io_callback on any one op would quietly
+    # reintroduce the 3-copy bridge tax there.
+    from tpunet import distributed
+    from tpunet.interop import (dcn_all_gather, dcn_all_to_all,
+                                dcn_broadcast, dcn_neighbor_exchange,
+                                dcn_psum, dcn_reduce_scatter)
+
+    distributed.finalize()
+    distributed.initialize(f"127.0.0.1:{free_port()}", 0, 1)
+    try:
+        x = jnp.ones((4, 2), jnp.float32)
+        for fn, target in (
+            (dcn_psum, "tpunet_all_reduce"),
+            (dcn_all_gather, "tpunet_all_gather"),
+            (dcn_reduce_scatter, "tpunet_reduce_scatter"),
+            (dcn_broadcast, "tpunet_broadcast"),
+            (dcn_neighbor_exchange, "tpunet_neighbor_exchange"),
+        ):
+            txt = jax.jit(fn).lower(x).as_text()
+            assert target in txt, (target, txt[:500])
+        txt = jax.jit(dcn_all_to_all).lower(
+            jnp.ones((1, 4), jnp.float32)).as_text()
+        assert "tpunet_all_to_all" in txt
+    finally:
+        distributed.finalize()
+
+
+def _asymmetric_chain_worker(rank: int, world: int, port: int, q) -> None:
+    # Rank-ASYMMETRIC trace (rank-dependent constants baked in) issuing two
+    # data-independent neighbor exchanges: exactly the pattern that
+    # cross-matched on the FFI path in dcn_ring_attention (round-5 bug).
+    # after=(ea,) makes ea an operand of the second custom call, pinning
+    # the order (optimization_barrier demonstrably does NOT); the
+    # packed-exchange alternative is covered by test_dcn_ring_attention.
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+
+        from tpunet import distributed
+        from tpunet.interop import dcn_neighbor_exchange
+
+        distributed.initialize(f"127.0.0.1:{port}", rank, world)
+
+        a = jnp.full((32,), 10.0 * (rank + 1), jnp.float32)
+        b = jnp.full((32,), 100.0 * (rank + 1), jnp.float32)
+
+        @jax.jit
+        def ring_like(a, b):
+            # rank-dependent constant makes per-rank HLO differ
+            a = a + float(rank)
+            ea = dcn_neighbor_exchange(a)
+            eb = dcn_neighbor_exchange(b, after=(ea,))
+            return ea, eb
+
+        for _ in range(3):
+            ea, eb = ring_like(a, b)
+            prev = (rank - 1 + world) % world
+            np.testing.assert_allclose(
+                np.asarray(ea), np.full(32, 10.0 * (prev + 1) + prev))
+            np.testing.assert_allclose(
+                np.asarray(eb), np.full(32, 100.0 * (prev + 1)))
+        distributed.finalize()
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def test_ffi_rank_asymmetric_trace_with_after_kwarg_4proc():
+    run_spawn_workers(_asymmetric_chain_worker, 4)

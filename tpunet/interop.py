@@ -20,11 +20,19 @@ In-pod (ICI) collectives should keep using `jax.lax.psum` et al. — these
 functions are the *between-hosts* tier of a hierarchical collective.
 
 All ranks must execute the same dcn_* calls in the same order. The
-io_callback path pins relative order with `ordered=True`; the FFI calls
-are side-effecting custom calls whose order follows the compiled
-schedule, which is deterministic and identical across ranks compiling
-the same program (empirically exercised by the multi-tensor ordering
-test). `dcn_all_reduce(sum)` is differentiable: the VJP of a sum
+io_callback path pins relative order with `ordered=True`. The FFI calls
+are side-effecting custom calls ordered by the compiled schedule: ranks
+compiling IDENTICAL programs schedule identically (the common case —
+trainer, ZeRO, hierarchical psum), but a trace that bakes in the rank
+(ring/zigzag attention's offsets) may schedule DATA-INDEPENDENT
+collectives differently per rank, silently cross-matching them. The
+contract: consecutive dcn_* calls in one trace must be related by data
+flow — pack independent tensors into one collective (see
+dcn_ring_attention's packed k/v exchange) or pass the earlier result via
+the `after=` kwarg, which makes it an extra OPERAND of the later custom
+call (a dependency no pass can dissolve; stablehlo.optimization_barrier
+is NOT sufficient — XLA expands it away and measurably reordered such
+collectives). `dcn_all_reduce(sum)` is differentiable: the VJP of a sum
 all-reduce is a sum all-reduce of the cotangent.
 """
 
@@ -46,11 +54,22 @@ def _comm():
 
 _ffi_state = {"registered": False, "available": None}
 
+# target name -> handler symbol in libtpunet.so (built all-or-none by the
+# Makefile's jaxlib-header guard, so probing one symbol decides for all).
+_FFI_TARGETS = {
+    "tpunet_all_reduce": "TpunetFfiAllReduce",
+    "tpunet_all_gather": "TpunetFfiAllGather",
+    "tpunet_reduce_scatter": "TpunetFfiReduceScatter",
+    "tpunet_broadcast": "TpunetFfiBroadcast",
+    "tpunet_all_to_all": "TpunetFfiAllToAll",
+    "tpunet_neighbor_exchange": "TpunetFfiNeighborExchange",
+}
+
 
 def _ffi_available() -> bool:
     """True when the zero-copy XLA custom-call path can serve this trace:
-    CPU backend, handler symbol present in libtpunet.so (it is omitted
-    when the .so was built without jaxlib headers), not disabled by
+    CPU backend, handler symbols present in libtpunet.so (omitted when the
+    .so was built without jaxlib headers), not disabled by
     TPUNET_FFI_COLLECTIVES=0. Decided at trace time; registration is
     one-shot per process."""
     import os
@@ -63,18 +82,34 @@ def _ffi_available() -> bool:
         from tpunet import _native
 
         lib = _native.load()
-        _ffi_state["available"] = hasattr(lib, "TpunetFfiAllReduce")
+        # ALL symbols must be present — a stale .so built when only
+        # all_reduce existed must fall back to io_callback gracefully,
+        # not crash at registration.
+        _ffi_state["available"] = all(
+            hasattr(lib, sym) for sym in _FFI_TARGETS.values())
     if not _ffi_state["available"]:
         return False
     if not _ffi_state["registered"]:
         from tpunet import _native
 
         lib = _native.load()
-        jax.ffi.register_ffi_target(
-            "tpunet_all_reduce", jax.ffi.pycapsule(lib.TpunetFfiAllReduce),
-            platform="cpu")
+        for target, symbol in _FFI_TARGETS.items():
+            jax.ffi.register_ffi_target(
+                target, jax.ffi.pycapsule(getattr(lib, symbol)),
+                platform="cpu")
         _ffi_state["registered"] = True
     return True
+
+
+def _ffi_call(target: str, spec, x, after=(), **attrs):
+    """Issue one FFI collective. `after` values become extra operands of
+    the custom call (the handlers ignore them): a dependency no XLA pass
+    can dissolve, pinning this collective AFTER the ones that produced
+    them. (stablehlo.optimization_barrier is NOT enough — the pipeline
+    expands it away and did reorder data-independent collectives in
+    rank-asymmetric traces.)"""
+    return jax.ffi.ffi_call(target, spec, has_side_effect=True)(
+        x, *after, **attrs)
 
 
 def _callback_result_spec(x: jax.Array | jnp.ndarray):
@@ -85,21 +120,45 @@ def _callback_result_spec(x: jax.Array | jnp.ndarray):
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(1,))
-def dcn_all_reduce(x, op: str = "sum"):
-    """AllReduce `x` across all processes over the DCN transport."""
+def _dcn_all_reduce_diff(x, op: str = "sum"):
     return _dcn_all_reduce_impl(x, op)
+
+
+def dcn_all_reduce(x, op: str = "sum", *, after=()):
+    """AllReduce `x` across all processes over the DCN transport.
+
+    `after`: results of earlier data-independent dcn_* calls this one must
+    follow (module docstring). The after-pinned form is NOT differentiable
+    — training all-reduces are ordered by gradient data flow already; the
+    kwarg exists for inference/serving traces."""
+    if after:
+        return _dcn_all_reduce_after(x, op, tuple(after))
+    return _dcn_all_reduce_diff(x, op)
+
+
+def _dcn_all_reduce_after(x, op: str, after):
+    if _ffi_available():
+        from tpunet.collectives import _OPS, _dtype_code
+
+        return _ffi_call(
+            "tpunet_all_reduce", _callback_result_spec(x), x, after,
+            dtype=np.int64(_dtype_code(np.dtype(jnp.result_type(x)))),
+            op=np.int64(_OPS[op]))
+
+    def cb(a):
+        return _comm().all_reduce(np.asarray(a), op)
+
+    return io_callback(cb, _callback_result_spec(x), x, ordered=True)
 
 
 def _dcn_all_reduce_impl(x, op: str):
     if _ffi_available():
         from tpunet.collectives import _OPS, _dtype_code
 
-        call = jax.ffi.ffi_call(
-            "tpunet_all_reduce", _callback_result_spec(x),
-            has_side_effect=True)
-        return call(x,
-                    dtype=np.int64(_dtype_code(np.dtype(jnp.result_type(x)))),
-                    op=np.int64(_OPS[op]))
+        return _ffi_call(
+            "tpunet_all_reduce", _callback_result_spec(x), x,
+            dtype=np.int64(_dtype_code(np.dtype(jnp.result_type(x)))),
+            op=np.int64(_OPS[op]))
 
     def cb(a):
         return _comm().all_reduce(np.asarray(a), op)
@@ -117,7 +176,7 @@ def _dcn_all_reduce_bwd(op: str, _res, g):
     return (_dcn_all_reduce_impl(g, "sum"),)
 
 
-dcn_all_reduce.defvjp(_dcn_all_reduce_fwd, _dcn_all_reduce_bwd)
+_dcn_all_reduce_diff.defvjp(_dcn_all_reduce_fwd, _dcn_all_reduce_bwd)
 
 
 def dcn_psum(x):
@@ -218,18 +277,23 @@ def dcn_all_reduce_finish(ticket, like):
 # -- other collectives ------------------------------------------------------
 
 
-def dcn_all_gather(x):
-    """Gather `x` from every process: result shape (world, *x.shape)."""
+def dcn_all_gather(x, *, after=()):
+    """Gather `x` from every process: result shape (world, *x.shape).
+    `after`: results of earlier data-independent dcn_* calls this one must
+    follow (module docstring; ignored on the io_callback path, which is
+    totally ordered)."""
     w = distributed.world_size()
+    spec = jax.ShapeDtypeStruct((w,) + tuple(jnp.shape(x)), jnp.result_type(x))
+    if _ffi_available():
+        return _ffi_call("tpunet_all_gather", spec, x, after)
 
     def cb(a):
         return _comm().all_gather(np.asarray(a))
 
-    spec = jax.ShapeDtypeStruct((w,) + tuple(jnp.shape(x)), jnp.result_type(x))
     return io_callback(cb, spec, x, ordered=True)
 
 
-def dcn_reduce_scatter(x, op: str = "sum"):
+def dcn_reduce_scatter(x, op: str = "sum", *, after=()):
     """x: leading axis divisible by world; returns this process's reduced
     shard (shape[0]/world leading axis)."""
     w = distributed.world_size()
@@ -237,14 +301,22 @@ def dcn_reduce_scatter(x, op: str = "sum"):
     if shape[0] % w != 0:
         raise ValueError(f"leading axis {shape[0]} not divisible by world size {w}")
 
+    spec = jax.ShapeDtypeStruct((shape[0] // w,) + shape[1:], jnp.result_type(x))
+    if _ffi_available():
+        from tpunet.collectives import _OPS, _dtype_code
+
+        return _ffi_call(
+            "tpunet_reduce_scatter", spec, x, after,
+            dtype=np.int64(_dtype_code(np.dtype(jnp.result_type(x)))),
+            op=np.int64(_OPS[op]))
+
     def cb(a):
         return _comm().reduce_scatter(np.asarray(a), op)
 
-    spec = jax.ShapeDtypeStruct((shape[0] // w,) + shape[1:], jnp.result_type(x))
     return io_callback(cb, spec, x, ordered=True)
 
 
-def dcn_all_to_all(x):
+def dcn_all_to_all(x, *, after=()):
     """AllToAll across processes: x has leading axis == world, block j goes
     to process j; the result's block j came from process j. Shape-preserving.
     The cross-host leg of Ulysses sequence parallelism and MoE dispatch."""
@@ -253,22 +325,35 @@ def dcn_all_to_all(x):
     if not shape or shape[0] != w:
         raise ValueError(f"leading axis must equal world size {w}, got {shape}")
 
+    if _ffi_available():
+        return _ffi_call("tpunet_all_to_all", _callback_result_spec(x), x,
+                         after)
+
     def cb(a):
         return _comm().all_to_all(np.asarray(a))
 
     return io_callback(cb, _callback_result_spec(x), x, ordered=True)
 
 
-def dcn_broadcast(x, root: int = 0):
+def dcn_broadcast(x, root: int = 0, *, after=()):
+    if _ffi_available():
+        return _ffi_call("tpunet_broadcast", _callback_result_spec(x), x,
+                         after, root=np.int64(root))
+
     def cb(a):
         return _comm().broadcast(np.asarray(a), root)
 
     return io_callback(cb, _callback_result_spec(x), x, ordered=True)
 
 
-def dcn_neighbor_exchange(x):
+def dcn_neighbor_exchange(x, *, after=()):
     """Send x to (rank+1)%world, receive from (rank-1+world)%world — the
-    ring-shift step of ring attention / sequence parallelism, across hosts."""
+    ring-shift step of ring attention / sequence parallelism, across hosts.
+    `after`: earlier collectives this exchange must follow (module
+    docstring)."""
+    if _ffi_available():
+        return _ffi_call("tpunet_neighbor_exchange",
+                         _callback_result_spec(x), x, after)
 
     def cb(a):
         return _comm().neighbor_exchange(np.asarray(a))

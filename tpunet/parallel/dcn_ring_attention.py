@@ -27,14 +27,30 @@ import jax.numpy as jnp
 from tpunet.parallel.ring_attention import NEG_INF, _block_update
 
 
+def _exchange_packed(kc, vc):
+    """Ring-shift k and v in ONE neighbor exchange (concat on the last
+    axis — they share (batch, seq, heads)). A single collective per
+    rotation keeps the cross-rank call sequence trivially aligned even
+    though each rank traces a different (rank-constant-bearing) program."""
+    from tpunet.interop import dcn_neighbor_exchange
+
+    dk = kc.shape[-1]
+    wide = jnp.promote_types(kc.dtype, vc.dtype)  # lossless packing
+    packed = dcn_neighbor_exchange(
+        jnp.concatenate([kc.astype(wide), vc.astype(wide)], axis=-1))
+    return packed[..., :dk].astype(kc.dtype), packed[..., dk:].astype(vc.dtype)
+
+
 def dcn_ring_attention(q, k, v, causal: bool = False):
     """Ring attention across processes. q/k/v: this process's sequence shard
     (batch, s_local, heads, head_dim); every process must hold equal-length
-    shards in rank order. Jittable (the exchanges are ordered io_callbacks).
+    shards in rank order. Jittable. The per-rotation k/v shift is ONE
+    packed collective: on the FFI custom-call path (default on CPU),
+    data-independent collectives in this rank-asymmetric trace carry no
+    cross-rank ordering guarantee — anyone adding another collective here
+    must pack it in or pin it with `after=` (tpunet.interop docstring).
     Requires `tpunet.distributed.initialize()` before the first trace."""
     from tpunet import distributed
-    from tpunet.interop import dcn_neighbor_exchange
-
     w = distributed.world_size()
     my = distributed.rank()
     s_local = q.shape[1]
@@ -66,8 +82,11 @@ def dcn_ring_attention(q, k, v, causal: bool = False):
                 causal=causal and src == my, scale=scale,
             )
         if t + 1 < w:
-            kc = dcn_neighbor_exchange(kc)
-            vc = dcn_neighbor_exchange(vc)
+            # ONE packed exchange, not one per tensor: data-independent
+            # FFI collectives carry no cross-rank ordering guarantee when
+            # per-rank programs differ (this trace bakes in rank), and the
+            # packed form also halves the per-rotation message count.
+            kc, vc = _exchange_packed(kc, vc)
     return (acc / l).astype(q.dtype)
 
 
@@ -85,7 +104,6 @@ def dcn_zigzag_attention(q, k, v):
     Positions for rotary: `zigzag_positions(world, world*2c, rank)`.
     """
     from tpunet import distributed
-    from tpunet.interop import dcn_neighbor_exchange
 
     w = distributed.world_size()
     my = distributed.rank()
@@ -127,8 +145,7 @@ def dcn_zigzag_attention(q, k, v):
                                   causal=True, scale=scale)
         # (a_lo x b_hi never computes: b_hi >= W > a_lo.)
         if t + 1 < w:
-            kc = dcn_neighbor_exchange(kc)
-            vc = dcn_neighbor_exchange(vc)
+            kc, vc = _exchange_packed(kc, vc)  # see dcn_ring_attention
     out = jnp.concatenate(
         [st_lo[0] / st_lo[2], st_hi[0] / st_hi[2]], axis=1
     )

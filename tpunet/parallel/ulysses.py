@@ -78,7 +78,11 @@ def dcn_ulysses_attention(q, k, v, causal: bool = False):
 
     q/k/v: this process's sequence shard (batch, s_local, heads, head_dim) in
     rank order; heads divisible by world size. Jittable (the all-to-alls are
-    ordered io_callbacks). Requires `tpunet.distributed.initialize()` before
+    data-DEPENDENT collectives — the second all-to-all consumes attention
+    over the first's output, so their order is pinned by data flow on both
+    the io_callback and FFI custom-call paths; an added independent
+    collective would need `after=` — tpunet.interop docstring). Requires
+    `tpunet.distributed.initialize()` before
     the first trace. Rotary/positions must already be global (the caller
     applies them with this process's sequence offset, exactly as for
     `dcn_ring_attention`)."""
