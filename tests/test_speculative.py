@@ -338,3 +338,66 @@ def test_per_row_speculative_with_quant_draft_and_chunked_prefill():
     got = speculative_generate(model, params, qdraft, qp, prompt, 10,
                                gamma=3, per_row=True, prefill_chunk=7)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# --- speculative x rolling-window ring cache (round 5) --------------------
+# With gamma + 1 <= window, speculation runs on the RING cache: the round
+# stashes the slots it overwrites and restores the rejected span
+# (_spec_ring_stash/_spec_ring_restore). Oracle: the identical model with
+# decode_ring_cache=False (full-capacity masked cache, round-4 rollback).
+
+
+def _ring_pair(window=8, **kw):
+    model = _tiny(n_kv_heads=2, attn_window=window, **kw)
+    draft = _tiny(n_layers=1, n_kv_heads=2, attn_window=window, **kw)
+    params, _ = _params(model)
+    dparams, _ = _params(draft, seed=3)
+    return model, draft, params, dparams
+
+
+def test_spec_ring_cache_matches_masked_cache_greedy():
+    model, draft, params, dparams = _ring_pair()
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 6), 0, 64)
+    kw = dict(max_new_tokens=12, gamma=3, temperature=0.0)
+    ring = speculative_generate(model, params, draft, dparams, prompt, **kw)
+    masked = speculative_generate(
+        model.clone(decode_ring_cache=False), params,
+        draft.clone(decode_ring_cache=False), dparams, prompt, **kw)
+    assert jnp.array_equal(ring, masked)
+
+
+def test_spec_ring_cache_matches_masked_cache_sampled_per_row():
+    model, draft, params, dparams = _ring_pair()
+    prompt = jax.random.randint(jax.random.PRNGKey(6), (3, 6), 0, 64)
+    for per_row in (False, True):
+        kw = dict(max_new_tokens=12, gamma=3, temperature=0.9, top_k=8,
+                  rng=jax.random.PRNGKey(11), per_row=per_row)
+        ring = speculative_generate(model, params, draft, dparams, prompt,
+                                    **kw)
+        masked = speculative_generate(
+            model.clone(decode_ring_cache=False), params,
+            draft.clone(decode_ring_cache=False), dparams, prompt, **kw)
+        assert jnp.array_equal(ring, masked), f"per_row={per_row}"
+
+
+def test_spec_ring_cache_matches_plain_generate():
+    # End-to-end exactness: ring-cache speculation == plain generate()
+    # greedy (the strongest oracle — no shared code with the spec loop).
+    model, draft, params, dparams = _ring_pair()
+    prompt = jax.random.randint(jax.random.PRNGKey(7), (2, 6), 0, 64)
+    out = speculative_generate(model, params, draft, dparams, prompt,
+                               max_new_tokens=10, gamma=2, temperature=0.0)
+    ref = generate(model, params, prompt, max_new_tokens=10, temperature=0.0)
+    assert jnp.array_equal(out[:, :ref.shape[1]], ref)
+
+
+def test_spec_narrow_window_falls_back_to_masked_cache():
+    # gamma + 1 > window: a round's writes would lap the ring (duplicate
+    # slots in the stash scatter) — the masked full-capacity cache is the
+    # correct substrate, and results still match plain generate().
+    model, draft, params, dparams = _ring_pair(window=4)
+    prompt = jax.random.randint(jax.random.PRNGKey(8), (2, 6), 0, 64)
+    out = speculative_generate(model, params, draft, dparams, prompt,
+                               max_new_tokens=8, gamma=4, temperature=0.0)
+    ref = generate(model, params, prompt, max_new_tokens=8, temperature=0.0)
+    assert jnp.array_equal(out[:, :ref.shape[1]], ref)

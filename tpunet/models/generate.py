@@ -294,14 +294,24 @@ def speculative_generate(
     # per-row mode a finished row's frozen frontier rewrites one more
     # block-width each extra round.
     cap = p + max_new_tokens + gamma + 1
-    # decode_ring_cache=False: rejection rolls the caches back by simply
-    # rewriting cache_index (entries beyond it are masked) — a rolling ring
-    # buffer would have OVERWRITTEN in-window history with rejected-token
-    # K/V, so windowed models speculate against the full masked cache.
+    # Windowed models CAN speculate against the rolling ring cache: the
+    # round stashes the <= gamma+1 slots it will overwrite and restores
+    # the rejected span after the accept decision (_spec_ring_stash /
+    # _spec_ring_restore) — rollback costs O(gamma) per layer, not a ring
+    # rebuild. Requires gamma + 1 <= window (otherwise a round's writes
+    # would lap the ring and the stash would hold duplicate slots);
+    # narrower windows fall back to the full-capacity masked cache, where
+    # rollback is just the index rewrite.
+    def _ring_ok(m):
+        return (m.attn_window is not None
+                and getattr(m, "decode_ring_cache", True)
+                and gamma + 1 <= m.attn_window)
+
+    t_ring, d_ring = _ring_ok(model), _ring_ok(draft_model)
     tm = model.clone(decode=True, per_row_cache=per_row,
-                     decode_ring_cache=False)
+                     decode_ring_cache=t_ring)
     dm = draft_model.clone(decode=True, per_row_cache=per_row,
-                           decode_ring_cache=False)
+                           decode_ring_cache=d_ring)
     t_cache = init_cache(tm, b, cap)
     d_cache = init_cache(dm, b, cap)
     if rng is None:
@@ -354,6 +364,13 @@ def speculative_generate(
         L_rows = p + n_out            # (b,) committed tokens per row
         last_tok = out[rows_i, L_rows - 1]
         rng, k_draft, k_accept, k_fix = jax.random.split(rng, 4)
+        # Both caches sit at idx0 = L_rows - 1 (the round-boundary
+        # invariant); ring mode stashes the slots this round overwrites.
+        idx0 = L_rows - 1
+        d_stash = (_spec_ring_stash(d_cache, idx0, gamma + 1)
+                   if d_ring else None)
+        t_stash = (_spec_ring_stash(t_cache, idx0, gamma + 1)
+                   if t_ring else None)
 
         # 1. Draft gamma tokens (small model, sequential scan) — plus ONE
         # extra step whose sampled token is discarded: it exists to feed
@@ -460,7 +477,14 @@ def speculative_generate(
         # as the next round's first input. Stale tail entries are masked
         # and later overwritten.
         n_out_new = jnp.minimum(n_out + n_eff + 1, max_new_tokens)
-        cidx = p + n_out_new - 1
+        new_idx = p + n_out_new - 1
+        if t_ring:
+            t_cache = _spec_ring_restore(t_cache, t_stash, idx0, new_idx,
+                                         gamma + 1)
+        if d_ring:
+            d_cache = _spec_ring_restore(d_cache, d_stash, idx0, new_idx,
+                                         gamma + 1)
+        cidx = new_idx
         if not per_row:
             cidx = cidx[0]  # scalar-cache models need a scalar index
         t_cache = _set_cache_index(t_cache, cidx)
@@ -495,6 +519,46 @@ def _map_cache_index(cache, fn):
         return fn(leaf) if name == "cache_index" else leaf
 
     return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+def _spec_ring_stash(cache, idx0, span):
+    """Gather the ring-cache slots a speculative round is about to
+    overwrite: slots (idx0 + i) mod W for i < span, per row. The parallel
+    tree this returns feeds _spec_ring_restore after the accept decision.
+    Non-k/v leaves pass through untouched (cheap references)."""
+    rows = jnp.arange(idx0.shape[0])[:, None]
+
+    def fix(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in ("cached_key", "cached_value"):
+            slot = (idx0[:, None] + jnp.arange(span)) % leaf.shape[1]
+            return leaf[rows, slot]  # (b, span, kv, dh)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+def _spec_ring_restore(cache, stash, idx0, new_idx, span):
+    """Undo a speculative round's ring writes beyond the committed
+    frontier: slots whose global position p >= new_idx regain their
+    stashed (previous-occupant) content; committed positions keep the
+    round's writes — whose evicted predecessors (p - W < new_idx - W) are
+    provably outside every future query's window, so the overwrite is
+    safe exactly when it is permanent."""
+    rows = jnp.arange(idx0.shape[0])[:, None]
+    pos = idx0[:, None] + jnp.arange(span)  # (b, span) global positions
+    rollback = pos >= new_idx[:, None]
+
+    def fix(path, leaf, saved):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in ("cached_key", "cached_value"):
+            slot = pos % leaf.shape[1]
+            cur = leaf[rows, slot]
+            merged = jnp.where(rollback[..., None, None], saved, cur)
+            return leaf.at[rows, slot].set(merged)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache, stash)
 
 
 def _set_cache_index(cache, idx):

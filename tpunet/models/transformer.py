@@ -219,10 +219,10 @@ class SelfAttention(nn.Module):
     #   batch slot advances independently (continuous batching)
     decode_ring_cache: bool = True  # attn_window + decode: the cache is a
     #   rolling ring buffer — leaves sized min(window, capacity), O(window)
-    #   decode contraction. False keeps the full-capacity masked cache,
-    #   which speculative decoding REQUIRES: its rollback just rewrites
-    #   cache_index, and a ring overwrite destroys the history a rollback
-    #   re-exposes.
+    #   decode contraction. False = full-capacity masked cache.
+    #   speculative_generate keeps the ring when gamma + 1 <= window
+    #   (rollback stashes/restores the overwritten slots) and falls back
+    #   to the masked cache for narrower windows.
     lora_rank: int = 0
     lora_alpha: float | None = None
 
@@ -706,8 +706,8 @@ class Transformer(nn.Module):
     #   the continuous-batching substrate (tpunet.models.serve.BatchServer)
     decode_ring_cache: bool = True  # attn_window + decode: rolling ring-
     #   buffer KV cache, leaves sized min(window, cap) — bounded memory and
-    #   O(window) decode contraction. speculative_generate turns it off
-    #   (rollback needs the full masked cache).
+    #   O(window) decode contraction. speculative_generate keeps it when
+    #   gamma + 1 <= window (stash/restore rollback), else masked cache.
     lora_rank: int = 0             # > 0: rank-r LoRA adapters on every Dense
     #   (tpunet.models.lora: lora_mask to train only A/B, graft_base to
     #   load a base checkpoint, merge_lora to fold back); composes with
