@@ -265,3 +265,127 @@ def test_run_pipeline_with_eos_matches_default():
     assert out2 == base
     for toks in base:
         assert eos not in toks[:-1]  # nothing after a (possible) eos
+
+
+# --- speculative continuous batching (round 5) ----------------------------
+# BatchServer(draft_model=...) turns each decode window into speculative
+# rounds: draft gamma, verify in one target forward, commit each row's OWN
+# accepted prefix. Exactness oracle: greedy tokens must equal generate()'s
+# per request, whatever the draft proposes.
+
+
+def _spec_srv(model, params, draft, dparams, reqs, **kw):
+    srv = BatchServer(model, params, draft_model=draft, draft_params=dparams,
+                      **kw)
+    ids = [srv.submit(p, n) for p, n in reqs]
+    return srv, ids, srv.run()
+
+
+@pytest.mark.parametrize("steps_per_call,pipeline", [
+    (1, 1),
+    # Multi-round windows exercise the per-round absorb loop and the
+    # mid-window retirement break; pipeline=2 exercises in-flight
+    # speculative windows + deferred refill tokens + the dispatch-time
+    # occupancy snapshot discarding recycled rows' rounds.
+    (4, 1),
+    (2, 2),
+])
+def test_spec_server_greedy_matches_generate_mixed_lengths(
+        steps_per_call, pipeline):
+    model, params = _setup()
+    draft = _tiny(n_layers=1)
+    dparams = draft.init(jax.random.PRNGKey(9),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(0)
+    reqs = [(rng.integers(0, 50, 5 + i % 3).astype(np.int32), n)
+            for i, n in enumerate([4, 11, 6, 13, 3, 8])]
+    srv = BatchServer(model, params, draft_model=draft,
+                      draft_params=dparams, slots=2, max_len=24,
+                      temperature=0.0, gamma=3,
+                      steps_per_call=steps_per_call)
+    ids = [srv.submit(p, n) for p, n in reqs]
+    res = srv.run(pipeline=pipeline)
+    for rid, (p, n) in zip(ids, reqs):
+        np.testing.assert_array_equal(
+            np.asarray(res[rid]), _oracle(model, params, p, n))
+    assert srv.stats["spec_rounds"] > 0
+
+
+def test_spec_server_windowed_ring_matches_generate():
+    # Windowed target + draft: the server speculates on the ROLLING RING
+    # cache (gamma + 1 <= window) with per-round stash/restore, and the
+    # greedy outputs still match generate() exactly.
+    model, params = _setup(attn_window=8)
+    draft = _tiny(n_layers=1, attn_window=8)
+    dparams = draft.init(jax.random.PRNGKey(9),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(1)
+    reqs = [(rng.integers(0, 50, 6).astype(np.int32), n)
+            for n in [5, 12, 7]]
+    srv, ids, res = _spec_srv(model, params, draft, dparams, reqs,
+                              slots=2, max_len=24, temperature=0.0, gamma=3)
+    for rid, (p, n) in zip(ids, reqs):
+        np.testing.assert_array_equal(
+            np.asarray(res[rid]), _oracle(model, params, p, n))
+    # ring actually backs the server cache
+    assert all(leaf.shape[1] == 8 for leaf in jax.tree.leaves(srv._cache)
+               if leaf.ndim == 4)
+
+
+def test_spec_server_quant_self_draft_accepts_and_matches():
+    # int8 self-draft: acceptance should be HIGH (the draft agrees with
+    # its own fp source), so rounds commit multiple tokens — and outputs
+    # stay exactly generate()'s.
+    from tpunet.models import quantize_params
+
+    model, params = _setup()
+    qmodel = model.clone(weight_quant="int8")
+    qparams = quantize_params(params)
+    rng = np.random.default_rng(2)
+    reqs = [(rng.integers(0, 50, 6).astype(np.int32), 12) for _ in range(3)]
+    srv, ids, res = _spec_srv(model, params, qmodel, qparams, reqs,
+                              slots=2, max_len=24, temperature=0.0, gamma=4)
+    for rid, (p, n) in zip(ids, reqs):
+        np.testing.assert_array_equal(
+            np.asarray(res[rid]), _oracle(model, params, p, n))
+    tok_per_round = (srv.stats["spec_committed"]
+                     / max(srv.stats["spec_rounds"], 1))
+    assert tok_per_round > 2.0, srv.stats
+
+
+def test_spec_server_eos_cuts_mid_round():
+    model, params = _setup()
+    draft = _tiny(n_layers=1)
+    dparams = draft.init(jax.random.PRNGKey(9),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+    p = np.arange(2, 8).astype(np.int32)
+    ref = _oracle(model, params, p, 12)
+    eos = int(ref[4])  # force a mid-stream retirement
+    first = int(np.nonzero(np.asarray(ref) == eos)[0][0])
+    want = list(ref[:first + 1])  # cut at the FIRST occurrence
+    srv = BatchServer(model, params, slots=1, max_len=24, temperature=0.0,
+                      eos_id=eos, draft_model=draft, draft_params=dparams,
+                      gamma=3)
+    rid = srv.submit(p, 12)
+    res = srv.run()
+    assert list(res[rid]) == want
+
+
+def test_spec_server_sampled_runs_and_validates():
+    model, params = _setup()
+    draft = _tiny(n_layers=1)
+    dparams = draft.init(jax.random.PRNGKey(9),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = BatchServer(model, params, slots=2, max_len=20, temperature=0.8,
+                      top_k=8, draft_model=draft, draft_params=dparams,
+                      gamma=2)
+    ids = [srv.submit(np.arange(1, 7), 8) for _ in range(3)]
+    res = srv.run()
+    for rid in ids:
+        assert res[rid].shape == (8,)
+        assert ((res[rid] >= 0) & (res[rid] < model.vocab)).all()
+    with pytest.raises(ValueError, match="draft_model and draft_params"):
+        BatchServer(model, params, slots=1, max_len=8, draft_model=draft)
+    with pytest.raises(ValueError, match="vocab"):
+        BatchServer(model, params, slots=1, max_len=8,
+                    draft_model=_tiny(vocab=32), draft_params=dparams)

@@ -228,6 +228,137 @@ def _residual_probs(p, q):
     return jnp.where(z > 0, r / jnp.maximum(z, 1e-30), p)
 
 
+
+def _make_spec_round_core(tm, dm, params, draft_params, gamma: int,
+                          greedy: bool, probs_of, t_ring: bool,
+                          d_ring: bool):
+    """The DEVICE core of one speculative round — draft scan (gamma+1
+    steps), single-forward verify, accept/reject, fix/bonus token,
+    committed-block construction, ring stash/restore — shared by
+    `speculative_generate` and the speculative BatchServer so the
+    exactness machinery cannot fork. Callers supply the two
+    schedule-dependent pieces: `adjust_n(n_rows)` turns raw per-row
+    acceptance into the commit length (identity for pure per-row;
+    done-freeze + batch-min for lockstep), and `commit_index(n_eff)`
+    yields the post-round cache index (max_new clamps / capacity parks),
+    which the ring restore keys on. The caller sets the cache index and
+    derives the next input token from the (possibly eos-pinned) block.
+
+    Returns (t_cache, d_cache, w, n_rows, n_eff) with w (b, gamma+1):
+    each row's committed tokens are w[:n_eff+1]."""
+
+    def draft_step(carry, key):
+        d_cache, tok = carry
+        logits, mut = dm.apply(
+            {"params": draft_params, "cache": d_cache}, tok[:, None],
+            mutable=["cache"])
+        row = logits[:, -1, :]
+        if greedy:
+            nxt = jnp.argmax(row, axis=-1).astype(jnp.int32)
+            q = jax.nn.one_hot(nxt, row.shape[-1], dtype=jnp.float32)
+        else:
+            q = probs_of(row)
+            # where(q > 0, log q, -inf), not log(max(q, eps)): a top-k/p
+            # filtered-out token must have EXACTLY zero draw probability,
+            # or the scheme's support can leak outside generate()'s.
+            nxt = jax.random.categorical(
+                key, jnp.where(q > 0, jnp.log(q), -jnp.inf), axis=-1
+            ).astype(jnp.int32)
+        return (mut["cache"], nxt), (nxt, q)
+
+    def round_core(t_cache, d_cache, last_tok, idx0, k_draft, k_accept,
+                   k_fix, adjust_n, commit_index):
+        b = last_tok.shape[0]
+        rows_i = jnp.arange(b)
+        # Both caches sit at idx0 (the round-boundary invariant); ring
+        # mode stashes the slots this round overwrites.
+        d_stash = (_spec_ring_stash(d_cache, idx0, gamma + 1)
+                   if d_ring else None)
+        t_stash = (_spec_ring_stash(t_cache, idx0, gamma + 1)
+                   if t_ring else None)
+
+        # 1. Draft gamma tokens (small model, sequential scan) — plus ONE
+        # extra step whose sampled token is discarded: it exists to feed
+        # d_gamma back through the draft so its K/V lands in the draft
+        # cache. Without it, a fully-accepted round (n == gamma) leaves
+        # the committed frontier's last token MISSING from the draft
+        # cache (the draft never consumed its own final sample), and
+        # every later round drafts against a zero K/V slot — silently
+        # wrong q, collapsing the acceptance rate.
+        (d_cache, _), (d_toks, q_probs) = jax.lax.scan(
+            draft_step, (d_cache, last_tok),
+            jax.random.split(k_draft, gamma + 1))
+        d_toks = d_toks.swapaxes(0, 1)[:, :gamma]       # (b, gamma)
+        q_probs = q_probs.swapaxes(0, 1)[:, :gamma]     # (b, gamma, V)
+
+        # 2. Verify: ONE target forward over [last, d_1..d_gamma] — row j
+        # scores draft position j, row gamma is the bonus distribution.
+        block = jnp.concatenate([last_tok[:, None], d_toks], axis=1)
+        t_logits, mut = tm.apply(
+            {"params": params, "cache": t_cache}, block, mutable=["cache"])
+        t_cache = mut["cache"]
+
+        # 3. Accept/reject each draft position against the target.
+        p_probs = None
+        if greedy:
+            t_argmax = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+            accept = d_toks == t_argmax[:, :gamma]
+        else:
+            p_probs = probs_of(
+                t_logits.reshape(b * (gamma + 1), -1)
+            ).reshape(b, gamma + 1, -1)
+            rows = rows_i[:, None]
+            cols = jnp.arange(gamma)[None, :]
+            p_tok = p_probs[rows, cols, d_toks]
+            q_tok = q_probs[rows, cols, d_toks]
+            u = jax.random.uniform(k_accept, (b, gamma))
+            accept = u * q_tok < p_tok
+        n_rows = _leading_accepts(accept)
+        n_eff = adjust_n(n_rows)
+
+        # 4. The (n_eff+1)-th token of the round, per row: its own
+        # accepted draft token when its rejection came later (lockstep
+        # only — the coin already accepted position n_eff), else the
+        # residual sample at its own rejection point (exactness partner
+        # of the rejection), else — whole block accepted — a bonus
+        # sample from the target's row gamma.
+        if greedy:
+            fix_tok = t_argmax[rows_i, n_eff]
+        else:
+            p_n = p_probs[rows_i, n_eff, :]
+            q_n = q_probs[
+                rows_i, jnp.minimum(n_eff, gamma - 1), :]  # row gamma: unused
+            res = _residual_probs(p_n, q_n)
+            bonus_or_res = jnp.where((n_eff >= gamma)[:, None], p_n, res)
+            fix_tok = jax.random.categorical(
+                k_fix,
+                jnp.where(bonus_or_res > 0, jnp.log(bonus_or_res),
+                          -jnp.inf), axis=-1
+            ).astype(jnp.int32)
+        keep_own = (n_rows > n_eff) & (n_eff < gamma)
+        e_tok = jnp.where(keep_own,
+                          d_toks[rows_i, jnp.minimum(n_eff, gamma - 1)],
+                          fix_tok).astype(jnp.int32)
+
+        # 5. The committed block (static width; entries past n_eff are
+        # junk the caller discards or overwrites).
+        w = jnp.concatenate([d_toks, e_tok[:, None]], axis=1)
+        offs = jnp.arange(gamma + 1)[None, :]
+        w = jnp.where(offs == n_eff[:, None], e_tok[:, None], w)
+
+        # 6. Ring rollback keyed on the caller's committed index.
+        new_idx = commit_index(n_eff)
+        if t_ring:
+            t_cache = _spec_ring_restore(t_cache, t_stash, idx0, new_idx,
+                                         gamma + 1)
+        if d_ring:
+            d_cache = _spec_ring_restore(d_cache, d_stash, idx0, new_idx,
+                                         gamma + 1)
+        return t_cache, d_cache, w, n_rows, n_eff
+
+    return round_core
+
+
 def speculative_generate(
     model,
     params,
@@ -302,12 +433,8 @@ def speculative_generate(
     # would lap the ring and the stash would hold duplicate slots);
     # narrower windows fall back to the full-capacity masked cache, where
     # rollback is just the index rewrite.
-    def _ring_ok(m):
-        return (m.attn_window is not None
-                and getattr(m, "decode_ring_cache", True)
-                and gamma + 1 <= m.attn_window)
-
-    t_ring, d_ring = _ring_ok(model), _ring_ok(draft_model)
+    t_ring = _spec_ring_ok(model, gamma)
+    d_ring = _spec_ring_ok(draft_model, gamma)
     tm = model.clone(decode=True, per_row_cache=per_row,
                      decode_ring_cache=t_ring)
     dm = draft_model.clone(decode=True, per_row_cache=per_row,
@@ -338,122 +465,48 @@ def speculative_generate(
     out0 = jax.lax.dynamic_update_slice(out0, prompt.astype(jnp.int32), (0, 0))
     out0 = out0.at[:, p].set(tok0)
 
-    def draft_step(carry, key):
-        d_cache, tok = carry
-        logits, mut = dm.apply(
-            {"params": draft_params, "cache": d_cache}, tok[:, None],
-            mutable=["cache"])
-        row = logits[:, -1, :]
-        if greedy:
-            nxt = jnp.argmax(row, axis=-1).astype(jnp.int32)
-            q = jax.nn.one_hot(nxt, row.shape[-1], dtype=jnp.float32)
-        else:
-            q = probs_of(row)
-            # where(q > 0, log q, -inf), not log(max(q, eps)): a top-k/p
-            # filtered-out token must have EXACTLY zero draw probability,
-            # or the scheme's support can leak outside generate()'s.
-            nxt = jax.random.categorical(
-                key, jnp.where(q > 0, jnp.log(q), -jnp.inf), axis=-1
-            ).astype(jnp.int32)
-        return (mut["cache"], nxt), (nxt, q)
-
     rows_i = jnp.arange(b)
+    round_core = _make_spec_round_core(tm, dm, params, draft_params, gamma,
+                                       greedy, probs_of, t_ring, d_ring)
 
     def round_body(state):
         out, n_out, t_cache, d_cache, done, rng, rounds, acc_sum, prop_sum = state
         L_rows = p + n_out            # (b,) committed tokens per row
         last_tok = out[rows_i, L_rows - 1]
         rng, k_draft, k_accept, k_fix = jax.random.split(rng, 4)
-        # Both caches sit at idx0 = L_rows - 1 (the round-boundary
-        # invariant); ring mode stashes the slots this round overwrites.
-        idx0 = L_rows - 1
-        d_stash = (_spec_ring_stash(d_cache, idx0, gamma + 1)
-                   if d_ring else None)
-        t_stash = (_spec_ring_stash(t_cache, idx0, gamma + 1)
-                   if t_ring else None)
+        idx0 = L_rows - 1  # the round-boundary invariant
 
-        # 1. Draft gamma tokens (small model, sequential scan) — plus ONE
-        # extra step whose sampled token is discarded: it exists to feed
-        # d_gamma back through the draft so its K/V lands in the draft
-        # cache. Without it, a fully-accepted round (n == gamma) leaves
-        # the committed frontier's last token MISSING from the draft cache
-        # (the draft never consumed its own final sample), and every
-        # later round drafts against a zero K/V slot — silently wrong
-        # q, collapsing the acceptance rate.
-        (d_cache, _), (d_toks, q_probs) = jax.lax.scan(
-            draft_step, (d_cache, last_tok),
-            jax.random.split(k_draft, gamma + 1))
-        d_toks = d_toks.swapaxes(0, 1)[:, :gamma]       # (b, gamma)
-        q_probs = q_probs.swapaxes(0, 1)[:, :gamma]     # (b, gamma, V)
+        def adjust_n(n_raw):
+            # A finished row must not hold the batch back (its output is
+            # pinned to eos regardless of what its branch computes); the
+            # round's effective commit length is each row's OWN acceptance
+            # in per_row mode, the batch min under a shared scalar cache
+            # index (one frontier forces one commit length).
+            frozen = jnp.where(done, gamma, n_raw)
+            return frozen if per_row else jnp.broadcast_to(
+                jnp.min(frozen), (b,))
 
-        # 2. Verify: ONE target forward over [last, d_1..d_gamma] — row j
-        # scores draft position j, row gamma is the bonus distribution.
-        block = jnp.concatenate([last_tok[:, None], d_toks], axis=1)
-        t_logits, mut = tm.apply(
-            {"params": params, "cache": t_cache}, block, mutable=["cache"])
-        t_cache = mut["cache"]
+        def commit_index(n_eff):
+            # Clamped at the schedule — a finished row's frontier
+            # freezes, bounding its garbage tail.
+            return p + jnp.minimum(n_out + n_eff + 1, max_new_tokens) - 1
 
-        # 3. Accept/reject each draft position against the target.
-        if greedy:
-            t_argmax = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
-            accept = d_toks == t_argmax[:, :gamma]
-        else:
-            p_probs = probs_of(
-                t_logits.reshape(b * (gamma + 1), -1)
-            ).reshape(b, gamma + 1, -1)
-            rows = jnp.arange(b)[:, None]
-            cols = jnp.arange(gamma)[None, :]
-            p_tok = p_probs[rows, cols, d_toks]
-            q_tok = q_probs[rows, cols, d_toks]
-            u = jax.random.uniform(k_accept, (b, gamma))
-            accept = u * q_tok < p_tok
-        n_rows = _leading_accepts(accept)         # (b,)
-        # Diagnostic accounting BEFORE the done/frozen forcing below: only
-        # rows still doing real work count, or eos-finished and
-        # schedule-frozen rows (forced to gamma / drafting garbage) would
-        # inflate the reported acceptance toward 1.0.
+        t_cache, d_cache, w, n_rows, n_eff = round_core(
+            t_cache, d_cache, last_tok, idx0, k_draft, k_accept, k_fix,
+            adjust_n, commit_index)
+        # Diagnostic accounting on the RAW acceptance: only rows still
+        # doing real work count, or eos-finished and schedule-frozen rows
+        # (forced to gamma / drafting garbage) would inflate the reported
+        # acceptance toward 1.0.
         active = (n_out < max_new_tokens) & ~done
         acc_sum = acc_sum + jnp.sum(jnp.where(active, n_rows, 0))
         prop_sum = prop_sum + gamma * jnp.sum(active)
-        # A finished row must not hold the batch back (its output is
-        # pinned to eos regardless of what its branch computes).
-        n_rows = jnp.where(done, gamma, n_rows)
-        # The round's effective accepted-prefix length per row: its OWN
-        # acceptance in per_row mode; the batch min under a shared scalar
-        # cache index (one frontier forces one commit length).
-        n_eff = n_rows if per_row else jnp.broadcast_to(
-            jnp.min(n_rows), (b,))
 
-        # 4. The (n_eff+1)-th token of the round, per row: its own
-        # accepted draft token when its rejection came later (lockstep
-        # only — the coin already accepted position n_eff), else the
-        # residual sample at its own rejection point (exactness partner of
-        # the rejection), else — whole block accepted — a bonus sample
-        # from the target's row gamma.
-        if greedy:
-            fix_tok = t_argmax[rows_i, n_eff]
-        else:
-            p_n = p_probs[rows_i, n_eff, :]
-            q_n = q_probs[
-                rows_i, jnp.minimum(n_eff, gamma - 1), :]  # row gamma: unused
-            res = _residual_probs(p_n, q_n)
-            bonus_or_res = jnp.where((n_eff >= gamma)[:, None], p_n, res)
-            fix_tok = jax.random.categorical(
-                k_fix,
-                jnp.where(bonus_or_res > 0, jnp.log(bonus_or_res),
-                          -jnp.inf), axis=-1
-            ).astype(jnp.int32)
-        keep_own = (n_rows > n_eff) & (n_eff < gamma)
-        e_tok = jnp.where(keep_own,
-                          d_toks[rows_i, jnp.minimum(n_eff, gamma - 1)],
-                          fix_tok).astype(jnp.int32)
 
-        # 5. Commit the block into `out` (static-width write; entries past
-        # n_eff+1 are junk the next round — or the final slice —
+        # Commit the core's block into `out` (static-width write; entries
+        # past n_eff+1 are junk the next round — or the final slice —
         # overwrites/drops), with eos pinning threaded through it.
-        w = jnp.concatenate([d_toks, e_tok[:, None]], axis=1)  # (b, gamma+1)
         offs = jnp.arange(gamma + 1)[None, :]
-        w = jnp.where(offs == n_eff[:, None], e_tok[:, None], w)
         if eos_id is not None:
             seen = done
             cols_list = []
@@ -470,21 +523,14 @@ def speculative_generate(
         out = out.at[rows_i[:, None], L_rows[:, None] + offs].set(
             w, mode="drop")
 
-        # 6. Advance each row (clamped at the schedule — a finished row's
-        # frontier freezes, bounding its garbage tail) and roll both
-        # caches to the committed frontier: correct K/V exists for
+        # Advance each row and roll both caches to the committed
+        # frontier (ring restores already happened inside the core,
+        # keyed on the same commit_index): correct K/V exists for
         # [0, commit_len - 1); the last committed token enters the caches
         # as the next round's first input. Stale tail entries are masked
         # and later overwritten.
         n_out_new = jnp.minimum(n_out + n_eff + 1, max_new_tokens)
-        new_idx = p + n_out_new - 1
-        if t_ring:
-            t_cache = _spec_ring_restore(t_cache, t_stash, idx0, new_idx,
-                                         gamma + 1)
-        if d_ring:
-            d_cache = _spec_ring_restore(d_cache, d_stash, idx0, new_idx,
-                                         gamma + 1)
-        cidx = new_idx
+        cidx = p + n_out_new - 1
         if not per_row:
             cidx = cidx[0]  # scalar-cache models need a scalar index
         t_cache = _set_cache_index(t_cache, cidx)
@@ -519,6 +565,26 @@ def _map_cache_index(cache, fn):
         return fn(leaf) if name == "cache_index" else leaf
 
     return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+def _spec_ring_ok(m, gamma: int) -> bool:
+    """True when speculative rounds of this gamma can run on the model's
+    rolling ring cache: a round writes gamma + 1 positions, which must not
+    lap the ring (duplicate slots in the stash scatter). Shared by
+    speculative_generate and the speculative BatchServer."""
+    return (m.attn_window is not None
+            and getattr(m, "decode_ring_cache", True)
+            and gamma + 1 <= m.attn_window)
+
+
+def _get_cache_index(cache):
+    """The current cache_index value (first such leaf — every layer
+    carries the same one)."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(cache)[0]:
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name == "cache_index":
+            return leaf
+    raise ValueError("cache has no cache_index leaf")
 
 
 def _spec_ring_stash(cache, idx0, span):

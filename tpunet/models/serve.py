@@ -20,6 +20,15 @@ the stale K/V above the new sequence's frontier is masked until
 overwritten (`key_pos <= q_pos`, the same argument that makes
 speculative rollback sound).
 
+Speculative mode (`draft_model=`): each decode window becomes
+`steps_per_call` SPECULATIVE ROUNDS — draft gamma tokens per slot, verify
+in one target forward, commit each row's own accepted prefix plus the
+fix/bonus token (same exactness machinery as `speculative_generate`:
+shared filtered distribution, residual sampling, ring stash/restore).
+A dispatch then commits up to gamma+1 tokens per row instead of one;
+greedy outputs are bitwise `generate()`'s. The draft cache rides the same
+slot lifecycle (row surgery prefills both).
+
 The reference repo has no inference path at all (it is a transport;
 SURVEY §2.3); this is framework capability above it.
 """
@@ -34,8 +43,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpunet.models.generate import (_map_cache_index, _prefill,
-                                    _set_cache_index, _validate_sampling,
+from tpunet.models.generate import (_get_cache_index, _make_spec_round_core,
+                                    _map_cache_index, _prefill,
+                                    _set_cache_index, _spec_ring_ok,
+                                    _validate_sampling, filtered_logits,
                                     init_cache, make_sampler)
 
 
@@ -58,8 +69,9 @@ class BatchServer:
 
     submit() enqueues a request; slots are assigned at the next
     step()/run() boundary, so a burst of submissions prefills as one
-    batched dispatch. step() advances every live slot one token and
-    returns the requests that finished. Greedy by default;
+    batched dispatch. step() advances every live slot one token (or one
+    speculative ROUND of up to gamma+1 tokens when a draft_model is
+    given) and returns the requests that finished. Greedy by default;
     temperature/top-k/top-p sample per-row from the device-carried key
     chain.
     """
@@ -68,8 +80,19 @@ class BatchServer:
                  temperature: float = 0.0, top_k: int | None = None,
                  top_p: float | None = None, eos_id: int | None = None,
                  rng=None, prefill_chunk: int | None = None,
-                 steps_per_call: int = 1, refill_coalesce: int = 1):
+                 steps_per_call: int = 1, refill_coalesce: int = 1,
+                 draft_model=None, draft_params=None, gamma: int = 4):
         _validate_sampling(temperature, top_k, top_p)
+        if (draft_model is None) != (draft_params is None):
+            raise ValueError("draft_model and draft_params come together")
+        if draft_model is not None and gamma < 1:
+            raise ValueError(f"gamma must be >= 1, got {gamma}")
+        if draft_model is not None and getattr(draft_model, "n_experts", 0):
+            raise ValueError("draft_model must be dense (same MoE "
+                             "batch-coupling argument as the target)")
+        if (draft_model is not None
+                and draft_model.vocab != model.vocab):
+            raise ValueError("draft vocab must match the target")
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if steps_per_call < 1:
@@ -104,8 +127,24 @@ class BatchServer:
         self.eos_id = eos_id
         self._sampling = (temperature, top_k, top_p)
         self._prefill_chunk = prefill_chunk
-        self._dm = model.clone(decode=True, per_row_cache=True)
-        self._cache = init_cache(self._dm, slots, max_len)
+        self._dm = model.clone(
+            decode=True, per_row_cache=True,
+            decode_ring_cache=(_spec_ring_ok(model, gamma)
+                               if draft_model is not None
+                               else getattr(model, "decode_ring_cache",
+                                            True)))
+        # Speculative rounds overshoot the committed frontier by up to
+        # gamma: the verify block must never cross the cache capacity for
+        # a LIVE row, so spec mode adds gamma + 1 slack rows of K/V (the
+        # submit() contract stays p + max_new <= max_len).
+        cache_cap = max_len + (gamma + 1 if draft_model is not None else 0)
+        self._cache = init_cache(self._dm, slots, cache_cap)
+        self._draft = draft_model
+        if draft_model is not None:
+            self._dm_draft = draft_model.clone(
+                decode=True, per_row_cache=True,
+                decode_ring_cache=_spec_ring_ok(draft_model, gamma))
+            self._dcache = init_cache(self._dm_draft, slots, cache_cap)
         self._free = list(range(slots))
         self._live: dict[int, dict] = {}       # slot -> request record
         self._pending: list[dict] = []
@@ -178,6 +217,89 @@ class BatchServer:
             toks = toks.at[rows].set(tok)
             return cache, toks, tok, key
 
+        if draft_model is not None:
+            greedy = temperature == 0.0
+            t_ring = _spec_ring_ok(model, gamma)
+            d_ring = _spec_ring_ok(draft_model, gamma)
+            draft_params_c = draft_params
+            rows_i = jnp.arange(slots)
+            spec_cap = max_len_cap + gamma + 1
+
+            def probs_of(logits):
+                return jax.nn.softmax(
+                    filtered_logits(logits, temperature, top_k, top_p),
+                    axis=-1)
+
+            round_core = _make_spec_round_core(
+                self._dm, self._dm_draft, params_c, draft_params_c, gamma,
+                greedy, probs_of, t_ring, d_ring)
+
+            def spec_round(carry, key):
+                # One speculative round over every slot (live or garbage):
+                # draft gamma, verify in ONE target forward, commit each
+                # row's own accepted prefix + fix/bonus token. The
+                # exactness machinery is THE SHARED CORE
+                # (_make_spec_round_core) speculative_generate uses — the
+                # server only owns the schedule: per-row commits
+                # (adjust_n identity), capacity parking, and the
+                # host-side eos/max_new cutting in _append_tokens
+                # (garbage rows are discarded by the occupancy snapshot).
+                t_cache, d_cache, tok = carry
+                k_draft, k_accept, k_fix = jax.random.split(key, 3)
+                idx0 = _get_cache_index(t_cache)  # (slots,) round frontier
+
+                t_cache, d_cache, w, _, n_eff = round_core(
+                    t_cache, d_cache, tok, idx0, k_draft, k_accept, k_fix,
+                    lambda n_raw: n_raw,          # pure per-row commits
+                    lambda n_eff: idx0 + n_eff + 1)
+                counts = n_eff + 1
+                # Idle rows' frontiers park at the cap (same clamp
+                # rationale as the plain path; spec_cap includes the
+                # overshoot slack so live rows never clamp).
+                new_idx = jnp.minimum(idx0 + counts, spec_cap)
+                t_cache = _set_cache_index(t_cache, new_idx)
+                d_cache = _set_cache_index(d_cache, new_idx)
+                tok_next = w[rows_i, n_eff]
+                return (t_cache, d_cache, tok_next), (w, counts)
+
+            @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+            def spec_decode_step(t_cache, d_cache, toks, key):
+                key, sub = jax.random.split(key)
+                (t_cache, d_cache, toks), (w, counts) = jax.lax.scan(
+                    spec_round, (t_cache, d_cache, toks),
+                    jax.random.split(sub, steps_per_call))
+                # (slots, rounds, gamma+1) committed blocks + per-round
+                # per-row commit counts.
+                return (t_cache, d_cache, toks, w.swapaxes(0, 1),
+                        counts.swapaxes(0, 1), key)
+
+            @partial(jax.jit, donate_argnums=(0, 1, 2),
+                     static_argnames=("chunk",))
+            def spec_prefill_slots(t_cache, d_cache, toks, prompts, rows,
+                                   key, chunk):
+                # Same row surgery as the plain path, on BOTH caches: the
+                # draft must hold the prompt K/V before it can propose.
+                key, sub = jax.random.split(key)
+                row = jax.tree.map(lambda a: a[rows], t_cache)
+                row = _set_cache_index(row, 0)
+                row, last = _prefill(self._dm, params_c, row, prompts,
+                                     chunk)
+                t_cache = jax.tree.map(
+                    lambda a, rw: a.at[rows].set(rw), t_cache, row)
+                drow = jax.tree.map(lambda a: a[rows], d_cache)
+                drow = _set_cache_index(drow, 0)
+                drow, _ = _prefill(self._dm_draft, draft_params_c, drow,
+                                   prompts, chunk)
+                d_cache = jax.tree.map(
+                    lambda a, rw: a.at[rows].set(rw), d_cache, drow)
+                tok = sample(last, sub)  # (n,)
+                toks = toks.at[rows].set(tok)
+                return t_cache, d_cache, toks, tok, key
+
+            self._spec_decode_step = spec_decode_step
+            self._spec_prefill_slots = spec_prefill_slots
+            self.stats["spec_rounds"] = 0
+            self.stats["spec_committed"] = 0
         self._decode_step = decode_step
         self._prefill_slots = prefill_slots
 
@@ -230,9 +352,16 @@ class BatchServer:
             prompts = (reqs[0]["prompt_dev"] if len(reqs) == 1
                        else jnp.concatenate(
                            [q["prompt_dev"] for q in reqs], axis=0))
-            self._cache, self._toks, tok, self._key = self._prefill_slots(
-                self._cache, self._toks, prompts, rows,
-                self._key, self._prefill_chunk)
+            if self._draft is not None:
+                (self._cache, self._dcache, self._toks, tok,
+                 self._key) = self._spec_prefill_slots(
+                    self._cache, self._dcache, self._toks, prompts, rows,
+                    self._key, self._prefill_chunk)
+            else:
+                (self._cache, self._toks, tok,
+                 self._key) = self._prefill_slots(
+                    self._cache, self._toks, prompts, rows,
+                    self._key, self._prefill_chunk)
             self.stats["prefills"] += len(group)
             if defer:
                 # Pipelined mode: don't sync on the prefill's sampled
@@ -276,16 +405,28 @@ class BatchServer:
 
     def _dispatch_window(self):
         """Issue one decode window WITHOUT reading it back; returns the
-        device window plus a {slot: request_id} snapshot of occupancy at
+        device payload plus a {slot: request_id} snapshot of occupancy at
         dispatch time (a later refill recycles the slot for a different
-        request — that window's tokens for the slot are garbage)."""
+        request — that window's tokens for the slot are garbage). Payload:
+        plain mode (window, None); speculative mode (w, counts) with w
+        (slots, rounds, gamma+1) and per-round per-row commit counts."""
+        if self._draft is not None:
+            (self._cache, self._dcache, self._toks, w, counts,
+             self._key) = self._spec_decode_step(
+                self._cache, self._dcache, self._toks, self._key)
+            self.stats["decode_windows"] += 1
+            return (w, counts), {r: req["id"]
+                                 for r, req in self._live.items()}
         self._cache, self._toks, window, self._key = self._decode_step(
             self._cache, self._toks, self._key)
         self.stats["decode_windows"] += 1
-        return window, {r: req["id"] for r, req in self._live.items()}
+        return (window, None), {r: req["id"]
+                                for r, req in self._live.items()}
 
-    def _absorb_window(self, window, ids_at_dispatch) -> None:
-        window = np.asarray(window)  # (slots, steps_per_call) readback
+    def _absorb_window(self, payload, ids_at_dispatch) -> None:
+        window, counts = payload
+        window = np.asarray(window)  # readback
+        counts = None if counts is None else np.asarray(counts)
         for r, rid in ids_at_dispatch.items():
             req = self._live.get(r)
             if req is None or req["id"] != rid:
@@ -300,7 +441,16 @@ class BatchServer:
                 self._append_tokens(r, req, holder["np"][i: i + 1])
                 if r not in self._live:
                     continue
-            self._append_tokens(r, req, window[r])
+            if counts is None:
+                self._append_tokens(r, req, window[r])
+                continue
+            for j in range(window.shape[1]):  # speculative rounds
+                c = int(counts[r, j])
+                self.stats["spec_rounds"] += 1
+                self.stats["spec_committed"] += c
+                self._append_tokens(r, req, window[r, j, :c])
+                if r not in self._live:
+                    break  # rest of this row's rounds are garbage
 
     def step(self) -> list[dict]:
         """Advance every live slot one token; returns the requests that
