@@ -68,6 +68,13 @@ def main(argv=None) -> None:
                          "for single-core hosts (compute and host "
                          "serialize anyway), 2 on real accelerators so "
                          "host bookkeeping hides under device compute")
+    ap.add_argument("--spec-gamma", type=int, default=None,
+                    help="serve with speculative decoding: int8 SELF-draft "
+                         "at this gamma (the BatchServer draft_model "
+                         "path). The lockstep baseline stays plain "
+                         "generate(), so vs_lockstep prices the whole "
+                         "speculative pipeline; tok/round lands in the "
+                         "JSON")
     ap.add_argument("--reps", type=int, default=7,
                     help="paired interleaved measurement passes "
                          "(serve/lockstep alternating); report medians + "
@@ -102,9 +109,16 @@ def main(argv=None) -> None:
     # Warm THE SERVER'S OWN jits (they are per-instance closures: a
     # throwaway warm server would leave the timed one cold): one prefill
     # trace — all prompts share a length — plus the decode window.
+    spec_kw = {}
+    if args.spec_gamma is not None:
+        from tpunet.models import quantize_params
+
+        spec_kw = dict(draft_model=model.clone(weight_quant="int8"),
+                       draft_params=quantize_params(params),
+                       gamma=args.spec_gamma)
     srv = BatchServer(model, params, slots=args.slots, max_len=max_len,
                       steps_per_call=args.steps_per_call,
-                      refill_coalesce=args.refill_coalesce)
+                      refill_coalesce=args.refill_coalesce, **spec_kw)
     srv.submit(prompts[0], 2)
     srv.run()
     # Warm EVERY batched refill trace (n, p) for n in 1..slots — the
@@ -116,8 +130,15 @@ def main(argv=None) -> None:
     for n in range(1, args.slots + 1):
         warm_prompts = jnp.tile(jnp.asarray(prompts[0][None]), (n, 1))
         warm_rows = jnp.asarray(np.arange(n, dtype=np.int32))
-        srv._cache, srv._toks, _, srv._key = srv._prefill_slots(
-            srv._cache, srv._toks, warm_prompts, warm_rows, srv._key, None)
+        if args.spec_gamma is not None:
+            (srv._cache, srv._dcache, srv._toks, _,
+             srv._key) = srv._spec_prefill_slots(
+                srv._cache, srv._dcache, srv._toks, warm_prompts,
+                warm_rows, srv._key, None)
+        else:
+            srv._cache, srv._toks, _, srv._key = srv._prefill_slots(
+                srv._cache, srv._toks, warm_prompts, warm_rows, srv._key,
+                None)
 
     def serve_pass():
         t0 = time.perf_counter()
@@ -168,6 +189,11 @@ def main(argv=None) -> None:
         "new_max": args.new_max, "steps_per_call": args.steps_per_call,
         "refill_coalesce": args.refill_coalesce,
         "pipeline": args.pipeline,
+        **({"spec_gamma": args.spec_gamma,
+            "spec_tok_per_round": round(
+                srv.stats["spec_committed"]
+                / max(srv.stats["spec_rounds"], 1), 3)}
+           if args.spec_gamma is not None else {}),
         "useful_tokens": total_tokens,
         "reps": args.reps,
         "serve_wall_s": round(serve_s, 3),
