@@ -30,6 +30,15 @@ extern "C" {
 #define TPUNET_ERR_NULL -1
 #define TPUNET_ERR_INVALID -2
 #define TPUNET_ERR_INNER -3
+/* Failure-model codes (docs/DESIGN.md "Failure model"): */
+/* per-chunk CRC32C mismatch (TPUNET_CRC=1) — the request failed but the
+ * comm is still usable (not a disconnect). */
+#define TPUNET_ERR_CORRUPT -4
+/* progress watchdog (TPUNET_PROGRESS_TIMEOUT_MS): zero bytes moved for a
+ * full window — treat the peer as stuck (same recovery as dead). */
+#define TPUNET_ERR_TIMEOUT -5
+/* peer speaks a different tpunet wire-framing version. */
+#define TPUNET_ERR_VERSION -6
 
 /* 64-byte opaque rendezvous blob: the serialized listen sockaddr, sized to
  * NCCL's handle budget (reference: cc/nccl_types.h:44). Ship it to the
@@ -83,6 +92,20 @@ int32_t tpunet_c_close_listen(uintptr_t instance, uintptr_t listen_comm);
 
 /* Thread-local message for the last TPUNET_ERR_* returned on this thread. */
 const char* tpunet_c_last_error(void);
+
+/* ---- Chaos / integrity tooling ----------------------------------------
+ * Deterministic fault injection (src/fault.h): parse `spec` (e.g.
+ * "stream=1:after_bytes=1M:action=close") and arm it process-wide for every
+ * engine's send/recv hot path. One fault at a time; re-arming replaces and
+ * resets the byte counters. NULL or "" clears. Returns TPUNET_ERR_INVALID
+ * (with tpunet_c_last_error() naming the bad token) on a malformed spec.
+ * TPUNET_FAULT_SPEC arms the same slot at engine creation. */
+int32_t tpunet_c_fault_inject(const char* spec);
+int32_t tpunet_c_fault_clear(void);
+/* CRC32C (Castagnoli) of `data`, seeded with `seed` (0 = fresh; chain for
+ * discontiguous buffers). Exposed for golden-vector tests and so Python
+ * tooling can pre-verify payloads against the wire trailers. */
+uint32_t tpunet_c_crc32c(const void* data, uint64_t nbytes, uint32_t seed);
 
 /* ---- Collectives (ring communicator over the transport) ----------------
  * The layer NCCL provided above the reference plugin (SURVEY §2.3); here it

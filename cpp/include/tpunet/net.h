@@ -23,13 +23,25 @@ namespace tpunet {
 
 // Error taxonomy mirrors reference interface.rs:3-11 {IOError, TCPError,
 // InnerError}, plus kInvalidArgument so programmer errors (stale/unknown ids,
-// bad device index) are distinguishable from transport failures at the ABI.
+// bad device index) are distinguishable from transport failures at the ABI,
+// plus the failure-model kinds (docs/DESIGN.md "Failure model"):
+//   kCorruption — a per-chunk CRC32C mismatch (TPUNET_CRC=1): the payload is
+//     wrong but the stream framing is intact, so the REQUEST fails while the
+//     comm stays usable (not a disconnect).
+//   kTimeout — the progress watchdog (TPUNET_PROGRESS_TIMEOUT_MS) saw a
+//     request move zero bytes for a full window: a live-but-stuck peer,
+//     classified upstream like a dead one (elastic rebuild).
+//   kVersion — the peer speaks a different tpunet wire framing version
+//     (preamble magic prefix matched, version byte did not).
 enum class ErrorKind : int32_t {
   kOk = 0,
   kIOError = 1,
   kTCPError = 2,
   kInnerError = 3,
   kInvalidArgument = 4,
+  kCorruption = 5,
+  kTimeout = 6,
+  kVersion = 7,
 };
 
 struct Status {
@@ -42,6 +54,9 @@ struct Status {
   static Status TCP(std::string m) { return Status{ErrorKind::kTCPError, std::move(m)}; }
   static Status Inner(std::string m) { return Status{ErrorKind::kInnerError, std::move(m)}; }
   static Status Invalid(std::string m) { return Status{ErrorKind::kInvalidArgument, std::move(m)}; }
+  static Status Corruption(std::string m) { return Status{ErrorKind::kCorruption, std::move(m)}; }
+  static Status Timeout(std::string m) { return Status{ErrorKind::kTimeout, std::move(m)}; }
+  static Status Version(std::string m) { return Status{ErrorKind::kVersion, std::move(m)}; }
 };
 
 // Reference: interface.rs:13-22 NCCLNetProperties.

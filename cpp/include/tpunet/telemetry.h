@@ -38,6 +38,10 @@ constexpr int kHistBuckets = 5;
 // default nstreams is 2-8, so 32 covers every sane config).
 constexpr int kMaxStreamStats = 32;
 
+// Fault-injection action slots for tpunet_faults_injected_total (indices
+// match FaultAction in src/fault.h; 0 is unused).
+constexpr int kFaultActionSlots = 5;
+
 struct MetricsSnapshot {
   uint64_t isend_count = 0;
   uint64_t irecv_count = 0;
@@ -47,6 +51,12 @@ struct MetricsSnapshot {
   uint64_t irecv_hist[kHistBuckets] = {0};
   uint64_t inflight = 0;        // requests posted but not yet test()ed done
   uint64_t failed_requests = 0;
+  // Failure-containment counters (docs/DESIGN.md "Failure model"):
+  // injected faults by action, data-stream failovers survived, and CRC32C
+  // chunk mismatches detected.
+  uint64_t faults_injected[kFaultActionSlots] = {0};
+  uint64_t stream_failovers = 0;
+  uint64_t crc_errors = 0;
   // Bytes moved per data-stream index, all comms aggregated — the observable
   // form of the rotating-cursor fairness property (the reference exposed
   // per-stream effective-time observers instead, nthread:343-348).
@@ -67,6 +77,10 @@ class Telemetry {
   // Engine hot-path hook: `nbytes` moved on data-stream `stream_idx`
   // (relaxed atomic add; indices >= kMaxStreamStats clamp to the last slot).
   void OnStreamBytes(bool is_send, uint64_t stream_idx, uint64_t nbytes);
+  // Failure-containment hooks (cold paths). `action` indexes FaultAction.
+  void OnFaultInjected(int action);
+  void OnStreamFailover();
+  void OnCrcError();
 
   MetricsSnapshot Snapshot() const;
   // Prometheus text exposition of the snapshot (also what the push thread

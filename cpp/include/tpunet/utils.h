@@ -59,6 +59,14 @@ Status ReadExact(int fd, void* buf, size_t n, bool spin = false);
 // timeout. Returns IOError on timeout or EOF.
 Status ReadExactDeadline(int fd, void* buf, size_t n, int timeout_ms);
 
+// CRC32C (Castagnoli, the iSCSI/ext4 polynomial) over `n` bytes, seeded with
+// `crc` (0 for a fresh checksum; chain calls to checksum discontiguous
+// buffers). Hardware-accelerated via SSE4.2 when the CPU has it, slicing-by-8
+// software fallback otherwise. Golden vector: crc32c("123456789") ==
+// 0xE3069283 (RFC 3720 B.4). Used for the per-chunk wire-integrity trailer
+// (TPUNET_CRC=1) on data streams.
+uint32_t Crc32c(const void* data, size_t n, uint32_t crc = 0);
+
 // "user:pass@host:port" -> (user, pass, addr); user/pass empty when absent
 // (reference: utils.rs:180-198).
 struct UserPassAddr {

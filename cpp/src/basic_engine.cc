@@ -31,11 +31,14 @@
 //     sharded maps, test() touches only atomics.
 //   * Request ids are freed on completion (reference leaked them:
 //     cc/bagua_net.cc:111-121).
+#include <errno.h>
+#include <poll.h>
 #include <string.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <deque>
 #include <memory>
@@ -44,6 +47,7 @@
 #include <vector>
 
 #include "engine_base.h"
+#include "fault.h"
 #include "id_map.h"
 #include "tpunet/net.h"
 #include "tpunet/telemetry.h"
@@ -91,6 +95,15 @@ class Queue {
     q_.pop_front();
     return true;
   }
+  // Nonblocking drain (failover: a retiring worker discards its queued
+  // tasks — the per-stream records are the authoritative copy).
+  bool TryPop(T* out) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (q_.empty()) return false;
+    *out = std::move(q_.front());
+    q_.pop_front();
+    return true;
+  }
   void Close() {
     {
       std::lock_guard<std::mutex> lk(mu_);
@@ -109,7 +122,21 @@ class Queue {
 struct ChunkTask {
   uint8_t* data = nullptr;  // send: source bytes; recv: destination bytes
   size_t len = 0;
+  uint64_t seq = 0;  // per-stream chunk sequence number (failover protocol)
   RequestPtr state;
+};
+
+// Failover bookkeeping: one record per chunk logically assigned to a data
+// stream. The sender retains records until the owning message settles (so a
+// NACKed stream's undelivered chunks can be retransmitted over the ctrl
+// connection); the receiver retains them until the chunk is fully read (so
+// a FAILOVER marker knows which buffers the retransmit batch fills).
+struct ChunkRec {
+  uint64_t seq = 0;
+  uint8_t* data = nullptr;
+  size_t len = 0;
+  RequestPtr state;
+  bool written = false;  // sender only: payload fully handed to the kernel
 };
 
 struct Msg {
@@ -136,9 +163,41 @@ struct Comm {
   size_t nstreams = 0;
   size_t min_chunksize = 0;
   bool spin = false;
+  bool crc = false;  // per-chunk CRC32C trailers (negotiated in the preamble)
   std::vector<std::unique_ptr<StreamWorker>> workers;
   Queue<Msg> msgs;
   std::unique_ptr<std::thread> scheduler;
+
+  // ---- Failover state (single-stream degradation; docs/DESIGN.md) -------
+  // fo_mu guards chunk assignment (cursor, per-stream seq counters,
+  // records, dead/retired bits) AND every ctrl-stream write, so message
+  // length frames and FAILOVER markers are totally ordered — that ordering
+  // is what lets both sides switch their chunk→stream rotation at the same
+  // point. Uncontended in steady state: one acquisition per message, not
+  // per chunk... (chunks are dispatched under the same acquisition).
+  std::mutex fo_mu;
+  // dead: IO on the stream has failed locally (or a NACK told the sender);
+  // no further tasks go to its worker, but the assignment rotation still
+  // includes it — records accumulate — until the FAILOVER marker retires it.
+  // retired: excluded from the rotation from the marker point in ctrl order.
+  std::vector<uint8_t> stream_dead;
+  std::vector<uint8_t> stream_retired;
+  size_t dead_count = 0;
+  std::vector<std::deque<ChunkRec>> recs;  // per-stream, seq-ordered
+  std::vector<uint64_t> next_seq;          // chunks ever assigned per stream
+  std::vector<uint64_t> done_seq;          // receiver: chunks fully read
+  // Receiver ctrl-read ownership: the scheduler, a lazy-recv caller, and a
+  // failed worker acting as ctrl pump never read the ctrl fd concurrently.
+  // A LEN frame read by the pump before its message is popped is stashed
+  // here (consumed by the next owner, preserving frame↔message pairing).
+  std::mutex ctrl_mu;
+  bool has_pending_frame = false;
+  uint64_t pending_frame = 0;
+  // Sender: reverse-ctrl reader parked on the (normally silent) receiver→
+  // sender direction of the ctrl connection, waiting for NACK frames.
+  std::unique_ptr<std::thread> nack_reader;
+
+  bool Aborted() const { return aborted_.load(std::memory_order_acquire); }
   // Inline fast path state (PERF_NOTES: caller->scheduler->worker hops cost
   // ~0.4ms per 1MiB message on a 1-core host). `inflight` counts messages
   // not yet fully settled; when it reads 0 the scheduler is idle and every
@@ -201,6 +260,7 @@ struct Comm {
       // thread handles (any pthread call on their stale ids is UB) and only
       // close this process's copies of the fds.
       (void)scheduler.release();
+      (void)nack_reader.release();
       for (auto& w : workers) {
         if (w->fd >= 0) ::close(w->fd);
         (void)w.release();
@@ -218,6 +278,7 @@ struct Comm {
     // a hang would otherwise be permanent since std::thread has no timed join.
     AbortStreams();
     if (scheduler && scheduler->joinable()) scheduler->join();
+    if (nack_reader && nack_reader->joinable()) nack_reader->join();
     for (auto& w : workers) w->tasks.Close();
     for (auto& w : workers) {
       if (w->thread.joinable()) w->thread.join();
@@ -239,44 +300,242 @@ using CommPtr = std::shared_ptr<Comm>;
 // ---------------------------------------------------------------------------
 // Worker / scheduler loops.
 
-// Chunk completion shared by both worker loops: the worker that settles the
-// message (last chunk) releases the comm's inflight slot, re-arming the
-// inline fast path.
-void FinishChunk(StreamWorker* w, ChunkTask& t) {
-  t.state->nbytes.fetch_add(t.len, std::memory_order_relaxed);
-  uint64_t prior = t.state->completed.fetch_add(1, std::memory_order_acq_rel);
-  uint64_t tot = t.state->total.load(std::memory_order_acquire);
-  TPUNET_DBG("chunk done len=%zu completed=%llu/%llu fail=%d", t.len, (unsigned long long)(prior+1), (unsigned long long)tot, (int)t.state->failed.load());
+// Chunk completion accounting shared by worker loops AND the failover
+// retransmit paths: whoever settles the message (last chunk) releases the
+// comm's inflight slot, re-arming the inline fast path.
+void AccountChunkDone(Comm* c, const RequestPtr& state, size_t len) {
+  state->nbytes.fetch_add(len, std::memory_order_relaxed);
+  uint64_t prior = state->completed.fetch_add(1, std::memory_order_acq_rel);
+  uint64_t tot = state->total.load(std::memory_order_acquire);
+  TPUNET_DBG("chunk done len=%zu completed=%llu/%llu fail=%d", len, (unsigned long long)(prior+1), (unsigned long long)tot, (int)state->failed.load());
   if (prior + 1 >= tot) {
-    w->comm->inflight.fetch_sub(1, std::memory_order_release);
+    c->inflight.fetch_sub(1, std::memory_order_release);
   }
-  t.state->NotifyIfSettled();
+  state->NotifyIfSettled();
 }
 
+void FinishChunk(StreamWorker* w, ChunkTask& t) { AccountChunkDone(w->comm, t.state, t.len); }
+
+// ---- Chunk assignment (fo_mu held) ----------------------------------------
+
+// Rotating-cursor pick over the NON-RETIRED streams in index order. With no
+// failures this is exactly the historical workers[cursor % nstreams]; after
+// a failover marker both sides hold an identical retired set and an
+// identical cursor (assignments are identical in ctrl order), so the
+// reduced-width rotation stays symmetric.
+size_t AssignStreamIdx(Comm* c) {
+  size_t alive = c->nstreams - [&] {
+    size_t r = 0;
+    for (size_t i = 0; i < c->nstreams; ++i) r += c->stream_retired[i] ? 1 : 0;
+    return r;
+  }();
+  size_t pick = c->cursor % alive;
+  c->cursor += 1;  // persists across messages — fairness rotation
+  for (size_t i = 0; i < c->nstreams; ++i) {
+    if (c->stream_retired[i]) continue;
+    if (pick == 0) return i;
+    --pick;
+  }
+  return 0;  // unreachable: alive >= 1 is an invariant (last loss poisons)
+}
+
+// Drop front records whose chunk was written AND whose message has settled
+// — the app may free those buffers after test(), so they are no longer
+// retransmittable (a NACK that still needs one becomes a typed poison, the
+// accepted kernel-buffered-bytes-lost race).
+void PruneRecs(Comm* c, size_t idx) {
+  auto& q = c->recs[idx];
+  while (!q.empty() && q.front().written &&
+         (q.front().state->Done() || q.front().state->failed.load(std::memory_order_acquire))) {
+    q.pop_front();
+  }
+}
+
+// Assign one chunk: record it, and hand it to the worker unless the stream
+// is locally dead (then the record alone carries it until the failover
+// marker retransmits or poisons).
+void AssignChunk(Comm* c, uint8_t* data, size_t n, const RequestPtr& state) {
+  size_t idx = AssignStreamIdx(c);
+  uint64_t seq = c->next_seq[idx]++;
+  if (c->is_send) PruneRecs(c, idx);
+  c->recs[idx].push_back(ChunkRec{seq, data, n, state, false});
+  if (!c->stream_dead[idx]) {
+    c->workers[idx]->tasks.Push(ChunkTask{data, n, seq, state});
+  }
+}
+
+// Sender: flag a record's payload as kernel-accepted (completion-counted).
+// Returns false when the record is GONE — a concurrent NACK failover
+// already claimed this chunk (retransmitted it over ctrl and accounted it),
+// so the worker must NOT count it again. A missing record can mean nothing
+// else: prune only removes records already marked written.
+bool MarkWritten(Comm* c, size_t idx, uint64_t seq) {
+  std::lock_guard<std::mutex> lk(c->fo_mu);
+  for (auto& r : c->recs[idx]) {
+    if (r.seq == seq) {
+      r.written = true;
+      return true;
+    }
+  }
+  return false;
+}
+
+// Receiver: a chunk fully arrived on its assigned stream.
+void PopRec(Comm* c, size_t idx, uint64_t seq) {
+  std::lock_guard<std::mutex> lk(c->fo_mu);
+  auto& q = c->recs[idx];
+  if (!q.empty() && q.front().seq == seq) q.pop_front();
+  c->done_seq[idx] = seq + 1;
+}
+
+// ---- CRC32C chunk trailers -------------------------------------------------
+
+Status WriteChunkCrc(int fd, uint32_t crc, bool spin) {
+  uint8_t b[4];
+  EncodeU32BE(crc, b);
+  return WriteAll(fd, b, sizeof(b), spin);
+}
+
+Status ReadChunkCrc(int fd, uint32_t* crc, bool spin) {
+  uint8_t b[4];
+  Status s = ReadExact(fd, b, sizeof(b), spin);
+  if (s.ok()) *crc = DecodeU32BE(b);
+  return s;
+}
+
+// ---- Stream failure handling ----------------------------------------------
+
+// Sender-side data-stream IO failure. Returns true when failover is engaged
+// (the worker retires quietly: drain the queue, keep the records, wait for
+// the receiver's NACK); false when the comm must poison (already aborted,
+// single-stream comm, or last surviving stream).
+bool SenderStreamFailed(Comm* c, StreamWorker* w) {
+  {
+    std::lock_guard<std::mutex> lk(c->fo_mu);
+    if (c->Aborted() || c->nstreams == 1) return false;
+    if (!c->stream_dead[w->idx]) {
+      if (c->dead_count + 1 >= c->nstreams) return false;  // last stream: poison
+      c->stream_dead[w->idx] = 1;
+      c->dead_count += 1;
+      Telemetry::Get().OnStreamFailover();
+      // Force the receiver's blocked read to notice promptly even when the
+      // failure was one-sided (FIN/RST): its NACK is what unblocks us.
+      ::shutdown(w->fd, SHUT_RDWR);
+      TPUNET_DBG("send stream %zu dead, awaiting NACK", w->idx);
+    }
+  }
+  ChunkTask d;
+  while (w->tasks.TryPop(&d)) {
+  }  // records are the authoritative copy
+  return true;
+}
+
+// Receiver-side data-stream IO failure: same verdict logic; on failover the
+// caller sends the NACK naming how many chunks it fully read off the stream
+// (== the first per-stream seq it still needs).
+bool ReceiverStreamFailed(Comm* c, StreamWorker* w) {
+  {
+    std::lock_guard<std::mutex> lk(c->fo_mu);
+    if (c->Aborted() || c->nstreams == 1) return false;
+    if (!c->stream_dead[w->idx]) {
+      if (c->dead_count + 1 >= c->nstreams) return false;
+      c->stream_dead[w->idx] = 1;
+      c->dead_count += 1;
+      Telemetry::Get().OnStreamFailover();
+      uint8_t frame[8];
+      EncodeU64BE(PackCtrlFrame(kCtrlFrameNack, w->idx, c->done_seq[w->idx]), frame);
+      Status ns = WriteAll(c->ctrl_fd, frame, sizeof(frame), c->spin);
+      if (!ns.ok()) return false;  // ctrl is gone too: poison
+      TPUNET_DBG("recv stream %zu dead, NACK sent (done_seq=%llu)", w->idx,
+                 (unsigned long long)c->done_seq[w->idx]);
+    }
+  }
+  ChunkTask d;
+  while (w->tasks.TryPop(&d)) {
+  }
+  return true;
+}
+
+void PoisonAndDrainQueue(Comm* c, const std::string& why);  // defined below
+
 void SendWorkerLoop(StreamWorker* w, bool spin) {
+  Comm* c = w->comm;
   ChunkTask t;
   while (w->tasks.Pop(&t)) {
-    Status s = WriteAll(w->fd, t.data, t.len, spin);
-    if (!s.ok()) {
-      t.state->SetError(s.msg);
-      w->comm->AbortStreams();
+    FaultAction fa = FaultCheck(true, w->idx, w->fd, t.len);
+    Status s;
+    if (fa == FaultAction::kCorrupt) {
+      // Damage the wire copy, never the caller's buffer; the CRC trailer is
+      // computed over the ORIGINAL bytes so TPUNET_CRC=1 catches the flip.
+      std::vector<uint8_t> dup(t.data, t.data + t.len);
+      if (!dup.empty()) dup[dup.size() / 2] ^= 0x01;
+      s = WriteAll(w->fd, dup.data(), dup.size(), spin);
     } else {
-      Telemetry::Get().OnStreamBytes(true, w->idx, t.len);
+      s = WriteAll(w->fd, t.data, t.len, spin);
     }
+    if (s.ok() && c->crc) s = WriteChunkCrc(w->fd, Crc32c(t.data, t.len), spin);
+    if (!s.ok()) {
+      if (SenderStreamFailed(c, w)) return;  // failover: records carry the rest
+      t.state->SetError(s.msg);
+      FinishChunk(w, t);
+      // Full poison (not just AbortStreams): any records orphaned by an
+      // earlier mid-failover stream death must settle too, or test() would
+      // hold their requests forever waiting to quiesce.
+      PoisonAndDrainQueue(c, s.msg);
+      continue;
+    }
+    if (!MarkWritten(c, w->idx, t.seq)) {
+      // A racing NACK failover already retransmitted and ACCOUNTED this
+      // chunk (our "successful" write went into a dying socket's buffer).
+      // Counting it again would underflow the comm's inflight slot.
+      ChunkTask d;
+      while (w->tasks.TryPop(&d)) {
+      }
+      return;
+    }
+    Telemetry::Get().OnStreamBytes(true, w->idx, t.len);
     FinishChunk(w, t);
   }
 }
 
+void PumpCtrlUntilRetired(Comm* c, size_t idx);  // defined after frame handling
+
 void RecvWorkerLoop(StreamWorker* w, bool spin) {
+  Comm* c = w->comm;
   ChunkTask t;
   while (w->tasks.Pop(&t)) {
+    FaultAction fa = FaultCheck(false, w->idx, w->fd, t.len);
     Status s = ReadExact(w->fd, t.data, t.len, spin);
+    uint32_t wire_crc = 0;
+    if (s.ok() && c->crc) s = ReadChunkCrc(w->fd, &wire_crc, spin);
     if (!s.ok()) {
+      if (ReceiverStreamFailed(c, w)) {
+        // Become the ctrl pump: with the scheduler possibly parked waiting
+        // for the NEXT message, nobody else may be reading the ctrl stream,
+        // and the FAILOVER marker + retransmitted chunks arrive there.
+        PumpCtrlUntilRetired(c, w->idx);
+        return;
+      }
       t.state->SetError(s.msg);
-      w->comm->AbortStreams();
+      FinishChunk(w, t);
+      PoisonAndDrainQueue(c, s.msg);  // see SendWorkerLoop: settles orphans too
+      continue;
+    }
+    if (fa == FaultAction::kCorrupt && t.len > 0) {
+      t.data[t.len / 2] ^= 0x01;  // simulate wire damage before verification
+    }
+    if (c->crc && wire_crc != Crc32c(t.data, t.len)) {
+      // Integrity failure is a REQUEST error, not a disconnect: the stream
+      // framing is intact (we consumed exactly chunk+trailer), so the comm
+      // keeps working for subsequent messages.
+      Telemetry::Get().OnCrcError();
+      t.state->SetError(ErrorKind::kCorruption,
+                        "CRC32C mismatch on data stream " + std::to_string(w->idx) +
+                            ": payload corrupted in transit");
     } else {
       Telemetry::Get().OnStreamBytes(false, w->idx, t.len);
     }
+    PopRec(c, w->idx, t.seq);
     FinishChunk(w, t);
   }
 }
@@ -284,7 +543,8 @@ void RecvWorkerLoop(StreamWorker* w, bool spin) {
 // Receiver-side: chunk a message and fan chunks out to stream workers
 // round-robin from the rotating cursor. The send side runs the same chunk
 // math + rotation inline in SendOneMsg (with ctrl-frame accounting on top),
-// keeping the two chunk maps symmetric (SURVEY hard-part #2).
+// keeping the two chunk maps symmetric (SURVEY hard-part #2). Callers hold
+// NO locks; the assignment happens under fo_mu.
 void DispatchChunks(Comm* c, uint8_t* data, size_t len, const RequestPtr& state) {
   size_t csize = ChunkSize(len, c->min_chunksize, c->nstreams);
   size_t nchunks = ChunkCount(len, csize);
@@ -295,12 +555,11 @@ void DispatchChunks(Comm* c, uint8_t* data, size_t len, const RequestPtr& state)
     return;
   }
   state->NotifyIfSettled();
+  std::lock_guard<std::mutex> lk(c->fo_mu);
   size_t off = 0;
   for (size_t i = 0; i < nchunks; ++i) {
     size_t n = std::min(csize, len - off);
-    StreamWorker* w = c->workers[c->cursor % c->nstreams].get();
-    c->cursor += 1;  // persists across messages — fairness rotation
-    w->tasks.Push(ChunkTask{data + off, n, state});
+    AssignChunk(c, data + off, n, state);
     off += n;
   }
 }
@@ -327,6 +586,22 @@ void PoisonAndDrainQueue(Comm* c, const std::string& why) {
   Msg m;
   while (c->msgs.Pop(&m)) {
     FailMsg(c, m.state, "comm broken by earlier ctrl-stream error: " + why);
+  }
+  // Orphaned failover records: chunks assigned to a dead-but-not-retired
+  // stream have no worker task behind them (queues were drained when the
+  // stream died), so nothing else will ever complete their accounting and
+  // test() would hold the request forever waiting to quiesce.
+  std::lock_guard<std::mutex> lk(c->fo_mu);
+  for (size_t i = 0; i < c->nstreams; ++i) {
+    if (!c->stream_dead[i] || c->stream_retired[i]) continue;
+    for (ChunkRec& r : c->recs[i]) {
+      if (r.written) continue;  // already completion-counted by its worker
+      r.state->SetError("comm poisoned with stream " + std::to_string(i) +
+                        " mid-failover: " + why);
+      AccountChunkDone(c, r.state, 0);
+    }
+    c->recs[i].clear();
+    c->stream_retired[i] = 1;  // no retransmit is coming
   }
 }
 
@@ -358,15 +633,21 @@ bool SendOneMsg(Comm* c, const Msg& m) {
   size_t csize = ChunkSize(m.len, c->min_chunksize, c->nstreams);
   size_t nchunks = ChunkCount(m.len, csize);
   m.state->total.store(nchunks + 1, std::memory_order_release);
-  size_t off = 0;
-  for (size_t i = 0; i < nchunks; ++i) {
-    size_t n = std::min(csize, m.len - off);
-    StreamWorker* w = c->workers[c->cursor % c->nstreams].get();
-    c->cursor += 1;  // persists across messages — fairness rotation
-    w->tasks.Push(ChunkTask{m.data + off, n, m.state});
-    off += n;
+  Status s;
+  {
+    // One fo_mu section covers this message's chunk assignment AND its ctrl
+    // length frame, so a concurrent FAILOVER marker (NACK handler) lands
+    // strictly before or strictly after the whole message in ctrl order —
+    // the receiver applies the same assignment set either way.
+    std::lock_guard<std::mutex> lk(c->fo_mu);
+    size_t off = 0;
+    for (size_t i = 0; i < nchunks; ++i) {
+      size_t n = std::min(csize, m.len - off);
+      AssignChunk(c, m.data + off, n, m.state);
+      off += n;
+    }
+    s = WriteAll(c->ctrl_fd, hdr, sizeof(hdr), c->spin);
   }
-  Status s = WriteAll(c->ctrl_fd, hdr, sizeof(hdr), c->spin);
   if (!s.ok()) m.state->SetError(s.msg);
   uint64_t prior = m.state->completed.fetch_add(1, std::memory_order_acq_rel);
   if (prior + 1 >= nchunks + 1) {
@@ -387,34 +668,290 @@ void SendSchedulerLoop(Comm* c) {
   }
 }
 
+// ---- Receiver ctrl-frame vocabulary ---------------------------------------
+
+// One ctrl frame, honoring a pump-stashed frame first. ctrl_mu held.
+Status ReadCtrlFrameLocked(Comm* c, uint64_t* frame) {
+  if (c->has_pending_frame) {
+    *frame = c->pending_frame;
+    c->has_pending_frame = false;
+    return Status::Ok();
+  }
+  uint8_t b[8];
+  Status s = ReadExact(c->ctrl_fd, b, sizeof(b), c->spin);
+  if (!s.ok()) return s;
+  *frame = DecodeU64BE(b);
+  return Status::Ok();
+}
+
+// FAILOVER marker: the sender retired stream k as of this point in ctrl
+// order and retransmits every chunk the receiver's NACK declared missing —
+// inline on the ctrl stream as [seq u64 | len u64 | payload | crc?] units.
+// ctrl_mu held; takes fo_mu for the record/rotation update.
+Status ProcessFailoverMarkerLocked(Comm* c, uint64_t frame) {
+  size_t k = (frame >> 48) & 0xff;
+  uint64_t count = frame & 0xffffffffffffull;
+  uint8_t b[16];
+  Status s = ReadExact(c->ctrl_fd, b, 8, c->spin);
+  if (!s.ok()) return s;
+  uint64_t start_seq = DecodeU64BE(b);
+  std::lock_guard<std::mutex> lk(c->fo_mu);
+  if (k >= c->nstreams || !c->stream_dead[k] || c->stream_retired[k]) {
+    return Status::Inner("failover marker for stream " + std::to_string(k) +
+                         " in an impossible state (protocol desync)");
+  }
+  if (start_seq != c->done_seq[k] || count != c->recs[k].size()) {
+    return Status::Inner(
+        "failover desync on stream " + std::to_string(k) + ": sender retransmits [" +
+        std::to_string(start_seq) + ", +" + std::to_string(count) + "), receiver needs [" +
+        std::to_string(c->done_seq[k]) + ", +" + std::to_string(c->recs[k].size()) + ")");
+  }
+  TPUNET_DBG("failover marker: stream %zu, %llu chunks over ctrl", k,
+             (unsigned long long)count);
+  for (ChunkRec& r : c->recs[k]) {
+    s = ReadExact(c->ctrl_fd, b, sizeof(b), c->spin);
+    if (!s.ok()) return s;
+    uint64_t seq = DecodeU64BE(b);
+    uint64_t len = DecodeU64BE(b + 8);
+    if (seq != r.seq || len != r.len) {
+      return Status::Inner("failover retransmit unit mismatch on stream " + std::to_string(k));
+    }
+    s = ReadExact(c->ctrl_fd, r.data, r.len, c->spin);
+    if (!s.ok()) return s;
+    if (c->crc) {
+      uint32_t wire_crc = 0;
+      s = ReadChunkCrc(c->ctrl_fd, &wire_crc, c->spin);
+      if (!s.ok()) return s;
+      if (wire_crc != Crc32c(r.data, r.len)) {
+        Telemetry::Get().OnCrcError();
+        r.state->SetError(ErrorKind::kCorruption,
+                          "CRC32C mismatch on failover retransmit (stream " +
+                              std::to_string(k) + ")");
+      }
+    }
+    if (!r.state->failed.load(std::memory_order_acquire)) {
+      Telemetry::Get().OnStreamBytes(false, k, r.len);
+    }
+    AccountChunkDone(c, r.state, r.len);
+  }
+  c->recs[k].clear();
+  c->stream_retired[k] = 1;  // rotation excludes k from here on — both sides
+  return Status::Ok();
+}
+
 // Per-message receiver ctrl-frame work; chunk handling differs between the
 // scheduler path (dispatch to workers) and the lazy path (caller reads).
-Status RecvCtrlFrame(Comm* c, const Msg& m, uint64_t* target) {
-  uint8_t hdr[8];
-  Status s = ReadExact(c->ctrl_fd, hdr, sizeof(hdr), c->spin);
-  if (!s.ok()) return s;
-  *target = DecodeU64BE(hdr);
-  if (*target > m.len) {
-    // Peer sent more than the posted buffer — unrecoverable protocol
-    // violation (the reference would panic slicing data[..target]).
-    return Status::Inner("incoming message (" + std::to_string(*target) +
-                         "B) exceeds posted recv buffer (" +
-                         std::to_string(m.len) + "B)");
+// Control frames (failover markers) encountered before the message's length
+// frame are processed inline. The caller passes its HELD ctrl_mu lock and
+// MUST dispatch the message's chunk assignment before releasing it: a
+// FAILOVER marker processed (by the pump) between this frame and the
+// dispatch would retire a stream the sender still counted into THIS
+// message's rotation, desynchronizing the chunk maps.
+Status RecvCtrlFrame(Comm* c, std::unique_lock<std::mutex>& ctrl_lk, const Msg& m,
+                     uint64_t* target) {
+  (void)ctrl_lk;  // held for the whole call; documents the locking contract
+  while (true) {
+    uint64_t frame = 0;
+    Status s = ReadCtrlFrameLocked(c, &frame);
+    if (!s.ok()) return s;
+    if ((frame >> 56) == kCtrlFrameFailover) {
+      s = ProcessFailoverMarkerLocked(c, frame);
+      if (!s.ok()) return s;
+      continue;
+    }
+    if (frame >= kMaxCtrlLen) {
+      return Status::Inner("bogus ctrl frame 0x" + std::to_string(frame >> 56) +
+                           "… — peer desynchronized");
+    }
+    *target = frame;
+    if (*target > m.len) {
+      // Peer sent more than the posted buffer — unrecoverable protocol
+      // violation (the reference would panic slicing data[..target]).
+      return Status::Inner("incoming message (" + std::to_string(*target) +
+                           "B) exceeds posted recv buffer (" +
+                           std::to_string(m.len) + "B)");
+    }
+    return Status::Ok();
   }
-  return Status::Ok();
+}
+
+// Ctrl pump run by a failed receiver worker: until its stream's FAILOVER
+// marker is processed (by this pump, the scheduler, or a lazy-recv caller —
+// whoever owns ctrl_mu when the marker lands), keep the ctrl stream moving.
+// A LEN frame read here is stashed for the real owner when its message is
+// not yet posted — the pump never pairs frames with messages itself, which
+// keeps frame↔message pairing strictly in pop order.
+void PumpCtrlUntilRetired(Comm* c, size_t idx) {
+  while (true) {
+    {
+      std::lock_guard<std::mutex> lk(c->fo_mu);
+      if (c->stream_retired[idx] || c->Aborted()) return;
+    }
+    if (!c->ctrl_mu.try_lock()) {
+      // Someone else (scheduler / lazy caller) is reading ctrl; they will
+      // process the marker. Check back shortly.
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+      continue;
+    }
+    std::lock_guard<std::mutex> lk(c->ctrl_mu, std::adopt_lock);
+    if (c->has_pending_frame) {
+      // A stashed LEN is waiting for its message; reading further frames
+      // would reorder the stream. Yield until the scheduler consumes it.
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+      continue;
+    }
+    struct pollfd pfd = {c->ctrl_fd, POLLIN, 0};
+    int pr = ::poll(&pfd, 1, 20);
+    if (pr < 0 && errno != EINTR) {
+      PoisonAndDrainQueue(c, "ctrl poll failed during failover");
+      return;
+    }
+    if (pr <= 0) continue;
+    uint64_t frame = 0;
+    Status s = ReadCtrlFrameLocked(c, &frame);
+    if (!s.ok()) {
+      PoisonAndDrainQueue(c, "ctrl stream lost during failover: " + s.msg);
+      return;
+    }
+    if ((frame >> 56) == kCtrlFrameFailover) {
+      s = ProcessFailoverMarkerLocked(c, frame);
+      if (!s.ok()) {
+        PoisonAndDrainQueue(c, s.msg);
+        return;
+      }
+      continue;
+    }
+    c->pending_frame = frame;  // LEN for a message the scheduler will pop
+    c->has_pending_frame = true;
+  }
+}
+
+// ---- Sender NACK reader ---------------------------------------------------
+
+// Respond to a receiver NACK: mark the stream dead, emit the FAILOVER
+// marker, and retransmit every record from the receiver's first missing seq
+// over the ctrl stream. Returns false when the comm poisoned.
+bool HandleNack(Comm* c, size_t k, uint64_t completed) {
+  std::string poison;  // set on any verdict that must poison; applied after
+                       // fo_mu is released (PoisonAndDrainQueue takes it)
+  {
+    std::lock_guard<std::mutex> lk(c->fo_mu);
+    if (c->Aborted()) return false;
+    if (k >= c->nstreams || c->stream_retired[k]) {
+      poison = "NACK for stream " + std::to_string(k) + " in impossible state";
+    } else if (!c->stream_dead[k] && c->dead_count + 1 >= c->nstreams) {
+      poison = "last data stream lost (NACK on stream " + std::to_string(k) + ")";
+    }
+    if (poison.empty()) {
+      if (!c->stream_dead[k]) {
+        c->stream_dead[k] = 1;
+        c->dead_count += 1;
+        Telemetry::Get().OnStreamFailover();
+        // Unblock a worker mid-write on the dead conn; it sees stream_dead
+        // and retires quietly.
+        ::shutdown(c->workers[k]->fd, SHUT_RDWR);
+        ChunkTask d;
+        while (c->workers[k]->tasks.TryPop(&d)) {
+        }
+      }
+      auto& q = c->recs[k];
+      while (poison.empty() && !q.empty() && q.front().seq < completed) {
+        if (!q.front().written) {
+          poison = "failover desync: receiver claims a chunk never written";
+          break;
+        }
+        q.pop_front();
+      }
+      if (poison.empty() && ((q.empty() && c->next_seq[k] != completed) ||
+                             (!q.empty() && q.front().seq != completed))) {
+        // The receiver still needs chunks whose records were pruned after
+        // their message settled — the app may have freed those buffers, so
+        // they are gone. Typed poison instead of a silent wrong answer.
+        poison = "failover impossible on stream " + std::to_string(k) +
+                 ": undelivered chunks were already released to the app "
+                 "(kernel-buffered bytes lost with the connection)";
+      }
+      if (poison.empty()) {
+        TPUNET_DBG("NACK stream %zu: retransmitting %zu chunks over ctrl", k, q.size());
+        uint8_t b[16];
+        EncodeU64BE(PackCtrlFrame(kCtrlFrameFailover, k, q.size()), b);
+        EncodeU64BE(completed, b + 8);
+        Status s = WriteAll(c->ctrl_fd, b, sizeof(b), c->spin);
+        for (ChunkRec& r : q) {
+          if (!s.ok()) break;
+          EncodeU64BE(r.seq, b);
+          EncodeU64BE(r.len, b + 8);
+          s = WriteAll(c->ctrl_fd, b, sizeof(b), c->spin);
+          if (s.ok()) s = WriteAll(c->ctrl_fd, r.data, r.len, c->spin);
+          if (s.ok() && c->crc) s = WriteChunkCrc(c->ctrl_fd, Crc32c(r.data, r.len), c->spin);
+          if (s.ok() && !r.written) {
+            // First time these bytes reach the kernel: complete their
+            // accounting (written records were counted by their worker).
+            Telemetry::Get().OnStreamBytes(true, k, r.len);
+            AccountChunkDone(c, r.state, r.len);
+            r.written = true;
+          }
+        }
+        if (!s.ok()) {
+          poison = "ctrl write failed during failover retransmit: " + s.msg;
+        } else {
+          q.clear();
+          c->stream_retired[k] = 1;
+        }
+      }
+    }
+  }
+  if (!poison.empty()) {
+    PoisonAndDrainQueue(c, poison);
+    return false;
+  }
+  return true;
+}
+
+// Parked on the receiver→sender direction of the ctrl connection (silent in
+// normal operation). Poll-based so spin mode's nonblocking ctrl fd does not
+// busy-burn a core here.
+void NackReaderLoop(Comm* c) {
+  uint8_t buf[8];
+  size_t got = 0;
+  while (true) {
+    struct pollfd pfd = {c->ctrl_fd, POLLIN, 0};
+    int pr = ::poll(&pfd, 1, 100);
+    if (pr < 0 && errno != EINTR) return;
+    if (c->Aborted()) return;
+    if (pr <= 0) continue;
+    ssize_t n = ::recv(c->ctrl_fd, buf + got, sizeof(buf) - got, MSG_DONTWAIT);
+    if (n == 0) return;  // peer closed ctrl; scheduler/poison paths own it
+    if (n < 0) {
+      if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK) continue;
+      return;
+    }
+    got += static_cast<size_t>(n);
+    if (got < sizeof(buf)) continue;
+    got = 0;
+    uint64_t frame = DecodeU64BE(buf);
+    if ((frame >> 56) != kCtrlFrameNack) {
+      PoisonAndDrainQueue(c, "unexpected reverse ctrl frame from receiver");
+      return;
+    }
+    if (!HandleNack(c, (frame >> 48) & 0xff, frame & 0xffffffffffffull)) return;
+  }
 }
 
 void RecvSchedulerLoop(Comm* c) {
   Msg m;
   while (c->msgs.Pop(&m)) {
     uint64_t target = 0;
-    Status s = RecvCtrlFrame(c, m, &target);
+    std::unique_lock<std::mutex> ctrl_lk(c->ctrl_mu);
+    Status s = RecvCtrlFrame(c, ctrl_lk, m, &target);
     if (!s.ok()) {
+      ctrl_lk.unlock();
       FailAndDrain(c, m.state, s.msg);
       return;
     }
     // NCCL semantics: recv buffer may exceed the message; true size comes
-    // from the ctrl frame (reference nthread:507).
+    // from the ctrl frame (reference nthread:507). Dispatched under the
+    // SAME ctrl_mu hold as the frame read — see RecvCtrlFrame on why.
     DispatchChunks(c, m.data, static_cast<size_t>(target), m.state);
   }
 }
@@ -426,8 +963,10 @@ void RecvSchedulerLoop(Comm* c) {
 // touches its fd without a task, so reading it here is exclusive.
 void ExecuteLazyRecv(Comm* c, const Msg& m) {
   uint64_t target = 0;
-  Status s = RecvCtrlFrame(c, m, &target);
+  std::unique_lock<std::mutex> ctrl_lk(c->ctrl_mu);
+  Status s = RecvCtrlFrame(c, ctrl_lk, m, &target);
   if (!s.ok()) {
+    ctrl_lk.unlock();
     FailMsg(c, m.state, s.msg);
     c->AbortStreams();
     return;
@@ -435,22 +974,59 @@ void ExecuteLazyRecv(Comm* c, const Msg& m) {
   size_t len = static_cast<size_t>(target);
   size_t csize = ChunkSize(len, c->min_chunksize, c->nstreams);
   size_t nchunks = ChunkCount(len, csize);
-  if (nchunks > 0) {
-    StreamWorker* w = c->workers[c->cursor % c->nstreams].get();
-    c->cursor += 1;  // same rotation the sender computes
+  if (nchunks == 0) {
+    ctrl_lk.unlock();
+    m.state->total.store(0, std::memory_order_release);
+    c->inflight.fetch_sub(1, std::memory_order_release);
+    m.state->NotifyIfSettled();
+    return;
+  }
+  // nchunks == 1 by lazy eligibility. Assigned through the shared rotation
+  // (failover bookkeeping stays symmetric with the sender) under the SAME
+  // ctrl_mu hold as the frame read — see RecvCtrlFrame. The lock is
+  // released before the blocking payload read: holding it there would
+  // starve the ctrl pump this very chunk may depend on after a failover.
+  m.state->total.store(nchunks, std::memory_order_release);
+  size_t idx;
+  uint64_t seq;
+  bool dead;
+  {
+    std::lock_guard<std::mutex> lk(c->fo_mu);
+    idx = AssignStreamIdx(c);
+    seq = c->next_seq[idx]++;
+    c->recs[idx].push_back(ChunkRec{seq, m.data, len, m.state, false});
+    dead = c->stream_dead[idx] != 0;
+  }
+  ctrl_lk.unlock();
+  if (!dead) {
+    StreamWorker* w = c->workers[idx].get();
     Status rs = ReadExact(w->fd, m.data, len, c->spin);
-    if (!rs.ok()) {
-      FailMsg(c, m.state, rs.msg);
-      c->AbortStreams();
+    uint32_t wire_crc = 0;
+    if (rs.ok() && c->crc) rs = ReadChunkCrc(w->fd, &wire_crc, c->spin);
+    if (rs.ok()) {
+      if (c->crc && wire_crc != Crc32c(m.data, len)) {
+        Telemetry::Get().OnCrcError();
+        m.state->SetError(ErrorKind::kCorruption,
+                          "CRC32C mismatch on data stream " + std::to_string(idx) +
+                              ": payload corrupted in transit");
+      } else {
+        Telemetry::Get().OnStreamBytes(false, idx, len);
+      }
+      PopRec(c, idx, seq);
+      AccountChunkDone(c, m.state, len);
       return;
     }
-    Telemetry::Get().OnStreamBytes(false, w->idx, len);
-    m.state->nbytes.store(len, std::memory_order_relaxed);
-    m.state->completed.store(nchunks, std::memory_order_release);
+    if (!ReceiverStreamFailed(c, c->workers[idx].get())) {
+      m.state->SetError(rs.msg);
+      AccountChunkDone(c, m.state, 0);
+      PoisonAndDrainQueue(c, rs.msg);
+      return;
+    }
+    // Fall through: the chunk arrives via the ctrl-stream retransmit.
   }
-  m.state->total.store(nchunks, std::memory_order_release);
-  c->inflight.fetch_sub(1, std::memory_order_release);
-  m.state->NotifyIfSettled();
+  // The assigned stream is dead: pump ctrl until the FAILOVER marker
+  // delivers (and accounts) this chunk, or the comm poisons.
+  PumpCtrlUntilRetired(c, idx);
 }
 
 // ---------------------------------------------------------------------------
@@ -475,7 +1051,8 @@ class BasicEngine : public EngineBase {
     if (!sdev.ok()) return sdev;
     std::vector<int> data_fds;
     int ctrl_fd = -1;
-    Status s = ConnectBundle(nics_, dev, handle, nstreams_, min_chunksize_, &data_fds, &ctrl_fd);
+    Status s = ConnectBundle(nics_, dev, handle, nstreams_, min_chunksize_, PreambleFlags(),
+                             &data_fds, &ctrl_fd);
     if (!s.ok()) return s;
 
     auto comm = std::make_shared<Comm>();
@@ -483,6 +1060,7 @@ class BasicEngine : public EngineBase {
     comm->nstreams = nstreams_;
     comm->min_chunksize = min_chunksize_;
     comm->spin = spin_;
+    comm->crc = crc_;
     comm->ctrl_fd = ctrl_fd;
     for (int fd : data_fds) {
       auto w = std::make_unique<StreamWorker>();
@@ -526,6 +1104,7 @@ class BasicEngine : public EngineBase {
       return Status::Inner("send comm created before fork(); its threads do not exist here");
     }
     auto state = std::make_shared<RequestState>();
+    ArmWatchdog(state, c);
     uint64_t id = next_id_.fetch_add(1);
     requests_.Put(id, state);
     Msg m{const_cast<uint8_t*>(static_cast<const uint8_t*>(data)), nbytes, state};
@@ -554,6 +1133,7 @@ class BasicEngine : public EngineBase {
       return Status::Inner("recv comm created before fork(); its threads do not exist here");
     }
     auto state = std::make_shared<RequestState>();
+    ArmWatchdog(state, c);
     uint64_t id = next_id_.fetch_add(1);
     requests_.Put(id, state);
     Msg m{static_cast<uint8_t*>(data), nbytes, state};
@@ -564,7 +1144,11 @@ class BasicEngine : public EngineBase {
     size_t csize = ChunkSize(nbytes, c->min_chunksize, c->nstreams);
     bool single = ChunkCount(nbytes, csize) <= 1;
     TPUNET_DBG("irecv req=%llu len=%zu prior=%llu single=%d", (unsigned long long)id, nbytes, (unsigned long long)prior, (int)single);
-    if (prior == 0 && single && lazy_recv_) {
+    // Watchdog mode disables lazy parking: the lazy wait() path runs
+    // BLOCKING ctrl/data reads on the caller thread, which the watchdog
+    // (which lives in the condvar wait, WaitIn) could never interrupt —
+    // bounded-wait guarantees beat the inline-hop optimization.
+    if (prior == 0 && single && lazy_recv_ && watchdog_ms_ == 0) {
       // Park lazily: wait() executes the ctrl+data reads on the caller
       // thread (no scheduler/worker hop, no completion wakeup). test()
       // or a later irecv upgrades it onto the scheduler queue.
@@ -603,7 +1187,7 @@ class BasicEngine : public EngineBase {
         return Status::Ok();
       }
       requests_.Erase(request);
-      return Status::Inner("request failed: " + state->ErrorMsg());
+      return Status{state->ErrKind(), "request failed: " + state->ErrorMsg()};
     }
     *done = state->Done();
     if (*done) {
@@ -685,6 +1269,18 @@ class BasicEngine : public EngineBase {
   }
 
  private:
+  // Progress-watchdog abort hook (only when TPUNET_PROGRESS_TIMEOUT_MS is
+  // set): WaitIn's timeout verdict shuts the comm's sockets down so blocked
+  // workers quiesce and the request surfaces its typed error. Weak capture —
+  // the comm may be closed before the request is waited.
+  void ArmWatchdog(const RequestPtr& state, const CommPtr& c) {
+    if (watchdog_ms_ == 0) return;
+    std::weak_ptr<Comm> wc = c;
+    state->on_stall = [wc] {
+      if (auto p = wc.lock()) p->AbortStreams();
+    };
+  }
+
   // Move a parked lazy recv onto the scheduler queue. The Push happens
   // UNDER lazy_mu: with it outside, a cross-thread upgrade could be
   // preempted between claim and push while the comm's caller posts (and
@@ -706,6 +1302,12 @@ class BasicEngine : public EngineBase {
   }
 
   void StartThreads(Comm* c) {
+    // Failover bookkeeping is per-stream; size it before any IO thread runs.
+    c->stream_dead.assign(c->nstreams, 0);
+    c->stream_retired.assign(c->nstreams, 0);
+    c->recs.resize(c->nstreams);
+    c->next_seq.assign(c->nstreams, 0);
+    c->done_seq.assign(c->nstreams, 0);
     bool spin = c->spin;
     for (auto& w : c->workers) {
       StreamWorker* wp = w.get();
@@ -715,15 +1317,23 @@ class BasicEngine : public EngineBase {
     }
     c->scheduler = std::make_unique<std::thread>(
         c->is_send ? SendSchedulerLoop : RecvSchedulerLoop, c);
+    if (c->is_send) {
+      // Reverse-ctrl NACK reader: the receiver speaks only when one of its
+      // data streams dies (single-stream failover, docs/DESIGN.md).
+      c->nack_reader = std::make_unique<std::thread>(NackReaderLoop, c);
+    }
   }
 
   Status BuildRecvComm(PartialBundle& b, uint64_t* recv_comm) {
     auto comm = std::make_shared<Comm>();
     comm->is_send = false;
     // Sender's chunk-map inputs win — carried in the preamble so both sides
-    // always partition messages identically (SURVEY hard-part #2).
+    // always partition messages identically (SURVEY hard-part #2). The CRC
+    // flag travels the same way: the receiver verifies iff the sender
+    // appends trailers, regardless of the local TPUNET_CRC setting.
     comm->nstreams = b.nstreams;
     comm->min_chunksize = b.min_chunksize;
+    comm->crc = (b.flags & kPreambleFlagCrc) != 0;
     comm->spin = spin_;
     comm->ctrl_fd = b.ctrl_fd;
     b.ctrl_fd = -1;
@@ -771,6 +1381,9 @@ std::unique_ptr<Net> CreateEngine() {
   // out wrapped in the telemetry decorator so metrics/tracing cannot
   // diverge between engines.
   std::string impl = GetEnv("TPUNET_IMPLEMENT", GetEnv("BAGUA_NET_IMPLEMENT", "BASIC"));
+  // Chaos hook: TPUNET_FAULT_SPEC arms a deterministic fault for this
+  // process (fault.h); runtime arming goes through tpunet_c_fault_inject().
+  ArmFaultFromEnv();
   auto engine = impl == "EPOLL" ? CreateEpollEngine() : CreateBasicEngine();
   return WrapWithTelemetry(std::move(engine));
 }

@@ -11,9 +11,11 @@
 #include <mutex>
 #include <vector>
 
+#include "fault.h"
 #include "id_map.h"
 #include "tpunet/net.h"
 #include "tpunet/telemetry.h"
+#include "tpunet/utils.h"
 
 namespace {
 
@@ -31,10 +33,18 @@ int32_t Fail(int32_t code, const std::string& msg) {
 
 int32_t FromStatus(const Status& s) {
   if (s.ok()) return TPUNET_OK;
-  if (s.kind == tpunet::ErrorKind::kInvalidArgument) {
-    return Fail(TPUNET_ERR_INVALID, s.msg);
+  switch (s.kind) {
+    case tpunet::ErrorKind::kInvalidArgument:
+      return Fail(TPUNET_ERR_INVALID, s.msg);
+    case tpunet::ErrorKind::kCorruption:
+      return Fail(TPUNET_ERR_CORRUPT, s.msg);
+    case tpunet::ErrorKind::kTimeout:
+      return Fail(TPUNET_ERR_TIMEOUT, s.msg);
+    case tpunet::ErrorKind::kVersion:
+      return Fail(TPUNET_ERR_VERSION, s.msg);
+    default:
+      return Fail(TPUNET_ERR_INNER, s.msg);
   }
-  return Fail(TPUNET_ERR_INNER, s.msg);
 }
 
 // An instance: the engine plus a property cache that owns the name/pci_path
@@ -226,6 +236,28 @@ int32_t tpunet_c_close_listen(uintptr_t instance, uintptr_t listen_comm) {
 }
 
 const char* tpunet_c_last_error(void) { return g_last_error.c_str(); }
+
+int32_t tpunet_c_fault_inject(const char* spec) {
+  if (spec == nullptr || *spec == '\0') {
+    tpunet::DisarmFault();
+    return TPUNET_OK;
+  }
+  tpunet::FaultSpec f;
+  Status s = tpunet::ParseFaultSpec(spec, &f);
+  if (!s.ok()) return FromStatus(s);
+  tpunet::ArmFault(f);
+  return TPUNET_OK;
+}
+
+int32_t tpunet_c_fault_clear(void) {
+  tpunet::DisarmFault();
+  return TPUNET_OK;
+}
+
+uint32_t tpunet_c_crc32c(const void* data, uint64_t nbytes, uint32_t seed) {
+  if (data == nullptr && nbytes > 0) return 0;
+  return tpunet::Crc32c(data, static_cast<size_t>(nbytes), seed);
+}
 
 }  // extern "C"
 

@@ -6,7 +6,9 @@
 #ifndef TPUNET_ENGINE_BASE_H_
 #define TPUNET_ENGINE_BASE_H_
 
+#include <algorithm>
 #include <atomic>
+#include <chrono>
 #include <string>
 #include <vector>
 
@@ -23,7 +25,9 @@ class EngineBase : public Net {
       : nics_(FindInterfaces()),
         nstreams_(GetEnvU64("TPUNET_NSTREAMS", GetEnvU64("BAGUA_NET_NSTREAMS", 2))),
         min_chunksize_(GetEnvU64("TPUNET_MIN_CHUNKSIZE",
-                                 GetEnvU64("BAGUA_NET_MIN_CHUNKSIZE", 1 << 20))) {
+                                 GetEnvU64("BAGUA_NET_MIN_CHUNKSIZE", 1 << 20))),
+        crc_(GetEnvU64("TPUNET_CRC", 0) != 0),
+        watchdog_ms_(GetEnvU64("TPUNET_PROGRESS_TIMEOUT_MS", 0)) {
     if (nstreams_ == 0) nstreams_ = 1;
     if (nstreams_ > kMaxStreams) nstreams_ = kMaxStreams;
     if (min_chunksize_ == 0) min_chunksize_ = 1;
@@ -72,13 +76,47 @@ class EngineBase : public Net {
   // own, so they pass it in): park on the request condvar, then consume via
   // the engine's test(). The loop re-parks for the failed-but-not-yet-
   // quiesced window where test() reports not-done.
+  //
+  // Progress watchdog (TPUNET_PROGRESS_TIMEOUT_MS > 0): while parked, the
+  // request's (completed, nbytes) pair is sampled; a full window with zero
+  // movement means a live-but-stuck peer (desync, scheduler stall, stalled
+  // middlebox) that TCP keepalive will never flag. The request gets a typed
+  // kTimeout error and its on_stall hook shuts the comm's sockets down so
+  // blocked workers quiesce — upstream (train/elastic.py) classifies the
+  // timeout exactly like a dead peer and rebuilds the generation.
   Status WaitIn(IdMap<RequestPtr>& requests, uint64_t request, size_t* nbytes) {
     while (true) {
       RequestPtr state;
       if (!requests.Get(request, &state)) {
         return Status::Invalid("unknown request " + std::to_string(request));
       }
-      state->WaitSettled();
+      if (watchdog_ms_ == 0) {
+        state->WaitSettled();
+      } else {
+        int slice = static_cast<int>(std::min<uint64_t>(watchdog_ms_, 100));
+        uint64_t last_completed = state->completed.load(std::memory_order_acquire);
+        uint64_t last_nbytes = state->nbytes.load(std::memory_order_relaxed);
+        auto last_move = std::chrono::steady_clock::now();
+        while (!state->WaitSettledFor(slice)) {
+          uint64_t c = state->completed.load(std::memory_order_acquire);
+          uint64_t b = state->nbytes.load(std::memory_order_relaxed);
+          if (c != last_completed || b != last_nbytes) {
+            last_completed = c;
+            last_nbytes = b;
+            last_move = std::chrono::steady_clock::now();
+            continue;
+          }
+          if (std::chrono::steady_clock::now() - last_move >=
+              std::chrono::milliseconds(watchdog_ms_)) {
+            state->SetError(ErrorKind::kTimeout,
+                            "progress watchdog: request moved zero bytes for " +
+                                std::to_string(watchdog_ms_) +
+                                "ms (TPUNET_PROGRESS_TIMEOUT_MS) — peer alive but stuck?");
+            if (state->on_stall) state->on_stall();
+            break;
+          }
+        }
+      }
       bool done = false;
       Status st = test(request, &done, nbytes);
       if (!st.ok() || done) return st;
@@ -106,9 +144,15 @@ class EngineBase : public Net {
     for (auto& lc : listen_comms_.DrainAll()) WakeListen(lc.get());
   }
 
+  // Preamble flags this engine advertises when connecting (sender's flags
+  // win on the far side, like nstreams/min_chunksize).
+  uint64_t PreambleFlags() const { return crc_ ? kPreambleFlagCrc : 0; }
+
   std::vector<NicInfo> nics_;
   uint64_t nstreams_;
   uint64_t min_chunksize_;
+  bool crc_;              // TPUNET_CRC=1: per-chunk CRC32C trailers
+  uint64_t watchdog_ms_;  // TPUNET_PROGRESS_TIMEOUT_MS (0 = off)
   std::atomic<uint64_t> next_id_{1};
   IdMap<ListenSockPtr> listen_comms_;
 };

@@ -45,6 +45,7 @@
 #include <vector>
 
 #include "engine_base.h"
+#include "fault.h"
 #include "id_map.h"
 #include "tpunet/net.h"
 #include "tpunet/telemetry.h"
@@ -57,11 +58,18 @@ namespace {
 // One unit of IO on one fd: move `len` bytes starting at data+done.
 // `counts_bytes` is false for ctrl length frames (protocol overhead is not
 // reported in test()'s nbytes; reference reports payload bytes only).
+// With CRC negotiated (kPreambleFlagCrc), data-chunk segments carry a
+// 4-byte CRC32C trailer: precomputed into `trailer` on the send side,
+// read into it and verified on the recv side after the payload completes.
 struct Segment {
   uint8_t* data = nullptr;
   size_t len = 0;
   size_t done = 0;
   bool counts_bytes = true;
+  uint8_t trailer[4] = {0, 0, 0, 0};
+  size_t trailer_len = 0;   // 0 = no trailer (ctrl frames, CRC off)
+  size_t trailer_done = 0;
+  bool corrupt = false;     // injected fault: damage payload before verify
   RequestPtr state;
   std::unique_ptr<uint8_t[]> owned;  // backing store for send-side ctrl frames
 };
@@ -88,6 +96,7 @@ struct EComm {
   bool is_send = false;
   size_t nstreams = 0;
   size_t min_chunksize = 0;
+  bool crc = false;  // per-chunk CRC32C trailers (negotiated in the preamble)
   uint64_t cursor = 0;  // rotating chunk-assignment cursor (fairness)
   FdState ctrl;
   // unique_ptr: FdState holds a deque of move-only Segments, and epoll
@@ -447,6 +456,12 @@ class Loop {
       seg.data = data + off;
       seg.len = n;
       seg.state = state;
+      if (c->crc) {
+        seg.trailer_len = 4;
+        // Send side precomputes the trailer at dispatch; the recv side
+        // reads the peer's 4 bytes into it and verifies at completion.
+        if (c->is_send) EncodeU32BE(Crc32c(seg.data, seg.len), seg.trailer);
+      }
       fs->segs.push_back(std::move(seg));
       WantIOLocked(fs);
       off += n;
@@ -472,20 +487,68 @@ class Loop {
     }
     while (!fs->segs.empty()) {
       Segment& seg = fs->segs.front();
+      bool in_trailer = seg.done == seg.len && seg.trailer_len > 0;
+      if (!fs->is_ctrl && !in_trailer) {
+        // Fault gate (data payload IO only; ctrl and trailers are exempt).
+        // Byte accounting is per-attempt here, so after_bytes thresholds
+        // are approximate on this engine (exact on BASIC's per-chunk IO).
+        FaultAction fa = FaultCheck(c->is_send, fs->stream_idx, fs->fd, seg.len - seg.done);
+        if (fa == FaultAction::kCorrupt) seg.corrupt = true;
+      }
       ssize_t m;
-      if (c->is_send) {
+      if (in_trailer) {
+        if (c->is_send && seg.corrupt && seg.trailer_done == 0) {
+          // Send-side injected corruption: damage the trailer on the wire
+          // (the payload is the caller's buffer and must not be touched).
+          seg.trailer[0] ^= 0x01;
+          seg.corrupt = false;
+        }
+        m = c->is_send ? ::send(fs->fd, seg.trailer + seg.trailer_done,
+                                seg.trailer_len - seg.trailer_done,
+                                MSG_DONTWAIT | MSG_NOSIGNAL)
+                       : ::recv(fs->fd, seg.trailer + seg.trailer_done,
+                                seg.trailer_len - seg.trailer_done, MSG_DONTWAIT);
+      } else if (c->is_send) {
         m = ::send(fs->fd, seg.data + seg.done, seg.len - seg.done,
                    MSG_DONTWAIT | MSG_NOSIGNAL);
       } else {
         m = ::recv(fs->fd, seg.data + seg.done, seg.len - seg.done, MSG_DONTWAIT);
       }
       if (m > 0) {
+        if (in_trailer) {
+          seg.trailer_done += static_cast<size_t>(m);
+          if (seg.trailer_done < seg.trailer_len) continue;
+          if (!c->is_send) {
+            if (seg.corrupt && seg.len > 0) {
+              seg.data[seg.len / 2] ^= 0x01;  // wire damage before verify
+              seg.corrupt = false;
+            }
+            if (DecodeU32BE(seg.trailer) != Crc32c(seg.data, seg.len)) {
+              // Integrity failure is a REQUEST error, not a disconnect: the
+              // framing is intact, so only this message's state fails and
+              // the comm keeps serving subsequent messages.
+              Telemetry::Get().OnCrcError();
+              seg.state->SetError(ErrorKind::kCorruption,
+                                  "CRC32C mismatch on data stream " +
+                                      std::to_string(fs->stream_idx) +
+                                      ": payload corrupted in transit");
+            }
+          }
+          CompleteSegment(seg);
+          fs->segs.pop_front();
+          continue;
+        }
         if (!fs->is_ctrl) {
           Telemetry::Get().OnStreamBytes(c->is_send, fs->stream_idx,
                                          static_cast<uint64_t>(m));
         }
         seg.done += static_cast<size_t>(m);
         if (seg.done == seg.len) {
+          if (seg.trailer_len > 0) continue;  // trailer phase next
+          if (!c->is_send && seg.corrupt && seg.len > 0) {
+            seg.data[seg.len / 2] ^= 0x01;  // CRC off: silent wire damage
+            seg.corrupt = false;
+          }
           CompleteSegment(seg);
           fs->segs.pop_front();
           continue;
@@ -638,9 +701,10 @@ class EpollEngine : public EngineBase {
     if (!sdev.ok()) return sdev;
     std::vector<int> data_fds;
     int ctrl_fd = -1;
-    Status s = ConnectBundle(nics_, dev, handle, nstreams_, min_chunksize_, &data_fds, &ctrl_fd);
+    Status s = ConnectBundle(nics_, dev, handle, nstreams_, min_chunksize_, PreambleFlags(),
+                             &data_fds, &ctrl_fd);
     if (!s.ok()) return s;
-    return AttachComm(true, nstreams_, min_chunksize_, ctrl_fd, data_fds, send_comm,
+    return AttachComm(true, nstreams_, min_chunksize_, crc_, ctrl_fd, data_fds, send_comm,
                       &send_comms_);
   }
 
@@ -653,9 +717,10 @@ class EpollEngine : public EngineBase {
     int ctrl_fd = b.ctrl_fd;
     b.data_fds.clear();
     b.ctrl_fd = -1;
-    // Sender's chunk-map inputs win (carried in the preamble).
-    return AttachComm(false, b.nstreams, b.min_chunksize, ctrl_fd, data_fds, recv_comm,
-                      &recv_comms_);
+    // Sender's chunk-map inputs win (carried in the preamble) — the CRC
+    // flag too: the receiver verifies iff the sender appends trailers.
+    return AttachComm(false, b.nstreams, b.min_chunksize, (b.flags & kPreambleFlagCrc) != 0,
+                      ctrl_fd, data_fds, recv_comm, &recv_comms_);
   }
 
   Status isend(uint64_t send_comm, const void* data, size_t nbytes, uint64_t* request) override {
@@ -676,7 +741,7 @@ class EpollEngine : public EngineBase {
       // Failed segments are dropped on the loop thread before failed is set,
       // so the caller's buffer is already quiescent here.
       requests_.Erase(request);
-      return Status::Inner("request failed: " + state->ErrorMsg());
+      return Status{state->ErrKind(), "request failed: " + state->ErrorMsg()};
     }
     *done = state->Done();
     if (*done) {
@@ -709,13 +774,14 @@ class EpollEngine : public EngineBase {
   }
 
  private:
-  Status AttachComm(bool is_send, uint64_t nstreams, uint64_t min_chunksize, int ctrl_fd,
-                    const std::vector<int>& data_fds, uint64_t* out_id,
+  Status AttachComm(bool is_send, uint64_t nstreams, uint64_t min_chunksize, bool crc,
+                    int ctrl_fd, const std::vector<int>& data_fds, uint64_t* out_id,
                     IdMap<CommHandle>* map) {
     auto comm = std::make_shared<EComm>();
     comm->is_send = is_send;
     comm->nstreams = nstreams;
     comm->min_chunksize = min_chunksize;
+    comm->crc = crc;
     comm->ctrl.fd = ctrl_fd;
     comm->ctrl.is_ctrl = true;
     comm->ctrl.comm = comm.get();
@@ -741,6 +807,22 @@ class EpollEngine : public EngineBase {
       return Status::Invalid("unknown comm " + std::to_string(comm_id));
     }
     auto state = std::make_shared<RequestState>();
+    if (watchdog_ms_ > 0) {
+      // Progress-watchdog abort hook: a timeout verdict in WaitIn shuts the
+      // comm's sockets down; the loop then observes EPOLLHUP/EOF and fails
+      // the comm, quiescing every segment (the typed timeout error was set
+      // first, so it is the one the caller sees).
+      std::weak_ptr<EComm> wc = h.comm;
+      state->on_stall = [wc] {
+        auto p = wc.lock();
+        if (!p) return;
+        std::lock_guard<std::mutex> lk(p->mu);
+        if (p->ctrl.fd >= 0) ::shutdown(p->ctrl.fd, SHUT_RDWR);
+        for (auto& s : p->streams) {
+          if (s->fd >= 0) ::shutdown(s->fd, SHUT_RDWR);
+        }
+      };
+    }
     uint64_t id = next_id_.fetch_add(1);
     requests_.Put(id, state);
     // Caller-thread fast path on an idle comm (see Loop::TryInline): the

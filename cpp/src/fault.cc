@@ -1,0 +1,193 @@
+// Deterministic fault injection. See fault.h for the spec grammar.
+#include "fault.h"
+
+#include <poll.h>
+#include <sys/socket.h>
+
+#include <chrono>
+#include <mutex>
+#include <thread>
+
+#include "tpunet/telemetry.h"
+#include "tpunet/utils.h"
+
+namespace tpunet {
+
+std::atomic<uint32_t> g_fault_armed{0};
+
+namespace {
+
+// The armed slot. `mu` guards spec swaps; the hot path reads the plain
+// fields only after g_fault_armed's acquire load in FaultPreIO, and ArmFault
+// publishes them with a release store — the classic flag-guarded payload.
+std::mutex g_mu;
+FaultSpec g_spec;
+std::atomic<uint64_t> g_bytes{0};     // bytes seen on matching (side, stream)
+std::atomic<uint32_t> g_latched{0};   // one-shot claim for close/corrupt
+
+bool ParseSize(const std::string& v, uint64_t* out) {
+  if (v.empty()) return false;
+  size_t i = 0;
+  uint64_t n = 0;
+  while (i < v.size() && v[i] >= '0' && v[i] <= '9') {
+    n = n * 10 + static_cast<uint64_t>(v[i] - '0');
+    ++i;
+  }
+  if (i == 0) return false;
+  if (i + 1 == v.size()) {
+    switch (v[i] | 0x20) {
+      case 'k': n <<= 10; ++i; break;
+      case 'm': n <<= 20; ++i; break;
+      case 'g': n <<= 30; ++i; break;
+      default: return false;
+    }
+  }
+  if (i != v.size()) return false;
+  *out = n;
+  return true;
+}
+
+}  // namespace
+
+Status ParseFaultSpec(const std::string& spec, FaultSpec* out) {
+  FaultSpec f;
+  bool saw_action = false;
+  size_t pos = 0;
+  while (pos <= spec.size()) {
+    size_t end = spec.find(':', pos);
+    if (end == std::string::npos) end = spec.size();
+    std::string item = spec.substr(pos, end - pos);
+    pos = end + 1;
+    if (item.empty()) {
+      if (end == spec.size()) break;
+      return Status::Invalid("fault spec: empty clause in '" + spec + "'");
+    }
+    size_t eq = item.find('=');
+    if (eq == std::string::npos) {
+      return Status::Invalid("fault spec: clause '" + item + "' is not key=value");
+    }
+    std::string key = item.substr(0, eq);
+    std::string val = item.substr(eq + 1);
+    if (key == "stream") {
+      if (val == "*") {
+        f.stream = -1;
+      } else {
+        uint64_t n = 0;
+        if (!ParseSize(val, &n) || n > 255) {
+          return Status::Invalid("fault spec: bad stream '" + val + "'");
+        }
+        f.stream = static_cast<int64_t>(n);
+      }
+    } else if (key == "side") {
+      if (val == "*") f.side = 0;
+      else if (val == "send") f.side = 1;
+      else if (val == "recv") f.side = 2;
+      else return Status::Invalid("fault spec: bad side '" + val + "'");
+    } else if (key == "after_bytes") {
+      if (!ParseSize(val, &f.after_bytes)) {
+        return Status::Invalid("fault spec: bad after_bytes '" + val + "'");
+      }
+    } else if (key == "action") {
+      saw_action = true;
+      // "delay=50" arrives split at OUR '=' too: val may itself carry one.
+      size_t deq = val.find('=');
+      std::string name = deq == std::string::npos ? val : val.substr(0, deq);
+      std::string arg = deq == std::string::npos ? "" : val.substr(deq + 1);
+      if (name == "close" && arg.empty()) f.action = FaultAction::kClose;
+      else if (name == "stall" && arg.empty()) f.action = FaultAction::kStall;
+      else if (name == "corrupt" && arg.empty()) f.action = FaultAction::kCorrupt;
+      else if (name == "delay") {
+        f.action = FaultAction::kDelay;
+        if (arg.empty() || !ParseSize(arg, &f.delay_ms) || f.delay_ms > 60000) {
+          return Status::Invalid("fault spec: bad delay '" + val + "' (want delay=<ms> <= 60000)");
+        }
+      } else {
+        return Status::Invalid("fault spec: unknown action '" + val + "'");
+      }
+    } else {
+      return Status::Invalid("fault spec: unknown key '" + key + "'");
+    }
+  }
+  if (!saw_action) return Status::Invalid("fault spec: missing action= clause");
+  *out = f;
+  return Status::Ok();
+}
+
+void ArmFault(const FaultSpec& spec) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_fault_armed.store(0, std::memory_order_release);  // quiesce readers' view
+  g_spec = spec;
+  g_bytes.store(0, std::memory_order_relaxed);
+  g_latched.store(0, std::memory_order_relaxed);
+  g_fault_armed.store(1, std::memory_order_release);
+}
+
+void DisarmFault() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_fault_armed.store(0, std::memory_order_release);
+}
+
+void ArmFaultFromEnv() {
+  std::string spec = GetEnv("TPUNET_FAULT_SPEC", "");
+  if (spec.empty()) return;
+  FaultSpec f;
+  Status s = ParseFaultSpec(spec, &f);
+  if (!s.ok()) {
+    fprintf(stderr, "tpunet: ignoring TPUNET_FAULT_SPEC: %s\n", s.msg.c_str());
+    return;
+  }
+  ArmFault(f);
+}
+
+FaultAction FaultPreIO(bool is_send, uint64_t stream_idx, int fd, size_t nbytes) {
+  // Re-check under acquire: pairs with ArmFault's release publish.
+  if (g_fault_armed.load(std::memory_order_acquire) == 0) return FaultAction::kNone;
+  const FaultSpec spec = g_spec;  // plain read, valid per the armed handshake
+  if (spec.side == 1 && !is_send) return FaultAction::kNone;
+  if (spec.side == 2 && is_send) return FaultAction::kNone;
+  if (spec.stream >= 0 && static_cast<uint64_t>(spec.stream) != stream_idx) {
+    return FaultAction::kNone;
+  }
+  uint64_t before = g_bytes.fetch_add(nbytes, std::memory_order_relaxed);
+  if (before < spec.after_bytes) return FaultAction::kNone;
+  switch (spec.action) {
+    case FaultAction::kClose:
+      if (g_latched.exchange(1, std::memory_order_acq_rel)) return FaultAction::kNone;
+      Telemetry::Get().OnFaultInjected(static_cast<int>(FaultAction::kClose));
+      ::shutdown(fd, SHUT_RDWR);
+      return FaultAction::kNone;  // the IO proceeds and fails organically
+    case FaultAction::kCorrupt:
+      if (g_latched.exchange(1, std::memory_order_acq_rel)) return FaultAction::kNone;
+      Telemetry::Get().OnFaultInjected(static_cast<int>(FaultAction::kCorrupt));
+      return FaultAction::kCorrupt;
+    case FaultAction::kStall:
+      if (!g_latched.exchange(1, std::memory_order_acq_rel)) {
+        Telemetry::Get().OnFaultInjected(static_cast<int>(FaultAction::kStall));
+      }
+      FaultStall(fd);
+      return FaultAction::kNone;
+    case FaultAction::kDelay:
+      if (!g_latched.exchange(1, std::memory_order_acq_rel)) {
+        Telemetry::Get().OnFaultInjected(static_cast<int>(FaultAction::kDelay));
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(spec.delay_ms));
+      return FaultAction::kNone;
+    case FaultAction::kNone:
+      break;
+  }
+  return FaultAction::kNone;
+}
+
+void FaultStall(int fd) {
+  // Hold until disarmed or the fd dies (watchdog abort / comm teardown
+  // shutdown(2)s it, which raises POLLHUP even for a local half-close).
+  while (g_fault_armed.load(std::memory_order_acquire) != 0) {
+    struct pollfd pfd = {fd, 0, 0};  // events=0: error conditions only
+    if (::poll(&pfd, 1, 0) > 0 && (pfd.revents & (POLLERR | POLLHUP | POLLNVAL))) {
+      return;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  }
+}
+
+}  // namespace tpunet

@@ -1,0 +1,105 @@
+// Deterministic fault injection for the transport engines.
+//
+// The fault-path test suite used to SIGKILL real subprocesses mid-64MiB
+// allreduce to exercise failure handling — slow, racy, and unable to target
+// a SPECIFIC stream or byte offset. This module makes faults first-class:
+// a spec like
+//
+//   stream=1:after_bytes=1M:action=close
+//   stream=*:side=recv:after_bytes=256K:action=delay=50
+//
+// arms exactly one fault, evaluated on the engines' send/recv hot paths.
+// Armed via env TPUNET_FAULT_SPEC (read at engine creation) or at runtime
+// through tpunet_c_fault_inject() (c_api.h). Disarmed, the hot-path check
+// is a single relaxed atomic load — it compiles to a predicted-not-taken
+// branch and costs nothing measurable.
+//
+// Spec grammar (colon-separated key=value pairs, sizes take K/M/G suffixes):
+//   stream=<idx>|*        data-stream index the fault targets (* = any)
+//   side=send|recv|*      direction, default *
+//   after_bytes=<n>       trigger once this many bytes moved on a matching
+//                         (side, stream); default 0 = first IO
+//   action=close          shutdown(2) the stream's socket (both halves) —
+//                         the canonical stream-loss/failover trigger
+//   action=stall          stop moving bytes on the stream while armed (the
+//                         live-but-stuck peer the progress watchdog exists
+//                         for); releases when disarmed or the comm aborts
+//   action=corrupt        flip one byte of the next chunk on the wire
+//                         (detected by TPUNET_CRC=1, silent otherwise —
+//                         that asymmetry is the point)
+//   action=delay=<ms>     sleep <ms> before each matching IO while armed
+//
+// close and corrupt are one-shot (first matching IO past the threshold
+// claims them); stall and delay persist until disarmed. Faults never target
+// the ctrl connection — ctrl loss is a poison-the-comm event by design and
+// needs no injection subtlety beyond `close` on the last data stream.
+#ifndef TPUNET_FAULT_H_
+#define TPUNET_FAULT_H_
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+#include "tpunet/net.h"
+
+namespace tpunet {
+
+enum class FaultAction : int32_t {
+  kNone = 0,
+  kClose = 1,
+  kStall = 2,
+  kCorrupt = 3,
+  kDelay = 4,
+};
+constexpr int kFaultActionCount = 5;
+
+struct FaultSpec {
+  int64_t stream = -1;       // -1 = any data stream
+  int32_t side = 0;          // 0 = any, 1 = send, 2 = recv
+  uint64_t after_bytes = 0;  // cumulative bytes on a matching (side, stream)
+  FaultAction action = FaultAction::kNone;
+  uint64_t delay_ms = 0;     // kDelay only
+};
+
+// Parse `spec` into `out`; Invalid status (with the offending token named)
+// on malformed input. Pure — no global state touched.
+Status ParseFaultSpec(const std::string& spec, FaultSpec* out);
+
+// Arm/disarm the process-wide fault slot (one fault at a time — chaos tests
+// arm, run, clear). Arming resets the byte counters and one-shot latches.
+void ArmFault(const FaultSpec& spec);
+void DisarmFault();
+// Arm from TPUNET_FAULT_SPEC if set and parseable (called at engine
+// creation); a malformed env spec is reported on stderr and ignored —
+// a typo must not take down training.
+void ArmFaultFromEnv();
+
+// Hot-path gate. Callers pass the IO they are about to perform; the slow
+// path applies side effects in place — kClose shuts the fd down (the IO
+// then fails organically), kStall parks in FaultStall until disarm/abort,
+// kDelay sleeps — and the return value tells the caller the one action that
+// needs its cooperation:
+//   kNone     proceed as usual (possibly after an internal stall/delay)
+//   kCorrupt  flip a byte of the payload on the wire (send side: in a copy,
+//             never the caller's buffer, with the CRC trailer computed over
+//             the ORIGINAL bytes so TPUNET_CRC=1 detects the damage; recv
+//             side: in the received bytes before CRC verification)
+FaultAction FaultPreIO(bool is_send, uint64_t stream_idx, int fd, size_t nbytes);
+
+extern std::atomic<uint32_t> g_fault_armed;
+
+inline FaultAction FaultCheck(bool is_send, uint64_t stream_idx, int fd, size_t nbytes) {
+  // The single disarmed-path branch: one relaxed load, no fences.
+  if (g_fault_armed.load(std::memory_order_relaxed) == 0) return FaultAction::kNone;
+  return FaultPreIO(is_send, stream_idx, fd, nbytes);
+}
+
+// Park while the stall fault holds: sleeps in small slices until the fault
+// is disarmed or the fd is shut down (POLLERR/POLLHUP — how a watchdog
+// abort or comm teardown releases a stalled worker).
+void FaultStall(int fd);
+
+}  // namespace tpunet
+
+#endif  // TPUNET_FAULT_H_

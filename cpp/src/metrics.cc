@@ -101,6 +101,9 @@ struct Telemetry::Impl {
   std::atomic<uint64_t> failed{0};
   std::atomic<uint64_t> stream_tx[kMaxStreamStats] = {};
   std::atomic<uint64_t> stream_rx[kMaxStreamStats] = {};
+  std::atomic<uint64_t> faults_injected[kFaultActionSlots] = {};
+  std::atomic<uint64_t> stream_failovers{0};
+  std::atomic<uint64_t> crc_errors{0};
   uint64_t start_us = NowUs();
   int64_t rank = RankFromEnv();
 
@@ -261,6 +264,19 @@ void Telemetry::OnStreamBytes(bool is_send, uint64_t stream_idx, uint64_t nbytes
   slot.fetch_add(nbytes, std::memory_order_relaxed);
 }
 
+void Telemetry::OnFaultInjected(int action) {
+  if (action < 0 || action >= kFaultActionSlots) return;
+  impl_->faults_injected[action].fetch_add(1, std::memory_order_relaxed);
+}
+
+void Telemetry::OnStreamFailover() {
+  impl_->stream_failovers.fetch_add(1, std::memory_order_relaxed);
+}
+
+void Telemetry::OnCrcError() {
+  impl_->crc_errors.fetch_add(1, std::memory_order_relaxed);
+}
+
 MetricsSnapshot Telemetry::Snapshot() const {
   const Impl* im = impl_.get();
   MetricsSnapshot s;
@@ -278,6 +294,11 @@ MetricsSnapshot Telemetry::Snapshot() const {
   }
   s.inflight = im->inflight.load(std::memory_order_relaxed);
   s.failed_requests = im->failed.load(std::memory_order_relaxed);
+  for (int i = 0; i < kFaultActionSlots; ++i) {
+    s.faults_injected[i] = im->faults_injected[i].load(std::memory_order_relaxed);
+  }
+  s.stream_failovers = im->stream_failovers.load(std::memory_order_relaxed);
+  s.crc_errors = im->crc_errors.load(std::memory_order_relaxed);
   s.uptime_s = (NowUs() - im->start_us) / 1e6;
   return s;
 }
@@ -346,6 +367,27 @@ std::string Telemetry::PrometheusText() const {
   emit("# TYPE tpunet_failed_requests counter\n");
   emit("tpunet_failed_requests{rank=\"%lld\"} %llu\n", (long long)rank,
        (unsigned long long)s.failed_requests);
+  // Failure-containment counters. faults_injected is labeled by action and
+  // emitted only for nonzero slots; the unlabeled totals are always present
+  // so dashboards (and the Python parser, which must accept label-less
+  // lines) see them even at zero.
+  emit("# TYPE tpunet_faults_injected_total counter\n");
+  static const char* kActionNames[kFaultActionSlots] = {"none", "close", "stall",
+                                                        "corrupt", "delay"};
+  uint64_t faults_total = 0;
+  for (int i = 1; i < kFaultActionSlots; ++i) {
+    faults_total += s.faults_injected[i];
+    if (s.faults_injected[i] == 0) continue;
+    emit("tpunet_faults_injected_total{rank=\"%lld\",action=\"%s\"} %llu\n", (long long)rank,
+         kActionNames[i], (unsigned long long)s.faults_injected[i]);
+  }
+  emit("tpunet_faults_injected %llu\n", (unsigned long long)faults_total);
+  emit("# TYPE tpunet_stream_failovers_total counter\n");
+  emit("tpunet_stream_failovers_total{rank=\"%lld\"} %llu\n", (long long)rank,
+       (unsigned long long)s.stream_failovers);
+  emit("# TYPE tpunet_crc_errors_total counter\n");
+  emit("tpunet_crc_errors_total{rank=\"%lld\"} %llu\n", (long long)rank,
+       (unsigned long long)s.crc_errors);
   return out;
 }
 

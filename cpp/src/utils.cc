@@ -262,6 +262,184 @@ Status ReadExactDeadline(int fd, void* buf, size_t n, int timeout_ms) {
   return Status::Ok();
 }
 
+namespace {
+
+// Slicing-by-8 CRC32C tables, generated once (reflected poly 0x82F63B78).
+struct Crc32cTables {
+  uint32_t t[8][256];
+  Crc32cTables() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) c = (c >> 1) ^ ((c & 1) ? 0x82F63B78u : 0);
+      t[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = t[0][i];
+      for (int s = 1; s < 8; ++s) {
+        c = t[0][c & 0xff] ^ (c >> 8);
+        t[s][i] = c;
+      }
+    }
+  }
+};
+
+uint32_t Crc32cSoftware(const uint8_t* p, size_t n, uint32_t crc) {
+  static const Crc32cTables tables;
+  const auto& t = tables.t;
+  crc = ~crc;
+  while (n >= 8) {
+    // Byte-wise loads keep this alignment-agnostic and endian-correct.
+    uint32_t lo = crc ^ (static_cast<uint32_t>(p[0]) | static_cast<uint32_t>(p[1]) << 8 |
+                         static_cast<uint32_t>(p[2]) << 16 | static_cast<uint32_t>(p[3]) << 24);
+    uint32_t hi = static_cast<uint32_t>(p[4]) | static_cast<uint32_t>(p[5]) << 8 |
+                  static_cast<uint32_t>(p[6]) << 16 | static_cast<uint32_t>(p[7]) << 24;
+    crc = t[7][lo & 0xff] ^ t[6][(lo >> 8) & 0xff] ^ t[5][(lo >> 16) & 0xff] ^
+          t[4][lo >> 24] ^ t[3][hi & 0xff] ^ t[2][(hi >> 8) & 0xff] ^
+          t[1][(hi >> 16) & 0xff] ^ t[0][hi >> 24];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = t[0][(crc ^ *p++) & 0xff] ^ (crc >> 8);
+  return ~crc;
+}
+
+#if defined(__x86_64__) || defined(__i386__)
+
+// The crc32 instruction is 3-cycle latency / 1-cycle throughput: a single
+// dependency chain runs at ~1/3 of peak (measured 4.9 GB/s on this class of
+// host). Three interleaved lanes hide the latency; lane results are
+// recombined by multiplying in GF(2) by x^(8*lanelen) via precomputed
+// shift tables (Mark Adler's crc32c scheme). ~3x the single-chain rate —
+// what keeps the TPUNET_CRC=1 wire-integrity tax small even on a loopback
+// box where sender, receiver, and checksum share one core.
+
+uint32_t Gf2MatrixTimes(const uint32_t* mat, uint32_t vec) {
+  uint32_t sum = 0;
+  while (vec) {
+    if (vec & 1) sum ^= *mat;
+    vec >>= 1;
+    ++mat;
+  }
+  return sum;
+}
+
+void Gf2MatrixSquare(uint32_t* square, const uint32_t* mat) {
+  for (int n = 0; n < 32; ++n) square[n] = Gf2MatrixTimes(mat, mat[n]);
+}
+
+// Operator (as a 32x32 GF(2) matrix) that advances a CRC-32C state over
+// `len` zero BYTES. `len` must be a power of two (both lane strides are):
+// starting from the 4-bit operator, each squaring doubles the span, and
+// halving a power-of-two len to zero performs exactly log2(8*len)-2 of
+// them.
+void Crc32cZerosOp(uint32_t* even, size_t len) {
+  uint32_t odd[32];
+  odd[0] = 0x82F63B78u;  // reflected CRC-32C polynomial: one zero bit
+  uint32_t row = 1;
+  for (int n = 1; n < 32; ++n) {
+    odd[n] = row;
+    row <<= 1;
+  }
+  Gf2MatrixSquare(even, odd);  // two zero bits
+  Gf2MatrixSquare(odd, even);  // four zero bits
+  do {
+    Gf2MatrixSquare(even, odd);
+    len >>= 1;
+    if (len == 0) return;
+    Gf2MatrixSquare(odd, even);
+    len >>= 1;
+  } while (len);
+  memcpy(even, odd, sizeof(odd));
+}
+
+struct Crc32cShiftTable {
+  uint32_t t[4][256];
+  explicit Crc32cShiftTable(size_t lane_bytes) {
+    uint32_t op[32];
+    Crc32cZerosOp(op, lane_bytes);
+    for (uint32_t n = 0; n < 256; ++n) {
+      t[0][n] = Gf2MatrixTimes(op, n);
+      t[1][n] = Gf2MatrixTimes(op, n << 8);
+      t[2][n] = Gf2MatrixTimes(op, n << 16);
+      t[3][n] = Gf2MatrixTimes(op, n << 24);
+    }
+  }
+  uint32_t Shift(uint32_t crc) const {
+    return t[0][crc & 0xff] ^ t[1][(crc >> 8) & 0xff] ^ t[2][(crc >> 16) & 0xff] ^
+           t[3][crc >> 24];
+  }
+};
+
+constexpr size_t kCrcLongLane = 2048;  // bytes per lane, big-buffer stride
+constexpr size_t kCrcShortLane = 256;  // bytes per lane, medium stride
+
+#if defined(__x86_64__)
+// A lambda would not inherit the enclosing function's target attribute, so
+// the 3-lane stride lives in its own sse4.2-attributed helper.
+__attribute__((target("sse4.2")))
+void Crc32cThreeLanes(const uint8_t*& p, size_t& n, uint32_t& crc,
+                      const Crc32cShiftTable& shift, size_t lane) {
+  while (n >= 3 * lane) {
+    uint64_t c0 = crc, c1 = 0, c2 = 0;
+    const uint8_t* q = p;
+    const uint8_t* end = p + lane;
+    while (q < end) {
+      uint64_t v0, v1, v2;
+      memcpy(&v0, q, 8);
+      memcpy(&v1, q + lane, 8);
+      memcpy(&v2, q + 2 * lane, 8);
+      c0 = __builtin_ia32_crc32di(c0, v0);
+      c1 = __builtin_ia32_crc32di(c1, v1);
+      c2 = __builtin_ia32_crc32di(c2, v2);
+      q += 8;
+    }
+    crc = shift.Shift(static_cast<uint32_t>(c0)) ^ static_cast<uint32_t>(c1);
+    crc = shift.Shift(crc) ^ static_cast<uint32_t>(c2);
+    p += 3 * lane;
+    n -= 3 * lane;
+  }
+}
+#endif
+
+__attribute__((target("sse4.2")))
+uint32_t Crc32cHardware(const uint8_t* p, size_t n, uint32_t crc) {
+  static const Crc32cShiftTable long_shift(kCrcLongLane);
+  static const Crc32cShiftTable short_shift(kCrcShortLane);
+  crc = ~crc;
+#if defined(__x86_64__)
+  Crc32cThreeLanes(p, n, crc, long_shift, kCrcLongLane);
+  Crc32cThreeLanes(p, n, crc, short_shift, kCrcShortLane);
+  while (n >= 8) {
+    uint64_t v;
+    memcpy(&v, p, 8);
+    crc = static_cast<uint32_t>(__builtin_ia32_crc32di(crc, v));
+    p += 8;
+    n -= 8;
+  }
+#endif
+  while (n >= 4) {
+    uint32_t v;
+    memcpy(&v, p, 4);
+    crc = __builtin_ia32_crc32si(crc, v);
+    p += 4;
+    n -= 4;
+  }
+  while (n--) crc = __builtin_ia32_crc32qi(crc, *p++);
+  return ~crc;
+}
+#endif
+
+}  // namespace
+
+uint32_t Crc32c(const void* data, size_t n, uint32_t crc) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+#if defined(__x86_64__) || defined(__i386__)
+  static const bool hw = __builtin_cpu_supports("sse4.2");
+  if (hw) return Crc32cHardware(p, n, crc);
+#endif
+  return Crc32cSoftware(p, n, crc);
+}
+
 bool ParseUserPassAndAddr(const std::string& s, UserPassAddr* out) {
   // Reference: utils.rs:180-198 regex ^((user):(pass)@)?addr$.
   out->user.clear();

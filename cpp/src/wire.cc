@@ -29,28 +29,41 @@ Status MakeSocket(int family, int* out) {
 }
 
 Status WritePreamble(int fd, const Preamble& p) {
-  uint8_t buf[40];
+  uint8_t buf[48];
   EncodeU64BE(kWireMagic, buf);
   EncodeU64BE(p.bundle_id, buf + 8);
   EncodeU64BE(p.stream_id, buf + 16);
   EncodeU64BE(p.nstreams, buf + 24);
   EncodeU64BE(p.min_chunksize, buf + 32);
+  EncodeU64BE(p.flags, buf + 40);
   return WriteAll(fd, buf, sizeof(buf));
 }
 
 Status ReadPreamble(int fd, Preamble* p, int timeout_ms) {
-  uint8_t buf[40];
-  // Hard deadline over the whole 40 bytes — a slow-loris client trickling
-  // one byte per interval cannot stretch this past timeout_ms.
-  Status s = ReadExactDeadline(fd, buf, sizeof(buf), timeout_ms);
+  uint8_t buf[48];
+  // Hard deadline over the whole 48 bytes — a slow-loris client trickling
+  // one byte per interval cannot stretch this past timeout_ms. The magic is
+  // checked as soon as its 8 bytes land so a mismatched-version peer (whose
+  // preamble may be shorter) gets the typed verdict instead of a timeout.
+  Status s = ReadExactDeadline(fd, buf, 8, timeout_ms);
   if (!s.ok()) return s;
-  if (DecodeU64BE(buf) != kWireMagic) {
-    return Status::TCP("bad wire magic — peer is not tpunet or version mismatch");
+  uint64_t magic = DecodeU64BE(buf);
+  if (magic != kWireMagic) {
+    if ((magic & kWireMagicPrefixMask) == (kWireMagic & kWireMagicPrefixMask)) {
+      return Status::Version(
+          "tpunet wire version mismatch: peer speaks framing v" +
+          std::to_string(magic & 0xff) + ", this build speaks v" +
+          std::to_string(kWireMagic & 0xff));
+    }
+    return Status::TCP("bad wire magic — peer is not tpunet");
   }
+  s = ReadExactDeadline(fd, buf + 8, sizeof(buf) - 8, timeout_ms);
+  if (!s.ok()) return s;
   p->bundle_id = DecodeU64BE(buf + 8);
   p->stream_id = DecodeU64BE(buf + 16);
   p->nstreams = DecodeU64BE(buf + 24);
   p->min_chunksize = DecodeU64BE(buf + 32);
+  p->flags = DecodeU64BE(buf + 40);
   if (p->nstreams == 0 || p->nstreams > kMaxStreams || p->stream_id > p->nstreams ||
       p->min_chunksize == 0) {
     return Status::TCP("malformed preamble: nstreams=" + std::to_string(p->nstreams) +
@@ -195,8 +208,10 @@ Status AcceptBundle(ListenSock* lc, PartialBundle* out) {
     if (b.nstreams == UINT64_MAX) {
       b.nstreams = p.nstreams;
       b.min_chunksize = p.min_chunksize;
+      b.flags = p.flags;
       b.first_seen = std::chrono::steady_clock::now();
-    } else if (b.nstreams != p.nstreams || b.min_chunksize != p.min_chunksize) {
+    } else if (b.nstreams != p.nstreams || b.min_chunksize != p.min_chunksize ||
+               b.flags != p.flags) {
       ::close(fd);  // inconsistent members: drop the whole bundle
       b.CloseAll();
       lc->partials.erase(p.bundle_id);
@@ -302,8 +317,8 @@ Status ConnectOne(const std::vector<NicInfo>& nics, int32_t dev, const SocketHan
 }  // namespace
 
 Status ConnectBundle(const std::vector<NicInfo>& nics, int32_t dev, const SocketHandle& handle,
-                     uint64_t nstreams, uint64_t min_chunksize, std::vector<int>* data_fds,
-                     int* ctrl_fd) {
+                     uint64_t nstreams, uint64_t min_chunksize, uint64_t flags,
+                     std::vector<int>* data_fds, int* ctrl_fd) {
   uint64_t bundle = RandomBundleId();
   auto cleanup = [&]() {
     for (int fd : *data_fds) ::close(fd);
@@ -321,7 +336,7 @@ Status ConnectBundle(const std::vector<NicInfo>& nics, int32_t dev, const Socket
       cleanup();
       return s;
     }
-    s = WritePreamble(fd, Preamble{bundle, sid, nstreams, min_chunksize});
+    s = WritePreamble(fd, Preamble{bundle, sid, nstreams, min_chunksize, flags});
     if (!s.ok()) {
       ::close(fd);
       cleanup();

@@ -17,6 +17,7 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <functional>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -28,9 +29,56 @@
 
 namespace tpunet {
 
-constexpr uint64_t kWireMagic = 0x7470756e65743102ull;  // "tpunet" + wire ver 2
+// Wire framing version 3: the preamble grew a flags word (CRC32C chunk
+// trailers are negotiated there) and the ctrl stream gained failover frames.
+// The low byte of the magic is the version; a peer whose magic matches the
+// 7-byte "tpunet1" prefix but not the version byte gets a typed kVersion
+// error instead of the generic bad-magic TCPError.
+constexpr uint64_t kWireMagic = 0x7470756e65743103ull;  // "tpunet" + wire ver 3
+constexpr uint64_t kWireMagicPrefixMask = 0xffffffffffffff00ull;
 constexpr int kListenBacklog = 16384;  // reference: nthread:101
 constexpr uint64_t kMaxStreams = 256;  // sanity bound on peer-supplied nstreams
+
+// Preamble flag bits (sender-advertised; like nstreams/min_chunksize the
+// sender's values win so the two sides can never disagree).
+constexpr uint64_t kPreambleFlagCrc = 1ull << 0;
+
+// Ctrl-stream frame vocabulary. A plain message length frame is a raw
+// big-endian u64 < 2^56; frames with a reserved top byte are transport
+// control frames (failover protocol, basic_engine.cc):
+//   0xFD  NACK (receiver -> sender): data stream died; bits 48..55 carry the
+//         stream index, bits 0..47 the count of chunks the receiver fully
+//         read off that stream — i.e. the first per-stream chunk seq it
+//         still needs.
+//   0xFE  FAILOVER marker (sender -> receiver): stream index in bits
+//         48..55, retransmit-unit count in bits 0..47; followed on the ctrl
+//         stream by one u64 (the receiver-confirmed seq the batch starts
+//         at) and then count units of [seq u64 | len u64 | payload |
+//         crc32c u32 when negotiated]. From this point in ctrl order both
+//         sides drop the stream from the chunk-assignment rotation.
+constexpr uint8_t kCtrlFrameNack = 0xFD;
+constexpr uint8_t kCtrlFrameFailover = 0xFE;
+// Lengths at or above this collide with the control-frame namespace; no
+// real message gets near 2^56 bytes.
+constexpr uint64_t kMaxCtrlLen = 1ull << 56;
+
+inline uint64_t PackCtrlFrame(uint8_t type, uint64_t stream, uint64_t arg) {
+  return (static_cast<uint64_t>(type) << 56) | ((stream & 0xff) << 48) |
+         (arg & 0xffffffffffffull);
+}
+
+// 4-byte big-endian CRC32C chunk trailer (TPUNET_CRC=1, negotiated via
+// kPreambleFlagCrc).
+inline void EncodeU32BE(uint32_t v, uint8_t out[4]) {
+  out[0] = static_cast<uint8_t>(v >> 24);
+  out[1] = static_cast<uint8_t>(v >> 16);
+  out[2] = static_cast<uint8_t>(v >> 8);
+  out[3] = static_cast<uint8_t>(v);
+}
+inline uint32_t DecodeU32BE(const uint8_t in[4]) {
+  return static_cast<uint32_t>(in[0]) << 24 | static_cast<uint32_t>(in[1]) << 16 |
+         static_cast<uint32_t>(in[2]) << 8 | static_cast<uint32_t>(in[3]);
+}
 
 socklen_t AddrLenForFamily(const sockaddr_storage& ss);
 
@@ -38,19 +86,23 @@ Status MakeSocket(int family, int* out);
 
 // Connection preamble: both chunk-map inputs (nstreams AND min_chunksize)
 // travel with the sender so the two sides can never compute divergent chunk
-// boundaries from mismatched env config — the sender's values win.
+// boundaries from mismatched env config — the sender's values win, and so
+// does the flags word (CRC32C trailers on data chunks, kPreambleFlagCrc).
 // [magic u64 | bundle_id u64 | stream_id u64 | nstreams u64 |
-//  min_chunksize u64], all big-endian. stream_id == nstreams marks the ctrl
-// connection (reference: nthread:380).
+//  min_chunksize u64 | flags u64], all big-endian. stream_id == nstreams
+// marks the ctrl connection (reference: nthread:380).
 struct Preamble {
   uint64_t bundle_id = 0;
   uint64_t stream_id = 0;
   uint64_t nstreams = 0;
   uint64_t min_chunksize = 0;
+  uint64_t flags = 0;
 };
 
 Status WritePreamble(int fd, const Preamble& p);
-// Bounded by timeout_ms over the WHOLE 40 bytes (slow-loris defense).
+// Bounded by timeout_ms over the WHOLE 48 bytes (slow-loris defense).
+// A magic whose "tpunet1" prefix matches but whose version byte differs
+// returns a typed kVersion status (framing-version negotiation).
 Status ReadPreamble(int fd, Preamble* p, int timeout_ms);
 
 uint64_t RandomBundleId();
@@ -66,17 +118,35 @@ struct RequestState {
   std::atomic<bool> failed{false};
   std::mutex err_mu;
   std::string err_msg;
+  // Error kind carried alongside the message so typed failures (corruption,
+  // watchdog timeout, version mismatch) survive the trip through test()/
+  // wait() to the C ABI instead of collapsing into kInnerError.
+  ErrorKind err_kind = ErrorKind::kInnerError;
+  // Progress-watchdog abort hook: set at request creation (only when
+  // TPUNET_PROGRESS_TIMEOUT_MS > 0) to shut down the owning comm's sockets
+  // so blocked workers quiesce after a timeout verdict. Captures a weak
+  // reference — the comm may die first.
+  std::function<void()> on_stall;
 
-  void SetError(const std::string& m) {
+  void SetError(const std::string& m) { SetError(ErrorKind::kInnerError, m); }
+  void SetError(ErrorKind k, const std::string& m) {
     {
       std::lock_guard<std::mutex> lk(err_mu);
-      if (err_msg.empty()) err_msg = m;
+      if (err_msg.empty()) {
+        err_msg = m;
+        err_kind = k;
+      }
     }
     failed.store(true, std::memory_order_release);
   }
   std::string ErrorMsg() {
     std::lock_guard<std::mutex> lk(err_mu);
     return err_msg;
+  }
+  // The kind recorded by the first SetError (first error wins, like the msg).
+  ErrorKind ErrKind() {
+    std::lock_guard<std::mutex> lk(err_mu);
+    return err_kind;
   }
   bool Done() const {
     uint64_t t = total.load(std::memory_order_acquire);
@@ -119,6 +189,7 @@ using RequestPtr = std::shared_ptr<RequestState>;
 struct PartialBundle {
   uint64_t nstreams = UINT64_MAX;
   uint64_t min_chunksize = 0;
+  uint64_t flags = 0;  // sender-advertised preamble flags (CRC etc.)
   int ctrl_fd = -1;
   std::chrono::steady_clock::time_point first_seen;
   std::map<uint64_t, int> data_fds;  // stream_id -> fd (ordered)
@@ -152,11 +223,12 @@ void WakeListen(ListenSock* ls);
 Status AcceptBundle(ListenSock* ls, PartialBundle* out);
 
 // Open the nstreams+1 connection bundle to a remote handle, writing each
-// preamble. On success data_fds holds nstreams stream-ordered connections
-// and ctrl_fd the ctrl connection; all blocking, TCP_NODELAY set.
+// preamble (flags advertises sender-side options, e.g. kPreambleFlagCrc).
+// On success data_fds holds nstreams stream-ordered connections and ctrl_fd
+// the ctrl connection; all blocking, TCP_NODELAY set.
 Status ConnectBundle(const std::vector<NicInfo>& nics, int32_t dev, const SocketHandle& handle,
-                     uint64_t nstreams, uint64_t min_chunksize, std::vector<int>* data_fds,
-                     int* ctrl_fd);
+                     uint64_t nstreams, uint64_t min_chunksize, uint64_t flags,
+                     std::vector<int>* data_fds, int* ctrl_fd);
 
 }  // namespace tpunet
 

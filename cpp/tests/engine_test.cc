@@ -14,6 +14,7 @@
 #include <thread>
 #include <vector>
 
+#include "tpunet/c_api.h"
 #include "tpunet/net.h"
 #include "tpunet/utils.h"
 
@@ -107,6 +108,161 @@ static void TestInterfaces() {
   }
 }
 
+static void TestCrc32c() {
+  // RFC 3720 B.4 golden vectors.
+  CHECK(Crc32c("123456789", 9) == 0xE3069283u);
+  uint8_t zeros[32] = {0};
+  CHECK(Crc32c(zeros, sizeof(zeros)) == 0x8A9136AAu);
+  uint8_t ffs[32];
+  memset(ffs, 0xFF, sizeof(ffs));
+  CHECK(Crc32c(ffs, sizeof(ffs)) == 0x62A8AB43u);
+  CHECK(Crc32c(nullptr, 0) == 0);
+  // Chaining across a split equals one pass (seeded form).
+  const char* s = "tpunet chunk integrity";
+  uint32_t whole = Crc32c(s, strlen(s));
+  uint32_t part = Crc32c(s, 7);
+  CHECK(Crc32c(s + 7, strlen(s) - 7, part) == whole);
+  // The C ABI wrapper agrees with the library function.
+  CHECK(tpunet_c_crc32c("123456789", 9, 0) == 0xE3069283u);
+}
+
+static void TestFaultSpecParser() {
+  // Valid specs arm cleanly through the C ABI (then always clear).
+  CHECK(tpunet_c_fault_inject("stream=1:after_bytes=1M:action=close") == TPUNET_OK);
+  CHECK(tpunet_c_fault_inject("stream=*:side=recv:action=stall") == TPUNET_OK);
+  CHECK(tpunet_c_fault_inject("action=delay=50:after_bytes=256K") == TPUNET_OK);
+  CHECK(tpunet_c_fault_inject("action=corrupt") == TPUNET_OK);
+  CHECK(tpunet_c_fault_inject(nullptr) == TPUNET_OK);  // NULL clears
+  // Malformed specs are typed invalid-argument failures.
+  CHECK(tpunet_c_fault_inject("nonsense") == TPUNET_ERR_INVALID);
+  CHECK(tpunet_c_fault_inject("stream=1") == TPUNET_ERR_INVALID);          // no action
+  CHECK(tpunet_c_fault_inject("action=explode") == TPUNET_ERR_INVALID);
+  CHECK(tpunet_c_fault_inject("action=delay") == TPUNET_ERR_INVALID);     // no ms
+  CHECK(tpunet_c_fault_inject("stream=bogus:action=close") == TPUNET_ERR_INVALID);
+  CHECK(tpunet_c_fault_inject("after_bytes=1X:action=close") == TPUNET_ERR_INVALID);
+  CHECK(tpunet_c_fault_inject("side=up:action=close") == TPUNET_ERR_INVALID);
+  CHECK(tpunet_c_fault_clear() == TPUNET_OK);
+}
+
+// Wire a fresh BASIC<->BASIC loopback pair; returns comm ids through refs.
+static void WireLoopback(Net* snet, Net* rnet, uint64_t* send_id, uint64_t* recv_id,
+                         uint64_t* listen_id) {
+  SocketHandle handle;
+  CHECK_OK(rnet->listen(0, &handle, listen_id));
+  std::thread acceptor([&] { CHECK_OK(rnet->accept(*listen_id, recv_id)); });
+  CHECK_OK(snet->connect(0, handle, send_id));
+  acceptor.join();
+}
+
+// Single-stream failover: kill one data stream mid-message with an injected
+// fault; the transfer must still complete with intact payload and the comm
+// must keep working at reduced width. Exercises the NACK/FAILOVER marker
+// protocol end to end (this is what the sanitizer lanes pin down).
+static void TestStreamFailover(bool crc) {
+  setenv("TPUNET_CRC", crc ? "1" : "0", 1);
+  fprintf(stderr, "  failover: close on data stream 1 (crc=%d)\n", crc ? 1 : 0);
+  auto snet = CreateBasicEngine();
+  auto rnet = CreateBasicEngine();
+  uint64_t send_id = 0, recv_id = 0, listen_id = 0;
+  WireLoopback(snet.get(), rnet.get(), &send_id, &recv_id, &listen_id);
+
+  CHECK(tpunet_c_fault_inject("stream=1:side=send:after_bytes=2M:action=close") == TPUNET_OK);
+  const size_t kSize = 16 << 20;  // 2 chunks of 8MiB at nstreams=2
+  std::vector<uint8_t> src(kSize), dst(kSize, 0);
+  for (size_t i = 0; i < kSize; ++i) src[i] = static_cast<uint8_t>(i * 13 + 5);
+  uint64_t sreq = 0, rreq = 0;
+  CHECK_OK(rnet->irecv(recv_id, dst.data(), dst.size(), &rreq));
+  CHECK_OK(snet->isend(send_id, src.data(), src.size(), &sreq));
+  size_t got = 0;
+  CHECK_OK(snet->wait(sreq, nullptr));
+  CHECK_OK(rnet->wait(rreq, &got));
+  CHECK(got == kSize);
+  CHECK(memcmp(src.data(), dst.data(), kSize) == 0);
+  CHECK(tpunet_c_fault_clear() == TPUNET_OK);
+
+  // The comm survives at reduced width: a second transfer works.
+  std::vector<uint8_t> src2(3 << 20, 0x5A), dst2(3 << 20, 0);
+  CHECK_OK(rnet->irecv(recv_id, dst2.data(), dst2.size(), &rreq));
+  CHECK_OK(snet->isend(send_id, src2.data(), src2.size(), &sreq));
+  CHECK_OK(snet->wait(sreq, nullptr));
+  CHECK_OK(rnet->wait(rreq, &got));
+  CHECK(got == src2.size());
+  CHECK(memcmp(src2.data(), dst2.data(), src2.size()) == 0);
+
+  CHECK_OK(snet->close_send(send_id));
+  CHECK_OK(rnet->close_recv(recv_id));
+  CHECK_OK(rnet->close_listen(listen_id));
+  unsetenv("TPUNET_CRC");
+}
+
+// Injected wire corruption with CRC on: the receiving REQUEST fails with a
+// typed kCorruption error, the comm does NOT disconnect, and the next
+// message flows clean.
+static void TestCorruptionDetected() {
+  setenv("TPUNET_CRC", "1", 1);
+  fprintf(stderr, "  corruption: flipped byte under TPUNET_CRC=1\n");
+  auto snet = CreateBasicEngine();
+  auto rnet = CreateBasicEngine();
+  uint64_t send_id = 0, recv_id = 0, listen_id = 0;
+  WireLoopback(snet.get(), rnet.get(), &send_id, &recv_id, &listen_id);
+
+  CHECK(tpunet_c_fault_inject("side=send:action=corrupt") == TPUNET_OK);
+  std::vector<uint8_t> src(4 << 20, 0xA7), dst(4 << 20, 0);
+  uint64_t sreq = 0, rreq = 0;
+  CHECK_OK(rnet->irecv(recv_id, dst.data(), dst.size(), &rreq));
+  CHECK_OK(snet->isend(send_id, src.data(), src.size(), &sreq));
+  CHECK_OK(snet->wait(sreq, nullptr));
+  Status rs = rnet->wait(rreq, nullptr);
+  CHECK(!rs.ok());
+  CHECK(rs.kind == ErrorKind::kCorruption);
+  CHECK(rs.msg.find("CRC32C") != std::string::npos);
+  CHECK(tpunet_c_fault_clear() == TPUNET_OK);
+
+  // Not a disconnect: the same comm carries the next message.
+  std::vector<uint8_t> src2(1 << 20, 0x3C), dst2(1 << 20, 0);
+  size_t got = 0;
+  CHECK_OK(rnet->irecv(recv_id, dst2.data(), dst2.size(), &rreq));
+  CHECK_OK(snet->isend(send_id, src2.data(), src2.size(), &sreq));
+  CHECK_OK(snet->wait(sreq, nullptr));
+  CHECK_OK(rnet->wait(rreq, &got));
+  CHECK(got == src2.size());
+  CHECK(memcmp(src2.data(), dst2.data(), src2.size()) == 0);
+
+  CHECK_OK(snet->close_send(send_id));
+  CHECK_OK(rnet->close_recv(recv_id));
+  CHECK_OK(rnet->close_listen(listen_id));
+  unsetenv("TPUNET_CRC");
+}
+
+// Progress watchdog: a recv with no sender traffic gets a typed kTimeout
+// within ~2x the window — never a hang (live-but-stuck peer model).
+static void TestProgressWatchdog(const char* impl) {
+  setenv("TPUNET_PROGRESS_TIMEOUT_MS", "300", 1);
+  fprintf(stderr, "  watchdog: silent peer on %s times out typed\n", impl);
+  auto make = [&]() {
+    return strcmp(impl, "EPOLL") == 0 ? CreateEpollEngine() : CreateBasicEngine();
+  };
+  auto snet = make();
+  auto rnet = make();
+  uint64_t send_id = 0, recv_id = 0, listen_id = 0;
+  WireLoopback(snet.get(), rnet.get(), &send_id, &recv_id, &listen_id);
+
+  std::vector<uint8_t> dst(1 << 20, 0);
+  uint64_t rreq = 0;
+  CHECK_OK(rnet->irecv(recv_id, dst.data(), dst.size(), &rreq));
+  auto t0 = std::chrono::steady_clock::now();
+  Status rs = rnet->wait(rreq, nullptr);
+  double dt = std::chrono::duration<double>(std::chrono::steady_clock::now() - t0).count();
+  CHECK(!rs.ok());
+  CHECK(rs.kind == ErrorKind::kTimeout);
+  CHECK(dt < 5.0);  // 300ms window, generous slack for sanitizer lanes
+
+  snet->close_send(send_id);  // comm already aborted by the watchdog
+  rnet->close_recv(recv_id);
+  rnet->close_listen(listen_id);
+  unsetenv("TPUNET_PROGRESS_TIMEOUT_MS");
+}
+
 static void WaitDone(Net* net, uint64_t req, size_t* nbytes) {
   bool done = false;
   while (!done) {
@@ -186,6 +342,8 @@ int main() {
   TestParse();
   TestSocketIO();
   TestInterfaces();
+  TestCrc32c();
+  TestFaultSpecParser();
   {
     auto basic = CreateBasicEngine();
     TestEngineLoopback(basic.get(), basic.get(), "BASIC <-> BASIC");
@@ -200,6 +358,22 @@ int main() {
     auto ep = CreateEpollEngine();
     TestEngineLoopback(basic.get(), ep.get(), "BASIC -> EPOLL");
     TestEngineLoopback(ep.get(), basic.get(), "EPOLL -> BASIC");
+  }
+  // Failure-containment layer (fault injection, CRC32C, failover, watchdog).
+  TestStreamFailover(/*crc=*/false);
+  TestStreamFailover(/*crc=*/true);
+  TestCorruptionDetected();
+  TestProgressWatchdog("BASIC");
+  TestProgressWatchdog("EPOLL");
+  {
+    // CRC on, no faults: clean sweep still verifies (trailers negotiated).
+    setenv("TPUNET_CRC", "1", 1);
+    auto basic = CreateBasicEngine();
+    auto ep = CreateEpollEngine();
+    TestEngineLoopback(basic.get(), basic.get(), "BASIC <-> BASIC (CRC)");
+    TestEngineLoopback(ep.get(), ep.get(), "EPOLL <-> EPOLL (CRC)");
+    TestEngineLoopback(basic.get(), ep.get(), "BASIC -> EPOLL (CRC)");
+    unsetenv("TPUNET_CRC");
   }
   if (g_failures == 0) {
     printf("OK: all C++ engine tests passed\n");

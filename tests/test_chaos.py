@@ -1,0 +1,346 @@
+"""Chaos suite: deterministic fault injection, CRC32C wire integrity,
+single-stream failover, and the collective progress watchdog.
+
+The old fault-path tests SIGKILL real subprocesses mid-64MiB-allreduce
+(tests/test_fault_paths.py) — worst-case wall clock, and no way to target a
+SPECIFIC stream or byte offset. Here faults are armed through the native
+fault-injection API (``tpunet.transport.fault_inject``), so each failure
+mode is exercised surgically:
+
+  * parser + CRC golden vectors: pure ctypes, no sockets (tier-1 fast);
+  * transport-level failover / corruption / watchdog: two engines over
+    loopback in THIS process, seconds each;
+  * the chaos matrix: every injectable action on each data stream, under a
+    real 2-rank allreduce — each case must end in a correct result
+    (failover) or a typed error within a bounded wait. Never a hang, never
+    a silent wrong answer.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpunet import _native, transport
+
+# ---------------------------------------------------------------------------
+# Fault-spec parser (no sockets).
+
+
+def test_fault_spec_parser_accepts_valid_specs():
+    for spec in (
+        "stream=1:after_bytes=1M:action=close",
+        "stream=*:side=recv:action=stall",
+        "action=delay=50:after_bytes=256K",
+        "action=corrupt",
+        "side=send:stream=0:after_bytes=4096:action=close",
+    ):
+        transport.fault_inject(spec)
+    transport.fault_clear()
+
+
+@pytest.mark.parametrize(
+    "spec, token",
+    [
+        ("nonsense", "nonsense"),
+        ("stream=1", "action"),  # missing action clause
+        ("action=explode", "explode"),
+        ("action=delay", "delay"),  # delay without =<ms>
+        ("stream=bogus:action=close", "bogus"),
+        ("after_bytes=1X:action=close", "1X"),
+        ("side=up:action=close", "up"),
+        ("flavor=spicy:action=close", "flavor"),
+    ],
+)
+def test_fault_spec_parser_rejects_malformed(spec, token):
+    with pytest.raises(_native.NativeError) as ei:
+        transport.fault_inject(spec)
+    assert ei.value.code == _native.TPUNET_ERR_INVALID
+    assert token in str(ei.value)
+    transport.fault_clear()
+
+
+# ---------------------------------------------------------------------------
+# CRC32C golden vectors (no sockets).
+
+
+def _crc32c_ref(data: bytes, crc: int = 0) -> int:
+    """Bit-at-a-time reference (reflected poly 0x82F63B78) to cross-check the
+    native table/hardware implementations on arbitrary inputs."""
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc ^= b
+        for _ in range(8):
+            crc = (crc >> 1) ^ (0x82F63B78 if crc & 1 else 0)
+    return crc ^ 0xFFFFFFFF
+
+
+def test_crc32c_golden_vectors():
+    # RFC 3720 B.4.
+    assert transport.crc32c(b"123456789") == 0xE3069283
+    assert transport.crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert transport.crc32c(b"\xff" * 32) == 0x62A8AB43
+    assert transport.crc32c(b"") == 0
+
+
+def test_crc32c_matches_reference_and_chains():
+    rng = np.random.default_rng(7)
+    for n in (1, 7, 63, 1024):
+        data = rng.integers(0, 256, n, np.uint8).tobytes()
+        assert transport.crc32c(data) == _crc32c_ref(data)
+    whole = b"tpunet chunk integrity"
+    split = transport.crc32c(whole[7:], seed=transport.crc32c(whole[:7]))
+    assert split == transport.crc32c(whole)
+
+
+# ---------------------------------------------------------------------------
+# Config validation (satellite: bad env values fail loudly, naming the var).
+
+
+@pytest.mark.parametrize(
+    "var, value, ok",
+    [
+        ("TPUNET_NSTREAMS", "0", False),
+        ("TPUNET_NSTREAMS", "-3", False),
+        ("TPUNET_NSTREAMS", "4", True),
+        ("BAGUA_NET_NSTREAMS", "0", False),
+        ("TPUNET_MIN_CHUNKSIZE", "-1", False),
+        ("TPUNET_MIN_CHUNKSIZE", "0", False),
+        ("TPUNET_MIN_CHUNKSIZE", "65536", True),
+        ("TPUNET_KEEPALIVE_IDLE_S", "-5", False),
+        ("TPUNET_KEEPALIVE_INTVL_S", "-1", False),
+        ("TPUNET_KEEPALIVE_CNT", "-2", False),
+        ("TPUNET_CONNECT_RETRY_MS", "-100", False),
+        ("TPUNET_PROGRESS_TIMEOUT_MS", "-1", False),
+        ("TPUNET_PROGRESS_TIMEOUT_MS", "5000", True),
+    ],
+)
+def test_config_from_env_validates_ranges(monkeypatch, var, value, ok):
+    from tpunet.config import Config
+
+    monkeypatch.setenv(var, value)
+    if ok:
+        Config.from_env()
+    else:
+        with pytest.raises(ValueError, match=var):
+            Config.from_env()
+
+
+def test_config_nonnumeric_still_falls_back(monkeypatch):
+    # Garbage stays fallback (native GetEnvU64 semantics) — only NUMERIC
+    # out-of-range values are config errors.
+    from tpunet.config import Config
+
+    monkeypatch.setenv("TPUNET_NSTREAMS", "lots")
+    assert Config.from_env().nstreams == 2
+
+
+def test_config_carries_failure_model_knobs(monkeypatch):
+    from tpunet.config import Config
+
+    monkeypatch.setenv("TPUNET_CRC", "1")
+    monkeypatch.setenv("TPUNET_PROGRESS_TIMEOUT_MS", "1234")
+    monkeypatch.setenv("TPUNET_FAULT_SPEC", "stream=0:action=close")
+    cfg = Config.from_env()
+    assert cfg.crc is True
+    assert cfg.progress_timeout_ms == 1234
+    assert cfg.fault_spec == "stream=0:action=close"
+
+
+# ---------------------------------------------------------------------------
+# Transport-level chaos over loopback (two engines in THIS process).
+
+
+def _wire_pair(net_s, net_r):
+    lc = net_r.listen()
+    got = {}
+    th = threading.Thread(target=lambda: got.setdefault("rc", lc.accept()))
+    th.start()
+    sc = net_s.connect(lc.handle)
+    th.join()
+    return sc, got["rc"], lc
+
+
+def test_single_stream_failover_keeps_transfer_intact(monkeypatch):
+    """Kill data stream 1 mid-transfer: the message completes byte-exact via
+    the ctrl-stream retransmit, the comm survives at reduced width, and the
+    failover counter moves."""
+    from tpunet import telemetry
+    from tpunet.transport import Net
+
+    before = telemetry.metrics().get("tpunet_stream_failovers_total", {})
+    before_n = sum(before.values())
+    with Net() as ns, Net() as nr:
+        sc, rc, lc = _wire_pair(ns, nr)
+        try:
+            transport.fault_inject("stream=1:side=send:after_bytes=2M:action=close")
+            for round_ in range(3):  # round 1 arms the byte counter, round 2 trips it
+                src = np.frombuffer(
+                    bytes((i * 31 + round_) & 0xFF for i in range(1 << 20)) * 8,
+                    np.uint8,
+                ).copy()
+                dst = np.zeros_like(src)
+                rreq = rc.irecv(dst)
+                sreq = sc.isend(src)
+                sreq.wait(timeout=60)
+                got = rreq.wait(timeout=60)
+                assert got == src.nbytes
+                np.testing.assert_array_equal(src, dst)
+        finally:
+            transport.fault_clear()
+            for c in (sc, rc, lc):
+                c.close()
+    after = telemetry.metrics().get("tpunet_stream_failovers_total", {})
+    assert sum(after.values()) > before_n
+
+
+def test_crc_detects_injected_corruption_without_disconnect(monkeypatch):
+    """TPUNET_CRC=1: a flipped wire byte fails the REQUEST with a typed
+    CorruptionError; the comm is not poisoned and the next message flows."""
+    monkeypatch.setenv("TPUNET_CRC", "1")
+    from tpunet.transport import Net
+
+    with Net() as ns, Net() as nr:
+        sc, rc, lc = _wire_pair(ns, nr)
+        try:
+            # Clean CRC-verified roundtrip first.
+            src = np.arange(1 << 20, dtype=np.uint8)
+            dst = np.zeros_like(src)
+            rreq = rc.irecv(dst)
+            sc.isend(src).wait(timeout=60)
+            assert rreq.wait(timeout=60) == src.nbytes
+            np.testing.assert_array_equal(src, dst)
+
+            transport.fault_inject("side=send:action=corrupt")
+            dst2 = np.zeros_like(src)
+            rreq = rc.irecv(dst2)
+            sc.isend(src).wait(timeout=60)
+            with pytest.raises(_native.CorruptionError, match="CRC32C"):
+                rreq.wait(timeout=60)
+            transport.fault_clear()
+
+            # Not a disconnect: same comm, next message verifies clean.
+            dst3 = np.zeros_like(src)
+            rreq = rc.irecv(dst3)
+            sc.isend(src).wait(timeout=60)
+            assert rreq.wait(timeout=60) == src.nbytes
+            np.testing.assert_array_equal(src, dst3)
+        finally:
+            transport.fault_clear()
+            for c in (sc, rc, lc):
+                c.close()
+
+
+def test_progress_watchdog_times_out_typed(monkeypatch):
+    """A recv with a silent peer raises ProgressTimeoutError within ~2x the
+    configured window — the live-but-stuck-peer contract."""
+    monkeypatch.setenv("TPUNET_PROGRESS_TIMEOUT_MS", "500")
+    from tpunet.transport import Net
+
+    with Net() as ns, Net() as nr:
+        sc, rc, lc = _wire_pair(ns, nr)
+        dst = np.zeros(1 << 20, np.uint8)
+        rreq = rc.irecv(dst)
+        t0 = time.perf_counter()
+        with pytest.raises(_native.ProgressTimeoutError, match="watchdog"):
+            rreq.wait()  # native blocking wait; the watchdog bounds it
+        assert time.perf_counter() - t0 < 10
+        for c in (sc, rc, lc):
+            try:
+                c.close()
+            except _native.NativeError:
+                pass  # comm already aborted by the watchdog
+
+
+# ---------------------------------------------------------------------------
+# Chaos matrix: every action on each data stream under a 2-rank allreduce.
+# Contract per case: a correct result (failover) or a typed error within a
+# bounded wait — never a hang, never a silent wrong answer; with
+# TPUNET_CRC=1 injected corruption is ALWAYS detected.
+
+
+def _matrix_worker(rank: int, world: int, port: int, q, action: str, stream: int) -> None:
+    try:
+        os.environ["TPUNET_PROGRESS_TIMEOUT_MS"] = "2500"
+        os.environ["TPUNET_CRC"] = "1"
+        from tpunet import _native as nat
+        from tpunet import transport as tp
+        from tpunet.collectives import Communicator
+
+        comm = Communicator(f"127.0.0.1:{port}", rank, world)
+        warm = comm.all_reduce(np.ones(4, np.float32))
+        assert warm[0] == world
+        comm.barrier()
+        if rank == 1:
+            act = "delay=30" if action == "delay" else action
+            tp.fault_inject(f"stream={stream}:after_bytes=256K:action={act}")
+        arr = np.full(1 << 20, float(rank + 1), np.float32)  # 4 MiB
+        t0 = time.perf_counter()
+        try:
+            out = comm.all_reduce(arr)
+            dt = time.perf_counter() - t0
+            correct = bool(np.all(out == 3.0))
+            q.put((rank, f"OK correct={correct} dt={dt:.1f}"))
+        except nat.NativeError as e:
+            dt = time.perf_counter() - t0
+            q.put((rank, f"TYPED code={e.code} dt={dt:.1f}"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+    finally:
+        try:
+            from tpunet import transport as tp
+
+            tp.fault_clear()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+@pytest.mark.parametrize("stream", [0, 1])
+@pytest.mark.parametrize("action", ["close", "stall", "corrupt", "delay"])
+def test_chaos_matrix_never_hangs_never_lies(action, stream):
+    import multiprocessing as mp
+
+    from conftest import free_port
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = free_port()
+    procs = [
+        ctx.Process(target=_matrix_worker, args=(r, 2, port, q, action, stream))
+        for r in range(2)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(2):
+            rank, status = q.get(timeout=150)  # the bounded-wait guarantee
+            results[rank] = status
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.kill()
+    assert len(results) == 2, f"missing rank report: {results}"
+    for rank, status in results.items():
+        assert not status.startswith("FAIL"), f"rank {rank}: {status}"
+        # A completed allreduce must be CORRECT — zero silent wrong answers.
+        assert "correct=False" not in status, f"rank {rank}: {status}"
+        assert status.startswith(("OK", "TYPED")), f"rank {rank}: {status}"
+    statuses = " | ".join(results.values())
+    if action == "delay":
+        # Pure latency: both ranks succeed with correct results.
+        assert all(s.startswith("OK correct=True") for s in results.values()), statuses
+    if action == "stall":
+        # Live-but-stuck: nobody succeeds silently; the watchdog's typed
+        # timeout (code -5) shows up on at least one rank.
+        assert all(s.startswith("TYPED") for s in results.values()), statuses
+        assert f"code={_native.TPUNET_ERR_TIMEOUT}" in statuses, statuses
+    if action == "corrupt":
+        # CRC on: the corruption is always DETECTED — some rank reports the
+        # typed corruption code; nobody reduces damaged data into a result.
+        assert f"code={_native.TPUNET_ERR_CORRUPT}" in statuses, statuses

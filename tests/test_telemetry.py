@@ -65,6 +65,35 @@ def test_metrics_text_parses_without_activity():
     assert any(k.startswith("tpunet_") for k in parsed)
 
 
+def test_metrics_parser_accepts_label_less_lines(monkeypatch):
+    """Prometheus exposition allows plain `name value` lines; the old
+    mandatory-`{labels}` regex silently dropped them from metrics()."""
+    from tpunet import telemetry
+
+    sample = "\n".join(
+        [
+            "# TYPE tpunet_faults_injected counter",
+            "tpunet_faults_injected 3",
+            'tpunet_stream_failovers_total{rank="0"} 2',
+            "tpunet_uptime_seconds 12.5",
+            "tpunet_rate 6.02e+23",
+            "not a metric line at all",
+            "tpunet_bad_value{rank=\"0\"} oops",
+        ]
+    )
+    monkeypatch.setattr(telemetry, "metrics_text", lambda: sample)
+    parsed = telemetry.metrics()
+    assert parsed["tpunet_faults_injected"][()] == 3.0
+    assert parsed["tpunet_stream_failovers_total"][('rank="0"',)] == 2.0
+    assert parsed["tpunet_uptime_seconds"][()] == 12.5
+    assert parsed["tpunet_rate"][()] == 6.02e23
+    assert "tpunet_bad_value" not in parsed
+    # The native exposition's label-less faults total parses too.
+    monkeypatch.undo()
+    real = telemetry.metrics()
+    assert () in real["tpunet_faults_injected"]
+
+
 def _push_worker(rank: int, world: int, port: int, q) -> None:
     """Point the native pushgateway client at an in-process HTTP sink and
     check one push arrives (reference: Prometheus push thread with basic

@@ -22,6 +22,10 @@ TPUNET_OK = 0
 TPUNET_ERR_NULL = -1
 TPUNET_ERR_INVALID = -2
 TPUNET_ERR_INNER = -3
+# Failure-model codes (docs/DESIGN.md "Failure model"):
+TPUNET_ERR_CORRUPT = -4   # per-chunk CRC32C mismatch (TPUNET_CRC=1)
+TPUNET_ERR_TIMEOUT = -5   # progress watchdog (TPUNET_PROGRESS_TIMEOUT_MS)
+TPUNET_ERR_VERSION = -6   # wire-framing version mismatch with the peer
 
 HANDLE_SIZE = 64
 
@@ -174,6 +178,13 @@ def load() -> ctypes.CDLL:
     lib.tpunet_c_trace_flush.argtypes = []
     lib.tpunet_c_trace_flush.restype = i32
 
+    lib.tpunet_c_fault_inject.argtypes = [ctypes.c_char_p]
+    lib.tpunet_c_fault_inject.restype = i32
+    lib.tpunet_c_fault_clear.argtypes = []
+    lib.tpunet_c_fault_clear.restype = i32
+    lib.tpunet_c_crc32c.argtypes = [ctypes.c_void_p, u64, ctypes.c_uint32]
+    lib.tpunet_c_crc32c.restype = ctypes.c_uint32
+
     _lib = lib
     return lib
 
@@ -191,6 +202,31 @@ class NativeError(RuntimeError):
         super().__init__(f"tpunet native {op} failed (code {code}): {last_error()}")
 
 
+class CorruptionError(NativeError):
+    """Wire payload failed its per-chunk CRC32C check (TPUNET_CRC=1).
+
+    The affected request failed but the comm did NOT disconnect — retrying
+    the collective on the same communicator is legitimate; repeated
+    corruption means a bad NIC/path and warrants a rebuild."""
+
+
+class ProgressTimeoutError(NativeError):
+    """The progress watchdog (TPUNET_PROGRESS_TIMEOUT_MS) saw a request move
+    zero bytes for a full window: the peer is alive but stuck. Classified as
+    a comm failure by tpunet.train.elastic — same recovery as a dead peer."""
+
+
+class VersionMismatchError(NativeError):
+    """The peer speaks a different tpunet wire-framing version."""
+
+
+_TYPED_ERRORS = {
+    TPUNET_ERR_CORRUPT: CorruptionError,
+    TPUNET_ERR_TIMEOUT: ProgressTimeoutError,
+    TPUNET_ERR_VERSION: VersionMismatchError,
+}
+
+
 def check(code: int, op: str) -> None:
     if code != TPUNET_OK:
-        raise NativeError(code, op)
+        raise _TYPED_ERRORS.get(code, NativeError)(code, op)

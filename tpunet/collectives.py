@@ -107,7 +107,16 @@ class AsyncResult:
 
 class Communicator:
     """Ring communicator; rank/world/coordinator default from env
-    (TPUNET_RANK/RANK, TPUNET_WORLD_SIZE/WORLD_SIZE, TPUNET_COORDINATOR)."""
+    (TPUNET_RANK/RANK, TPUNET_WORLD_SIZE/WORLD_SIZE, TPUNET_COORDINATOR).
+
+    Failure model (docs/DESIGN.md): collectives raise typed subclasses of
+    ``_native.NativeError`` — ``CorruptionError`` for a CRC32C-detected wire
+    corruption (TPUNET_CRC=1; the comm survives), ``ProgressTimeoutError``
+    when the progress watchdog (TPUNET_PROGRESS_TIMEOUT_MS) flags a
+    live-but-stuck peer, and plain NativeError for disconnect/poison. A
+    single data-stream loss is NOT an error: the transport fails over onto
+    the surviving streams and the collective completes (see
+    ``tpunet_stream_failovers_total`` in telemetry.metrics())."""
 
     def __init__(
         self,

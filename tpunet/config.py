@@ -22,6 +22,33 @@ def _env_int(name: str, fallback: int) -> int:
         return fallback
 
 
+def _env_int_checked(names: tuple[str, ...], fallback: int, minimum: int,
+                     what: str) -> int:
+    """Read the first set env var in `names`; a NUMERIC value below `minimum`
+    raises ValueError naming the offending var.
+
+    The silent-fallback behavior of _env_int let ``TPUNET_NSTREAMS=0`` or a
+    negative keepalive window flow into the native layer (which clamps or
+    ignores them) without the operator ever learning their config was
+    nonsense. Out-of-range numbers now fail loudly at Config.from_env();
+    non-numeric garbage still falls back, matching the native GetEnvU64
+    reader so the two layers never disagree on the effective value."""
+    for name in names:
+        v = os.environ.get(name)
+        if v is None or v == "":
+            continue
+        try:
+            n = int(v)
+        except ValueError:
+            return fallback  # native GetEnvU64 semantics: garbage -> default
+        if n < minimum:
+            raise ValueError(
+                f"{name}={v} is invalid: {what} must be >= {minimum}"
+            )
+        return n
+    return fallback
+
+
 @dataclass(frozen=True)
 class Config:
     """Snapshot of tpunet env configuration at construction time."""
@@ -86,15 +113,35 @@ class Config:
     # inline dispatch + immediate-IO fast path (0 = pure event loop).
     epoll_threads: int = 2
     epoll_inline: bool = True
+    # ---- Failure model (docs/DESIGN.md "Failure model") ------------------
+    # Per-chunk CRC32C trailers on data streams; negotiated in the connect
+    # preamble (the sender's setting wins on the receiving side). Detected
+    # corruption fails the REQUEST with a typed error — not a disconnect.
+    crc: bool = False
+    # Progress watchdog: a blocking wait whose request moves zero bytes for
+    # this many ms raises a typed timeout (0 = off). Catches live-but-stuck
+    # peers that TCP keepalive never flags; elastic recovery treats the
+    # timeout like a dead peer.
+    progress_timeout_ms: int = 0
+    # Deterministic fault to arm at engine creation (chaos testing), e.g.
+    # "stream=1:after_bytes=1M:action=close". Empty = none.
+    fault_spec: str = ""
 
     @staticmethod
     def from_env() -> "Config":
+        """Snapshot env config, validating range-sensitive knobs: zero/negative
+        nstreams, non-positive min_chunksize, and negative keepalive/retry/
+        watchdog windows raise ValueError naming the offending env var
+        instead of flowing into the native layer unchecked."""
         env = os.environ
         return Config(
             implement=env.get("TPUNET_IMPLEMENT", env.get("BAGUA_NET_IMPLEMENT", "BASIC")),
-            nstreams=_env_int("TPUNET_NSTREAMS", _env_int("BAGUA_NET_NSTREAMS", 2)),
-            min_chunksize=_env_int(
-                "TPUNET_MIN_CHUNKSIZE", _env_int("BAGUA_NET_MIN_CHUNKSIZE", 1 << 20)
+            nstreams=_env_int_checked(
+                ("TPUNET_NSTREAMS", "BAGUA_NET_NSTREAMS"), 2, 1, "data-stream count"
+            ),
+            min_chunksize=_env_int_checked(
+                ("TPUNET_MIN_CHUNKSIZE", "BAGUA_NET_MIN_CHUNKSIZE"), 1 << 20, 1,
+                "minimum chunk size",
             ),
             # GetEnvU64 semantics like the native reader: non-numeric -> 0.
             spin=_env_int("TPUNET_SPIN", 0) != 0,
@@ -110,10 +157,18 @@ class Config:
             socket_bufsize=_env_int("TPUNET_SOCKET_BUFSIZE", 0),
             ring_chunksize=_env_int("TPUNET_RING_CHUNKSIZE", 8 << 20),
             reduce_threads=_env_int("TPUNET_REDUCE_THREADS", 0),
-            keepalive_idle_s=_env_int("TPUNET_KEEPALIVE_IDLE_S", 30),
-            keepalive_intvl_s=_env_int("TPUNET_KEEPALIVE_INTVL_S", 10),
-            keepalive_cnt=_env_int("TPUNET_KEEPALIVE_CNT", 3),
-            connect_retry_ms=_env_int("TPUNET_CONNECT_RETRY_MS", 10_000),
+            keepalive_idle_s=_env_int_checked(
+                ("TPUNET_KEEPALIVE_IDLE_S",), 30, 0, "keepalive idle window"
+            ),
+            keepalive_intvl_s=_env_int_checked(
+                ("TPUNET_KEEPALIVE_INTVL_S",), 10, 0, "keepalive probe interval"
+            ),
+            keepalive_cnt=_env_int_checked(
+                ("TPUNET_KEEPALIVE_CNT",), 3, 0, "keepalive probe count"
+            ),
+            connect_retry_ms=_env_int_checked(
+                ("TPUNET_CONNECT_RETRY_MS",), 10_000, 0, "connect retry window"
+            ),
             async_channels=_env_int("TPUNET_ASYNC_CHANNELS", 2),
             a2a=env.get("TPUNET_A2A", "pairwise"),
             a2a_mesh_max_world=_env_int("TPUNET_A2A_MESH_MAX_WORLD", 32),
@@ -125,4 +180,9 @@ class Config:
             # the inventory reports the thread count that actually runs.
             epoll_threads=max(1, _env_int("TPUNET_EPOLL_THREADS", 2)),
             epoll_inline=_env_int("TPUNET_EPOLL_INLINE", 1) != 0,
+            crc=_env_int("TPUNET_CRC", 0) != 0,
+            progress_timeout_ms=_env_int_checked(
+                ("TPUNET_PROGRESS_TIMEOUT_MS",), 0, 0, "progress watchdog window"
+            ),
+            fault_spec=env.get("TPUNET_FAULT_SPEC", ""),
         )

@@ -37,11 +37,16 @@ def metrics_text() -> str:
         cap = n + 256
 
 
-_LINE = re.compile(r"^(\w+)\{([^}]*)\}\s+([0-9.eE+-]+)$")
+# Prometheus exposition line: the `{labels}` block is OPTIONAL — plain
+# `name value` lines are valid exposition and the old mandatory-braces
+# pattern silently dropped them from metrics().
+_LINE = re.compile(r"^(\w+)(?:\{([^}]*)\})?\s+([0-9.eE+-]+|[+-]?Inf|NaN)$")
 
 
 def metrics() -> dict:
-    """Parse the Prometheus text into {name: {(label=value, ...): float}}."""
+    """Parse the Prometheus text into {name: {(label=value, ...): float}}.
+
+    Lines without a label block parse to the empty label tuple ()."""
     out: dict = {}
     for line in metrics_text().splitlines():
         if line.startswith("#"):

@@ -73,7 +73,13 @@ def is_comm_failure(exc: BaseException) -> bool:
     err`` sets __cause__). Implicit __context__ is deliberately NOT walked:
     an unrelated error raised while handling a comm error (say, a NaN-loss
     ValueError inside an except block) must still propagate, not be
-    "recovered" into silent restarts."""
+    "recovered" into silent restarts.
+
+    The typed failure-model errors are NativeError subclasses and classify
+    accordingly: a ProgressTimeoutError (TPUNET_PROGRESS_TIMEOUT_MS — peer
+    alive but stuck) triggers the SAME generation rebuild as a dead peer,
+    and a CorruptionError (CRC32C mismatch under TPUNET_CRC=1) rebuilds
+    rather than silently reducing damaged gradients."""
     seen: set[int] = set()
     cur: BaseException | None = exc
     while cur is not None and id(cur) not in seen:

@@ -19,6 +19,37 @@ import numpy as np
 from tpunet import _native
 
 
+def fault_inject(spec: str) -> None:
+    """Arm a deterministic transport fault process-wide (chaos testing).
+
+    ``spec`` uses the native grammar, e.g. ``"stream=1:after_bytes=1M:
+    action=close"`` — see docs/DESIGN.md "Failure model" for the full
+    vocabulary (close / stall / corrupt / delay=<ms>). One fault at a time;
+    re-arming replaces it and resets the byte counters. Raises NativeError
+    (INVALID) naming the bad token for a malformed spec. The env knob
+    TPUNET_FAULT_SPEC arms the same slot at engine creation."""
+    lib = _native.load()
+    _native.check(lib.tpunet_c_fault_inject(spec.encode()), "fault_inject")
+
+
+def fault_clear() -> None:
+    """Disarm any injected fault (safe to call when none is armed)."""
+    lib = _native.load()
+    _native.check(lib.tpunet_c_fault_clear(), "fault_clear")
+
+
+def crc32c(data: Any, seed: int = 0) -> int:
+    """CRC32C (Castagnoli) of a bytes-like object via the native library —
+    the same routine that integrity-protects wire chunks under TPUNET_CRC=1.
+    Chain calls by passing the previous value as ``seed``."""
+    lib = _native.load()
+    mv = memoryview(data)
+    if not mv.c_contiguous:
+        raise ValueError("crc32c needs a C-contiguous buffer")
+    buf = bytes(mv) if mv.nbytes else b""
+    return int(lib.tpunet_c_crc32c(buf, mv.nbytes, seed & 0xFFFFFFFF))
+
+
 def _as_buffer(obj: Any, writable: bool) -> tuple[int, int, Any]:
     """Return (address, nbytes, pin) for bytes/bytearray/numpy/memoryview."""
     if isinstance(obj, np.ndarray):
