@@ -154,14 +154,28 @@ int32_t tpunet_comm_ticket_wait(uintptr_t comm, uint64_t ticket);
 int32_t tpunet_comm_ticket_test(uintptr_t comm, uint64_t ticket, uint8_t* done);
 
 /* ---- Telemetry ---------------------------------------------------------
- * Metrics counters are process-global and always on; spans/push are gated by
- * env (TPUNET_TRACE_DIR / TPUNET_METRICS_ADDR, rank 0-7 — the reference's
- * gating, nthread:108-130). */
+ * Metrics counters are process-global and always on; spans/push/scrape are
+ * gated by env (TPUNET_TRACE_DIR / TPUNET_METRICS_ADDR /
+ * TPUNET_METRICS_PORT, rank 0-7 — the reference's gating, nthread:108-130).
+ * Deep observability (docs/DESIGN.md "Observability"): per-stream
+ * TCP_INFO gauges + Jain fairness + straggler events
+ * (TPUNET_TCPINFO_INTERVAL_MS, TPUNET_STRAGGLER_FACTOR), request
+ * stage-latency histograms (tpunet_req_{queue,wire,total}_us), and
+ * collective phase spans tagged (comm_id, coll_seq, phase). */
 /* Write the Prometheus text exposition into buf (NUL-terminated, truncated
  * to cap). Returns the full length (excluding NUL), or a TPUNET_ERR_*. */
 int32_t tpunet_c_metrics_text(char* buf, uint64_t cap);
-/* Flush buffered trace spans to TPUNET_TRACE_DIR (no-op when disabled). */
+/* Zero every metric counter/histogram/gauge (trace spans and the in-flight
+ * gauge are untouched) so tests and benchmark warmups don't bleed counters
+ * into measurement windows. */
+int32_t tpunet_c_metrics_reset(void);
+/* Flush buffered trace spans to the trace file (no-op when disabled). The
+ * file is valid Chrome-trace JSON after every flush. */
 int32_t tpunet_c_trace_flush(void);
+/* Runtime-(re)target tracing at `dir` (tpunet.telemetry.profile()): starts
+ * tracing even when TPUNET_TRACE_DIR was unset at load. NULL or "" flushes
+ * and disables. */
+int32_t tpunet_c_trace_set_dir(const char* dir);
 
 #ifdef __cplusplus
 }

@@ -1,4 +1,5 @@
-// tpunet observability: per-request tracing + transport metrics.
+// tpunet observability: per-request tracing + transport metrics + deep
+// per-stream TCP introspection.
 //
 // TPU-native re-design of the reference's OpenTelemetry stack (SURVEY §5;
 // reference: nthread_per_socket_backend.rs:108-212): no third-party SDK,
@@ -6,21 +7,32 @@
 //
 // Tracing (reference: root span "BaguaNet-{rank}" nthread:132-137, child
 // span per isend/irecv with id+nbytes attrs :529-538, ended at test()
-// completion :606): spans are buffered and flushed as Chrome-trace JSON
-// (loadable in Perfetto) to TPUNET_TRACE_DIR/tpunet-trace-rank<R>.json.
-// Env-gated exactly like the reference (rank 0-7 AND the address var set,
-// nthread:108-130).
+// completion :606): spans are buffered and flushed as VALID Chrome-trace
+// JSON (json.load-able, Perfetto-loadable) to
+// TPUNET_TRACE_DIR/tpunet-trace-rank<R>.json. Env-gated like the reference
+// (rank 0-7 AND the dir var set, nthread:108-130), or enabled at runtime via
+// tpunet_c_trace_set_dir() / tpunet.telemetry.profile(). Besides request
+// spans the file carries collective phase spans tagged
+// (comm_id, coll_seq, phase) — the cross-rank join key merge_traces() uses
+// to align per-rank files into one timeline — and straggler instant events.
 //
 // Metrics (reference: isend/irecv_nbytes histograms with boundaries
 // [16,1024,4096,1048576] nthread:139-180, bytes/s observers :343-348,
 // in-flight gauge tokio:184-190): counters are always-on atomics; a push
-// thread POSTs Prometheus text to a pushgateway at TPUNET_METRICS_ADDR
-// ("user:pass@host:port", basic auth, reference utils.rs:180-198) every
-// TPUNET_METRICS_INTERVAL_MS (default 1000 — the reference pushed every
-// 200 µs, nthread:183-211, which SURVEY flags as a bug we do not copy).
+// thread PUTs Prometheus text to a pushgateway at TPUNET_METRICS_ADDR every
+// TPUNET_METRICS_INTERVAL_MS (default 1000), and an on-demand scrape
+// listener serves the same exposition at http://:TPUNET_METRICS_PORT/metrics.
+//
+// TCP introspection: a rate-limited getsockopt(TCP_INFO) sampler on the
+// engines' data paths (TPUNET_TCPINFO_INTERVAL_MS per stream slot, default
+// 100, 0 disables) exports per-stream RTT / retransmit / cwnd /
+// delivery-rate gauges, a Jain's-fairness gauge over windowed per-stream
+// bytes, and a straggler detector (smoothed RTT > k× the median across
+// active streams -> tpunet_straggler_events_total + a trace instant event).
 #ifndef TPUNET_TELEMETRY_H_
 #define TPUNET_TELEMETRY_H_
 
+#include <atomic>
 #include <cstdint>
 #include <memory>
 #include <string>
@@ -34,6 +46,12 @@ namespace tpunet {
 constexpr uint64_t kHistBounds[4] = {16, 1024, 4096, 1048576};
 constexpr int kHistBuckets = 5;
 
+// Stage-latency histogram bounds in microseconds (+Inf bucket appended):
+// post->first-wire-byte (queue), first->last wire byte (wire), and
+// post->completion (total) land in these.
+constexpr uint64_t kStageHistBounds[7] = {50, 200, 1000, 5000, 20000, 100000, 1000000};
+constexpr int kStageHistBuckets = 8;
+
 // Per-stream byte counters cap (streams beyond this lump into the last slot;
 // default nstreams is 2-8, so 32 covers every sane config).
 constexpr int kMaxStreamStats = 32;
@@ -41,6 +59,25 @@ constexpr int kMaxStreamStats = 32;
 // Fault-injection action slots for tpunet_faults_injected_total (indices
 // match FaultAction in src/fault.h; 0 is unused).
 constexpr int kFaultActionSlots = 5;
+
+// Last getsockopt(TCP_INFO) sample for one stream slot. When several comms
+// share a stream index the last-sampled socket wins — gauges describe "a
+// live connection at this stream position", which is what stream-skew
+// triage needs (per-comm split would be unbounded cardinality).
+struct StreamTcpSample {
+  uint64_t rtt_us = 0;            // tcpi_rtt
+  uint64_t srtt_us = 0;           // EWMA over samples (straggler detector input)
+  uint64_t retrans_total = 0;     // tcpi_total_retrans of the sampled socket
+  uint64_t cwnd = 0;              // tcpi_snd_cwnd (segments)
+  uint64_t delivery_rate_bps = 0; // tcpi_delivery_rate * 8 (0 on old kernels)
+  bool sampled = false;
+};
+
+struct StageHist {
+  uint64_t buckets[kStageHistBuckets] = {0};
+  uint64_t sum_us = 0;
+  uint64_t count = 0;
+};
 
 struct MetricsSnapshot {
   uint64_t isend_count = 0;
@@ -62,6 +99,15 @@ struct MetricsSnapshot {
   // per-stream effective-time observers instead, nthread:343-348).
   uint64_t stream_tx_bytes[kMaxStreamStats] = {0};
   uint64_t stream_rx_bytes[kMaxStreamStats] = {0};
+  // Deep-observability additions (docs/DESIGN.md "Observability"):
+  StreamTcpSample stream_tcp_tx[kMaxStreamStats];
+  StreamTcpSample stream_tcp_rx[kMaxStreamStats];
+  double fairness_tx = 1.0;     // Jain's index over windowed per-stream bytes
+  double fairness_rx = 1.0;
+  uint64_t straggler_events = 0;
+  StageHist req_queue_us;       // post -> first wire byte
+  StageHist req_wire_us;        // first -> last wire byte
+  StageHist req_total_us;       // post -> completion
   double uptime_s = 0;          // for bytes/s derivation
 };
 
@@ -77,6 +123,21 @@ class Telemetry {
   // Engine hot-path hook: `nbytes` moved on data-stream `stream_idx`
   // (relaxed atomic add; indices >= kMaxStreamStats clamp to the last slot).
   void OnStreamBytes(bool is_send, uint64_t stream_idx, uint64_t nbytes);
+  // Rate-limited TCP_INFO sampler: called from the engines' data paths after
+  // chunk IO with the live socket. Costs one clock read + one relaxed atomic
+  // compare when the slot's sampling window has not elapsed; otherwise does
+  // the getsockopt, updates the slot's gauges, and runs the straggler check.
+  void MaybeSampleStream(bool is_send, uint64_t stream_idx, int fd);
+  // Stage-latency accounting, called by the engines when a successful request
+  // is consumed by test()/wait(). Timestamps are MonotonicUs(); completion
+  // time is "now". post_us == 0 (no stamp) is ignored.
+  void OnRequestStages(uint64_t post_us, uint64_t first_wire_us, uint64_t last_wire_us);
+  // Collective phase span (collectives.cc): buffered into the trace file as
+  // a Chrome-trace X event tagged {comm_id, coll_seq} — the cross-rank join
+  // key. No-op when tracing is off (callers should pre-check
+  // tracing_enabled() to skip building the phase string).
+  void OnCollPhase(uint64_t comm_id, uint64_t coll_seq, const char* phase,
+                   uint64_t start_us, uint64_t dur_us, uint64_t nbytes);
   // Failure-containment hooks (cold paths). `action` indexes FaultAction.
   void OnFaultInjected(int action);
   void OnStreamFailover();
@@ -84,17 +145,27 @@ class Telemetry {
 
   MetricsSnapshot Snapshot() const;
   // Prometheus text exposition of the snapshot (also what the push thread
-  // sends).
+  // sends and the scrape listener serves). Every family carries adjacent
+  // # HELP / # TYPE lines (text-format lint clean).
   std::string PrometheusText() const;
+  // Zero every counter/histogram/gauge (trace spans and the in-flight gauge
+  // are untouched) so tests and benchmark warmups don't bleed into
+  // measurement windows. Also restarts the uptime/fairness windows.
+  void Reset();
 
-  bool tracing_enabled() const { return trace_enabled_; }
+  bool tracing_enabled() const { return trace_enabled_.load(std::memory_order_relaxed); }
+  // Runtime-(re)target tracing at `dir` (empty = flush and disable). Used by
+  // tpunet_c_trace_set_dir() / telemetry.profile() so a profile can start
+  // after the library loaded without TPUNET_TRACE_DIR.
+  bool SetTraceDir(const std::string& dir);
   // Write buffered spans to the trace file; called on buffer pressure, from
   // tpunet_c_trace_flush(), and at process exit (atexit — the singleton is
-  // leaked so its destructor never runs). Returns false when the trace file
-  // could not be written (spans are dropped); true on success or when tracing
-  // is disabled.
+  // leaked so its destructor never runs). The file is valid JSON after every
+  // flush. Returns false when the trace file could not be written (spans are
+  // dropped); true on success or when tracing is disabled.
   bool FlushTrace();
-  // Stop the push thread and flush; atexit hook (safe to call repeatedly).
+  // Stop the push/scrape threads and flush; atexit hook (safe to call
+  // repeatedly).
   void ShutdownForExit();
 
   ~Telemetry();
@@ -103,7 +174,7 @@ class Telemetry {
   Telemetry();
   struct Impl;
   std::unique_ptr<Impl> impl_;
-  bool trace_enabled_ = false;
+  std::atomic<bool> trace_enabled_{false};
 };
 
 // Decorator installed by CreateEngine() around the selected engine so both

@@ -83,6 +83,12 @@ uint64_t DecodeU64BE(const uint8_t in[8]);
 std::string GetEnv(const char* name, const std::string& fallback = "");
 uint64_t GetEnvU64(const char* name, uint64_t fallback);
 
+// CLOCK_MONOTONIC in microseconds — the shared clock for telemetry stage
+// timestamps and trace spans. Monotonic is machine-wide (per-boot), so spans
+// from different processes on ONE host share a timeline; cross-host traces
+// are aligned by collective tags in merge_traces() instead.
+uint64_t MonotonicUs();
+
 // Fork-generation counter: bumps in the child after every fork() (via a
 // pthread_atfork handler registered on first call). Threads do not survive
 // fork, so anything owning a thread records ForkGeneration() at creation and

@@ -304,6 +304,14 @@ using CommPtr = std::shared_ptr<Comm>;
 // retransmit paths: whoever settles the message (last chunk) releases the
 // comm's inflight slot, re-arming the inline fast path.
 void AccountChunkDone(Comm* c, const RequestPtr& state, size_t len) {
+  if (len > 0) {
+    // Stage-latency stamps: every completion path (worker, lazy, failover
+    // retransmit) marks last-wire here; the CAS-from-0 start is a fallback
+    // for paths that never stamped the true IO start (retransmits).
+    uint64_t now = MonotonicUs();
+    state->MarkWireStart(now);
+    state->MarkWireEnd(now);
+  }
   state->nbytes.fetch_add(len, std::memory_order_relaxed);
   uint64_t prior = state->completed.fetch_add(1, std::memory_order_acq_rel);
   uint64_t tot = state->total.load(std::memory_order_acquire);
@@ -462,6 +470,7 @@ void SendWorkerLoop(StreamWorker* w, bool spin) {
   Comm* c = w->comm;
   ChunkTask t;
   while (w->tasks.Pop(&t)) {
+    t.state->MarkWireStart(MonotonicUs());  // queue stage ends at first chunk IO
     FaultAction fa = FaultCheck(true, w->idx, w->fd, t.len);
     Status s;
     if (fa == FaultAction::kCorrupt) {
@@ -494,6 +503,7 @@ void SendWorkerLoop(StreamWorker* w, bool spin) {
       return;
     }
     Telemetry::Get().OnStreamBytes(true, w->idx, t.len);
+    Telemetry::Get().MaybeSampleStream(true, w->idx, w->fd);
     FinishChunk(w, t);
   }
 }
@@ -504,6 +514,7 @@ void RecvWorkerLoop(StreamWorker* w, bool spin) {
   Comm* c = w->comm;
   ChunkTask t;
   while (w->tasks.Pop(&t)) {
+    t.state->MarkWireStart(MonotonicUs());
     FaultAction fa = FaultCheck(false, w->idx, w->fd, t.len);
     Status s = ReadExact(w->fd, t.data, t.len, spin);
     uint32_t wire_crc = 0;
@@ -534,6 +545,7 @@ void RecvWorkerLoop(StreamWorker* w, bool spin) {
                             ": payload corrupted in transit");
     } else {
       Telemetry::Get().OnStreamBytes(false, w->idx, t.len);
+      Telemetry::Get().MaybeSampleStream(false, w->idx, w->fd);
     }
     PopRec(c, w->idx, t.seq);
     FinishChunk(w, t);
@@ -1000,6 +1012,7 @@ void ExecuteLazyRecv(Comm* c, const Msg& m) {
   ctrl_lk.unlock();
   if (!dead) {
     StreamWorker* w = c->workers[idx].get();
+    m.state->MarkWireStart(MonotonicUs());
     Status rs = ReadExact(w->fd, m.data, len, c->spin);
     uint32_t wire_crc = 0;
     if (rs.ok() && c->crc) rs = ReadChunkCrc(w->fd, &wire_crc, c->spin);
@@ -1011,6 +1024,7 @@ void ExecuteLazyRecv(Comm* c, const Msg& m) {
                               ": payload corrupted in transit");
       } else {
         Telemetry::Get().OnStreamBytes(false, idx, len);
+        Telemetry::Get().MaybeSampleStream(false, idx, w->fd);
       }
       PopRec(c, idx, seq);
       AccountChunkDone(c, m.state, len);
@@ -1104,6 +1118,7 @@ class BasicEngine : public EngineBase {
       return Status::Inner("send comm created before fork(); its threads do not exist here");
     }
     auto state = std::make_shared<RequestState>();
+    state->t_post_us = MonotonicUs();
     ArmWatchdog(state, c);
     uint64_t id = next_id_.fetch_add(1);
     requests_.Put(id, state);
@@ -1133,6 +1148,7 @@ class BasicEngine : public EngineBase {
       return Status::Inner("recv comm created before fork(); its threads do not exist here");
     }
     auto state = std::make_shared<RequestState>();
+    state->t_post_us = MonotonicUs();
     ArmWatchdog(state, c);
     uint64_t id = next_id_.fetch_add(1);
     requests_.Put(id, state);
@@ -1192,6 +1208,7 @@ class BasicEngine : public EngineBase {
     *done = state->Done();
     if (*done) {
       if (nbytes) *nbytes = state->nbytes.load(std::memory_order_acquire);
+      RecordRequestStages(state);
       requests_.Erase(request);  // reference leaked these (bagua_net.cc:111-121)
     }
     return Status::Ok();

@@ -439,9 +439,21 @@ int32_t tpunet_c_metrics_text(char* buf, uint64_t cap) {
   return static_cast<int32_t>(text.size());
 }
 
+int32_t tpunet_c_metrics_reset(void) {
+  tpunet::Telemetry::Get().Reset();
+  return TPUNET_OK;
+}
+
 int32_t tpunet_c_trace_flush(void) {
   if (!tpunet::Telemetry::Get().FlushTrace()) {
     return Fail(TPUNET_ERR_INNER, "trace file unwritable; spans dropped");
+  }
+  return TPUNET_OK;
+}
+
+int32_t tpunet_c_trace_set_dir(const char* dir) {
+  if (!tpunet::Telemetry::Get().SetTraceDir(dir ? dir : "")) {
+    return Fail(TPUNET_ERR_INNER, "trace flush failed while retargeting");
   }
   return TPUNET_OK;
 }

@@ -26,6 +26,7 @@
 #include <vector>
 
 #include "tpunet/bootstrap.h"
+#include "tpunet/telemetry.h"
 #include "tpunet/utils.h"
 
 namespace tpunet {
@@ -276,6 +277,37 @@ void Reduce(void* dst, const void* a, const void* b, size_t n, DType dtype,
 // always < world) on the shared listener.
 constexpr uint64_t kRingHelloTag = 0x52494E47ull << 32;  // "RING"
 
+// RAII trace span around one collective phase. Every rank runs the same
+// collective program, so (comm_id, coll_seq, phase) names the SAME logical
+// phase on every rank — the cross-rank join key telemetry.merge_traces()
+// aligns per-rank trace files with. Zero cost when tracing is off (the
+// caller passes tracing_enabled() as `on`; no string is built either way
+// until the destructor fires with on=true).
+class PhaseSpan {
+ public:
+  PhaseSpan(bool on, uint64_t comm_id, uint64_t seq, const char* kind, int step,
+            uint64_t nbytes)
+      : on_(on), comm_id_(comm_id), seq_(seq), kind_(kind), step_(step),
+        nbytes_(nbytes), start_us_(on ? MonotonicUs() : 0) {}
+  ~PhaseSpan() {
+    if (!on_) return;
+    std::string phase =
+        step_ < 0 ? std::string(kind_) : std::string(kind_) + "." + std::to_string(step_);
+    Telemetry::Get().OnCollPhase(comm_id_, seq_, phase.c_str(), start_us_,
+                                 MonotonicUs() - start_us_, nbytes_);
+  }
+  PhaseSpan(const PhaseSpan&) = delete;
+  PhaseSpan& operator=(const PhaseSpan&) = delete;
+
+ private:
+  bool on_;
+  uint64_t comm_id_, seq_;
+  const char* kind_;
+  int step_;
+  uint64_t nbytes_;
+  uint64_t start_us_;
+};
+
 class RingCommunicator : public Communicator {
  public:
   // A channel is one independent ring: a send comm to (rank+1)%W and a recv
@@ -310,6 +342,12 @@ class RingCommunicator : public Communicator {
 
   Status Init(const std::string& coordinator) {
     net_ = CreateEngine();
+    // Trace identity: every rank hashes the SAME coordinator string and
+    // world size, so (comm_id, coll_seq) tags agree across ranks without a
+    // wire round. |1 keeps it nonzero even for a degenerate hash.
+    trace_comm_id_ =
+        (static_cast<uint64_t>(Crc32c(coordinator.data(), coordinator.size())) |
+         (static_cast<uint64_t>(world_) << 32)) | 1ull;
     channels_.resize(1);
     Status s = Bootstrap::Create(coordinator, rank_, world_, &bootstrap_);
     if (!s.ok()) return s;
@@ -373,7 +411,7 @@ class RingCommunicator : public Communicator {
     // directly (no worker hop) — also the kill switch for the ticketed path.
     if (AsyncChannelCount() == 1) {
       FenceAsync();
-      return DoAllReduce(sendbuf, recvbuf, count, dtype, op, channels_[0]);
+      return DoAllReduce(sendbuf, recvbuf, count, dtype, op, channels_[0], ++coll_seq_);
     }
     // Fence first: the documented contract is that a blocking collective
     // orders AFTER all outstanding tickets (callers rely on it for buffer
@@ -386,7 +424,7 @@ class RingCommunicator : public Communicator {
   }
 
   Status DoAllReduce(const void* sendbuf, void* recvbuf, size_t count, DType dtype,
-                     RedOp op, RingChannel& ch) {
+                     RedOp op, RingChannel& ch, uint64_t seq) {
     size_t esize = DTypeSize(dtype);
     if (esize == 0) return Status::Invalid("bad dtype");
     if (count == 0) return Status::Ok();
@@ -394,6 +432,8 @@ class RingCommunicator : public Communicator {
       if (sendbuf != recvbuf) memcpy(recvbuf, sendbuf, count * esize);
       return Status::Ok();
     }
+    const bool tracing = Telemetry::Get().tracing_enabled();
+    PhaseSpan whole(tracing, trace_comm_id_, seq, "allreduce", -1, count * esize);
     const uint8_t* src = static_cast<const uint8_t*>(sendbuf);
     uint8_t* data = static_cast<uint8_t*>(recvbuf);
     // Out-of-place with DISJOINT buffers needs no staging copy at all:
@@ -428,6 +468,7 @@ class RingCommunicator : public Communicator {
       // operand still lives in sendbuf on the no-copy path.
       const uint8_t* sptr =
           ((oop && s == 0) ? src : data) + off(sidx) * esize;
+      PhaseSpan step(tracing, trace_comm_id_, seq, "rs", s, sbytes);
       Status st = ExchangeReduce(sptr, sbytes, data + off(ridx) * esize,
                                  rbytes, dtype, op, ch,
                                  oop ? src + off(ridx) * esize : nullptr);
@@ -438,6 +479,7 @@ class RingCommunicator : public Communicator {
       int ridx = (rank_ - s - 1 + W) % W;
       size_t sbytes = (off(sidx + 1) - off(sidx)) * esize;
       size_t rbytes = (off(ridx + 1) - off(ridx)) * esize;
+      PhaseSpan step(tracing, trace_comm_id_, seq, "ag", s, sbytes);
       Status st = Exchange(data + off(sidx) * esize, sbytes, data + off(ridx) * esize,
                            rbytes, nullptr, ch);
       if (!st.ok()) return st;
@@ -459,6 +501,10 @@ class RingCommunicator : public Communicator {
     size_t block = recv_count * esize;
     const uint8_t* src = static_cast<const uint8_t*>(sendbuf);
     uint8_t* out = static_cast<uint8_t*>(recvbuf);
+    const bool tracing = Telemetry::Get().tracing_enabled();
+    const uint64_t seq = ++coll_seq_;
+    PhaseSpan whole(tracing, trace_comm_id_, seq, "reduce_scatter", -1,
+                    static_cast<uint64_t>(W) * block);
     if (out < src + static_cast<size_t>(W) * block && src < out + block) {
       // Overlapping C-ABI buffers: keep the safe full-copy path.
       work_.resize(static_cast<size_t>(W) * block);
@@ -467,6 +513,7 @@ class RingCommunicator : public Communicator {
       for (int s = 0; s < W - 1; ++s) {
         int sidx = (vr0 - s + W) % W;
         int ridx = (vr0 - s - 1 + W) % W;
+        PhaseSpan step(tracing, trace_comm_id_, seq, "rs", s, block);
         Status st = ExchangeReduce(work_.data() + sidx * block, block,
                                    work_.data() + ridx * block, block, dtype, op, channels_[0]);
         if (!st.ok()) return st;
@@ -495,6 +542,7 @@ class RingCommunicator : public Communicator {
       int ridx = (vr - s - 1 + W) % W;
       const uint8_t* sptr = (s == 0) ? src + sidx * block : pb[(s - 1) & 1];
       uint8_t* optr = (s == W - 2) ? out : pb[s & 1];
+      PhaseSpan step(tracing, trace_comm_id_, seq, "rs", s, block);
       Status st = ExchangeReduce(sptr, block, optr, block, dtype, op,
                                  channels_[0], src + ridx * block);
       if (!st.ok()) return st;
@@ -510,9 +558,14 @@ class RingCommunicator : public Communicator {
       memcpy(out + rank_ * bytes_per_rank, sendbuf, bytes_per_rank);
     }
     if (W == 1 || bytes_per_rank == 0) return Status::Ok();
+    const bool tracing = Telemetry::Get().tracing_enabled();
+    const uint64_t seq = ++coll_seq_;
+    PhaseSpan whole(tracing, trace_comm_id_, seq, "all_gather", -1,
+                    static_cast<uint64_t>(W) * bytes_per_rank);
     for (int s = 0; s < W - 1; ++s) {
       int sidx = (rank_ - s + W) % W;
       int ridx = (rank_ - s - 1 + W) % W;
+      PhaseSpan step(tracing, trace_comm_id_, seq, "ag", s, bytes_per_rank);
       Status st = Exchange(out + sidx * bytes_per_rank, bytes_per_rank,
                            out + ridx * bytes_per_rank, bytes_per_rank, nullptr, channels_[0]);
       if (!st.ok()) return st;
@@ -525,6 +578,8 @@ class RingCommunicator : public Communicator {
     const int W = world_;
     if (W == 1 || nbytes == 0) return Status::Ok();
     if (root < 0 || root >= W) return Status::Invalid("bad broadcast root");
+    PhaseSpan whole(Telemetry::Get().tracing_enabled(), trace_comm_id_, ++coll_seq_,
+                    "broadcast", -1, nbytes);
     uint8_t* data = static_cast<uint8_t*>(buf);
     int dist = (rank_ - root + W) % W;          // hops from root along the ring
     bool is_tail = dist == W - 1;               // last rank forwards nothing
@@ -568,6 +623,8 @@ class RingCommunicator : public Communicator {
       memcpy(out + rank_ * B, in + rank_ * B, B);  // own block stays local
     }
     if (W == 1 || B == 0) return Status::Ok();
+    PhaseSpan whole(Telemetry::Get().tracing_enabled(), trace_comm_id_, ++coll_seq_,
+                    "all_to_all", -1, static_cast<uint64_t>(W) * B);
     // Direct pairwise exchange by default: O(W*B) bytes on the wire per
     // rank vs the ring relay's O(W^2*B/2) — the difference between usable
     // and quadratic cross-host MoE dispatch / DCN-Ulysses at pod scale.
@@ -753,6 +810,8 @@ class RingCommunicator : public Communicator {
       if (got) *got = send_nbytes;
       return Status::Ok();
     }
+    PhaseSpan whole(Telemetry::Get().tracing_enabled(), trace_comm_id_, ++coll_seq_,
+                    "neighbor_exchange", -1, send_nbytes);
     return Exchange(sendbuf, send_nbytes, recvbuf, recv_nbytes, got, channels_[0]);
   }
 
@@ -781,12 +840,16 @@ class RingCommunicator : public Communicator {
       }
     }
     uint64_t t = next_ticket_++;
+    // Trace seq is claimed at SUBMISSION (same order on every rank), not at
+    // execution, so spans from overlapping tickets keep cross-rank-stable
+    // tags.
+    uint64_t seq = ++coll_seq_;
     // Deterministic ticket→channel map: submission order is already the
     // cross-rank contract for nonblocking collectives, so every rank routes
     // ticket t to the same ring and messages pair up peer-to-peer.
     size_t ch = (t - 1) % queues_.size();
-    queues_[ch].emplace_back(t, [this, sendbuf, recvbuf, count, dtype, op, ch] {
-      return DoAllReduce(sendbuf, recvbuf, count, dtype, op, channels_[ch]);
+    queues_[ch].emplace_back(t, [this, sendbuf, recvbuf, count, dtype, op, ch, seq] {
+      return DoAllReduce(sendbuf, recvbuf, count, dtype, op, channels_[ch], seq);
     });
     *ticket = t;
     work_cv_.notify_all();
@@ -1104,6 +1167,12 @@ class RingCommunicator : public Communicator {
   std::unique_ptr<Net> net_;
   std::unique_ptr<Bootstrap> bootstrap_;
   uint64_t listen_comm_ = 0;
+  // Collective tracing identity: comm_id hashes (coordinator, world) — the
+  // same on every rank — and coll_seq_ counts collectives in program order
+  // (MPI semantics make the program identical across ranks), so
+  // (trace_comm_id_, coll_seq_, phase) tags match rank-to-rank.
+  uint64_t trace_comm_id_ = 0;
+  uint64_t coll_seq_ = 0;
   // channels_[0] is the Init-wired ring every blocking collective uses;
   // channels_[1..] are wired by EnsureAsyncChannels for overlapping async
   // tickets. Stable after the first IAllReduce (workers capture indices).

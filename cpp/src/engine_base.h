@@ -14,6 +14,7 @@
 
 #include "id_map.h"
 #include "tpunet/net.h"
+#include "tpunet/telemetry.h"
 #include "tpunet/utils.h"
 #include "wire.h"
 
@@ -121,6 +122,16 @@ class EngineBase : public Net {
       Status st = test(request, &done, nbytes);
       if (!st.ok() || done) return st;
     }
+  }
+
+  // Stage-latency accounting at the request consumption point (the engine's
+  // test() when it reports done; wait() funnels through test via WaitIn).
+  // Shared here so the engines cannot diverge on WHEN a request's queue/wire
+  // split is folded into the tpunet_req_{queue,wire,total}_us histograms.
+  static void RecordRequestStages(const RequestPtr& state) {
+    Telemetry::Get().OnRequestStages(
+        state->t_post_us, state->t_first_wire_us.load(std::memory_order_relaxed),
+        state->t_last_wire_us.load(std::memory_order_relaxed));
   }
 
   Status CheckDev(int32_t dev) const {

@@ -495,6 +495,7 @@ class Loop {
         FaultAction fa = FaultCheck(c->is_send, fs->stream_idx, fs->fd, seg.len - seg.done);
         if (fa == FaultAction::kCorrupt) seg.corrupt = true;
       }
+      const bool first_payload_io = !fs->is_ctrl && !in_trailer && seg.done == 0;
       ssize_t m;
       if (in_trailer) {
         if (c->is_send && seg.corrupt && seg.trailer_done == 0) {
@@ -534,11 +535,12 @@ class Loop {
                                       ": payload corrupted in transit");
             }
           }
-          CompleteSegment(seg);
+          CompleteSegment(seg, fs);
           fs->segs.pop_front();
           continue;
         }
         if (!fs->is_ctrl) {
+          if (first_payload_io) seg.state->MarkWireStart(MonotonicUs());
           Telemetry::Get().OnStreamBytes(c->is_send, fs->stream_idx,
                                          static_cast<uint64_t>(m));
         }
@@ -549,7 +551,7 @@ class Loop {
             seg.data[seg.len / 2] ^= 0x01;  // CRC off: silent wire damage
             seg.corrupt = false;
           }
-          CompleteSegment(seg);
+          CompleteSegment(seg, fs);
           fs->segs.pop_front();
           continue;
         }
@@ -615,9 +617,13 @@ class Loop {
     }
   }
 
-  void CompleteSegment(Segment& seg) {
+  void CompleteSegment(Segment& seg, FdState* fs) {
     if (seg.counts_bytes) {
       seg.state->nbytes.fetch_add(seg.len, std::memory_order_relaxed);
+      seg.state->MarkWireEnd(MonotonicUs());
+      // Rate-limited TCP_INFO sample off the chunk's live socket (per-chunk,
+      // never per-partial-read — the limiter check is one clock + atomic).
+      Telemetry::Get().MaybeSampleStream(fs->comm->is_send, fs->stream_idx, fs->fd);
     }
     seg.state->completed.fetch_add(1, std::memory_order_acq_rel);
     seg.state->NotifyIfSettled();
@@ -746,6 +752,7 @@ class EpollEngine : public EngineBase {
     *done = state->Done();
     if (*done) {
       if (nbytes) *nbytes = state->nbytes.load(std::memory_order_acquire);
+      RecordRequestStages(state);
       requests_.Erase(request);
     }
     return Status::Ok();
@@ -807,6 +814,7 @@ class EpollEngine : public EngineBase {
       return Status::Invalid("unknown comm " + std::to_string(comm_id));
     }
     auto state = std::make_shared<RequestState>();
+    state->t_post_us = MonotonicUs();
     if (watchdog_ms_ > 0) {
       // Progress-watchdog abort hook: a timeout verdict in WaitIn shuts the
       // comm's sockets down; the loop then observes EPOLLHUP/EOF and fails

@@ -1,11 +1,17 @@
 // tpunet telemetry implementation. See include/tpunet/telemetry.h.
 #include "tpunet/telemetry.h"
 
+#include <errno.h>
 #include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <stddef.h>
 #include <string.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -20,17 +26,20 @@
 namespace tpunet {
 namespace {
 
-uint64_t NowUs() {
-  return std::chrono::duration_cast<std::chrono::microseconds>(
-             std::chrono::steady_clock::now().time_since_epoch())
-      .count();
-}
+uint64_t NowUs() { return MonotonicUs(); }
 
 int HistBucket(uint64_t nbytes) {
   for (int i = 0; i < kHistBuckets - 1; ++i) {
     if (nbytes <= kHistBounds[i]) return i;
   }
   return kHistBuckets - 1;
+}
+
+int StageBucket(uint64_t us) {
+  for (int i = 0; i < kStageHistBuckets - 1; ++i) {
+    if (us <= kStageHistBounds[i]) return i;
+  }
+  return kStageHistBuckets - 1;
 }
 
 int64_t RankFromEnv() {
@@ -71,13 +80,35 @@ std::string Base64(const std::string& in) {
   return out;
 }
 
+// Linux UAPI struct tcp_info layout through tcpi_delivery_rate (the glibc
+// copy in <netinet/tcp.h> predates the delivery-rate fields on many
+// distros). getsockopt fills min(optlen, kernel size) and reports the filled
+// length, so reads past what the running kernel provides are guarded by the
+// returned length.
+struct TcpInfoCompat {
+  uint8_t state, ca_state, retransmits, probes, backoff, options, wscale, flags;
+  uint32_t rto, ato, snd_mss, rcv_mss;
+  uint32_t unacked, sacked, lost, retrans, fackets;
+  uint32_t last_data_sent, last_ack_sent, last_data_recv, last_ack_recv;
+  uint32_t pmtu, rcv_ssthresh, rtt, rttvar, snd_ssthresh, snd_cwnd, advmss, reordering;
+  uint32_t rcv_rtt, rcv_space;
+  uint32_t total_retrans;
+  uint64_t pacing_rate, max_pacing_rate, bytes_acked, bytes_received;
+  uint32_t segs_out, segs_in;
+  uint32_t notsent_bytes, min_rtt, data_segs_in, data_segs_out;
+  uint64_t delivery_rate;  // bytes/sec
+};
+
 struct Span {
-  bool is_send;
-  uint64_t comm;
-  uint64_t req;
-  uint64_t nbytes;
-  uint64_t start_us;
-  uint64_t dur_us;
+  enum class Kind : uint8_t { kReq, kColl, kInstant };
+  Kind kind = Kind::kReq;
+  bool is_send = false;
+  uint64_t comm = 0;    // kReq: comm id | kColl: comm_id | kInstant: stream idx
+  uint64_t req = 0;     // kReq: request id | kColl: coll_seq | kInstant: srtt
+  uint64_t nbytes = 0;  // kReq/kColl: bytes | kInstant: median srtt
+  uint64_t start_us = 0;
+  uint64_t dur_us = 0;
+  std::string name;     // kColl: phase | kInstant: event name
 };
 
 // Request ids are engine-local (each instance counts from 1), so open spans
@@ -88,6 +119,70 @@ struct SpanKeyHash {
     return std::hash<uint64_t>()(k.first * 0x9e3779b97f4a7c15ull ^ k.second);
   }
 };
+
+// Per-stream-slot TCP introspection state: the rate limiter plus the last
+// sample's gauges, all relaxed atomics (last writer wins is fine for gauges).
+struct StreamTcpState {
+  std::atomic<uint64_t> next_sample_us{0};
+  std::atomic<uint64_t> rtt_us{0};
+  std::atomic<uint64_t> srtt_us{0};
+  std::atomic<uint64_t> retrans_total{0};
+  std::atomic<uint64_t> cwnd{0};
+  std::atomic<uint64_t> delivery_rate_bps{0};
+  std::atomic<uint8_t> sampled{0};
+  std::atomic<uint8_t> straggling{0};  // hysteresis: count rising edges only
+};
+
+struct StageHistAtomic {
+  std::atomic<uint64_t> buckets[kStageHistBuckets] = {};
+  std::atomic<uint64_t> sum_us{0};
+  std::atomic<uint64_t> count{0};
+
+  void Observe(uint64_t us) {
+    buckets[StageBucket(us)].fetch_add(1, std::memory_order_relaxed);
+    sum_us.fetch_add(us, std::memory_order_relaxed);
+    count.fetch_add(1, std::memory_order_relaxed);
+  }
+  void SnapshotInto(StageHist* out) const {
+    for (int i = 0; i < kStageHistBuckets; ++i) {
+      out->buckets[i] = buckets[i].load(std::memory_order_relaxed);
+    }
+    out->sum_us = sum_us.load(std::memory_order_relaxed);
+    out->count = count.load(std::memory_order_relaxed);
+  }
+  void Reset() {
+    for (auto& b : buckets) b.store(0, std::memory_order_relaxed);
+    sum_us.store(0, std::memory_order_relaxed);
+    count.store(0, std::memory_order_relaxed);
+  }
+};
+
+double BitsToDouble(uint64_t bits) {
+  double d;
+  memcpy(&d, &bits, sizeof(d));
+  return d;
+}
+uint64_t DoubleToBits(double d) {
+  uint64_t bits;
+  memcpy(&bits, &d, sizeof(bits));
+  return bits;
+}
+
+// Jain's fairness index (sum x)^2 / (n * sum x^2) over the nonzero entries;
+// 1.0 when nothing moved (vacuously fair).
+double JainIndex(const uint64_t* deltas, int n) {
+  double sum = 0, sumsq = 0;
+  int active = 0;
+  for (int i = 0; i < n; ++i) {
+    if (deltas[i] == 0) continue;
+    double x = static_cast<double>(deltas[i]);
+    sum += x;
+    sumsq += x * x;
+    ++active;
+  }
+  if (active == 0 || sumsq == 0) return 1.0;
+  return (sum * sum) / (active * sumsq);
+}
 
 }  // namespace
 
@@ -104,8 +199,36 @@ struct Telemetry::Impl {
   std::atomic<uint64_t> faults_injected[kFaultActionSlots] = {};
   std::atomic<uint64_t> stream_failovers{0};
   std::atomic<uint64_t> crc_errors{0};
-  uint64_t start_us = NowUs();
+  std::atomic<uint64_t> start_us{NowUs()};
   int64_t rank = RankFromEnv();
+
+  // Stage-latency histograms (always on; fed by the engines at request
+  // consumption).
+  StageHistAtomic req_queue, req_wire, req_total;
+
+  // TCP introspection (always on unless TPUNET_TCPINFO_INTERVAL_MS=0).
+  uint64_t tcp_interval_us =
+      GetEnvU64("TPUNET_TCPINFO_INTERVAL_MS", 100) * 1000;
+  uint64_t straggler_factor = GetEnvU64("TPUNET_STRAGGLER_FACTOR", 3);
+  // RTT floor below which nothing counts as a straggler — loopback and
+  // intra-rack RTTs jitter by whole multiples without meaning anything.
+  uint64_t straggler_min_rtt_us = GetEnvU64("TPUNET_STRAGGLER_MIN_RTT_US", 1000);
+  StreamTcpState tcp_tx[kMaxStreamStats];
+  StreamTcpState tcp_rx[kMaxStreamStats];
+  std::atomic<uint64_t> straggler_events{0};
+
+  // Fairness window (win_mu): Jain's index over per-stream byte deltas
+  // between rolls. Rolled lazily from Snapshot() at most once per
+  // TPUNET_FAIRNESS_WINDOW_MS; the first roll covers everything since
+  // start/Reset (deterministic for tests).
+  std::mutex win_mu;
+  bool win_init = false;
+  uint64_t win_last_us = 0;
+  uint64_t fairness_window_us = GetEnvU64("TPUNET_FAIRNESS_WINDOW_MS", 1000) * 1000;
+  uint64_t win_tx[kMaxStreamStats] = {0};
+  uint64_t win_rx[kMaxStreamStats] = {0};
+  std::atomic<uint64_t> fair_tx_bits{DoubleToBits(1.0)};
+  std::atomic<uint64_t> fair_rx_bits{DoubleToBits(1.0)};
 
   // Span tracking (tracing only).
   std::mutex span_mu;
@@ -124,6 +247,10 @@ struct Telemetry::Impl {
   std::mutex push_mu;
   std::condition_variable push_cv;
   bool stopping = false;
+
+  // On-demand /metrics scrape listener (TPUNET_METRICS_PORT).
+  std::thread scraper;
+  std::atomic<bool> scrape_stop{false};
 };
 
 Telemetry& Telemetry::Get() {
@@ -133,9 +260,13 @@ Telemetry& Telemetry::Get() {
 
 namespace {
 // The leaked singleton's destructor never runs, so final trace flush and
-// pusher shutdown are driven by atexit instead (registered only when some
-// telemetry sink is enabled).
+// pusher/scraper shutdown are driven by atexit instead (registered once,
+// when any telemetry sink is enabled).
 void TelemetryAtExit() { Telemetry::Get().ShutdownForExit(); }
+std::once_flag g_atexit_once;
+void RegisterAtExit() {
+  std::call_once(g_atexit_once, [] { std::atexit(TelemetryAtExit); });
+}
 }  // namespace
 
 Telemetry::Telemetry() : impl_(new Impl()) {
@@ -145,15 +276,14 @@ Telemetry::Telemetry() : impl_(new Impl()) {
     // but writes local Chrome-trace JSON — there is no Jaeger agent here.
     impl_->trace_path =
         trace_dir + "/tpunet-trace-rank" + std::to_string(impl_->rank) + ".json";
-    trace_enabled_ = true;
+    trace_enabled_.store(true, std::memory_order_relaxed);
+    RegisterAtExit();
   }
 
   std::string addr = GetEnv("TPUNET_METRICS_ADDR", GetEnv("TPUNET_PROMETHEUS_ADDRESS",
                             GetEnv("BAGUA_NET_PROMETHEUS_ADDRESS", "")));
-  if (trace_enabled_ || (!addr.empty() && RankGate())) {
-    std::atexit(TelemetryAtExit);
-  }
   if (!addr.empty() && RankGate()) {
+    RegisterAtExit();
     uint64_t interval_ms = GetEnvU64("TPUNET_METRICS_INTERVAL_MS", 1000);
     if (interval_ms == 0) interval_ms = 1000;
     impl_->pusher = std::thread([this, addr, interval_ms] {
@@ -195,15 +325,62 @@ Telemetry::Telemetry() : impl_(new Impl()) {
       }
     });
   }
+
+  // On-demand Prometheus scrape endpoint: GET http://host:PORT/metrics.
+  // Each rank needs its own port (first binder wins on a shared one); the
+  // pusher and the listener are independent — either or both may be on.
+  uint64_t scrape_port = GetEnvU64("TPUNET_METRICS_PORT", 0);
+  if (scrape_port != 0 && scrape_port < 65536 && RankGate()) {
+    RegisterAtExit();
+    impl_->scraper = std::thread([this, scrape_port] {
+      int lfd = ::socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+      if (lfd < 0) return;
+      int one = 1;
+      ::setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+      sockaddr_in sa = {};
+      sa.sin_family = AF_INET;
+      sa.sin_port = htons(static_cast<uint16_t>(scrape_port));
+      sa.sin_addr.s_addr = htonl(INADDR_ANY);
+      if (::bind(lfd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0 ||
+          ::listen(lfd, 16) != 0) {
+        fprintf(stderr, "[tpunet] /metrics listener: cannot bind port %llu: %s\n",
+                (unsigned long long)scrape_port, strerror(errno));
+        ::close(lfd);
+        return;
+      }
+      while (!impl_->scrape_stop.load(std::memory_order_acquire)) {
+        struct pollfd pfd = {lfd, POLLIN, 0};
+        int pr = ::poll(&pfd, 1, 200);
+        if (pr <= 0) continue;
+        int cfd = ::accept(lfd, nullptr, nullptr);
+        if (cfd < 0) continue;
+        // Drain whatever request line arrived (any path gets the exposition;
+        // a scraper that sends nothing within the poll window still gets it).
+        char reqbuf[1024];
+        struct pollfd cpfd = {cfd, POLLIN, 0};
+        if (::poll(&cpfd, 1, 250) > 0) {
+          (void)!::recv(cfd, reqbuf, sizeof(reqbuf), MSG_DONTWAIT);
+        }
+        std::string body = PrometheusText();
+        std::string resp =
+            "HTTP/1.1 200 OK\r\nContent-Type: text/plain; version=0.0.4\r\n"
+            "Content-Length: " + std::to_string(body.size()) +
+            "\r\nConnection: close\r\n\r\n" + body;
+        (void)!::send(cfd, resp.data(), resp.size(), MSG_NOSIGNAL);
+        ::close(cfd);
+      }
+      ::close(lfd);
+    });
+  }
 }
 
 Telemetry::~Telemetry() { ShutdownForExit(); }
 
 void Telemetry::ShutdownForExit() {
   // Forked child (atexit hooks registered pre-fork still run at its exit()):
-  // the pusher pthread never existed here and the mutexes below may have been
-  // captured locked at fork — skip the shutdown handshake entirely; the
-  // parent owns the final flush.
+  // the pusher/scraper pthreads never existed here and the mutexes below may
+  // have been captured locked at fork — skip the shutdown handshake
+  // entirely; the parent owns the final flush.
   if (ForkGeneration() != impl_->created_fork_gen) return;
   if (impl_->pusher.joinable()) {
     {
@@ -213,7 +390,29 @@ void Telemetry::ShutdownForExit() {
     impl_->push_cv.notify_all();
     impl_->pusher.join();
   }
+  if (impl_->scraper.joinable()) {
+    impl_->scrape_stop.store(true, std::memory_order_release);
+    impl_->scraper.join();
+  }
   FlushTrace();
+}
+
+bool Telemetry::SetTraceDir(const std::string& dir) {
+  // Flush under the old target first so no buffered span lands in the wrong
+  // file (or is lost on disable).
+  FlushTrace();
+  Impl* im = impl_.get();
+  std::lock_guard<std::mutex> lk(im->span_mu);
+  if (dir.empty()) {
+    trace_enabled_.store(false, std::memory_order_relaxed);
+    im->open_spans.clear();
+    return true;
+  }
+  im->trace_path = dir + "/tpunet-trace-rank" + std::to_string(im->rank) + ".json";
+  im->trace_header_written = false;
+  trace_enabled_.store(true, std::memory_order_relaxed);
+  RegisterAtExit();
+  return true;
 }
 
 void Telemetry::OnRequestStart(uint64_t owner, bool is_send, uint64_t comm, uint64_t req,
@@ -229,9 +428,16 @@ void Telemetry::OnRequestStart(uint64_t owner, bool is_send, uint64_t comm, uint
     im->irecv_hist[HistBucket(nbytes)].fetch_add(1, std::memory_order_relaxed);
   }
   im->inflight.fetch_add(1, std::memory_order_relaxed);
-  if (trace_enabled_) {
+  if (tracing_enabled()) {
+    Span s;
+    s.kind = Span::Kind::kReq;
+    s.is_send = is_send;
+    s.comm = comm;
+    s.req = req;
+    s.nbytes = nbytes;
+    s.start_us = NowUs();
     std::lock_guard<std::mutex> lk(im->span_mu);
-    im->open_spans[SpanKey{owner, req}] = Span{is_send, comm, req, nbytes, NowUs(), 0};
+    im->open_spans[SpanKey{owner, req}] = std::move(s);
   }
 }
 
@@ -243,7 +449,7 @@ void Telemetry::OnRequestDone(uint64_t owner, uint64_t req, bool failed) {
          !im->inflight.compare_exchange_weak(cur, cur - 1, std::memory_order_relaxed)) {
   }
   if (failed) im->failed.fetch_add(1, std::memory_order_relaxed);
-  if (!trace_enabled_) return;
+  if (!tracing_enabled()) return;
   bool flush = false;
   {
     std::lock_guard<std::mutex> lk(im->span_mu);
@@ -252,7 +458,7 @@ void Telemetry::OnRequestDone(uint64_t owner, uint64_t req, bool failed) {
     Span s = it->second;
     im->open_spans.erase(it);
     s.dur_us = NowUs() - s.start_us;
-    im->done_spans.push_back(s);
+    im->done_spans.push_back(std::move(s));
     flush = im->done_spans.size() >= 4096;
   }
   if (flush) FlushTrace();
@@ -262,6 +468,110 @@ void Telemetry::OnStreamBytes(bool is_send, uint64_t stream_idx, uint64_t nbytes
   if (stream_idx >= kMaxStreamStats) stream_idx = kMaxStreamStats - 1;
   auto& slot = is_send ? impl_->stream_tx[stream_idx] : impl_->stream_rx[stream_idx];
   slot.fetch_add(nbytes, std::memory_order_relaxed);
+}
+
+void Telemetry::MaybeSampleStream(bool is_send, uint64_t stream_idx, int fd) {
+  Impl* im = impl_.get();
+  if (im->tcp_interval_us == 0 || fd < 0) return;
+  if (stream_idx >= kMaxStreamStats) stream_idx = kMaxStreamStats - 1;
+  StreamTcpState* slots = is_send ? im->tcp_tx : im->tcp_rx;
+  StreamTcpState& slot = slots[stream_idx];
+  uint64_t now = NowUs();
+  uint64_t due = slot.next_sample_us.load(std::memory_order_relaxed);
+  if (now < due) return;
+  // One sampler per slot per window: losing the CAS means a sibling thread
+  // is already doing this window's getsockopt.
+  if (!slot.next_sample_us.compare_exchange_strong(due, now + im->tcp_interval_us,
+                                                   std::memory_order_relaxed)) {
+    return;
+  }
+  TcpInfoCompat ti = {};
+  socklen_t len = sizeof(ti);
+  if (::getsockopt(fd, IPPROTO_TCP, TCP_INFO, &ti, &len) != 0) return;
+  if (len < offsetof(TcpInfoCompat, total_retrans) + sizeof(uint32_t)) return;
+  uint64_t rtt = ti.rtt;  // µs already
+  slot.rtt_us.store(rtt, std::memory_order_relaxed);
+  uint64_t old_srtt = slot.srtt_us.load(std::memory_order_relaxed);
+  uint64_t srtt = old_srtt == 0 ? rtt : (3 * old_srtt + rtt) / 4;
+  slot.srtt_us.store(srtt, std::memory_order_relaxed);
+  slot.retrans_total.store(ti.total_retrans, std::memory_order_relaxed);
+  slot.cwnd.store(ti.snd_cwnd, std::memory_order_relaxed);
+  if (len >= offsetof(TcpInfoCompat, delivery_rate) + sizeof(uint64_t)) {
+    slot.delivery_rate_bps.store(ti.delivery_rate * 8, std::memory_order_relaxed);
+  }
+  slot.sampled.store(1, std::memory_order_relaxed);
+
+  // Straggler check: this stream's smoothed RTT vs the median across the
+  // active same-direction streams. Hysteresis (rising edge only) keeps a
+  // persistently slow stream from inflating the counter every sample.
+  if (srtt < im->straggler_min_rtt_us || im->straggler_factor == 0) {
+    slot.straggling.store(0, std::memory_order_relaxed);
+    return;
+  }
+  std::vector<uint64_t> srtts;
+  srtts.reserve(kMaxStreamStats);
+  for (int i = 0; i < kMaxStreamStats; ++i) {
+    if (slots[i].sampled.load(std::memory_order_relaxed)) {
+      srtts.push_back(slots[i].srtt_us.load(std::memory_order_relaxed));
+    }
+  }
+  if (srtts.size() < 2) return;
+  std::nth_element(srtts.begin(), srtts.begin() + srtts.size() / 2, srtts.end());
+  uint64_t median = srtts[srtts.size() / 2];
+  if (median > 0 && srtt > im->straggler_factor * median) {
+    if (!slot.straggling.exchange(1, std::memory_order_relaxed)) {
+      im->straggler_events.fetch_add(1, std::memory_order_relaxed);
+      if (tracing_enabled()) {
+        Span s;
+        s.kind = Span::Kind::kInstant;
+        s.is_send = is_send;
+        s.comm = stream_idx;
+        s.req = srtt;
+        s.nbytes = median;
+        s.start_us = now;
+        s.name = "straggler-stream" + std::to_string(stream_idx);
+        std::lock_guard<std::mutex> lk(im->span_mu);
+        im->done_spans.push_back(std::move(s));
+      }
+    }
+  } else {
+    slot.straggling.store(0, std::memory_order_relaxed);
+  }
+}
+
+void Telemetry::OnRequestStages(uint64_t post_us, uint64_t first_wire_us,
+                                uint64_t last_wire_us) {
+  if (post_us == 0) return;  // engine predates stamping / synthetic request
+  Impl* im = impl_.get();
+  uint64_t done_us = NowUs();
+  if (done_us < post_us) return;
+  im->req_total.Observe(done_us - post_us);
+  if (last_wire_us == 0) return;  // zero-byte message: no wire stage
+  if (first_wire_us == 0 || first_wire_us < post_us) first_wire_us = last_wire_us;
+  if (first_wire_us < post_us || last_wire_us < first_wire_us) return;
+  im->req_queue.Observe(first_wire_us - post_us);
+  im->req_wire.Observe(last_wire_us - first_wire_us);
+}
+
+void Telemetry::OnCollPhase(uint64_t comm_id, uint64_t coll_seq, const char* phase,
+                            uint64_t start_us, uint64_t dur_us, uint64_t nbytes) {
+  if (!tracing_enabled()) return;
+  Impl* im = impl_.get();
+  Span s;
+  s.kind = Span::Kind::kColl;
+  s.comm = comm_id;
+  s.req = coll_seq;
+  s.nbytes = nbytes;
+  s.start_us = start_us;
+  s.dur_us = dur_us;
+  s.name = phase;
+  bool flush = false;
+  {
+    std::lock_guard<std::mutex> lk(im->span_mu);
+    im->done_spans.push_back(std::move(s));
+    flush = im->done_spans.size() >= 4096;
+  }
+  if (flush) FlushTrace();
 }
 
 void Telemetry::OnFaultInjected(int action) {
@@ -277,13 +587,109 @@ void Telemetry::OnCrcError() {
   impl_->crc_errors.fetch_add(1, std::memory_order_relaxed);
 }
 
+void Telemetry::Reset() {
+  Impl* im = impl_.get();
+  im->isend_count.store(0, std::memory_order_relaxed);
+  im->irecv_count.store(0, std::memory_order_relaxed);
+  im->isend_bytes.store(0, std::memory_order_relaxed);
+  im->irecv_bytes.store(0, std::memory_order_relaxed);
+  for (int i = 0; i < kHistBuckets; ++i) {
+    im->isend_hist[i].store(0, std::memory_order_relaxed);
+    im->irecv_hist[i].store(0, std::memory_order_relaxed);
+  }
+  // inflight is deliberately NOT reset: it tracks live requests whose done
+  // events will still arrive — zeroing it would make them wrap the clamp.
+  im->failed.store(0, std::memory_order_relaxed);
+  for (int i = 0; i < kMaxStreamStats; ++i) {
+    im->stream_tx[i].store(0, std::memory_order_relaxed);
+    im->stream_rx[i].store(0, std::memory_order_relaxed);
+    for (StreamTcpState* slots : {im->tcp_tx, im->tcp_rx}) {
+      slots[i].rtt_us.store(0, std::memory_order_relaxed);
+      slots[i].srtt_us.store(0, std::memory_order_relaxed);
+      slots[i].retrans_total.store(0, std::memory_order_relaxed);
+      slots[i].cwnd.store(0, std::memory_order_relaxed);
+      slots[i].delivery_rate_bps.store(0, std::memory_order_relaxed);
+      slots[i].sampled.store(0, std::memory_order_relaxed);
+      slots[i].straggling.store(0, std::memory_order_relaxed);
+      slots[i].next_sample_us.store(0, std::memory_order_relaxed);
+    }
+  }
+  for (int i = 0; i < kFaultActionSlots; ++i) {
+    im->faults_injected[i].store(0, std::memory_order_relaxed);
+  }
+  im->stream_failovers.store(0, std::memory_order_relaxed);
+  im->crc_errors.store(0, std::memory_order_relaxed);
+  im->straggler_events.store(0, std::memory_order_relaxed);
+  im->req_queue.Reset();
+  im->req_wire.Reset();
+  im->req_total.Reset();
+  {
+    std::lock_guard<std::mutex> lk(im->win_mu);
+    im->win_init = false;
+    im->win_last_us = 0;
+    memset(im->win_tx, 0, sizeof(im->win_tx));
+    memset(im->win_rx, 0, sizeof(im->win_rx));
+    im->fair_tx_bits.store(DoubleToBits(1.0), std::memory_order_relaxed);
+    im->fair_rx_bits.store(DoubleToBits(1.0), std::memory_order_relaxed);
+  }
+  im->start_us.store(NowUs(), std::memory_order_relaxed);
+}
+
 MetricsSnapshot Telemetry::Snapshot() const {
-  const Impl* im = impl_.get();
+  Impl* im = impl_.get();
   MetricsSnapshot s;
   for (int i = 0; i < kMaxStreamStats; ++i) {
     s.stream_tx_bytes[i] = im->stream_tx[i].load(std::memory_order_relaxed);
     s.stream_rx_bytes[i] = im->stream_rx[i].load(std::memory_order_relaxed);
   }
+  // Fairness window roll: at most once per TPUNET_FAIRNESS_WINDOW_MS so two
+  // back-to-back scrapes don't compute Jain over an empty delta. The first
+  // roll covers everything since start/Reset.
+  {
+    std::lock_guard<std::mutex> lk(im->win_mu);
+    uint64_t now = NowUs();
+    if (!im->win_init || now - im->win_last_us >= im->fairness_window_us) {
+      uint64_t dtx[kMaxStreamStats], drx[kMaxStreamStats];
+      uint64_t tot_tx = 0, tot_rx = 0;
+      for (int i = 0; i < kMaxStreamStats; ++i) {
+        dtx[i] = s.stream_tx_bytes[i] - im->win_tx[i];
+        drx[i] = s.stream_rx_bytes[i] - im->win_rx[i];
+        tot_tx += dtx[i];
+        tot_rx += drx[i];
+      }
+      // Only move the gauge when bytes moved (else keep the last verdict).
+      if (tot_tx > 0) {
+        im->fair_tx_bits.store(DoubleToBits(JainIndex(dtx, kMaxStreamStats)),
+                               std::memory_order_relaxed);
+      }
+      if (tot_rx > 0) {
+        im->fair_rx_bits.store(DoubleToBits(JainIndex(drx, kMaxStreamStats)),
+                               std::memory_order_relaxed);
+      }
+      if (!im->win_init || tot_tx > 0 || tot_rx > 0) {
+        memcpy(im->win_tx, s.stream_tx_bytes, sizeof(im->win_tx));
+        memcpy(im->win_rx, s.stream_rx_bytes, sizeof(im->win_rx));
+        im->win_init = true;
+        im->win_last_us = now;
+      }
+    }
+  }
+  s.fairness_tx = BitsToDouble(im->fair_tx_bits.load(std::memory_order_relaxed));
+  s.fairness_rx = BitsToDouble(im->fair_rx_bits.load(std::memory_order_relaxed));
+  for (int i = 0; i < kMaxStreamStats; ++i) {
+    for (auto [slots, out] : {std::pair<StreamTcpState*, StreamTcpSample*>{
+                                  im->tcp_tx, s.stream_tcp_tx},
+                              {im->tcp_rx, s.stream_tcp_rx}}) {
+      out[i].sampled = slots[i].sampled.load(std::memory_order_relaxed) != 0;
+      out[i].rtt_us = slots[i].rtt_us.load(std::memory_order_relaxed);
+      out[i].srtt_us = slots[i].srtt_us.load(std::memory_order_relaxed);
+      out[i].retrans_total = slots[i].retrans_total.load(std::memory_order_relaxed);
+      out[i].cwnd = slots[i].cwnd.load(std::memory_order_relaxed);
+      out[i].delivery_rate_bps =
+          slots[i].delivery_rate_bps.load(std::memory_order_relaxed);
+    }
+  }
+  s.straggler_events = im->straggler_events.load(std::memory_order_relaxed);
   s.isend_count = im->isend_count.load(std::memory_order_relaxed);
   s.irecv_count = im->irecv_count.load(std::memory_order_relaxed);
   s.isend_bytes = im->isend_bytes.load(std::memory_order_relaxed);
@@ -299,7 +705,10 @@ MetricsSnapshot Telemetry::Snapshot() const {
   }
   s.stream_failovers = im->stream_failovers.load(std::memory_order_relaxed);
   s.crc_errors = im->crc_errors.load(std::memory_order_relaxed);
-  s.uptime_s = (NowUs() - im->start_us) / 1e6;
+  im->req_queue.SnapshotInto(&s.req_queue_us);
+  im->req_wire.SnapshotInto(&s.req_wire_us);
+  im->req_total.SnapshotInto(&s.req_total_us);
+  s.uptime_s = (NowUs() - im->start_us.load(std::memory_order_relaxed)) / 1e6;
   return s;
 }
 
@@ -311,67 +720,143 @@ std::string Telemetry::PrometheusText() const {
     snprintf(buf, sizeof(buf), fmt, args...);
     out += buf;
   };
+  // One # HELP + # TYPE header per family, immediately before its samples,
+  // so the exposition passes a Prometheus text-format lint.
+  auto family = [&](const char* name, const char* type, const char* help) {
+    emit("# HELP %s %s\n# TYPE %s %s\n", name, help, name, type);
+  };
   int64_t rank = impl_->rank;
   // Instrument names follow the reference (isend_nbytes / irecv_nbytes value
   // recorders nthread:172-180, bytes/s observers :343-348, hold_on_request
   // in-flight gauge tokio:184-190).
-  emit("# TYPE tpunet_isend_nbytes histogram\n");
-  uint64_t cum = 0;
-  for (int i = 0; i < kHistBuckets - 1; ++i) {
-    cum += s.isend_hist[i];
-    emit("tpunet_isend_nbytes_bucket{rank=\"%lld\",le=\"%llu\"} %llu\n", (long long)rank,
-         (unsigned long long)kHistBounds[i], (unsigned long long)cum);
-  }
-  cum += s.isend_hist[kHistBuckets - 1];
-  emit("tpunet_isend_nbytes_bucket{rank=\"%lld\",le=\"+Inf\"} %llu\n", (long long)rank,
-       (unsigned long long)cum);
-  emit("tpunet_isend_nbytes_sum{rank=\"%lld\"} %llu\n", (long long)rank,
-       (unsigned long long)s.isend_bytes);
-  emit("tpunet_isend_nbytes_count{rank=\"%lld\"} %llu\n", (long long)rank,
-       (unsigned long long)s.isend_count);
-  emit("# TYPE tpunet_irecv_nbytes histogram\n");
-  cum = 0;
-  for (int i = 0; i < kHistBuckets - 1; ++i) {
-    cum += s.irecv_hist[i];
-    emit("tpunet_irecv_nbytes_bucket{rank=\"%lld\",le=\"%llu\"} %llu\n", (long long)rank,
-         (unsigned long long)kHistBounds[i], (unsigned long long)cum);
-  }
-  cum += s.irecv_hist[kHistBuckets - 1];
-  emit("tpunet_irecv_nbytes_bucket{rank=\"%lld\",le=\"+Inf\"} %llu\n", (long long)rank,
-       (unsigned long long)cum);
-  emit("tpunet_irecv_nbytes_sum{rank=\"%lld\"} %llu\n", (long long)rank,
-       (unsigned long long)s.irecv_bytes);
-  emit("tpunet_irecv_nbytes_count{rank=\"%lld\"} %llu\n", (long long)rank,
-       (unsigned long long)s.irecv_count);
-  emit("# TYPE tpunet_isend_nbytes_per_second gauge\n");
+  auto size_hist = [&](const char* name, const char* help, const uint64_t* hist,
+                       uint64_t sum, uint64_t count) {
+    family(name, "histogram", help);
+    uint64_t cum = 0;
+    for (int i = 0; i < kHistBuckets - 1; ++i) {
+      cum += hist[i];
+      emit("%s_bucket{rank=\"%lld\",le=\"%llu\"} %llu\n", name, (long long)rank,
+           (unsigned long long)kHistBounds[i], (unsigned long long)cum);
+    }
+    cum += hist[kHistBuckets - 1];
+    emit("%s_bucket{rank=\"%lld\",le=\"+Inf\"} %llu\n", name, (long long)rank,
+         (unsigned long long)cum);
+    emit("%s_sum{rank=\"%lld\"} %llu\n", name, (long long)rank, (unsigned long long)sum);
+    emit("%s_count{rank=\"%lld\"} %llu\n", name, (long long)rank,
+         (unsigned long long)count);
+  };
+  size_hist("tpunet_isend_nbytes", "Posted isend message sizes in bytes.",
+            s.isend_hist, s.isend_bytes, s.isend_count);
+  size_hist("tpunet_irecv_nbytes", "Posted irecv message sizes in bytes.",
+            s.irecv_hist, s.irecv_bytes, s.irecv_count);
+  family("tpunet_isend_nbytes_per_second", "gauge",
+         "Mean outbound payload rate since start (bytes/s).");
   emit("tpunet_isend_nbytes_per_second{rank=\"%lld\"} %.1f\n", (long long)rank,
        s.uptime_s > 0 ? s.isend_bytes / s.uptime_s : 0.0);
-  emit("# TYPE tpunet_irecv_nbytes_per_second gauge\n");
+  family("tpunet_irecv_nbytes_per_second", "gauge",
+         "Mean inbound payload rate since start (bytes/s).");
   emit("tpunet_irecv_nbytes_per_second{rank=\"%lld\"} %.1f\n", (long long)rank,
        s.uptime_s > 0 ? s.irecv_bytes / s.uptime_s : 0.0);
-  emit("# TYPE tpunet_stream_tx_bytes counter\n");
+  family("tpunet_stream_tx_bytes", "counter",
+         "Payload bytes sent per data-stream index (all comms aggregated).");
   for (int i = 0; i < kMaxStreamStats; ++i) {
     if (s.stream_tx_bytes[i] == 0) continue;
     emit("tpunet_stream_tx_bytes{rank=\"%lld\",stream=\"%d\"} %llu\n", (long long)rank, i,
          (unsigned long long)s.stream_tx_bytes[i]);
   }
-  emit("# TYPE tpunet_stream_rx_bytes counter\n");
+  family("tpunet_stream_rx_bytes", "counter",
+         "Payload bytes received per data-stream index (all comms aggregated).");
   for (int i = 0; i < kMaxStreamStats; ++i) {
     if (s.stream_rx_bytes[i] == 0) continue;
     emit("tpunet_stream_rx_bytes{rank=\"%lld\",stream=\"%d\"} %llu\n", (long long)rank, i,
          (unsigned long long)s.stream_rx_bytes[i]);
   }
-  emit("# TYPE tpunet_hold_on_request gauge\n");
+  // Per-stream TCP introspection gauges (TCP_INFO sampler). Only sampled
+  // slots are emitted; dir distinguishes the send-side and recv-side sockets
+  // of the same stream index.
+  struct TcpGaugeDef {
+    const char* name;
+    const char* type;
+    const char* help;
+    uint64_t StreamTcpSample::*field;
+  };
+  static const TcpGaugeDef kTcpGauges[] = {
+      {"tpunet_stream_rtt_us", "gauge",
+       "Last-sampled TCP round-trip time per data stream (tcpi_rtt, microseconds).",
+       &StreamTcpSample::rtt_us},
+      {"tpunet_stream_retrans_total", "counter",
+       "TCP retransmitted segments of the last-sampled socket per data stream "
+       "(tcpi_total_retrans).",
+       &StreamTcpSample::retrans_total},
+      {"tpunet_stream_cwnd", "gauge",
+       "TCP congestion window per data stream (tcpi_snd_cwnd, segments).",
+       &StreamTcpSample::cwnd},
+      {"tpunet_stream_delivery_rate_bps", "gauge",
+       "TCP delivery rate per data stream (tcpi_delivery_rate, bits/s; 0 on old kernels).",
+       &StreamTcpSample::delivery_rate_bps},
+  };
+  for (const TcpGaugeDef& g : kTcpGauges) {
+    family(g.name, g.type, g.help);
+    for (auto [samples, dir] : {std::pair<const StreamTcpSample*, const char*>{
+                                    s.stream_tcp_tx, "tx"},
+                                {s.stream_tcp_rx, "rx"}}) {
+      for (int i = 0; i < kMaxStreamStats; ++i) {
+        if (!samples[i].sampled) continue;
+        emit("%s{rank=\"%lld\",stream=\"%d\",dir=\"%s\"} %llu\n", g.name,
+             (long long)rank, i, dir, (unsigned long long)(samples[i].*(g.field)));
+      }
+    }
+  }
+  family("tpunet_stream_fairness_jain", "gauge",
+         "Jain's fairness index over windowed per-stream bytes (1.0 = perfectly fair).");
+  emit("tpunet_stream_fairness_jain{rank=\"%lld\",dir=\"tx\"} %.6f\n", (long long)rank,
+       s.fairness_tx);
+  emit("tpunet_stream_fairness_jain{rank=\"%lld\",dir=\"rx\"} %.6f\n", (long long)rank,
+       s.fairness_rx);
+  family("tpunet_straggler_events_total", "counter",
+         "Streams whose smoothed RTT newly exceeded k x the comm median "
+         "(TPUNET_STRAGGLER_FACTOR).");
+  emit("tpunet_straggler_events_total{rank=\"%lld\"} %llu\n", (long long)rank,
+       (unsigned long long)s.straggler_events);
+  // Request stage-latency histograms: queueing delay separable from wire time.
+  auto stage_hist = [&](const char* name, const char* help, const StageHist& h) {
+    family(name, "histogram", help);
+    uint64_t cum = 0;
+    for (int i = 0; i < kStageHistBuckets - 1; ++i) {
+      cum += h.buckets[i];
+      emit("%s_bucket{rank=\"%lld\",le=\"%llu\"} %llu\n", name, (long long)rank,
+           (unsigned long long)kStageHistBounds[i], (unsigned long long)cum);
+    }
+    cum += h.buckets[kStageHistBuckets - 1];
+    emit("%s_bucket{rank=\"%lld\",le=\"+Inf\"} %llu\n", name, (long long)rank,
+         (unsigned long long)cum);
+    emit("%s_sum{rank=\"%lld\"} %llu\n", name, (long long)rank,
+         (unsigned long long)h.sum_us);
+    emit("%s_count{rank=\"%lld\"} %llu\n", name, (long long)rank,
+         (unsigned long long)h.count);
+  };
+  stage_hist("tpunet_req_queue_us",
+             "Request post to first wire byte (queueing delay, microseconds).",
+             s.req_queue_us);
+  stage_hist("tpunet_req_wire_us",
+             "Request first to last wire byte (wire time, microseconds).",
+             s.req_wire_us);
+  stage_hist("tpunet_req_total_us",
+             "Request post to completion (total latency, microseconds).",
+             s.req_total_us);
+  family("tpunet_hold_on_request", "gauge",
+         "Requests posted but not yet test()ed done (in flight).");
   emit("tpunet_hold_on_request{rank=\"%lld\"} %llu\n", (long long)rank,
        (unsigned long long)s.inflight);
-  emit("# TYPE tpunet_failed_requests counter\n");
+  family("tpunet_failed_requests", "counter", "Requests that completed with an error.");
   emit("tpunet_failed_requests{rank=\"%lld\"} %llu\n", (long long)rank,
        (unsigned long long)s.failed_requests);
   // Failure-containment counters. faults_injected is labeled by action and
   // emitted only for nonzero slots; the unlabeled totals are always present
   // so dashboards (and the Python parser, which must accept label-less
   // lines) see them even at zero.
-  emit("# TYPE tpunet_faults_injected_total counter\n");
+  family("tpunet_faults_injected_total", "counter",
+         "Deterministic fault injections fired, by action (chaos testing).");
   static const char* kActionNames[kFaultActionSlots] = {"none", "close", "stall",
                                                         "corrupt", "delay"};
   uint64_t faults_total = 0;
@@ -381,49 +866,96 @@ std::string Telemetry::PrometheusText() const {
     emit("tpunet_faults_injected_total{rank=\"%lld\",action=\"%s\"} %llu\n", (long long)rank,
          kActionNames[i], (unsigned long long)s.faults_injected[i]);
   }
+  family("tpunet_faults_injected", "counter",
+         "Deterministic fault injections fired, all actions (label-less total).");
   emit("tpunet_faults_injected %llu\n", (unsigned long long)faults_total);
-  emit("# TYPE tpunet_stream_failovers_total counter\n");
+  family("tpunet_stream_failovers_total", "counter",
+         "Data-stream failures survived via single-stream failover.");
   emit("tpunet_stream_failovers_total{rank=\"%lld\"} %llu\n", (long long)rank,
        (unsigned long long)s.stream_failovers);
-  emit("# TYPE tpunet_crc_errors_total counter\n");
+  family("tpunet_crc_errors_total", "counter",
+         "Per-chunk CRC32C mismatches detected (TPUNET_CRC=1).");
   emit("tpunet_crc_errors_total{rank=\"%lld\"} %llu\n", (long long)rank,
        (unsigned long long)s.crc_errors);
   return out;
 }
 
 bool Telemetry::FlushTrace() {
-  if (!trace_enabled_) return true;
+  if (!tracing_enabled()) return true;
   Impl* im = impl_.get();
   std::vector<Span> spans;
   {
     std::lock_guard<std::mutex> lk(im->span_mu);
     spans.swap(im->done_spans);
   }
-  if (spans.empty() && im->trace_header_written) return true;
   std::lock_guard<std::mutex> lk(im->span_mu);  // serialize file writes
-  FILE* f = fopen(im->trace_path.c_str(), im->trace_header_written ? "a" : "w");
-  if (!f) return false;  // spans dropped; caller surfaces the failure
-  if (!im->trace_header_written) {
-    // Chrome trace format; Perfetto tolerates a missing closing bracket, so
-    // appends stay valid.
-    fprintf(f, "[\n");
-    fprintf(f,
-            "{\"name\":\"tpunet-rank%lld\",\"ph\":\"M\",\"pid\":%lld,"
-            "\"args\":{\"kind\":\"process_name\"}},\n",
+  if (spans.empty() && im->trace_header_written) return true;
+  // The file is VALID JSON after every flush: the array's closing "\n]" is
+  // rewritten in place on each append (r+ / seek −2), so json.load and
+  // Perfetto both accept it at any point, including mid-run.
+  FILE* f = nullptr;
+  auto write_header = [&]() -> FILE* {
+    FILE* nf = fopen(im->trace_path.c_str(), "w");
+    if (!nf) return nullptr;
+    fprintf(nf,
+            "[\n{\"name\":\"process_name\",\"ph\":\"M\",\"pid\":%lld,"
+            "\"args\":{\"name\":\"tpunet-rank%lld\"}}",
             (long long)im->rank, (long long)im->rank);
     im->trace_header_written = true;
+    return nf;
+  };
+  if (!im->trace_header_written) {
+    f = write_header();
+  } else {
+    f = fopen(im->trace_path.c_str(), "r+");
+    if (f) {
+      if (fseek(f, -2, SEEK_END) != 0) {
+        fclose(f);
+        f = nullptr;
+      }
+    }
+    if (!f) f = write_header();  // file deleted/truncated underneath: restart
   }
+  if (!f) return false;  // spans dropped; caller surfaces the failure
   for (const Span& s : spans) {
-    // Span naming per the reference: "isend-{comm}" / "irecv-{comm}" with id
-    // and nbytes attributes (nthread:529-538).
-    fprintf(f,
-            "{\"name\":\"%s-%llu\",\"ph\":\"X\",\"pid\":%lld,\"tid\":%llu,"
-            "\"ts\":%llu,\"dur\":%llu,\"args\":{\"id\":%llu,\"nbytes\":%llu}},\n",
-            s.is_send ? "isend" : "irecv", (unsigned long long)s.comm, (long long)im->rank,
-            (unsigned long long)s.comm, (unsigned long long)s.start_us,
-            (unsigned long long)s.dur_us, (unsigned long long)s.req,
-            (unsigned long long)s.nbytes);
+    switch (s.kind) {
+      case Span::Kind::kReq:
+        // Span naming per the reference: "isend-{comm}" / "irecv-{comm}" with
+        // id and nbytes attributes (nthread:529-538).
+        fprintf(f,
+                ",\n{\"name\":\"%s-%llu\",\"ph\":\"X\",\"pid\":%lld,\"tid\":%llu,"
+                "\"ts\":%llu,\"dur\":%llu,\"args\":{\"id\":%llu,\"nbytes\":%llu}}",
+                s.is_send ? "isend" : "irecv", (unsigned long long)s.comm,
+                (long long)im->rank, (unsigned long long)s.comm,
+                (unsigned long long)s.start_us, (unsigned long long)s.dur_us,
+                (unsigned long long)s.req, (unsigned long long)s.nbytes);
+        break;
+      case Span::Kind::kColl:
+        // Collective phase span: (comm_id, coll_seq, name) is the cross-rank
+        // join key merge_traces() aligns per-rank timelines with.
+        fprintf(f,
+                ",\n{\"name\":\"%s\",\"ph\":\"X\",\"pid\":%lld,\"tid\":%llu,"
+                "\"ts\":%llu,\"dur\":%llu,\"args\":{\"comm_id\":%llu,"
+                "\"coll_seq\":%llu,\"nbytes\":%llu}}",
+                s.name.c_str(), (long long)im->rank,
+                (unsigned long long)(s.comm & 0xffff),
+                (unsigned long long)s.start_us, (unsigned long long)s.dur_us,
+                (unsigned long long)s.comm, (unsigned long long)s.req,
+                (unsigned long long)s.nbytes);
+        break;
+      case Span::Kind::kInstant:
+        fprintf(f,
+                ",\n{\"name\":\"%s\",\"ph\":\"i\",\"s\":\"p\",\"pid\":%lld,"
+                "\"tid\":%llu,\"ts\":%llu,\"args\":{\"stream\":%llu,"
+                "\"srtt_us\":%llu,\"median_srtt_us\":%llu,\"dir\":\"%s\"}}",
+                s.name.c_str(), (long long)im->rank, (unsigned long long)s.comm,
+                (unsigned long long)s.start_us, (unsigned long long)s.comm,
+                (unsigned long long)s.req, (unsigned long long)s.nbytes,
+                s.is_send ? "tx" : "rx");
+        break;
+    }
   }
+  fprintf(f, "\n]");
   fclose(f);
   return true;
 }

@@ -46,6 +46,13 @@ uint64_t GetEnvU64(const char* name, uint64_t fallback) {
   return static_cast<uint64_t>(parsed);
 }
 
+uint64_t MonotonicUs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000000ull +
+         static_cast<uint64_t>(ts.tv_nsec) / 1000ull;
+}
+
 namespace {
 std::atomic<uint64_t> g_fork_gen{0};
 std::once_flag g_fork_once;

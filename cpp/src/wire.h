@@ -128,6 +128,21 @@ struct RequestState {
   // reference — the comm may die first.
   std::function<void()> on_stall;
 
+  // Stage-latency clock points (telemetry stage histograms, docs/DESIGN.md
+  // "Observability"): t_post_us is stamped by the engine at isend/irecv;
+  // the data-path IO stamps first/last wire byte. first is CAS-from-0 so
+  // whichever chunk touches the wire first wins regardless of stream.
+  uint64_t t_post_us = 0;
+  std::atomic<uint64_t> t_first_wire_us{0};
+  std::atomic<uint64_t> t_last_wire_us{0};
+  void MarkWireStart(uint64_t now_us) {
+    uint64_t expect = 0;
+    t_first_wire_us.compare_exchange_strong(expect, now_us, std::memory_order_relaxed);
+  }
+  void MarkWireEnd(uint64_t now_us) {
+    t_last_wire_us.store(now_us, std::memory_order_relaxed);
+  }
+
   void SetError(const std::string& m) { SetError(ErrorKind::kInnerError, m); }
   void SetError(ErrorKind k, const std::string& m) {
     {

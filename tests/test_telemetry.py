@@ -1,11 +1,41 @@
-"""Telemetry: metrics counters + trace spans across a real collective."""
+"""Telemetry: metrics counters, TCP introspection, stage histograms, trace
+spans (valid Chrome-trace JSON + cross-rank merge), scrape listener, reset."""
 
 from __future__ import annotations
 
 import json
 import os
 
-from conftest import run_spawn_workers
+from conftest import free_port, run_spawn_workers
+
+
+def _lint_exposition(text: str) -> None:
+    """Prometheus text-format lint: every sample belongs to a family whose
+    # TYPE line is adjacent to (immediately after) its # HELP line, and no
+    sample appears before its family header."""
+    import re
+
+    line_re = re.compile(r"^(\w+)(?:\{[^}]*\})?\s+\S+$")
+    pending_help: str | None = None
+    current: str | None = None
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            pending_help = line.split()[2]
+        elif line.startswith("# TYPE "):
+            fam = line.split()[2]
+            assert pending_help == fam, f"# TYPE {fam} not adjacent to its # HELP"
+            current = fam
+            pending_help = None
+        elif line.strip():
+            assert pending_help is None, f"HELP {pending_help} with no adjacent TYPE"
+            m = line_re.match(line)
+            assert m, f"unparseable sample line: {line!r}"
+            name = m.group(1)
+            base = name
+            for suf in ("_bucket", "_sum", "_count"):
+                if current and name == current + suf:
+                    base = current
+            assert base == current, f"sample {name} outside its TYPE'd family ({current})"
 
 
 def _worker(rank: int, world: int, port: int, q, trace_dir: str) -> None:
@@ -34,19 +64,55 @@ def _worker(rank: int, world: int, port: int, q, trace_dir: str) -> None:
         assert m["tpunet_hold_on_request"][rank_key] == 0
         assert m["tpunet_failed_requests"][rank_key] == 0
 
+        # TCP introspection: the sampler fires on the first chunk of each
+        # stream, so per-stream gauges exist after one collective.
+        for gauge in (
+            "tpunet_stream_rtt_us",
+            "tpunet_stream_retrans_total",
+            "tpunet_stream_cwnd",
+            "tpunet_stream_delivery_rate_bps",
+        ):
+            assert m.get(gauge), f"missing {gauge} after transfer: {sorted(m)}"
+        # Fairness gauge present for both directions, in (0, 1].
+        fair = m["tpunet_stream_fairness_jain"]
+        assert len(fair) == 2
+        assert all(0.0 < v <= 1.0 for v in fair.values()), fair
+        # Stage-latency histograms: wire time observed for the ring messages,
+        # and the numeric bucket view is monotonic with +Inf last.
+        assert m["tpunet_req_wire_us_count"][rank_key] > 0
+        assert m["tpunet_req_queue_us_count"][rank_key] > 0
+        assert m["tpunet_req_total_us_count"][rank_key] > 0
+        buckets = telemetry.histogram_buckets("tpunet_req_wire_us", m)
+        assert buckets and buckets[-1][0] == float("inf")
+        counts = [c for _, c in buckets]
+        assert counts == sorted(counts) and counts[-1] > 0
+        # The exposition is lint-clean (HELP/TYPE adjacent per family).
+        _lint_exposition(telemetry.metrics_text())
+
         telemetry.flush_trace()
         comm.close()
 
         path = os.path.join(trace_dir, f"tpunet-trace-rank{rank}.json")
         assert os.path.exists(path), f"missing trace file {path}"
-        text = open(path).read()
-        assert '"isend-' in text and '"irecv-' in text
-        # Spans must carry the reference's attributes (id, nbytes).
-        first_span = json.loads(
-            next(l for l in text.splitlines() if '"isend-' in l).rstrip(",")
-        )
-        assert first_span["args"]["nbytes"] > 0
-        assert first_span["dur"] >= 0
+        # Golden: flush_trace() output is VALID Chrome-trace JSON.
+        with open(path) as f:
+            events = json.load(f)
+        xspans = [e for e in events if e.get("ph") == "X"]
+        for e in xspans:
+            for field in ("name", "ts", "dur", "pid", "tid"):
+                assert field in e, f"span missing {field}: {e}"
+        isends = [e for e in xspans if e["name"].startswith("isend-")]
+        irecvs = [e for e in xspans if e["name"].startswith("irecv-")]
+        assert isends and irecvs
+        assert isends[0]["args"]["nbytes"] > 0
+        assert isends[0]["dur"] >= 0
+        # Collective phase spans tagged with the cross-rank join key.
+        colls = [e for e in xspans if "comm_id" in (e.get("args") or {})]
+        assert any(e["name"] == "allreduce" for e in colls)
+        assert any(e["name"].startswith("rs.") for e in colls)
+        assert any(e["name"].startswith("ag.") for e in colls)
+        for e in colls:
+            assert "coll_seq" in e["args"]
         q.put((rank, "OK"))
     except Exception as e:  # noqa: BLE001
         q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
@@ -54,6 +120,25 @@ def _worker(rank: int, world: int, port: int, q, trace_dir: str) -> None:
 
 def test_metrics_and_trace(tmp_path):
     run_spawn_workers(_worker, 2, extra_args=(str(tmp_path),))
+    # Cross-rank merge: both ranks' spans for the same (comm_id, coll_seq,
+    # phase) land in ONE Perfetto-loadable timeline.
+    from tpunet import telemetry
+
+    merged_path = telemetry.merge_traces(str(tmp_path))
+    with open(merged_path) as f:
+        merged = json.load(f)
+    by_tag: dict = {}
+    for ev in merged:
+        args = ev.get("args") or {}
+        if "comm_id" in args and "coll_seq" in args:
+            by_tag.setdefault(
+                (args["comm_id"], args["coll_seq"], ev["name"]), set()
+            ).add(ev["pid"])
+    assert by_tag, "no collective spans in merged trace"
+    both = [tag for tag, pids in by_tag.items() if pids == {0, 1}]
+    assert both, f"no tag present on both ranks: {by_tag}"
+    # Alignment anchored the common tags; every event still has a timestamp.
+    assert all("ts" in e for e in merged if e.get("ph") == "X")
 
 
 def test_metrics_text_parses_without_activity():
@@ -63,6 +148,7 @@ def test_metrics_text_parses_without_activity():
     assert "tpunet_isend_nbytes_count" in text
     parsed = telemetry.metrics()
     assert any(k.startswith("tpunet_") for k in parsed)
+    _lint_exposition(text)
 
 
 def test_metrics_parser_accepts_label_less_lines(monkeypatch):
@@ -92,6 +178,173 @@ def test_metrics_parser_accepts_label_less_lines(monkeypatch):
     monkeypatch.undo()
     real = telemetry.metrics()
     assert () in real["tpunet_faults_injected"]
+
+
+def test_metrics_parser_preserves_label_order(monkeypatch):
+    """Label tuples keep declaration order — sorting them made keys depend
+    on label VALUES and scrambled le-bucket lookups."""
+    from tpunet import telemetry
+
+    sample = "\n".join(
+        [
+            'tpunet_demo_bucket{rank="0",le="200"} 1',
+            'tpunet_demo_bucket{rank="0",le="1000"} 3',
+            'tpunet_demo_bucket{rank="0",le="+Inf"} 4',
+        ]
+    )
+    monkeypatch.setattr(telemetry, "metrics_text", lambda: sample)
+    parsed = telemetry.metrics()
+    assert ('rank="0"', 'le="200"') in parsed["tpunet_demo_bucket"]
+    assert telemetry.labels(('rank="0"', 'le="200"')) == {"rank": "0", "le": "200"}
+    buckets = telemetry.histogram_buckets("tpunet_demo", parsed)
+    assert buckets == [(200.0, 1), (1000.0, 3), (float("inf"), 4)]
+
+
+def _reset_worker(rank: int, world: int, port: int, q) -> None:
+    """telemetry.reset() zeroes counters so warmups don't bleed into
+    measurement windows (exercised over a real loopback transfer)."""
+    try:
+        import numpy as np
+
+        from tpunet import telemetry
+        from tpunet.transport import Net
+
+        net = Net()
+        listen = net.listen(0)
+        rc_holder = {}
+        import threading
+
+        t = threading.Thread(target=lambda: rc_holder.update(rc=listen.accept()))
+        t.start()
+        sc = net.connect(listen.handle)
+        t.join()
+        rc = rc_holder["rc"]
+
+        data = np.arange(1 << 20, dtype=np.uint8) % 251
+        buf = np.zeros(1 << 20, dtype=np.uint8)
+        req = rc.irecv(buf)
+        sc.send(data, timeout=60)
+        req.wait(timeout=60)
+
+        m = telemetry.metrics()
+        rank_key = (f'rank="{rank}"',)
+        assert m["tpunet_isend_nbytes_count"][rank_key] >= 1
+        assert m["tpunet_req_total_us_count"][rank_key] >= 1
+        assert m.get("tpunet_stream_tx_bytes")
+
+        telemetry.reset()
+        m2 = telemetry.metrics()
+        assert m2["tpunet_isend_nbytes_count"][rank_key] == 0
+        assert m2["tpunet_irecv_nbytes_count"][rank_key] == 0
+        assert m2["tpunet_req_total_us_count"][rank_key] == 0
+        assert m2["tpunet_req_wire_us_count"][rank_key] == 0
+        assert not m2.get("tpunet_stream_tx_bytes")  # zero slots are elided
+        assert not m2.get("tpunet_stream_rtt_us")
+        assert m2["tpunet_straggler_events_total"][rank_key] == 0
+
+        # Counters keep working after a reset (a second transfer re-counts).
+        req = rc.irecv(buf)
+        sc.send(data, timeout=60)
+        req.wait(timeout=60)
+        m3 = telemetry.metrics()
+        assert m3["tpunet_isend_nbytes_count"][rank_key] == 1
+
+        sc.close()
+        rc.close()
+        listen.close()
+        net.close()
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def test_metrics_reset():
+    run_spawn_workers(_reset_worker, 1)
+
+
+def _profile_worker(rank: int, world: int, port: int, q, trace_dir: str) -> None:
+    """profile() enables tracing at RUNTIME (no TPUNET_TRACE_DIR at load)."""
+    try:
+        os.environ.pop("TPUNET_TRACE_DIR", None)
+        import numpy as np
+
+        from tpunet import telemetry
+        from tpunet.transport import Net
+
+        net = Net()
+        listen = net.listen(0)
+        import threading
+
+        rc_holder = {}
+        t = threading.Thread(target=lambda: rc_holder.update(rc=listen.accept()))
+        t.start()
+        sc = net.connect(listen.handle)
+        t.join()
+        rc = rc_holder["rc"]
+
+        with telemetry.profile(trace_dir) as prof:
+            data = np.arange(1 << 18, dtype=np.uint8) % 251
+            buf = np.zeros(1 << 18, dtype=np.uint8)
+            req = rc.irecv(buf)
+            sc.send(data, timeout=60)
+            req.wait(timeout=60)
+        files = prof.rank_files()
+        assert files, f"profile() wrote no trace files in {trace_dir}"
+        with open(files[0]) as f:
+            events = json.load(f)  # valid JSON after the context exits
+        assert any(e.get("name", "").startswith("isend-") for e in events)
+
+        # Tracing is OFF again after the context: a post-profile transfer
+        # must not grow the trace file.
+        size_before = os.path.getsize(files[0])
+        req = rc.irecv(buf)
+        sc.send(data, timeout=60)
+        req.wait(timeout=60)
+        telemetry.flush_trace()
+        assert os.path.getsize(files[0]) == size_before
+
+        sc.close()
+        rc.close()
+        listen.close()
+        net.close()
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def test_profile_context_manager(tmp_path):
+    run_spawn_workers(_profile_worker, 1, extra_args=(str(tmp_path),))
+
+
+def _scrape_worker(rank: int, world: int, port: int, q, scrape_port: str) -> None:
+    """The on-demand /metrics listener serves a lint-clean exposition."""
+    try:
+        os.environ["TPUNET_METRICS_PORT"] = scrape_port
+        os.environ["TPUNET_RANK"] = str(rank)
+        import time
+
+        from tpunet import telemetry
+
+        telemetry.metrics_text()  # constructs the singleton -> starts listener
+        deadline = time.monotonic() + 10
+        text = None
+        while time.monotonic() < deadline:
+            try:
+                text = telemetry.scrape(int(scrape_port))
+                break
+            except OSError:
+                time.sleep(0.1)
+        assert text is not None, "scrape listener never came up"
+        assert "tpunet_isend_nbytes_count" in text
+        assert "# HELP tpunet_isend_nbytes" in text
+        _lint_exposition(text)
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def test_metrics_scrape_listener():
+    run_spawn_workers(_scrape_worker, 1, extra_args=(str(free_port()),))
 
 
 def _push_worker(rank: int, world: int, port: int, q) -> None:

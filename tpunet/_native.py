@@ -175,8 +175,12 @@ def load() -> ctypes.CDLL:
 
     lib.tpunet_c_metrics_text.argtypes = [ctypes.c_char_p, u64]
     lib.tpunet_c_metrics_text.restype = i32
+    lib.tpunet_c_metrics_reset.argtypes = []
+    lib.tpunet_c_metrics_reset.restype = i32
     lib.tpunet_c_trace_flush.argtypes = []
     lib.tpunet_c_trace_flush.restype = i32
+    lib.tpunet_c_trace_set_dir.argtypes = [ctypes.c_char_p]
+    lib.tpunet_c_trace_set_dir.restype = i32
 
     lib.tpunet_c_fault_inject.argtypes = [ctypes.c_char_p]
     lib.tpunet_c_fault_inject.restype = i32

@@ -1,23 +1,43 @@
 """Transport observability accessors.
 
-The native layer records per-request metrics (always-on counters) and,
-when env-gated, trace spans (SURVEY §5; reference: OpenTelemetry pipeline in
-nthread_per_socket_backend.rs:108-212). This module reads them from Python:
+The native layer records per-request metrics (always-on counters), deep
+per-stream TCP introspection (rate-limited ``getsockopt(TCP_INFO)`` gauges,
+Jain's fairness index, straggler events), request stage-latency histograms
+(queueing delay separable from wire time), and — when tracing is on —
+Chrome-trace spans for every request plus collective phase spans tagged
+``(comm_id, coll_seq, phase)``. This module reads it all from Python:
 
-  metrics_text()  -> Prometheus exposition text
-  metrics()       -> parsed {metric_name: {labels_tuple: value}}
-  flush_trace()   -> write buffered spans to TPUNET_TRACE_DIR
+  metrics_text()      -> Prometheus exposition text (lint-clean HELP/TYPE)
+  metrics()           -> parsed {metric_name: {labels_tuple: value}}
+  labels(key)         -> a metrics() label tuple as an ordered dict
+  histogram_buckets() -> [(upper_bound, cumulative_count)] with `le` parsed
+                         numerically (+Inf last)
+  reset()             -> zero every counter so warmups don't bleed into
+                         measurement windows
+  flush_trace()       -> write buffered spans (file is valid JSON after)
+  profile()           -> context manager that enables tracing at runtime
+  merge_traces()      -> join per-rank trace files into one Perfetto
+                         timeline, aligned by collective tags
+  scrape()            -> GET the native /metrics listener
 
 Env flags (rank-gated 0-7 like the reference, nthread:108-130):
   TPUNET_TRACE_DIR            directory for Chrome-trace JSON (Perfetto)
   TPUNET_METRICS_ADDR         pushgateway "user:pass@host:port"
   TPUNET_METRICS_INTERVAL_MS  push period, default 1000
+  TPUNET_METRICS_PORT         on-demand /metrics scrape listener port
+  TPUNET_TCPINFO_INTERVAL_MS  TCP_INFO sample period per stream (0 = off)
+  TPUNET_STRAGGLER_FACTOR     straggler threshold k over the median sRTT
 """
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
+import glob
+import json
+import os
 import re
+import urllib.request
 
 from tpunet import _native
 
@@ -26,7 +46,7 @@ def metrics_text() -> str:
     lib = _native.load()
     # Counters move concurrently, so the text can grow between the sizing
     # call and the copy; retry until the copy fits its own length.
-    cap = 4096
+    cap = 16384
     while True:
         buf = ctypes.create_string_buffer(cap)
         n = lib.tpunet_c_metrics_text(buf, cap)
@@ -41,11 +61,14 @@ def metrics_text() -> str:
 # `name value` lines are valid exposition and the old mandatory-braces
 # pattern silently dropped them from metrics().
 _LINE = re.compile(r"^(\w+)(?:\{([^}]*)\})?\s+([0-9.eE+-]+|[+-]?Inf|NaN)$")
+_LABEL = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
 
 
 def metrics() -> dict:
-    """Parse the Prometheus text into {name: {(label=value, ...): float}}.
+    """Parse the Prometheus text into {name: {(label="v", ...): float}}.
 
+    Label tuples preserve the exposition's declaration order (sorting them
+    scrambled `le` bucket bounds and made keys depend on label VALUES).
     Lines without a label block parse to the empty label tuple ()."""
     out: dict = {}
     for line in metrics_text().splitlines():
@@ -55,11 +78,153 @@ def metrics() -> dict:
         if not m:
             continue
         name, labels, value = m.groups()
-        key = tuple(sorted(labels.split(","))) if labels else ()
+        key = tuple(labels.split(",")) if labels else ()
         out.setdefault(name, {})[key] = float(value)
     return out
+
+
+def labels(key: tuple) -> dict:
+    """A metrics() label tuple as an insertion-ordered {name: value} dict:
+    labels(('rank="0"', 'le="1024"')) -> {"rank": "0", "le": "1024"}."""
+    out = {}
+    for part in key:
+        m = _LABEL.match(part)
+        if m:
+            out[m.group(1)] = m.group(2)
+    return out
+
+
+def histogram_buckets(name: str, parsed: dict | None = None) -> list[tuple[float, int]]:
+    """Numeric view of a histogram family: [(upper_bound, cumulative_count)]
+    sorted by bound with +Inf last, so buckets can be consumed numerically.
+    `name` is the family name without the `_bucket` suffix; counts with the
+    same `le` across other label sets (e.g. several ranks) are summed."""
+    if parsed is None:
+        parsed = metrics()
+    by_bound: dict[float, int] = {}
+    for key, value in parsed.get(name + "_bucket", {}).items():
+        le = labels(key).get("le")
+        if le is None:
+            continue
+        bound = float("inf") if le in ("+Inf", "Inf") else float(le)
+        by_bound[bound] = by_bound.get(bound, 0) + int(value)
+    return sorted(by_bound.items())
+
+
+def reset() -> None:
+    """Zero every metric counter/histogram/gauge (trace spans and the
+    in-flight gauge are untouched) — call between a warmup and a measurement
+    window so the first doesn't bleed into the second."""
+    lib = _native.load()
+    _native.check(lib.tpunet_c_metrics_reset(), "metrics_reset")
 
 
 def flush_trace() -> None:
     lib = _native.load()
     _native.check(lib.tpunet_c_trace_flush(), "trace_flush")
+
+
+class _Profile:
+    """Handle yielded by profile(): where the trace files land."""
+
+    def __init__(self, trace_dir: str):
+        self.trace_dir = trace_dir
+        self.merged_path: str | None = None
+
+    def rank_files(self) -> list[str]:
+        return sorted(glob.glob(os.path.join(self.trace_dir, "tpunet-trace-rank*.json")))
+
+
+@contextlib.contextmanager
+def profile(trace_dir: str | None = None, merge: bool = False):
+    """Enable tracing at runtime for the duration of the block.
+
+    Unlike TPUNET_TRACE_DIR (read once at library load), this retargets the
+    native tracer on entry and flushes + disables on exit, so a profile can
+    bracket exactly one measurement window::
+
+        with telemetry.profile("/tmp/traces") as prof:
+            comm.all_reduce(x)
+        telemetry.merge_traces(prof.trace_dir)
+
+    With merge=True the per-rank files present in trace_dir are merged into
+    one Perfetto timeline on exit (single-host convenience; multi-host jobs
+    collect the rank files first and call merge_traces() themselves)."""
+    lib = _native.load()
+    trace_dir = trace_dir or os.environ.get("TPUNET_TRACE_DIR") or "/tmp/tpunet-traces"
+    os.makedirs(trace_dir, exist_ok=True)
+    _native.check(lib.tpunet_c_trace_set_dir(trace_dir.encode()), "trace_set_dir")
+    prof = _Profile(trace_dir)
+    try:
+        yield prof
+    finally:
+        _native.check(lib.tpunet_c_trace_flush(), "trace_flush")
+        _native.check(lib.tpunet_c_trace_set_dir(b""), "trace_set_dir")
+        if merge:
+            prof.merged_path = merge_traces(trace_dir)
+
+
+def _coll_tags(events: list[dict]) -> dict[tuple, int]:
+    """(comm_id, coll_seq, name) -> start ts for collective phase spans."""
+    tags = {}
+    for ev in events:
+        args = ev.get("args") or {}
+        if "comm_id" in args and "coll_seq" in args and "ts" in ev:
+            key = (args["comm_id"], args["coll_seq"], ev.get("name", ""))
+            # Keep the earliest occurrence (phases are unique per rank anyway).
+            if key not in tags:
+                tags[key] = ev["ts"]
+    return tags
+
+
+def merge_traces(trace_dir: str, out_path: str | None = None) -> str:
+    """Join every per-rank Chrome-trace JSON in `trace_dir` into ONE
+    Perfetto-loadable timeline and return its path.
+
+    Ranks on one host already share the monotonic clock; across hosts the
+    clocks are unrelated, so per-rank timelines are aligned on the collective
+    phase tags ``(comm_id, coll_seq, phase)``: the earliest tag common to all
+    ranks becomes the anchor, and every rank is shifted so its anchor span
+    starts at the same instant (the straggler-analysis convention — skew
+    WITHIN a collective is preserved, clock offset is not mistaken for it).
+    Files without common tags (point-to-point-only traces) merge unshifted."""
+    files = sorted(glob.glob(os.path.join(trace_dir, "tpunet-trace-rank*.json")))
+    if not files:
+        raise FileNotFoundError(f"no tpunet-trace-rank*.json files in {trace_dir}")
+    per_rank: list[list[dict]] = []
+    for path in files:
+        with open(path) as f:
+            per_rank.append(json.load(f))
+    # Alignment: anchor on the earliest (comm_id, coll_seq, phase) present in
+    # EVERY rank's file; shift each rank so anchors coincide at the max.
+    tag_maps = [_coll_tags(events) for events in per_rank]
+    common = set(tag_maps[0])
+    for tm in tag_maps[1:]:
+        common &= set(tm)
+    offsets = [0] * len(per_rank)
+    if common and len(per_rank) > 1:
+        anchor = min(common, key=lambda k: (k[1], k[2]))  # lowest coll_seq
+        target = max(tm[anchor] for tm in tag_maps)
+        offsets = [target - tm[anchor] for tm in tag_maps]
+    merged: list[dict] = []
+    for events, off in zip(per_rank, offsets):
+        for ev in events:
+            if off and "ts" in ev:
+                ev = dict(ev)
+                ev["ts"] = ev["ts"] + off
+            merged.append(ev)
+    out_path = out_path or os.path.join(trace_dir, "tpunet-trace-merged.json")
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    return out_path
+
+
+def scrape(port: int | None = None, host: str = "127.0.0.1", timeout: float = 5.0) -> str:
+    """GET the native on-demand /metrics listener (TPUNET_METRICS_PORT) and
+    return the exposition text — what a Prometheus scraper would see."""
+    if port is None:
+        port = int(os.environ.get("TPUNET_METRICS_PORT", "0"))
+    if not port:
+        raise ValueError("no port given and TPUNET_METRICS_PORT unset")
+    with urllib.request.urlopen(f"http://{host}:{port}/metrics", timeout=timeout) as r:
+        return r.read().decode()
