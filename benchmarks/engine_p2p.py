@@ -23,10 +23,20 @@ per-size MEDIAN and IQR of each rep's best-of-iters — box-noise drift
 stragglers, so "within noise" becomes a statement about a distribution,
 not a single sample.
 
+Round-6 additions: per-size syscalls/MiB and bytes/syscall, derived from the
+native tpunet_engine_syscalls_total{op,dir} counters over the timed window
+(telemetry.reset() after warmup). The counter-derived budget is the signal
+the 1-core box CANNOT noise out: a change that re-fragments the vectored
+wire path (one sendmsg per [payload|crc] chunk, MSG_WAITALL reads) moves
+syscalls/MiB by integer factors while GB/s swings ±20% on its own.
+
 Usage: python -m benchmarks.engine_p2p [--sizes 1048576 134217728]
        [--iters 8] [--nstreams 4] [--engines BASIC EPOLL] [--reps 10]
-Prints ONE JSON line: {engine: {size: {rtt_ms, rtt_iqr_ms, gbps, ...,
-reps}}, epoll_over_basic_rtt: {...}} (medians when reps > 1).
+       [--json PATH]
+Prints ONE JSON line: {engine: {size: {rtt_ms, rtt_iqr_ms, gbps,
+syscalls_per_mib, bytes_per_syscall, ...}}, epoll_over_basic_rtt: {...}}
+(medians when reps > 1); --json also writes it to PATH for bench.py-style
+file consumption.
 """
 
 from __future__ import annotations
@@ -38,6 +48,15 @@ import sys
 import time
 
 
+def _syscall_total() -> int:
+    """Sum of tpunet_engine_syscalls_total{op,dir} since the last
+    telemetry.reset() — wire send/recv-family syscalls this process issued."""
+    from tpunet import telemetry
+
+    return int(sum(telemetry.metrics().get(
+        "tpunet_engine_syscalls_total", {}).values()))
+
+
 def _peer(rank: int, conn, q, engine: str, nstreams: int,
           sizes: list, iters: int) -> None:
     try:
@@ -45,6 +64,7 @@ def _peer(rank: int, conn, q, engine: str, nstreams: int,
         os.environ["TPUNET_NSTREAMS"] = str(nstreams)
         import numpy as np
 
+        from tpunet import telemetry
         from tpunet.transport import Net
 
         net = Net()
@@ -63,6 +83,10 @@ def _peer(rank: int, conn, q, engine: str, nstreams: int,
             buf_rx = np.zeros(size, dtype=np.uint8)
             times = []
             for it in range(2 + iters):  # 2 warmup
+                if it == 2:
+                    # Counter window starts after warmup: syscalls/MiB below
+                    # covers exactly the timed iterations.
+                    telemetry.reset()
                 t0 = time.perf_counter()
                 if rank == 0:
                     sc.send(buf_tx, timeout=120)
@@ -76,8 +100,17 @@ def _peer(rank: int, conn, q, engine: str, nstreams: int,
             if size and not np.array_equal(buf_rx, buf_tx):
                 raise RuntimeError(f"payload corrupt at size {size}")
             best = min(times)
+            # Syscall budget over the timed window: this process moved
+            # size bytes out AND size bytes in per iteration (ping-pong).
+            syscalls = _syscall_total()
+            moved = 2 * size * iters
             out[size] = {"rtt_ms": round(best * 1e3, 4),
-                         "gbps": round(size / (best / 2) / 1e9, 3) if size else None}
+                         "gbps": round(size / (best / 2) / 1e9, 3) if size else None,
+                         "syscalls": syscalls,
+                         "syscalls_per_mib": (round(syscalls / (moved / 2**20), 3)
+                                              if moved else None),
+                         "bytes_per_syscall": (round(moved / syscalls)
+                                               if syscalls and moved else None)}
         sc.close()
         rc.close()
         listen.close()
@@ -146,6 +179,10 @@ def main(argv=None) -> None:
     ap.add_argument("--reps", type=int, default=10,
                     help="fresh process pairs per engine, interleaved "
                          "A/B/A/B; report per-size median + IQR")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="also write the result object to PATH "
+                         "(bench.py-style machine consumption; stdout keeps "
+                         "the one-JSON-line contract either way)")
     args = ap.parse_args(argv)
 
     # Interleaved: rep k runs every engine before rep k+1 starts, so slow
@@ -178,11 +215,23 @@ def main(argv=None) -> None:
         for s in args.sizes:
             rtts = [r[s]["rtt_ms"] for r in raw[eng]]
             spread = iqr(rtts)
+            spm = [r[s]["syscalls_per_mib"] for r in raw[eng]
+                   if r[s].get("syscalls_per_mib") is not None]
+            bps = [r[s]["bytes_per_syscall"] for r in raw[eng]
+                   if r[s].get("bytes_per_syscall") is not None]
             agg[s] = {
                 "rtt_ms": round(statistics.median(rtts), 4),
                 "rtt_iqr_ms": round(spread, 4) if spread is not None else None,
                 "gbps": (round(s / (statistics.median(rtts) / 1e3 / 2) / 1e9,
                                3) if s else None),
+                # Counter-derived fragmentation signal (median over reps):
+                # immune to the box's timing noise, so regressions that
+                # re-fragment the vectored wire path are visible even when
+                # GB/s is not (PERF_NOTES round 6).
+                "syscalls_per_mib": (round(statistics.median(spm), 3)
+                                     if spm else None),
+                "bytes_per_syscall": (round(statistics.median(bps))
+                                      if bps else None),
             }
         out["engines"][eng] = agg
     if "BASIC" in out["engines"] and "EPOLL" in out["engines"]:
@@ -191,6 +240,9 @@ def main(argv=None) -> None:
                           / out["engines"]["EPOLL"][s]["rtt_ms"], 3)
             for s in args.sizes
         }
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=2)
     print(json.dumps(out))
 
 

@@ -106,6 +106,15 @@ int32_t tpunet_c_fault_clear(void);
  * discontiguous buffers). Exposed for golden-vector tests and so Python
  * tooling can pre-verify payloads against the wire trailers. */
 uint32_t tpunet_c_crc32c(const void* data, uint64_t nbytes, uint32_t seed);
+/* Elementwise reduction dst[i] = a[i] op b[i] over n elements — the
+ * runtime-dispatched (SIMD when the CPU has it, scalar otherwise) kernel the
+ * ring collectives run post-wire, exposed so SIMD-vs-scalar equivalence
+ * goldens can pin it from Python. dst may alias a (in-place accumulate).
+ * dtype: 0=f32 1=f64 2=bf16 3=i32 4=i64 5=u8; op: 0=sum 1=prod 2=min 3=max.
+ * Returns TPUNET_ERR_INVALID for an unknown dtype/op or a NULL buffer with
+ * n > 0. */
+int32_t tpunet_c_reduce(void* dst, const void* a, const void* b, uint64_t n,
+                        int32_t dtype, int32_t op);
 
 /* ---- Collectives (ring communicator over the transport) ----------------
  * The layer NCCL provided above the reference plugin (SURVEY §2.3); here it

@@ -108,6 +108,13 @@ struct MetricsSnapshot {
   StageHist req_queue_us;       // post -> first wire byte
   StageHist req_wire_us;        // first -> last wire byte
   StageHist req_total_us;       // post -> completion
+  // Zero-copy data-path counters (docs/DESIGN.md "Data path"): wire syscalls
+  // indexed by utils.h IoOp (send, recv, sendmsg, recvmsg) and bytes
+  // produced by the reduction kernels. syscalls/MiB is derived from these in
+  // benchmarks/engine_p2p.py — the fragmentation signal the 1-core sandbox
+  // cannot noise out the way it noises GB/s.
+  uint64_t engine_syscalls[4] = {0};
+  uint64_t reduce_bytes = 0;
   double uptime_s = 0;          // for bytes/s derivation
 };
 

@@ -6,6 +6,7 @@
 #define TPUNET_UTILS_H_
 
 #include <sys/socket.h>
+#include <sys/uio.h>
 
 #include <cstddef>
 #include <cstdint>
@@ -44,14 +45,37 @@ size_t ChunkSize(size_t total, size_t min_chunksize, size_t n);
 // Number of chunks a message of `total` bytes splits into (0 for total==0).
 size_t ChunkCount(size_t total, size_t chunksize);
 
+// ---- Wire-syscall accounting (tpunet_engine_syscalls_total{op,dir}) -------
+// Every send/recv-family syscall the engines issue on their data paths bumps
+// one relaxed process-wide counter, indexed by the syscall actually made
+// (writev/readv are issued as sendmsg/recvmsg so flags apply). The counters
+// are what makes the zero-copy work measurable: syscalls/MiB is a number the
+// 1-vCPU sandbox cannot noise out the way it noises GB/s.
+enum IoOp { kIoSend = 0, kIoRecv = 1, kIoSendmsg = 2, kIoRecvmsg = 3, kIoOpCount = 4 };
+void CountIoSyscall(IoOp op);
+uint64_t IoSyscallCount(IoOp op);
+void ResetIoSyscallCounts();
+
 // Blocking write/read of exactly n bytes, retrying on EINTR/partial IO.
 // A read of 0 bytes means EOF -> error (reference: utils.rs:168-171).
 // If `spin` is true the fd is assumed nonblocking and we busy-poll on
 // EWOULDBLOCK with sched_yield (the reference's only mode, utils.rs:132-178);
 // the default blocking mode is our TPU-host-friendly improvement (no 100% CPU
-// burn on a shared trainer host).
+// burn on a shared trainer host). ReadExact passes MSG_WAITALL so a blocking
+// chunk read is ONE syscall, not one per kernel-buffer refill — the recv-side
+// half of the syscalls/MiB budget (docs/DESIGN.md "Data path").
 Status WriteAll(int fd, const void* buf, size_t n, bool spin = false);
 Status ReadExact(int fd, void* buf, size_t n, bool spin = false);
+
+// Vectored variants: move every byte described by iov[0..iovcnt) in as few
+// sendmsg/recvmsg syscalls as possible (one, in the common case — e.g. a
+// chunk payload and its CRC32C trailer coalesce instead of paying separate
+// syscalls). The iov array is MUTATED as the cursor advances across partial
+// IO; zero-length entries are permitted. Semantics otherwise match
+// WriteAll/ReadExact (EINTR retry, spin busy-poll, EOF -> error on read;
+// reads use MSG_WAITALL).
+Status WritevAll(int fd, struct iovec* iov, int iovcnt, bool spin = false);
+Status ReadvExact(int fd, struct iovec* iov, int iovcnt, bool spin = false);
 
 // Read exactly n bytes with a hard wall-clock deadline over the WHOLE read
 // (poll + MSG_DONTWAIT recv) — unlike SO_RCVTIMEO, which restarts on every
@@ -66,6 +90,63 @@ Status ReadExactDeadline(int fd, void* buf, size_t n, int timeout_ms);
 // 0xE3069283 (RFC 3720 B.4). Used for the per-chunk wire-integrity trailer
 // (TPUNET_CRC=1) on data streams.
 uint32_t Crc32c(const void* data, size_t n, uint32_t crc = 0);
+
+// ---- Reduction kernels (the collectives' post-wire stage) -----------------
+// Elementwise dst[i] = a[i] op b[i] for the wire dtypes. dst may alias a
+// (the classic in-place accumulate); the out-of-place collectives pass
+// a = caller's sendbuf so no staging copy ever exists. Dispatch is runtime:
+// AVX2 lanes for f32/bf16 when the CPU has them (TPUNET_REDUCE_SIMD=0
+// forces scalar for bisection), scalar otherwise — the scalar and SIMD
+// paths are BITWISE identical, including NaN/inf propagation and bf16
+// round-to-nearest-even (pinned by tests/test_wire_vectored.py goldens).
+// Above a size threshold the work fans out over a persistent fork-join pool
+// (TPUNET_REDUCE_THREADS total shards incl. the caller; 0 = auto), so the
+// reduce of ring chunk k keeps pace with the wire moving chunk k+1.
+// Every call adds n * element-size to the tpunet_reduce_bytes_total counter.
+enum class WireDType : uint8_t { kF32 = 0, kF64, kBF16, kI32, kI64, kU8 };
+enum class WireRedOp : uint8_t { kSum = 0, kProd, kMin, kMax };
+size_t WireDTypeSize(WireDType d);
+void ReduceInto(void* dst, const void* a, const void* b, size_t n,
+                WireDType dtype, WireRedOp op);
+uint64_t ReduceBytesTotal();
+void ResetReduceBytesTotal();
+
+// Growable 64-byte-aligned scratch that never zero-fills: reserve() grows
+// capacity WITHOUT initializing or preserving contents (it is a landing
+// buffer for wire bytes / reduce partials — std::vector::resize would pay an
+// O(capacity) zero-fill pass plus first-touch faults for data about to be
+// overwritten, the copy class the zero-staging collectives exist to avoid).
+// Alignment keeps the SIMD reduce on aligned loads when slices line up.
+class ScratchBuf {
+ public:
+  ScratchBuf() = default;
+  ~ScratchBuf();
+  ScratchBuf(const ScratchBuf&) = delete;
+  ScratchBuf& operator=(const ScratchBuf&) = delete;
+  ScratchBuf(ScratchBuf&& o) noexcept : p_(o.p_), cap_(o.cap_) {
+    o.p_ = nullptr;
+    o.cap_ = 0;
+  }
+  ScratchBuf& operator=(ScratchBuf&& o) noexcept {
+    swap(o);
+    return *this;
+  }
+  uint8_t* data() { return p_; }
+  size_t capacity() const { return cap_; }
+  void reserve(size_t n);
+  void swap(ScratchBuf& o) {
+    uint8_t* tp = p_;
+    size_t tc = cap_;
+    p_ = o.p_;
+    cap_ = o.cap_;
+    o.p_ = tp;
+    o.cap_ = tc;
+  }
+
+ private:
+  uint8_t* p_ = nullptr;
+  size_t cap_ = 0;
+};
 
 // "user:pass@host:port" -> (user, pass, addr); user/pass empty when absent
 // (reference: utils.rs:180-198).

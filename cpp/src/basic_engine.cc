@@ -396,18 +396,32 @@ void PopRec(Comm* c, size_t idx, uint64_t seq) {
   c->done_seq[idx] = seq + 1;
 }
 
-// ---- CRC32C chunk trailers -------------------------------------------------
+// ---- Chunk wire IO (vectored) ----------------------------------------------
+// One sendmsg/recvmsg per chunk: payload and (when negotiated) the 4-byte
+// CRC32C trailer ride a single syscall instead of two, and the recv side's
+// MSG_WAITALL read is one syscall per chunk instead of one per kernel-buffer
+// refill. Wire bytes are IDENTICAL to the segmented writes (payload||crc) —
+// v3 peers interop either way; tests/test_wire_vectored.py captures the
+// frames and pins that.
 
-Status WriteChunkCrc(int fd, uint32_t crc, bool spin) {
-  uint8_t b[4];
-  EncodeU32BE(crc, b);
-  return WriteAll(fd, b, sizeof(b), spin);
+Status SendChunkWire(int fd, const uint8_t* data, size_t len, bool crc, bool spin) {
+  if (!crc) return WriteAll(fd, data, len, spin);
+  uint8_t crcb[4];
+  EncodeU32BE(Crc32c(data, len), crcb);
+  struct iovec iov[2] = {{const_cast<uint8_t*>(data), len}, {crcb, sizeof(crcb)}};
+  return WritevAll(fd, iov, 2, spin);
 }
 
-Status ReadChunkCrc(int fd, uint32_t* crc, bool spin) {
-  uint8_t b[4];
-  Status s = ReadExact(fd, b, sizeof(b), spin);
-  if (s.ok()) *crc = DecodeU32BE(b);
+// With CRC: trailer is read into *wire_crc alongside the payload. The CRC is
+// computed over the ORIGINAL bytes by the sender, so a fault-injected wire
+// flip (applied by the caller after this returns) is detectable.
+Status RecvChunkWire(int fd, uint8_t* data, size_t len, bool crc, bool spin,
+                     uint32_t* wire_crc) {
+  if (!crc) return ReadExact(fd, data, len, spin);
+  uint8_t crcb[4];
+  struct iovec iov[2] = {{data, len}, {crcb, sizeof(crcb)}};
+  Status s = ReadvExact(fd, iov, 2, spin);
+  if (s.ok()) *wire_crc = DecodeU32BE(crcb);
   return s;
 }
 
@@ -478,11 +492,17 @@ void SendWorkerLoop(StreamWorker* w, bool spin) {
       // computed over the ORIGINAL bytes so TPUNET_CRC=1 catches the flip.
       std::vector<uint8_t> dup(t.data, t.data + t.len);
       if (!dup.empty()) dup[dup.size() / 2] ^= 0x01;
-      s = WriteAll(w->fd, dup.data(), dup.size(), spin);
+      if (c->crc) {
+        uint8_t crcb[4];
+        EncodeU32BE(Crc32c(t.data, t.len), crcb);
+        struct iovec iov[2] = {{dup.data(), dup.size()}, {crcb, sizeof(crcb)}};
+        s = WritevAll(w->fd, iov, 2, spin);
+      } else {
+        s = WriteAll(w->fd, dup.data(), dup.size(), spin);
+      }
     } else {
-      s = WriteAll(w->fd, t.data, t.len, spin);
+      s = SendChunkWire(w->fd, t.data, t.len, c->crc, spin);
     }
-    if (s.ok() && c->crc) s = WriteChunkCrc(w->fd, Crc32c(t.data, t.len), spin);
     if (!s.ok()) {
       if (SenderStreamFailed(c, w)) return;  // failover: records carry the rest
       t.state->SetError(s.msg);
@@ -516,9 +536,8 @@ void RecvWorkerLoop(StreamWorker* w, bool spin) {
   while (w->tasks.Pop(&t)) {
     t.state->MarkWireStart(MonotonicUs());
     FaultAction fa = FaultCheck(false, w->idx, w->fd, t.len);
-    Status s = ReadExact(w->fd, t.data, t.len, spin);
     uint32_t wire_crc = 0;
-    if (s.ok() && c->crc) s = ReadChunkCrc(w->fd, &wire_crc, spin);
+    Status s = RecvChunkWire(w->fd, t.data, t.len, c->crc, spin, &wire_crc);
     if (!s.ok()) {
       if (ReceiverStreamFailed(c, w)) {
         // Become the ctrl pump: with the scheduler possibly parked waiting
@@ -728,12 +747,10 @@ Status ProcessFailoverMarkerLocked(Comm* c, uint64_t frame) {
     if (seq != r.seq || len != r.len) {
       return Status::Inner("failover retransmit unit mismatch on stream " + std::to_string(k));
     }
-    s = ReadExact(c->ctrl_fd, r.data, r.len, c->spin);
+    uint32_t wire_crc = 0;
+    s = RecvChunkWire(c->ctrl_fd, r.data, r.len, c->crc, c->spin, &wire_crc);
     if (!s.ok()) return s;
     if (c->crc) {
-      uint32_t wire_crc = 0;
-      s = ReadChunkCrc(c->ctrl_fd, &wire_crc, c->spin);
-      if (!s.ok()) return s;
       if (wire_crc != Crc32c(r.data, r.len)) {
         Telemetry::Get().OnCrcError();
         r.state->SetError(ErrorKind::kCorruption,
@@ -893,9 +910,16 @@ bool HandleNack(Comm* c, size_t k, uint64_t completed) {
           if (!s.ok()) break;
           EncodeU64BE(r.seq, b);
           EncodeU64BE(r.len, b + 8);
-          s = WriteAll(c->ctrl_fd, b, sizeof(b), c->spin);
-          if (s.ok()) s = WriteAll(c->ctrl_fd, r.data, r.len, c->spin);
-          if (s.ok() && c->crc) s = WriteChunkCrc(c->ctrl_fd, Crc32c(r.data, r.len), c->spin);
+          // One writev per retransmit unit: [seq|len header, payload, crc?].
+          uint8_t crcb[4];
+          struct iovec iov[3] = {{b, sizeof(b)}, {r.data, r.len}, {crcb, 0}};
+          int niov = 2;
+          if (c->crc) {
+            EncodeU32BE(Crc32c(r.data, r.len), crcb);
+            iov[2].iov_len = sizeof(crcb);
+            niov = 3;
+          }
+          s = WritevAll(c->ctrl_fd, iov, niov, c->spin);
           if (s.ok() && !r.written) {
             // First time these bytes reach the kernel: complete their
             // accounting (written records were counted by their worker).
@@ -1013,9 +1037,8 @@ void ExecuteLazyRecv(Comm* c, const Msg& m) {
   if (!dead) {
     StreamWorker* w = c->workers[idx].get();
     m.state->MarkWireStart(MonotonicUs());
-    Status rs = ReadExact(w->fd, m.data, len, c->spin);
     uint32_t wire_crc = 0;
-    if (rs.ok() && c->crc) rs = ReadChunkCrc(w->fd, &wire_crc, c->spin);
+    Status rs = RecvChunkWire(w->fd, m.data, len, c->crc, c->spin, &wire_crc);
     if (rs.ok()) {
       if (c->crc && wire_crc != Crc32c(m.data, len)) {
         Telemetry::Get().OnCrcError();

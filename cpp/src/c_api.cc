@@ -259,6 +259,19 @@ uint32_t tpunet_c_crc32c(const void* data, uint64_t nbytes, uint32_t seed) {
   return tpunet::Crc32c(data, static_cast<size_t>(nbytes), seed);
 }
 
+int32_t tpunet_c_reduce(void* dst, const void* a, const void* b, uint64_t n,
+                        int32_t dtype, int32_t op) {
+  if (dtype < 0 || dtype > 5) return Fail(TPUNET_ERR_INVALID, "bad dtype");
+  if (op < 0 || op > 3) return Fail(TPUNET_ERR_INVALID, "bad op");
+  if (n > 0 && (dst == nullptr || a == nullptr || b == nullptr)) {
+    return Fail(TPUNET_ERR_INVALID, "null buffer with n > 0");
+  }
+  tpunet::ReduceInto(dst, a, b, static_cast<size_t>(n),
+                     static_cast<tpunet::WireDType>(dtype),
+                     static_cast<tpunet::WireRedOp>(op));
+  return TPUNET_OK;
+}
+
 }  // extern "C"
 
 // ---- Collectives ABI ------------------------------------------------------

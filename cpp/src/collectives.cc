@@ -63,211 +63,48 @@ size_t RingChunkBytes() {
 }
 
 // --------------------------------------------------------------------------
-// Reduction kernels. bf16 is stored as uint16_t and reduced in float with
-// round-to-nearest-even back-conversion (TPU-native dtype; XLA does the same
-// for bf16 accumulation on host).
+// Reduction: the 3-operand kernels (dst[i] = a[i] op b[i]) live in utils.cc
+// as ReduceInto — SIMD with runtime dispatch, fork-join above 4 MiB, and the
+// tpunet_reduce_bytes_total counter. The in-place accumulate is the a == dst
+// degenerate case; the out-of-place collectives pass a = caller's sendbuf so
+// the staging copy never has to exist. This file only maps the public
+// DType/RedOp enums onto the wire-layer ones.
 
-inline float Bf16ToF32(uint16_t v) {
-  uint32_t bits = static_cast<uint32_t>(v) << 16;
-  float f;
-  memcpy(&f, &bits, 4);
-  return f;
+WireDType ToWireDType(DType d) {
+  switch (d) {
+    case DType::kF32:
+      return WireDType::kF32;
+    case DType::kF64:
+      return WireDType::kF64;
+    case DType::kBF16:
+      return WireDType::kBF16;
+    case DType::kI32:
+      return WireDType::kI32;
+    case DType::kI64:
+      return WireDType::kI64;
+    case DType::kU8:
+      return WireDType::kU8;
+  }
+  return WireDType::kU8;
 }
 
-inline uint16_t F32ToBf16(float f) {
-  uint32_t bits;
-  memcpy(&bits, &f, 4);
-  // RNE: add half-ulp (0x7FFF) plus the lsb of the kept part.
-  uint32_t rounded = bits + 0x7FFF + ((bits >> 16) & 1);
-  return static_cast<uint16_t>(rounded >> 16);
-}
-
-// 3-operand kernels: dst[i] = a[i] op b[i]. The common in-place reduce is
-// the a == dst degenerate case; the out-of-place collectives pass a =
-// caller's sendbuf so the staging copy never has to exist.
-template <typename T>
-void ReduceTyped(T* dst, const T* a, const T* b, size_t n, RedOp op) {
+WireRedOp ToWireRedOp(RedOp op) {
   switch (op) {
     case RedOp::kSum:
-      for (size_t i = 0; i < n; ++i) dst[i] = a[i] + b[i];
-      break;
+      return WireRedOp::kSum;
     case RedOp::kProd:
-      for (size_t i = 0; i < n; ++i) dst[i] = a[i] * b[i];
-      break;
+      return WireRedOp::kProd;
     case RedOp::kMin:
-      for (size_t i = 0; i < n; ++i) dst[i] = std::min(a[i], b[i]);
-      break;
+      return WireRedOp::kMin;
     case RedOp::kMax:
-      for (size_t i = 0; i < n; ++i) dst[i] = std::max(a[i], b[i]);
-      break;
+      return WireRedOp::kMax;
   }
+  return WireRedOp::kSum;
 }
 
-void ReduceBf16(uint16_t* dst, const uint16_t* asrc, const uint16_t* bsrc,
-                size_t n, RedOp op) {
-  for (size_t i = 0; i < n; ++i) {
-    float a = Bf16ToF32(asrc[i]);
-    float b = Bf16ToF32(bsrc[i]);
-    float r = 0;
-    switch (op) {
-      case RedOp::kSum:
-        r = a + b;
-        break;
-      case RedOp::kProd:
-        r = a * b;
-        break;
-      case RedOp::kMin:
-        r = std::min(a, b);
-        break;
-      case RedOp::kMax:
-        r = std::max(a, b);
-        break;
-    }
-    dst[i] = F32ToBf16(r);
-  }
-}
-
-void ReduceSerial(void* dst, const void* a, const void* b, size_t n, DType dtype,
-                  RedOp op) {
-  switch (dtype) {
-    case DType::kF32:
-      ReduceTyped(static_cast<float*>(dst), static_cast<const float*>(a),
-                  static_cast<const float*>(b), n, op);
-      break;
-    case DType::kF64:
-      ReduceTyped(static_cast<double*>(dst), static_cast<const double*>(a),
-                  static_cast<const double*>(b), n, op);
-      break;
-    case DType::kBF16:
-      ReduceBf16(static_cast<uint16_t*>(dst), static_cast<const uint16_t*>(a),
-                 static_cast<const uint16_t*>(b), n, op);
-      break;
-    case DType::kI32:
-      ReduceTyped(static_cast<int32_t*>(dst), static_cast<const int32_t*>(a),
-                  static_cast<const int32_t*>(b), n, op);
-      break;
-    case DType::kI64:
-      ReduceTyped(static_cast<int64_t*>(dst), static_cast<const int64_t*>(a),
-                  static_cast<const int64_t*>(b), n, op);
-      break;
-    case DType::kU8:
-      ReduceTyped(static_cast<uint8_t*>(dst), static_cast<const uint8_t*>(a),
-                  static_cast<const uint8_t*>(b), n, op);
-      break;
-  }
-}
-
-// Fork-join pool for the reduction kernels. At 100Gb-class DCN speeds a
-// single core's reduce bandwidth (~5-10 GB/s streaming) becomes the pipeline
-// bottleneck of ExchangeReduce, so large chunks fan out across a few cores.
-// Persistent parked threads (no spawn per chunk); sized well below the host
-// core count — the transport's stream workers need cores too.
-class ReducePool {
- public:
-  static ReducePool& Get() {
-    static ReducePool* pool = new ReducePool();  // leaked: lives for process
-    return *pool;
-  }
-
-  // Run fn(i) for i in [0, njobs) on the pool + calling thread; blocks.
-  // Serialized across callers: two Communicators driven from different
-  // Python threads (ctypes releases the GIL) must not interleave the shared
-  // job_/njobs_/next_/pending_ state mid-reduction.
-  void Run(const std::function<void(size_t)>& fn, size_t njobs) {
-    if (nworkers_ == 0 || njobs <= 1) {
-      for (size_t i = 0; i < njobs; ++i) fn(i);
-      return;
-    }
-    std::lock_guard<std::mutex> run_lk(run_mu_);
-    std::unique_lock<std::mutex> lk(mu_);
-    job_ = &fn;
-    njobs_ = njobs;
-    next_ = 0;
-    pending_ = njobs;
-    ++gen_;
-    work_cv_.notify_all();
-    // The caller pulls jobs too — no idle waiting while work remains.
-    while (true) {
-      size_t i = next_;
-      if (i >= njobs_) break;
-      next_ = i + 1;
-      lk.unlock();
-      fn(i);
-      lk.lock();
-      --pending_;
-    }
-    done_cv_.wait(lk, [&] { return pending_ == 0; });
-    job_ = nullptr;
-  }
-
-  size_t nworkers() const { return nworkers_; }
-
- private:
-  ReducePool() {
-    unsigned hw = std::thread::hardware_concurrency();
-    size_t n = hw > 2 ? std::min<size_t>(3, hw / 2) : 0;
-    // TPUNET_REDUCE_THREADS overrides (total shards = workers + caller);
-    // also how CI exercises the parallel path on small runners.
-    uint64_t env = GetEnvU64("TPUNET_REDUCE_THREADS", 0);
-    if (env > 0) n = std::min<uint64_t>(env - 1, 15);
-    nworkers_ = n;
-    for (size_t t = 0; t < n; ++t) {
-      threads_.emplace_back([this] { WorkerLoop(); });
-      threads_.back().detach();  // pool is process-lifetime
-    }
-  }
-
-  void WorkerLoop() {
-    uint64_t seen = 0;
-    std::unique_lock<std::mutex> lk(mu_);
-    while (true) {
-      work_cv_.wait(lk, [&] { return gen_ != seen && job_ != nullptr; });
-      seen = gen_;
-      while (true) {
-        size_t i = next_;
-        if (i >= njobs_) break;
-        next_ = i + 1;
-        const auto* fn = job_;
-        lk.unlock();
-        (*fn)(i);
-        lk.lock();
-        if (--pending_ == 0) done_cv_.notify_all();
-      }
-    }
-  }
-
-  std::mutex run_mu_;  // serializes concurrent Run() callers
-  std::mutex mu_;
-  std::condition_variable work_cv_, done_cv_;
-  const std::function<void(size_t)>* job_ = nullptr;
-  size_t njobs_ = 0, next_ = 0, pending_ = 0;
-  uint64_t gen_ = 0;
-  size_t nworkers_ = 0;
-  std::vector<std::thread> threads_;
-};
-
-// Parallel reduce (dst = a op b): split [0, n) into per-core ranges when the
-// chunk is big enough to amortize the fork-join (>= 4 MiB) and cores are
-// available.
 void Reduce(void* dst, const void* a, const void* b, size_t n, DType dtype,
             RedOp op) {
-  size_t esize = DTypeSize(dtype);
-  ReducePool& pool = ReducePool::Get();
-  size_t nshards = pool.nworkers() + 1;
-  if (nshards <= 1 || n * esize < (4u << 20)) {
-    ReduceSerial(dst, a, b, n, dtype, op);
-    return;
-  }
-  auto* d8 = static_cast<uint8_t*>(dst);
-  const auto* a8 = static_cast<const uint8_t*>(a);
-  const auto* b8 = static_cast<const uint8_t*>(b);
-  pool.Run(
-      [&](size_t i) {
-        size_t lo = n * i / nshards, hi = n * (i + 1) / nshards;
-        ReduceSerial(d8 + lo * esize, a8 + lo * esize, b8 + lo * esize,
-                     hi - lo, dtype, op);
-      },
-      nshards);
+  ReduceInto(dst, a, b, n, ToWireDType(dtype), ToWireRedOp(op));
 }
 
 // --------------------------------------------------------------------------
@@ -318,7 +155,7 @@ class RingCommunicator : public Communicator {
   struct RingChannel {
     uint64_t send_comm = 0;
     uint64_t recv_comm = 0;
-    std::vector<uint8_t> scratch;
+    ScratchBuf scratch;  // chunk landing slots; aligned, never zero-filled
   };
 
   RingCommunicator(int rank, int world) : rank_(rank), world_(world) {}
@@ -507,8 +344,8 @@ class RingCommunicator : public Communicator {
                     static_cast<uint64_t>(W) * block);
     if (out < src + static_cast<size_t>(W) * block && src < out + block) {
       // Overlapping C-ABI buffers: keep the safe full-copy path.
-      work_.resize(static_cast<size_t>(W) * block);
-      memcpy(work_.data(), sendbuf, work_.size());
+      work_.reserve(static_cast<size_t>(W) * block);
+      memcpy(work_.data(), sendbuf, static_cast<size_t>(W) * block);
       const int vr0 = (rank_ + W - 1) % W;
       for (int s = 0; s < W - 1; ++s) {
         int sidx = (vr0 - s + W) % W;
@@ -532,7 +369,7 @@ class RingCommunicator : public Communicator {
     // path exists to avoid).
     uint8_t* pb[2] = {nullptr, nullptr};
     if (W > 2) {
-      work_.resize(2 * block);
+      work_.reserve(2 * block);
       pb[0] = work_.data();
       pb[1] = work_.data() + block;
     }  // W==2: single round goes sendbuf->recvbuf, pb never read
@@ -648,8 +485,8 @@ class RingCommunicator : public Communicator {
     // block rank (rank-s-1) addressed to us), the rest forward verbatim next
     // step. Both sides compute identical per-step sizes, so the fixed-size
     // Exchange path (got=nullptr) catches rank disagreement as an error.
-    a2a_fwd_.resize(static_cast<size_t>(W - 1) * B);
-    a2a_rcv_.resize(static_cast<size_t>(W - 1) * B);
+    a2a_fwd_.reserve(static_cast<size_t>(W - 1) * B);
+    a2a_rcv_.reserve(static_cast<size_t>(W - 1) * B);
     for (int p = 0; p < W - 1; ++p) {
       int dest = (rank_ + (W - 1 - p)) % W;
       memcpy(a2a_fwd_.data() + static_cast<size_t>(p) * B, in + dest * B, B);
@@ -661,7 +498,7 @@ class RingCommunicator : public Communicator {
       if (!st.ok()) return st;
       int src = (rank_ - s - 1 + W) % W;
       memcpy(out + src * B, a2a_rcv_.data() + (nblk - 1) * B, B);
-      std::swap(a2a_fwd_, a2a_rcv_);
+      a2a_fwd_.swap(a2a_rcv_);
     }
     return Status::Ok();
   }
@@ -756,8 +593,8 @@ class RingCommunicator : public Communicator {
     // the outgoing blocks.
     const uint8_t* src = in;
     if (in == out) {
-      a2a_fwd_.resize(static_cast<size_t>(W) * B);
-      memcpy(a2a_fwd_.data(), in, a2a_fwd_.size());
+      a2a_fwd_.reserve(static_cast<size_t>(W) * B);
+      memcpy(a2a_fwd_.data(), in, static_cast<size_t>(W) * B);
       src = a2a_fwd_.data();
     }
     std::vector<uint64_t> rreqs, sreqs;
@@ -903,7 +740,7 @@ class RingCommunicator : public Communicator {
     size_t esize = DTypeSize(dtype);
     size_t chunk = RingChunkBytes() / esize * esize;
     if (chunk == 0 || (send_nbytes <= chunk && recv_nbytes <= chunk)) {
-      ch.scratch.resize(std::max(ch.scratch.size(), recv_nbytes));
+      ch.scratch.reserve(recv_nbytes);
       Status st = Exchange(sendbuf, send_nbytes, ch.scratch.data(), recv_nbytes, nullptr, ch);
       if (!st.ok()) return st;
       Reduce(accum, local, ch.scratch.data(), recv_nbytes / esize, dtype, op);
@@ -916,7 +753,7 @@ class RingCommunicator : public Communicator {
     size_t ns = (send_nbytes + chunk - 1) / chunk;
     size_t nr = (recv_nbytes + chunk - 1) / chunk;
     size_t n = std::max(ns, nr);
-    ch.scratch.resize(2 * chunk);
+    ch.scratch.reserve(2 * chunk);
     auto slen = [&](size_t i) { return std::min(chunk, send_nbytes - i * chunk); };
     auto rlen = [&](size_t i) { return std::min(chunk, recv_nbytes - i * chunk); };
 
@@ -1184,9 +1021,9 @@ class RingCommunicator : public Communicator {
   std::vector<SocketHandle> all_handles_;
   std::vector<uint64_t> mesh_send_;
   std::vector<uint64_t> mesh_recv_;
-  std::vector<uint8_t> work_;
+  ScratchBuf work_;
   std::vector<uint8_t> barrier_scratch_;
-  std::vector<uint8_t> a2a_fwd_, a2a_rcv_;
+  ScratchBuf a2a_fwd_, a2a_rcv_;
   // Async (nonblocking-collective) state; async_mu_ guards all of it. Worker
   // c is the only place async jobs touch channel c's comms/scratch, and
   // FenceAsync keeps the sync paths out while any job runs.

@@ -478,6 +478,30 @@ class Loop {
     AdvanceFdLocked(fs);
   }
 
+  // Recv-side completion side effects: injected wire damage lands before the
+  // CRC verify, and a trailer mismatch fails the REQUEST (not the comm — the
+  // framing is intact, so the comm keeps serving subsequent messages).
+  void FinishSegmentLocked(Segment& seg, FdState* fs) {
+    if (!fs->comm->is_send) {
+      if (seg.corrupt && seg.len > 0) {
+        seg.data[seg.len / 2] ^= 0x01;  // wire damage before verify
+        seg.corrupt = false;
+      }
+      if (seg.trailer_len > 0 && DecodeU32BE(seg.trailer) != Crc32c(seg.data, seg.len)) {
+        Telemetry::Get().OnCrcError();
+        seg.state->SetError(ErrorKind::kCorruption,
+                            "CRC32C mismatch on data stream " +
+                                std::to_string(fs->stream_idx) +
+                                ": payload corrupted in transit");
+      }
+    }
+    CompleteSegment(seg, fs);
+  }
+
+  // Segments coalesced per sendmsg/recvmsg. Each contributes up to two
+  // iovecs (payload remainder + trailer remainder); well under IOV_MAX.
+  static constexpr int kIovBatch = 64;
+
   void AdvanceFdLocked(FdState* fs) {
     EComm* c = fs->comm;
     if (c->failed || fs->fd < 0) return;
@@ -486,85 +510,91 @@ class Loop {
       return;
     }
     while (!fs->segs.empty()) {
-      Segment& seg = fs->segs.front();
-      bool in_trailer = seg.done == seg.len && seg.trailer_len > 0;
-      if (!fs->is_ctrl && !in_trailer) {
-        // Fault gate (data payload IO only; ctrl and trailers are exempt).
-        // Byte accounting is per-attempt here, so after_bytes thresholds
-        // are approximate on this engine (exact on BASIC's per-chunk IO).
-        FaultAction fa = FaultCheck(c->is_send, fs->stream_idx, fs->fd, seg.len - seg.done);
-        if (fa == FaultAction::kCorrupt) seg.corrupt = true;
-      }
-      const bool first_payload_io = !fs->is_ctrl && !in_trailer && seg.done == 0;
-      ssize_t m;
-      if (in_trailer) {
-        if (c->is_send && seg.corrupt && seg.trailer_done == 0) {
+      // Iovec cursor over the segment FIFO: gather every queued segment's
+      // remaining payload + CRC trailer into ONE sendmsg/recvmsg, then walk
+      // the moved bytes back through the segments. The round-4 machine paid
+      // one syscall per partial segment move (plus one per trailer); this
+      // pass moves as many whole segments as the kernel will take per
+      // syscall — the tx half of the syscalls/MiB budget (docs/DESIGN.md).
+      struct iovec iov[kIovBatch];
+      int niov = 0;
+      size_t want = 0;
+      for (Segment& seg : fs->segs) {
+        if (niov + 2 > kIovBatch) break;
+        size_t left = seg.len - seg.done;
+        if (left > 0 && !fs->is_ctrl) {
+          // Fault gate (data payload only; ctrl frames and trailers are
+          // exempt). Gated once per segment per IO pass, so after_bytes
+          // thresholds are approximate on this engine (exact on BASIC's
+          // per-chunk IO) — as before the vectored rewrite.
+          FaultAction fa = FaultCheck(c->is_send, fs->stream_idx, fs->fd, left);
+          if (fa == FaultAction::kCorrupt) seg.corrupt = true;
+        }
+        if (c->is_send && seg.corrupt && seg.trailer_len > 0 && seg.trailer_done == 0) {
           // Send-side injected corruption: damage the trailer on the wire
           // (the payload is the caller's buffer and must not be touched).
           seg.trailer[0] ^= 0x01;
           seg.corrupt = false;
         }
-        m = c->is_send ? ::send(fs->fd, seg.trailer + seg.trailer_done,
-                                seg.trailer_len - seg.trailer_done,
-                                MSG_DONTWAIT | MSG_NOSIGNAL)
-                       : ::recv(fs->fd, seg.trailer + seg.trailer_done,
-                                seg.trailer_len - seg.trailer_done, MSG_DONTWAIT);
-      } else if (c->is_send) {
-        m = ::send(fs->fd, seg.data + seg.done, seg.len - seg.done,
-                   MSG_DONTWAIT | MSG_NOSIGNAL);
-      } else {
-        m = ::recv(fs->fd, seg.data + seg.done, seg.len - seg.done, MSG_DONTWAIT);
+        if (left > 0) {
+          iov[niov].iov_base = seg.data + seg.done;
+          iov[niov].iov_len = left;
+          ++niov;
+          want += left;
+        }
+        size_t tleft = seg.trailer_len - seg.trailer_done;
+        if (tleft > 0) {
+          iov[niov].iov_base = seg.trailer + seg.trailer_done;
+          iov[niov].iov_len = tleft;
+          ++niov;
+          want += tleft;
+        }
       }
-      if (m > 0) {
-        if (in_trailer) {
-          seg.trailer_done += static_cast<size_t>(m);
-          if (seg.trailer_done < seg.trailer_len) continue;
-          if (!c->is_send) {
-            if (seg.corrupt && seg.len > 0) {
-              seg.data[seg.len / 2] ^= 0x01;  // wire damage before verify
-              seg.corrupt = false;
-            }
-            if (DecodeU32BE(seg.trailer) != Crc32c(seg.data, seg.len)) {
-              // Integrity failure is a REQUEST error, not a disconnect: the
-              // framing is intact, so only this message's state fails and
-              // the comm keeps serving subsequent messages.
-              Telemetry::Get().OnCrcError();
-              seg.state->SetError(ErrorKind::kCorruption,
-                                  "CRC32C mismatch on data stream " +
-                                      std::to_string(fs->stream_idx) +
-                                      ": payload corrupted in transit");
-            }
-          }
-          CompleteSegment(seg, fs);
-          fs->segs.pop_front();
-          continue;
-        }
-        if (!fs->is_ctrl) {
-          if (first_payload_io) seg.state->MarkWireStart(MonotonicUs());
-          Telemetry::Get().OnStreamBytes(c->is_send, fs->stream_idx,
-                                         static_cast<uint64_t>(m));
-        }
-        seg.done += static_cast<size_t>(m);
-        if (seg.done == seg.len) {
-          if (seg.trailer_len > 0) continue;  // trailer phase next
-          if (!c->is_send && seg.corrupt && seg.len > 0) {
-            seg.data[seg.len / 2] ^= 0x01;  // CRC off: silent wire damage
-            seg.corrupt = false;
-          }
-          CompleteSegment(seg, fs);
-          fs->segs.pop_front();
-          continue;
-        }
-        continue;  // partial move; kernel may have more room/bytes
+      if (want == 0) break;  // defensive: no segment with bytes left
+      struct msghdr mh = {};
+      mh.msg_iov = iov;
+      mh.msg_iovlen = static_cast<size_t>(niov);
+      CountIoSyscall(c->is_send ? kIoSendmsg : kIoRecvmsg);
+      ssize_t m = c->is_send ? ::sendmsg(fs->fd, &mh, MSG_DONTWAIT | MSG_NOSIGNAL)
+                             : ::recvmsg(fs->fd, &mh, MSG_DONTWAIT);
+      if (m < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        if (errno == EINTR) continue;
+        FailCommLocked(c, std::string(c->is_send ? "send" : "recv") +
+                              " failed: " + strerror(errno));
+        return;
       }
-      if (m == 0) {  // EOF on recv
+      if (m == 0 && !c->is_send) {  // EOF on recv
         FailCommLocked(c, "peer closed data stream mid-message");
         return;
       }
-      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
-      if (errno == EINTR) continue;
-      FailCommLocked(c, std::string(c->is_send ? "send" : "recv") + " failed: " + strerror(errno));
-      return;
+      // Cursor walk: spread the moved bytes over the front segments,
+      // completing (and popping) each one that fills.
+      const uint64_t now = MonotonicUs();
+      size_t moved = static_cast<size_t>(m);
+      while (moved > 0 && !fs->segs.empty()) {
+        Segment& seg = fs->segs.front();
+        size_t take = std::min(moved, seg.len - seg.done);
+        if (take > 0) {
+          if (!fs->is_ctrl) {
+            if (seg.done == 0) seg.state->MarkWireStart(now);
+            Telemetry::Get().OnStreamBytes(c->is_send, fs->stream_idx,
+                                           static_cast<uint64_t>(take));
+          }
+          seg.done += take;
+          moved -= take;
+        }
+        size_t ttake = std::min(moved, seg.trailer_len - seg.trailer_done);
+        seg.trailer_done += ttake;
+        moved -= ttake;
+        if (seg.done == seg.len && seg.trailer_done == seg.trailer_len) {
+          FinishSegmentLocked(seg, fs);
+          fs->segs.pop_front();
+          continue;
+        }
+        break;  // kernel stopped mid-segment; moved is 0 here
+      }
+      if (static_cast<size_t>(m) < want) break;  // kernel full/empty: arm below
     }
     WantIOLocked(fs);
   }
@@ -573,6 +603,7 @@ class Loop {
     FdState* fs = &c->ctrl;
     bool dispatched = false;
     while (!c->pending.empty()) {
+      CountIoSyscall(kIoRecv);
       ssize_t m = ::recv(fs->fd, c->hdr + c->hdr_done, 8 - c->hdr_done, MSG_DONTWAIT);
       if (m > 0) {
         c->hdr_done += static_cast<size_t>(m);

@@ -620,6 +620,8 @@ void Telemetry::Reset() {
   im->stream_failovers.store(0, std::memory_order_relaxed);
   im->crc_errors.store(0, std::memory_order_relaxed);
   im->straggler_events.store(0, std::memory_order_relaxed);
+  ResetIoSyscallCounts();
+  ResetReduceBytesTotal();
   im->req_queue.Reset();
   im->req_wire.Reset();
   im->req_total.Reset();
@@ -708,6 +710,10 @@ MetricsSnapshot Telemetry::Snapshot() const {
   im->req_queue.SnapshotInto(&s.req_queue_us);
   im->req_wire.SnapshotInto(&s.req_wire_us);
   im->req_total.SnapshotInto(&s.req_total_us);
+  for (int i = 0; i < kIoOpCount; ++i) {
+    s.engine_syscalls[i] = IoSyscallCount(static_cast<IoOp>(i));
+  }
+  s.reduce_bytes = ReduceBytesTotal();
   s.uptime_s = (NowUs() - im->start_us.load(std::memory_order_relaxed)) / 1e6;
   return s;
 }
@@ -877,6 +883,25 @@ std::string Telemetry::PrometheusText() const {
          "Per-chunk CRC32C mismatches detected (TPUNET_CRC=1).");
   emit("tpunet_crc_errors_total{rank=\"%lld\"} %llu\n", (long long)rank,
        (unsigned long long)s.crc_errors);
+  // Zero-copy data-path counters. All four op slots emit even at zero so
+  // syscalls/MiB derivations never divide by a missing series.
+  family("tpunet_engine_syscalls_total", "counter",
+         "Wire send/recv-family syscalls issued on the engines' data paths, "
+         "by syscall op and direction.");
+  static const struct {
+    const char* op;
+    const char* dir;
+  } kIoOpLabels[kIoOpCount] = {
+      {"send", "tx"}, {"recv", "rx"}, {"sendmsg", "tx"}, {"recvmsg", "rx"}};
+  for (int i = 0; i < kIoOpCount; ++i) {
+    emit("tpunet_engine_syscalls_total{rank=\"%lld\",op=\"%s\",dir=\"%s\"} %llu\n",
+         (long long)rank, kIoOpLabels[i].op, kIoOpLabels[i].dir,
+         (unsigned long long)s.engine_syscalls[i]);
+  }
+  family("tpunet_reduce_bytes_total", "counter",
+         "Bytes produced by the collective reduction kernels (output side).");
+  emit("tpunet_reduce_bytes_total{rank=\"%lld\"} %llu\n", (long long)rank,
+       (unsigned long long)s.reduce_bytes);
   return out;
 }
 

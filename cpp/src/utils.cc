@@ -18,11 +18,19 @@
 
 #include <algorithm>
 #include <atomic>
+#include <condition_variable>
 #include <cstdio>
+#include <functional>
 #include <mutex>
 #include <fstream>
+#include <new>
 #include <set>
 #include <sstream>
+#include <thread>
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
 
 namespace tpunet {
 
@@ -194,10 +202,27 @@ size_t ChunkCount(size_t total, size_t chunksize) {
   return (total + chunksize - 1) / chunksize;
 }
 
+namespace {
+std::atomic<uint64_t> g_io_syscalls[kIoOpCount] = {};
+}  // namespace
+
+void CountIoSyscall(IoOp op) {
+  g_io_syscalls[op].fetch_add(1, std::memory_order_relaxed);
+}
+
+uint64_t IoSyscallCount(IoOp op) {
+  return g_io_syscalls[op].load(std::memory_order_relaxed);
+}
+
+void ResetIoSyscallCounts() {
+  for (auto& c : g_io_syscalls) c.store(0, std::memory_order_relaxed);
+}
+
 Status WriteAll(int fd, const void* buf, size_t n, bool spin) {
   const uint8_t* p = static_cast<const uint8_t*>(buf);
   size_t left = n;
   while (left > 0) {
+    CountIoSyscall(kIoSend);
     ssize_t w = ::send(fd, p, left, MSG_NOSIGNAL);
     if (w > 0) {
       p += w;
@@ -218,7 +243,13 @@ Status ReadExact(int fd, void* buf, size_t n, bool spin) {
   uint8_t* p = static_cast<uint8_t*>(buf);
   size_t left = n;
   while (left > 0) {
-    ssize_t r = ::recv(fd, p, left, 0);
+    // MSG_WAITALL: on a blocking socket the kernel assembles the whole read
+    // internally — one syscall per chunk instead of one per buffer refill
+    // (~16/MiB before). Partial returns (signal, shutdown, nonblocking spin
+    // fd) still land in the loop. Harmless in spin mode: a nonblocking fd
+    // never waits regardless of the flag.
+    CountIoSyscall(kIoRecv);
+    ssize_t r = ::recv(fd, p, left, MSG_WAITALL);
     if (r > 0) {
       p += r;
       left -= static_cast<size_t>(r);
@@ -234,6 +265,83 @@ Status ReadExact(int fd, void* buf, size_t n, bool spin) {
       continue;
     }
     return Status::IO("read failed: " + std::string(strerror(errno)));
+  }
+  return Status::Ok();
+}
+
+namespace {
+
+// Advance a vectored-IO cursor by `moved` bytes: shrink/skip leading iovecs
+// in place. Returns the new head/count through the out-params.
+void AdvanceIov(struct iovec** iov, int* iovcnt, size_t moved) {
+  struct iovec* v = *iov;
+  int n = *iovcnt;
+  while (n > 0 && (moved >= v->iov_len || v->iov_len == 0)) {
+    moved -= v->iov_len;
+    ++v;
+    --n;
+  }
+  if (n > 0 && moved > 0) {
+    v->iov_base = static_cast<uint8_t*>(v->iov_base) + moved;
+    v->iov_len -= moved;
+  }
+  *iov = v;
+  *iovcnt = n;
+}
+
+size_t IovTotal(const struct iovec* iov, int iovcnt) {
+  size_t total = 0;
+  for (int i = 0; i < iovcnt; ++i) total += iov[i].iov_len;
+  return total;
+}
+
+}  // namespace
+
+Status WritevAll(int fd, struct iovec* iov, int iovcnt, bool spin) {
+  size_t left = IovTotal(iov, iovcnt);
+  while (left > 0) {
+    struct msghdr mh = {};
+    mh.msg_iov = iov;
+    mh.msg_iovlen = static_cast<size_t>(iovcnt);
+    CountIoSyscall(kIoSendmsg);
+    ssize_t w = ::sendmsg(fd, &mh, MSG_NOSIGNAL);
+    if (w > 0) {
+      left -= static_cast<size_t>(w);
+      AdvanceIov(&iov, &iovcnt, static_cast<size_t>(w));
+      continue;
+    }
+    if (w < 0 && errno == EINTR) continue;
+    if (w < 0 && spin && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      sched_yield();
+      continue;
+    }
+    return Status::IO("writev failed: " + std::string(strerror(errno)));
+  }
+  return Status::Ok();
+}
+
+Status ReadvExact(int fd, struct iovec* iov, int iovcnt, bool spin) {
+  size_t left = IovTotal(iov, iovcnt);
+  while (left > 0) {
+    struct msghdr mh = {};
+    mh.msg_iov = iov;
+    mh.msg_iovlen = static_cast<size_t>(iovcnt);
+    CountIoSyscall(kIoRecvmsg);
+    // recvmsg (not readv) so MSG_WAITALL applies — one syscall per vectored
+    // chunk read in the common case; see ReadExact.
+    ssize_t r = ::recvmsg(fd, &mh, MSG_WAITALL);
+    if (r > 0) {
+      left -= static_cast<size_t>(r);
+      AdvanceIov(&iov, &iovcnt, static_cast<size_t>(r));
+      continue;
+    }
+    if (r == 0) return Status::IO("unexpected EOF: peer closed connection");
+    if (errno == EINTR) continue;
+    if (spin && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      sched_yield();
+      continue;
+    }
+    return Status::IO("readv failed: " + std::string(strerror(errno)));
   }
   return Status::Ok();
 }
@@ -445,6 +553,339 @@ uint32_t Crc32c(const void* data, size_t n, uint32_t crc) {
   if (hw) return Crc32cHardware(p, n, crc);
 #endif
   return Crc32cSoftware(p, n, crc);
+}
+
+// ---------------------------------------------------------------------------
+// Reduction kernels (see utils.h). The scalar bodies are the ground truth;
+// the AVX2 paths replicate them BITWISE — float min/max via compare+blend
+// (std::min(a,b) == (b<a)?b:a, NaN-propagation included; _mm256_min_ps has
+// different NaN semantics and is deliberately not used), bf16 via the same
+// integer round-to-nearest-even arithmetic as the scalar converter.
+
+namespace {
+
+std::atomic<uint64_t> g_reduce_bytes{0};
+
+inline float Bf16ToF32(uint16_t v) {
+  uint32_t bits = static_cast<uint32_t>(v) << 16;
+  float f;
+  memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t F32ToBf16(float f) {
+  uint32_t bits;
+  memcpy(&bits, &f, 4);
+  // RNE: add half-ulp (0x7FFF) plus the lsb of the kept part.
+  uint32_t rounded = bits + 0x7FFF + ((bits >> 16) & 1);
+  return static_cast<uint16_t>(rounded >> 16);
+}
+
+template <typename T>
+void ReduceTyped(T* dst, const T* a, const T* b, size_t n, WireRedOp op) {
+  switch (op) {
+    case WireRedOp::kSum:
+      for (size_t i = 0; i < n; ++i) dst[i] = a[i] + b[i];
+      break;
+    case WireRedOp::kProd:
+      for (size_t i = 0; i < n; ++i) dst[i] = a[i] * b[i];
+      break;
+    case WireRedOp::kMin:
+      for (size_t i = 0; i < n; ++i) dst[i] = std::min(a[i], b[i]);
+      break;
+    case WireRedOp::kMax:
+      for (size_t i = 0; i < n; ++i) dst[i] = std::max(a[i], b[i]);
+      break;
+  }
+}
+
+void ReduceBf16Scalar(uint16_t* dst, const uint16_t* asrc, const uint16_t* bsrc,
+                      size_t n, WireRedOp op) {
+  for (size_t i = 0; i < n; ++i) {
+    float a = Bf16ToF32(asrc[i]);
+    float b = Bf16ToF32(bsrc[i]);
+    float r = 0;
+    switch (op) {
+      case WireRedOp::kSum:
+        r = a + b;
+        break;
+      case WireRedOp::kProd:
+        r = a * b;
+        break;
+      case WireRedOp::kMin:
+        r = std::min(a, b);
+        break;
+      case WireRedOp::kMax:
+        r = std::max(a, b);
+        break;
+    }
+    dst[i] = F32ToBf16(r);
+  }
+}
+
+void ReduceShardScalar(void* dst, const void* a, const void* b, size_t n,
+                       WireDType dtype, WireRedOp op) {
+  switch (dtype) {
+    case WireDType::kF32:
+      ReduceTyped(static_cast<float*>(dst), static_cast<const float*>(a),
+                  static_cast<const float*>(b), n, op);
+      break;
+    case WireDType::kF64:
+      ReduceTyped(static_cast<double*>(dst), static_cast<const double*>(a),
+                  static_cast<const double*>(b), n, op);
+      break;
+    case WireDType::kBF16:
+      ReduceBf16Scalar(static_cast<uint16_t*>(dst), static_cast<const uint16_t*>(a),
+                       static_cast<const uint16_t*>(b), n, op);
+      break;
+    case WireDType::kI32:
+      ReduceTyped(static_cast<int32_t*>(dst), static_cast<const int32_t*>(a),
+                  static_cast<const int32_t*>(b), n, op);
+      break;
+    case WireDType::kI64:
+      ReduceTyped(static_cast<int64_t*>(dst), static_cast<const int64_t*>(a),
+                  static_cast<const int64_t*>(b), n, op);
+      break;
+    case WireDType::kU8:
+      ReduceTyped(static_cast<uint8_t*>(dst), static_cast<const uint8_t*>(a),
+                  static_cast<const uint8_t*>(b), n, op);
+      break;
+  }
+}
+
+#if defined(__x86_64__)
+
+// Elementwise op on two f32 vectors with scalar-identical semantics: IEEE
+// add/mul are exact per element; min/max replicate std::min/std::max via
+// ordered-quiet compare + blend (NaN in either operand -> compare false ->
+// the FIRST operand survives, exactly like the scalar ternary).
+__attribute__((target("avx2")))
+inline __m256 Avx2Op(__m256 va, __m256 vb, WireRedOp op) {
+  switch (op) {
+    case WireRedOp::kSum:
+      return _mm256_add_ps(va, vb);
+    case WireRedOp::kProd:
+      return _mm256_mul_ps(va, vb);
+    case WireRedOp::kMin:
+      return _mm256_blendv_ps(va, vb, _mm256_cmp_ps(vb, va, _CMP_LT_OQ));
+    case WireRedOp::kMax:
+      return _mm256_blendv_ps(va, vb, _mm256_cmp_ps(va, vb, _CMP_LT_OQ));
+  }
+  return va;
+}
+
+__attribute__((target("avx2")))
+void ReduceF32Avx2(float* dst, const float* a, const float* b, size_t n,
+                   WireRedOp op) {
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m256 va = _mm256_loadu_ps(a + i);
+    __m256 vb = _mm256_loadu_ps(b + i);
+    _mm256_storeu_ps(dst + i, Avx2Op(va, vb, op));
+  }
+  if (i < n) ReduceTyped(dst + i, a + i, b + i, n - i, op);
+}
+
+__attribute__((target("avx2")))
+void ReduceBf16Avx2(uint16_t* dst, const uint16_t* a, const uint16_t* b,
+                    size_t n, WireRedOp op) {
+  const __m256i kHalf = _mm256_set1_epi32(0x7FFF);
+  const __m256i kOne = _mm256_set1_epi32(1);
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m128i ha = _mm_loadu_si128(reinterpret_cast<const __m128i*>(a + i));
+    __m128i hb = _mm_loadu_si128(reinterpret_cast<const __m128i*>(b + i));
+    __m256 fa = _mm256_castsi256_ps(_mm256_slli_epi32(_mm256_cvtepu16_epi32(ha), 16));
+    __m256 fb = _mm256_castsi256_ps(_mm256_slli_epi32(_mm256_cvtepu16_epi32(hb), 16));
+    __m256i bits = _mm256_castps_si256(Avx2Op(fa, fb, op));
+    // F32ToBf16's RNE: bits + 0x7FFF + ((bits >> 16) & 1), take the high
+    // half. The adds wrap mod 2^32 exactly like the scalar uint32_t.
+    __m256i lsb = _mm256_and_si256(_mm256_srli_epi32(bits, 16), kOne);
+    __m256i hi = _mm256_srli_epi32(_mm256_add_epi32(_mm256_add_epi32(bits, kHalf), lsb), 16);
+    // Pack 8 u32 (each <= 0xFFFF, so packus saturation is exact) to 8 u16;
+    // packus interleaves 128-bit lanes, the permute restores order.
+    __m256i packed = _mm256_permute4x64_epi64(_mm256_packus_epi32(hi, hi), 0xD8);
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(dst + i),
+                     _mm256_castsi256_si128(packed));
+  }
+  if (i < n) ReduceBf16Scalar(dst + i, a + i, b + i, n - i, op);
+}
+
+bool ReduceSimdEnabled() {
+  static const bool on = GetEnvU64("TPUNET_REDUCE_SIMD", 1) != 0 &&
+                         __builtin_cpu_supports("avx2");
+  return on;
+}
+
+#endif  // __x86_64__
+
+// One shard of a reduce: SIMD when the dtype has a vector kernel and the
+// CPU dispatch admits it, scalar otherwise.
+void ReduceShard(void* dst, const void* a, const void* b, size_t n,
+                 WireDType dtype, WireRedOp op) {
+#if defined(__x86_64__)
+  if (ReduceSimdEnabled()) {
+    if (dtype == WireDType::kF32) {
+      ReduceF32Avx2(static_cast<float*>(dst), static_cast<const float*>(a),
+                    static_cast<const float*>(b), n, op);
+      return;
+    }
+    if (dtype == WireDType::kBF16) {
+      ReduceBf16Avx2(static_cast<uint16_t*>(dst), static_cast<const uint16_t*>(a),
+                     static_cast<const uint16_t*>(b), n, op);
+      return;
+    }
+  }
+#endif
+  ReduceShardScalar(dst, a, b, n, dtype, op);
+}
+
+// Fork-join pool for the reduction kernels. At 100Gb-class DCN speeds a
+// single core's reduce bandwidth (~5-10 GB/s streaming) becomes the pipeline
+// bottleneck of the ring's pipelined exchange, so large chunks fan out
+// across a few cores. Persistent parked threads (no spawn per chunk); sized
+// well below the host core count — the transport's stream workers need
+// cores too.
+class ReducePool {
+ public:
+  static ReducePool& Get() {
+    static ReducePool* pool = new ReducePool();  // leaked: lives for process
+    return *pool;
+  }
+
+  // Run fn(i) for i in [0, njobs) on the pool + calling thread; blocks.
+  // Serialized across callers: two Communicators driven from different
+  // Python threads (ctypes releases the GIL) must not interleave the shared
+  // job_/njobs_/next_/pending_ state mid-reduction.
+  void Run(const std::function<void(size_t)>& fn, size_t njobs) {
+    if (nworkers_ == 0 || njobs <= 1) {
+      for (size_t i = 0; i < njobs; ++i) fn(i);
+      return;
+    }
+    std::lock_guard<std::mutex> run_lk(run_mu_);
+    std::unique_lock<std::mutex> lk(mu_);
+    job_ = &fn;
+    njobs_ = njobs;
+    next_ = 0;
+    pending_ = njobs;
+    ++gen_;
+    work_cv_.notify_all();
+    // The caller pulls jobs too — no idle waiting while work remains.
+    while (true) {
+      size_t i = next_;
+      if (i >= njobs_) break;
+      next_ = i + 1;
+      lk.unlock();
+      fn(i);
+      lk.lock();
+      --pending_;
+    }
+    done_cv_.wait(lk, [&] { return pending_ == 0; });
+    job_ = nullptr;
+  }
+
+  size_t nworkers() const { return nworkers_; }
+
+ private:
+  ReducePool() {
+    unsigned hw = std::thread::hardware_concurrency();
+    size_t n = hw > 2 ? std::min<size_t>(3, hw / 2) : 0;
+    // TPUNET_REDUCE_THREADS overrides (total shards = workers + caller);
+    // also how CI exercises the parallel path on small runners.
+    uint64_t env = GetEnvU64("TPUNET_REDUCE_THREADS", 0);
+    if (env > 0) n = std::min<uint64_t>(env - 1, 15);
+    nworkers_ = n;
+    for (size_t t = 0; t < n; ++t) {
+      threads_.emplace_back([this] { WorkerLoop(); });
+      threads_.back().detach();  // pool is process-lifetime
+    }
+  }
+
+  void WorkerLoop() {
+    uint64_t seen = 0;
+    std::unique_lock<std::mutex> lk(mu_);
+    while (true) {
+      work_cv_.wait(lk, [&] { return gen_ != seen && job_ != nullptr; });
+      seen = gen_;
+      while (true) {
+        size_t i = next_;
+        if (i >= njobs_) break;
+        next_ = i + 1;
+        const auto* fn = job_;
+        lk.unlock();
+        (*fn)(i);
+        lk.lock();
+        if (--pending_ == 0) done_cv_.notify_all();
+      }
+    }
+  }
+
+  std::mutex run_mu_;  // serializes concurrent Run() callers
+  std::mutex mu_;
+  std::condition_variable work_cv_, done_cv_;
+  const std::function<void(size_t)>* job_ = nullptr;
+  size_t njobs_ = 0, next_ = 0, pending_ = 0;
+  uint64_t gen_ = 0;
+  size_t nworkers_ = 0;
+  std::vector<std::thread> threads_;
+};
+
+}  // namespace
+
+size_t WireDTypeSize(WireDType d) {
+  switch (d) {
+    case WireDType::kF32:
+    case WireDType::kI32:
+      return 4;
+    case WireDType::kF64:
+    case WireDType::kI64:
+      return 8;
+    case WireDType::kBF16:
+      return 2;
+    case WireDType::kU8:
+      return 1;
+  }
+  return 0;
+}
+
+void ReduceInto(void* dst, const void* a, const void* b, size_t n,
+                WireDType dtype, WireRedOp op) {
+  size_t esize = WireDTypeSize(dtype);
+  g_reduce_bytes.fetch_add(n * esize, std::memory_order_relaxed);
+  ReducePool& pool = ReducePool::Get();
+  size_t nshards = pool.nworkers() + 1;
+  // Fan out only when the chunk amortizes the fork-join (>= 4 MiB).
+  if (nshards <= 1 || n * esize < (4u << 20)) {
+    ReduceShard(dst, a, b, n, dtype, op);
+    return;
+  }
+  auto* d8 = static_cast<uint8_t*>(dst);
+  const auto* a8 = static_cast<const uint8_t*>(a);
+  const auto* b8 = static_cast<const uint8_t*>(b);
+  pool.Run(
+      [&](size_t i) {
+        size_t lo = n * i / nshards, hi = n * (i + 1) / nshards;
+        ReduceShard(d8 + lo * esize, a8 + lo * esize, b8 + lo * esize,
+                    hi - lo, dtype, op);
+      },
+      nshards);
+}
+
+uint64_t ReduceBytesTotal() {
+  return g_reduce_bytes.load(std::memory_order_relaxed);
+}
+
+void ResetReduceBytesTotal() { g_reduce_bytes.store(0, std::memory_order_relaxed); }
+
+ScratchBuf::~ScratchBuf() {
+  if (p_) ::operator delete[](p_, std::align_val_t(64));
+}
+
+void ScratchBuf::reserve(size_t n) {
+  if (n <= cap_) return;
+  if (p_) ::operator delete[](p_, std::align_val_t(64));
+  p_ = static_cast<uint8_t*>(::operator new[](n, std::align_val_t(64)));
+  cap_ = n;
 }
 
 bool ParseUserPassAndAddr(const std::string& s, UserPassAddr* out) {

@@ -1,0 +1,56 @@
+"""CI perf-smoke lane (not pytest-collected — run as a script).
+
+A short loopback p2p transfer per engine, asserting syscalls/MiB stays under
+a committed budget. This is the regression tripwire for the vectored wire
+path: a change that re-fragments it — separate syscalls for payload vs CRC
+trailer, losing MSG_WAITALL on chunk reads, per-segment instead of
+iovec-batched IO on EPOLL — moves syscalls/MiB by integer FACTORS, while
+the 1-core CI box's GB/s swings ±20% on its own and can hide any throughput
+regression. The counters come from tpunet_engine_syscalls_total{op,dir}
+over the timed window (warmup excluded), via benchmarks.engine_p2p.
+
+Budgets (16 MiB messages, nstreams=2, CRC off; PERF_NOTES round 6):
+  BASIC: blocking IO — 1 sendmsg + 1 MSG_WAITALL recvmsg per chunk +
+         per-message ctrl traffic => measured 0.19/MiB; budget 3.0 leaves
+         jitter headroom while still catching any per-refill re-read
+         pattern (a real-NIC-style 64 KiB refill cadence is 16/MiB).
+  EPOLL: nonblocking IO moves only what's ready per syscall, so the count
+         is readiness-dependent; measured 0.42/MiB with iovec batching
+         (pre-vectored seed: ~0.5 at 128 MiB, worse at this size, plus a
+         trailer syscall per chunk under CRC); budget 6.0.
+
+Usage: python tests/perf_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.engine_p2p import run_engine  # noqa: E402
+
+SIZE = 16 << 20
+BUDGET_SYSCALLS_PER_MIB = {"BASIC": 3.0, "EPOLL": 6.0}
+
+
+def main() -> None:
+    os.environ.setdefault("TPUNET_CRC", "0")
+    failures = []
+    for engine, budget in BUDGET_SYSCALLS_PER_MIB.items():
+        r = run_engine(engine, nstreams=2, sizes=[SIZE], iters=4)
+        spm = r[SIZE]["syscalls_per_mib"]
+        bps = r[SIZE]["bytes_per_syscall"]
+        print(f"[perf_smoke] {engine}: {spm} syscalls/MiB "
+              f"({bps} B/syscall, budget {budget})")
+        if spm is None or spm > budget:
+            failures.append(f"{engine}: {spm} syscalls/MiB exceeds budget {budget}")
+    if failures:
+        raise SystemExit("perf smoke FAILED — wire path re-fragmented?\n  "
+                         + "\n  ".join(failures))
+    print("perf_smoke OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -117,6 +117,15 @@ def test_crc32c_matches_reference_and_chains():
         ("TPUNET_CONNECT_RETRY_MS", "-100", False),
         ("TPUNET_PROGRESS_TIMEOUT_MS", "-1", False),
         ("TPUNET_PROGRESS_TIMEOUT_MS", "5000", True),
+        ("TPUNET_METRICS_PORT", "-1", False),
+        ("TPUNET_METRICS_PORT", "65536", False),
+        ("TPUNET_METRICS_PORT", "70000", False),
+        ("TPUNET_METRICS_PORT", "0", True),
+        ("TPUNET_METRICS_PORT", "9108", True),
+        ("TPUNET_METRICS_PORT", "65535", True),
+        ("TPUNET_REDUCE_THREADS", "-1", False),
+        ("TPUNET_REDUCE_THREADS", "0", True),
+        ("TPUNET_REDUCE_THREADS", "8", True),
     ],
 )
 def test_config_from_env_validates_ranges(monkeypatch, var, value, ok):

@@ -1,0 +1,365 @@
+"""Zero-copy hot path tests (vectored wire I/O + SIMD reduce).
+
+Three pins:
+  * cross-engine interop matrix — BASIC/EPOLL senders and receivers in every
+    combination, CRC on and off, stay byte-exact: the vectored senders (one
+    sendmsg per [payload | crc trailer] chunk; iovec-cursor batching on
+    EPOLL) changed SYSCALL shape, not wire bytes, so v3 peers interop.
+  * golden frame capture — a raw-socket receiver captures exactly what each
+    engine's sender puts on the wire for one message and asserts it is
+    byte-identical to the segmented layout (preamble, 8-byte BE ctrl length
+    frame, payload, 4-byte BE CRC32C trailer) AND identical across engines.
+  * SIMD-vs-scalar reduce goldens — the AVX2 kernels must be bitwise equal
+    to the scalar ground truth for f32 (all ops, NaN/inf payloads included)
+    and bf16 (round-to-nearest-even), and the fork-join sharding must not
+    change results.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from tpunet import transport
+
+HANDLE_SIZE = 64
+
+
+def _wire_pair(net_s, net_r):
+    lc = net_r.listen()
+    got = {}
+    th = threading.Thread(target=lambda: got.setdefault("rc", lc.accept()))
+    th.start()
+    sc = net_s.connect(lc.handle)
+    th.join()
+    return sc, got["rc"], lc
+
+
+def _pattern(n: int, salt: int = 0) -> np.ndarray:
+    return np.frombuffer(
+        bytes(((i * 131 + salt) ^ (i >> 8)) & 0xFF for i in range(min(n, 4096)))
+        * (n // min(n, 4096) + 1),
+        np.uint8,
+    )[:n].copy()
+
+
+# ---------------------------------------------------------------------------
+# Cross-engine interop matrix.
+
+
+@pytest.mark.parametrize("crc", [False, True], ids=["crc0", "crc1"])
+@pytest.mark.parametrize("recv_engine", ["BASIC", "EPOLL"])
+@pytest.mark.parametrize("send_engine", ["BASIC", "EPOLL"])
+def test_cross_engine_interop_matrix(monkeypatch, send_engine, recv_engine, crc):
+    """Every (sender, receiver, CRC) combination transfers byte-exact,
+    including a multi-chunk message — the shared wire contract survives the
+    vectored-IO rewrite on both engines."""
+    from tpunet.transport import Net
+
+    # The CRC flag is the SENDER's to advertise (preamble kPreambleFlagCrc);
+    # set it for both instances anyway so the intent is unambiguous.
+    monkeypatch.setenv("TPUNET_CRC", "1" if crc else "0")
+    monkeypatch.setenv("TPUNET_NSTREAMS", "2")
+    monkeypatch.setenv("TPUNET_IMPLEMENT", send_engine)
+    ns = Net()
+    monkeypatch.setenv("TPUNET_IMPLEMENT", recv_engine)
+    nr = Net()
+    try:
+        sc, rc, lc = _wire_pair(ns, nr)
+        try:
+            # 1 B (single chunk), 64 KiB (single chunk), 3 MiB (multi-chunk
+            # at nstreams=2 / min_chunksize=1MiB).
+            for salt, size in enumerate((1, 1 << 16, 3 << 20)):
+                src = _pattern(size, salt)
+                dst = np.zeros_like(src)
+                rreq = rc.irecv(dst)
+                sreq = sc.isend(src)
+                sreq.wait(timeout=60)
+                assert rreq.wait(timeout=60) == size
+                np.testing.assert_array_equal(src, dst)
+        finally:
+            for c in (sc, rc, lc):
+                c.close()
+    finally:
+        ns.close()
+        nr.close()
+
+
+# ---------------------------------------------------------------------------
+# Golden frame capture: the vectored sender's wire bytes, observed raw.
+
+
+def _handle_for(port: int) -> bytes:
+    """A rendezvous handle (raw sockaddr_in, zero-padded to 64B) pointing at
+    a 127.0.0.1 port this test controls."""
+    sa = (
+        struct.pack("=H", socket.AF_INET)
+        + struct.pack("!H", port)
+        + socket.inet_aton("127.0.0.1")
+    )
+    return sa + b"\x00" * (HANDLE_SIZE - len(sa))
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        got = sock.recv(n - len(buf))
+        if not got:
+            raise AssertionError(f"peer closed after {len(buf)}/{n} bytes")
+        buf += got
+    return buf
+
+
+def _capture_one_send(monkeypatch, engine: str, crc: bool, payload: bytes) -> dict:
+    """Accept an engine's connect bundle on a raw socket, let it isend one
+    message, and return the captured preamble fields + ctrl frame + data
+    stream bytes."""
+    monkeypatch.setenv("TPUNET_IMPLEMENT", engine)
+    monkeypatch.setenv("TPUNET_CRC", "1" if crc else "0")
+    monkeypatch.setenv("TPUNET_NSTREAMS", "1")  # all chunks on stream 0, in order
+    from tpunet.transport import Net
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    port = srv.getsockname()[1]
+    net = Net()
+    out = {}
+    try:
+        sc = net.connect(_handle_for(port))
+        conns = {}
+        ctrl = None
+        for _ in range(2):  # nstreams=1 data conns + 1 ctrl conn
+            c, _addr = srv.accept()
+            pre = _read_exact(c, 48)
+            magic, _bundle, sid, nstreams, _mcs, flags = struct.unpack("!6Q", pre)
+            assert magic >> 8 == 0x7470756E65743103 >> 8  # "tpunet" + v3
+            if sid == nstreams:
+                ctrl = c
+            else:
+                conns[sid] = c
+        assert ctrl is not None and 0 in conns
+        out["flags"] = flags
+
+        req = sc.isend(np.frombuffer(payload, np.uint8))
+        frame = _read_exact(ctrl, 8)
+        out["frame"] = frame
+        (length,) = struct.unpack("!Q", frame)
+        assert length == len(payload)
+        out["data"] = _read_exact(conns[0], length + (4 if crc else 0))
+        req.wait(timeout=30)
+        # Nothing may trail the chunk: re-fragmentation aside, the sender
+        # must not interleave any extra framing on the data stream.
+        conns[0].settimeout(0.2)
+        try:
+            extra = conns[0].recv(64)
+        except socket.timeout:
+            extra = b""
+        assert extra == b""
+        sc.close()
+        for c in (ctrl, *conns.values()):
+            c.close()
+    finally:
+        net.close()
+        srv.close()
+    return out
+
+
+@pytest.mark.parametrize("crc", [False, True], ids=["crc0", "crc1"])
+def test_golden_frame_capture_sender_bytes(monkeypatch, crc):
+    """Both engines' vectored senders put EXACTLY the segmented layout on the
+    wire: [payload] or [payload || crc32c_be(payload)] on the data stream and
+    a bare 8-byte BE length frame on ctrl — and are byte-identical to each
+    other."""
+    payload = bytes(_pattern(96 * 1024, salt=7))
+    caps = {eng: _capture_one_send(monkeypatch, eng, crc, payload)
+            for eng in ("BASIC", "EPOLL")}
+    expect = payload + (
+        struct.pack("!I", transport.crc32c(payload)) if crc else b""
+    )
+    for eng, cap in caps.items():
+        assert cap["frame"] == struct.pack("!Q", len(payload)), eng
+        assert cap["data"] == expect, f"{eng} wire bytes diverge from golden"
+        assert (cap["flags"] & 1) == (1 if crc else 0), eng
+    assert caps["BASIC"]["data"] == caps["EPOLL"]["data"]
+    assert caps["BASIC"]["frame"] == caps["EPOLL"]["frame"]
+
+
+# ---------------------------------------------------------------------------
+# SIMD-vs-scalar reduce equivalence goldens.
+
+
+def _f32_scalar_ref(a: np.ndarray, b: np.ndarray, op: str) -> np.ndarray:
+    """Bitwise replication of the native SCALAR kernel for f32: IEEE
+    elementwise sum/prod; min/max via the (b<a)?b:a / (a<b)?b:a ternaries
+    (NaN in either operand -> comparison false -> a survives)."""
+    with np.errstate(invalid="ignore", over="ignore"):
+        if op == "sum":
+            return a + b
+        if op == "prod":
+            return a * b
+        if op == "min":
+            return np.where(b < a, b, a)
+        if op == "max":
+            return np.where(a < b, b, a)
+    raise AssertionError(op)
+
+
+def _bf16_to_f32(u: np.ndarray) -> np.ndarray:
+    return (u.astype(np.uint32) << 16).view(np.float32)
+
+
+def _f32_to_bf16(f: np.ndarray) -> np.ndarray:
+    """The native kernel's RNE: bits + 0x7FFF + ((bits >> 16) & 1), high
+    half (mod 2^32, like the C uint32_t arithmetic)."""
+    bits = f.view(np.uint32).astype(np.uint64)
+    rounded = (bits + 0x7FFF + ((bits >> 16) & 1)) & 0xFFFFFFFF
+    return (rounded >> 16).astype(np.uint16)
+
+
+def _f32_cases(rng) -> list[np.ndarray]:
+    n = 4099  # odd: exercises the SIMD tail
+    a = rng.standard_normal(n).astype(np.float32)
+    b = rng.standard_normal(n).astype(np.float32)
+    for arr in (a, b):
+        arr[rng.integers(0, n, 64)] = np.nan
+        arr[rng.integers(0, n, 64)] = np.inf
+        arr[rng.integers(0, n, 64)] = -np.inf
+        arr[rng.integers(0, n, 64)] = -0.0
+    return [a, b]
+
+
+@pytest.mark.parametrize("op", ["sum", "prod", "min", "max"])
+def test_reduce_f32_matches_scalar_golden(op):
+    """Native reduce (SIMD path where the CPU has AVX2) is BITWISE equal to
+    the scalar ground truth on f32, NaN/inf/-0.0 payloads included."""
+    a, b = _f32_cases(np.random.default_rng(20260804))
+    dst = np.empty_like(a)
+    transport.reduce_into(dst, a, b, "f32", op)
+    expect = _f32_scalar_ref(a, b, op)
+    np.testing.assert_array_equal(dst.view(np.uint32), expect.view(np.uint32))
+
+
+@pytest.mark.parametrize("op", ["sum", "prod", "min", "max"])
+def test_reduce_bf16_matches_scalar_golden(op):
+    """bf16 reduce: widen to f32, op with scalar semantics, RNE-narrow —
+    bitwise, including NaN/inf encodings."""
+    rng = np.random.default_rng(42)
+    n = 2053
+    a = rng.integers(0, 1 << 16, n).astype(np.uint16)
+    b = rng.integers(0, 1 << 16, n).astype(np.uint16)
+    dst = np.empty_like(a)
+    transport.reduce_into(dst, a, b, "bf16", op)
+    expect = _f32_to_bf16(_f32_scalar_ref(_bf16_to_f32(a), _bf16_to_f32(b), op))
+    np.testing.assert_array_equal(dst, expect)
+
+
+def test_reduce_inplace_alias_and_other_dtypes():
+    """dst aliasing a (the ring's in-place accumulate) works; the non-SIMD
+    dtypes route through the scalar kernel correctly."""
+    a = np.arange(1000, dtype=np.int32)
+    b = np.arange(1000, dtype=np.int32)[::-1].copy()
+    transport.reduce_into(a, a, b, "i32", "sum")
+    np.testing.assert_array_equal(a, np.full(1000, 999, np.int32))
+    x = np.arange(17, dtype=np.float64)
+    y = np.arange(17, dtype=np.float64)[::-1].copy()
+    d = np.empty_like(x)
+    transport.reduce_into(d, x, y, "f64", "max")
+    np.testing.assert_array_equal(d, np.maximum(x, y))
+    u = np.arange(256, dtype=np.uint8)
+    v = np.full(256, 7, np.uint8)
+    transport.reduce_into(u, u, v, "u8", "min")
+    np.testing.assert_array_equal(u, np.minimum(np.arange(256), 7).astype(np.uint8))
+
+
+def _threaded_reduce_worker(q) -> None:
+    try:
+        import numpy as np
+
+        from tpunet import transport as t
+
+        rng = np.random.default_rng(7)
+        n = (6 << 20) // 4  # 6 MiB of f32: above the 4 MiB fan-out threshold
+        a = rng.standard_normal(n).astype(np.float32)
+        b = rng.standard_normal(n).astype(np.float32)
+        a[:17] = np.nan
+        dst = np.empty_like(a)
+        t.reduce_into(dst, a, b, "f32", "sum")
+        np.testing.assert_array_equal(
+            dst.view(np.uint32), (a + b).view(np.uint32))
+        q.put(("ok", None))
+    except Exception as e:  # noqa: BLE001
+        q.put(("err", repr(e)))
+
+
+def test_reduce_threaded_sharding_equivalent():
+    """TPUNET_REDUCE_THREADS=4 fork-join sharding produces the same bits as
+    the elementwise reference on a >4 MiB buffer (spawned so the env is read
+    at pool construction)."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_threaded_reduce_worker, args=(q,))
+    env_before = os.environ.get("TPUNET_REDUCE_THREADS")
+    os.environ["TPUNET_REDUCE_THREADS"] = "4"
+    try:
+        p.start()
+        tag, detail = q.get(timeout=120)
+    finally:
+        p.join(timeout=30)
+        if p.is_alive():
+            p.kill()
+        if env_before is None:
+            os.environ.pop("TPUNET_REDUCE_THREADS", None)
+        else:
+            os.environ["TPUNET_REDUCE_THREADS"] = env_before
+    assert tag == "ok", detail
+
+
+def test_reduce_rejects_bad_args():
+    a = np.zeros(4, np.float32)
+    with pytest.raises(ValueError):
+        transport.reduce_into(a, a, a, "f16")
+    with pytest.raises(ValueError):
+        transport.reduce_into(a, a, a, "f32", "avg")
+    with pytest.raises(ValueError):
+        transport.reduce_into(a, a, np.zeros(5, np.float32), "f32")
+
+
+# ---------------------------------------------------------------------------
+# Syscall counters: the budget the perf-smoke lane enforces exists and moves.
+
+
+def test_engine_syscall_counters_move_and_reset():
+    from tpunet import telemetry
+    from tpunet.transport import Net
+
+    telemetry.reset()
+    parsed = telemetry.metrics().get("tpunet_engine_syscalls_total", {})
+    # All four op series present even at zero (derivations never divide by a
+    # missing series).
+    assert len(parsed) == 4
+    with Net() as ns, Net() as nr:
+        sc, rc, lc = _wire_pair(ns, nr)
+        try:
+            src = _pattern(1 << 20)
+            dst = np.zeros_like(src)
+            rreq = rc.irecv(dst)
+            sreq = sc.isend(src)
+            sreq.wait(timeout=60)
+            rreq.wait(timeout=60)
+            np.testing.assert_array_equal(src, dst)
+        finally:
+            for c in (sc, rc, lc):
+                c.close()
+    moved = sum(telemetry.metrics().get("tpunet_engine_syscalls_total", {}).values())
+    assert moved > 0
+    telemetry.reset()
+    assert sum(
+        telemetry.metrics().get("tpunet_engine_syscalls_total", {}).values()) == 0

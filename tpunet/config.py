@@ -23,9 +23,10 @@ def _env_int(name: str, fallback: int) -> int:
 
 
 def _env_int_checked(names: tuple[str, ...], fallback: int, minimum: int,
-                     what: str) -> int:
+                     what: str, maximum: int | None = None) -> int:
     """Read the first set env var in `names`; a NUMERIC value below `minimum`
-    raises ValueError naming the offending var.
+    (or above `maximum`, when given) raises ValueError naming the offending
+    var.
 
     The silent-fallback behavior of _env_int let ``TPUNET_NSTREAMS=0`` or a
     negative keepalive window flow into the native layer (which clamps or
@@ -44,6 +45,10 @@ def _env_int_checked(names: tuple[str, ...], fallback: int, minimum: int,
         if n < minimum:
             raise ValueError(
                 f"{name}={v} is invalid: {what} must be >= {minimum}"
+            )
+        if maximum is not None and n > maximum:
+            raise ValueError(
+                f"{name}={v} is invalid: {what} must be <= {maximum}"
             )
         return n
     return fallback
@@ -78,12 +83,16 @@ class Config:
     # BAGUA_NET_PROMETHEUS_ADDRESS nthread:184-185). Empty = disabled.
     trace_dir: str = ""
     metrics_addr: str = ""
+    # On-demand /metrics scrape listener port (0 = disabled). Each rank needs
+    # its own port; first binder wins on a shared one.
+    metrics_port: int = 0
     # SO_SNDBUF/SO_RCVBUF override in bytes; 0 = kernel autotuning.
     socket_bufsize: int = 0
     # Collectives pipeline granularity: ring steps stream their slice in
     # chunks this size so reduction overlaps transfer.
     ring_chunksize: int = 8 << 20
-    # Fork-join reduce shards (0 = auto: min(4, cores/2)).
+    # Total fork-join reduce shards, caller included (0 = auto: min(4,
+    # cores/2)); the native pool clamps at 16.
     reduce_threads: int = 0
     # TCP keepalive dead-peer detection: first probe after idle_s (0 =
     # disabled), then every intvl_s, dead after cnt misses.
@@ -130,9 +139,10 @@ class Config:
     @staticmethod
     def from_env() -> "Config":
         """Snapshot env config, validating range-sensitive knobs: zero/negative
-        nstreams, non-positive min_chunksize, and negative keepalive/retry/
-        watchdog windows raise ValueError naming the offending env var
-        instead of flowing into the native layer unchecked."""
+        nstreams, non-positive min_chunksize, negative keepalive/retry/
+        watchdog windows, an out-of-range metrics port (0-65535), and a
+        negative reduce-thread count raise ValueError naming the offending
+        env var instead of flowing into the native layer unchecked."""
         env = os.environ
         return Config(
             implement=env.get("TPUNET_IMPLEMENT", env.get("BAGUA_NET_IMPLEMENT", "BASIC")),
@@ -154,9 +164,17 @@ class Config:
             world_size=_env_int("TPUNET_WORLD_SIZE", _env_int("WORLD_SIZE", 1)),
             trace_dir=env.get("TPUNET_TRACE_DIR", ""),
             metrics_addr=env.get("TPUNET_METRICS_ADDR", os.environ.get("TPUNET_PROMETHEUS_ADDRESS", "")),
+            # The native listener ignores ports >= 65536 silently; the config
+            # layer names the bad var instead (PR-1 validator style).
+            metrics_port=_env_int_checked(
+                ("TPUNET_METRICS_PORT",), 0, 0, "metrics scrape port",
+                maximum=65535,
+            ),
             socket_bufsize=_env_int("TPUNET_SOCKET_BUFSIZE", 0),
             ring_chunksize=_env_int("TPUNET_RING_CHUNKSIZE", 8 << 20),
-            reduce_threads=_env_int("TPUNET_REDUCE_THREADS", 0),
+            reduce_threads=_env_int_checked(
+                ("TPUNET_REDUCE_THREADS",), 0, 0, "reduce thread count"
+            ),
             keepalive_idle_s=_env_int_checked(
                 ("TPUNET_KEEPALIVE_IDLE_S",), 30, 0, "keepalive idle window"
             ),

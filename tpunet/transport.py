@@ -50,6 +50,37 @@ def crc32c(data: Any, seed: int = 0) -> int:
     return int(lib.tpunet_c_crc32c(buf, mv.nbytes, seed & 0xFFFFFFFF))
 
 
+_REDUCE_DTYPES = {"f32": 0, "f64": 1, "bf16": 2, "i32": 3, "i64": 4, "u8": 5}
+_REDUCE_OPS = {"sum": 0, "prod": 1, "min": 2, "max": 3}
+
+
+def reduce_into(dst: np.ndarray, a: np.ndarray, b: np.ndarray, dtype: str,
+                op: str = "sum") -> None:
+    """Elementwise ``dst = a op b`` via the native reduction kernel — the
+    runtime-dispatched (SIMD where the CPU has it) routine the ring
+    collectives run post-wire. ``dst`` may be the same array as ``a``
+    (in-place accumulate). ``dtype`` is the WIRE dtype ("f32", "f64",
+    "bf16", "i32", "i64", "u8"); bf16 arrays are passed as uint16 views.
+    Exposed so tests can pin SIMD-vs-scalar equivalence goldens."""
+    if dtype not in _REDUCE_DTYPES:
+        raise ValueError(f"unknown reduce dtype {dtype!r}")
+    if op not in _REDUCE_OPS:
+        raise ValueError(f"unknown reduce op {op!r}")
+    for name, arr, writable in (("dst", dst, True), ("a", a, False), ("b", b, False)):
+        if not isinstance(arr, np.ndarray) or not arr.flags.c_contiguous:
+            raise ValueError(f"{name} must be a C-contiguous numpy array")
+        if writable and not arr.flags.writeable:
+            raise ValueError(f"{name} must be writable")
+    if not (dst.size == a.size == b.size):
+        raise ValueError("dst/a/b element counts differ")
+    lib = _native.load()
+    _native.check(
+        lib.tpunet_c_reduce(dst.ctypes.data, a.ctypes.data, b.ctypes.data,
+                            dst.size, _REDUCE_DTYPES[dtype], _REDUCE_OPS[op]),
+        "reduce",
+    )
+
+
 def _as_buffer(obj: Any, writable: bool) -> tuple[int, int, Any]:
     """Return (address, nbytes, pin) for bytes/bytearray/numpy/memoryview."""
     if isinstance(obj, np.ndarray):
