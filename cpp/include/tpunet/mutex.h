@@ -1,0 +1,95 @@
+// Annotated Mutex / MutexLock / CondVar over the std primitives.
+//
+// Thin wrappers whose only job is carrying the clang thread-safety
+// capability attributes (thread_annotations.h) — std::mutex itself has no
+// annotations, so code locking it directly is invisible to -Wthread-safety.
+// Every lock-protected field and locking function in the C++ core goes
+// through these types; `make tsa` then proves the lock discipline at build
+// time. Zero overhead over the raw std types on the lock/unlock paths (all
+// methods are inline forwarding calls).
+//
+// CondVar wraps std::condition_variable_any parked directly on the Mutex
+// (which is BasicLockable via lock()/unlock()). Vs. std::condition_variable
+// + std::unique_lock this costs one extra internal mutex inside libstdc++'s
+// condition_variable_any — irrelevant next to the syscall in every park —
+// and buys waits expressible as Wait(mu) under an annotation-visible
+// capability instead of an opaque unique_lock the analysis cannot track.
+//
+// No predicate-taking Wait overload on purpose: TSA analyzes a lambda as a
+// separate function with no REQUIRES, so guarded reads inside a wait
+// predicate would all warn. Callers write the explicit
+// `while (!cond) cv.Wait(mu);` loop instead, which the analysis checks
+// field-by-field.
+#ifndef TPUNET_MUTEX_H_
+#define TPUNET_MUTEX_H_
+
+#include <chrono>
+#include <condition_variable>
+#include <mutex>
+
+#include "tpunet/thread_annotations.h"
+
+namespace tpunet {
+
+class CAPABILITY("mutex") Mutex {
+ public:
+  Mutex() = default;
+  Mutex(const Mutex&) = delete;
+  Mutex& operator=(const Mutex&) = delete;
+
+  void Lock() ACQUIRE() { mu_.lock(); }
+  void Unlock() RELEASE() { mu_.unlock(); }
+  bool TryLock() TRY_ACQUIRE(true) { return mu_.try_lock(); }
+
+  // BasicLockable spelling so CondVar (condition_variable_any) can park on
+  // the Mutex directly. Same capability effects as Lock/Unlock.
+  void lock() ACQUIRE() { mu_.lock(); }
+  void unlock() RELEASE() { mu_.unlock(); }
+
+ private:
+  std::mutex mu_;
+};
+
+// RAII lock. The std::adopt_lock_t overload takes ownership of an
+// already-held Mutex (pairs with Mutex::TryLock — see
+// basic_engine.cc's PumpCtrlUntilRetired).
+class SCOPED_CAPABILITY MutexLock {
+ public:
+  explicit MutexLock(Mutex& mu) ACQUIRE(mu) : mu_(mu) { mu_.Lock(); }
+  MutexLock(Mutex& mu, std::adopt_lock_t) REQUIRES(mu) : mu_(mu) {}
+  ~MutexLock() RELEASE() { mu_.Unlock(); }
+  MutexLock(const MutexLock&) = delete;
+  MutexLock& operator=(const MutexLock&) = delete;
+
+ private:
+  Mutex& mu_;
+};
+
+class CondVar {
+ public:
+  CondVar() = default;
+  CondVar(const CondVar&) = delete;
+  CondVar& operator=(const CondVar&) = delete;
+
+  // Atomically release `mu`, park, and reacquire before returning. Callers
+  // loop on their condition (spurious wakeups, as with the std primitive).
+  void Wait(Mutex& mu) REQUIRES(mu) { cv_.wait(mu); }
+
+  // Bounded park; returns false on timeout. The glibc path under this is
+  // pthread_cond_timedwait — see cpp/tests/tsan.supp for the one libtsan
+  // modeling artifact timed waits still carry.
+  bool WaitFor(Mutex& mu, int ms) REQUIRES(mu) {
+    return cv_.wait_for(mu, std::chrono::milliseconds(ms)) ==
+           std::cv_status::no_timeout;
+  }
+
+  void NotifyOne() { cv_.notify_one(); }
+  void NotifyAll() { cv_.notify_all(); }
+
+ private:
+  std::condition_variable_any cv_;
+};
+
+}  // namespace tpunet
+
+#endif  // TPUNET_MUTEX_H_

@@ -1,0 +1,93 @@
+// Clang thread-safety-analysis (TSA) macros.
+//
+// The engines and collectives are ~2k LoC of hand-rolled mutex/CV/atomic
+// code whose lock discipline was, until this header, enforced only by the
+// dynamic tsan/asan lanes — which exercise exactly the interleavings the
+// loopback tests happen to hit. These macros let the lock contracts live in
+// the type system instead: every lock-protected field names its mutex
+// (GUARDED_BY), every must-hold-the-lock function names its precondition
+// (REQUIRES), and `make tsa` compiles the tree with clang's
+// -Wthread-safety -Werror so a violation is a build break, not a flaky
+// nightly report. See docs/DESIGN.md "Concurrency model & lock hierarchy"
+// for the repo-wide lock ordering these annotations encode.
+//
+// Under non-clang compilers (the default g++ build) every macro expands to
+// nothing — the annotations are zero-cost documentation there, and the
+// tsan/asan lanes keep covering what static analysis cannot (condvar wakeup
+// ordering, atomics-based handshakes like Comm::inflight).
+//
+// Naming follows the capability-based spelling from the clang docs (and
+// Abseil): ACQUIRE/RELEASE rather than the legacy EXCLUSIVE_LOCK_FUNCTION/
+// UNLOCK_FUNCTION. Analysis-relevant notes:
+//   * Attribute arguments are late-parsed: a GUARDED_BY(mu) may name a
+//     member declared later in the same class.
+//   * The analysis is purely syntactic — REQUIRES(c->mu) at a call site
+//     substitutes the caller's argument expression for `c`, so functions
+//     taking an object plus one of its sub-parts must take the OWNER as an
+//     explicit parameter (see epoll_engine.cc's AdvanceFdLocked(EComm*,
+//     FdState*)) or the capability expressions will not match.
+//   * ACQUIRED_AFTER/ACQUIRED_BEFORE (lock-ordering declarations) are only
+//     checked under -Wthread-safety-beta; they are included in `make tsa`
+//     as documentation that the beta lane can later enforce.
+#ifndef TPUNET_THREAD_ANNOTATIONS_H_
+#define TPUNET_THREAD_ANNOTATIONS_H_
+
+#if defined(__clang__) && !defined(SWIG)
+#define TPUNET_THREAD_ANNOTATION_ATTRIBUTE_(x) __attribute__((x))
+#else
+#define TPUNET_THREAD_ANNOTATION_ATTRIBUTE_(x)  // no-op on gcc/others
+#endif
+
+// Type attribute: this class is a lockable capability ("mutex").
+#define CAPABILITY(x) TPUNET_THREAD_ANNOTATION_ATTRIBUTE_(capability(x))
+
+// Type attribute: RAII object that acquires in its ctor, releases in dtor.
+#define SCOPED_CAPABILITY TPUNET_THREAD_ANNOTATION_ATTRIBUTE_(scoped_lockable)
+
+// Data member: may only be read/written while holding `x`.
+#define GUARDED_BY(x) TPUNET_THREAD_ANNOTATION_ATTRIBUTE_(guarded_by(x))
+
+// Pointer member: the POINTED-TO data requires `x` (the pointer itself
+// does not).
+#define PT_GUARDED_BY(x) TPUNET_THREAD_ANNOTATION_ATTRIBUTE_(pt_guarded_by(x))
+
+// Lock-ordering documentation (checked only under -Wthread-safety-beta).
+#define ACQUIRED_BEFORE(...) \
+  TPUNET_THREAD_ANNOTATION_ATTRIBUTE_(acquired_before(__VA_ARGS__))
+#define ACQUIRED_AFTER(...) \
+  TPUNET_THREAD_ANNOTATION_ATTRIBUTE_(acquired_after(__VA_ARGS__))
+
+// Function precondition: caller must hold the named capabilities.
+#define REQUIRES(...) \
+  TPUNET_THREAD_ANNOTATION_ATTRIBUTE_(requires_capability(__VA_ARGS__))
+
+// Function effect: acquires / releases the named capabilities.
+#define ACQUIRE(...) \
+  TPUNET_THREAD_ANNOTATION_ATTRIBUTE_(acquire_capability(__VA_ARGS__))
+#define RELEASE(...) \
+  TPUNET_THREAD_ANNOTATION_ATTRIBUTE_(release_capability(__VA_ARGS__))
+
+// Function effect: acquires the capability iff the return value equals the
+// first argument (e.g. TRY_ACQUIRE(true) for a bool TryLock()).
+#define TRY_ACQUIRE(...) \
+  TPUNET_THREAD_ANNOTATION_ATTRIBUTE_(try_acquire_capability(__VA_ARGS__))
+
+// Function precondition: caller must NOT hold the named capabilities
+// (deadlock documentation for self-locking functions).
+#define EXCLUDES(...) \
+  TPUNET_THREAD_ANNOTATION_ATTRIBUTE_(locks_excluded(__VA_ARGS__))
+
+// Runtime assertion that the capability is held (no acquire/release).
+#define ASSERT_CAPABILITY(x) \
+  TPUNET_THREAD_ANNOTATION_ATTRIBUTE_(assert_capability(x))
+
+// Function returns a reference to the named capability.
+#define RETURN_CAPABILITY(x) \
+  TPUNET_THREAD_ANNOTATION_ATTRIBUTE_(lock_returned(x))
+
+// Escape hatch: the function's locking is deliberately outside what the
+// analysis can model. Every use must carry a comment saying why.
+#define NO_THREAD_SAFETY_ANALYSIS \
+  TPUNET_THREAD_ANNOTATION_ATTRIBUTE_(no_thread_safety_analysis)
+
+#endif  // TPUNET_THREAD_ANNOTATIONS_H_

@@ -39,7 +39,6 @@
 
 #include <atomic>
 #include <chrono>
-#include <condition_variable>
 #include <deque>
 #include <memory>
 #include <mutex>
@@ -49,6 +48,7 @@
 #include "engine_base.h"
 #include "fault.h"
 #include "id_map.h"
+#include "tpunet/mutex.h"
 #include "tpunet/net.h"
 #include "tpunet/telemetry.h"
 #include "tpunet/utils.h"
@@ -80,16 +80,16 @@ class Queue {
   // messages without a parked fail-sink thread.
   bool Push(T t) {
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      MutexLock lk(mu_);
       if (closed_) return false;
       q_.push_back(std::move(t));
     }
-    cv_.notify_one();
+    cv_.NotifyOne();
     return true;
   }
   bool Pop(T* out) {
-    std::unique_lock<std::mutex> lk(mu_);
-    cv_.wait(lk, [&] { return closed_ || !q_.empty(); });
+    MutexLock lk(mu_);
+    while (!closed_ && q_.empty()) cv_.Wait(mu_);
     if (q_.empty()) return false;
     *out = std::move(q_.front());
     q_.pop_front();
@@ -98,7 +98,7 @@ class Queue {
   // Nonblocking drain (failover: a retiring worker discards its queued
   // tasks — the per-stream records are the authoritative copy).
   bool TryPop(T* out) {
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     if (q_.empty()) return false;
     *out = std::move(q_.front());
     q_.pop_front();
@@ -106,17 +106,17 @@ class Queue {
   }
   void Close() {
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      MutexLock lk(mu_);
       closed_ = true;
     }
-    cv_.notify_all();
+    cv_.NotifyAll();
   }
 
  private:
-  std::mutex mu_;
-  std::condition_variable cv_;
-  std::deque<T> q_;
-  bool closed_ = false;
+  Mutex mu_;  // leaf: nothing else is acquired while held
+  CondVar cv_;
+  std::deque<T> q_ GUARDED_BY(mu_);
+  bool closed_ GUARDED_BY(mu_) = false;
 };
 
 struct ChunkTask {
@@ -175,24 +175,26 @@ struct Comm {
   // is what lets both sides switch their chunk→stream rotation at the same
   // point. Uncontended in steady state: one acquisition per message, not
   // per chunk... (chunks are dispatched under the same acquisition).
-  std::mutex fo_mu;
+  // Ordering: ctrl_mu may be held when fo_mu is taken (failover marker
+  // processing), never the reverse.
+  Mutex fo_mu ACQUIRED_AFTER(ctrl_mu);
   // dead: IO on the stream has failed locally (or a NACK told the sender);
   // no further tasks go to its worker, but the assignment rotation still
   // includes it — records accumulate — until the FAILOVER marker retires it.
   // retired: excluded from the rotation from the marker point in ctrl order.
-  std::vector<uint8_t> stream_dead;
-  std::vector<uint8_t> stream_retired;
-  size_t dead_count = 0;
-  std::vector<std::deque<ChunkRec>> recs;  // per-stream, seq-ordered
-  std::vector<uint64_t> next_seq;          // chunks ever assigned per stream
-  std::vector<uint64_t> done_seq;          // receiver: chunks fully read
+  std::vector<uint8_t> stream_dead GUARDED_BY(fo_mu);
+  std::vector<uint8_t> stream_retired GUARDED_BY(fo_mu);
+  size_t dead_count GUARDED_BY(fo_mu) = 0;
+  std::vector<std::deque<ChunkRec>> recs GUARDED_BY(fo_mu);  // per-stream, seq-ordered
+  std::vector<uint64_t> next_seq GUARDED_BY(fo_mu);  // chunks ever assigned per stream
+  std::vector<uint64_t> done_seq GUARDED_BY(fo_mu);  // receiver: chunks fully read
   // Receiver ctrl-read ownership: the scheduler, a lazy-recv caller, and a
   // failed worker acting as ctrl pump never read the ctrl fd concurrently.
   // A LEN frame read by the pump before its message is popped is stashed
   // here (consumed by the next owner, preserving frame↔message pairing).
-  std::mutex ctrl_mu;
-  bool has_pending_frame = false;
-  uint64_t pending_frame = 0;
+  Mutex ctrl_mu;
+  bool has_pending_frame GUARDED_BY(ctrl_mu) = false;
+  uint64_t pending_frame GUARDED_BY(ctrl_mu) = 0;
   // Sender: reverse-ctrl reader parked on the (normally silent) receiver→
   // sender direction of the ctrl connection, waiting for NACK frames.
   std::unique_ptr<std::thread> nack_reader;
@@ -210,15 +212,18 @@ struct Comm {
   // read. Callers are single-threaded per comm (NCCL proxy contract; our
   // collectives layer likewise).
   std::atomic<uint64_t> inflight{0};
-  uint64_t cursor = 0;
+  // All cursor touches happen inside the fo_mu-held assignment sections
+  // (AssignStreamIdx), so the annotation is fo_mu even though the
+  // inline-path handoff above is what really orders scheduler vs caller.
+  uint64_t cursor GUARDED_BY(fo_mu) = 0;
   // Lazy recv slot: an irecv posted on an idle comm parks here; its wait()
   // executes the ctrl read + data read inline on the caller thread (saving
   // two hops and the completion wakeup). test() or a later irecv upgrades
   // it onto the scheduler queue instead.
-  std::mutex lazy_mu;
-  Msg lazy_msg;
-  bool has_lazy = false;
-  uint64_t lazy_req = 0;
+  Mutex lazy_mu;
+  Msg lazy_msg GUARDED_BY(lazy_mu);
+  bool has_lazy GUARDED_BY(lazy_mu) = false;
+  uint64_t lazy_req GUARDED_BY(lazy_mu) = 0;
   // Threads do not survive fork(): a mismatch means this comm's scheduler /
   // workers never existed in this process (see Shutdown and the engine's
   // isend/irecv fail-fast).
@@ -243,7 +248,7 @@ struct Comm {
     // A lazy recv parked here would otherwise never execute; fail it so a
     // post-close wait() errors instead of hanging.
     {
-      std::lock_guard<std::mutex> lk(lazy_mu);
+      MutexLock lk(lazy_mu);
       if (has_lazy) {
         lazy_msg.state->SetError("comm closed with pending lazy recv");
         lazy_msg.state->total.store(0, std::memory_order_release);
@@ -331,7 +336,7 @@ void FinishChunk(StreamWorker* w, ChunkTask& t) { AccountChunkDone(w->comm, t.st
 // a failover marker both sides hold an identical retired set and an
 // identical cursor (assignments are identical in ctrl order), so the
 // reduced-width rotation stays symmetric.
-size_t AssignStreamIdx(Comm* c) {
+size_t AssignStreamIdx(Comm* c) REQUIRES(c->fo_mu) {
   size_t alive = c->nstreams - [&] {
     size_t r = 0;
     for (size_t i = 0; i < c->nstreams; ++i) r += c->stream_retired[i] ? 1 : 0;
@@ -351,7 +356,7 @@ size_t AssignStreamIdx(Comm* c) {
 // — the app may free those buffers after test(), so they are no longer
 // retransmittable (a NACK that still needs one becomes a typed poison, the
 // accepted kernel-buffered-bytes-lost race).
-void PruneRecs(Comm* c, size_t idx) {
+void PruneRecs(Comm* c, size_t idx) REQUIRES(c->fo_mu) {
   auto& q = c->recs[idx];
   while (!q.empty() && q.front().written &&
          (q.front().state->Done() || q.front().state->failed.load(std::memory_order_acquire))) {
@@ -362,7 +367,8 @@ void PruneRecs(Comm* c, size_t idx) {
 // Assign one chunk: record it, and hand it to the worker unless the stream
 // is locally dead (then the record alone carries it until the failover
 // marker retransmits or poisons).
-void AssignChunk(Comm* c, uint8_t* data, size_t n, const RequestPtr& state) {
+void AssignChunk(Comm* c, uint8_t* data, size_t n, const RequestPtr& state)
+    REQUIRES(c->fo_mu) {
   size_t idx = AssignStreamIdx(c);
   uint64_t seq = c->next_seq[idx]++;
   if (c->is_send) PruneRecs(c, idx);
@@ -378,7 +384,7 @@ void AssignChunk(Comm* c, uint8_t* data, size_t n, const RequestPtr& state) {
 // so the worker must NOT count it again. A missing record can mean nothing
 // else: prune only removes records already marked written.
 bool MarkWritten(Comm* c, size_t idx, uint64_t seq) {
-  std::lock_guard<std::mutex> lk(c->fo_mu);
+  MutexLock lk(c->fo_mu);
   for (auto& r : c->recs[idx]) {
     if (r.seq == seq) {
       r.written = true;
@@ -390,7 +396,7 @@ bool MarkWritten(Comm* c, size_t idx, uint64_t seq) {
 
 // Receiver: a chunk fully arrived on its assigned stream.
 void PopRec(Comm* c, size_t idx, uint64_t seq) {
-  std::lock_guard<std::mutex> lk(c->fo_mu);
+  MutexLock lk(c->fo_mu);
   auto& q = c->recs[idx];
   if (!q.empty() && q.front().seq == seq) q.pop_front();
   c->done_seq[idx] = seq + 1;
@@ -433,7 +439,7 @@ Status RecvChunkWire(int fd, uint8_t* data, size_t len, bool crc, bool spin,
 // single-stream comm, or last surviving stream).
 bool SenderStreamFailed(Comm* c, StreamWorker* w) {
   {
-    std::lock_guard<std::mutex> lk(c->fo_mu);
+    MutexLock lk(c->fo_mu);
     if (c->Aborted() || c->nstreams == 1) return false;
     if (!c->stream_dead[w->idx]) {
       if (c->dead_count + 1 >= c->nstreams) return false;  // last stream: poison
@@ -457,7 +463,7 @@ bool SenderStreamFailed(Comm* c, StreamWorker* w) {
 // (== the first per-stream seq it still needs).
 bool ReceiverStreamFailed(Comm* c, StreamWorker* w) {
   {
-    std::lock_guard<std::mutex> lk(c->fo_mu);
+    MutexLock lk(c->fo_mu);
     if (c->Aborted() || c->nstreams == 1) return false;
     if (!c->stream_dead[w->idx]) {
       if (c->dead_count + 1 >= c->nstreams) return false;
@@ -586,7 +592,7 @@ void DispatchChunks(Comm* c, uint8_t* data, size_t len, const RequestPtr& state)
     return;
   }
   state->NotifyIfSettled();
-  std::lock_guard<std::mutex> lk(c->fo_mu);
+  MutexLock lk(c->fo_mu);
   size_t off = 0;
   for (size_t i = 0; i < nchunks; ++i) {
     size_t n = std::min(csize, len - off);
@@ -622,7 +628,7 @@ void PoisonAndDrainQueue(Comm* c, const std::string& why) {
   // stream have no worker task behind them (queues were drained when the
   // stream died), so nothing else will ever complete their accounting and
   // test() would hold the request forever waiting to quiesce.
-  std::lock_guard<std::mutex> lk(c->fo_mu);
+  MutexLock lk(c->fo_mu);
   for (size_t i = 0; i < c->nstreams; ++i) {
     if (!c->stream_dead[i] || c->stream_retired[i]) continue;
     for (ChunkRec& r : c->recs[i]) {
@@ -670,7 +676,7 @@ bool SendOneMsg(Comm* c, const Msg& m) {
     // length frame, so a concurrent FAILOVER marker (NACK handler) lands
     // strictly before or strictly after the whole message in ctrl order —
     // the receiver applies the same assignment set either way.
-    std::lock_guard<std::mutex> lk(c->fo_mu);
+    MutexLock lk(c->fo_mu);
     size_t off = 0;
     for (size_t i = 0; i < nchunks; ++i) {
       size_t n = std::min(csize, m.len - off);
@@ -702,7 +708,7 @@ void SendSchedulerLoop(Comm* c) {
 // ---- Receiver ctrl-frame vocabulary ---------------------------------------
 
 // One ctrl frame, honoring a pump-stashed frame first. ctrl_mu held.
-Status ReadCtrlFrameLocked(Comm* c, uint64_t* frame) {
+Status ReadCtrlFrameLocked(Comm* c, uint64_t* frame) REQUIRES(c->ctrl_mu) {
   if (c->has_pending_frame) {
     *frame = c->pending_frame;
     c->has_pending_frame = false;
@@ -719,14 +725,14 @@ Status ReadCtrlFrameLocked(Comm* c, uint64_t* frame) {
 // order and retransmits every chunk the receiver's NACK declared missing —
 // inline on the ctrl stream as [seq u64 | len u64 | payload | crc?] units.
 // ctrl_mu held; takes fo_mu for the record/rotation update.
-Status ProcessFailoverMarkerLocked(Comm* c, uint64_t frame) {
+Status ProcessFailoverMarkerLocked(Comm* c, uint64_t frame) REQUIRES(c->ctrl_mu) {
   size_t k = (frame >> 48) & 0xff;
   uint64_t count = frame & 0xffffffffffffull;
   uint8_t b[16];
   Status s = ReadExact(c->ctrl_fd, b, 8, c->spin);
   if (!s.ok()) return s;
   uint64_t start_seq = DecodeU64BE(b);
-  std::lock_guard<std::mutex> lk(c->fo_mu);
+  MutexLock lk(c->fo_mu);
   if (k >= c->nstreams || !c->stream_dead[k] || c->stream_retired[k]) {
     return Status::Inner("failover marker for stream " + std::to_string(k) +
                          " in an impossible state (protocol desync)");
@@ -771,14 +777,12 @@ Status ProcessFailoverMarkerLocked(Comm* c, uint64_t frame) {
 // Per-message receiver ctrl-frame work; chunk handling differs between the
 // scheduler path (dispatch to workers) and the lazy path (caller reads).
 // Control frames (failover markers) encountered before the message's length
-// frame are processed inline. The caller passes its HELD ctrl_mu lock and
-// MUST dispatch the message's chunk assignment before releasing it: a
-// FAILOVER marker processed (by the pump) between this frame and the
+// frame are processed inline. The caller holds ctrl_mu (REQUIRES, checked
+// by TSA) and MUST dispatch the message's chunk assignment before releasing
+// it: a FAILOVER marker processed (by the pump) between this frame and the
 // dispatch would retire a stream the sender still counted into THIS
 // message's rotation, desynchronizing the chunk maps.
-Status RecvCtrlFrame(Comm* c, std::unique_lock<std::mutex>& ctrl_lk, const Msg& m,
-                     uint64_t* target) {
-  (void)ctrl_lk;  // held for the whole call; documents the locking contract
+Status RecvCtrlFrame(Comm* c, const Msg& m, uint64_t* target) REQUIRES(c->ctrl_mu) {
   while (true) {
     uint64_t frame = 0;
     Status s = ReadCtrlFrameLocked(c, &frame);
@@ -813,16 +817,16 @@ Status RecvCtrlFrame(Comm* c, std::unique_lock<std::mutex>& ctrl_lk, const Msg& 
 void PumpCtrlUntilRetired(Comm* c, size_t idx) {
   while (true) {
     {
-      std::lock_guard<std::mutex> lk(c->fo_mu);
+      MutexLock lk(c->fo_mu);
       if (c->stream_retired[idx] || c->Aborted()) return;
     }
-    if (!c->ctrl_mu.try_lock()) {
+    if (!c->ctrl_mu.TryLock()) {
       // Someone else (scheduler / lazy caller) is reading ctrl; they will
       // process the marker. Check back shortly.
       std::this_thread::sleep_for(std::chrono::milliseconds(1));
       continue;
     }
-    std::lock_guard<std::mutex> lk(c->ctrl_mu, std::adopt_lock);
+    MutexLock lk(c->ctrl_mu, std::adopt_lock);
     if (c->has_pending_frame) {
       // A stashed LEN is waiting for its message; reading further frames
       // would reorder the stream. Yield until the scheduler consumes it.
@@ -864,7 +868,7 @@ bool HandleNack(Comm* c, size_t k, uint64_t completed) {
   std::string poison;  // set on any verdict that must poison; applied after
                        // fo_mu is released (PoisonAndDrainQueue takes it)
   {
-    std::lock_guard<std::mutex> lk(c->fo_mu);
+    MutexLock lk(c->fo_mu);
     if (c->Aborted()) return false;
     if (k >= c->nstreams || c->stream_retired[k]) {
       poison = "NACK for stream " + std::to_string(k) + " in impossible state";
@@ -978,10 +982,10 @@ void RecvSchedulerLoop(Comm* c) {
   Msg m;
   while (c->msgs.Pop(&m)) {
     uint64_t target = 0;
-    std::unique_lock<std::mutex> ctrl_lk(c->ctrl_mu);
-    Status s = RecvCtrlFrame(c, ctrl_lk, m, &target);
+    c->ctrl_mu.Lock();
+    Status s = RecvCtrlFrame(c, m, &target);
     if (!s.ok()) {
-      ctrl_lk.unlock();
+      c->ctrl_mu.Unlock();
       FailAndDrain(c, m.state, s.msg);
       return;
     }
@@ -989,6 +993,7 @@ void RecvSchedulerLoop(Comm* c) {
     // from the ctrl frame (reference nthread:507). Dispatched under the
     // SAME ctrl_mu hold as the frame read — see RecvCtrlFrame on why.
     DispatchChunks(c, m.data, static_cast<size_t>(target), m.state);
+    c->ctrl_mu.Unlock();
   }
 }
 
@@ -999,10 +1004,10 @@ void RecvSchedulerLoop(Comm* c) {
 // touches its fd without a task, so reading it here is exclusive.
 void ExecuteLazyRecv(Comm* c, const Msg& m) {
   uint64_t target = 0;
-  std::unique_lock<std::mutex> ctrl_lk(c->ctrl_mu);
-  Status s = RecvCtrlFrame(c, ctrl_lk, m, &target);
+  c->ctrl_mu.Lock();
+  Status s = RecvCtrlFrame(c, m, &target);
   if (!s.ok()) {
-    ctrl_lk.unlock();
+    c->ctrl_mu.Unlock();
     FailMsg(c, m.state, s.msg);
     c->AbortStreams();
     return;
@@ -1011,7 +1016,7 @@ void ExecuteLazyRecv(Comm* c, const Msg& m) {
   size_t csize = ChunkSize(len, c->min_chunksize, c->nstreams);
   size_t nchunks = ChunkCount(len, csize);
   if (nchunks == 0) {
-    ctrl_lk.unlock();
+    c->ctrl_mu.Unlock();
     m.state->total.store(0, std::memory_order_release);
     c->inflight.fetch_sub(1, std::memory_order_release);
     m.state->NotifyIfSettled();
@@ -1027,13 +1032,13 @@ void ExecuteLazyRecv(Comm* c, const Msg& m) {
   uint64_t seq;
   bool dead;
   {
-    std::lock_guard<std::mutex> lk(c->fo_mu);
+    MutexLock lk(c->fo_mu);
     idx = AssignStreamIdx(c);
     seq = c->next_seq[idx]++;
     c->recs[idx].push_back(ChunkRec{seq, m.data, len, m.state, false});
     dead = c->stream_dead[idx] != 0;
   }
-  ctrl_lk.unlock();
+  c->ctrl_mu.Unlock();
   if (!dead) {
     StreamWorker* w = c->workers[idx].get();
     m.state->MarkWireStart(MonotonicUs());
@@ -1193,7 +1198,7 @@ class BasicEngine : public EngineBase {
       // or a later irecv upgrades it onto the scheduler queue.
       // Single-chunk eligibility from the posted size is conservative:
       // the actual (<=posted) size can only have fewer chunks.
-      std::lock_guard<std::mutex> lk(c->lazy_mu);
+      MutexLock lk(c->lazy_mu);
       c->lazy_msg = m;
       c->has_lazy = true;
       c->lazy_req = id;
@@ -1244,7 +1249,7 @@ class BasicEngine : public EngineBase {
       Msg m;
       bool mine = false;
       {
-        std::lock_guard<std::mutex> lk(c->lazy_mu);
+        MutexLock lk(c->lazy_mu);
         if (c->has_lazy && c->lazy_req == request) {
           m = c->lazy_msg;
           c->lazy_msg = Msg{};
@@ -1331,7 +1336,7 @@ class BasicEngine : public EngineBase {
   // expect_req != 0 restricts the upgrade to that specific parked request
   // (test()'s stale-entry guard); 0 upgrades whatever is parked.
   static void UpgradeLazyIf(Comm* c, uint64_t expect_req) {
-    std::lock_guard<std::mutex> lk(c->lazy_mu);
+    MutexLock lk(c->lazy_mu);
     if (!c->has_lazy) return;
     if (expect_req != 0 && c->lazy_req != expect_req) return;
     Msg m = c->lazy_msg;
@@ -1342,12 +1347,16 @@ class BasicEngine : public EngineBase {
   }
 
   void StartThreads(Comm* c) {
-    // Failover bookkeeping is per-stream; size it before any IO thread runs.
-    c->stream_dead.assign(c->nstreams, 0);
-    c->stream_retired.assign(c->nstreams, 0);
-    c->recs.resize(c->nstreams);
-    c->next_seq.assign(c->nstreams, 0);
-    c->done_seq.assign(c->nstreams, 0);
+    {
+      // Failover bookkeeping is per-stream; size it before any IO thread
+      // runs. No concurrency yet — the lock exists for the TSA contract.
+      MutexLock lk(c->fo_mu);
+      c->stream_dead.assign(c->nstreams, 0);
+      c->stream_retired.assign(c->nstreams, 0);
+      c->recs.resize(c->nstreams);
+      c->next_seq.assign(c->nstreams, 0);
+      c->done_seq.assign(c->nstreams, 0);
+    }
     bool spin = c->spin;
     for (auto& w : c->workers) {
       StreamWorker* wp = w.get();

@@ -8,11 +8,11 @@
 #include <atomic>
 #include <map>
 #include <memory>
-#include <mutex>
 #include <vector>
 
 #include "fault.h"
 #include "id_map.h"
+#include "tpunet/mutex.h"
 #include "tpunet/net.h"
 #include "tpunet/telemetry.h"
 #include "tpunet/utils.h"
@@ -53,11 +53,11 @@ int32_t FromStatus(const Status& s) {
 // one allocator owns everything).
 struct Instance {
   std::unique_ptr<Net> net;
-  std::mutex props_mu;
+  tpunet::Mutex props_mu;  // leaf lock
   // One cached entry per device, reused across calls — properties are static
   // per NIC, and reusing bounds the cache (a poll-properties loop must not
   // grow memory for the instance lifetime).
-  std::map<int32_t, std::unique_ptr<NetProperties>> props_cache;
+  std::map<int32_t, std::unique_ptr<NetProperties>> props_cache GUARDED_BY(props_mu);
 };
 
 tpunet::IdMap<std::shared_ptr<Instance>> g_instances;
@@ -107,7 +107,7 @@ int32_t tpunet_c_get_properties(uintptr_t instance, int32_t dev,
   if (!props) return Fail(TPUNET_ERR_NULL, "props is null");
   auto inst = GetInstance(instance);
   if (!inst) return Fail(TPUNET_ERR_INVALID, "unknown instance");
-  std::lock_guard<std::mutex> lk(inst->props_mu);
+  tpunet::MutexLock lk(inst->props_mu);
   auto it = inst->props_cache.find(dev);
   if (it == inst->props_cache.end()) {
     auto p = std::make_unique<NetProperties>();

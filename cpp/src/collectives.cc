@@ -17,15 +17,14 @@
 
 #include <algorithm>
 #include <chrono>
-#include <condition_variable>
 #include <deque>
 #include <functional>
 #include <map>
-#include <mutex>
 #include <thread>
 #include <vector>
 
 #include "tpunet/bootstrap.h"
+#include "tpunet/mutex.h"
 #include "tpunet/telemetry.h"
 #include "tpunet/utils.h"
 
@@ -661,7 +660,7 @@ class RingCommunicator : public Communicator {
 
   Status IAllReduce(const void* sendbuf, void* recvbuf, size_t count, DType dtype,
                     RedOp op, uint64_t* ticket) override {
-    std::unique_lock<std::mutex> lk(async_mu_);
+    MutexLock lk(async_mu_);
     if (!worker_started_) {
       // First async collective: wire the extra channels and spawn one worker
       // per channel. Safe to touch the listener here — the communicator runs
@@ -689,16 +688,16 @@ class RingCommunicator : public Communicator {
       return DoAllReduce(sendbuf, recvbuf, count, dtype, op, channels_[ch], seq);
     });
     *ticket = t;
-    work_cv_.notify_all();
+    work_cv_.NotifyAll();
     return Status::Ok();
   }
 
   Status WaitTicket(uint64_t ticket) override {
-    std::unique_lock<std::mutex> lk(async_mu_);
+    MutexLock lk(async_mu_);
     if (!TicketLive(ticket)) return Status::Invalid("unknown or already-waited ticket");
     // Also wake if the ticket stops being live without completing (shutdown
     // dropped it, or a racing waiter claimed it) — never sleep forever.
-    done_cv_.wait(lk, [&] { return done_.count(ticket) != 0 || !TicketLive(ticket); });
+    while (done_.count(ticket) == 0 && TicketLive(ticket)) done_cv_.Wait(async_mu_);
     auto it = done_.find(ticket);
     if (it == done_.end()) {
       return Status::Invalid("ticket abandoned (shutdown or waited elsewhere)");
@@ -709,7 +708,7 @@ class RingCommunicator : public Communicator {
   }
 
   Status TestTicket(uint64_t ticket, bool* done) override {
-    std::unique_lock<std::mutex> lk(async_mu_);
+    MutexLock lk(async_mu_);
     auto it = done_.find(ticket);
     if (it != done_.end()) {
       *done = true;
@@ -924,9 +923,9 @@ class RingCommunicator : public Communicator {
     return result;
   }
 
-  // Caller holds async_mu_. A ticket is live (waitable) if it is queued,
-  // currently executing, or completed-but-unclaimed.
-  bool TicketLive(uint64_t ticket) {
+  // A ticket is live (waitable) if it is queued, currently executing, or
+  // completed-but-unclaimed.
+  bool TicketLive(uint64_t ticket) REQUIRES(async_mu_) {
     if (done_.count(ticket)) return true;
     for (uint64_t r : running_) {
       if (r == ticket) return true;
@@ -940,41 +939,45 @@ class RingCommunicator : public Communicator {
   }
 
   void AsyncWorkerLoop(size_t ch) {
-    std::unique_lock<std::mutex> lk(async_mu_);
+    async_mu_.Lock();
     while (true) {
-      work_cv_.wait(lk, [&] { return stop_ || !queues_[ch].empty(); });
-      if (stop_) return;
+      while (!stop_ && queues_[ch].empty()) work_cv_.Wait(async_mu_);
+      if (stop_) break;
       auto job = std::move(queues_[ch].front());
       queues_[ch].pop_front();
       running_[ch] = job.first;
-      lk.unlock();
+      async_mu_.Unlock();
       Status s = job.second();  // the ring collective, off the caller thread
-      lk.lock();
+      async_mu_.Lock();
       running_[ch] = 0;
       done_[job.first] = s;
-      done_cv_.notify_all();  // wakes WaitTicket and FenceAsync
+      done_cv_.NotifyAll();  // wakes WaitTicket and FenceAsync
     }
+    async_mu_.Unlock();
+  }
+
+  // True when no async job is queued or executing.
+  bool AsyncIdle() REQUIRES(async_mu_) {
+    for (const auto& q : queues_) {
+      if (!q.empty()) return false;
+    }
+    for (uint64_t r : running_) {
+      if (r != 0) return false;
+    }
+    return true;
   }
 
   // Blocking collectives fence behind outstanding async work so the two
   // kinds never interleave on the underlying comms.
   void FenceAsync() {
-    std::unique_lock<std::mutex> lk(async_mu_);
+    MutexLock lk(async_mu_);
     if (!worker_started_) return;
-    done_cv_.wait(lk, [&] {
-      for (const auto& q : queues_) {
-        if (!q.empty()) return false;
-      }
-      for (uint64_t r : running_) {
-        if (r != 0) return false;
-      }
-      return true;
-    });
+    while (!AsyncIdle()) done_cv_.Wait(async_mu_);
   }
 
   void StopAsyncWorker() {
     {
-      std::unique_lock<std::mutex> lk(async_mu_);
+      MutexLock lk(async_mu_);
       if (!worker_started_) return;
       // Destroying with queued work is a caller error (peers would be left
       // mid-collective); the running jobs finish, queued jobs fail their
@@ -987,8 +990,8 @@ class RingCommunicator : public Communicator {
         }
         q.clear();
       }
-      work_cv_.notify_all();
-      done_cv_.notify_all();
+      work_cv_.NotifyAll();
+      done_cv_.NotifyAll();
     }
     for (std::thread& w : workers_) w.join();
   }
@@ -1026,16 +1029,22 @@ class RingCommunicator : public Communicator {
   ScratchBuf a2a_fwd_, a2a_rcv_;
   // Async (nonblocking-collective) state; async_mu_ guards all of it. Worker
   // c is the only place async jobs touch channel c's comms/scratch, and
-  // FenceAsync keeps the sync paths out while any job runs.
-  std::mutex async_mu_;
-  std::condition_variable work_cv_, done_cv_;
-  std::vector<std::deque<std::pair<uint64_t, std::function<Status()>>>> queues_;
-  std::vector<uint64_t> running_;
-  std::map<uint64_t, Status> done_;
+  // FenceAsync keeps the sync paths out while any job runs. async_mu_ is
+  // released before any job executes, so it is never held around engine or
+  // request locks (docs/DESIGN.md "Concurrency model").
+  Mutex async_mu_;
+  CondVar work_cv_, done_cv_;
+  std::vector<std::deque<std::pair<uint64_t, std::function<Status()>>>> queues_
+      GUARDED_BY(async_mu_);
+  std::vector<uint64_t> running_ GUARDED_BY(async_mu_);
+  std::map<uint64_t, Status> done_ GUARDED_BY(async_mu_);
   Status async_wire_status_ = Status::Ok();
-  uint64_t next_ticket_ = 1;
-  bool worker_started_ = false;
-  bool stop_ = false;
+  uint64_t next_ticket_ GUARDED_BY(async_mu_) = 1;
+  bool worker_started_ GUARDED_BY(async_mu_) = false;
+  bool stop_ GUARDED_BY(async_mu_) = false;
+  // Joined in StopAsyncWorker AFTER async_mu_ is released (a worker must be
+  // able to take the lock to observe stop_), so the vector itself cannot be
+  // async_mu_-guarded; it only grows under the lock in IAllReduce.
   std::vector<std::thread> workers_;
 };
 

@@ -13,6 +13,7 @@
 #include <vector>
 
 #include "id_map.h"
+#include "tpunet/mutex.h"
 #include "tpunet/net.h"
 #include "tpunet/telemetry.h"
 #include "tpunet/utils.h"
@@ -77,6 +78,11 @@ class EngineBase : public Net {
   // own, so they pass it in): park on the request condvar, then consume via
   // the engine's test(). The loop re-parks for the failed-but-not-yet-
   // quiesced window where test() reports not-done.
+  //
+  // Lock discipline: this function holds NO engine/comm lock — it parks on
+  // the request's leaf err_mu (inside WaitSettled*) and calls test(), which
+  // takes only IdMap shard locks. See docs/DESIGN.md "Concurrency model &
+  // lock hierarchy".
   //
   // Progress watchdog (TPUNET_PROGRESS_TIMEOUT_MS > 0): while parked, the
   // request's (completed, nbytes) pair is sampled; a full window with zero

@@ -40,13 +40,13 @@
 #include <future>
 #include <map>
 #include <memory>
-#include <mutex>
 #include <thread>
 #include <vector>
 
 #include "engine_base.h"
 #include "fault.h"
 #include "id_map.h"
+#include "tpunet/mutex.h"
 #include "tpunet/net.h"
 #include "tpunet/telemetry.h"
 #include "tpunet/utils.h"
@@ -77,6 +77,11 @@ struct Segment {
 struct EComm;
 
 // Per-fd state: the fd, its comm, and the FIFO of segments to move.
+// Everything mutable here (fd, segs, armed) is guarded by the owning
+// EComm's `mu` BY CONVENTION: EComm is an incomplete type at this point, so
+// GUARDED_BY(comm->mu) cannot be spelled. The contract is enforced one
+// level up instead — every function touching an FdState takes the owning
+// EComm explicitly and carries REQUIRES(c->mu).
 struct FdState {
   int fd = -1;
   bool is_ctrl = false;
@@ -97,19 +102,8 @@ struct EComm {
   size_t nstreams = 0;
   size_t min_chunksize = 0;
   bool crc = false;  // per-chunk CRC32C trailers (negotiated in the preamble)
-  uint64_t cursor = 0;  // rotating chunk-assignment cursor (fairness)
-  FdState ctrl;
-  // unique_ptr: FdState holds a deque of move-only Segments, and epoll
-  // stores raw FdState* in event data — addresses must be stable.
-  std::vector<std::unique_ptr<FdState>> streams;
-  // recv side: posted irecvs waiting for their ctrl length frame, in order.
-  std::deque<PendingRecv> pending;
-  uint8_t hdr[8];       // recv-side ctrl frame assembly buffer
-  size_t hdr_done = 0;
-  bool failed = false;
-  std::string fail_msg;
   // Inline fast path (caller-thread IO; see Loop::TryInline). `mu` guards
-  // ALL mutable comm state above, taken by the loop thread at each entry
+  // ALL mutable comm state below, taken by the loop thread at each entry
   // point and by the caller thread in TryInline — uncontended in steady
   // state, so the common cost is one atomic pair per entry. `attached`
   // flips once on the loop thread after epoll registration (fds are
@@ -117,8 +111,22 @@ struct EComm {
   // to the loop but not yet fully dispatched; TryInline requires 0 so an
   // inline message can never overtake a queued one on the wire (the loop
   // decrements only AFTER StartMsgLocked finishes, under mu).
-  std::mutex mu;
-  bool attached = false;
+  Mutex mu;
+  uint64_t cursor GUARDED_BY(mu) = 0;  // rotating chunk-assignment cursor (fairness)
+  // The FdStates' mutable innards (fd, segs, armed) are mu-guarded by
+  // convention — see the FdState comment. The containers themselves are
+  // shaped once pre-attach and stable after.
+  FdState ctrl;
+  // unique_ptr: FdState holds a deque of move-only Segments, and epoll
+  // stores raw FdState* in event data — addresses must be stable.
+  std::vector<std::unique_ptr<FdState>> streams;
+  // recv side: posted irecvs waiting for their ctrl length frame, in order.
+  std::deque<PendingRecv> pending GUARDED_BY(mu);
+  uint8_t hdr[8] GUARDED_BY(mu);  // recv-side ctrl frame assembly buffer
+  size_t hdr_done GUARDED_BY(mu) = 0;
+  bool failed GUARDED_BY(mu) = false;
+  std::string fail_msg GUARDED_BY(mu);
+  bool attached GUARDED_BY(mu) = false;
   std::atomic<uint64_t> queued{0};
 };
 
@@ -181,7 +189,7 @@ class Loop {
       return;
     }
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      MutexLock lk(mu_);
       if (!dead_) {
         cmds_.push_back(std::move(c));
         uint64_t one = 1;
@@ -214,7 +222,7 @@ class Loop {
     // fork). Decline; the caller falls through to Post(), whose guard
     // fails the request with the canonical before-fork error.
     if (ForkGeneration() != fork_gen_) return false;
-    std::lock_guard<std::mutex> lk(c->mu);
+    MutexLock lk(c->mu);
     if (!c->attached && !c->failed) return false;
     if (c->queued.load(std::memory_order_acquire) != 0) return false;
     if (!c->ctrl.segs.empty() || !c->pending.empty()) return false;
@@ -274,14 +282,15 @@ class Loop {
     // caller (kClose acks are signaled, kMsg requests are failed).
     for (auto& kv : comms_) FailComm(kv.second.get(), "engine shut down");
     for (auto& kv : comms_) {
-      std::lock_guard<std::mutex> lk(kv.second->mu);
-      CloseFds(kv.second.get());
+      EComm* c = kv.second.get();
+      MutexLock lk(c->mu);
+      CloseFds(c);
     }
     comms_.clear();
     graveyard_.clear();
     std::deque<Command> late;
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      MutexLock lk(mu_);
       dead_ = true;
       late.swap(cmds_);
     }
@@ -291,7 +300,7 @@ class Loop {
   bool DrainCommands() {
     std::deque<Command> batch;
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      MutexLock lk(mu_);
       batch.swap(cmds_);
     }
     bool stop = false;
@@ -302,7 +311,7 @@ class Loop {
           break;
         case Command::kMsg: {
           EComm* ec = c.comm.get();
-          std::lock_guard<std::mutex> lk(ec->mu);
+          MutexLock lk(ec->mu);
           StartMsgLocked(ec, c.data, c.len, c.state);
           // Decrement only now, under mu: TryInline observing queued==0
           // then implies this message's segments are already dispatched
@@ -324,20 +333,21 @@ class Loop {
 
   void Attach(const std::shared_ptr<EComm>& comm) {
     comms_[comm.get()] = comm;
-    std::lock_guard<std::mutex> lk(comm->mu);
-    bool ok = Register(&comm->ctrl);
-    for (auto& s : comm->streams) ok = Register(s.get()) && ok;
+    EComm* c = comm.get();
+    MutexLock lk(c->mu);
+    bool ok = Register(c, &c->ctrl);
+    for (auto& s : c->streams) ok = Register(c, s.get()) && ok;
     if (!ok) {
       // A comm with unwatched fds would never progress and never error;
       // fail it now so its requests surface the problem via test().
-      FailCommLocked(comm.get(),
-                     "epoll registration failed: " + std::string(strerror(errno)));
+      FailCommLocked(c, "epoll registration failed: " + std::string(strerror(errno)));
       return;
     }
-    comm->attached = true;  // TryInline may take the fast path from here on
+    c->attached = true;  // TryInline may take the fast path from here on
   }
 
-  bool Register(FdState* fs) {
+  bool Register(EComm* c, FdState* fs) REQUIRES(c->mu) {
+    (void)c;
     SetNonblocking(fs->fd);
     epoll_event ev{};
     ev.events = 0;
@@ -353,18 +363,18 @@ class Loop {
     // surfaces an error instead of polling forever (BASIC flushes queued
     // work on close for the same reason).
     EComm* c = comm.get();
-    std::lock_guard<std::mutex> lk(c->mu);
+    MutexLock lk(c->mu);
     bool leftovers = !c->ctrl.segs.empty() || !c->pending.empty();
     for (auto& s : c->streams) leftovers = leftovers || !s->segs.empty();
     if (leftovers) FailCommLocked(c, "comm closed with requests in flight");
-    CloseFds(comm.get());
+    CloseFds(c);
     comms_.erase(comm.get());
     // Keep the comm alive until the current event batch has fully drained —
     // stale events in this batch still point at its FdStates.
     graveyard_.push_back(comm);
   }
 
-  void CloseFds(EComm* c) {
+  void CloseFds(EComm* c) REQUIRES(c->mu) {
     auto drop = [&](FdState& fs) {
       if (fs.fd >= 0) {
         ::epoll_ctl(ep_, EPOLL_CTL_DEL, fs.fd, nullptr);
@@ -379,8 +389,9 @@ class Loop {
   // Set epoll interest on fs to `want` (EPOLLIN or EPOLLOUT or 0).
   // epoll_ctl is thread-safe against the loop's epoll_wait, so this is
   // callable from the caller thread's inline path; fs->armed is guarded by
-  // the comm mutex all callers hold.
-  void Arm(FdState* fs, uint32_t want) {
+  // the comm mutex all callers hold (REQUIRES below).
+  void Arm(EComm* c, FdState* fs, uint32_t want) REQUIRES(c->mu) {
+    (void)c;
     if (fs->armed == want || fs->fd < 0) return;
     epoll_event ev{};
     ev.events = want;
@@ -389,22 +400,23 @@ class Loop {
     fs->armed = want;
   }
 
-  void WantIOLocked(FdState* fs) {
-    uint32_t dir = fs->comm->is_send ? static_cast<uint32_t>(EPOLLOUT)
-                                     : static_cast<uint32_t>(EPOLLIN);
+  void WantIOLocked(EComm* c, FdState* fs) REQUIRES(c->mu) {
+    uint32_t dir = c->is_send ? static_cast<uint32_t>(EPOLLOUT)
+                              : static_cast<uint32_t>(EPOLLIN);
     // Recv-side ctrl arms EPOLLIN while a posted recv awaits its frame.
-    if (!fs->comm->is_send && fs->is_ctrl) {
-      Arm(fs, fs->comm->pending.empty() && fs->segs.empty()
-                  ? 0
-                  : static_cast<uint32_t>(EPOLLIN));
+    if (!c->is_send && fs->is_ctrl) {
+      Arm(c, fs, c->pending.empty() && fs->segs.empty()
+                     ? 0
+                     : static_cast<uint32_t>(EPOLLIN));
       return;
     }
-    Arm(fs, fs->segs.empty() ? 0 : dir);
+    Arm(c, fs, fs->segs.empty() ? 0 : dir);
   }
 
   // ----- message start (comm mutex held) -----------------------------------
 
-  void StartMsgLocked(EComm* c, uint8_t* data, size_t len, const RequestPtr& state) {
+  void StartMsgLocked(EComm* c, uint8_t* data, size_t len, const RequestPtr& state)
+      REQUIRES(c->mu) {
     if (c->failed) {
       state->SetError("comm broken by earlier error: " + c->fail_msg);
       state->total.store(0, std::memory_order_release);
@@ -429,10 +441,10 @@ class Loop {
       // Immediate IO pass (ctrl frame first): a message that fits the
       // kernel socket buffers completes right here with interest left at 0
       // — no epoll round-trip at all. Residue arms itself in AdvanceFd.
-      AdvanceFdLocked(&c->ctrl);
+      AdvanceFdLocked(c, &c->ctrl);
       for (auto& s : c->streams) {
         if (c->failed) break;
-        if (!s->segs.empty()) AdvanceFdLocked(s.get());
+        if (!s->segs.empty()) AdvanceFdLocked(c, s.get());
       }
     } else {
       c->pending.push_back(PendingRecv{data, len, state});
@@ -444,7 +456,7 @@ class Loop {
   }
 
   void DispatchChunksLocked(EComm* c, uint8_t* data, size_t len,
-                            const RequestPtr& state) {
+                            const RequestPtr& state) REQUIRES(c->mu) {
     size_t csize = ChunkSize(len, c->min_chunksize, c->nstreams);
     size_t nchunks = ChunkCount(len, csize);
     size_t off = 0;
@@ -463,7 +475,7 @@ class Loop {
         if (c->is_send) EncodeU32BE(Crc32c(seg.data, seg.len), seg.trailer);
       }
       fs->segs.push_back(std::move(seg));
-      WantIOLocked(fs);
+      WantIOLocked(c, fs);
       off += n;
     }
   }
@@ -474,15 +486,16 @@ class Loop {
   // StartMsgLocked with the same mutex held, so fd/segment state is only
   // ever touched under c->mu.
   void Advance(FdState* fs) {
-    std::lock_guard<std::mutex> lk(fs->comm->mu);
-    AdvanceFdLocked(fs);
+    EComm* c = fs->comm;
+    MutexLock lk(c->mu);
+    AdvanceFdLocked(c, fs);
   }
 
   // Recv-side completion side effects: injected wire damage lands before the
   // CRC verify, and a trailer mismatch fails the REQUEST (not the comm — the
   // framing is intact, so the comm keeps serving subsequent messages).
-  void FinishSegmentLocked(Segment& seg, FdState* fs) {
-    if (!fs->comm->is_send) {
+  void FinishSegmentLocked(EComm* c, Segment& seg, FdState* fs) REQUIRES(c->mu) {
+    if (!c->is_send) {
       if (seg.corrupt && seg.len > 0) {
         seg.data[seg.len / 2] ^= 0x01;  // wire damage before verify
         seg.corrupt = false;
@@ -495,15 +508,14 @@ class Loop {
                                 ": payload corrupted in transit");
       }
     }
-    CompleteSegment(seg, fs);
+    CompleteSegment(c, seg, fs);
   }
 
   // Segments coalesced per sendmsg/recvmsg. Each contributes up to two
   // iovecs (payload remainder + trailer remainder); well under IOV_MAX.
   static constexpr int kIovBatch = 64;
 
-  void AdvanceFdLocked(FdState* fs) {
-    EComm* c = fs->comm;
+  void AdvanceFdLocked(EComm* c, FdState* fs) REQUIRES(c->mu) {
     if (c->failed || fs->fd < 0) return;
     if (!c->is_send && fs->is_ctrl) {
       AdvanceRecvCtrlLocked(c);
@@ -588,7 +600,7 @@ class Loop {
         seg.trailer_done += ttake;
         moved -= ttake;
         if (seg.done == seg.len && seg.trailer_done == seg.trailer_len) {
-          FinishSegmentLocked(seg, fs);
+          FinishSegmentLocked(c, seg, fs);
           fs->segs.pop_front();
           continue;
         }
@@ -596,10 +608,10 @@ class Loop {
       }
       if (static_cast<size_t>(m) < want) break;  // kernel full/empty: arm below
     }
-    WantIOLocked(fs);
+    WantIOLocked(c, fs);
   }
 
-  void AdvanceRecvCtrlLocked(EComm* c) {
+  void AdvanceRecvCtrlLocked(EComm* c) REQUIRES(c->mu) {
     FdState* fs = &c->ctrl;
     bool dispatched = false;
     while (!c->pending.empty()) {
@@ -636,40 +648,40 @@ class Loop {
       FailCommLocked(c, std::string("ctrl recv failed: ") + strerror(errno));
       return;
     }
-    WantIOLocked(fs);
+    WantIOLocked(c, fs);
     if (dispatched) {
       // Eager data pass: when the frame was readable, the payload usually
       // is too — drain what's buffered now instead of paying a readiness
       // round-trip per data fd.
       for (auto& s : c->streams) {
         if (c->failed) break;
-        if (!s->segs.empty()) AdvanceFdLocked(s.get());
+        if (!s->segs.empty()) AdvanceFdLocked(c, s.get());
       }
     }
   }
 
-  void CompleteSegment(Segment& seg, FdState* fs) {
+  void CompleteSegment(EComm* c, Segment& seg, FdState* fs) REQUIRES(c->mu) {
     if (seg.counts_bytes) {
       seg.state->nbytes.fetch_add(seg.len, std::memory_order_relaxed);
       seg.state->MarkWireEnd(MonotonicUs());
       // Rate-limited TCP_INFO sample off the chunk's live socket (per-chunk,
       // never per-partial-read — the limiter check is one clock + atomic).
-      Telemetry::Get().MaybeSampleStream(fs->comm->is_send, fs->stream_idx, fs->fd);
+      Telemetry::Get().MaybeSampleStream(c->is_send, fs->stream_idx, fs->fd);
     }
     seg.state->completed.fetch_add(1, std::memory_order_acq_rel);
     seg.state->NotifyIfSettled();
   }
 
   // Loop-thread entry (EPOLLERR/EPOLLHUP and Run-exit paths).
-  void FailComm(EComm* c, const std::string& msg) {
-    std::lock_guard<std::mutex> lk(c->mu);
+  void FailComm(EComm* c, const std::string& msg) EXCLUDES(c->mu) {
+    MutexLock lk(c->mu);
     FailCommLocked(c, msg);
   }
 
   // Fail every in-flight and future request on the comm. Buffers are safe to
   // release immediately: segments are dropped under the comm mutex, which
   // every toucher (loop thread and inline caller) holds.
-  void FailCommLocked(EComm* c, const std::string& msg) {
+  void FailCommLocked(EComm* c, const std::string& msg) REQUIRES(c->mu) {
     if (c->failed) return;
     c->failed = true;
     c->fail_msg = msg;
@@ -700,11 +712,13 @@ class Loop {
 
   int ep_ = -1;
   int wake_ = -1;
-  bool dead_ = false;  // guarded by mu_ after construction
   const uint64_t fork_gen_ = ForkGeneration();  // fork detection (see Post)
   std::unique_ptr<std::thread> thread_;
-  std::mutex mu_;
-  std::deque<Command> cmds_;
+  Mutex mu_;
+  // Written unlocked only in the constructor (TSA exempts ctors; no other
+  // thread exists until thread_ starts below that write).
+  bool dead_ GUARDED_BY(mu_) = false;
+  std::deque<Command> cmds_ GUARDED_BY(mu_);
   std::map<EComm*, std::shared_ptr<EComm>> comms_;  // keeps comms alive on-loop
   std::vector<std::shared_ptr<EComm>> graveyard_;   // detached, freed post-batch
 };
@@ -855,7 +869,7 @@ class EpollEngine : public EngineBase {
       state->on_stall = [wc] {
         auto p = wc.lock();
         if (!p) return;
-        std::lock_guard<std::mutex> lk(p->mu);
+        MutexLock lk(p->mu);
         if (p->ctrl.fd >= 0) ::shutdown(p->ctrl.fd, SHUT_RDWR);
         for (auto& s : p->streams) {
           if (s->fd >= 0) ::shutdown(s->fd, SHUT_RDWR);

@@ -5,9 +5,9 @@
 #include <sys/socket.h>
 
 #include <chrono>
-#include <mutex>
 #include <thread>
 
+#include "tpunet/mutex.h"
 #include "tpunet/telemetry.h"
 #include "tpunet/utils.h"
 
@@ -17,11 +17,15 @@ std::atomic<uint32_t> g_fault_armed{0};
 
 namespace {
 
-// The armed slot. `mu` guards spec swaps; the hot path reads the plain
-// fields only after g_fault_armed's acquire load in FaultPreIO, and ArmFault
-// publishes them with a release store — the classic flag-guarded payload.
-std::mutex g_mu;
-FaultSpec g_spec;
+// The armed slot. `g_mu` guards the spec: arm/disarm swap it under the
+// lock, and FaultPreIO copies it under the lock too. (It used to read the
+// plain fields through a release/acquire handshake on g_fault_armed — a
+// pattern the thread-safety analysis cannot express and tsan flagged as a
+// race whenever a chaos test re-armed mid-traffic. The lock only costs on
+// the slow path: the disarmed hot path is still the single relaxed load in
+// FaultCheck.) g_mu is a leaf lock.
+Mutex g_mu;
+FaultSpec g_spec GUARDED_BY(g_mu);
 std::atomic<uint64_t> g_bytes{0};     // bytes seen on matching (side, stream)
 std::atomic<uint32_t> g_latched{0};   // one-shot claim for close/corrupt
 
@@ -114,7 +118,7 @@ Status ParseFaultSpec(const std::string& spec, FaultSpec* out) {
 }
 
 void ArmFault(const FaultSpec& spec) {
-  std::lock_guard<std::mutex> lk(g_mu);
+  MutexLock lk(g_mu);
   g_fault_armed.store(0, std::memory_order_release);  // quiesce readers' view
   g_spec = spec;
   g_bytes.store(0, std::memory_order_relaxed);
@@ -123,7 +127,7 @@ void ArmFault(const FaultSpec& spec) {
 }
 
 void DisarmFault() {
-  std::lock_guard<std::mutex> lk(g_mu);
+  MutexLock lk(g_mu);
   g_fault_armed.store(0, std::memory_order_release);
 }
 
@@ -140,9 +144,15 @@ void ArmFaultFromEnv() {
 }
 
 FaultAction FaultPreIO(bool is_send, uint64_t stream_idx, int fd, size_t nbytes) {
-  // Re-check under acquire: pairs with ArmFault's release publish.
-  if (g_fault_armed.load(std::memory_order_acquire) == 0) return FaultAction::kNone;
-  const FaultSpec spec = g_spec;  // plain read, valid per the armed handshake
+  // Slow path only (FaultCheck already saw armed != 0): copy the spec under
+  // its lock. Re-check armed under the same lock so a concurrent disarm
+  // cannot hand out a stale spec.
+  FaultSpec spec;
+  {
+    MutexLock lk(g_mu);
+    if (g_fault_armed.load(std::memory_order_acquire) == 0) return FaultAction::kNone;
+    spec = g_spec;
+  }
   if (spec.side == 1 && !is_send) return FaultAction::kNone;
   if (spec.side == 2 && is_send) return FaultAction::kNone;
   if (spec.stream >= 0 && static_cast<uint64_t>(spec.stream) != stream_idx) {

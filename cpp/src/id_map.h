@@ -1,15 +1,18 @@
 // Sharded concurrent id->value map. Replaces the reference's single global
 // Arc<Mutex<Box<dyn Net>>> big-lock (reference: src/lib.rs:14-16) which
 // serialized even the hot test() polling path; here each id hashes to one of
-// 16 independently-locked shards.
+// 16 independently-locked shards. Shard locks are leaves of the lock
+// hierarchy (docs/DESIGN.md "Concurrency model"): no other lock is ever
+// acquired while one is held.
 #ifndef TPUNET_ID_MAP_H_
 #define TPUNET_ID_MAP_H_
 
 #include <array>
 #include <cstdint>
-#include <mutex>
 #include <unordered_map>
 #include <vector>
+
+#include "tpunet/mutex.h"
 
 namespace tpunet {
 
@@ -18,13 +21,13 @@ class IdMap {
  public:
   void Put(uint64_t id, V v) {
     Shard& s = shard(id);
-    std::lock_guard<std::mutex> lk(s.mu);
+    MutexLock lk(s.mu);
     s.m[id] = std::move(v);
   }
 
   bool Get(uint64_t id, V* out) const {
     const Shard& s = shard(id);
-    std::lock_guard<std::mutex> lk(s.mu);
+    MutexLock lk(s.mu);
     auto it = s.m.find(id);
     if (it == s.m.end()) return false;
     *out = it->second;
@@ -33,7 +36,7 @@ class IdMap {
 
   bool Take(uint64_t id, V* out) {
     Shard& s = shard(id);
-    std::lock_guard<std::mutex> lk(s.mu);
+    MutexLock lk(s.mu);
     auto it = s.m.find(id);
     if (it == s.m.end()) return false;
     *out = std::move(it->second);
@@ -43,14 +46,14 @@ class IdMap {
 
   bool Erase(uint64_t id) {
     Shard& s = shard(id);
-    std::lock_guard<std::mutex> lk(s.mu);
+    MutexLock lk(s.mu);
     return s.m.erase(id) > 0;
   }
 
   std::vector<V> DrainAll() {
     std::vector<V> out;
     for (Shard& s : shards_) {
-      std::lock_guard<std::mutex> lk(s.mu);
+      MutexLock lk(s.mu);
       for (auto& kv : s.m) out.push_back(std::move(kv.second));
       s.m.clear();
     }
@@ -60,7 +63,7 @@ class IdMap {
   size_t Size() const {
     size_t n = 0;
     for (const Shard& s : shards_) {
-      std::lock_guard<std::mutex> lk(s.mu);
+      MutexLock lk(s.mu);
       n += s.m.size();
     }
     return n;
@@ -69,8 +72,8 @@ class IdMap {
  private:
   static constexpr size_t kShards = 16;
   struct Shard {
-    mutable std::mutex mu;
-    std::unordered_map<uint64_t, V> m;
+    mutable Mutex mu;
+    std::unordered_map<uint64_t, V> m GUARDED_BY(mu);
   };
   Shard& shard(uint64_t id) { return shards_[id % kShards]; }
   const Shard& shard(uint64_t id) const { return shards_[id % kShards]; }

@@ -14,13 +14,13 @@
 #include <algorithm>
 #include <atomic>
 #include <chrono>
-#include <condition_variable>
 #include <cstdio>
 #include <mutex>
 #include <thread>
 #include <unordered_map>
 #include <vector>
 
+#include "tpunet/mutex.h"
 #include "tpunet/utils.h"
 
 namespace tpunet {
@@ -220,22 +220,23 @@ struct Telemetry::Impl {
   // Fairness window (win_mu): Jain's index over per-stream byte deltas
   // between rolls. Rolled lazily from Snapshot() at most once per
   // TPUNET_FAIRNESS_WINDOW_MS; the first roll covers everything since
-  // start/Reset (deterministic for tests).
-  std::mutex win_mu;
-  bool win_init = false;
-  uint64_t win_last_us = 0;
+  // start/Reset (deterministic for tests). win_mu is a leaf lock.
+  Mutex win_mu;
+  bool win_init GUARDED_BY(win_mu) = false;
+  uint64_t win_last_us GUARDED_BY(win_mu) = 0;
   uint64_t fairness_window_us = GetEnvU64("TPUNET_FAIRNESS_WINDOW_MS", 1000) * 1000;
-  uint64_t win_tx[kMaxStreamStats] = {0};
-  uint64_t win_rx[kMaxStreamStats] = {0};
+  uint64_t win_tx[kMaxStreamStats] GUARDED_BY(win_mu) = {0};
+  uint64_t win_rx[kMaxStreamStats] GUARDED_BY(win_mu) = {0};
   std::atomic<uint64_t> fair_tx_bits{DoubleToBits(1.0)};
   std::atomic<uint64_t> fair_rx_bits{DoubleToBits(1.0)};
 
-  // Span tracking (tracing only).
-  std::mutex span_mu;
-  std::unordered_map<SpanKey, Span, SpanKeyHash> open_spans;
-  std::vector<Span> done_spans;
-  std::string trace_path;
-  bool trace_header_written = false;
+  // Span tracking (tracing only). span_mu also serializes trace-file writes
+  // (FlushTrace) and the trace target swap (SetTraceDir); leaf lock.
+  Mutex span_mu;
+  std::unordered_map<SpanKey, Span, SpanKeyHash> open_spans GUARDED_BY(span_mu);
+  std::vector<Span> done_spans GUARDED_BY(span_mu);
+  std::string trace_path GUARDED_BY(span_mu);
+  bool trace_header_written GUARDED_BY(span_mu) = false;
 
   // Threads do not survive fork(): a mismatch in the child means the pusher
   // pthread never existed here and push_mu/span_mu may have been captured
@@ -244,9 +245,9 @@ struct Telemetry::Impl {
 
   // Push thread.
   std::thread pusher;
-  std::mutex push_mu;
-  std::condition_variable push_cv;
-  bool stopping = false;
+  Mutex push_mu;  // leaf: guards only the stop flag
+  CondVar push_cv;
+  bool stopping GUARDED_BY(push_mu) = false;
 
   // On-demand /metrics scrape listener (TPUNET_METRICS_PORT).
   std::thread scraper;
@@ -298,9 +299,12 @@ Telemetry::Telemetry() : impl_(new Impl()) {
       std::string path = "/metrics/job/tpunet/rank/" + std::to_string(impl_->rank);
       while (true) {
         {
-          std::unique_lock<std::mutex> lk(impl_->push_mu);
-          impl_->push_cv.wait_for(lk, std::chrono::milliseconds(interval_ms),
-                                  [&] { return impl_->stopping; });
+          // A spurious wakeup inside the interval just pushes one period
+          // early — harmless, so no deadline re-arm loop here.
+          MutexLock lk(impl_->push_mu);
+          if (!impl_->stopping) {
+            impl_->push_cv.WaitFor(impl_->push_mu, static_cast<int>(interval_ms));
+          }
           if (impl_->stopping) return;
         }
         std::string body = PrometheusText();
@@ -384,10 +388,10 @@ void Telemetry::ShutdownForExit() {
   if (ForkGeneration() != impl_->created_fork_gen) return;
   if (impl_->pusher.joinable()) {
     {
-      std::lock_guard<std::mutex> lk(impl_->push_mu);
+      MutexLock lk(impl_->push_mu);
       impl_->stopping = true;
     }
-    impl_->push_cv.notify_all();
+    impl_->push_cv.NotifyAll();
     impl_->pusher.join();
   }
   if (impl_->scraper.joinable()) {
@@ -402,7 +406,7 @@ bool Telemetry::SetTraceDir(const std::string& dir) {
   // file (or is lost on disable).
   FlushTrace();
   Impl* im = impl_.get();
-  std::lock_guard<std::mutex> lk(im->span_mu);
+  MutexLock lk(im->span_mu);
   if (dir.empty()) {
     trace_enabled_.store(false, std::memory_order_relaxed);
     im->open_spans.clear();
@@ -436,7 +440,7 @@ void Telemetry::OnRequestStart(uint64_t owner, bool is_send, uint64_t comm, uint
     s.req = req;
     s.nbytes = nbytes;
     s.start_us = NowUs();
-    std::lock_guard<std::mutex> lk(im->span_mu);
+    MutexLock lk(im->span_mu);
     im->open_spans[SpanKey{owner, req}] = std::move(s);
   }
 }
@@ -452,7 +456,7 @@ void Telemetry::OnRequestDone(uint64_t owner, uint64_t req, bool failed) {
   if (!tracing_enabled()) return;
   bool flush = false;
   {
-    std::lock_guard<std::mutex> lk(im->span_mu);
+    MutexLock lk(im->span_mu);
     auto it = im->open_spans.find(SpanKey{owner, req});
     if (it == im->open_spans.end()) return;
     Span s = it->second;
@@ -530,7 +534,7 @@ void Telemetry::MaybeSampleStream(bool is_send, uint64_t stream_idx, int fd) {
         s.nbytes = median;
         s.start_us = now;
         s.name = "straggler-stream" + std::to_string(stream_idx);
-        std::lock_guard<std::mutex> lk(im->span_mu);
+        MutexLock lk(im->span_mu);
         im->done_spans.push_back(std::move(s));
       }
     }
@@ -567,7 +571,7 @@ void Telemetry::OnCollPhase(uint64_t comm_id, uint64_t coll_seq, const char* pha
   s.name = phase;
   bool flush = false;
   {
-    std::lock_guard<std::mutex> lk(im->span_mu);
+    MutexLock lk(im->span_mu);
     im->done_spans.push_back(std::move(s));
     flush = im->done_spans.size() >= 4096;
   }
@@ -626,7 +630,7 @@ void Telemetry::Reset() {
   im->req_wire.Reset();
   im->req_total.Reset();
   {
-    std::lock_guard<std::mutex> lk(im->win_mu);
+    MutexLock lk(im->win_mu);
     im->win_init = false;
     im->win_last_us = 0;
     memset(im->win_tx, 0, sizeof(im->win_tx));
@@ -648,7 +652,7 @@ MetricsSnapshot Telemetry::Snapshot() const {
   // back-to-back scrapes don't compute Jain over an empty delta. The first
   // roll covers everything since start/Reset.
   {
-    std::lock_guard<std::mutex> lk(im->win_mu);
+    MutexLock lk(im->win_mu);
     uint64_t now = NowUs();
     if (!im->win_init || now - im->win_last_us >= im->fairness_window_us) {
       uint64_t dtx[kMaxStreamStats], drx[kMaxStreamStats];
@@ -910,29 +914,35 @@ bool Telemetry::FlushTrace() {
   Impl* im = impl_.get();
   std::vector<Span> spans;
   {
-    std::lock_guard<std::mutex> lk(im->span_mu);
+    MutexLock lk(im->span_mu);
     spans.swap(im->done_spans);
   }
-  std::lock_guard<std::mutex> lk(im->span_mu);  // serialize file writes
+  MutexLock lk(im->span_mu);  // serialize file writes
   if (spans.empty() && im->trace_header_written) return true;
   // The file is VALID JSON after every flush: the array's closing "\n]" is
   // rewritten in place on each append (r+ / seek −2), so json.load and
   // Perfetto both accept it at any point, including mid-run.
+  //
+  // Guarded state is copied to locals around the write_header lambda: TSA
+  // analyzes a lambda as a separate unannotated function, so direct guarded
+  // accesses inside it would (falsely) warn even with span_mu held here.
+  const std::string path = im->trace_path;
+  bool header_written = im->trace_header_written;
   FILE* f = nullptr;
   auto write_header = [&]() -> FILE* {
-    FILE* nf = fopen(im->trace_path.c_str(), "w");
+    FILE* nf = fopen(path.c_str(), "w");
     if (!nf) return nullptr;
     fprintf(nf,
             "[\n{\"name\":\"process_name\",\"ph\":\"M\",\"pid\":%lld,"
             "\"args\":{\"name\":\"tpunet-rank%lld\"}}",
             (long long)im->rank, (long long)im->rank);
-    im->trace_header_written = true;
+    header_written = true;
     return nf;
   };
-  if (!im->trace_header_written) {
+  if (!header_written) {
     f = write_header();
   } else {
-    f = fopen(im->trace_path.c_str(), "r+");
+    f = fopen(path.c_str(), "r+");
     if (f) {
       if (fseek(f, -2, SEEK_END) != 0) {
         fclose(f);
@@ -942,6 +952,7 @@ bool Telemetry::FlushTrace() {
     if (!f) f = write_header();  // file deleted/truncated underneath: restart
   }
   if (!f) return false;  // spans dropped; caller surfaces the failure
+  im->trace_header_written = header_written;
   for (const Span& s : spans) {
     switch (s.kind) {
       case Span::Kind::kReq:

@@ -18,15 +18,15 @@
 
 #include <algorithm>
 #include <atomic>
-#include <condition_variable>
 #include <cstdio>
 #include <functional>
-#include <mutex>
 #include <fstream>
 #include <new>
 #include <set>
 #include <sstream>
 #include <thread>
+
+#include "tpunet/mutex.h"
 
 #if defined(__x86_64__)
 #include <immintrin.h>
@@ -762,26 +762,27 @@ class ReducePool {
       for (size_t i = 0; i < njobs; ++i) fn(i);
       return;
     }
-    std::lock_guard<std::mutex> run_lk(run_mu_);
-    std::unique_lock<std::mutex> lk(mu_);
+    MutexLock run_lk(run_mu_);
+    mu_.Lock();
     job_ = &fn;
     njobs_ = njobs;
     next_ = 0;
     pending_ = njobs;
     ++gen_;
-    work_cv_.notify_all();
+    work_cv_.NotifyAll();
     // The caller pulls jobs too — no idle waiting while work remains.
     while (true) {
       size_t i = next_;
       if (i >= njobs_) break;
       next_ = i + 1;
-      lk.unlock();
+      mu_.Unlock();
       fn(i);
-      lk.lock();
+      mu_.Lock();
       --pending_;
     }
-    done_cv_.wait(lk, [&] { return pending_ == 0; });
+    while (pending_ != 0) done_cv_.Wait(mu_);
     job_ = nullptr;
+    mu_.Unlock();
   }
 
   size_t nworkers() const { return nworkers_; }
@@ -801,31 +802,35 @@ class ReducePool {
     }
   }
 
-  void WorkerLoop() {
+  // Never returns (pool threads are process-lifetime, detached) — the
+  // mutex is intentionally held at the unreachable function exit.
+  void WorkerLoop() NO_THREAD_SAFETY_ANALYSIS {
     uint64_t seen = 0;
-    std::unique_lock<std::mutex> lk(mu_);
+    mu_.Lock();
     while (true) {
-      work_cv_.wait(lk, [&] { return gen_ != seen && job_ != nullptr; });
+      while (!(gen_ != seen && job_ != nullptr)) work_cv_.Wait(mu_);
       seen = gen_;
       while (true) {
         size_t i = next_;
         if (i >= njobs_) break;
         next_ = i + 1;
         const auto* fn = job_;
-        lk.unlock();
+        mu_.Unlock();
         (*fn)(i);
-        lk.lock();
-        if (--pending_ == 0) done_cv_.notify_all();
+        mu_.Lock();
+        if (--pending_ == 0) done_cv_.NotifyAll();
       }
     }
   }
 
-  std::mutex run_mu_;  // serializes concurrent Run() callers
-  std::mutex mu_;
-  std::condition_variable work_cv_, done_cv_;
-  const std::function<void(size_t)>* job_ = nullptr;
-  size_t njobs_ = 0, next_ = 0, pending_ = 0;
-  uint64_t gen_ = 0;
+  Mutex run_mu_;  // serializes concurrent Run() callers; ordered before mu_
+  Mutex mu_ ACQUIRED_AFTER(run_mu_);
+  CondVar work_cv_, done_cv_;
+  const std::function<void(size_t)>* job_ GUARDED_BY(mu_) = nullptr;
+  size_t njobs_ GUARDED_BY(mu_) = 0;
+  size_t next_ GUARDED_BY(mu_) = 0;
+  size_t pending_ GUARDED_BY(mu_) = 0;
+  uint64_t gen_ GUARDED_BY(mu_) = 0;
   size_t nworkers_ = 0;
   std::vector<std::thread> threads_;
 };

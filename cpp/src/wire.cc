@@ -143,7 +143,7 @@ Status AcceptBundle(ListenSock* lc, PartialBundle* out) {
   // Accept connections, grouping by bundle id, until one bundle is whole
   // (reference accepts exactly nstreams+1 and keys by raw id,
   // nthread:425-522; bundles make concurrent senders safe).
-  std::lock_guard<std::mutex> accept_lk(lc->mu);
+  MutexLock accept_lk(lc->mu);
   uint64_t expiry_ms = 2 * GetEnvU64("TPUNET_HANDSHAKE_TIMEOUT_MS", 10000);
   while (true) {
     // Expire half-arrived bundles from dead senders so their parked fds

@@ -15,15 +15,14 @@
 
 #include <atomic>
 #include <chrono>
-#include <condition_variable>
 #include <cstdint>
 #include <functional>
 #include <map>
 #include <memory>
-#include <mutex>
 #include <string>
 #include <vector>
 
+#include "tpunet/mutex.h"
 #include "tpunet/net.h"
 #include "tpunet/utils.h"
 
@@ -116,12 +115,15 @@ struct RequestState {
   std::atomic<uint64_t> completed{0};
   std::atomic<uint64_t> nbytes{0};
   std::atomic<bool> failed{false};
-  std::mutex err_mu;
-  std::string err_msg;
+  // err_mu is a LEAF of the lock hierarchy (docs/DESIGN.md "Concurrency
+  // model"): completion paths take it while holding fo_mu/ctrl_mu/EComm::mu,
+  // so nothing may be acquired under it.
+  Mutex err_mu;
+  std::string err_msg GUARDED_BY(err_mu);
   // Error kind carried alongside the message so typed failures (corruption,
   // watchdog timeout, version mismatch) survive the trip through test()/
   // wait() to the C ABI instead of collapsing into kInnerError.
-  ErrorKind err_kind = ErrorKind::kInnerError;
+  ErrorKind err_kind GUARDED_BY(err_mu) = ErrorKind::kInnerError;
   // Progress-watchdog abort hook: set at request creation (only when
   // TPUNET_PROGRESS_TIMEOUT_MS > 0) to shut down the owning comm's sockets
   // so blocked workers quiesce after a timeout verdict. Captures a weak
@@ -146,7 +148,7 @@ struct RequestState {
   void SetError(const std::string& m) { SetError(ErrorKind::kInnerError, m); }
   void SetError(ErrorKind k, const std::string& m) {
     {
-      std::lock_guard<std::mutex> lk(err_mu);
+      MutexLock lk(err_mu);
       if (err_msg.empty()) {
         err_msg = m;
         err_kind = k;
@@ -155,12 +157,12 @@ struct RequestState {
     failed.store(true, std::memory_order_release);
   }
   std::string ErrorMsg() {
-    std::lock_guard<std::mutex> lk(err_mu);
+    MutexLock lk(err_mu);
     return err_msg;
   }
   // The kind recorded by the first SetError (first error wins, like the msg).
   ErrorKind ErrKind() {
-    std::lock_guard<std::mutex> lk(err_mu);
+    MutexLock lk(err_mu);
     return err_kind;
   }
   bool Done() const {
@@ -176,26 +178,26 @@ struct RequestState {
   // cannot be lost; the wait_for timeout is belt-and-braces only.
   void NotifyIfSettled() {
     if (!Done() && !failed.load(std::memory_order_acquire)) return;
-    std::lock_guard<std::mutex> lk(err_mu);
-    cv.notify_all();
+    MutexLock lk(err_mu);
+    cv.NotifyAll();
   }
   void WaitSettled() {
-    std::unique_lock<std::mutex> lk(err_mu);
+    MutexLock lk(err_mu);
     while (!Done() && !failed.load(std::memory_order_acquire)) {
-      cv.wait_for(lk, std::chrono::milliseconds(100));
+      cv.WaitFor(err_mu, 100);
     }
   }
   // Bounded settle-wait; returns whether the request settled. Used by the
   // BASIC engine's wait() to detect "not settling promptly" and break any
   // cross-request coupling with parked lazy recvs.
   bool WaitSettledFor(int ms) {
-    std::unique_lock<std::mutex> lk(err_mu);
+    MutexLock lk(err_mu);
     if (Done() || failed.load(std::memory_order_acquire)) return true;
-    cv.wait_for(lk, std::chrono::milliseconds(ms));
+    cv.WaitFor(err_mu, ms);
     return Done() || failed.load(std::memory_order_acquire);
   }
 
-  std::condition_variable cv;
+  CondVar cv;
 };
 using RequestPtr = std::shared_ptr<RequestState>;
 
@@ -220,8 +222,8 @@ struct ListenSock {
   int wake_fd = -1;  // eventfd; close_listen signals it to abort a blocked accept
   int32_t dev = 0;
   std::atomic<bool> closed{false};
-  std::mutex mu;  // guards partials; accept() may be called from many threads
-  std::map<uint64_t, PartialBundle> partials;
+  Mutex mu;  // serializes AcceptBundle callers; leaf lock
+  std::map<uint64_t, PartialBundle> partials GUARDED_BY(mu);
 
   ~ListenSock();
 };

@@ -1,0 +1,239 @@
+"""Invariant lint suite (tools/lint): live-tree gates + negative fixtures.
+
+Two halves:
+  * The live-tree tests run all four checkers against THIS repository and
+    require zero violations — the same gate the CI analysis lane applies via
+    ``python -m tools.lint``.
+  * The negative-fixture tests synthesize minimal broken trees (an
+    unregistered env var, a duplicated/misnamed metric family, a mismatched
+    error code, a missing ctypes binding) and prove each checker actually
+    FIRES on its defect class — a checker that cannot go red is decoration.
+
+Plus the Config.from_env validation surface the env checker forced into
+existence: the observability/wire-timeout knobs now raise ValueError naming
+the offending variable (PR-1 convention) instead of flowing into the native
+layer unchecked.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.lint import CHECKERS, run_all  # noqa: E402
+from tools.lint.cabi import check_c_abi  # noqa: E402
+from tools.lint.envvars import check_env_registry  # noqa: E402
+from tools.lint.errcodes import check_error_codes  # noqa: E402
+from tools.lint.metricsreg import check_metric_registry  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# Live tree: every invariant must hold on the repository as committed.
+
+
+@pytest.mark.parametrize("name", sorted(CHECKERS))
+def test_live_tree_is_clean(name):
+    violations = CHECKERS[name](REPO)
+    assert violations == [], (
+        f"checker {name} found drift in the live tree:\n  " + "\n  ".join(violations)
+    )
+
+
+def test_run_all_covers_every_checker():
+    results = run_all(REPO)
+    assert set(results) == set(CHECKERS)
+
+
+# ---------------------------------------------------------------------------
+# Negative fixtures: each checker must fire on its seeded defect.
+
+
+def _write(root: Path, rel: str, content: str) -> None:
+    path = root / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(content))
+
+
+def test_env_checker_fires_on_unregistered_var(tmp_path):
+    _write(tmp_path, "cpp/src/x.cc", '''
+        #include "tpunet/utils.h"
+        uint64_t f() { return GetEnvU64("TPUNET_FAKE_KNOB", 1); }
+    ''')
+    _write(tmp_path, "tpunet/config.py", '''
+        # registry mentions only TPUNET_REAL_KNOB
+        REAL = "TPUNET_REAL_KNOB"
+    ''')
+    _write(tmp_path, "docs/DESIGN.md", "`TPUNET_REAL_KNOB` is documented here.\n")
+    violations = check_env_registry(tmp_path)
+    assert any("TPUNET_FAKE_KNOB" in v and "neither registered" in v for v in violations)
+    # ...and the same var is also flagged as undocumented.
+    assert any("TPUNET_FAKE_KNOB" in v and "docs" in v for v in violations)
+
+
+def test_env_checker_fires_on_undocumented_registered_var(tmp_path):
+    _write(tmp_path, "tpunet/config.py", 'KNOB = "TPUNET_DOCLESS_KNOB"\n')
+    _write(tmp_path, "docs/DESIGN.md", "nothing to see\n")
+    violations = check_env_registry(tmp_path)
+    assert any("TPUNET_DOCLESS_KNOB" in v and "docs" in v for v in violations)
+
+
+def test_env_checker_ignores_comment_mentions(tmp_path):
+    _write(tmp_path, "cpp/src/x.cc", '''
+        // A comment naming GetEnv("TPUNET_ONLY_IN_COMMENT") must not count
+        int f() { return 0; }
+    ''')
+    assert check_env_registry(tmp_path) == []
+
+
+_METRICS_FIXTURE = '''
+    #include "tpunet/telemetry.h"
+    void emit_all() {
+      family("tpunet_thing_total", "counter", "a thing");
+      family("tpunet_thing_total", "counter", "declared twice");
+      family("tpunet_widget", "gauge", "no unit suffix");
+      emit("tpunet_thing_total{rank=\\"%lld\\"} %llu\\n", rank, v);
+      emit("tpunet_thing_total{rank=\\"%lld\\",dir=\\"tx\\"} %llu\\n", rank, v);
+      emit("tpunet_ghost_total{rank=\\"%lld\\"} %llu\\n", rank, v);
+    }
+'''
+
+
+def test_metric_checker_fires_on_each_defect_class(tmp_path):
+    _write(tmp_path, "cpp/src/metrics.cc", _METRICS_FIXTURE)
+    _write(tmp_path, "tpunet/telemetry.py", 'NAME = "tpunet_missing_total"\n')
+    violations = check_metric_registry(tmp_path)
+    joined = "\n".join(violations)
+    assert "tpunet_thing_total is registered more than once" in joined
+    assert "tpunet_widget has no unit suffix" in joined
+    assert "tpunet_thing_total emits inconsistent label sets" in joined
+    assert "tpunet_ghost_total is emitted in metrics.cc but never registered" in joined
+    assert "tpunet_missing_total which does not exist" in joined
+
+
+def test_errcode_checker_fires_on_orphans_and_mismatch(tmp_path):
+    _write(tmp_path, "cpp/include/tpunet/c_api.h", '''
+        #define TPUNET_OK 0
+        #define TPUNET_ERR_INNER -3
+        #define TPUNET_ERR_FROB -7
+    ''')
+    _write(tmp_path, "tpunet/_native.py", '''
+        TPUNET_OK = 0
+        TPUNET_ERR_INNER = -99
+        TPUNET_ERR_PHANTOM = -42
+        _TYPED_ERRORS = {}
+    ''')
+    violations = check_error_codes(tmp_path)
+    joined = "\n".join(violations)
+    assert "TPUNET_ERR_FROB" in joined and "no constant" in joined      # C-only orphan
+    assert "TPUNET_ERR_PHANTOM" in joined and "not in" in joined        # Python-only orphan
+    assert "TPUNET_ERR_INNER value mismatch" in joined                  # value drift
+    assert "TPUNET_ERR_FROB" in joined and "typed exception" in joined  # missing typed class
+
+
+def test_cabi_checker_fires_on_missing_definition_and_binding(tmp_path):
+    _write(tmp_path, "cpp/include/tpunet/c_api.h", '''
+        int32_t tpunet_c_frobnicate(void);
+        int32_t tpunet_c_real(void);
+    ''')
+    _write(tmp_path, "cpp/src/c_api.cc", '''
+        int32_t tpunet_c_real(void) { return 0; }
+        int32_t tpunet_c_secret(void) { return 0; }
+    ''')
+    _write(tmp_path, "tpunet/_native.py", '''
+        lib.tpunet_c_real.argtypes = []
+        lib.tpunet_c_unbound_ghost.argtypes = []
+    ''')
+    violations = check_c_abi(tmp_path)
+    joined = "\n".join(violations)
+    assert "tpunet_c_frobnicate is declared in c_api.h but has no definition" in joined
+    assert "tpunet_c_secret is defined in cpp/src but not declared" in joined
+    assert "tpunet_c_frobnicate is declared in c_api.h but has no ctypes binding" in joined
+    assert "lib.tpunet_c_unbound_ghost" in joined
+
+
+def test_cabi_checker_does_not_mistake_calls_for_definitions(tmp_path):
+    _write(tmp_path, "cpp/include/tpunet/c_api.h", "int32_t tpunet_c_only_called(void);\n")
+    _write(tmp_path, "cpp/src/shim.cc", '''
+        void consumer() { (void)tpunet_c_only_called(); }
+    ''')
+    violations = check_c_abi(tmp_path)
+    assert any("tpunet_c_only_called is declared in c_api.h but has no definition" in v
+               for v in violations)
+
+
+# ---------------------------------------------------------------------------
+# Config.from_env validation for the vars the env checker surfaced as
+# previously unvalidated (same ValueError-naming-the-var convention as the
+# PR-1/PR-3 validators).
+
+
+def _from_env(**env):
+    from tpunet.config import Config
+
+    saved = {}
+    for k, v in env.items():
+        saved[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        return Config.from_env()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@pytest.mark.parametrize(
+    "var,bad",
+    [
+        ("TPUNET_TCPINFO_INTERVAL_MS", "-1"),
+        ("TPUNET_FAIRNESS_WINDOW_MS", "-5"),
+        ("TPUNET_STRAGGLER_FACTOR", "-2"),
+        ("TPUNET_STRAGGLER_MIN_RTT_US", "-1"),
+        ("TPUNET_METRICS_INTERVAL_MS", "0"),
+        ("TPUNET_HANDSHAKE_TIMEOUT_MS", "0"),
+        ("TPUNET_BOOTSTRAP_TIMEOUT_MS", "0"),
+        ("TPUNET_RING_CHUNKSIZE", "0"),
+        ("TPUNET_ASYNC_CHANNELS", "0"),
+    ],
+)
+def test_config_rejects_out_of_range_naming_the_var(var, bad):
+    with pytest.raises(ValueError, match=var):
+        _from_env(**{var: bad})
+
+
+def test_config_accepts_defaults_and_zero_disables():
+    cfg = _from_env(
+        TPUNET_TCPINFO_INTERVAL_MS="0",   # 0 = sampler off, legal
+        TPUNET_STRAGGLER_FACTOR="0",      # 0 = detector off, legal
+        TPUNET_DEBUG="1",
+        TPUNET_REDUCE_SIMD="0",
+        TPUNET_FFI_COLLECTIVES="0",
+    )
+    assert cfg.tcpinfo_interval_ms == 0
+    assert cfg.straggler_factor == 0
+    assert cfg.debug is True
+    assert cfg.reduce_simd is False
+    assert cfg.ffi_collectives is False
+
+
+def test_config_new_fields_defaults():
+    cfg = _from_env()
+    assert cfg.tcpinfo_interval_ms == 100
+    assert cfg.fairness_window_ms == 1000
+    assert cfg.straggler_factor == 3
+    assert cfg.straggler_min_rtt_us == 1000
+    assert cfg.metrics_interval_ms == 1000
+    assert cfg.handshake_timeout_ms == 10_000
+    assert cfg.bootstrap_timeout_ms == 120_000
+    assert cfg.debug is False
+    assert cfg.reduce_simd is True
+    assert cfg.ffi_collectives is True

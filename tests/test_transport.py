@@ -47,14 +47,18 @@ def _receiver_proc(conn, nstreams: int) -> None:
 
 
 @pytest.mark.parametrize("nstreams", [1, 2, 4])
-def test_loopback_sweep(nstreams):
+def test_loopback_sweep(nstreams, monkeypatch):
     ctx = mp.get_context("spawn")
     parent, child = ctx.Pipe()
     proc = ctx.Process(target=_receiver_proc, args=(child, nstreams))
     proc.start()
     try:
         handle = parent.recv()
-        os.environ["TPUNET_NSTREAMS"] = str(nstreams)
+        # monkeypatch (not a bare os.environ write): a leaked TPUNET_NSTREAMS
+        # shadows the BAGUA_NET_NSTREAMS fallback that test_chaos's config
+        # validation cases exercise — env hygiene IS the test contract here
+        # (caught by running the suites in non-alphabetical order).
+        monkeypatch.setenv("TPUNET_NSTREAMS", str(nstreams))
         from tpunet.transport import Net
 
         net = Net()

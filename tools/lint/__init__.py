@@ -1,0 +1,38 @@
+"""tpunet invariant lint suite — cross-layer registry checkers.
+
+The C++ core and the Python binding share several registries that nothing
+type-checks across the language boundary: the env-var inventory
+(``Config.from_env`` vs every ``GetEnv``/``os.environ`` read site), the
+Prometheus metric catalogue (``metrics.cc`` vs ``tpunet/telemetry.py``
+consumers), the error-code table (``c_api.h`` ``TPUNET_ERR_*`` vs the typed
+exceptions in ``tpunet/_native.py``), and the C ABI itself (declarations vs
+``extern "C"`` definitions vs ctypes bindings). Each has drifted silently in
+at least one real transport project; here drift is a red CI lane.
+
+Checkers are pure functions ``check_*(root: Path) -> list[str]`` returning
+human-readable violations (empty = clean), so tests can point them at tiny
+negative-fixture trees to prove each one actually fires
+(``tests/test_lint.py``). Run all four from the CLI with
+``python -m tools.lint``.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from tools.lint.cabi import check_c_abi
+from tools.lint.envvars import check_env_registry
+from tools.lint.errcodes import check_error_codes
+from tools.lint.metricsreg import check_metric_registry
+
+CHECKERS = {
+    "env-registry": check_env_registry,
+    "metric-registry": check_metric_registry,
+    "error-codes": check_error_codes,
+    "c-abi": check_c_abi,
+}
+
+
+def run_all(root: Path) -> dict[str, list[str]]:
+    """Run every checker against the tree at `root`; returns name->violations."""
+    return {name: checker(Path(root)) for name, checker in CHECKERS.items()}

@@ -1,0 +1,30 @@
+"""CLI entry: ``python -m tools.lint [root]`` — run all four invariant
+checkers; exit 1 if any violation is found (the CI analysis lane's gate)."""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+from tools.lint import run_all
+
+
+def main(argv: list[str]) -> int:
+    root = Path(argv[1]) if len(argv) > 1 else Path(__file__).resolve().parents[2]
+    results = run_all(root)
+    total = 0
+    for name, violations in results.items():
+        status = "ok" if not violations else f"{len(violations)} violation(s)"
+        print(f"[{name}] {status}")
+        for v in violations:
+            print(f"  - {v}")
+        total += len(violations)
+    if total:
+        print(f"\ntools.lint: {total} violation(s) across {sum(1 for v in results.values() if v)} checker(s)")
+        return 1
+    print("tools.lint: all invariants hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,0 +1,39 @@
+"""Shared helpers for the invariant lint checkers."""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Iterator
+
+_BLOCK_COMMENT = re.compile(r"/\*.*?\*/", re.S)
+_LINE_COMMENT = re.compile(r"//[^\n]*")
+
+
+def strip_c_comments(text: str) -> str:
+    """Remove /* */ and // comments, preserving line structure for /* */
+    so line numbers of surviving code stay meaningful."""
+    text = _BLOCK_COMMENT.sub(lambda m: "\n" * m.group(0).count("\n"), text)
+    return _LINE_COMMENT.sub("", text)
+
+
+def iter_files(root: Path, patterns: tuple[str, ...]) -> Iterator[Path]:
+    """Yield files under `root` matching any glob pattern, skipping caches
+    and build trees; tolerant of missing directories (negative fixtures are
+    tiny synthesized trees)."""
+    for pattern in patterns:
+        for path in sorted(root.glob(pattern)):
+            if "__pycache__" in path.parts or "build" in path.parts:
+                continue
+            if path.is_file():
+                yield path
+
+
+def read_text(path: Path) -> str:
+    return path.read_text(encoding="utf-8", errors="replace")
+
+
+def find_with_lines(text: str, regex: re.Pattern[str]) -> Iterator[tuple[str, int]]:
+    """Yield (first capture group, 1-based line number) for every match."""
+    for m in regex.finditer(text):
+        yield m.group(1), text.count("\n", 0, m.start()) + 1

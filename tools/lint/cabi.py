@@ -1,0 +1,77 @@
+"""C-ABI completeness checker.
+
+Three-way agreement over the stable ABI surface (``tpunet_c_*`` and
+``tpunet_comm_*``):
+
+1. Every symbol DECLARED in ``cpp/include/tpunet/c_api.h`` has an
+   ``extern "C"`` DEFINITION in some ``cpp/src/*.cc`` (a declared-but-
+   undefined symbol only explodes at dlopen/link time, far from the edit).
+2. Every such definition in ``cpp/src`` is declared in the header (no
+   undocumented ABI surface creeping in).
+3. Every declared symbol has a ctypes binding (``lib.<name>``) in
+   ``tpunet/_native.py`` — a missing binding is the drift that makes Python
+   crash with an AttributeError the first time a code path is exercised in
+   production rather than at import.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from tools.lint._util import iter_files, read_text, strip_c_comments
+
+_SYM = r"tpunet_(?:c|comm)_[a-z0-9_]+"
+_DECL = re.compile(rf"\b({_SYM})\s*\(")
+# A definition: symbol, argument list (no ; { } inside), then an opening
+# brace. Calls end with ');' and never match.
+_DEF_TEMPLATE = r"\b{name}\s*\([^;{{}}]*\)\s*\{{"
+
+
+def check_c_abi(root: Path) -> list[str]:
+    root = Path(root)
+    header = root / "cpp" / "include" / "tpunet" / "c_api.h"
+    native = root / "tpunet" / "_native.py"
+    violations: list[str] = []
+    if not header.is_file():
+        return ["cpp/include/tpunet/c_api.h not found — C ABI unverifiable"]
+
+    declared = set(_DECL.findall(strip_c_comments(read_text(header))))
+
+    src_texts = {
+        path: strip_c_comments(read_text(path))
+        for path in iter_files(root, ("cpp/src/*.cc",))
+    }
+    defined: set[str] = set()
+    for text in src_texts.values():
+        for name in set(_DECL.findall(text)):
+            if re.search(_DEF_TEMPLATE.format(name=re.escape(name)), text, re.S):
+                defined.add(name)
+
+    for name in sorted(declared - defined):
+        violations.append(
+            f"{name} is declared in c_api.h but has no definition in cpp/src/*.cc"
+        )
+    for name in sorted(defined - declared):
+        violations.append(
+            f"{name} is defined in cpp/src but not declared in c_api.h — "
+            f"undocumented ABI surface"
+        )
+
+    if native.is_file():
+        py_text = read_text(native)
+        bound = set(re.findall(rf"\blib\.({_SYM})", py_text)) | set(
+            re.findall(rf"\b_lib\.({_SYM})", py_text)
+        )
+        for name in sorted(declared - bound):
+            violations.append(
+                f"{name} is declared in c_api.h but has no ctypes binding "
+                f"(lib.{name}) in tpunet/_native.py"
+            )
+        for name in sorted(bound - declared):
+            violations.append(
+                f"tpunet/_native.py binds lib.{name} which is not declared in c_api.h"
+            )
+    else:
+        violations.append("tpunet/_native.py not found — ctypes bindings unverifiable")
+    return violations

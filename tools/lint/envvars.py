@@ -1,0 +1,99 @@
+"""Env-var registry checker.
+
+Invariants enforced over the tree:
+
+1. Every ``TPUNET_*`` env var READ anywhere in the C++ core
+   (``GetEnv``/``GetEnvU64``/``getenv``) or the ``tpunet`` Python package
+   (``os.environ.get`` / ``os.environ[...]`` / ``os.getenv``) must be
+   registered in ``tpunet/config.py`` — i.e. appear in ``Config.from_env``'s
+   inventory — or carry an explicit ALLOWLIST entry with a reason. An
+   unregistered read is exactly how the reference project accumulated knobs
+   nobody could enumerate (SURVEY §5).
+
+2. Every var in that surface (read sites ∪ registry ∪ allowlist) must be
+   mentioned in ``docs/*.md`` — an operator grepping the docs for a knob
+   they found in a traceback must land somewhere.
+
+``tpunet/config.py`` itself is the registry, so its own read sites don't
+count as consumers for invariant 1.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from tools.lint._util import find_with_lines, iter_files, read_text, strip_c_comments
+
+# Vars legitimately consumed outside the Config inventory; each entry needs
+# a reason AND (invariant 2) a docs/*.md mention like everything else.
+ALLOWLIST = {
+    # Load-time override for the ctypes loader: it selects WHICH libtpunet.so
+    # to dlopen, so it is consumed before any Config (or the library whose
+    # behavior Config describes) exists.
+    "TPUNET_LIBRARY_PATH": "pre-load .so path override, consumed before Config exists",
+}
+
+_CPP_READ = re.compile(r'(?:GetEnvU64|GetEnv|getenv)\(\s*"(TPUNET_[A-Z0-9_]+)"')
+_PY_READ = re.compile(
+    r'(?:os\.environ\.get|environ\.get|os\.environ\[|environ\[|os\.getenv)'
+    r'\(?\s*["\'](TPUNET_[A-Z0-9_]+)["\']'
+)
+_ANY_NAME = re.compile(r"TPUNET_[A-Z0-9_]+")
+
+_CPP_GLOBS = ("cpp/src/**/*.cc", "cpp/src/**/*.h", "cpp/include/**/*.h")
+_PY_GLOBS = ("tpunet/**/*.py",)
+
+
+def _read_sites(root: Path) -> dict[str, list[str]]:
+    sites: dict[str, list[str]] = {}
+    for path in iter_files(root, _CPP_GLOBS):
+        text = strip_c_comments(read_text(path))
+        for name, line in find_with_lines(text, _CPP_READ):
+            sites.setdefault(name, []).append(f"{path.relative_to(root)}:{line}")
+    for path in iter_files(root, _PY_GLOBS):
+        if path.name == "config.py" and path.parent.name == "tpunet":
+            continue  # the registry itself
+        for name, line in find_with_lines(read_text(path), _PY_READ):
+            sites.setdefault(name, []).append(f"{path.relative_to(root)}:{line}")
+    return sites
+
+
+def _registry(root: Path) -> set[str]:
+    config = root / "tpunet" / "config.py"
+    if not config.is_file():
+        return set()
+    return set(_ANY_NAME.findall(read_text(config)))
+
+
+def _doc_names(root: Path) -> set[str]:
+    names: set[str] = set()
+    for path in iter_files(root, ("docs/*.md",)):
+        names.update(_ANY_NAME.findall(read_text(path)))
+    return names
+
+
+def check_env_registry(root: Path) -> list[str]:
+    root = Path(root)
+    sites = _read_sites(root)
+    registry = _registry(root)
+    docs = _doc_names(root)
+    violations: list[str] = []
+    for name in sorted(sites):
+        if name not in registry and name not in ALLOWLIST:
+            where = ", ".join(sites[name][:3])
+            violations.append(
+                f"env var {name} is read at {where} but is neither registered in "
+                f"tpunet/config.py (Config.from_env) nor allowlisted in "
+                f"tools/lint/envvars.py"
+            )
+    # Doc coverage over the vars this TREE actually has (read or registered);
+    # allowlisted names are doc-checked through their read sites, so an
+    # allowlist entry unused by a (fixture) tree imposes nothing on it.
+    for name in sorted(set(sites) | registry):
+        if name not in docs:
+            violations.append(
+                f"env var {name} has no mention in docs/*.md (operators must be "
+                f"able to grep the docs for every knob)"
+            )
+    return violations

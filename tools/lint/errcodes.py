@@ -1,0 +1,77 @@
+"""Error-code bijection checker.
+
+The C ABI's ``TPUNET_OK`` / ``TPUNET_ERR_*`` codes (``cpp/include/tpunet/
+c_api.h``) and the Python constants + typed exceptions in
+``tpunet/_native.py`` must agree exactly:
+
+1. Same name set, same numeric values, both directions (an orphan on either
+   side means a failure class that one layer can raise and the other cannot
+   name).
+2. Every failure-model code (value <= -4, i.e. beyond the reference's
+   null/invalid/inner trio that maps to plain NativeError) has a typed
+   exception registered in ``_TYPED_ERRORS``, and that exception class is
+   actually defined in ``_native.py``.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from tools.lint._util import read_text, strip_c_comments
+
+_H_DEFINE = re.compile(r"#define\s+(TPUNET_(?:OK|ERR_[A-Z0-9_]+))\s+(-?\d+)")
+_PY_CONST = re.compile(r"^(TPUNET_(?:OK|ERR_[A-Z0-9_]+))\s*=\s*(-?\d+)", re.M)
+_TYPED_BLOCK = re.compile(r"_TYPED_ERRORS\s*=\s*\{(.*?)\}", re.S)
+_TYPED_ENTRY = re.compile(r"(TPUNET_ERR_[A-Z0-9_]+)\s*:\s*([A-Za-z_]\w*)")
+
+# Base codes whose Python surface is the untyped NativeError itself.
+_BASE_CODES = {"TPUNET_OK", "TPUNET_ERR_NULL", "TPUNET_ERR_INVALID", "TPUNET_ERR_INNER"}
+
+
+def check_error_codes(root: Path) -> list[str]:
+    root = Path(root)
+    header = root / "cpp" / "include" / "tpunet" / "c_api.h"
+    native = root / "tpunet" / "_native.py"
+    violations: list[str] = []
+    if not header.is_file() or not native.is_file():
+        return [f"missing {header.name if not header.is_file() else native.name} — "
+                f"error-code bijection unverifiable"]
+
+    h_codes = {name: int(v) for name, v in _H_DEFINE.findall(strip_c_comments(read_text(header)))}
+    py_text = read_text(native)
+    py_codes = {name: int(v) for name, v in _PY_CONST.findall(py_text)}
+
+    for name in sorted(set(h_codes) - set(py_codes)):
+        violations.append(
+            f"{name} (= {h_codes[name]}) is defined in c_api.h but has no constant "
+            f"in tpunet/_native.py"
+        )
+    for name in sorted(set(py_codes) - set(h_codes)):
+        violations.append(
+            f"{name} (= {py_codes[name]}) exists in tpunet/_native.py but not in "
+            f"c_api.h — Python names a code the ABI cannot return"
+        )
+    for name in sorted(set(h_codes) & set(py_codes)):
+        if h_codes[name] != py_codes[name]:
+            violations.append(
+                f"{name} value mismatch: c_api.h says {h_codes[name]}, "
+                f"_native.py says {py_codes[name]}"
+            )
+
+    typed_m = _TYPED_BLOCK.search(py_text)
+    typed = dict(_TYPED_ENTRY.findall(typed_m.group(1))) if typed_m else {}
+    for name, value in sorted(h_codes.items()):
+        if name in _BASE_CODES or value > -4:
+            continue
+        if name not in typed:
+            violations.append(
+                f"failure-model code {name} (= {value}) has no typed exception in "
+                f"_native.py _TYPED_ERRORS — it would surface as a bare NativeError"
+            )
+    for name, cls in sorted(typed.items()):
+        if name not in py_codes:
+            violations.append(f"_TYPED_ERRORS maps unknown code constant {name}")
+        if not re.search(rf"class\s+{cls}\s*\(", py_text):
+            violations.append(f"_TYPED_ERRORS names exception class {cls} which is not defined")
+    return violations
