@@ -135,6 +135,31 @@ class Config:
     # Deterministic fault to arm at engine creation (chaos testing), e.g.
     # "stream=1:after_bytes=1M:action=close". Empty = none.
     fault_spec: str = ""
+    # ---- Observability sampling/push cadence (docs/DESIGN.md §6c) --------
+    # TCP_INFO sample period per stream slot (0 = sampler off).
+    tcpinfo_interval_ms: int = 100
+    # Jain's-fairness byte-delta window.
+    fairness_window_ms: int = 1000
+    # Straggler threshold k over the median smoothed RTT (0 = detector off),
+    # and the RTT noise floor below which nothing counts as straggling.
+    straggler_factor: int = 3
+    straggler_min_rtt_us: int = 1000
+    # Pushgateway PUT period when TPUNET_METRICS_ADDR is set.
+    metrics_interval_ms: int = 1000
+    # ---- Wire/bootstrap deadlines (docs/DESIGN.md §1) --------------------
+    # Whole-preamble read deadline on accept (slow-loris defense); partial
+    # bundles expire after 2x this.
+    handshake_timeout_ms: int = 10_000
+    # Rendezvous connect/collect deadline at Communicator creation.
+    bootstrap_timeout_ms: int = 120_000
+    # ---- Debug / dispatch toggles ----------------------------------------
+    # Per-engine stderr event log (TPUNET_DEBUG=1).
+    debug: bool = False
+    # Runtime SIMD dispatch for the reduction kernels (0 forces scalar —
+    # bisection aid; the two paths are bitwise identical).
+    reduce_simd: bool = True
+    # XLA custom-call collectives (0 falls back to the io_callback bridge).
+    ffi_collectives: bool = True
 
     @staticmethod
     def from_env() -> "Config":
@@ -171,7 +196,11 @@ class Config:
                 maximum=65535,
             ),
             socket_bufsize=_env_int("TPUNET_SOCKET_BUFSIZE", 0),
-            ring_chunksize=_env_int("TPUNET_RING_CHUNKSIZE", 8 << 20),
+            # The native reader treats 0 as "use the default" silently; the
+            # config layer names the bad var instead (PR-1 validator style).
+            ring_chunksize=_env_int_checked(
+                ("TPUNET_RING_CHUNKSIZE",), 8 << 20, 1, "ring pipeline chunk size"
+            ),
             reduce_threads=_env_int_checked(
                 ("TPUNET_REDUCE_THREADS",), 0, 0, "reduce thread count"
             ),
@@ -187,7 +216,10 @@ class Config:
             connect_retry_ms=_env_int_checked(
                 ("TPUNET_CONNECT_RETRY_MS",), 10_000, 0, "connect retry window"
             ),
-            async_channels=_env_int("TPUNET_ASYNC_CHANNELS", 2),
+            # Native clamps to [1, 8]; numeric 0 is a config error here.
+            async_channels=_env_int_checked(
+                ("TPUNET_ASYNC_CHANNELS",), 2, 1, "async ring channel count", maximum=8
+            ),
             a2a=env.get("TPUNET_A2A", "pairwise"),
             a2a_mesh_max_world=_env_int("TPUNET_A2A_MESH_MAX_WORLD", 32),
             # Parsed to match the native consumer (GetEnvU64, default 1):
@@ -203,4 +235,35 @@ class Config:
                 ("TPUNET_PROGRESS_TIMEOUT_MS",), 0, 0, "progress watchdog window"
             ),
             fault_spec=env.get("TPUNET_FAULT_SPEC", ""),
+            # Observability cadence knobs (0 legitimately disables the
+            # sampler/detector; only negatives are config errors).
+            tcpinfo_interval_ms=_env_int_checked(
+                ("TPUNET_TCPINFO_INTERVAL_MS",), 100, 0, "TCP_INFO sample period"
+            ),
+            fairness_window_ms=_env_int_checked(
+                ("TPUNET_FAIRNESS_WINDOW_MS",), 1000, 0, "fairness byte window"
+            ),
+            straggler_factor=_env_int_checked(
+                ("TPUNET_STRAGGLER_FACTOR",), 3, 0, "straggler threshold factor"
+            ),
+            straggler_min_rtt_us=_env_int_checked(
+                ("TPUNET_STRAGGLER_MIN_RTT_US",), 1000, 0, "straggler RTT floor"
+            ),
+            metrics_interval_ms=_env_int_checked(
+                ("TPUNET_METRICS_INTERVAL_MS",), 1000, 1, "metrics push period"
+            ),
+            # Deadlines: 0 would make every handshake/bootstrap time out
+            # instantly — loud config error, not a silent wedge.
+            handshake_timeout_ms=_env_int_checked(
+                ("TPUNET_HANDSHAKE_TIMEOUT_MS",), 10_000, 1, "handshake deadline"
+            ),
+            bootstrap_timeout_ms=_env_int_checked(
+                ("TPUNET_BOOTSTRAP_TIMEOUT_MS",), 120_000, 1, "bootstrap deadline"
+            ),
+            debug=_env_int("TPUNET_DEBUG", 0) != 0,
+            # GetEnvU64 semantics (default 1): only a numeric 0 disables.
+            reduce_simd=_env_int("TPUNET_REDUCE_SIMD", 1) != 0,
+            # Matches the interop.py consumer: enabled iff the var is unset
+            # or exactly "1".
+            ffi_collectives=env.get("TPUNET_FFI_COLLECTIVES", "1") == "1",
         )
