@@ -64,6 +64,7 @@ def _busbw_factor(op: str, world: int) -> float:
 def _run_collective_rank(rank, world, coordinator, args, emit):
     import numpy as np
 
+    from tpunet import telemetry
     from tpunet.collectives import Communicator
 
     comm = Communicator(coordinator=coordinator, rank=rank, world_size=world)
@@ -98,11 +99,19 @@ def _run_collective_rank(rank, world, coordinator, args, emit):
         for _ in range(args.warmup):
             run()
         comm.barrier()
+        telemetry.reset()  # codec counters cover exactly the timed window
         t0 = time.perf_counter()
         for _ in range(iters):
             out = run()
         comm.barrier()
         dt = (time.perf_counter() - t0) / iters
+        # Wire-compression ratio over the timed window, straight from the
+        # native counters (tpunet_codec_wire_ratio = encoded/payload bytes;
+        # 1.0 on the f32 lane) — the noise-immune number BENCH json records
+        # next to GB/s.
+        m = telemetry.metrics()
+        wire_ratio = next(
+            iter(m.get("tpunet_codec_wire_ratio", {}).values()), 1.0)
         if args.op == "allreduce":
             expect = sum(r + 1 for r in range(world))
             assert out[0] == expect, f"bad allreduce result {out[0]} != {expect}"
@@ -111,7 +120,7 @@ def _run_collective_rank(rank, world, coordinator, args, emit):
                 expect = float(j * world + rank)
                 assert out[j][0] == expect, \
                     f"bad alltoall block {j} at rank {rank}: {out[j][0]} != {expect}"
-        rows.append((count * 4, count, dt))
+        rows.append((count * 4, count, dt, wire_ratio))
     comm.close()
     if rank == 0:
         emit(rows, world)
@@ -170,43 +179,59 @@ def _run_p2p_rank(rank, world, coordinator, args, emit):
         emit(rows, world)
 
 
-def make_table_emitter(op: str, nstreams=None, engine=None, json_path: str = ""):
+def make_table_emitter(op: str, nstreams=None, engine=None, json_path: str = "",
+                       wire_dtype=None):
     """Shared all_reduce_perf-style table emitter (also used by psum_sweep,
-    keeping the two sweeps' output directly comparable). nstreams/engine
-    default to the env the workers ran with."""
+    keeping the two sweeps' output directly comparable). nstreams/engine/
+    wire_dtype default to the env the workers ran with. Rows may carry a
+    4th element — wire_bytes_per_payload_byte from the codec counters —
+    which is printed and recorded when present (psum_sweep's 3-tuples keep
+    working)."""
     if nstreams is None:
         nstreams = os.environ.get("TPUNET_NSTREAMS", "2")
     if engine is None:
         engine = os.environ.get("TPUNET_IMPLEMENT", "BASIC")
+    if wire_dtype is None:
+        wire_dtype = os.environ.get("TPUNET_WIRE_DTYPE", "f32")
 
     def emit(rows, world):
         factor = _busbw_factor(op, world)
         print(f"# tpunet {op} sweep  world={world} "
-              f"nstreams={nstreams} engine={engine}")
+              f"nstreams={nstreams} engine={engine} wire_dtype={wire_dtype}")
         print(f"# {'size':>12} {'count':>12} {'time(us)':>12} "
-              f"{'algbw(GB/s)':>12} {'busbw(GB/s)':>12}")
+              f"{'algbw(GB/s)':>12} {'busbw(GB/s)':>12} {'wireB/B':>8}")
         out = []
-        for nbytes, count, dt in rows:
+        for row in rows:
+            nbytes, count, dt = row[:3]
+            wire_ratio = row[3] if len(row) > 3 else None
             algbw = nbytes / dt / 1e9
             busbw = algbw * factor
             print(f"  {nbytes:>12} {count:>12} {dt * 1e6:>12.1f} "
-                  f"{algbw:>12.3f} {busbw:>12.3f}")
-            out.append({"bytes": nbytes, "time_us": dt * 1e6,
-                        "algbw_gbps": algbw, "busbw_gbps": busbw})
+                  f"{algbw:>12.3f} {busbw:>12.3f} "
+                  f"{'' if wire_ratio is None else format(wire_ratio, '8.3f')}")
+            entry = {"bytes": nbytes, "time_us": dt * 1e6,
+                     "algbw_gbps": algbw, "busbw_gbps": busbw}
+            if wire_ratio is not None:
+                entry["wire_bytes_per_payload_byte"] = wire_ratio
+            out.append(entry)
         if json_path:
             with open(json_path, "w") as f:
-                json.dump({"op": op, "world": world, "rows": out}, f)
+                json.dump({"op": op, "world": world,
+                           "wire_dtype": wire_dtype, "rows": out}, f)
     return emit
 
 
 def _emit_table(args):
-    return make_table_emitter(args.op, json_path=args.json)
+    return make_table_emitter(args.op, json_path=args.json,
+                              wire_dtype=getattr(args, "wire_dtype", "") or None)
 
 
 def _worker(rank, world, port, q, args):
     try:
         if args.nstreams:
             os.environ["TPUNET_NSTREAMS"] = str(args.nstreams)
+        if args.wire_dtype:
+            os.environ["TPUNET_WIRE_DTYPE"] = args.wire_dtype
         run = _run_p2p_rank if args.op == "p2p" else _run_collective_rank
         run(rank, world, f"127.0.0.1:{port}", args, _emit_table(args))
         q.put((rank, "OK"))
@@ -226,6 +251,11 @@ def main() -> None:
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--warmup", type=int, default=1)
     ap.add_argument("--nstreams", type=int, default=0, help="override TPUNET_NSTREAMS")
+    ap.add_argument("--wire-dtype", dest="wire_dtype", default="",
+                    choices=["", "f32", "bf16", "int8"],
+                    help="collective wire codec lane (sets TPUNET_WIRE_DTYPE "
+                         "in the workers; BENCH json records the measured "
+                         "wire_bytes_per_payload_byte from the codec counters)")
     ap.add_argument("--json", default="", help="also dump rows to this file")
     ap.add_argument("--external", action="store_true",
                     help="run as one rank; rank/world/coordinator from env")
@@ -236,6 +266,8 @@ def main() -> None:
     _native.build_native()
 
     if args.external:
+        if args.wire_dtype:
+            os.environ["TPUNET_WIRE_DTYPE"] = args.wire_dtype
         rank = int(os.environ.get("TPUNET_RANK", os.environ.get("RANK", "0")))
         world = int(os.environ.get("TPUNET_WORLD_SIZE", os.environ.get("WORLD_SIZE", "1")))
         coord = os.environ.get("TPUNET_COORDINATOR", "127.0.0.1:29500")

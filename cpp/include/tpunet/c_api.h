@@ -39,6 +39,11 @@ extern "C" {
 #define TPUNET_ERR_TIMEOUT -5
 /* peer speaks a different tpunet wire-framing version. */
 #define TPUNET_ERR_VERSION -6
+/* collective wire-codec mismatch (TPUNET_WIRE_DTYPE / wire_dtype): the
+ * ranks of a group disagree on the f32 wire compression codec. Raised at
+ * communicator wiring time by the codec handshake on EVERY rank, before any
+ * payload could be mis-decoded. */
+#define TPUNET_ERR_CODEC -7
 
 /* 64-byte opaque rendezvous blob: the serialized listen sockaddr, sized to
  * NCCL's handle budget (reference: cc/nccl_types.h:44). Ship it to the
@@ -115,6 +120,21 @@ uint32_t tpunet_c_crc32c(const void* data, uint64_t nbytes, uint32_t seed);
  * n > 0. */
 int32_t tpunet_c_reduce(void* dst, const void* a, const void* b, uint64_t n,
                         int32_t dtype, int32_t op);
+/* ---- Wire codecs (compressed ring collectives) -------------------------
+ * The encode/decode kernels the ring runs at every compressed wire hop
+ * (codec: 0=f32 passthrough, 1=bf16 RNE, 2=int8 block-scaled — see
+ * docs/DESIGN.md "Compressed collectives"), exposed so Python golden tests
+ * can pin the wire format and the documented int8 error bound without a
+ * socket in sight. n counts f32 ELEMENTS. */
+/* Encoded byte count for n f32 elements (0 for an unknown codec). */
+uint64_t tpunet_c_codec_wire_bytes(int32_t codec, uint64_t n);
+/* Encode n f32 elements from src into dst (dst_cap must be >= the wire
+ * byte count; TPUNET_ERR_INVALID otherwise). */
+int32_t tpunet_c_codec_encode(int32_t codec, const void* src, uint64_t n,
+                              void* dst, uint64_t dst_cap);
+/* Decode a wire buffer of n encoded f32 elements into dst (n floats). */
+int32_t tpunet_c_codec_decode(int32_t codec, const void* wire, uint64_t n,
+                              void* dst);
 
 /* ---- Collectives (ring communicator over the transport) ----------------
  * The layer NCCL provided above the reference plugin (SURVEY §2.3); here it
@@ -125,6 +145,16 @@ int32_t tpunet_c_reduce(void* dst, const void* a, const void* b, uint64_t n,
  * must call the same collectives in the same order. */
 int32_t tpunet_comm_create(const char* coordinator, int32_t rank, int32_t world_size,
                            uintptr_t* comm);
+/* As tpunet_comm_create, selecting the wire compression codec for f32
+ * collectives: wire_dtype in {"f32","bf16","int8"}; NULL or "" defers to
+ * TPUNET_WIRE_DTYPE (default f32). Unknown names are TPUNET_ERR_INVALID; a
+ * cross-rank disagreement fails wiring with TPUNET_ERR_CODEC on every rank
+ * (docs/DESIGN.md "Compressed collectives"). */
+int32_t tpunet_comm_create_ex(const char* coordinator, int32_t rank,
+                              int32_t world_size, const char* wire_dtype,
+                              uintptr_t* comm);
+/* Negotiated wire codec of a live communicator: 0=f32, 1=bf16, 2=int8. */
+int32_t tpunet_comm_wire_dtype(uintptr_t comm, int32_t* wire_dtype);
 /* Process-default communicator for callers that cannot thread a handle —
  * the XLA FFI custom-call collectives look it up at CALL time so elastic
  * recovery can re-point it under already-compiled executables. set(0)

@@ -48,6 +48,15 @@ class Communicator {
   // and world_size. Owns its own transport engine instance.
   static Status Create(const std::string& coordinator, int rank, int world_size,
                        std::unique_ptr<Communicator>* out);
+  // As above, selecting the wire compression codec for f32 collectives
+  // ("f32" / "bf16" / "int8"; empty = TPUNET_WIRE_DTYPE, default f32 — see
+  // docs/DESIGN.md "Compressed collectives"). The codec is negotiated over
+  // the bootstrap at wiring time: ranks that disagree ALL fail with
+  // ErrorKind::kCodec before any payload could be mis-decoded. Unknown
+  // names are kInvalidArgument.
+  static Status Create(const std::string& coordinator, int rank, int world_size,
+                       const std::string& wire_dtype,
+                       std::unique_ptr<Communicator>* out);
 
   // sendbuf may equal recvbuf (in-place). count = elements. Blocking
   // AllReduce is exactly IAllReduce+WaitTicket (MPI/NCCL matching rule:
@@ -108,6 +117,10 @@ class Communicator {
 
   virtual int rank() const = 0;
   virtual int world_size() const = 0;
+  // Negotiated wire codec: 0 = f32 (uncompressed), 1 = bf16, 2 = int8 —
+  // WireCodec values (utils.h). The trainer reads this to route
+  // grad_compression through the wire instead of double-casting.
+  virtual int32_t wire_codec() const = 0;
 };
 
 }  // namespace tpunet

@@ -33,6 +33,10 @@ namespace tpunet {
 //     classified upstream like a dead one (elastic rebuild).
 //   kVersion — the peer speaks a different tpunet wire framing version
 //     (preamble magic prefix matched, version byte did not).
+//   kCodec — the ranks of a collective group disagree on the wire
+//     compression codec (TPUNET_WIRE_DTYPE / wire_dtype); raised at
+//     communicator wiring time by the codec-byte handshake, before any
+//     data could be mis-decoded (docs/DESIGN.md "Compressed collectives").
 enum class ErrorKind : int32_t {
   kOk = 0,
   kIOError = 1,
@@ -42,6 +46,7 @@ enum class ErrorKind : int32_t {
   kCorruption = 5,
   kTimeout = 6,
   kVersion = 7,
+  kCodec = 8,
 };
 
 struct Status {
@@ -57,6 +62,7 @@ struct Status {
   static Status Corruption(std::string m) { return Status{ErrorKind::kCorruption, std::move(m)}; }
   static Status Timeout(std::string m) { return Status{ErrorKind::kTimeout, std::move(m)}; }
   static Status Version(std::string m) { return Status{ErrorKind::kVersion, std::move(m)}; }
+  static Status Codec(std::string m) { return Status{ErrorKind::kCodec, std::move(m)}; }
 };
 
 // Reference: interface.rs:13-22 NCCLNetProperties.

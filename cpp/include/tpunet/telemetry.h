@@ -115,6 +115,13 @@ struct MetricsSnapshot {
   // cannot noise out the way it noises GB/s.
   uint64_t engine_syscalls[4] = {0};
   uint64_t reduce_bytes = 0;
+  // Compressed-collectives accounting (docs/DESIGN.md "Compressed
+  // collectives"): encoded bytes per codec and direction, plus the f32
+  // payload bytes the encoded forms stood in for. The wire-compression
+  // ratio (tpunet_codec_wire_ratio) is encoded/payload — the noise-immune
+  // proof that bf16 halved (int8: quartered) the ring's DCN bytes.
+  uint64_t codec_bytes[2][2] = {{0, 0}, {0, 0}};  // [bf16,int8][tx,rx]
+  uint64_t codec_payload_bytes[2] = {0, 0};       // [tx,rx]
   double uptime_s = 0;          // for bytes/s derivation
 };
 

@@ -111,6 +111,61 @@ void ReduceInto(void* dst, const void* a, const void* b, size_t n,
 uint64_t ReduceBytesTotal();
 void ResetReduceBytesTotal();
 
+// ---- Wire codecs (compressed ring collectives) ----------------------------
+// On-the-wire compression for f32 collective payloads (docs/DESIGN.md
+// "Compressed collectives"): the ring encodes each chunk right before isend
+// and runs a fused decode+reduce right after irecv, so the ACCUMULATOR stays
+// f32 and quantization error enters only at wire hops (EQuARX-style), never
+// compounds in the running sum. Two codecs:
+//   kBF16 — truncate-with-RNE to bfloat16 (the SAME integer
+//     round-to-nearest-even arithmetic as the bf16 reduce kernels, so the
+//     wire values are bit-identical to a bf16 cast); 2 bytes/element.
+//   kI8 — block-scaled int8: per kI8CodecBlock(=256)-element block, one f32
+//     scale amax/127 followed by the rounded int8 quotients. Max elementwise
+//     error per wire hop is amax_block/254 (half a quantization step; see
+//     DESIGN.md for the derivation). n + 4*ceil(n/256) bytes.
+// Dispatch is runtime like ReduceInto: AVX2 bf16 lanes when the CPU has them
+// (gated by the same TPUNET_REDUCE_SIMD=0 bisection switch), scalar
+// otherwise — bitwise identical either way. Every encode/decode call feeds
+// the tpunet_codec_bytes_total{codec,dir} counters plus the payload-byte
+// totals behind the tpunet_codec_wire_ratio gauge.
+enum class WireCodec : uint8_t { kF32 = 0, kBF16 = 1, kI8 = 2 };
+constexpr int kWireCodecCount = 3;
+constexpr size_t kI8CodecBlock = 256;  // elements per int8 scale block
+
+// "f32" / "bf16" / "int8" <-> WireCodec. Parse returns false on unknown.
+bool ParseWireCodec(const std::string& name, WireCodec* out);
+const char* WireCodecName(WireCodec c);
+
+// Encoded byte count for n f32 elements (n*4 for kF32 passthrough).
+size_t CodecWireBytes(WireCodec c, size_t n);
+// Encode n f32 elements into dst (CodecWireBytes(c, n) bytes).
+void CodecEncode(WireCodec c, const float* src, uint8_t* dst, size_t n);
+// Decode a wire buffer back to n f32 elements.
+void CodecDecode(WireCodec c, const uint8_t* wire, float* dst, size_t n);
+// Fused decode+reduce: dst[i] = local[i] op decode(wire)[i], all f32.
+// local == nullptr means dst itself (in-place accumulate).
+void CodecDecodeReduce(WireCodec c, float* dst, const float* local,
+                       const uint8_t* wire, size_t n, WireRedOp op);
+// Fused decode+reduce+re-encode for the ring's RS->AG handoff:
+//   t       = local op decode(wire)        (f32 accumulate, as above)
+//   enc_out = encode(t)                    (the AG phase's step-0 send)
+//   dst     = decode(encode(t))            (the QUANTIZED accumulator)
+// dst holds the decode of what peers will receive, so every rank
+// materializes bit-identical slice values without the AG phase paying a
+// separate encode + decode pass over the slice (that pair measured ~1/3 of
+// the whole compressed-allreduce overhead). local == nullptr means dst.
+void CodecDecodeReduceQuantize(WireCodec c, float* dst, const float* local,
+                               const uint8_t* wire, uint8_t* enc_out,
+                               size_t n, WireRedOp op);
+
+// Counters behind tpunet_codec_bytes_total{codec,dir} and the
+// tpunet_codec_wire_ratio gauge. dir: 0 = tx (encode), 1 = rx (decode).
+// Payload totals count the f32 bytes the encoded form stands in for.
+uint64_t CodecBytesTotal(WireCodec c, int dir);
+uint64_t CodecPayloadBytesTotal(int dir);
+void ResetCodecBytesTotals();
+
 // Growable 64-byte-aligned scratch that never zero-fills: reserve() grows
 // capacity WITHOUT initializing or preserving contents (it is a landing
 // buffer for wire bytes / reduce partials — std::vector::resize would pay an

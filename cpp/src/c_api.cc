@@ -42,6 +42,8 @@ int32_t FromStatus(const Status& s) {
       return Fail(TPUNET_ERR_TIMEOUT, s.msg);
     case tpunet::ErrorKind::kVersion:
       return Fail(TPUNET_ERR_VERSION, s.msg);
+    case tpunet::ErrorKind::kCodec:
+      return Fail(TPUNET_ERR_CODEC, s.msg);
     default:
       return Fail(TPUNET_ERR_INNER, s.msg);
   }
@@ -272,6 +274,43 @@ int32_t tpunet_c_reduce(void* dst, const void* a, const void* b, uint64_t n,
   return TPUNET_OK;
 }
 
+uint64_t tpunet_c_codec_wire_bytes(int32_t codec, uint64_t n) {
+  if (codec < 0 || codec >= tpunet::kWireCodecCount) return 0;
+  return tpunet::CodecWireBytes(static_cast<tpunet::WireCodec>(codec),
+                                static_cast<size_t>(n));
+}
+
+int32_t tpunet_c_codec_encode(int32_t codec, const void* src, uint64_t n,
+                              void* dst, uint64_t dst_cap) {
+  if (codec < 0 || codec >= tpunet::kWireCodecCount) {
+    return Fail(TPUNET_ERR_INVALID, "bad codec");
+  }
+  if (n > 0 && (src == nullptr || dst == nullptr)) {
+    return Fail(TPUNET_ERR_NULL, "null buffer with n > 0");
+  }
+  auto c = static_cast<tpunet::WireCodec>(codec);
+  if (dst_cap < tpunet::CodecWireBytes(c, static_cast<size_t>(n))) {
+    return Fail(TPUNET_ERR_INVALID, "dst_cap smaller than the encoded size");
+  }
+  tpunet::CodecEncode(c, static_cast<const float*>(src),
+                      static_cast<uint8_t*>(dst), static_cast<size_t>(n));
+  return TPUNET_OK;
+}
+
+int32_t tpunet_c_codec_decode(int32_t codec, const void* wire, uint64_t n,
+                              void* dst) {
+  if (codec < 0 || codec >= tpunet::kWireCodecCount) {
+    return Fail(TPUNET_ERR_INVALID, "bad codec");
+  }
+  if (n > 0 && (wire == nullptr || dst == nullptr)) {
+    return Fail(TPUNET_ERR_NULL, "null buffer with n > 0");
+  }
+  tpunet::CodecDecode(static_cast<tpunet::WireCodec>(codec),
+                      static_cast<const uint8_t*>(wire),
+                      static_cast<float*>(dst), static_cast<size_t>(n));
+  return TPUNET_OK;
+}
+
 }  // extern "C"
 
 // ---- Collectives ABI ------------------------------------------------------
@@ -303,13 +342,28 @@ extern "C" {
 
 int32_t tpunet_comm_create(const char* coordinator, int32_t rank, int32_t world_size,
                            uintptr_t* comm) {
+  return tpunet_comm_create_ex(coordinator, rank, world_size, nullptr, comm);
+}
+
+int32_t tpunet_comm_create_ex(const char* coordinator, int32_t rank,
+                              int32_t world_size, const char* wire_dtype,
+                              uintptr_t* comm) {
   if (!coordinator || !comm) return Fail(TPUNET_ERR_NULL, "null param");
   std::unique_ptr<tpunet::Communicator> c;
-  Status s = tpunet::Communicator::Create(coordinator, rank, world_size, &c);
+  Status s = tpunet::Communicator::Create(coordinator, rank, world_size,
+                                          wire_dtype ? wire_dtype : "", &c);
   if (!s.ok()) return FromStatus(s);
   uint64_t id = g_next_comm_id.fetch_add(1);
   g_comms.Put(id, std::shared_ptr<tpunet::Communicator>(std::move(c)));
   *comm = id;
+  return TPUNET_OK;
+}
+
+int32_t tpunet_comm_wire_dtype(uintptr_t comm, int32_t* wire_dtype) {
+  if (!wire_dtype) return Fail(TPUNET_ERR_NULL, "wire_dtype is null");
+  auto c = GetComm(comm);
+  if (!c) return Fail(TPUNET_ERR_INVALID, "unknown comm");
+  *wire_dtype = c->wire_codec();
   return TPUNET_OK;
 }
 

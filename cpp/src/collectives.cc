@@ -157,7 +157,8 @@ class RingCommunicator : public Communicator {
     ScratchBuf scratch;  // chunk landing slots; aligned, never zero-filled
   };
 
-  RingCommunicator(int rank, int world) : rank_(rank), world_(world) {}
+  RingCommunicator(int rank, int world, WireCodec codec)
+      : rank_(rank), world_(world), codec_(codec) {}
 
   ~RingCommunicator() override {
     StopAsyncWorker();
@@ -190,6 +191,28 @@ class RingCommunicator : public Communicator {
     if (world_ == 1) {
       bootstrap_.reset();
       return Status::Ok();
+    }
+
+    // Wire-codec negotiation, piggybacked on the bootstrap ctrl plane the
+    // wiring already rides: one 1-byte AllGather round. Every rank compares
+    // the full vector, so ALL ranks fail identically (kCodec) on a mismatch
+    // — before any ring comm exists that could mis-decode a payload.
+    uint8_t my_codec = static_cast<uint8_t>(codec_);
+    std::vector<uint8_t> codecs;
+    s = bootstrap_->AllGather(&my_codec, 1, &codecs);
+    if (!s.ok()) return s;
+    for (int r = 0; r < world_; ++r) {
+      if (codecs[r] != my_codec) {
+        std::string theirs =
+            codecs[r] < kWireCodecCount
+                ? std::string(WireCodecName(static_cast<WireCodec>(codecs[r])))
+                : "#" + std::to_string(codecs[r]);
+        return Status::Codec(
+            "wire codec mismatch: rank " + std::to_string(rank_) + " uses " +
+            WireCodecName(codec_) + " but rank " + std::to_string(r) + " uses " +
+            theirs +
+            " (set TPUNET_WIRE_DTYPE / wire_dtype identically on every rank)");
+      }
     }
 
     SocketHandle handle;
@@ -295,6 +318,17 @@ class RingCommunicator : public Communicator {
     // vr relabels the ring so this rank finishes the RS phase owning slice
     // `rank`, which the AG phase then circulates.
     const int vr = (rank_ + W - 1) % W;
+    const bool codec_on = UseCodec(dtype);
+    size_t ag_slot = 0;
+    if (codec_on) {
+      // Park the AG phase's two wire slots at the BOTTOM of the channel
+      // scratch, before any RS chunk slot: the RS final round's fused
+      // handoff writes the owned slice's encoded bytes into AG slot 0, and
+      // they must survive the RS rounds' own scratch use.
+      ag_slot = CodecWireBytes(codec_, (count + W - 1) / W);
+      ch.scratch.reserve(2 * ag_slot +
+                         4 * CodecWireBytes(codec_, CodecChunkElems()));
+    }
     for (int s = 0; s < W - 1; ++s) {
       int sidx = (vr - s + W) % W;
       int ridx = (vr - s - 1 + W) % W;
@@ -305,10 +339,24 @@ class RingCommunicator : public Communicator {
       const uint8_t* sptr =
           ((oop && s == 0) ? src : data) + off(sidx) * esize;
       PhaseSpan step(tracing, trace_comm_id_, seq, "rs", s, sbytes);
-      Status st = ExchangeReduce(sptr, sbytes, data + off(ridx) * esize,
-                                 rbytes, dtype, op, ch,
-                                 oop ? src + off(ridx) * esize : nullptr);
+      Status st;
+      if (codec_on) {
+        // Final round reduces into this rank's owned slice (ridx == rank_):
+        // fuse the AG-entry quantize+encode into it.
+        uint8_t* fused = (s == W - 2) ? ch.scratch.data() : nullptr;
+        st = ExchangeReduceCodec(sptr, sbytes, data + off(ridx) * esize,
+                                 rbytes, op, ch,
+                                 oop ? src + off(ridx) * esize : nullptr,
+                                 fused, 2 * ag_slot);
+      } else {
+        st = ExchangeReduce(sptr, sbytes, data + off(ridx) * esize,
+                            rbytes, dtype, op, ch,
+                            oop ? src + off(ridx) * esize : nullptr);
+      }
       if (!st.ok()) return st;
+    }
+    if (codec_on) {
+      return AgPhaseCodec(reinterpret_cast<float*>(data), count, ch, seq, tracing);
     }
     for (int s = 0; s < W - 1; ++s) {
       int sidx = (rank_ - s + W) % W;
@@ -721,8 +769,18 @@ class RingCommunicator : public Communicator {
 
   int rank() const override { return rank_; }
   int world_size() const override { return world_; }
+  int32_t wire_codec() const override { return static_cast<int32_t>(codec_); }
 
  private:
+  // The codec engages only where elements are KNOWN f32: AllReduce /
+  // ReduceScatter payloads and the AG phase inside AllReduce. The
+  // byte-oriented collectives (AllGather, Broadcast, AllToAll,
+  // NeighborExchange, Barrier) carry opaque bytes — rendezvous handles,
+  // tokens, arbitrary dtypes — and are never lossily compressed
+  // (docs/DESIGN.md "Compressed collectives").
+  bool UseCodec(DType dtype) const {
+    return codec_ != WireCodec::kF32 && dtype == DType::kF32 && world_ > 1;
+  }
   // One pipelined reduce ring step: send `sendbuf` to next while receiving
   // the same-size slice from prev in chunks, folding each received chunk
   // into `accum` (element count = slice bytes / esize) as soon as it lands —
@@ -736,6 +794,10 @@ class RingCommunicator : public Communicator {
                         size_t recv_nbytes, DType dtype, RedOp op, RingChannel& ch,
                         const uint8_t* local = nullptr) {
     if (local == nullptr) local = accum;
+    if (UseCodec(dtype)) {
+      return ExchangeReduceCodec(sendbuf, send_nbytes, accum, recv_nbytes, op,
+                                 ch, local);
+    }
     size_t esize = DTypeSize(dtype);
     size_t chunk = RingChunkBytes() / esize * esize;
     if (chunk == 0 || (send_nbytes <= chunk && recv_nbytes <= chunk)) {
@@ -811,6 +873,209 @@ class RingCommunicator : public Communicator {
         slive[slot] = false;
         if (!st.ok()) return quiesce(st);
       }
+    }
+    return Status::Ok();
+  }
+
+  // Codec variant of ExchangeReduce for f32 payloads (docs/DESIGN.md
+  // "Compressed collectives"): each chunk is ENCODED into a scratch slot
+  // right before its isend and runs a FUSED decode+reduce straight off the
+  // recv slot — the accumulator (and the local operand) stay f32, so
+  // quantization error enters once per wire hop and never compounds in the
+  // running sum. Chunk boundaries are computed over ELEMENT counts exactly
+  // like the uncompressed path, so both peers derive identical per-chunk
+  // wire sizes from their own payload byte counts; a rank disagreement
+  // surfaces as the same size-mismatch error. Double-buffered recv AND send
+  // slots (the encode is a staging copy the zero-copy f32 path avoids —
+  // that copy is the price of shipping half/quarter the bytes).
+  // Payload elements per pipeline chunk, sized so the WIRE chunk — not the
+  // payload chunk — lands on the tuned TPUNET_RING_CHUNKSIZE granularity:
+  // the ring's per-chunk costs (ctrl frames, request churn, stream
+  // scheduling) are paid per chunk regardless of its size, so a compressed
+  // chunk must carry as many wire bytes as an uncompressed one or
+  // compression halves the bytes but none of the per-chunk overhead
+  // (measured: payload-sized bf16 chunks left the whole RS phase at f32
+  // speed). int8 chunks stay multiples of the scale block so the per-chunk
+  // encoding is byte-identical to a whole-slice encode (the fused RS->AG
+  // handoff and the AG receiver both rely on that).
+  size_t CodecChunkElems() const {
+    size_t ce;
+    switch (codec_) {
+      case WireCodec::kBF16:
+        ce = RingChunkBytes() / 2;  // 2 wire bytes per element
+        break;
+      case WireCodec::kI8:
+        ce = RingChunkBytes() & ~(kI8CodecBlock - 1);  // ~1 wire byte/element
+        if (ce < kI8CodecBlock) ce = kI8CodecBlock;
+        break;
+      default:
+        ce = RingChunkBytes() / 4;
+        break;
+    }
+    return std::max<size_t>(ce, 1);
+  }
+
+  // `fused_enc` (optional): run the RS->AG handoff kernel on every received
+  // chunk — the accumulator comes out QUANTIZED (bit-identical to what peers
+  // will decode) and its encoded form lands at fused_enc, laid out exactly
+  // like a whole-slice encode, ready to be the AG phase's first send.
+  // `scratch_off`: byte offset into ch.scratch below which the caller has
+  // staged bytes this call must not clobber.
+  Status ExchangeReduceCodec(const uint8_t* sendbuf, size_t send_nbytes,
+                             uint8_t* accum, size_t recv_nbytes, RedOp op,
+                             RingChannel& ch, const uint8_t* local,
+                             uint8_t* fused_enc = nullptr,
+                             size_t scratch_off = 0) {
+    if (local == nullptr) local = accum;  // classic in-place accumulate
+    const float* send_f = reinterpret_cast<const float*>(sendbuf);
+    float* acc_f = reinterpret_cast<float*>(accum);
+    const float* loc_f = reinterpret_cast<const float*>(local);
+    const WireRedOp wop = ToWireRedOp(op);
+    const size_t send_n = send_nbytes / 4;
+    const size_t recv_n = recv_nbytes / 4;
+    const size_t chunk_elems = CodecChunkElems();
+
+    if (send_n <= chunk_elems && recv_n <= chunk_elems) {
+      size_t rw = CodecWireBytes(codec_, recv_n);
+      size_t sw = CodecWireBytes(codec_, send_n);
+      ch.scratch.reserve(scratch_off + rw + sw);
+      uint8_t* rbuf = ch.scratch.data() + scratch_off;
+      uint8_t* sbuf = rbuf + rw;
+      CodecEncode(codec_, send_f, sbuf, send_n);
+      Status st = Exchange(sbuf, sw, rbuf, rw, nullptr, ch);
+      if (!st.ok()) return st;
+      if (fused_enc != nullptr) {
+        CodecDecodeReduceQuantize(codec_, acc_f, loc_f, rbuf, fused_enc, recv_n, wop);
+      } else {
+        CodecDecodeReduce(codec_, acc_f, loc_f, rbuf, recv_n, wop);
+      }
+      return Status::Ok();
+    }
+
+    const size_t ns = (send_n + chunk_elems - 1) / chunk_elems;
+    const size_t nr = (recv_n + chunk_elems - 1) / chunk_elems;
+    const size_t n = std::max(ns, nr);
+    const size_t slot_bytes = CodecWireBytes(codec_, chunk_elems);
+    // 2 recv + 2 send wire slots, after whatever the caller staged below
+    // scratch_off (DoAllReduce parks the AG slots there — reserve only
+    // grows, so their bytes survive this call).
+    ch.scratch.reserve(scratch_off + 4 * slot_bytes);
+    uint8_t* base = ch.scratch.data() + scratch_off;
+    auto rbuf = [&](size_t i) { return base + (i & 1) * slot_bytes; };
+    auto sbuf = [&](size_t i) { return base + (2 + (i & 1)) * slot_bytes; };
+    auto selems = [&](size_t i) { return std::min(chunk_elems, send_n - i * chunk_elems); };
+    auto relems = [&](size_t i) { return std::min(chunk_elems, recv_n - i * chunk_elems); };
+
+    uint64_t rreq[2] = {0, 0}, sreq[2] = {0, 0};
+    bool rlive[2] = {false, false}, slive[2] = {false, false};
+    auto post = [&](size_t i) -> Status {
+      int slot = i & 1;
+      if (i < nr) {
+        Status st = net_->irecv(ch.recv_comm, rbuf(i),
+                                CodecWireBytes(codec_, relems(i)), &rreq[slot]);
+        if (!st.ok()) return st;
+        rlive[slot] = true;
+      }
+      if (i < ns) {
+        // Encode right before the isend: slot (i&1)'s previous send (i-2)
+        // was waited at the tail of iteration i-2, so the staging bytes are
+        // free to overwrite, and the encode of chunk i overlaps the wire
+        // moving chunk i-1.
+        CodecEncode(codec_, send_f + i * chunk_elems, sbuf(i), selems(i));
+        Status st = net_->isend(ch.send_comm, sbuf(i),
+                                CodecWireBytes(codec_, selems(i)), &sreq[slot]);
+        if (!st.ok()) return st;
+        slive[slot] = true;
+      }
+      return Status::Ok();
+    };
+    auto quiesce = [&](Status primary) {
+      for (int b = 0; b < 2; ++b) {
+        if (rlive[b]) WaitRequest(rreq[b], nullptr);
+        if (slive[b]) WaitRequest(sreq[b], nullptr);
+      }
+      return primary;
+    };
+
+    Status st = post(0);
+    if (!st.ok()) return quiesce(st);
+    for (size_t i = 0; i < n; ++i) {
+      int slot = i & 1;
+      bool has_r = i < nr;
+      if (has_r) {
+        size_t got = 0;
+        st = WaitRequest(rreq[slot], &got);
+        rlive[slot] = false;
+        if (!st.ok()) return quiesce(st);
+        if (got != CodecWireBytes(codec_, relems(i))) {
+          return quiesce(Status::Inner(
+              "ring step size mismatch: expected " +
+              std::to_string(CodecWireBytes(codec_, relems(i))) +
+              "B encoded chunk, got " + std::to_string(got) +
+              "B (ranks disagree on collective arguments, TPUNET_RING_CHUNKSIZE "
+              "or TPUNET_WIRE_DTYPE?)"));
+        }
+      }
+      if (i + 1 < n) {
+        st = post(i + 1);  // keep the wire busy while we decode+reduce chunk i
+        if (!st.ok()) return quiesce(st);
+      }
+      if (has_r) {
+        if (fused_enc != nullptr) {
+          // Chunks are block-aligned (CodecChunkElems), so the wire offset
+          // of chunk i inside the whole-slice encoding is exact.
+          CodecDecodeReduceQuantize(codec_, acc_f + i * chunk_elems,
+                                    loc_f + i * chunk_elems, rbuf(i),
+                                    fused_enc + CodecWireBytes(codec_, i * chunk_elems),
+                                    relems(i), wop);
+        } else {
+          CodecDecodeReduce(codec_, acc_f + i * chunk_elems, loc_f + i * chunk_elems,
+                            rbuf(i), relems(i), wop);
+        }
+      }
+      if (i < ns) {
+        st = WaitRequest(sreq[slot], nullptr);
+        slive[slot] = false;
+        if (!st.ok()) return quiesce(st);
+      }
+    }
+    return Status::Ok();
+  }
+
+  // Codec variant of the AllReduce AG phase ("AllGather passthrough":
+  // encode-only, no reduce). Slices travel ENCODED, and the encoded bytes
+  // are forwarded VERBATIM hop to hop while each rank decodes a private f32
+  // copy — so every rank materializes BIT-IDENTICAL values for every slice
+  // (the cross-rank determinism trainers assert on) and no hop ever
+  // re-quantizes. Precondition: the RS final round's fused handoff
+  // (CodecDecodeReduceQuantize) already QUANTIZED the owned slice in `data`
+  // and parked its encoded bytes in scratch slot 0 — what the owner keeps
+  // equals what every peer decodes, and this phase starts with zero codec
+  // passes of its own over the owned slice. Net effect: one quantization of
+  // each fully-reduced slice, on top of the RS phase's one-per-hop.
+  Status AgPhaseCodec(float* data, size_t count, RingChannel& ch, uint64_t seq,
+                      bool tracing) {
+    const int W = world_;
+    auto off = [&](int i) { return (count * static_cast<size_t>(i)) / W; };
+    const size_t max_elems = (count + W - 1) / W;
+    const size_t slot_bytes = CodecWireBytes(codec_, max_elems);
+    ch.scratch.reserve(2 * slot_bytes);  // no-op: DoAllReduce pre-reserved
+    uint8_t* slots[2] = {ch.scratch.data(), ch.scratch.data() + slot_bytes};
+    int cur = 0;  // slot 0 holds enc(owned slice), courtesy of the RS fusion
+    for (int s = 0; s < W - 1; ++s) {
+      int sidx = (rank_ - s + W) % W;
+      int ridx = (rank_ - s - 1 + W) % W;
+      size_t sw = CodecWireBytes(codec_, off(sidx + 1) - off(sidx));
+      size_t relems = off(ridx + 1) - off(ridx);
+      size_t rw = CodecWireBytes(codec_, relems);
+      PhaseSpan step(tracing, trace_comm_id_, seq, "ag", s, sw);
+      // The slice sent at step s+1 is exactly the one received at step s
+      // (sidx_{s+1} == ridx_s), so the received wire bytes ping-pong into
+      // the next step's send slot untouched.
+      Status st = Exchange(slots[cur], sw, slots[1 - cur], rw, nullptr, ch);
+      if (!st.ok()) return st;
+      CodecDecode(codec_, slots[1 - cur], data + off(ridx), relems);
+      cur = 1 - cur;
     }
     return Status::Ok();
   }
@@ -1004,6 +1269,9 @@ class RingCommunicator : public Communicator {
 
   int rank_;
   int world_;
+  // Wire compression codec for f32 collectives, fixed at construction and
+  // verified equal across ranks by the Init handshake (UseCodec above).
+  WireCodec codec_ = WireCodec::kF32;
   std::unique_ptr<Net> net_;
   std::unique_ptr<Bootstrap> bootstrap_;
   uint64_t listen_comm_ = 0;
@@ -1052,10 +1320,23 @@ class RingCommunicator : public Communicator {
 
 Status Communicator::Create(const std::string& coordinator, int rank, int world_size,
                             std::unique_ptr<Communicator>* out) {
+  return Create(coordinator, rank, world_size, "", out);
+}
+
+Status Communicator::Create(const std::string& coordinator, int rank, int world_size,
+                            const std::string& wire_dtype,
+                            std::unique_ptr<Communicator>* out) {
   if (world_size < 1 || rank < 0 || rank >= world_size) {
     return Status::Invalid("bad rank/world_size");
   }
-  auto comm = std::make_unique<RingCommunicator>(rank, world_size);
+  std::string name =
+      wire_dtype.empty() ? GetEnv("TPUNET_WIRE_DTYPE", "f32") : wire_dtype;
+  WireCodec codec;
+  if (!ParseWireCodec(name, &codec)) {
+    return Status::Invalid("unknown wire_dtype \"" + name +
+                           "\" (expected f32, bf16 or int8)");
+  }
+  auto comm = std::make_unique<RingCommunicator>(rank, world_size, codec);
   Status s = comm->Init(coordinator);
   if (!s.ok()) return s;
   *out = std::move(comm);

@@ -626,6 +626,7 @@ void Telemetry::Reset() {
   im->straggler_events.store(0, std::memory_order_relaxed);
   ResetIoSyscallCounts();
   ResetReduceBytesTotal();
+  ResetCodecBytesTotals();
   im->req_queue.Reset();
   im->req_wire.Reset();
   im->req_total.Reset();
@@ -718,6 +719,13 @@ MetricsSnapshot Telemetry::Snapshot() const {
     s.engine_syscalls[i] = IoSyscallCount(static_cast<IoOp>(i));
   }
   s.reduce_bytes = ReduceBytesTotal();
+  for (int c = 0; c < 2; ++c) {
+    for (int d = 0; d < 2; ++d) {
+      // Snapshot slot c maps to WireCodec c+1 (kF32 passthrough is uncounted).
+      s.codec_bytes[c][d] = CodecBytesTotal(static_cast<WireCodec>(c + 1), d);
+    }
+  }
+  for (int d = 0; d < 2; ++d) s.codec_payload_bytes[d] = CodecPayloadBytesTotal(d);
   s.uptime_s = (NowUs() - im->start_us.load(std::memory_order_relaxed)) / 1e6;
   return s;
 }
@@ -906,6 +914,31 @@ std::string Telemetry::PrometheusText() const {
          "Bytes produced by the collective reduction kernels (output side).");
   emit("tpunet_reduce_bytes_total{rank=\"%lld\"} %llu\n", (long long)rank,
        (unsigned long long)s.reduce_bytes);
+  // Compressed-collectives counters. Every codec x dir series emits even at
+  // zero so wire-ratio derivations (perf smoke, busbw_sweep) never divide by
+  // a missing series.
+  family("tpunet_codec_bytes_total", "counter",
+         "Encoded bytes produced (tx) and consumed (rx) by the collective "
+         "wire codecs, by codec.");
+  static const char* kCodecNames[2] = {"bf16", "int8"};
+  static const char* kCodecDirs[2] = {"tx", "rx"};
+  for (int c = 0; c < 2; ++c) {
+    for (int d = 0; d < 2; ++d) {
+      emit("tpunet_codec_bytes_total{rank=\"%lld\",codec=\"%s\",dir=\"%s\"} %llu\n",
+           (long long)rank, kCodecNames[c], kCodecDirs[d],
+           (unsigned long long)s.codec_bytes[c][d]);
+    }
+  }
+  family("tpunet_codec_wire_ratio", "gauge",
+         "Encoded wire bytes per f32 payload byte over the compressed "
+         "collective paths (1.0 when nothing was compressed).");
+  uint64_t codec_encoded = 0, codec_payload = 0;
+  for (int c = 0; c < 2; ++c) {
+    for (int d = 0; d < 2; ++d) codec_encoded += s.codec_bytes[c][d];
+  }
+  for (int d = 0; d < 2; ++d) codec_payload += s.codec_payload_bytes[d];
+  emit("tpunet_codec_wire_ratio{rank=\"%lld\"} %.6f\n", (long long)rank,
+       codec_payload > 0 ? (double)codec_encoded / (double)codec_payload : 1.0);
   return out;
 }
 

@@ -18,7 +18,9 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cmath>
 #include <cstdio>
+#include <limits>
 #include <functional>
 #include <fstream>
 #include <new>
@@ -881,6 +883,527 @@ uint64_t ReduceBytesTotal() {
 }
 
 void ResetReduceBytesTotal() { g_reduce_bytes.store(0, std::memory_order_relaxed); }
+
+// ---------------------------------------------------------------------------
+// Wire codecs (see utils.h). The bf16 converters are the EXACT F32ToBf16 /
+// Bf16ToF32 the reduce kernels use, so wire values are bit-identical to the
+// bf16-RNE reduce goldens; the AVX2 lanes replicate the scalar arithmetic
+// bitwise (same integer RNE, same expand) and are gated by the same
+// TPUNET_REDUCE_SIMD switch as the reduce kernels.
+
+namespace {
+
+std::atomic<uint64_t> g_codec_bytes[kWireCodecCount][2] = {};
+std::atomic<uint64_t> g_codec_payload[2] = {};
+
+void CountCodec(WireCodec c, int dir, size_t wire_bytes, size_t n) {
+  g_codec_bytes[static_cast<int>(c)][dir].fetch_add(wire_bytes,
+                                                    std::memory_order_relaxed);
+  g_codec_payload[dir].fetch_add(n * sizeof(float), std::memory_order_relaxed);
+}
+
+bool CodecSimdEnabled() {
+#if defined(__x86_64__)
+  return ReduceSimdEnabled();
+#else
+  return false;
+#endif
+}
+
+void EncodeBf16Scalar(const float* src, uint16_t* dst, size_t n) {
+  for (size_t i = 0; i < n; ++i) dst[i] = F32ToBf16(src[i]);
+}
+
+void DecodeBf16Scalar(const uint16_t* src, float* dst, size_t n) {
+  for (size_t i = 0; i < n; ++i) dst[i] = Bf16ToF32(src[i]);
+}
+
+void DecodeReduceBf16Scalar(float* dst, const float* local, const uint16_t* wire,
+                            size_t n, WireRedOp op) {
+  for (size_t i = 0; i < n; ++i) {
+    float a = local[i];
+    float b = Bf16ToF32(wire[i]);
+    switch (op) {
+      case WireRedOp::kSum:
+        dst[i] = a + b;
+        break;
+      case WireRedOp::kProd:
+        dst[i] = a * b;
+        break;
+      case WireRedOp::kMin:
+        dst[i] = std::min(a, b);
+        break;
+      case WireRedOp::kMax:
+        dst[i] = std::max(a, b);
+        break;
+    }
+  }
+}
+
+#if defined(__x86_64__)
+
+__attribute__((target("avx2")))
+void EncodeBf16Avx2(const float* src, uint16_t* dst, size_t n) {
+  const __m256i kHalf = _mm256_set1_epi32(0x7FFF);
+  const __m256i kOne = _mm256_set1_epi32(1);
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m256i bits = _mm256_castps_si256(_mm256_loadu_ps(src + i));
+    // F32ToBf16's RNE: bits + 0x7FFF + ((bits >> 16) & 1), keep high half —
+    // identical wraparound arithmetic to the scalar uint32_t path.
+    __m256i lsb = _mm256_and_si256(_mm256_srli_epi32(bits, 16), kOne);
+    __m256i hi = _mm256_srli_epi32(_mm256_add_epi32(_mm256_add_epi32(bits, kHalf), lsb), 16);
+    __m256i packed = _mm256_permute4x64_epi64(_mm256_packus_epi32(hi, hi), 0xD8);
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(dst + i),
+                     _mm256_castsi256_si128(packed));
+  }
+  if (i < n) EncodeBf16Scalar(src + i, dst + i, n - i);
+}
+
+__attribute__((target("avx2")))
+void DecodeBf16Avx2(const uint16_t* src, float* dst, size_t n) {
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m128i h = _mm_loadu_si128(reinterpret_cast<const __m128i*>(src + i));
+    __m256 f = _mm256_castsi256_ps(_mm256_slli_epi32(_mm256_cvtepu16_epi32(h), 16));
+    _mm256_storeu_ps(dst + i, f);
+  }
+  if (i < n) DecodeBf16Scalar(src + i, dst + i, n - i);
+}
+
+__attribute__((target("avx2")))
+void DecodeReduceBf16Avx2(float* dst, const float* local, const uint16_t* wire,
+                          size_t n, WireRedOp op) {
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m128i h = _mm_loadu_si128(reinterpret_cast<const __m128i*>(wire + i));
+    __m256 b = _mm256_castsi256_ps(_mm256_slli_epi32(_mm256_cvtepu16_epi32(h), 16));
+    __m256 a = _mm256_loadu_ps(local + i);
+    _mm256_storeu_ps(dst + i, Avx2Op(a, b, op));
+  }
+  if (i < n) DecodeReduceBf16Scalar(dst + i, local + i, wire + i, n - i, op);
+}
+
+#endif  // __x86_64__
+
+// int8 block-scale layout per kI8CodecBlock elements: [f32 scale][int8 x m].
+// scale = amax/127 over the block's FINITE magnitudes (0 when the block is
+// all zero; NaN when the block holds any non-finite value — the whole block
+// then decodes to NaN LOUDLY instead of silently zeroing an overflowed
+// gradient). q = rint(x * 127/amax) in [-127, 127], so
+// |x - q*scale| <= scale/2 = amax/254 per element on finite blocks.
+// Shared scale/inv derivation so the scalar and AVX2 block encoders agree
+// bitwise.
+inline void I8ScaleInv(float amax, bool has_nan, float* scale, float* inv) {
+  if (has_nan || !std::isfinite(amax)) {
+    *scale = std::numeric_limits<float>::quiet_NaN();
+    *inv = 0.0f;
+  } else if (amax == 0.0f) {
+    *scale = 0.0f;
+    *inv = 0.0f;
+  } else {
+    *scale = amax / 127.0f;
+    *inv = 127.0f / amax;
+  }
+}
+
+void EncodeI8BlockScalar(const float* src, uint8_t* dst, size_t m) {
+  float amax = 0.0f;
+  bool has_nan = false;
+  for (size_t i = 0; i < m; ++i) {
+    float a = std::fabs(src[i]);
+    if (a != a) {
+      has_nan = true;
+    } else {
+      amax = std::max(amax, a);
+    }
+  }
+  float scale, inv;
+  I8ScaleInv(amax, has_nan, &scale, &inv);
+  memcpy(dst, &scale, sizeof(scale));
+  int8_t* q = reinterpret_cast<int8_t*>(dst + sizeof(scale));
+  for (size_t i = 0; i < m; ++i) {
+    long v = lrintf(src[i] * inv);  // round-to-nearest-even (default mode)
+    if (v > 127) v = 127;
+    if (v < -127) v = -127;
+    q[i] = static_cast<int8_t>(v);
+  }
+}
+
+#if defined(__x86_64__)
+
+// A lambda would not inherit the enclosing function's target attribute
+// (same toolchain quirk Crc32cThreeLanes documents), so the 8-lane
+// quantize step lives in its own avx2-attributed helper.
+__attribute__((target("avx2")))
+inline __m256i QuantI8x8(const float* p, __m256 vinv, __m256i hi, __m256i lo) {
+  __m256i v = _mm256_cvtps_epi32(_mm256_mul_ps(_mm256_loadu_ps(p), vinv));
+  return _mm256_max_epi32(_mm256_min_epi32(v, hi), lo);
+}
+
+// AVX2 block encoder, bitwise-equal to the scalar one: the amax pass masks
+// NaN lanes to 0 exactly like the scalar skip (tracking them in a separate
+// unordered mask), _mm256_cvtps_epi32 rounds per MXCSR (RNE, the same
+// default mode lrintf uses), and the post-convert integer clamp maps the
+// cvt's INT_MIN "indefinite" for NaN inputs to -127 just like the scalar
+// clamp does on x86. The scalar loop was the int8 lane's bottleneck
+// (measured ~1 GB/s vs ~6 for the bf16 AVX2 pack — the per-block amax is
+// only 1 KiB of L1-resident data, so two vector passes are nearly free).
+__attribute__((target("avx2")))
+void EncodeI8BlockAvx2(const float* src, uint8_t* dst, size_t m) {
+  const __m256 kAbsMask = _mm256_castsi256_ps(_mm256_set1_epi32(0x7FFFFFFF));
+  __m256 vmax = _mm256_setzero_ps();
+  __m256 vunord = _mm256_setzero_ps();
+  size_t i = 0;
+  for (; i + 8 <= m; i += 8) {
+    __m256 v = _mm256_loadu_ps(src + i);
+    __m256 ord = _mm256_cmp_ps(v, v, _CMP_ORD_Q);  // all-ones on non-NaN
+    vunord = _mm256_or_ps(vunord, _mm256_cmp_ps(v, v, _CMP_UNORD_Q));
+    __m256 a = _mm256_and_ps(_mm256_and_ps(v, kAbsMask), ord);  // NaN -> 0
+    vmax = _mm256_max_ps(vmax, a);
+  }
+  float lanes[8];
+  _mm256_storeu_ps(lanes, vmax);
+  float amax = 0.0f;
+  for (float l : lanes) amax = std::max(amax, l);
+  bool has_nan = _mm256_movemask_ps(vunord) != 0;
+  for (; i < m; ++i) {
+    float a = std::fabs(src[i]);
+    if (a != a) {
+      has_nan = true;
+    } else {
+      amax = std::max(amax, a);
+    }
+  }
+  float scale, inv;
+  I8ScaleInv(amax, has_nan, &scale, &inv);
+  memcpy(dst, &scale, sizeof(scale));
+  int8_t* q = reinterpret_cast<int8_t*>(dst + sizeof(scale));
+  const __m256 vinv = _mm256_set1_ps(inv);
+  const __m256i kHi = _mm256_set1_epi32(127);
+  const __m256i kLo = _mm256_set1_epi32(-127);
+  const __m256i kFix = _mm256_setr_epi32(0, 4, 1, 5, 2, 6, 3, 7);
+  i = 0;
+  for (; i + 32 <= m; i += 32) {
+    __m256i a = QuantI8x8(src + i, vinv, kHi, kLo);
+    __m256i b = QuantI8x8(src + i + 8, vinv, kHi, kLo);
+    __m256i c = QuantI8x8(src + i + 16, vinv, kHi, kLo);
+    __m256i d = QuantI8x8(src + i + 24, vinv, kHi, kLo);
+    // packs interleaves 128-bit lanes; the dword permute restores order.
+    __m256i p16a = _mm256_packs_epi32(a, b);
+    __m256i p16b = _mm256_packs_epi32(c, d);
+    __m256i p8 = _mm256_permutevar8x32_epi32(_mm256_packs_epi16(p16a, p16b), kFix);
+    _mm256_storeu_si256(reinterpret_cast<__m256i*>(q + i), p8);
+  }
+  for (; i < m; ++i) {
+    long v = lrintf(src[i] * inv);
+    if (v > 127) v = 127;
+    if (v < -127) v = -127;
+    q[i] = static_cast<int8_t>(v);
+  }
+}
+
+#endif  // __x86_64__
+
+void EncodeI8Block(const float* src, uint8_t* dst, size_t m) {
+#if defined(__x86_64__)
+  if (CodecSimdEnabled()) {
+    EncodeI8BlockAvx2(src, dst, m);
+    return;
+  }
+#endif
+  EncodeI8BlockScalar(src, dst, m);
+}
+
+void DecodeI8Block(const uint8_t* src, float* dst, size_t m) {
+  float scale;
+  memcpy(&scale, src, sizeof(scale));
+  const int8_t* q = reinterpret_cast<const int8_t*>(src + sizeof(scale));
+  for (size_t i = 0; i < m; ++i) dst[i] = static_cast<float>(q[i]) * scale;
+}
+
+void DecodeReduceQuantBf16Scalar(float* dst, const float* local,
+                                 const uint16_t* wire, uint16_t* enc, size_t n,
+                                 WireRedOp op) {
+  for (size_t i = 0; i < n; ++i) {
+    float a = local[i];
+    float b = Bf16ToF32(wire[i]);
+    float t = 0;
+    switch (op) {
+      case WireRedOp::kSum:
+        t = a + b;
+        break;
+      case WireRedOp::kProd:
+        t = a * b;
+        break;
+      case WireRedOp::kMin:
+        t = std::min(a, b);
+        break;
+      case WireRedOp::kMax:
+        t = std::max(a, b);
+        break;
+    }
+    uint16_t e = F32ToBf16(t);
+    enc[i] = e;
+    dst[i] = Bf16ToF32(e);
+  }
+}
+
+#if defined(__x86_64__)
+
+__attribute__((target("avx2")))
+void DecodeReduceQuantBf16Avx2(float* dst, const float* local,
+                               const uint16_t* wire, uint16_t* enc, size_t n,
+                               WireRedOp op) {
+  const __m256i kHalf = _mm256_set1_epi32(0x7FFF);
+  const __m256i kOne = _mm256_set1_epi32(1);
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m128i h = _mm_loadu_si128(reinterpret_cast<const __m128i*>(wire + i));
+    __m256 b = _mm256_castsi256_ps(_mm256_slli_epi32(_mm256_cvtepu16_epi32(h), 16));
+    __m256 a = _mm256_loadu_ps(local + i);
+    __m256i bits = _mm256_castps_si256(Avx2Op(a, b, op));
+    __m256i lsb = _mm256_and_si256(_mm256_srli_epi32(bits, 16), kOne);
+    __m256i hi = _mm256_srli_epi32(_mm256_add_epi32(_mm256_add_epi32(bits, kHalf), lsb), 16);
+    __m256i packed = _mm256_permute4x64_epi64(_mm256_packus_epi32(hi, hi), 0xD8);
+    __m128i e = _mm256_castsi256_si128(packed);
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(enc + i), e);
+    __m256 q = _mm256_castsi256_ps(_mm256_slli_epi32(_mm256_cvtepu16_epi32(e), 16));
+    _mm256_storeu_ps(dst + i, q);
+  }
+  if (i < n) DecodeReduceQuantBf16Scalar(dst + i, local + i, wire + i, enc + i, n - i, op);
+}
+
+#endif  // __x86_64__
+
+void DecodeReduceI8Block(float* dst, const float* local, const uint8_t* src,
+                         size_t m, WireRedOp op) {
+  float scale;
+  memcpy(&scale, src, sizeof(scale));
+  const int8_t* q = reinterpret_cast<const int8_t*>(src + sizeof(scale));
+  for (size_t i = 0; i < m; ++i) {
+    float a = local[i];
+    float b = static_cast<float>(q[i]) * scale;
+    switch (op) {
+      case WireRedOp::kSum:
+        dst[i] = a + b;
+        break;
+      case WireRedOp::kProd:
+        dst[i] = a * b;
+        break;
+      case WireRedOp::kMin:
+        dst[i] = std::min(a, b);
+        break;
+      case WireRedOp::kMax:
+        dst[i] = std::max(a, b);
+        break;
+    }
+  }
+}
+
+}  // namespace
+
+bool ParseWireCodec(const std::string& name, WireCodec* out) {
+  if (name.empty() || name == "f32") {
+    *out = WireCodec::kF32;
+    return true;
+  }
+  if (name == "bf16") {
+    *out = WireCodec::kBF16;
+    return true;
+  }
+  if (name == "int8") {
+    *out = WireCodec::kI8;
+    return true;
+  }
+  return false;
+}
+
+const char* WireCodecName(WireCodec c) {
+  switch (c) {
+    case WireCodec::kF32:
+      return "f32";
+    case WireCodec::kBF16:
+      return "bf16";
+    case WireCodec::kI8:
+      return "int8";
+  }
+  return "?";
+}
+
+size_t CodecWireBytes(WireCodec c, size_t n) {
+  switch (c) {
+    case WireCodec::kF32:
+      return n * 4;
+    case WireCodec::kBF16:
+      return n * 2;
+    case WireCodec::kI8:
+      return n + sizeof(float) * ((n + kI8CodecBlock - 1) / kI8CodecBlock);
+  }
+  return n * 4;
+}
+
+void CodecEncode(WireCodec c, const float* src, uint8_t* dst, size_t n) {
+  switch (c) {
+    case WireCodec::kF32:
+      // Passthrough for completeness (the collectives skip the codec stage
+      // entirely at f32); not counted — the ratio gauge tracks compression.
+      memcpy(dst, src, n * 4);
+      return;
+    case WireCodec::kBF16: {
+      auto* d16 = reinterpret_cast<uint16_t*>(dst);
+#if defined(__x86_64__)
+      if (CodecSimdEnabled()) {
+        EncodeBf16Avx2(src, d16, n);
+      } else {
+        EncodeBf16Scalar(src, d16, n);
+      }
+#else
+      EncodeBf16Scalar(src, d16, n);
+#endif
+      break;
+    }
+    case WireCodec::kI8: {
+      uint8_t* out = dst;
+      for (size_t off = 0; off < n; off += kI8CodecBlock) {
+        size_t m = std::min(kI8CodecBlock, n - off);
+        EncodeI8Block(src + off, out, m);
+        out += sizeof(float) + m;
+      }
+      break;
+    }
+  }
+  CountCodec(c, 0, CodecWireBytes(c, n), n);
+}
+
+void CodecDecode(WireCodec c, const uint8_t* wire, float* dst, size_t n) {
+  switch (c) {
+    case WireCodec::kF32:
+      memcpy(dst, wire, n * 4);
+      return;
+    case WireCodec::kBF16: {
+      const auto* s16 = reinterpret_cast<const uint16_t*>(wire);
+#if defined(__x86_64__)
+      if (CodecSimdEnabled()) {
+        DecodeBf16Avx2(s16, dst, n);
+      } else {
+        DecodeBf16Scalar(s16, dst, n);
+      }
+#else
+      DecodeBf16Scalar(s16, dst, n);
+#endif
+      break;
+    }
+    case WireCodec::kI8: {
+      const uint8_t* in = wire;
+      for (size_t off = 0; off < n; off += kI8CodecBlock) {
+        size_t m = std::min(kI8CodecBlock, n - off);
+        DecodeI8Block(in, dst + off, m);
+        in += sizeof(float) + m;
+      }
+      break;
+    }
+  }
+  CountCodec(c, 1, CodecWireBytes(c, n), n);
+}
+
+void CodecDecodeReduce(WireCodec c, float* dst, const float* local,
+                       const uint8_t* wire, size_t n, WireRedOp op) {
+  if (local == nullptr) local = dst;
+  switch (c) {
+    case WireCodec::kF32:
+      ReduceInto(dst, local, wire, n, WireDType::kF32, op);
+      return;
+    case WireCodec::kBF16: {
+      const auto* w16 = reinterpret_cast<const uint16_t*>(wire);
+#if defined(__x86_64__)
+      if (CodecSimdEnabled()) {
+        DecodeReduceBf16Avx2(dst, local, w16, n, op);
+      } else {
+        DecodeReduceBf16Scalar(dst, local, w16, n, op);
+      }
+#else
+      DecodeReduceBf16Scalar(dst, local, w16, n, op);
+#endif
+      break;
+    }
+    case WireCodec::kI8: {
+      const uint8_t* in = wire;
+      for (size_t off = 0; off < n; off += kI8CodecBlock) {
+        size_t m = std::min(kI8CodecBlock, n - off);
+        DecodeReduceI8Block(dst + off, local + off, in, m, op);
+        in += sizeof(float) + m;
+      }
+      break;
+    }
+  }
+  // The fused stage is both a decode (rx accounting) and the collectives'
+  // reduce step — feed the reduce counter too so the post-wire stage stays
+  // visible next to the uncompressed path's numbers.
+  g_reduce_bytes.fetch_add(n * sizeof(float), std::memory_order_relaxed);
+  CountCodec(c, 1, CodecWireBytes(c, n), n);
+}
+
+void CodecDecodeReduceQuantize(WireCodec c, float* dst, const float* local,
+                               const uint8_t* wire, uint8_t* enc_out,
+                               size_t n, WireRedOp op) {
+  if (local == nullptr) local = dst;
+  switch (c) {
+    case WireCodec::kF32:
+      // Degenerate: no quantization; reduce then copy the bytes out.
+      ReduceInto(dst, local, wire, n, WireDType::kF32, op);
+      memcpy(enc_out, dst, n * 4);
+      return;
+    case WireCodec::kBF16: {
+      const auto* w16 = reinterpret_cast<const uint16_t*>(wire);
+      auto* e16 = reinterpret_cast<uint16_t*>(enc_out);
+#if defined(__x86_64__)
+      if (CodecSimdEnabled()) {
+        DecodeReduceQuantBf16Avx2(dst, local, w16, e16, n, op);
+      } else {
+        DecodeReduceQuantBf16Scalar(dst, local, w16, e16, n, op);
+      }
+#else
+      DecodeReduceQuantBf16Scalar(dst, local, w16, e16, n, op);
+#endif
+      break;
+    }
+    case WireCodec::kI8: {
+      // Per 256-element block (1 KiB, L1-resident): reduce into dst, encode
+      // dst, decode back over dst — three hot passes beat one cold
+      // whole-slice encode + decode later.
+      const uint8_t* in = wire;
+      uint8_t* out = enc_out;
+      for (size_t off = 0; off < n; off += kI8CodecBlock) {
+        size_t m = std::min(kI8CodecBlock, n - off);
+        DecodeReduceI8Block(dst + off, local + off, in, m, op);
+        EncodeI8Block(dst + off, out, m);
+        DecodeI8Block(out, dst + off, m);
+        in += sizeof(float) + m;
+        out += sizeof(float) + m;
+      }
+      break;
+    }
+  }
+  g_reduce_bytes.fetch_add(n * sizeof(float), std::memory_order_relaxed);
+  CountCodec(c, 1, CodecWireBytes(c, n), n);  // decoded the incoming chunk
+  CountCodec(c, 0, CodecWireBytes(c, n), n);  // produced the AG send bytes
+}
+
+uint64_t CodecBytesTotal(WireCodec c, int dir) {
+  return g_codec_bytes[static_cast<int>(c)][dir & 1].load(std::memory_order_relaxed);
+}
+
+uint64_t CodecPayloadBytesTotal(int dir) {
+  return g_codec_payload[dir & 1].load(std::memory_order_relaxed);
+}
+
+void ResetCodecBytesTotals() {
+  for (auto& per_codec : g_codec_bytes) {
+    for (auto& v : per_codec) v.store(0, std::memory_order_relaxed);
+  }
+  for (auto& v : g_codec_payload) v.store(0, std::memory_order_relaxed);
+}
 
 ScratchBuf::~ScratchBuf() {
   if (p_) ::operator delete[](p_, std::align_val_t(64));

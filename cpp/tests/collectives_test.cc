@@ -41,6 +41,76 @@ std::atomic<int> g_failures{0};
 
 #define CHECK_OK(expr) CHECK_MSG((expr) == 0, "%s -> %s", #expr, tpunet_c_last_error())
 
+// Compressed-collectives lane (docs/DESIGN.md "Compressed collectives"):
+// per codec, an f32 allreduce + reduce_scatter over the quantized ring —
+// error-bounded vs the exact sum, cross-rank BIT-IDENTICAL (checked via a
+// CRC32C allgather), wire_dtype getter agreeing — plus the negotiation
+// failure path: ranks configured with different codecs ALL fail with
+// TPUNET_ERR_CODEC. Runs under asan/tsan with the small ring chunks set in
+// main(), so the chunked encode/fused-decode-reduce pipeline really cycles.
+void codec_rank_main(int rank, int base_port) {
+  const char* codecs[2] = {"bf16", "int8"};
+  for (int ci = 0; ci < 2; ++ci) {
+    std::string coord = "127.0.0.1:" + std::to_string(base_port + 1 + ci);
+    uintptr_t comm = 0;
+    CHECK_OK(tpunet_comm_create_ex(coord.c_str(), rank, kWorld, codecs[ci], &comm));
+    int32_t wd = -1;
+    CHECK_OK(tpunet_comm_wire_dtype(comm, &wd));
+    CHECK_MSG(wd == ci + 1, "wire_dtype %d != %d for %s", wd, ci + 1, codecs[ci]);
+
+    std::vector<float> send(kCount), recv(kCount);
+    for (uint64_t i = 0; i < kCount; ++i) send[i] = float(rank + 1) + float(i % 7);
+    CHECK_OK(tpunet_comm_all_reduce(comm, send.data(), recv.data(), kCount, 0, 0));
+    for (uint64_t i = 0; i < kCount; ++i) {
+      // Exact sum <= 24; per-hop quantization error is <= amax*2^-8 (bf16)
+      // or amax/254 (int8) over <= W hops — 0.5 covers both with margin.
+      float expect = float(kWorld * (kWorld + 1) / 2) + float(kWorld * (i % 7));
+      CHECK_MSG(std::fabs(recv[i] - expect) < 0.5f, "%s all_reduce[%" PRIu64 "] %f != %f",
+                codecs[ci], i, double(recv[i]), double(expect));
+    }
+    // Cross-rank bit-identity: every rank must hold the SAME quantized
+    // bytes (the AG phase forwards encoded frames verbatim).
+    uint32_t crc = tpunet_c_crc32c(recv.data(), kCount * 4, 0);
+    std::vector<uint32_t> crcs(kWorld, 0);
+    CHECK_OK(tpunet_comm_all_gather(comm, &crc, crcs.data(), sizeof(crc)));
+    for (int r = 0; r < kWorld; ++r) {
+      CHECK_MSG(crcs[r] == crc, "%s result bytes differ between rank %d and %d",
+                codecs[ci], rank, r);
+    }
+
+    // reduce_scatter rides the same compressed RS pipeline.
+    const uint64_t rc = 4096;
+    std::vector<float> rs_in(kWorld * rc), rs_out(rc);
+    for (uint64_t i = 0; i < rs_in.size(); ++i) rs_in[i] = float(rank) + float(i % 11);
+    CHECK_OK(tpunet_comm_reduce_scatter(comm, rs_in.data(), rs_out.data(), rc, 0, 0));
+    for (uint64_t i = 0; i < rc; ++i) {
+      float expect = float(kWorld * (kWorld - 1) / 2) +
+                     float(kWorld) * float((rank * rc + i) % 11);
+      CHECK_MSG(std::fabs(rs_out[i] - expect) < 0.5f, "%s reduce_scatter[%" PRIu64 "]",
+                codecs[ci], i);
+    }
+    CHECK_OK(tpunet_comm_destroy(&comm));
+  }
+
+  // Negotiation failure: rank 0 asks for bf16, everyone else f32 — every
+  // rank must get the typed mismatch, nobody may wedge or succeed.
+  {
+    std::string coord = "127.0.0.1:" + std::to_string(base_port + 3);
+    uintptr_t comm = 0;
+    int32_t rcv = tpunet_comm_create_ex(coord.c_str(), rank, kWorld,
+                                        rank == 0 ? "bf16" : "f32", &comm);
+    CHECK_MSG(rcv == TPUNET_ERR_CODEC, "expected TPUNET_ERR_CODEC, got %d (%s)",
+              rcv, tpunet_c_last_error());
+  }
+
+  // Unknown codec name fails before any socket exists.
+  {
+    uintptr_t comm = 0;
+    int32_t rcv = tpunet_comm_create_ex("127.0.0.1:1", rank, 1, "fp8", &comm);
+    CHECK_MSG(rcv == TPUNET_ERR_INVALID, "expected INVALID for fp8, got %d", rcv);
+  }
+}
+
 void rank_main(int rank, const std::string& coordinator) {
   uintptr_t comm = 0;
   CHECK_OK(tpunet_comm_create(coordinator.c_str(), rank, kWorld, &comm));
@@ -147,8 +217,8 @@ int main() {
   setenv("TPUNET_RING_CHUNKSIZE", "16384", 1);
 
   const char* port_env = getenv("TPUNET_TEST_PORT");
-  std::string coordinator =
-      std::string("127.0.0.1:") + (port_env ? port_env : "29517");
+  int base_port = port_env ? atoi(port_env) : 29517;
+  std::string coordinator = "127.0.0.1:" + std::to_string(base_port);
 
   // A failed check on one rank-thread leaves its peers blocked in the next
   // collective (no data-plane timeout); without a watchdog that is a CI
@@ -168,6 +238,13 @@ int main() {
   for (int r = 0; r < kWorld; ++r)
     ranks.emplace_back(rank_main, r, coordinator);
   for (auto& th : ranks) th.join();
+
+  // Compressed-collectives lane (fresh comms on base_port+1..+3).
+  ranks.clear();
+  for (int r = 0; r < kWorld; ++r)
+    ranks.emplace_back(codec_rank_main, r, base_port);
+  for (auto& th : ranks) th.join();
+
   finished.store(true);
   watchdog.join();
 

@@ -1,7 +1,9 @@
 """CI perf-smoke lane (not pytest-collected — run as a script).
 
 A short loopback p2p transfer per engine, asserting syscalls/MiB stays under
-a committed budget. This is the regression tripwire for the vectored wire
+a committed budget, plus a compressed-collectives byte check: a bf16-wire
+allreduce must post <= 0.55x the f32 lane's wire bytes (counter-based via
+tpunet_isend_nbytes — noise-immune where this box's GB/s is not). This is the regression tripwire for the vectored wire
 path: a change that re-fragments it — separate syscalls for payload vs CRC
 trailer, losing MSG_WAITALL on chunk reads, per-segment instead of
 iovec-batched IO on EPOLL — moves syscalls/MiB by integer FACTORS, while
@@ -34,6 +36,44 @@ from benchmarks.engine_p2p import run_engine  # noqa: E402
 SIZE = 16 << 20
 BUDGET_SYSCALLS_PER_MIB = {"BASIC": 3.0, "EPOLL": 6.0}
 
+# Codec lane: bf16-wire allreduce must post at most this fraction of the
+# f32 lane's wire bytes. The true ratio is 0.500 exactly (every ring hop
+# halves); 0.55 leaves room only for the fixed non-payload traffic (ctrl
+# frames are not counted in isend_nbytes, so in practice this is tight).
+CODEC_SIZE = 8 << 20
+CODEC_BUDGET = 0.55
+
+
+def _codec_rank(rank, world, port, q, codec):
+    try:
+        os.environ["TPUNET_WIRE_DTYPE"] = codec
+        import numpy as np
+
+        from tpunet import telemetry
+        from tpunet.collectives import Communicator
+
+        comm = Communicator(f"127.0.0.1:{port}", rank, world)
+        arr = np.full(CODEC_SIZE // 4, float(rank + 1), np.float32)
+        comm.all_reduce(arr, inplace=True)  # warmup: wiring + scratch faults
+        comm.barrier()
+        telemetry.reset()
+        comm.all_reduce(arr, inplace=True)
+        # Posted wire payload over the measured allreduce: the histogram's
+        # _sum series parses as its own family in telemetry.metrics().
+        wire = int(sum(telemetry.metrics()["tpunet_isend_nbytes_sum"].values()))
+        comm.close()
+        q.put((rank, ("OK", wire)))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, (f"ERR: {e!r}", 0)))
+
+
+def _codec_wire_bytes(codec: str) -> int:
+    from benchmarks import check_rank_results, spawn_ranks
+
+    results = check_rank_results(
+        spawn_ranks(_codec_rank, 2, extra_args=(codec,), timeout=180))
+    return results[0]
+
 
 def main() -> None:
     os.environ.setdefault("TPUNET_CRC", "0")
@@ -46,6 +86,17 @@ def main() -> None:
               f"({bps} B/syscall, budget {budget})")
         if spm is None or spm > budget:
             failures.append(f"{engine}: {spm} syscalls/MiB exceeds budget {budget}")
+
+    f32_bytes = _codec_wire_bytes("f32")
+    bf16_bytes = _codec_wire_bytes("bf16")
+    ratio = bf16_bytes / f32_bytes if f32_bytes else float("inf")
+    print(f"[perf_smoke] codec: bf16 wire {bf16_bytes}B vs f32 {f32_bytes}B "
+          f"-> {ratio:.3f}x (budget {CODEC_BUDGET})")
+    if ratio > CODEC_BUDGET:
+        failures.append(
+            f"bf16 wire bytes {ratio:.3f}x of f32 exceeds {CODEC_BUDGET} — "
+            "codec not engaging on the ring?")
+
     if failures:
         raise SystemExit("perf smoke FAILED — wire path re-fragmented?\n  "
                          + "\n  ".join(failures))

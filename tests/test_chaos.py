@@ -272,10 +272,12 @@ def test_progress_watchdog_times_out_typed(monkeypatch):
 # TPUNET_CRC=1 injected corruption is ALWAYS detected.
 
 
-def _matrix_worker(rank: int, world: int, port: int, q, action: str, stream: int) -> None:
+def _matrix_worker(rank: int, world: int, port: int, q, action: str, stream: int,
+                   codec: str = "f32") -> None:
     try:
         os.environ["TPUNET_PROGRESS_TIMEOUT_MS"] = "2500"
         os.environ["TPUNET_CRC"] = "1"
+        os.environ["TPUNET_WIRE_DTYPE"] = codec
         from tpunet import _native as nat
         from tpunet import transport as tp
         from tpunet.collectives import Communicator
@@ -292,7 +294,10 @@ def _matrix_worker(rank: int, world: int, port: int, q, action: str, stream: int
         try:
             out = comm.all_reduce(arr)
             dt = time.perf_counter() - t0
-            correct = bool(np.all(out == 3.0))
+            # int8-wire quantizes (1/254 of the block amax per hop); f32 and
+            # bf16 represent 1.0 + 2.0 = 3.0 exactly.
+            tol = 0.05 if codec == "int8" else 0.0
+            correct = bool(np.all(np.abs(out - 3.0) <= tol))
             q.put((rank, f"OK correct={correct} dt={dt:.1f}"))
         except nat.NativeError as e:
             dt = time.perf_counter() - t0
@@ -352,4 +357,44 @@ def test_chaos_matrix_never_hangs_never_lies(action, stream):
     if action == "corrupt":
         # CRC on: the corruption is always DETECTED — some rank reports the
         # typed corruption code; nobody reduces damaged data into a result.
+        assert f"code={_native.TPUNET_ERR_CORRUPT}" in statuses, statuses
+
+
+@pytest.mark.parametrize("codec", ["bf16", "int8"])
+@pytest.mark.parametrize("action", ["corrupt", "close"])
+def test_chaos_crc_codec_matrix(action, codec):
+    """TPUNET_CRC=1 x wire codec: the per-chunk CRC32C trailer protects the
+    ENCODED frames too — a flipped wire byte on a compressed allreduce is
+    always detected (typed corruption, never a silently wrong decode), and
+    stream loss still fails over / errors out within the bounded wait."""
+    import multiprocessing as mp
+
+    from conftest import free_port
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = free_port()
+    procs = [
+        ctx.Process(target=_matrix_worker, args=(r, 2, port, q, action, 0, codec))
+        for r in range(2)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(2):
+            rank, status = q.get(timeout=150)
+            results[rank] = status
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.kill()
+    assert len(results) == 2, f"missing rank report: {results}"
+    statuses = " | ".join(results.values())
+    for rank, status in results.items():
+        assert not status.startswith("FAIL"), f"rank {rank}: {status}"
+        assert "correct=False" not in status, f"rank {rank}: {status}"
+        assert status.startswith(("OK", "TYPED")), f"rank {rank}: {status}"
+    if action == "corrupt":
         assert f"code={_native.TPUNET_ERR_CORRUPT}" in statuses, statuses

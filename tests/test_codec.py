@@ -1,0 +1,370 @@
+"""Compressed ring collectives (docs/DESIGN.md "Compressed collectives").
+
+Coverage, socket-free first:
+  * wire-size formulas per codec (bf16: 2n; int8: n + 4*ceil(n/256));
+  * bf16 encode goldens — bitwise vs a python replication of the native
+    RNE (bits + 0x7FFF + lsb), NaN/inf/-0.0 included, and roundtrip equal
+    to an ml_dtypes bfloat16 cast on finite values — the wire values are
+    the SAME bf16 the reduce kernels produce, by construction;
+  * int8 block-scale goldens — the [f32 scale][int8 x 256] layout parsed by
+    hand, the documented max-error bound |x - dec(enc(x))| <= amax/254 per
+    block, the all-zero block, block-boundary sizes, and the
+    non-finite-block -> NaN loudness contract.
+
+Then with sockets (spawned ranks):
+  * 2-rank compressed allreduce BYTE-EXACT against a separately-computed
+    reference built from the same encode/decode primitives (both codecs,
+    chunked and single-shot paths), plus cross-rank bit-identity;
+  * 3-rank lane: the AG phase forwards ENCODED bytes verbatim, so every
+    rank materializes identical values (sum and max ops);
+  * codec-mismatch handshake raises CodecMismatchError on EVERY rank;
+  * tpunet_codec_bytes_total / tpunet_codec_wire_ratio counters prove the
+    bytes halved (bf16) / quartered (int8).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from conftest import run_spawn_workers
+from tpunet import _native, transport
+
+# ---------------------------------------------------------------------------
+# Wire-size formulas.
+
+
+@pytest.mark.parametrize("n", [0, 1, 7, 255, 256, 257, 1000, 4099])
+def test_codec_wire_bytes_formulas(n):
+    assert transport.codec_wire_bytes("f32", n) == 4 * n
+    assert transport.codec_wire_bytes("bf16", n) == 2 * n
+    assert transport.codec_wire_bytes("int8", n) == n + 4 * ((n + 255) // 256)
+
+
+def test_codec_rejects_unknown_names():
+    with pytest.raises(ValueError, match="unknown wire codec"):
+        transport.codec_wire_bytes("fp8", 4)
+    with pytest.raises(ValueError, match="unknown wire codec"):
+        transport.codec_encode(np.zeros(4, np.float32), "bf-16")
+
+
+# ---------------------------------------------------------------------------
+# bf16 goldens.
+
+
+def _f32_to_bf16_ref(f: np.ndarray) -> np.ndarray:
+    """Python replication of the native RNE: bits + 0x7FFF + ((bits>>16)&1),
+    keep the high half (mod 2^32) — the SAME arithmetic the bf16 reduce
+    kernels use, so the wire values are pinned to the reduce goldens."""
+    bits = f.view(np.uint32).astype(np.uint64)
+    rounded = (bits + 0x7FFF + ((bits >> 16) & 1)) & 0xFFFFFFFF
+    return (rounded >> 16).astype(np.uint16)
+
+
+def test_bf16_encode_matches_rne_golden():
+    rng = np.random.default_rng(20260804)
+    x = (rng.standard_normal(4099) * 100).astype(np.float32)  # odd: SIMD tail
+    x[rng.integers(0, x.size, 32)] = np.nan
+    x[rng.integers(0, x.size, 32)] = np.inf
+    x[rng.integers(0, x.size, 32)] = -np.inf
+    x[rng.integers(0, x.size, 32)] = -0.0
+    enc = transport.codec_encode(x, "bf16").view(np.uint16)
+    np.testing.assert_array_equal(enc, _f32_to_bf16_ref(x))
+
+
+def test_bf16_specials_roundtrip():
+    sp = np.array([np.nan, np.inf, -np.inf, -0.0, 0.0, 1.0, 1.0 + 2**-8,
+                   1.0 + 3 * 2**-9], np.float32)
+    dec = transport.codec_decode(transport.codec_encode(sp, "bf16"), "bf16", sp.size)
+    assert np.isnan(dec[0])
+    assert dec[1] == np.inf and dec[2] == -np.inf
+    assert dec[3] == 0.0 and np.signbit(dec[3])  # -0.0 keeps its sign
+    assert dec[4] == 0.0 and not np.signbit(dec[4])
+    assert dec[5] == 1.0
+    assert dec[6] == 1.0  # RNE ties-to-even rounds the half-ulp down
+    assert dec[7] == np.float32(1.0 + 2**-7)  # and the 3/2-ulp up
+
+
+def test_bf16_roundtrip_matches_ml_dtypes():
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    rng = np.random.default_rng(7)
+    x = (rng.standard_normal(10000) * 10).astype(np.float32)
+    dec = transport.codec_decode(transport.codec_encode(x, "bf16"), "bf16", x.size)
+    ref = x.astype(ml_dtypes.bfloat16).astype(np.float32)
+    np.testing.assert_array_equal(dec, ref)
+
+
+# ---------------------------------------------------------------------------
+# int8 block-scale goldens.
+
+
+def _int8_blocks(enc: np.ndarray, n: int):
+    """Parse the wire layout: per <=256-element block, [f32 scale][int8 x m]."""
+    out = []
+    off = 0
+    done = 0
+    while done < n:
+        m = min(256, n - done)
+        scale = enc[off:off + 4].view(np.float32)[0]
+        q = enc[off + 4:off + 4 + m].view(np.int8)
+        out.append((scale, q))
+        off += 4 + m
+        done += m
+    assert off == enc.size
+    return out
+
+
+def test_int8_layout_and_scale_formula():
+    rng = np.random.default_rng(3)
+    x = (rng.standard_normal(600) * 9).astype(np.float32)
+    enc = transport.codec_encode(x, "int8")
+    for i, (scale, q) in enumerate(_int8_blocks(enc, x.size)):
+        blk = x[i * 256:(i + 1) * 256]
+        amax = np.max(np.abs(blk))
+        assert scale == np.float32(amax) / np.float32(127.0)
+        assert np.all(np.abs(q.astype(np.int32)) <= 127)
+        # The block max must quantize to exactly +-127.
+        assert np.max(np.abs(q.astype(np.int32))) == 127
+
+
+@pytest.mark.parametrize("n", [1, 7, 255, 256, 257, 512, 513, 40001])
+def test_int8_error_within_documented_bound(n):
+    """Documented bound (docs/DESIGN.md): per element of a finite block,
+    |x - dec(enc(x))| <= amax_block/254 — half a quantization step. The
+    1e-4 relative slack covers the single-precision evaluation of
+    x * (127/amax) inside the kernel."""
+    rng = np.random.default_rng(n)
+    x = (rng.standard_normal(n) * 17).astype(np.float32)
+    dec = transport.codec_decode(transport.codec_encode(x, "int8"), "int8", n)
+    err = np.abs(dec.astype(np.float64) - x.astype(np.float64))
+    for off in range(0, n, 256):
+        blk = slice(off, min(off + 256, n))
+        bound = np.max(np.abs(x[blk])).astype(np.float64) / 254.0
+        assert np.all(err[blk] <= bound * (1 + 1e-4) + 1e-30), (
+            f"block at {off}: max err {err[blk].max()} > bound {bound}")
+
+
+def test_int8_zero_block_is_exact():
+    z = np.zeros(300, np.float32)
+    enc = transport.codec_encode(z, "int8")
+    np.testing.assert_array_equal(
+        transport.codec_decode(enc, "int8", z.size), z)
+
+
+def test_int8_nonfinite_block_decodes_nan_loudly():
+    """A block holding inf/NaN cannot be represented; the whole block
+    decodes to NaN instead of silently zeroing an overflowed gradient."""
+    x = np.ones(300, np.float32)
+    x[10] = np.inf
+    dec = transport.codec_decode(transport.codec_encode(x, "int8"), "int8", x.size)
+    assert np.all(np.isnan(dec[:256]))  # the poisoned block
+    np.testing.assert_array_equal(dec[256:], x[256:])  # the clean one
+
+    y = np.ones(10, np.float32)
+    y[3] = np.nan
+    dec = transport.codec_decode(transport.codec_encode(y, "int8"), "int8", y.size)
+    assert np.all(np.isnan(dec))
+
+
+# ---------------------------------------------------------------------------
+# Config registration.
+
+
+def test_config_registers_wire_dtype(monkeypatch):
+    from tpunet.config import Config
+
+    assert Config.from_env().wire_dtype == "f32"
+    monkeypatch.setenv("TPUNET_WIRE_DTYPE", "bf16")
+    assert Config.from_env().wire_dtype == "bf16"
+    monkeypatch.setenv("TPUNET_WIRE_DTYPE", "bf-16")
+    with pytest.raises(ValueError, match="TPUNET_WIRE_DTYPE"):
+        Config.from_env()
+
+
+# ---------------------------------------------------------------------------
+# 2-rank compressed allreduce: byte-exact vs a separately-computed reference.
+
+
+def _allreduce_worker(rank: int, world: int, port: int, q, codec: str,
+                      chunk: int) -> None:
+    try:
+        os.environ["TPUNET_RING_CHUNKSIZE"] = str(chunk)
+        from tpunet import telemetry
+        from tpunet.collectives import Communicator
+
+        comm = Communicator(f"127.0.0.1:{port}", rank, world, wire_dtype=codec)
+        assert comm.wire_dtype == codec, comm.wire_dtype
+        rng = np.random.default_rng(rank)
+        x = (rng.standard_normal(40001) * 3).astype(np.float32)
+        out = comm.all_reduce(x)
+        m = telemetry.metrics()
+        codec_bytes = {
+            (telemetry.labels(k).get("codec"), telemetry.labels(k).get("dir")): v
+            for k, v in m.get("tpunet_codec_bytes_total", {}).items()
+        }
+        ratio = next(iter(m.get("tpunet_codec_wire_ratio", {}).values()), None)
+        comm.close()
+        # Queue payloads must pickle: ship plain arrays/floats.
+        q.put((rank, ("OK", (x.tobytes(), out.tobytes(), codec_bytes, ratio))))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def _spawn_collect(target, world, extra):
+    """run_spawn_workers variant that returns per-rank payloads."""
+    import multiprocessing as mp
+
+    from conftest import free_port
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = free_port()
+    procs = [ctx.Process(target=target, args=(r, world, port, q) + tuple(extra))
+             for r in range(world)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(world):
+            rank, payload = q.get(timeout=180)
+            results[rank] = payload
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.kill()
+    for r, payload in results.items():
+        assert isinstance(payload, tuple) and payload[0] == "OK", f"rank {r}: {payload}"
+    assert len(results) == world
+    return {r: payload[1] for r, payload in results.items()}
+
+
+def _encdec(a: np.ndarray, codec: str) -> np.ndarray:
+    return transport.codec_decode(transport.codec_encode(a, codec), codec, a.size)
+
+
+@pytest.mark.parametrize("codec", ["bf16", "int8"])
+@pytest.mark.parametrize("chunk", [16384, 8 << 20])  # pipelined + single-shot
+def test_compressed_allreduce_2rank_byte_exact(codec, chunk):
+    """W=2 model of the compressed ring, built from the SAME primitives the
+    ring uses (codec_encode/codec_decode): the slice owner accumulates
+    local + dec(enc(remote)) in f32 per pipeline chunk, and the AG phase
+    distributes dec(enc(accum)) — every rank must hold exactly those bytes."""
+    res = _spawn_collect(_allreduce_worker, 2, (codec, chunk))
+    x = {r: np.frombuffer(res[r][0], np.float32) for r in res}
+    out = {r: np.frombuffer(res[r][1], np.float32) for r in res}
+    np.testing.assert_array_equal(out[0].view(np.uint32), out[1].view(np.uint32))
+
+    n = x[0].size
+    half = n // 2
+    # Per-chunk element counts mirror the native CodecChunkElems: the WIRE
+    # chunk rides TPUNET_RING_CHUNKSIZE, so bf16 packs chunk/2 elements and
+    # int8 a block-rounded chunk.
+    if codec == "bf16":
+        ce = max(chunk // 2, 1)
+    else:
+        ce = max(chunk & ~255, 256)
+    expect = np.empty(n, np.float32)
+    for sl, owner in ((slice(0, half), 0), (slice(half, n), 1)):
+        own = x[owner][sl]
+        other = x[1 - owner][sl]
+        acc = np.empty_like(own)
+        for off in range(0, own.size, ce):
+            c = slice(off, off + ce)
+            acc[c] = own[c] + _encdec(np.ascontiguousarray(other[c]), codec)
+        expect[sl] = _encdec(acc, codec)
+    np.testing.assert_array_equal(out[0].view(np.uint32), expect.view(np.uint32))
+
+    # Counters: wire bytes exactly halved (bf16) / quartered-ish (int8).
+    codec_bytes, ratio = res[0][2], res[0][3]
+    tx = codec_bytes.get((codec, "tx"), 0)
+    assert tx == transport.codec_wire_bytes(codec, half) + \
+        transport.codec_wire_bytes(codec, n - half)
+    expect_ratio = 0.5 if codec == "bf16" else (
+        transport.codec_wire_bytes("int8", n) / (4 * n))
+    assert ratio == pytest.approx(expect_ratio, rel=0.01)
+
+
+def _w3_worker(rank: int, world: int, port: int, q, codec: str) -> None:
+    try:
+        os.environ["TPUNET_RING_CHUNKSIZE"] = "16384"
+        from tpunet.collectives import Communicator
+
+        comm = Communicator(f"127.0.0.1:{port}", rank, world, wire_dtype=codec)
+        rng = np.random.default_rng(rank)
+        x = (rng.standard_normal(10007) * 5).astype(np.float32)
+        out_sum = comm.all_reduce(x)
+        out_max = comm.all_reduce(x, op="max")
+        comm.close()
+        q.put((rank, ("OK", (x.tobytes(), out_sum.tobytes(), out_max.tobytes()))))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+@pytest.mark.parametrize("codec", ["bf16", "int8"])
+def test_compressed_allreduce_3rank_identical_and_bounded(codec):
+    """W=3 exercises the AG phase's encoded-byte FORWARDING (slices travel
+    verbatim hop to hop): all ranks bit-identical, error bounded by the
+    per-hop quantization model."""
+    res = _spawn_collect(_w3_worker, 3, (codec,))
+    xs = [np.frombuffer(res[r][0], np.float32) for r in range(3)]
+    sums = [np.frombuffer(res[r][1], np.float32) for r in range(3)]
+    maxs = [np.frombuffer(res[r][2], np.float32) for r in range(3)]
+    for r in (1, 2):
+        np.testing.assert_array_equal(sums[0].view(np.uint32), sums[r].view(np.uint32))
+        np.testing.assert_array_equal(maxs[0].view(np.uint32), maxs[r].view(np.uint32))
+    exact = np.sum(xs, axis=0, dtype=np.float64)
+    # 2 RS hops + 1 final quantize, each bounded by ~amax * (2^-8 for bf16,
+    # 1/254 for int8); 0.05 * max|sum| is comfortably above both.
+    assert np.max(np.abs(sums[0] - exact)) <= 0.05 * np.max(np.abs(exact))
+    # max-op: per-hop error is absolute (a block-amax fraction), not
+    # relative — small elements in a large-amax block wear the same bound.
+    np.testing.assert_allclose(maxs[0], np.max(xs, axis=0), rtol=0,
+                               atol=0.05 * np.max(np.abs(xs)))
+
+
+# ---------------------------------------------------------------------------
+# Codec-mismatch handshake.
+
+
+def _mismatch_worker(rank: int, world: int, port: int, q) -> None:
+    try:
+        from tpunet.collectives import Communicator
+
+        try:
+            Communicator(f"127.0.0.1:{port}", rank, world,
+                         wire_dtype="bf16" if rank == 0 else "f32")
+            q.put((rank, "FAIL: no error raised"))
+        except _native.CodecMismatchError as e:
+            assert e.code == _native.TPUNET_ERR_CODEC
+            assert "wire codec mismatch" in str(e)
+            q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def test_codec_mismatch_raises_typed_error_on_every_rank():
+    run_spawn_workers(_mismatch_worker, 2)
+
+
+def test_unknown_wire_dtype_rejected_before_any_socket():
+    from tpunet.collectives import Communicator
+
+    with pytest.raises(_native.NativeError) as ei:
+        Communicator("127.0.0.1:1", 0, 1, wire_dtype="fp8")
+    assert ei.value.code == _native.TPUNET_ERR_INVALID
+    assert "wire_dtype" in str(ei.value)
+
+
+def test_world1_carries_codec_without_wire():
+    from tpunet.collectives import Communicator
+
+    comm = Communicator("127.0.0.1:1", 0, 1, wire_dtype="bf16")
+    try:
+        assert comm.wire_dtype == "bf16"
+        x = np.arange(7, dtype=np.float32)
+        np.testing.assert_array_equal(comm.all_reduce(x), x)  # self-loop: exact
+    finally:
+        comm.close()

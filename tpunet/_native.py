@@ -26,6 +26,7 @@ TPUNET_ERR_INNER = -3
 TPUNET_ERR_CORRUPT = -4   # per-chunk CRC32C mismatch (TPUNET_CRC=1)
 TPUNET_ERR_TIMEOUT = -5   # progress watchdog (TPUNET_PROGRESS_TIMEOUT_MS)
 TPUNET_ERR_VERSION = -6   # wire-framing version mismatch with the peer
+TPUNET_ERR_CODEC = -7     # ranks disagree on the collective wire codec
 
 HANDLE_SIZE = 64
 
@@ -142,6 +143,10 @@ def load() -> ctypes.CDLL:
 
     lib.tpunet_comm_create.argtypes = [ctypes.c_char_p, i32, i32, P(u)]
     lib.tpunet_comm_create.restype = i32
+    lib.tpunet_comm_create_ex.argtypes = [ctypes.c_char_p, i32, i32, ctypes.c_char_p, P(u)]
+    lib.tpunet_comm_create_ex.restype = i32
+    lib.tpunet_comm_wire_dtype.argtypes = [u, P(i32)]
+    lib.tpunet_comm_wire_dtype.restype = i32
     lib.tpunet_comm_destroy.argtypes = [P(u)]
     lib.tpunet_comm_destroy.restype = i32
     lib.tpunet_comm_rank.argtypes = [u, P(i32), P(i32)]
@@ -192,6 +197,12 @@ def load() -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, u64, i32, i32,
     ]
     lib.tpunet_c_reduce.restype = i32
+    lib.tpunet_c_codec_wire_bytes.argtypes = [i32, u64]
+    lib.tpunet_c_codec_wire_bytes.restype = u64
+    lib.tpunet_c_codec_encode.argtypes = [i32, ctypes.c_void_p, u64, ctypes.c_void_p, u64]
+    lib.tpunet_c_codec_encode.restype = i32
+    lib.tpunet_c_codec_decode.argtypes = [i32, ctypes.c_void_p, u64, ctypes.c_void_p]
+    lib.tpunet_c_codec_decode.restype = i32
 
     _lib = lib
     return lib
@@ -228,10 +239,19 @@ class VersionMismatchError(NativeError):
     """The peer speaks a different tpunet wire-framing version."""
 
 
+class CodecMismatchError(NativeError):
+    """The ranks of a collective group disagree on the wire compression
+    codec (TPUNET_WIRE_DTYPE / wire_dtype). Raised at communicator wiring
+    time on EVERY rank — before any payload could be mis-decoded — with the
+    offending ranks and codecs in the message. Fix the config and rebuild
+    the communicator; nothing was corrupted."""
+
+
 _TYPED_ERRORS = {
     TPUNET_ERR_CORRUPT: CorruptionError,
     TPUNET_ERR_TIMEOUT: ProgressTimeoutError,
     TPUNET_ERR_VERSION: VersionMismatchError,
+    TPUNET_ERR_CODEC: CodecMismatchError,
 }
 
 

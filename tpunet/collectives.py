@@ -123,6 +123,7 @@ class Communicator:
         coordinator: str | None = None,
         rank: int | None = None,
         world_size: int | None = None,
+        wire_dtype: str | None = None,
     ):
         env = os.environ
         coordinator = coordinator or env.get("TPUNET_COORDINATOR", "127.0.0.1:29500")
@@ -134,13 +135,28 @@ class Communicator:
         )
         self._lib = _native.load()
         cid = ctypes.c_size_t(0)
+        # wire_dtype selects the f32 wire compression codec ("f32"/"bf16"/
+        # "int8"; None defers to TPUNET_WIRE_DTYPE, default f32). Negotiated
+        # at wiring time: a cross-rank disagreement raises CodecMismatchError
+        # on every rank before any payload could be mis-decoded.
         _native.check(
-            self._lib.tpunet_comm_create(coordinator.encode(), rank, world_size, ctypes.byref(cid)),
+            self._lib.tpunet_comm_create_ex(
+                coordinator.encode(), rank, world_size,
+                (wire_dtype or "").encode(), ctypes.byref(cid),
+            ),
             "comm_create",
         )
         self._id = cid.value
         self.rank = rank
         self.world_size = world_size
+        codec = ctypes.c_int32(0)
+        _native.check(
+            self._lib.tpunet_comm_wire_dtype(self._id, ctypes.byref(codec)),
+            "comm_wire_dtype",
+        )
+        #: Negotiated wire codec name — authoritative (read back from the
+        #: native layer, so env-default and explicit construction agree).
+        self.wire_dtype: str = {0: "f32", 1: "bf16", 2: "int8"}[codec.value]
 
     # -- collectives -------------------------------------------------------
 

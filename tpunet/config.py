@@ -54,6 +54,23 @@ def _env_int_checked(names: tuple[str, ...], fallback: int, minimum: int,
     return fallback
 
 
+def _env_choice(name: str, fallback: str, choices: tuple[str, ...],
+                what: str) -> str:
+    """Read an enumerated env var; any value outside `choices` raises
+    ValueError naming the var. Unlike the numeric readers there is no
+    silent-garbage fallback: a typo'd codec name ("bf-16") silently running
+    uncompressed would fake the perf it was set to buy, and the native layer
+    rejects the same values loudly (tpunet_comm_create_ex)."""
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return fallback
+    if v not in choices:
+        raise ValueError(
+            f"{name}={v} is invalid: {what} must be one of {', '.join(choices)}"
+        )
+    return v
+
+
 @dataclass(frozen=True)
 class Config:
     """Snapshot of tpunet env configuration at construction time."""
@@ -160,6 +177,12 @@ class Config:
     reduce_simd: bool = True
     # XLA custom-call collectives (0 falls back to the io_callback bridge).
     ffi_collectives: bool = True
+    # Collective wire compression codec for f32 payloads ("f32" = off,
+    # "bf16" = RNE truncation halves ring DCN bytes, "int8" = block-scaled
+    # quarters them; accumulate stays f32 either way). Negotiated at
+    # communicator wiring — all ranks must agree or creation fails with
+    # CodecMismatchError. docs/DESIGN.md "Compressed collectives".
+    wire_dtype: str = "f32"
 
     @staticmethod
     def from_env() -> "Config":
@@ -266,4 +289,8 @@ class Config:
             # Matches the interop.py consumer: enabled iff the var is unset
             # or exactly "1".
             ffi_collectives=env.get("TPUNET_FFI_COLLECTIVES", "1") == "1",
+            wire_dtype=_env_choice(
+                "TPUNET_WIRE_DTYPE", "f32", ("f32", "bf16", "int8"),
+                "collective wire codec",
+            ),
         )

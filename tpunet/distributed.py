@@ -25,26 +25,31 @@ def initialize(
     coordinator: str | None = None,
     rank: int | None = None,
     world_size: int | None = None,
+    wire_dtype: str | None = None,
 ) -> Communicator:
     """Create (or return) the process-global communicator.
 
     Collective across processes: every process of the job must call it.
     Defaults from env: TPUNET_COORDINATOR, TPUNET_RANK/RANK,
-    TPUNET_WORLD_SIZE/WORLD_SIZE.
+    TPUNET_WORLD_SIZE/WORLD_SIZE. ``wire_dtype`` selects the collective
+    wire compression codec ("f32"/"bf16"/"int8"; None defers to
+    TPUNET_WIRE_DTYPE) — because the FFI custom-call collectives route
+    through this communicator, it is also the codec every jitted dcn_*
+    collective rides.
     """
     global _comm, _comm_args
     with _lock:
         if _comm is None:
-            _comm = Communicator(coordinator, rank, world_size)
+            _comm = Communicator(coordinator, rank, world_size, wire_dtype)
             _comm.set_as_default()  # FFI collectives resolve it at call time
-            _comm_args = (coordinator, rank, world_size)
-        elif (coordinator, rank, world_size) != _comm_args and any(
-            a is not None for a in (coordinator, rank, world_size)
+            _comm_args = (coordinator, rank, world_size, wire_dtype)
+        elif (coordinator, rank, world_size, wire_dtype) != _comm_args and any(
+            a is not None for a in (coordinator, rank, world_size, wire_dtype)
         ):
             raise RuntimeError(
                 f"tpunet.distributed already initialized with {_comm_args}; "
-                f"got conflicting ({coordinator}, {rank}, {world_size}) — call "
-                "finalize() first to re-initialize"
+                f"got conflicting ({coordinator}, {rank}, {world_size}, "
+                f"{wire_dtype}) — call finalize() first to re-initialize"
             )
         return _comm
 

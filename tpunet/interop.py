@@ -66,6 +66,20 @@ _FFI_TARGETS = {
 }
 
 
+def _jax_ffi_mod():
+    """The FFI registration/call module: ``jax.ffi`` on current jax,
+    ``jax.extend.ffi`` on the 0.4.x line (same curried ffi_call API). The
+    custom-call lane must not depend on which spelling this environment
+    ships — falling back to io_callback over a NAME move would silently
+    cost the 3-copy bridge."""
+    mod = getattr(jax, "ffi", None)
+    if mod is not None and hasattr(mod, "register_ffi_target"):
+        return mod
+    from jax.extend import ffi as extend_ffi
+
+    return extend_ffi
+
+
 def _ffi_available() -> bool:
     """True when the zero-copy XLA custom-call path can serve this trace:
     CPU backend, handler symbols present in libtpunet.so (omitted when the
@@ -93,9 +107,10 @@ def _ffi_available() -> bool:
         from tpunet import _native
 
         lib = _native.load()
+        ffi = _jax_ffi_mod()
         for target, symbol in _FFI_TARGETS.items():
-            jax.ffi.register_ffi_target(
-                target, jax.ffi.pycapsule(getattr(lib, symbol)),
+            ffi.register_ffi_target(
+                target, ffi.pycapsule(getattr(lib, symbol)),
                 platform="cpu")
         _ffi_state["registered"] = True
     return True
@@ -108,7 +123,7 @@ def _ffi_call(target: str, spec, x, after=(), **attrs):
     them. (stablehlo.optimization_barrier is NOT enough — the pipeline
     expands it away and did reorder data-independent collectives in
     rank-asymmetric traces.)"""
-    return jax.ffi.ffi_call(target, spec, has_side_effect=True)(
+    return _jax_ffi_mod().ffi_call(target, spec, has_side_effect=True)(
         x, *after, **attrs)
 
 

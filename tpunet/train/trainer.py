@@ -116,6 +116,22 @@ def _bucketed_dcn_pmean(grads, bucket_bytes: int, compression: str | None, world
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 
+def _wire_handles_bf16() -> bool:
+    """True when the native communicator already compresses f32 payloads to
+    bf16 ON THE WIRE (wire_dtype="bf16" / TPUNET_WIRE_DTYPE=bf16 — see
+    docs/DESIGN.md "Compressed collectives"). The trainer then ships f32
+    gradients straight through — ONE cast path, at the wire hop, with f32
+    accumulation inside the ring — instead of double-casting in JAX and
+    reducing in bf16. Communicators without the codec (f32-wire, or an
+    emulated backend without a wire_dtype at all) keep the pure-Python
+    bf16 cast."""
+    from tpunet import distributed
+
+    if not distributed.is_initialized():
+        return False
+    return getattr(distributed.global_communicator(), "wire_dtype", "f32") == "bf16"
+
+
 def _make_loss_fn(model, images, labels, dropout_rng, moe_aux_weight: float,
                   fused_xent_block: int | None = None,
                   z_loss: float = 0.0):
@@ -310,6 +326,12 @@ def make_train_step(model, tx, cross_host: bool = False, donate: bool = True,
         from tpunet.interop import dcn_pmean
 
         world = distributed.world_size()  # raises early if initialize() was skipped
+        # One cast path: when the wire already compresses to bf16, ship f32
+        # gradients and let the ring quantize at the hops (f32 accumulation;
+        # strictly better numerics than reducing in bf16). Decided at trace
+        # time like every other cross-host choice.
+        if grad_compression == "bf16" and _wire_handles_bf16():
+            grad_compression = None
 
     def train_step(state: TrainState, images, labels, dropout_rng):
         loss, grads = _value_and_grads(model, state.params, images, labels,
@@ -410,6 +432,10 @@ def make_zero_train_step(model, tx, donate: bool = True,
 
     world = distributed.world_size()
     rank = distributed.rank()
+    # One cast path (see make_train_step): the native wire codec quantizes
+    # the reduce-scatter's hops itself, with f32 accumulation.
+    if grad_compression == "bf16" and _wire_handles_bf16():
+        grad_compression = None
 
     def train_step(state: TrainState, images, labels, dropout_rng):
         loss, grads = _value_and_grads(model, state.params, images, labels,

@@ -81,6 +81,61 @@ def reduce_into(dst: np.ndarray, a: np.ndarray, b: np.ndarray, dtype: str,
     )
 
 
+_CODECS = {"f32": 0, "bf16": 1, "int8": 2}
+
+
+def codec_wire_bytes(codec: str, n: int) -> int:
+    """Encoded byte count for ``n`` f32 elements under ``codec`` ("f32",
+    "bf16" or "int8") — the exact sizing rule the compressed ring uses
+    (bf16: 2n; int8: n + 4*ceil(n/256) for the per-block f32 scales)."""
+    if codec not in _CODECS:
+        raise ValueError(f"unknown wire codec {codec!r}")
+    lib = _native.load()
+    return int(lib.tpunet_c_codec_wire_bytes(_CODECS[codec], n))
+
+
+def codec_encode(arr: np.ndarray, codec: str) -> np.ndarray:
+    """Encode a C-contiguous float32 array into its wire form (uint8 array)
+    via the native codec kernel — the SAME routine the ring collectives run
+    before every compressed isend, exposed so golden tests can pin the wire
+    format (bf16 RNE incl. NaN/inf/-0.0; int8 block-scale layout and error
+    bound) without a socket in sight."""
+    if codec not in _CODECS:
+        raise ValueError(f"unknown wire codec {codec!r}")
+    if not isinstance(arr, np.ndarray) or arr.dtype != np.float32 or not arr.flags.c_contiguous:
+        raise ValueError("codec_encode needs a C-contiguous float32 array")
+    lib = _native.load()
+    out = np.empty(codec_wire_bytes(codec, arr.size), np.uint8)
+    _native.check(
+        lib.tpunet_c_codec_encode(_CODECS[codec], arr.ctypes.data, arr.size,
+                                  out.ctypes.data if out.size else None, out.size),
+        "codec_encode",
+    )
+    return out
+
+
+def codec_decode(wire: np.ndarray, codec: str, n: int) -> np.ndarray:
+    """Decode a wire buffer of ``n`` encoded f32 elements back to float32 —
+    the fused decode half of the ring's post-irecv stage (without the
+    reduce)."""
+    if codec not in _CODECS:
+        raise ValueError(f"unknown wire codec {codec!r}")
+    wire = np.ascontiguousarray(wire, np.uint8)
+    if wire.size != codec_wire_bytes(codec, n):
+        raise ValueError(
+            f"wire buffer is {wire.size}B but {codec} x {n} elements encodes to "
+            f"{codec_wire_bytes(codec, n)}B"
+        )
+    lib = _native.load()
+    out = np.empty(n, np.float32)
+    _native.check(
+        lib.tpunet_c_codec_decode(_CODECS[codec], wire.ctypes.data if wire.size else None,
+                                  n, out.ctypes.data if out.size else None),
+        "codec_decode",
+    )
+    return out
+
+
 def _as_buffer(obj: Any, writable: bool) -> tuple[int, int, Any]:
     """Return (address, nbytes, pin) for bytes/bytearray/numpy/memoryview."""
     if isinstance(obj, np.ndarray):
