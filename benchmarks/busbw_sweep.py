@@ -126,6 +126,74 @@ def _run_collective_rank(rank, world, coordinator, args, emit):
         emit(rows, world)
 
 
+def _run_dispatch_rank(rank, world, coordinator, args, emit):
+    """--emit-dispatch lane: time the allreduce sweep under EACH schedule
+    (ring / rhd / tree, one communicator per algo on coordinator port +0/+1/
+    +2), take the MEDIAN of 3 timed reps per (algo, size) — a single-shot
+    winner is noise-picked on a busy host — and write the winner table as
+    the TPUNET_DISPATCH_TABLE JSON (docs/DESIGN.md "Schedules & algorithm
+    selection"). Adjacent sizes with the same winner coalesce into one
+    entry; the last run is open-ended (max_bytes 0)."""
+    import statistics
+
+    import numpy as np
+
+    from tpunet.collectives import Communicator
+
+    host, port = coordinator.rsplit(":", 1)
+    algos = ["ring", "rhd", "tree"]
+    sizes = sweep_sizes(args.begin, args.end, args.factor)
+    reps = 3
+    medians: dict[str, dict[int, float]] = {a: {} for a in algos}
+    for ai, algo in enumerate(algos):
+        comm = Communicator(coordinator=f"{host}:{int(port) + ai}", rank=rank,
+                            world_size=world, algo=algo)
+        for nbytes in sizes:
+            count = max(nbytes // 4, 1)
+            arr = np.full(count, float(rank + 1), np.float32)
+            iters = args.iters if nbytes >= (1 << 16) else args.iters * 4
+            for _ in range(args.warmup):
+                comm.all_reduce(arr)
+            samples = []
+            for _ in range(reps):
+                comm.barrier()
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = comm.all_reduce(arr)
+                comm.barrier()
+                samples.append((time.perf_counter() - t0) / iters)
+            assert out[0] == sum(r + 1 for r in range(world)), "bad allreduce result"
+            medians[algo][nbytes] = statistics.median(samples)
+        comm.close()
+    if rank != 0:
+        return
+    winners = {n: min(algos, key=lambda a: medians[a][n]) for n in sizes}
+    entries = []
+    for i, n in enumerate(sizes):
+        if entries and entries[-1]["algo"] == winners[n]:
+            entries[-1]["max_bytes"] = n
+        else:
+            entries.append({"coll": "allreduce", "world": world,
+                            "max_bytes": n, "algo": winners[n]})
+    if entries:
+        entries[-1]["max_bytes"] = 0  # last run is open-ended
+    table = {"version": 1, "op": "allreduce", "world": world,
+             "reps": reps, "entries": entries}
+    with open(args.emit_dispatch, "w") as f:
+        json.dump(table, f, indent=1)
+    print(f"# tpunet dispatch sweep  world={world} reps={reps} "
+          f"-> {args.emit_dispatch}")
+    print(f"# {'size':>12} " + " ".join(f"{a + '(us)':>12}" for a in algos)
+          + f" {'winner':>8}")
+    for n in sizes:
+        print(f"  {n:>12} "
+              + " ".join(f"{medians[a][n] * 1e6:>12.1f}" for a in algos)
+              + f" {winners[n]:>8}")
+    for e in entries:
+        bound = "inf" if e["max_bytes"] == 0 else str(e["max_bytes"])
+        print(f"#   allreduce <= {bound} B -> {e['algo']}")
+
+
 def _run_p2p_rank(rank, world, coordinator, args, emit):
     """One-way stream: rank 0 sends, rank 1 receives; handles swap over the
     collectives bootstrap (the role NCCL's OOB bootstrap played)."""
@@ -232,7 +300,10 @@ def _worker(rank, world, port, q, args):
             os.environ["TPUNET_NSTREAMS"] = str(args.nstreams)
         if args.wire_dtype:
             os.environ["TPUNET_WIRE_DTYPE"] = args.wire_dtype
-        run = _run_p2p_rank if args.op == "p2p" else _run_collective_rank
+        if args.emit_dispatch:
+            run = _run_dispatch_rank
+        else:
+            run = _run_p2p_rank if args.op == "p2p" else _run_collective_rank
         run(rank, world, f"127.0.0.1:{port}", args, _emit_table(args))
         q.put((rank, "OK"))
     except Exception as e:  # noqa: BLE001
@@ -257,6 +328,12 @@ def main() -> None:
                          "in the workers; BENCH json records the measured "
                          "wire_bytes_per_payload_byte from the codec counters)")
     ap.add_argument("--json", default="", help="also dump rows to this file")
+    ap.add_argument("--emit-dispatch", dest="emit_dispatch", default="",
+                    help="time the allreduce sweep under each schedule "
+                         "(ring/rhd/tree; median of 3 reps per size) and "
+                         "write the winner table to this path as "
+                         "TPUNET_DISPATCH_TABLE JSON (uses coordinator "
+                         "ports +0/+1/+2)")
     ap.add_argument("--external", action="store_true",
                     help="run as one rank; rank/world/coordinator from env")
     args = ap.parse_args()
@@ -271,7 +348,10 @@ def main() -> None:
         rank = int(os.environ.get("TPUNET_RANK", os.environ.get("RANK", "0")))
         world = int(os.environ.get("TPUNET_WORLD_SIZE", os.environ.get("WORLD_SIZE", "1")))
         coord = os.environ.get("TPUNET_COORDINATOR", "127.0.0.1:29500")
-        run = _run_p2p_rank if args.op == "p2p" else _run_collective_rank
+        if args.emit_dispatch:
+            run = _run_dispatch_rank
+        else:
+            run = _run_p2p_rank if args.op == "p2p" else _run_collective_rank
         run(rank, world, coord, args, _emit_table(args))
         return
 

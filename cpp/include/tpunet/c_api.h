@@ -146,13 +146,19 @@ int32_t tpunet_c_codec_decode(int32_t codec, const void* wire, uint64_t n,
 int32_t tpunet_comm_create(const char* coordinator, int32_t rank, int32_t world_size,
                            uintptr_t* comm);
 /* As tpunet_comm_create, selecting the wire compression codec for f32
- * collectives: wire_dtype in {"f32","bf16","int8"}; NULL or "" defers to
- * TPUNET_WIRE_DTYPE (default f32). Unknown names are TPUNET_ERR_INVALID; a
- * cross-rank disagreement fails wiring with TPUNET_ERR_CODEC on every rank
- * (docs/DESIGN.md "Compressed collectives"). */
+ * collectives — wire_dtype in {"f32","bf16","int8"}; NULL or "" defers to
+ * TPUNET_WIRE_DTYPE (default f32) — and the collective schedule: algo in
+ * {"auto","ring","rhd","tree"}; NULL or "" defers to TPUNET_ALGO (default
+ * auto). "auto" dispatches per (collective, payload bytes, world) through
+ * built-in thresholds or the TPUNET_DISPATCH_TABLE JSON written by
+ * `busbw_sweep --emit-dispatch` (docs/DESIGN.md "Schedules & algorithm
+ * selection"). Unknown names are TPUNET_ERR_INVALID. Cross-rank
+ * disagreements fail wiring on EVERY rank: TPUNET_ERR_CODEC for the codec,
+ * TPUNET_ERR_INVALID for the algo/dispatch-table handshake (ranks on
+ * different schedules deadlock — this fails them loudly first). */
 int32_t tpunet_comm_create_ex(const char* coordinator, int32_t rank,
                               int32_t world_size, const char* wire_dtype,
-                              uintptr_t* comm);
+                              const char* algo, uintptr_t* comm);
 /* Negotiated wire codec of a live communicator: 0=f32, 1=bf16, 2=int8. */
 int32_t tpunet_comm_wire_dtype(uintptr_t comm, int32_t* wire_dtype);
 /* Process-default communicator for callers that cannot thread a handle —

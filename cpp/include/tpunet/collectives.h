@@ -1,12 +1,16 @@
-// tpunet collectives — ring algorithms over the multi-stream transport.
+// tpunet collectives — topology-aware schedules over the multi-stream
+// transport.
 //
-// The reference provided only point-to-point isend/irecv; NCCL's ring
-// algorithms lived above it (SURVEY §2.3: "AllReduce / collectives
-// algorithms — absent in-repo, external"). On TPU there is no NCCL to sit
-// under, so tpunet owns this layer: ring AllReduce (reduce-scatter +
-// all-gather phases), AllGather, ReduceScatter, Broadcast, Barrier, and the
-// neighbor-exchange primitive that sequence-parallel/ring-attention layers
-// need. Rendezvous handles travel via the Bootstrap (bootstrap.h).
+// The reference provided only point-to-point isend/irecv; NCCL's algorithm
+// layer lived above it (SURVEY §2.3: "AllReduce / collectives algorithms —
+// absent in-repo, external"). On TPU there is no NCCL to sit under, so
+// tpunet owns this layer: AllReduce under three schedules — chunk-pipelined
+// ring (reduce-scatter + all-gather), recursive halving-doubling, and
+// binomial tree — selected per (collective, payload bytes, world) by the
+// dispatch layer (docs/DESIGN.md "Schedules & algorithm selection"), plus
+// ring AllGather/ReduceScatter, ring- or tree-Broadcast, Barrier, AllToAll,
+// and the neighbor-exchange primitive that sequence-parallel/ring-attention
+// layers need. Rendezvous handles travel via the Bootstrap (bootstrap.h).
 #ifndef TPUNET_COLLECTIVES_H_
 #define TPUNET_COLLECTIVES_H_
 
@@ -56,6 +60,17 @@ class Communicator {
   // names are kInvalidArgument.
   static Status Create(const std::string& coordinator, int rank, int world_size,
                        const std::string& wire_dtype,
+                       std::unique_ptr<Communicator>* out);
+  // As above, additionally pinning the collective schedule ("auto" / "ring"
+  // / "rhd" / "tree"; empty = TPUNET_ALGO, default auto — docs/DESIGN.md
+  // "Schedules & algorithm selection"). "auto" selects per
+  // (collective, payload bytes, world): built-in thresholds, overridable by
+  // a TPUNET_DISPATCH_TABLE JSON seeded offline by `busbw_sweep
+  // --emit-dispatch`. The (algo, table) pair is negotiated over the
+  // bootstrap like the codec: ranks that disagree ALL fail at wiring time
+  // (two ranks on different schedules would deadlock, not corrupt).
+  static Status Create(const std::string& coordinator, int rank, int world_size,
+                       const std::string& wire_dtype, const std::string& algo,
                        std::unique_ptr<Communicator>* out);
 
   // sendbuf may equal recvbuf (in-place). count = elements. Blocking

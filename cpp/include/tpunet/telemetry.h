@@ -122,6 +122,15 @@ struct MetricsSnapshot {
   // proof that bf16 halved (int8: quartered) the ring's DCN bytes.
   uint64_t codec_bytes[2][2] = {{0, 0}, {0, 0}};  // [bf16,int8][tx,rx]
   uint64_t codec_payload_bytes[2] = {0, 0};       // [tx,rx]
+  // Schedule-dispatch accounting (docs/DESIGN.md "Schedules & algorithm
+  // selection"): sequential collective wire rounds executed by this rank
+  // per schedule, and dispatch decisions per (collective, resolved
+  // schedule). Slot i maps to CollAlgo i+1 (ring, rhd, tree — kAuto never
+  // executes); kind slots are CollKind order (allreduce, broadcast). These
+  // counters carry the small-message latency claim: ring AllReduce is
+  // 2(W-1) rounds where rhd is 2*log2(W') and tree <= 2*ceil(log2 W).
+  uint64_t coll_steps[3] = {0, 0, 0};
+  uint64_t coll_algo_selected[2][3] = {{0, 0, 0}, {0, 0, 0}};
   double uptime_s = 0;          // for bytes/s derivation
 };
 

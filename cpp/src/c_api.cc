@@ -342,16 +342,18 @@ extern "C" {
 
 int32_t tpunet_comm_create(const char* coordinator, int32_t rank, int32_t world_size,
                            uintptr_t* comm) {
-  return tpunet_comm_create_ex(coordinator, rank, world_size, nullptr, comm);
+  return tpunet_comm_create_ex(coordinator, rank, world_size, nullptr, nullptr,
+                               comm);
 }
 
 int32_t tpunet_comm_create_ex(const char* coordinator, int32_t rank,
                               int32_t world_size, const char* wire_dtype,
-                              uintptr_t* comm) {
+                              const char* algo, uintptr_t* comm) {
   if (!coordinator || !comm) return Fail(TPUNET_ERR_NULL, "null param");
   std::unique_ptr<tpunet::Communicator> c;
   Status s = tpunet::Communicator::Create(coordinator, rank, world_size,
-                                          wire_dtype ? wire_dtype : "", &c);
+                                          wire_dtype ? wire_dtype : "",
+                                          algo ? algo : "", &c);
   if (!s.ok()) return FromStatus(s);
   uint64_t id = g_next_comm_id.fetch_add(1);
   g_comms.Put(id, std::shared_ptr<tpunet::Communicator>(std::move(c)));

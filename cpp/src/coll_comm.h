@@ -1,0 +1,320 @@
+// Internal declaration of the tpunet communicator, shared by the schedule
+// translation units (docs/DESIGN.md "Schedules & algorithm selection").
+//
+// The communicator owns TOPOLOGY — the wired comm resources:
+//   * ring channels (send to (rank+1)%W, recv from (rank-1+W)%W; channel 0
+//     from Init, extra channels for overlapping async tickets), and
+//   * the lazily-wired pairwise mesh (one send + one recv comm per peer),
+// plus the machinery every schedule shares: the chunked exchange pipeline,
+// the wire codec fusion, scratch buffers, trace spans, and the async ticket
+// workers. SCHEDULES are member functions spread over per-algorithm TUs:
+//   schedule_ring.cc — the chunk-pipelined ring (RS+AG AllReduce,
+//     ReduceScatter, AllGather, pipelined Broadcast relay);
+//   schedule_rhd.cc  — recursive halving-doubling AllReduce over the mesh
+//     (2*log2(W') rounds; non-power-of-2 worlds fold the remainder in);
+//   schedule_tree.cc — binomial tree (reduce-to-root + bcast AllReduce for
+//     small payloads, binomial Broadcast).
+// collectives.cc keeps lifecycle, wiring, dispatch and the async machinery.
+// Which schedule runs is resolved per call by dispatch.h's selector.
+#ifndef TPUNET_SRC_COLL_COMM_H_
+#define TPUNET_SRC_COLL_COMM_H_
+
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <map>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "dispatch.h"
+#include "tpunet/bootstrap.h"
+#include "tpunet/collectives.h"
+#include "tpunet/mutex.h"
+#include "tpunet/net.h"
+#include "tpunet/telemetry.h"
+#include "tpunet/utils.h"
+
+namespace tpunet {
+namespace internal {
+
+// Broadcast store-and-forward granularity (ring relay AND binomial tree):
+// per-chunk forwarding streams the payload instead of paying the full
+// buffer's latency per hop.
+constexpr size_t kBcastChunk = 1 << 20;
+
+// Reduce-phase pipeline granularity: each ring step streams its slice in
+// chunks this size so the reduction of chunk i overlaps the wire transfer of
+// chunk i+1 (the NCCL pipelining insight — without it a step is strictly
+// transfer-then-reduce and the reduce time adds to the critical path).
+inline size_t RingChunkBytes() {
+  static const size_t v = GetEnvU64("TPUNET_RING_CHUNKSIZE", 8 << 20);
+  return v ? v : (8 << 20);
+}
+
+// Tag for the 8-byte hello a lazily-wired extra ring channel sends on its
+// first message, distinguishing it from a pairwise-mesh hello (a bare rank,
+// always < world) on the shared listener.
+constexpr uint64_t kRingHelloTag = 0x52494E47ull << 32;  // "RING"
+
+// Public DType/RedOp enums -> the wire-layer ones the reduce kernels use.
+inline WireDType ToWireDType(DType d) {
+  switch (d) {
+    case DType::kF32:
+      return WireDType::kF32;
+    case DType::kF64:
+      return WireDType::kF64;
+    case DType::kBF16:
+      return WireDType::kBF16;
+    case DType::kI32:
+      return WireDType::kI32;
+    case DType::kI64:
+      return WireDType::kI64;
+    case DType::kU8:
+      return WireDType::kU8;
+  }
+  return WireDType::kU8;
+}
+
+inline WireRedOp ToWireRedOp(RedOp op) {
+  switch (op) {
+    case RedOp::kSum:
+      return WireRedOp::kSum;
+    case RedOp::kProd:
+      return WireRedOp::kProd;
+    case RedOp::kMin:
+      return WireRedOp::kMin;
+    case RedOp::kMax:
+      return WireRedOp::kMax;
+  }
+  return WireRedOp::kSum;
+}
+
+// The 3-operand reduction kernels (dst[i] = a[i] op b[i]) live in utils.cc
+// as ReduceInto — SIMD with runtime dispatch, fork-join pool, and the
+// tpunet_reduce_bytes_total counter.
+inline void Reduce(void* dst, const void* a, const void* b, size_t n,
+                   DType dtype, RedOp op) {
+  ReduceInto(dst, a, b, n, ToWireDType(dtype), ToWireRedOp(op));
+}
+
+// RAII trace span around one collective phase. Every rank runs the same
+// collective program, so (comm_id, coll_seq, phase) names the SAME logical
+// phase on every rank — the cross-rank join key telemetry.merge_traces()
+// aligns per-rank trace files with. Zero cost when tracing is off (the
+// caller passes tracing_enabled() as `on`; no string is built either way
+// until the destructor fires with on=true).
+class PhaseSpan {
+ public:
+  PhaseSpan(bool on, uint64_t comm_id, uint64_t seq, const char* kind, int step,
+            uint64_t nbytes)
+      : on_(on), comm_id_(comm_id), seq_(seq), kind_(kind), step_(step),
+        nbytes_(nbytes), start_us_(on ? MonotonicUs() : 0) {}
+  ~PhaseSpan() {
+    if (!on_) return;
+    std::string phase =
+        step_ < 0 ? std::string(kind_) : std::string(kind_) + "." + std::to_string(step_);
+    Telemetry::Get().OnCollPhase(comm_id_, seq_, phase.c_str(), start_us_,
+                                 MonotonicUs() - start_us_, nbytes_);
+  }
+  PhaseSpan(const PhaseSpan&) = delete;
+  PhaseSpan& operator=(const PhaseSpan&) = delete;
+
+ private:
+  bool on_;
+  uint64_t comm_id_, seq_;
+  const char* kind_;
+  int step_;
+  uint64_t nbytes_;
+  uint64_t start_us_;
+};
+
+class ScheduledCommunicator : public Communicator {
+ public:
+  // A channel is one independent ring: a send comm to (rank+1)%W and a recv
+  // comm from (rank-1+W)%W, plus the scratch its pipelined reduce uses.
+  // Channel 0 is wired at Init and carries every blocking collective; extra
+  // channels exist so concurrent async tickets can overlap on the wire
+  // (ticket k+1's transfer no longer waits for ticket k's reduce).
+  struct RingChannel {
+    uint64_t send_comm = 0;
+    uint64_t recv_comm = 0;
+    ScratchBuf scratch;  // chunk landing slots; aligned, never zero-filled
+  };
+
+  ScheduledCommunicator(int rank, int world, WireCodec codec, CollAlgo algo)
+      : rank_(rank), world_(world), codec_(codec), algo_override_(algo) {}
+  ~ScheduledCommunicator() override;
+
+  Status Init(const std::string& coordinator);
+
+  // -- Communicator interface (collectives.cc unless noted) -----------------
+  Status AllReduce(const void* sendbuf, void* recvbuf, size_t count, DType dtype,
+                   RedOp op) override;
+  Status ReduceScatter(const void* sendbuf, void* recvbuf, size_t recv_count,
+                       DType dtype, RedOp op) override;  // schedule_ring.cc
+  Status AllGather(const void* sendbuf, void* recvbuf, size_t bytes_per_rank)
+      override;  // schedule_ring.cc
+  Status Broadcast(void* buf, size_t nbytes, int root) override;
+  Status AllToAll(const void* sendbuf, void* recvbuf, size_t bytes_per_rank) override;
+  Status NeighborExchange(const void* sendbuf, size_t send_nbytes, void* recvbuf,
+                          size_t recv_nbytes, size_t* got) override;
+  Status Barrier() override;
+  Status IAllReduce(const void* sendbuf, void* recvbuf, size_t count, DType dtype,
+                    RedOp op, uint64_t* ticket) override;
+  Status WaitTicket(uint64_t ticket) override;
+  Status TestTicket(uint64_t ticket, bool* done) override;
+  int rank() const override { return rank_; }
+  int world_size() const override { return world_; }
+  int32_t wire_codec() const override { return static_cast<int32_t>(codec_); }
+
+ private:
+  // -- dispatch (collectives.cc) --------------------------------------------
+  // Resolve the schedule for an AllReduce/Broadcast of `nbytes` payload and
+  // bump tpunet_coll_algo_selected_total. Deterministic from negotiated
+  // state, so every rank resolves identically.
+  CollAlgo ResolveAlgo(CollKind coll, uint64_t nbytes);
+  // Run one AllReduce under the already-resolved schedule (the async ticket
+  // job body; blocking calls go through the ticket path or call it inline).
+  Status DoAllReduce(const void* sendbuf, void* recvbuf, size_t count, DType dtype,
+                     RedOp op, RingChannel& ch, uint64_t seq, CollAlgo algo);
+
+  // -- ring schedule (schedule_ring.cc) -------------------------------------
+  Status DoAllReduceRing(const void* sendbuf, void* recvbuf, size_t count,
+                         DType dtype, RedOp op, RingChannel& ch, uint64_t seq);
+  Status DoBroadcastRing(void* buf, size_t nbytes, int root, uint64_t seq);
+  // One pipelined reduce ring step — see schedule_ring.cc for the contract.
+  Status ExchangeReduce(const uint8_t* sendbuf, size_t send_nbytes, uint8_t* accum,
+                        size_t recv_nbytes, DType dtype, RedOp op, RingChannel& ch,
+                        const uint8_t* local = nullptr);
+  Status ExchangeReduceCodec(const uint8_t* sendbuf, size_t send_nbytes,
+                             uint8_t* accum, size_t recv_nbytes, RedOp op,
+                             RingChannel& ch, const uint8_t* local,
+                             uint8_t* fused_enc = nullptr, size_t scratch_off = 0);
+  Status AgPhaseCodec(float* data, size_t count, RingChannel& ch, uint64_t seq,
+                      bool tracing);
+  // One ring step: recv from prev into recvbuf while sending sendbuf to next.
+  Status Exchange(const void* sendbuf, size_t send_nbytes, void* recvbuf,
+                  size_t recv_nbytes, size_t* got, RingChannel& ch);
+  Status DrainSends(std::vector<uint64_t>& reqs, Status primary);
+  size_t CodecChunkElems() const;
+
+  // -- halving-doubling schedule (schedule_rhd.cc) --------------------------
+  Status DoAllReduceRhd(const void* sendbuf, void* recvbuf, size_t count,
+                        DType dtype, RedOp op, uint64_t seq);
+  // Full-duplex pairwise step on the mesh comms of `peer`; zero-length
+  // directions are skipped (empty halving segments at tiny counts) — both
+  // sides derive sizes from the same geometry, so the skips pair up.
+  Status MeshExchange(int peer, const void* sendbuf, size_t send_nbytes,
+                      void* recvbuf, size_t recv_nbytes);
+  Status MeshSend(int peer, const void* buf, size_t nbytes);
+  Status MeshRecv(int peer, void* buf, size_t nbytes);
+
+  // -- binomial tree schedule (schedule_tree.cc) ----------------------------
+  Status DoAllReduceTree(const void* sendbuf, void* recvbuf, size_t count,
+                         DType dtype, RedOp op, uint64_t seq);
+  Status DoBroadcastTree(void* buf, size_t nbytes, int root, uint64_t seq);
+
+  // -- wiring / lifecycle (collectives.cc) ----------------------------------
+  Status ConnectAndWire(const SocketHandle& next_handle);
+  Status AcceptHello(uint64_t* rc, uint64_t* hello);
+  Status ConnectHello(int peer, uint64_t hello, uint64_t* comm);
+  Status EnsureMesh();
+  // EnsureMesh plus a one-time ring-step quiesce: no rank proceeds past the
+  // first mesh use until EVERY rank finished wiring, so a later
+  // listener-touching op (EnsureAsyncChannels on a fast rank) can never be
+  // mistaken for a mesh connect by a peer still in its accept loop.
+  Status EnsureMeshQuiesced();
+  Status PairwiseAllToAll(const uint8_t* in, uint8_t* out, size_t B);
+  Status EnsureAsyncChannels(size_t nch);
+  static size_t AsyncChannelCount();
+
+  // -- async worker machinery (collectives.cc) ------------------------------
+  bool TicketLive(uint64_t ticket) REQUIRES(async_mu_);
+  void AsyncWorkerLoop(size_t ch);
+  bool AsyncIdle() REQUIRES(async_mu_);
+  void FenceAsync();
+  void StopAsyncWorker();
+
+  Status WaitRequest(uint64_t req, size_t* nbytes) {
+    // Blocking condvar wait — a test() poll loop here competes with the
+    // stream worker threads for CPU (catastrophic on few-core hosts).
+    return net_->wait(req, nbytes);
+  }
+
+  // The codec engages only where elements are KNOWN f32: AllReduce /
+  // ReduceScatter payloads and the AG phase inside AllReduce. The
+  // byte-oriented collectives (AllGather, Broadcast, AllToAll,
+  // NeighborExchange, Barrier) carry opaque bytes — rendezvous handles,
+  // tokens, arbitrary dtypes — and are never lossily compressed
+  // (docs/DESIGN.md "Compressed collectives").
+  bool UseCodec(DType dtype) const {
+    return codec_ != WireCodec::kF32 && dtype == DType::kF32 && world_ > 1;
+  }
+
+  int rank_;
+  int world_;
+  // Wire compression codec for f32 collectives, fixed at construction and
+  // verified equal across ranks by the Init handshake (UseCodec above).
+  WireCodec codec_ = WireCodec::kF32;
+  // Per-communicator schedule override (kAuto = per-size selection) and the
+  // dispatch table loaded from TPUNET_DISPATCH_TABLE. Both are negotiated
+  // at Init — (override, table CRC) ride the codec handshake — so every
+  // rank resolves the same schedule for the same collective.
+  CollAlgo algo_override_ = CollAlgo::kAuto;
+  DispatchTable dispatch_;
+  std::unique_ptr<Net> net_;
+  std::unique_ptr<Bootstrap> bootstrap_;
+  uint64_t listen_comm_ = 0;
+  // Collective tracing identity: comm_id hashes (coordinator, world) — the
+  // same on every rank — and coll_seq_ counts collectives in program order
+  // (MPI semantics make the program identical across ranks), so
+  // (trace_comm_id_, coll_seq_, phase) tags match rank-to-rank.
+  uint64_t trace_comm_id_ = 0;
+  uint64_t coll_seq_ = 0;
+  // channels_[0] is the Init-wired ring every blocking collective uses;
+  // channels_[1..] are wired by EnsureAsyncChannels for overlapping async
+  // tickets. Stable after the first IAllReduce (workers capture indices).
+  std::vector<RingChannel> channels_;
+  // Scratch buffers reused across calls; a Communicator is not thread-safe
+  // (one collective at a time, like an MPI communicator).
+  // Pairwise-mesh comms for AllToAll and the rhd/tree schedules, keyed by
+  // peer rank (0 = unwired / self). Wired lazily by EnsureMesh from
+  // all_handles_; mesh_quiesced_ records the one-time wiring barrier.
+  std::vector<SocketHandle> all_handles_;
+  std::vector<uint64_t> mesh_send_;
+  std::vector<uint64_t> mesh_recv_;
+  bool mesh_quiesced_ = false;
+  ScratchBuf work_;
+  std::vector<uint8_t> barrier_scratch_;
+  ScratchBuf a2a_fwd_, a2a_rcv_;
+  // Mesh-schedule scratch (rhd halves / tree partials, and the encoded-atom
+  // assembly the codec AG forwards verbatim). Non-ring jobs serialize on
+  // channel 0's queue — or run on the fenced caller thread — so one set
+  // suffices; never touched by two threads at once.
+  ScratchBuf mesh_scratch_, mesh_enc_;
+  // Async (nonblocking-collective) state; async_mu_ guards all of it. Worker
+  // c is the only place async jobs touch channel c's comms/scratch, and
+  // FenceAsync keeps the sync paths out while any job runs. async_mu_ is
+  // released before any job executes, so it is never held around engine or
+  // request locks (docs/DESIGN.md "Concurrency model").
+  Mutex async_mu_;
+  CondVar work_cv_, done_cv_;
+  std::vector<std::deque<std::pair<uint64_t, std::function<Status()>>>> queues_
+      GUARDED_BY(async_mu_);
+  std::vector<uint64_t> running_ GUARDED_BY(async_mu_);
+  std::map<uint64_t, Status> done_ GUARDED_BY(async_mu_);
+  Status async_wire_status_ = Status::Ok();
+  uint64_t next_ticket_ GUARDED_BY(async_mu_) = 1;
+  bool worker_started_ GUARDED_BY(async_mu_) = false;
+  bool stop_ GUARDED_BY(async_mu_) = false;
+  // Joined in StopAsyncWorker AFTER async_mu_ is released (a worker must be
+  // able to take the lock to observe stop_), so the vector itself cannot be
+  // async_mu_-guarded; it only grows under the lock in IAllReduce.
+  std::vector<std::thread> workers_;
+};
+
+}  // namespace internal
+}  // namespace tpunet
+
+#endif  // TPUNET_SRC_COLL_COMM_H_

@@ -1,28 +1,29 @@
-// tpunet ring collectives over the multi-stream transport. See collectives.h.
+// tpunet collectives over the multi-stream transport. See collectives.h for
+// the public contract and coll_comm.h for the internal split:
 //
-// Algorithms (chunked ring, the same family NCCL runs above the reference
-// plugin — SURVEY §1 L6):
-//   AllReduce      = reduce-scatter phase + all-gather phase, 2(W-1) steps,
-//                    busbw-optimal 2(W-1)/W bytes per element on the wire.
-//   ReduceScatter  = the RS phase alone on W equal blocks.
-//   AllGather      = the AG phase alone.
-//   Broadcast      = pipelined ring forward from root (1 MiB chunks).
-//   Barrier        = 1-byte AllGather.
-// Every step posts the irecv before the isend and waits on both — each rank
-// sends to (rank+1)%W and receives from (rank-1+W)%W over independent
-// full-duplex comms, so the ring cannot deadlock.
+// This TU owns the communicator LIFECYCLE (bootstrap rendezvous, codec +
+// schedule negotiation, ring/mesh wiring, teardown), the per-call SCHEDULE
+// DISPATCH (dispatch.h selector: ring / recursive halving-doubling /
+// binomial tree by (collective, payload bytes, world)), the byte-oriented
+// collectives that ride the wiring directly (AllToAll, NeighborExchange,
+// Barrier), and the async ticket machinery. The algorithms themselves live
+// in schedule_{ring,rhd,tree}.cc.
+//
+// Every ring step posts the irecv before the isend and waits on both — each
+// rank sends to (rank+1)%W and receives from (rank-1+W)%W over independent
+// full-duplex comms, so the ring cannot deadlock. Mesh steps (rhd/tree)
+// follow the same recv-first discipline on per-peer comm pairs.
 #include "tpunet/collectives.h"
 
 #include <string.h>
 
 #include <algorithm>
-#include <chrono>
-#include <deque>
-#include <functional>
-#include <map>
-#include <thread>
+#include <string>
+#include <utility>
 #include <vector>
 
+#include "coll_comm.h"
+#include "dispatch.h"
 #include "tpunet/bootstrap.h"
 #include "tpunet/mutex.h"
 #include "tpunet/telemetry.h"
@@ -48,1283 +49,685 @@ size_t DTypeSize(DType d) {
   return 0;
 }
 
-namespace {
+namespace internal {
 
-constexpr size_t kBcastChunk = 1 << 20;  // broadcast pipeline granularity
-
-// Reduce-phase pipeline granularity: each ring step streams its slice in
-// chunks this size so the reduction of chunk i overlaps the wire transfer of
-// chunk i+1 (the NCCL pipelining insight — without it a step is strictly
-// transfer-then-reduce and the reduce time adds to the critical path).
-size_t RingChunkBytes() {
-  static const size_t v = GetEnvU64("TPUNET_RING_CHUNKSIZE", 8 << 20);
-  return v ? v : (8 << 20);
+ScheduledCommunicator::~ScheduledCommunicator() {
+  StopAsyncWorker();
+  if (net_) {
+    for (uint64_t c : mesh_send_) {
+      if (c) net_->close_send(c);
+    }
+    for (uint64_t c : mesh_recv_) {
+      if (c) net_->close_recv(c);
+    }
+    for (RingChannel& ch : channels_) {
+      if (ch.send_comm) net_->close_send(ch.send_comm);
+      if (ch.recv_comm) net_->close_recv(ch.recv_comm);
+    }
+    if (listen_comm_) net_->close_listen(listen_comm_);
+  }
 }
 
-// --------------------------------------------------------------------------
-// Reduction: the 3-operand kernels (dst[i] = a[i] op b[i]) live in utils.cc
-// as ReduceInto — SIMD with runtime dispatch, fork-join above 4 MiB, and the
-// tpunet_reduce_bytes_total counter. The in-place accumulate is the a == dst
-// degenerate case; the out-of-place collectives pass a = caller's sendbuf so
-// the staging copy never has to exist. This file only maps the public
-// DType/RedOp enums onto the wire-layer ones.
-
-WireDType ToWireDType(DType d) {
-  switch (d) {
-    case DType::kF32:
-      return WireDType::kF32;
-    case DType::kF64:
-      return WireDType::kF64;
-    case DType::kBF16:
-      return WireDType::kBF16;
-    case DType::kI32:
-      return WireDType::kI32;
-    case DType::kI64:
-      return WireDType::kI64;
-    case DType::kU8:
-      return WireDType::kU8;
+Status ScheduledCommunicator::Init(const std::string& coordinator) {
+  net_ = CreateEngine();
+  // Trace identity: every rank hashes the SAME coordinator string and
+  // world size, so (comm_id, coll_seq) tags agree across ranks without a
+  // wire round. |1 keeps it nonzero even for a degenerate hash.
+  trace_comm_id_ =
+      (static_cast<uint64_t>(Crc32c(coordinator.data(), coordinator.size())) |
+       (static_cast<uint64_t>(world_) << 32)) | 1ull;
+  channels_.resize(1);
+  // The offline-tuned dispatch table (busbw_sweep --emit-dispatch) loads
+  // per communicator so elastic rebuilds pick up a re-tuned file; a
+  // malformed table fails creation loudly rather than silently running the
+  // built-in thresholds the operator thought they replaced.
+  std::string table_path = GetEnv("TPUNET_DISPATCH_TABLE", "");
+  if (!table_path.empty()) {
+    Status ts = LoadDispatchTableFile(table_path, &dispatch_);
+    if (!ts.ok()) return ts;
   }
-  return WireDType::kU8;
-}
-
-WireRedOp ToWireRedOp(RedOp op) {
-  switch (op) {
-    case RedOp::kSum:
-      return WireRedOp::kSum;
-    case RedOp::kProd:
-      return WireRedOp::kProd;
-    case RedOp::kMin:
-      return WireRedOp::kMin;
-    case RedOp::kMax:
-      return WireRedOp::kMax;
-  }
-  return WireRedOp::kSum;
-}
-
-void Reduce(void* dst, const void* a, const void* b, size_t n, DType dtype,
-            RedOp op) {
-  ReduceInto(dst, a, b, n, ToWireDType(dtype), ToWireRedOp(op));
-}
-
-// --------------------------------------------------------------------------
-
-// Tag for the 8-byte hello a lazily-wired extra ring channel sends on its
-// first message, distinguishing it from a pairwise-mesh hello (a bare rank,
-// always < world) on the shared listener.
-constexpr uint64_t kRingHelloTag = 0x52494E47ull << 32;  // "RING"
-
-// RAII trace span around one collective phase. Every rank runs the same
-// collective program, so (comm_id, coll_seq, phase) names the SAME logical
-// phase on every rank — the cross-rank join key telemetry.merge_traces()
-// aligns per-rank trace files with. Zero cost when tracing is off (the
-// caller passes tracing_enabled() as `on`; no string is built either way
-// until the destructor fires with on=true).
-class PhaseSpan {
- public:
-  PhaseSpan(bool on, uint64_t comm_id, uint64_t seq, const char* kind, int step,
-            uint64_t nbytes)
-      : on_(on), comm_id_(comm_id), seq_(seq), kind_(kind), step_(step),
-        nbytes_(nbytes), start_us_(on ? MonotonicUs() : 0) {}
-  ~PhaseSpan() {
-    if (!on_) return;
-    std::string phase =
-        step_ < 0 ? std::string(kind_) : std::string(kind_) + "." + std::to_string(step_);
-    Telemetry::Get().OnCollPhase(comm_id_, seq_, phase.c_str(), start_us_,
-                                 MonotonicUs() - start_us_, nbytes_);
-  }
-  PhaseSpan(const PhaseSpan&) = delete;
-  PhaseSpan& operator=(const PhaseSpan&) = delete;
-
- private:
-  bool on_;
-  uint64_t comm_id_, seq_;
-  const char* kind_;
-  int step_;
-  uint64_t nbytes_;
-  uint64_t start_us_;
-};
-
-class RingCommunicator : public Communicator {
- public:
-  // A channel is one independent ring: a send comm to (rank+1)%W and a recv
-  // comm from (rank-1+W)%W, plus the scratch its pipelined reduce uses.
-  // Channel 0 is wired at Init and carries every blocking collective; extra
-  // channels exist so concurrent async tickets can overlap on the wire
-  // (ticket k+1's transfer no longer waits for ticket k's reduce).
-  struct RingChannel {
-    uint64_t send_comm = 0;
-    uint64_t recv_comm = 0;
-    ScratchBuf scratch;  // chunk landing slots; aligned, never zero-filled
-  };
-
-  RingCommunicator(int rank, int world, WireCodec codec)
-      : rank_(rank), world_(world), codec_(codec) {}
-
-  ~RingCommunicator() override {
-    StopAsyncWorker();
-    if (net_) {
-      for (uint64_t c : mesh_send_) {
-        if (c) net_->close_send(c);
-      }
-      for (uint64_t c : mesh_recv_) {
-        if (c) net_->close_recv(c);
-      }
-      for (RingChannel& ch : channels_) {
-        if (ch.send_comm) net_->close_send(ch.send_comm);
-        if (ch.recv_comm) net_->close_recv(ch.recv_comm);
-      }
-      if (listen_comm_) net_->close_listen(listen_comm_);
-    }
-  }
-
-  Status Init(const std::string& coordinator) {
-    net_ = CreateEngine();
-    // Trace identity: every rank hashes the SAME coordinator string and
-    // world size, so (comm_id, coll_seq) tags agree across ranks without a
-    // wire round. |1 keeps it nonzero even for a degenerate hash.
-    trace_comm_id_ =
-        (static_cast<uint64_t>(Crc32c(coordinator.data(), coordinator.size())) |
-         (static_cast<uint64_t>(world_) << 32)) | 1ull;
-    channels_.resize(1);
-    Status s = Bootstrap::Create(coordinator, rank_, world_, &bootstrap_);
-    if (!s.ok()) return s;
-    if (world_ == 1) {
-      bootstrap_.reset();
-      return Status::Ok();
-    }
-
-    // Wire-codec negotiation, piggybacked on the bootstrap ctrl plane the
-    // wiring already rides: one 1-byte AllGather round. Every rank compares
-    // the full vector, so ALL ranks fail identically (kCodec) on a mismatch
-    // — before any ring comm exists that could mis-decode a payload.
-    uint8_t my_codec = static_cast<uint8_t>(codec_);
-    std::vector<uint8_t> codecs;
-    s = bootstrap_->AllGather(&my_codec, 1, &codecs);
-    if (!s.ok()) return s;
-    for (int r = 0; r < world_; ++r) {
-      if (codecs[r] != my_codec) {
-        std::string theirs =
-            codecs[r] < kWireCodecCount
-                ? std::string(WireCodecName(static_cast<WireCodec>(codecs[r])))
-                : "#" + std::to_string(codecs[r]);
-        return Status::Codec(
-            "wire codec mismatch: rank " + std::to_string(rank_) + " uses " +
-            WireCodecName(codec_) + " but rank " + std::to_string(r) + " uses " +
-            theirs +
-            " (set TPUNET_WIRE_DTYPE / wire_dtype identically on every rank)");
-      }
-    }
-
-    SocketHandle handle;
-    s = net_->listen(0, &handle, &listen_comm_);
-    if (!s.ok()) return s;
-    uint8_t blob[kHandleSize] = {0};
-    memcpy(blob, &handle.addr, std::min(sizeof(handle.addr), sizeof(blob)));
-    std::vector<uint8_t> all;
-    s = bootstrap_->AllGather(blob, kHandleSize, &all);
-    if (!s.ok()) return s;
-
-    // Keep every rank's listen handle: the pairwise AllToAll mesh is wired
-    // lazily from these on first use (the listeners stay alive for the
-    // communicator's lifetime, so no bootstrap round is needed then).
-    all_handles_.resize(world_);
-    for (int r = 0; r < world_; ++r) {
-      memcpy(&all_handles_[r].addr, all.data() + r * kHandleSize, kHandleSize);
-      all_handles_[r].addrlen = 0;  // derived from family by the engine
-    }
-
-    int next = (rank_ + 1) % world_;
-    s = ConnectAndWire(all_handles_[next]);
-    if (!s.ok()) return s;
-    // The bootstrap's job is done once the ring is wired; dropping it frees
-    // the coordinator port and rank 0's W-1 peer sockets so long-lived jobs
-    // don't pin fds and another communicator can reuse the address.
+  Status s = Bootstrap::Create(coordinator, rank_, world_, &bootstrap_);
+  if (!s.ok()) return s;
+  if (world_ == 1) {
     bootstrap_.reset();
     return Status::Ok();
   }
 
-  Status ConnectAndWire(const SocketHandle& next_handle) {
-    Status s = net_->connect(0, next_handle, &channels_[0].send_comm);
-    if (!s.ok()) return s;
-    // Barrier BEFORE accept: once it passes, every rank has connected to its
-    // next, so our prev's bundle is already inbound and accept() cannot
-    // block forever. A rank that died earlier fails the barrier with a clean
-    // error instead of wedging the ring (observed: peer death between
-    // bootstrap and connect hung accept indefinitely).
-    s = bootstrap_->Barrier();
-    if (!s.ok()) return s;
-    return net_->accept(listen_comm_, &channels_[0].recv_comm);
-  }
-
-  // Blocking AllReduce IS IAllReduce + WaitTicket. This is not a
-  // convenience: the cross-rank matching rule (MPI/NCCL semantics) lets one
-  // rank call AllReduce where another calls IAllReduce+wait for the same
-  // collective, so BOTH kinds must consume the same ticket sequence — the
-  // ticket->channel map is what pairs ring messages across ranks, and a
-  // blocking call that bypassed it would desync (and never wire channels on
-  // ranks that only ever call the blocking form).
-  Status AllReduce(const void* sendbuf, void* recvbuf, size_t count, DType dtype,
-                   RedOp op) override {
-    // Single-channel mode: everything rides channel 0 in submission order,
-    // so pairing cannot desync and the caller thread can run the ring
-    // directly (no worker hop) — also the kill switch for the ticketed path.
-    if (AsyncChannelCount() == 1) {
-      FenceAsync();
-      return DoAllReduce(sendbuf, recvbuf, count, dtype, op, channels_[0], ++coll_seq_);
+  // Schedule-config negotiation, piggybacked on the bootstrap ctrl plane
+  // the wiring already rides: one 8-byte AllGather round carrying
+  // (wire codec, algo override, dispatch-table CRC32C). Every rank compares
+  // the full vector, so ALL ranks fail identically on a mismatch — before
+  // any comm exists that could mis-decode a payload or run half the world
+  // on a different schedule (two schedules deadlock, they don't corrupt).
+  uint8_t my_blob[8] = {0};
+  my_blob[0] = static_cast<uint8_t>(codec_);
+  my_blob[1] = static_cast<uint8_t>(algo_override_);
+  uint32_t table_crc = dispatch_.loaded ? dispatch_.crc : 0;
+  my_blob[2] = static_cast<uint8_t>(table_crc >> 24);
+  my_blob[3] = static_cast<uint8_t>(table_crc >> 16);
+  my_blob[4] = static_cast<uint8_t>(table_crc >> 8);
+  my_blob[5] = static_cast<uint8_t>(table_crc);
+  std::vector<uint8_t> blobs;
+  s = bootstrap_->AllGather(my_blob, sizeof(my_blob), &blobs);
+  if (!s.ok()) return s;
+  for (int r = 0; r < world_; ++r) {
+    const uint8_t* theirs = blobs.data() + r * sizeof(my_blob);
+    if (theirs[0] != my_blob[0]) {
+      std::string name =
+          theirs[0] < kWireCodecCount
+              ? std::string(WireCodecName(static_cast<WireCodec>(theirs[0])))
+              : "#" + std::to_string(theirs[0]);
+      return Status::Codec(
+          "wire codec mismatch: rank " + std::to_string(rank_) + " uses " +
+          WireCodecName(codec_) + " but rank " + std::to_string(r) + " uses " +
+          name +
+          " (set TPUNET_WIRE_DTYPE / wire_dtype identically on every rank)");
     }
-    // Fence first: the documented contract is that a blocking collective
-    // orders AFTER all outstanding tickets (callers rely on it for buffer
-    // reuse). Fencing consumes no ticket, so it cannot desync pairing.
-    FenceAsync();
-    uint64_t ticket = 0;
-    Status s = IAllReduce(sendbuf, recvbuf, count, dtype, op, &ticket);
-    if (!s.ok()) return s;
-    return WaitTicket(ticket);
+    if (theirs[1] != my_blob[1]) {
+      std::string name =
+          theirs[1] < kCollAlgoCount
+              ? std::string(CollAlgoName(static_cast<CollAlgo>(theirs[1])))
+              : "#" + std::to_string(theirs[1]);
+      return Status::Invalid(
+          "collective algo mismatch: rank " + std::to_string(rank_) + " uses " +
+          CollAlgoName(algo_override_) + " but rank " + std::to_string(r) +
+          " uses " + name +
+          " (set TPUNET_ALGO / algo identically on every rank — ranks on "
+          "different schedules deadlock)");
+    }
+    if (memcmp(theirs + 2, my_blob + 2, 4) != 0) {
+      return Status::Invalid(
+          "dispatch table mismatch: rank " + std::to_string(rank_) +
+          " and rank " + std::to_string(r) +
+          " loaded different TPUNET_DISPATCH_TABLE contents (every rank must "
+          "see the same table or none — per-size selection must agree)");
+    }
   }
 
-  Status DoAllReduce(const void* sendbuf, void* recvbuf, size_t count, DType dtype,
-                     RedOp op, RingChannel& ch, uint64_t seq) {
+  SocketHandle handle;
+  s = net_->listen(0, &handle, &listen_comm_);
+  if (!s.ok()) return s;
+  uint8_t blob[kHandleSize] = {0};
+  memcpy(blob, &handle.addr, std::min(sizeof(handle.addr), sizeof(blob)));
+  std::vector<uint8_t> all;
+  s = bootstrap_->AllGather(blob, kHandleSize, &all);
+  if (!s.ok()) return s;
+
+  // Keep every rank's listen handle: the pairwise mesh (AllToAll, rhd, tree)
+  // is wired lazily from these on first use (the listeners stay alive for
+  // the communicator's lifetime, so no bootstrap round is needed then).
+  all_handles_.resize(world_);
+  for (int r = 0; r < world_; ++r) {
+    memcpy(&all_handles_[r].addr, all.data() + r * kHandleSize, kHandleSize);
+    all_handles_[r].addrlen = 0;  // derived from family by the engine
+  }
+
+  int next = (rank_ + 1) % world_;
+  s = ConnectAndWire(all_handles_[next]);
+  if (!s.ok()) return s;
+  // The bootstrap's job is done once the ring is wired; dropping it frees
+  // the coordinator port and rank 0's W-1 peer sockets so long-lived jobs
+  // don't pin fds and another communicator can reuse the address.
+  bootstrap_.reset();
+  return Status::Ok();
+}
+
+Status ScheduledCommunicator::ConnectAndWire(const SocketHandle& next_handle) {
+  Status s = net_->connect(0, next_handle, &channels_[0].send_comm);
+  if (!s.ok()) return s;
+  // Barrier BEFORE accept: once it passes, every rank has connected to its
+  // next, so our prev's bundle is already inbound and accept() cannot
+  // block forever. A rank that died earlier fails the barrier with a clean
+  // error instead of wedging the ring (observed: peer death between
+  // bootstrap and connect hung accept indefinitely).
+  s = bootstrap_->Barrier();
+  if (!s.ok()) return s;
+  return net_->accept(listen_comm_, &channels_[0].recv_comm);
+}
+
+// ---------------------------------------------------------------------------
+// Dispatch.
+
+CollAlgo ScheduledCommunicator::ResolveAlgo(CollKind coll, uint64_t nbytes) {
+  // Degenerate calls never reach a schedule (DoAllReduce/Broadcast
+  // early-return) — don't let them pollute the selection counters.
+  if (world_ <= 1 || nbytes == 0) return CollAlgo::kRing;
+  CollAlgo a = SelectCollAlgo(dispatch_, algo_override_, coll, nbytes, world_);
+  // Halving-doubling is an AllReduce shape; a Broadcast pinned (or table-
+  // routed) to rhd runs the ring relay — and the counter records what RAN.
+  if (coll == CollKind::kBroadcast && a == CollAlgo::kRhd) a = CollAlgo::kRing;
+  CountCollAlgoSelected(coll, a);
+  return a;
+}
+
+Status ScheduledCommunicator::DoAllReduce(const void* sendbuf, void* recvbuf,
+                                          size_t count, DType dtype, RedOp op,
+                                          RingChannel& ch, uint64_t seq,
+                                          CollAlgo algo) {
+  size_t esize = DTypeSize(dtype);
+  if (esize == 0) return Status::Invalid("bad dtype");
+  if (count == 0) return Status::Ok();
+  if (world_ == 1) {
+    if (sendbuf != recvbuf) memcpy(recvbuf, sendbuf, count * esize);
+    return Status::Ok();
+  }
+  switch (algo) {
+    case CollAlgo::kRhd:
+      return DoAllReduceRhd(sendbuf, recvbuf, count, dtype, op, seq);
+    case CollAlgo::kTree:
+      return DoAllReduceTree(sendbuf, recvbuf, count, dtype, op, seq);
+    default:
+      return DoAllReduceRing(sendbuf, recvbuf, count, dtype, op, ch, seq);
+  }
+}
+
+// Blocking AllReduce IS IAllReduce + WaitTicket. This is not a
+// convenience: the cross-rank matching rule (MPI/NCCL semantics) lets one
+// rank call AllReduce where another calls IAllReduce+wait for the same
+// collective, so BOTH kinds must consume the same ticket sequence — the
+// ticket->channel map is what pairs ring messages across ranks, and a
+// blocking call that bypassed it would desync (and never wire channels on
+// ranks that only ever call the blocking form). Schedule selection happens
+// at submission, identically for both forms.
+Status ScheduledCommunicator::AllReduce(const void* sendbuf, void* recvbuf,
+                                        size_t count, DType dtype, RedOp op) {
+  // Single-channel mode: everything rides channel 0 in submission order,
+  // so pairing cannot desync and the caller thread can run the schedule
+  // directly (no worker hop) — also the kill switch for the ticketed path.
+  if (AsyncChannelCount() == 1) {
+    FenceAsync();
     size_t esize = DTypeSize(dtype);
     if (esize == 0) return Status::Invalid("bad dtype");
-    if (count == 0) return Status::Ok();
-    if (world_ == 1) {
-      if (sendbuf != recvbuf) memcpy(recvbuf, sendbuf, count * esize);
-      return Status::Ok();
-    }
-    const bool tracing = Telemetry::Get().tracing_enabled();
-    PhaseSpan whole(tracing, trace_comm_id_, seq, "allreduce", -1, count * esize);
-    const uint8_t* src = static_cast<const uint8_t*>(sendbuf);
-    uint8_t* data = static_cast<uint8_t*>(recvbuf);
-    // Out-of-place with DISJOINT buffers needs no staging copy at all:
-    // round 0 sends from the caller's sendbuf, later rounds send the slice
-    // reduced the previous round (already in recvbuf), and every reduce
-    // reads its local operand from sendbuf while writing into recvbuf —
-    // every recvbuf slice is written (by RS or AG) before anything reads
-    // it, so the caller's input never needs to be there. Measured 2x
-    // on the 128 MiB out-of-place path (PERF_NOTES round 4): the memcpy
-    // plus first-touch faulting of a cold 128 MiB destination was as
-    // expensive as the whole ring on a 1-core host. Partially-overlapping
-    // buffers (C-ABI callers only; the Python binding never does this)
-    // keep the safe copy path.
-    bool oop = sendbuf != recvbuf;
-    if (oop && src < data + count * esize && data < src + count * esize) {
-      // Overlapping: stage (memmove — the ranges provably overlap).
-      memmove(recvbuf, sendbuf, count * esize);
-      oop = false;
-    }
-    const int W = world_;
-    auto off = [&](int i) { return (count * static_cast<size_t>(i)) / W; };
-
-    // vr relabels the ring so this rank finishes the RS phase owning slice
-    // `rank`, which the AG phase then circulates.
-    const int vr = (rank_ + W - 1) % W;
-    const bool codec_on = UseCodec(dtype);
-    size_t ag_slot = 0;
-    if (codec_on) {
-      // Park the AG phase's two wire slots at the BOTTOM of the channel
-      // scratch, before any RS chunk slot: the RS final round's fused
-      // handoff writes the owned slice's encoded bytes into AG slot 0, and
-      // they must survive the RS rounds' own scratch use.
-      ag_slot = CodecWireBytes(codec_, (count + W - 1) / W);
-      ch.scratch.reserve(2 * ag_slot +
-                         4 * CodecWireBytes(codec_, CodecChunkElems()));
-    }
-    for (int s = 0; s < W - 1; ++s) {
-      int sidx = (vr - s + W) % W;
-      int ridx = (vr - s - 1 + W) % W;
-      size_t sbytes = (off(sidx + 1) - off(sidx)) * esize;
-      size_t rbytes = (off(ridx + 1) - off(ridx)) * esize;
-      // Round s sends the slice reduced in round s-1; only round 0's send
-      // operand still lives in sendbuf on the no-copy path.
-      const uint8_t* sptr =
-          ((oop && s == 0) ? src : data) + off(sidx) * esize;
-      PhaseSpan step(tracing, trace_comm_id_, seq, "rs", s, sbytes);
-      Status st;
-      if (codec_on) {
-        // Final round reduces into this rank's owned slice (ridx == rank_):
-        // fuse the AG-entry quantize+encode into it.
-        uint8_t* fused = (s == W - 2) ? ch.scratch.data() : nullptr;
-        st = ExchangeReduceCodec(sptr, sbytes, data + off(ridx) * esize,
-                                 rbytes, op, ch,
-                                 oop ? src + off(ridx) * esize : nullptr,
-                                 fused, 2 * ag_slot);
-      } else {
-        st = ExchangeReduce(sptr, sbytes, data + off(ridx) * esize,
-                            rbytes, dtype, op, ch,
-                            oop ? src + off(ridx) * esize : nullptr);
-      }
-      if (!st.ok()) return st;
-    }
-    if (codec_on) {
-      return AgPhaseCodec(reinterpret_cast<float*>(data), count, ch, seq, tracing);
-    }
-    for (int s = 0; s < W - 1; ++s) {
-      int sidx = (rank_ - s + W) % W;
-      int ridx = (rank_ - s - 1 + W) % W;
-      size_t sbytes = (off(sidx + 1) - off(sidx)) * esize;
-      size_t rbytes = (off(ridx + 1) - off(ridx)) * esize;
-      PhaseSpan step(tracing, trace_comm_id_, seq, "ag", s, sbytes);
-      Status st = Exchange(data + off(sidx) * esize, sbytes, data + off(ridx) * esize,
-                           rbytes, nullptr, ch);
-      if (!st.ok()) return st;
-    }
-    return Status::Ok();
+    CollAlgo algo = ResolveAlgo(CollKind::kAllReduce, count * esize);
+    return DoAllReduce(sendbuf, recvbuf, count, dtype, op, channels_[0],
+                       ++coll_seq_, algo);
   }
+  // Fence first: the documented contract is that a blocking collective
+  // orders AFTER all outstanding tickets (callers rely on it for buffer
+  // reuse). Fencing consumes no ticket, so it cannot desync pairing.
+  FenceAsync();
+  uint64_t ticket = 0;
+  Status s = IAllReduce(sendbuf, recvbuf, count, dtype, op, &ticket);
+  if (!s.ok()) return s;
+  return WaitTicket(ticket);
+}
 
-  Status ReduceScatter(const void* sendbuf, void* recvbuf, size_t recv_count, DType dtype,
-                       RedOp op) override {
-    FenceAsync();
-    size_t esize = DTypeSize(dtype);
-    if (esize == 0) return Status::Invalid("bad dtype");
-    if (recv_count == 0) return Status::Ok();
-    const int W = world_;
-    if (W == 1) {
-      if (sendbuf != recvbuf) memcpy(recvbuf, sendbuf, recv_count * esize);
-      return Status::Ok();
-    }
-    size_t block = recv_count * esize;
-    const uint8_t* src = static_cast<const uint8_t*>(sendbuf);
-    uint8_t* out = static_cast<uint8_t*>(recvbuf);
-    const bool tracing = Telemetry::Get().tracing_enabled();
-    const uint64_t seq = ++coll_seq_;
-    PhaseSpan whole(tracing, trace_comm_id_, seq, "reduce_scatter", -1,
-                    static_cast<uint64_t>(W) * block);
-    if (out < src + static_cast<size_t>(W) * block && src < out + block) {
-      // Overlapping C-ABI buffers: keep the safe full-copy path.
-      work_.reserve(static_cast<size_t>(W) * block);
-      memcpy(work_.data(), sendbuf, static_cast<size_t>(W) * block);
-      const int vr0 = (rank_ + W - 1) % W;
-      for (int s = 0; s < W - 1; ++s) {
-        int sidx = (vr0 - s + W) % W;
-        int ridx = (vr0 - s - 1 + W) % W;
-        PhaseSpan step(tracing, trace_comm_id_, seq, "rs", s, block);
-        Status st = ExchangeReduce(work_.data() + sidx * block, block,
-                                   work_.data() + ridx * block, block, dtype, op, channels_[0]);
-        if (!st.ok()) return st;
-      }
-      memcpy(recvbuf, work_.data() + rank_ * block, block);
-      return Status::Ok();
-    }
-    // No staging copy of the W-block input: each round's reduce reads its
-    // local operand from the caller's sendbuf; partials land in a 2-block
-    // ping-pong scratch (a round's output is the NEXT round's send
-    // operand), and the final round — whose target is this rank's owned
-    // block — writes straight into recvbuf. Scratch is 2 blocks instead of
-    // the previous W, and the O(W·B) memcpy is gone. W=2's single round
-    // goes sendbuf->recvbuf directly and needs no scratch at all (resizing
-    // it would zero-fill + fault pages for nothing — the cost class this
-    // path exists to avoid).
-    uint8_t* pb[2] = {nullptr, nullptr};
-    if (W > 2) {
-      work_.reserve(2 * block);
-      pb[0] = work_.data();
-      pb[1] = work_.data() + block;
-    }  // W==2: single round goes sendbuf->recvbuf, pb never read
-    const int vr = (rank_ + W - 1) % W;
-    for (int s = 0; s < W - 1; ++s) {
-      int sidx = (vr - s + W) % W;
-      int ridx = (vr - s - 1 + W) % W;
-      const uint8_t* sptr = (s == 0) ? src + sidx * block : pb[(s - 1) & 1];
-      uint8_t* optr = (s == W - 2) ? out : pb[s & 1];
-      PhaseSpan step(tracing, trace_comm_id_, seq, "rs", s, block);
-      Status st = ExchangeReduce(sptr, block, optr, block, dtype, op,
-                                 channels_[0], src + ridx * block);
-      if (!st.ok()) return st;
-    }
-    return Status::Ok();
-  }
+Status ScheduledCommunicator::Broadcast(void* buf, size_t nbytes, int root) {
+  FenceAsync();
+  if (world_ == 1 || nbytes == 0) return Status::Ok();
+  if (root < 0 || root >= world_) return Status::Invalid("bad broadcast root");
+  CollAlgo algo = ResolveAlgo(CollKind::kBroadcast, nbytes);
+  uint64_t seq = ++coll_seq_;
+  if (algo == CollAlgo::kTree) return DoBroadcastTree(buf, nbytes, root, seq);
+  return DoBroadcastRing(buf, nbytes, root, seq);
+}
 
-  Status AllGather(const void* sendbuf, void* recvbuf, size_t bytes_per_rank) override {
-    FenceAsync();
-    const int W = world_;
-    uint8_t* out = static_cast<uint8_t*>(recvbuf);
-    if (out + rank_ * bytes_per_rank != sendbuf) {
-      memcpy(out + rank_ * bytes_per_rank, sendbuf, bytes_per_rank);
-    }
-    if (W == 1 || bytes_per_rank == 0) return Status::Ok();
-    const bool tracing = Telemetry::Get().tracing_enabled();
-    const uint64_t seq = ++coll_seq_;
-    PhaseSpan whole(tracing, trace_comm_id_, seq, "all_gather", -1,
-                    static_cast<uint64_t>(W) * bytes_per_rank);
-    for (int s = 0; s < W - 1; ++s) {
-      int sidx = (rank_ - s + W) % W;
-      int ridx = (rank_ - s - 1 + W) % W;
-      PhaseSpan step(tracing, trace_comm_id_, seq, "ag", s, bytes_per_rank);
-      Status st = Exchange(out + sidx * bytes_per_rank, bytes_per_rank,
-                           out + ridx * bytes_per_rank, bytes_per_rank, nullptr, channels_[0]);
-      if (!st.ok()) return st;
-    }
-    return Status::Ok();
-  }
+// ---------------------------------------------------------------------------
+// Mesh wiring + the byte-oriented collectives that ride it.
 
-  Status Broadcast(void* buf, size_t nbytes, int root) override {
-    FenceAsync();
-    const int W = world_;
-    if (W == 1 || nbytes == 0) return Status::Ok();
-    if (root < 0 || root >= W) return Status::Invalid("bad broadcast root");
-    PhaseSpan whole(Telemetry::Get().tracing_enabled(), trace_comm_id_, ++coll_seq_,
-                    "broadcast", -1, nbytes);
-    uint8_t* data = static_cast<uint8_t*>(buf);
-    int dist = (rank_ - root + W) % W;          // hops from root along the ring
-    bool is_tail = dist == W - 1;               // last rank forwards nothing
-    size_t nchunks = (nbytes + kBcastChunk - 1) / kBcastChunk;
-
-    // Pipelined forward: receive chunk c, then send it on while chunk c+1 is
-    // in flight — the ring streams instead of store-and-forwarding the
-    // whole buffer W-1 times.
-    std::vector<uint64_t> pending_sends;
-    for (size_t c = 0; c < nchunks; ++c) {
-      size_t coff = c * kBcastChunk;
-      size_t clen = std::min(kBcastChunk, nbytes - coff);
-      if (dist != 0) {
-        uint64_t rreq = 0;
-        Status st = net_->irecv(channels_[0].recv_comm, data + coff, clen, &rreq);
-        if (!st.ok()) return DrainSends(pending_sends, st);
-        size_t got = 0;
-        st = WaitRequest(rreq, &got);
-        if (!st.ok()) return DrainSends(pending_sends, st);
-        if (got != clen) {
-          return DrainSends(pending_sends, Status::Inner("broadcast chunk size mismatch"));
-        }
-      }
-      if (!is_tail) {
-        uint64_t sreq = 0;
-        Status st = net_->isend(channels_[0].send_comm, data + coff, clen, &sreq);
-        if (!st.ok()) return DrainSends(pending_sends, st);
-        pending_sends.push_back(sreq);
-      }
-    }
-    return DrainSends(pending_sends, Status::Ok());
-  }
-
-  Status AllToAll(const void* sendbuf, void* recvbuf, size_t bytes_per_rank) override {
-    FenceAsync();
-    const int W = world_;
-    const size_t B = bytes_per_rank;
-    const uint8_t* in = static_cast<const uint8_t*>(sendbuf);
-    uint8_t* out = static_cast<uint8_t*>(recvbuf);
-    if (static_cast<const void*>(out) != sendbuf) {
-      memcpy(out + rank_ * B, in + rank_ * B, B);  // own block stays local
-    }
-    if (W == 1 || B == 0) return Status::Ok();
-    PhaseSpan whole(Telemetry::Get().tracing_enabled(), trace_comm_id_, ++coll_seq_,
-                    "all_to_all", -1, static_cast<uint64_t>(W) * B);
-    // Direct pairwise exchange by default: O(W*B) bytes on the wire per
-    // rank vs the ring relay's O(W^2*B/2) — the difference between usable
-    // and quadratic cross-host MoE dispatch / DCN-Ulysses at pod scale.
-    // TPUNET_A2A=ring keeps the relay (no extra comms; fine at tiny W).
-    // The mesh costs 2*(W-1) comms per rank, each nstreams+1 fds and
-    // nstreams+1 threads, so very large worlds fall back to the relay
-    // rather than exhausting fds/threads; raise TPUNET_A2A_MESH_MAX_WORLD
-    // on hosts provisioned for it (the long-term fix is single-stream
-    // mesh comms, which need a per-connect nstreams override in Net).
-    static const bool use_ring = GetEnv("TPUNET_A2A", "pairwise") == "ring";
-    static const uint64_t mesh_max_world =
-        GetEnvU64("TPUNET_A2A_MESH_MAX_WORLD", 32);
-    if (!use_ring && static_cast<uint64_t>(W) <= mesh_max_world) {
-      return PairwiseAllToAll(in, out, B);
-    }
-
-    // Store-and-forward relay. Packet invariant at step s: the packet holds
-    // nblk = W-1-s blocks; position p carries the block with nblk-p hops of
-    // remaining travel (descending). After one Exchange hop every block's
-    // remaining distance drops by one: the last block has arrived (it is the
-    // block rank (rank-s-1) addressed to us), the rest forward verbatim next
-    // step. Both sides compute identical per-step sizes, so the fixed-size
-    // Exchange path (got=nullptr) catches rank disagreement as an error.
-    a2a_fwd_.reserve(static_cast<size_t>(W - 1) * B);
-    a2a_rcv_.reserve(static_cast<size_t>(W - 1) * B);
-    for (int p = 0; p < W - 1; ++p) {
-      int dest = (rank_ + (W - 1 - p)) % W;
-      memcpy(a2a_fwd_.data() + static_cast<size_t>(p) * B, in + dest * B, B);
-    }
-    for (int s = 0; s < W - 1; ++s) {
-      size_t nblk = static_cast<size_t>(W - 1 - s);
-      Status st = Exchange(a2a_fwd_.data(), nblk * B, a2a_rcv_.data(), nblk * B, nullptr,
-                           channels_[0]);
-      if (!st.ok()) return st;
-      int src = (rank_ - s - 1 + W) % W;
-      memcpy(out + src * B, a2a_rcv_.data() + (nblk - 1) * B, B);
-      a2a_fwd_.swap(a2a_rcv_);
-    }
-    return Status::Ok();
-  }
-
-  // Accept one inbound comm off the shared listener and read its 8-byte
-  // identifying hello. On failure the comm (if any) is closed. Shared by
-  // the two lazy wiring paths (pairwise mesh, async ring channels), which
-  // differ only in how they encode/validate the hello.
-  Status AcceptHello(uint64_t* rc, uint64_t* hello) {
+// Accept one inbound comm off the shared listener and read its 8-byte
+// identifying hello. On failure the comm (if any) is closed. Shared by
+// the two lazy wiring paths (pairwise mesh, async ring channels), which
+// differ only in how they encode/validate the hello.
+Status ScheduledCommunicator::AcceptHello(uint64_t* rc, uint64_t* hello) {
+  *rc = 0;
+  Status s = net_->accept(listen_comm_, rc);
+  if (!s.ok()) return s;
+  uint8_t buf[8] = {0};
+  uint64_t req = 0;
+  size_t got = 0;
+  s = net_->irecv(*rc, buf, sizeof(buf), &req);
+  if (s.ok()) s = net_->wait(req, &got);
+  if (s.ok() && got != sizeof(buf)) s = Status::Inner("wiring hello truncated");
+  if (!s.ok()) {
+    net_->close_recv(*rc);
     *rc = 0;
-    Status s = net_->accept(listen_comm_, rc);
-    if (!s.ok()) return s;
-    uint8_t buf[8] = {0};
-    uint64_t req = 0;
-    size_t got = 0;
-    s = net_->irecv(*rc, buf, sizeof(buf), &req);
-    if (s.ok()) s = net_->wait(req, &got);
-    if (s.ok() && got != sizeof(buf)) s = Status::Inner("wiring hello truncated");
-    if (!s.ok()) {
-      net_->close_recv(*rc);
-      *rc = 0;
-      return s;
-    }
-    *hello = DecodeU64BE(buf);
-    return Status::Ok();
-  }
-
-  // Connect to a peer's listener and identify the new comm with an 8-byte
-  // hello — the other half of AcceptHello.
-  Status ConnectHello(int peer, uint64_t hello, uint64_t* comm) {
-    Status s = net_->connect(0, all_handles_[peer], comm);
-    if (!s.ok()) return s;
-    uint8_t buf[8];
-    EncodeU64BE(hello, buf);
-    uint64_t req = 0;
-    s = net_->isend(*comm, buf, sizeof(buf), &req);
-    if (s.ok()) s = net_->wait(req, nullptr);
     return s;
   }
+  *hello = DecodeU64BE(buf);
+  return Status::Ok();
+}
 
-  // Lazily wire one send + one recv comm per peer over the listeners whose
-  // handles Init gathered. Every rank first issues all its connects (TCP
-  // backlog + buffered preamble mean connect never blocks on the peer
-  // calling accept), sends an 8-byte rank hello on each new comm, then
-  // accepts its W-1 inbound comms and reads the hellos to key them by
-  // peer — no bootstrap round, no cross-rank ordering assumption.
-  Status EnsureMesh() {
-    if (!mesh_send_.empty()) return Status::Ok();
-    const int W = world_;
-    std::vector<uint64_t> msend(W, 0), mrecv(W, 0);
-    Status result = Status::Ok();
-    for (int p = 0; p < W && result.ok(); ++p) {
-      if (p == rank_) continue;
-      result = ConnectHello(p, static_cast<uint64_t>(rank_), &msend[p]);
-    }
-    for (int i = 0; i < W - 1 && result.ok(); ++i) {
-      uint64_t rc = 0, peer = 0;
-      result = AcceptHello(&rc, &peer);
-      if (!result.ok()) break;
-      if (peer >= static_cast<uint64_t>(W) || peer == static_cast<uint64_t>(rank_) ||
-          mrecv[peer] != 0) {
-        net_->close_recv(rc);
-        result = Status::Inner("mesh hello names invalid peer rank " +
-                               std::to_string(peer));
-      } else {
-        mrecv[peer] = rc;
-      }
-    }
-    if (!result.ok()) {
-      for (uint64_t c : msend) {
-        if (c) net_->close_send(c);
-      }
-      for (uint64_t c : mrecv) {
-        if (c) net_->close_recv(c);
-      }
-      return result;
-    }
-    mesh_send_ = std::move(msend);
-    mesh_recv_ = std::move(mrecv);
-    return Status::Ok();
+// Connect to a peer's listener and identify the new comm with an 8-byte
+// hello — the other half of AcceptHello.
+Status ScheduledCommunicator::ConnectHello(int peer, uint64_t hello, uint64_t* comm) {
+  Status s = net_->connect(0, all_handles_[peer], comm);
+  if (!s.ok()) return s;
+  uint8_t buf[8];
+  EncodeU64BE(hello, buf);
+  uint64_t req = 0;
+  s = net_->isend(*comm, buf, sizeof(buf), &req);
+  if (s.ok()) s = net_->wait(req, nullptr);
+  return s;
+}
+
+// Lazily wire one send + one recv comm per peer over the listeners whose
+// handles Init gathered. Every rank first issues all its connects (TCP
+// backlog + buffered preamble mean connect never blocks on the peer
+// calling accept), sends an 8-byte rank hello on each new comm, then
+// accepts its W-1 inbound comms and reads the hellos to key them by
+// peer — no bootstrap round, no cross-rank ordering assumption.
+Status ScheduledCommunicator::EnsureMesh() {
+  if (!mesh_send_.empty()) return Status::Ok();
+  const int W = world_;
+  std::vector<uint64_t> msend(W, 0), mrecv(W, 0);
+  Status result = Status::Ok();
+  for (int p = 0; p < W && result.ok(); ++p) {
+    if (p == rank_) continue;
+    result = ConnectHello(p, static_cast<uint64_t>(rank_), &msend[p]);
   }
-
-  // One B-sized message to every peer, one from every peer, all posted
-  // up-front on dedicated per-peer comms (so no message queues behind
-  // another), then quiesced recv-first. O(W*B) wire bytes per rank.
-  Status PairwiseAllToAll(const uint8_t* in, uint8_t* out, size_t B) {
-    Status st = EnsureMesh();
-    if (!st.ok()) return st;
-    const int W = world_;
-    // In-place callers overwrite recv block p while block p is still being
-    // sent to peer p (send/recv blocks coincide in this collective) — stage
-    // the outgoing blocks.
-    const uint8_t* src = in;
-    if (in == out) {
-      a2a_fwd_.reserve(static_cast<size_t>(W) * B);
-      memcpy(a2a_fwd_.data(), in, static_cast<size_t>(W) * B);
-      src = a2a_fwd_.data();
+  for (int i = 0; i < W - 1 && result.ok(); ++i) {
+    uint64_t rc = 0, peer = 0;
+    result = AcceptHello(&rc, &peer);
+    if (!result.ok()) break;
+    if (peer >= static_cast<uint64_t>(W) || peer == static_cast<uint64_t>(rank_) ||
+        mrecv[peer] != 0) {
+      net_->close_recv(rc);
+      result = Status::Inner("mesh hello names invalid peer rank " +
+                             std::to_string(peer));
+    } else {
+      mrecv[peer] = rc;
     }
-    std::vector<uint64_t> rreqs, sreqs;
-    std::vector<int> rpeers, speers;
-    Status first = Status::Ok();
-    for (int s = 1; s < W; ++s) {
-      int to = (rank_ + s) % W;
-      int from = (rank_ - s + W) % W;
-      uint64_t rreq = 0, sreq = 0;
-      Status a = net_->irecv(mesh_recv_[from], out + from * B, B, &rreq);
-      if (a.ok()) {
-        rreqs.push_back(rreq);
-        rpeers.push_back(from);
-      } else if (first.ok()) {
-        first = a;
-      }
-      Status b = net_->isend(mesh_send_[to], src + to * B, B, &sreq);
-      if (b.ok()) {
-        sreqs.push_back(sreq);
-        speers.push_back(to);
-      } else if (first.ok()) {
-        first = b;
-      }
-    }
-    for (size_t i = 0; i < rreqs.size(); ++i) {
-      size_t got = 0;
-      Status a = net_->wait(rreqs[i], &got);
-      if (a.ok() && got != B) {
-        a = Status::Inner("all_to_all block from rank " + std::to_string(rpeers[i]) +
-                          ": got " + std::to_string(got) + "B, want " + std::to_string(B));
-      }
-      if (!a.ok() && first.ok()) first = a;
-    }
-    for (size_t i = 0; i < sreqs.size(); ++i) {
-      Status b = net_->wait(sreqs[i], nullptr);
-      if (!b.ok() && first.ok()) {
-        first = Status{b.kind, "all_to_all send to rank " +
-                                   std::to_string(speers[i]) + ": " + b.msg};
-      }
-    }
-    return first;
   }
-
-  Status NeighborExchange(const void* sendbuf, size_t send_nbytes, void* recvbuf,
-                          size_t recv_nbytes, size_t* got) override {
-    FenceAsync();
-    if (world_ == 1) {
-      if (send_nbytes > recv_nbytes) return Status::Invalid("recv buffer too small");
-      memcpy(recvbuf, sendbuf, send_nbytes);
-      if (got) *got = send_nbytes;
-      return Status::Ok();
+  if (!result.ok()) {
+    for (uint64_t c : msend) {
+      if (c) net_->close_send(c);
     }
-    PhaseSpan whole(Telemetry::Get().tracing_enabled(), trace_comm_id_, ++coll_seq_,
-                    "neighbor_exchange", -1, send_nbytes);
-    return Exchange(sendbuf, send_nbytes, recvbuf, recv_nbytes, got, channels_[0]);
-  }
-
-  Status Barrier() override {
-    if (world_ == 1) return Status::Ok();
-    barrier_scratch_.resize(world_);
-    uint8_t token = 1;
-    return AllGather(&token, barrier_scratch_.data(), 1);  // fences via AllGather
-  }
-
-  Status IAllReduce(const void* sendbuf, void* recvbuf, size_t count, DType dtype,
-                    RedOp op, uint64_t* ticket) override {
-    MutexLock lk(async_mu_);
-    if (!worker_started_) {
-      // First async collective: wire the extra channels and spawn one worker
-      // per channel. Safe to touch the listener here — the communicator runs
-      // one collective program, so every rank reaches its first IAllReduce at
-      // the same point of it and nothing else is mid-accept.
-      Status s = EnsureAsyncChannels(AsyncChannelCount());
-      if (!s.ok()) return s;
-      queues_.resize(channels_.size());
-      running_.assign(channels_.size(), 0);
-      worker_started_ = true;
-      for (size_t c = 0; c < channels_.size(); ++c) {
-        workers_.emplace_back([this, c] { AsyncWorkerLoop(c); });
-      }
-    }
-    uint64_t t = next_ticket_++;
-    // Trace seq is claimed at SUBMISSION (same order on every rank), not at
-    // execution, so spans from overlapping tickets keep cross-rank-stable
-    // tags.
-    uint64_t seq = ++coll_seq_;
-    // Deterministic ticket→channel map: submission order is already the
-    // cross-rank contract for nonblocking collectives, so every rank routes
-    // ticket t to the same ring and messages pair up peer-to-peer.
-    size_t ch = (t - 1) % queues_.size();
-    queues_[ch].emplace_back(t, [this, sendbuf, recvbuf, count, dtype, op, ch, seq] {
-      return DoAllReduce(sendbuf, recvbuf, count, dtype, op, channels_[ch], seq);
-    });
-    *ticket = t;
-    work_cv_.NotifyAll();
-    return Status::Ok();
-  }
-
-  Status WaitTicket(uint64_t ticket) override {
-    MutexLock lk(async_mu_);
-    if (!TicketLive(ticket)) return Status::Invalid("unknown or already-waited ticket");
-    // Also wake if the ticket stops being live without completing (shutdown
-    // dropped it, or a racing waiter claimed it) — never sleep forever.
-    while (done_.count(ticket) == 0 && TicketLive(ticket)) done_cv_.Wait(async_mu_);
-    auto it = done_.find(ticket);
-    if (it == done_.end()) {
-      return Status::Invalid("ticket abandoned (shutdown or waited elsewhere)");
-    }
-    Status s = it->second;
-    done_.erase(it);
-    return s;
-  }
-
-  Status TestTicket(uint64_t ticket, bool* done) override {
-    MutexLock lk(async_mu_);
-    auto it = done_.find(ticket);
-    if (it != done_.end()) {
-      *done = true;
-      return Status::Ok();
-    }
-    if (!TicketLive(ticket)) return Status::Invalid("unknown or already-waited ticket");
-    *done = false;
-    return Status::Ok();
-  }
-
-  int rank() const override { return rank_; }
-  int world_size() const override { return world_; }
-  int32_t wire_codec() const override { return static_cast<int32_t>(codec_); }
-
- private:
-  // The codec engages only where elements are KNOWN f32: AllReduce /
-  // ReduceScatter payloads and the AG phase inside AllReduce. The
-  // byte-oriented collectives (AllGather, Broadcast, AllToAll,
-  // NeighborExchange, Barrier) carry opaque bytes — rendezvous handles,
-  // tokens, arbitrary dtypes — and are never lossily compressed
-  // (docs/DESIGN.md "Compressed collectives").
-  bool UseCodec(DType dtype) const {
-    return codec_ != WireCodec::kF32 && dtype == DType::kF32 && world_ > 1;
-  }
-  // One pipelined reduce ring step: send `sendbuf` to next while receiving
-  // the same-size slice from prev in chunks, folding each received chunk
-  // into `accum` (element count = slice bytes / esize) as soon as it lands —
-  // chunk i's Reduce overlaps chunk i+1's transfer. Double-buffered scratch;
-  // all in-flight requests are quiesced before returning, even on error.
-  // `local` is the left operand of the reduce (accum = local op incoming);
-  // nullptr = accum itself (the classic in-place accumulate). A distinct
-  // local lets out-of-place collectives read the caller's sendbuf directly
-  // and write partials straight into recvbuf — no staging copy anywhere.
-  Status ExchangeReduce(const uint8_t* sendbuf, size_t send_nbytes, uint8_t* accum,
-                        size_t recv_nbytes, DType dtype, RedOp op, RingChannel& ch,
-                        const uint8_t* local = nullptr) {
-    if (local == nullptr) local = accum;
-    if (UseCodec(dtype)) {
-      return ExchangeReduceCodec(sendbuf, send_nbytes, accum, recv_nbytes, op,
-                                 ch, local);
-    }
-    size_t esize = DTypeSize(dtype);
-    size_t chunk = RingChunkBytes() / esize * esize;
-    if (chunk == 0 || (send_nbytes <= chunk && recv_nbytes <= chunk)) {
-      ch.scratch.reserve(recv_nbytes);
-      Status st = Exchange(sendbuf, send_nbytes, ch.scratch.data(), recv_nbytes, nullptr, ch);
-      if (!st.ok()) return st;
-      Reduce(accum, local, ch.scratch.data(), recv_nbytes / esize, dtype, op);
-      return Status::Ok();
-    }
-    // Send and recv slice sizes can differ (ring slices are count*i/W
-    // splits); each side chunks ITS byte count with the shared chunk size,
-    // which matches what the peer computes for the same bytes. A chunk-size
-    // mismatch between ranks surfaces as a size-mismatch error below.
-    size_t ns = (send_nbytes + chunk - 1) / chunk;
-    size_t nr = (recv_nbytes + chunk - 1) / chunk;
-    size_t n = std::max(ns, nr);
-    ch.scratch.reserve(2 * chunk);
-    auto slen = [&](size_t i) { return std::min(chunk, send_nbytes - i * chunk); };
-    auto rlen = [&](size_t i) { return std::min(chunk, recv_nbytes - i * chunk); };
-
-    uint64_t rreq[2] = {0, 0}, sreq[2] = {0, 0};
-    bool rlive[2] = {false, false}, slive[2] = {false, false};
-    auto post = [&](size_t i) -> Status {
-      int slot = i & 1;
-      if (i < nr) {
-        Status st =
-            net_->irecv(ch.recv_comm, ch.scratch.data() + slot * chunk, rlen(i), &rreq[slot]);
-        if (!st.ok()) return st;
-        rlive[slot] = true;
-      }
-      if (i < ns) {
-        Status st = net_->isend(ch.send_comm, sendbuf + i * chunk, slen(i), &sreq[slot]);
-        if (!st.ok()) return st;
-        slive[slot] = true;
-      }
-      return Status::Ok();
-    };
-    auto quiesce = [&](Status primary) {
-      for (int b = 0; b < 2; ++b) {
-        if (rlive[b]) WaitRequest(rreq[b], nullptr);
-        if (slive[b]) WaitRequest(sreq[b], nullptr);
-      }
-      return primary;
-    };
-
-    Status st = post(0);
-    if (!st.ok()) return quiesce(st);
-    for (size_t i = 0; i < n; ++i) {
-      int slot = i & 1;
-      bool has_r = i < nr;
-      if (has_r) {
-        size_t got = 0;
-        st = WaitRequest(rreq[slot], &got);
-        rlive[slot] = false;
-        if (!st.ok()) return quiesce(st);
-        if (got != rlen(i)) {
-          return quiesce(Status::Inner(
-              "ring step size mismatch: expected " + std::to_string(rlen(i)) +
-              "B chunk, got " + std::to_string(got) +
-              "B (ranks disagree on collective arguments or TPUNET_RING_CHUNKSIZE?)"));
-        }
-      }
-      if (i + 1 < n) {
-        st = post(i + 1);  // keep the wire busy while we reduce chunk i
-        if (!st.ok()) return quiesce(st);
-      }
-      if (has_r) {
-        Reduce(accum + i * chunk, local + i * chunk,
-               ch.scratch.data() + slot * chunk, rlen(i) / esize, dtype, op);
-      }
-      if (i < ns) {
-        st = WaitRequest(sreq[slot], nullptr);
-        slive[slot] = false;
-        if (!st.ok()) return quiesce(st);
-      }
-    }
-    return Status::Ok();
-  }
-
-  // Codec variant of ExchangeReduce for f32 payloads (docs/DESIGN.md
-  // "Compressed collectives"): each chunk is ENCODED into a scratch slot
-  // right before its isend and runs a FUSED decode+reduce straight off the
-  // recv slot — the accumulator (and the local operand) stay f32, so
-  // quantization error enters once per wire hop and never compounds in the
-  // running sum. Chunk boundaries are computed over ELEMENT counts exactly
-  // like the uncompressed path, so both peers derive identical per-chunk
-  // wire sizes from their own payload byte counts; a rank disagreement
-  // surfaces as the same size-mismatch error. Double-buffered recv AND send
-  // slots (the encode is a staging copy the zero-copy f32 path avoids —
-  // that copy is the price of shipping half/quarter the bytes).
-  // Payload elements per pipeline chunk, sized so the WIRE chunk — not the
-  // payload chunk — lands on the tuned TPUNET_RING_CHUNKSIZE granularity:
-  // the ring's per-chunk costs (ctrl frames, request churn, stream
-  // scheduling) are paid per chunk regardless of its size, so a compressed
-  // chunk must carry as many wire bytes as an uncompressed one or
-  // compression halves the bytes but none of the per-chunk overhead
-  // (measured: payload-sized bf16 chunks left the whole RS phase at f32
-  // speed). int8 chunks stay multiples of the scale block so the per-chunk
-  // encoding is byte-identical to a whole-slice encode (the fused RS->AG
-  // handoff and the AG receiver both rely on that).
-  size_t CodecChunkElems() const {
-    size_t ce;
-    switch (codec_) {
-      case WireCodec::kBF16:
-        ce = RingChunkBytes() / 2;  // 2 wire bytes per element
-        break;
-      case WireCodec::kI8:
-        ce = RingChunkBytes() & ~(kI8CodecBlock - 1);  // ~1 wire byte/element
-        if (ce < kI8CodecBlock) ce = kI8CodecBlock;
-        break;
-      default:
-        ce = RingChunkBytes() / 4;
-        break;
-    }
-    return std::max<size_t>(ce, 1);
-  }
-
-  // `fused_enc` (optional): run the RS->AG handoff kernel on every received
-  // chunk — the accumulator comes out QUANTIZED (bit-identical to what peers
-  // will decode) and its encoded form lands at fused_enc, laid out exactly
-  // like a whole-slice encode, ready to be the AG phase's first send.
-  // `scratch_off`: byte offset into ch.scratch below which the caller has
-  // staged bytes this call must not clobber.
-  Status ExchangeReduceCodec(const uint8_t* sendbuf, size_t send_nbytes,
-                             uint8_t* accum, size_t recv_nbytes, RedOp op,
-                             RingChannel& ch, const uint8_t* local,
-                             uint8_t* fused_enc = nullptr,
-                             size_t scratch_off = 0) {
-    if (local == nullptr) local = accum;  // classic in-place accumulate
-    const float* send_f = reinterpret_cast<const float*>(sendbuf);
-    float* acc_f = reinterpret_cast<float*>(accum);
-    const float* loc_f = reinterpret_cast<const float*>(local);
-    const WireRedOp wop = ToWireRedOp(op);
-    const size_t send_n = send_nbytes / 4;
-    const size_t recv_n = recv_nbytes / 4;
-    const size_t chunk_elems = CodecChunkElems();
-
-    if (send_n <= chunk_elems && recv_n <= chunk_elems) {
-      size_t rw = CodecWireBytes(codec_, recv_n);
-      size_t sw = CodecWireBytes(codec_, send_n);
-      ch.scratch.reserve(scratch_off + rw + sw);
-      uint8_t* rbuf = ch.scratch.data() + scratch_off;
-      uint8_t* sbuf = rbuf + rw;
-      CodecEncode(codec_, send_f, sbuf, send_n);
-      Status st = Exchange(sbuf, sw, rbuf, rw, nullptr, ch);
-      if (!st.ok()) return st;
-      if (fused_enc != nullptr) {
-        CodecDecodeReduceQuantize(codec_, acc_f, loc_f, rbuf, fused_enc, recv_n, wop);
-      } else {
-        CodecDecodeReduce(codec_, acc_f, loc_f, rbuf, recv_n, wop);
-      }
-      return Status::Ok();
-    }
-
-    const size_t ns = (send_n + chunk_elems - 1) / chunk_elems;
-    const size_t nr = (recv_n + chunk_elems - 1) / chunk_elems;
-    const size_t n = std::max(ns, nr);
-    const size_t slot_bytes = CodecWireBytes(codec_, chunk_elems);
-    // 2 recv + 2 send wire slots, after whatever the caller staged below
-    // scratch_off (DoAllReduce parks the AG slots there — reserve only
-    // grows, so their bytes survive this call).
-    ch.scratch.reserve(scratch_off + 4 * slot_bytes);
-    uint8_t* base = ch.scratch.data() + scratch_off;
-    auto rbuf = [&](size_t i) { return base + (i & 1) * slot_bytes; };
-    auto sbuf = [&](size_t i) { return base + (2 + (i & 1)) * slot_bytes; };
-    auto selems = [&](size_t i) { return std::min(chunk_elems, send_n - i * chunk_elems); };
-    auto relems = [&](size_t i) { return std::min(chunk_elems, recv_n - i * chunk_elems); };
-
-    uint64_t rreq[2] = {0, 0}, sreq[2] = {0, 0};
-    bool rlive[2] = {false, false}, slive[2] = {false, false};
-    auto post = [&](size_t i) -> Status {
-      int slot = i & 1;
-      if (i < nr) {
-        Status st = net_->irecv(ch.recv_comm, rbuf(i),
-                                CodecWireBytes(codec_, relems(i)), &rreq[slot]);
-        if (!st.ok()) return st;
-        rlive[slot] = true;
-      }
-      if (i < ns) {
-        // Encode right before the isend: slot (i&1)'s previous send (i-2)
-        // was waited at the tail of iteration i-2, so the staging bytes are
-        // free to overwrite, and the encode of chunk i overlaps the wire
-        // moving chunk i-1.
-        CodecEncode(codec_, send_f + i * chunk_elems, sbuf(i), selems(i));
-        Status st = net_->isend(ch.send_comm, sbuf(i),
-                                CodecWireBytes(codec_, selems(i)), &sreq[slot]);
-        if (!st.ok()) return st;
-        slive[slot] = true;
-      }
-      return Status::Ok();
-    };
-    auto quiesce = [&](Status primary) {
-      for (int b = 0; b < 2; ++b) {
-        if (rlive[b]) WaitRequest(rreq[b], nullptr);
-        if (slive[b]) WaitRequest(sreq[b], nullptr);
-      }
-      return primary;
-    };
-
-    Status st = post(0);
-    if (!st.ok()) return quiesce(st);
-    for (size_t i = 0; i < n; ++i) {
-      int slot = i & 1;
-      bool has_r = i < nr;
-      if (has_r) {
-        size_t got = 0;
-        st = WaitRequest(rreq[slot], &got);
-        rlive[slot] = false;
-        if (!st.ok()) return quiesce(st);
-        if (got != CodecWireBytes(codec_, relems(i))) {
-          return quiesce(Status::Inner(
-              "ring step size mismatch: expected " +
-              std::to_string(CodecWireBytes(codec_, relems(i))) +
-              "B encoded chunk, got " + std::to_string(got) +
-              "B (ranks disagree on collective arguments, TPUNET_RING_CHUNKSIZE "
-              "or TPUNET_WIRE_DTYPE?)"));
-        }
-      }
-      if (i + 1 < n) {
-        st = post(i + 1);  // keep the wire busy while we decode+reduce chunk i
-        if (!st.ok()) return quiesce(st);
-      }
-      if (has_r) {
-        if (fused_enc != nullptr) {
-          // Chunks are block-aligned (CodecChunkElems), so the wire offset
-          // of chunk i inside the whole-slice encoding is exact.
-          CodecDecodeReduceQuantize(codec_, acc_f + i * chunk_elems,
-                                    loc_f + i * chunk_elems, rbuf(i),
-                                    fused_enc + CodecWireBytes(codec_, i * chunk_elems),
-                                    relems(i), wop);
-        } else {
-          CodecDecodeReduce(codec_, acc_f + i * chunk_elems, loc_f + i * chunk_elems,
-                            rbuf(i), relems(i), wop);
-        }
-      }
-      if (i < ns) {
-        st = WaitRequest(sreq[slot], nullptr);
-        slive[slot] = false;
-        if (!st.ok()) return quiesce(st);
-      }
-    }
-    return Status::Ok();
-  }
-
-  // Codec variant of the AllReduce AG phase ("AllGather passthrough":
-  // encode-only, no reduce). Slices travel ENCODED, and the encoded bytes
-  // are forwarded VERBATIM hop to hop while each rank decodes a private f32
-  // copy — so every rank materializes BIT-IDENTICAL values for every slice
-  // (the cross-rank determinism trainers assert on) and no hop ever
-  // re-quantizes. Precondition: the RS final round's fused handoff
-  // (CodecDecodeReduceQuantize) already QUANTIZED the owned slice in `data`
-  // and parked its encoded bytes in scratch slot 0 — what the owner keeps
-  // equals what every peer decodes, and this phase starts with zero codec
-  // passes of its own over the owned slice. Net effect: one quantization of
-  // each fully-reduced slice, on top of the RS phase's one-per-hop.
-  Status AgPhaseCodec(float* data, size_t count, RingChannel& ch, uint64_t seq,
-                      bool tracing) {
-    const int W = world_;
-    auto off = [&](int i) { return (count * static_cast<size_t>(i)) / W; };
-    const size_t max_elems = (count + W - 1) / W;
-    const size_t slot_bytes = CodecWireBytes(codec_, max_elems);
-    ch.scratch.reserve(2 * slot_bytes);  // no-op: DoAllReduce pre-reserved
-    uint8_t* slots[2] = {ch.scratch.data(), ch.scratch.data() + slot_bytes};
-    int cur = 0;  // slot 0 holds enc(owned slice), courtesy of the RS fusion
-    for (int s = 0; s < W - 1; ++s) {
-      int sidx = (rank_ - s + W) % W;
-      int ridx = (rank_ - s - 1 + W) % W;
-      size_t sw = CodecWireBytes(codec_, off(sidx + 1) - off(sidx));
-      size_t relems = off(ridx + 1) - off(ridx);
-      size_t rw = CodecWireBytes(codec_, relems);
-      PhaseSpan step(tracing, trace_comm_id_, seq, "ag", s, sw);
-      // The slice sent at step s+1 is exactly the one received at step s
-      // (sidx_{s+1} == ridx_s), so the received wire bytes ping-pong into
-      // the next step's send slot untouched.
-      Status st = Exchange(slots[cur], sw, slots[1 - cur], rw, nullptr, ch);
-      if (!st.ok()) return st;
-      CodecDecode(codec_, slots[1 - cur], data + off(ridx), relems);
-      cur = 1 - cur;
-    }
-    return Status::Ok();
-  }
-
-  // One ring step: recv from prev into recvbuf while sending sendbuf to
-  // next. Posts the irecv first; BOTH requests are waited before returning —
-  // even on error — because an abandoned in-flight request would let the
-  // caller free a buffer the stream workers still touch. When got==nullptr
-  // the step is fixed-size and a short receive (ranks disagreeing on counts)
-  // is an error, not silent stale-tail corruption.
-  Status Exchange(const void* sendbuf, size_t send_nbytes, void* recvbuf, size_t recv_nbytes,
-                  size_t* got, RingChannel& ch) {
-    uint64_t rreq = 0, sreq = 0;
-    Status st = net_->irecv(ch.recv_comm, recvbuf, recv_nbytes, &rreq);
-    if (!st.ok()) return st;
-    st = net_->isend(ch.send_comm, sendbuf, send_nbytes, &sreq);
-    if (!st.ok()) {
-      WaitRequest(rreq, nullptr);  // quiesce the posted recv before unwinding
-      return st;
-    }
-    size_t rgot = 0;
-    Status r_st = WaitRequest(rreq, &rgot);
-    Status s_st = WaitRequest(sreq, nullptr);
-    if (!r_st.ok()) return r_st;
-    if (!s_st.ok()) return s_st;
-    if (got) {
-      *got = rgot;
-    } else if (rgot != recv_nbytes) {
-      return Status::Inner("ring step size mismatch: expected " + std::to_string(recv_nbytes) +
-                           "B from prev rank, got " + std::to_string(rgot) +
-                           "B (ranks disagree on collective arguments?)");
-    }
-    return Status::Ok();
-  }
-
-  // Wait out every pending send (ignoring their status) before surfacing
-  // `primary` — never abandon in-flight requests that reference caller
-  // buffers.
-  Status DrainSends(std::vector<uint64_t>& reqs, Status primary) {
-    for (uint64_t req : reqs) {
-      Status st = WaitRequest(req, nullptr);
-      if (primary.ok() && !st.ok()) primary = st;
-    }
-    reqs.clear();
-    return primary;
-  }
-
-  // -- async worker machinery ---------------------------------------------
-
-  // Number of independent async ring channels (and worker threads). Each
-  // extra channel is one more comm pair per rank — with two, bucket k+1's
-  // ring transfer runs while bucket k reduces, and the two transfers share
-  // the NIC instead of serializing behind a single worker. Must agree across
-  // ranks (it changes how many wiring connects each peer expects).
-  static size_t AsyncChannelCount() {
-    static const size_t v = [] {
-      uint64_t n = GetEnvU64("TPUNET_ASYNC_CHANNELS", 2);
-      return static_cast<size_t>(std::min<uint64_t>(std::max<uint64_t>(n, 1), 8));
-    }();
-    return v;
-  }
-
-  // Wire ring channels [channels_.size(), nch): connect to next with a
-  // channel-tagged hello, then accept the matching connects from prev off
-  // the shared listener. Connect never blocks on the peer's accept (TCP
-  // backlog + the engine's buffered preamble), so connect-all-then-accept-all
-  // cannot deadlock; the hello keys each inbound comm to its channel so
-  // accept-order races cannot cross-wire rings. Runs once, on the caller
-  // thread of the first IAllReduce, before any worker exists.
-  Status EnsureAsyncChannels(size_t nch) {
-    if (!async_wire_status_.ok()) return async_wire_status_;
-    if (channels_.size() >= nch || world_ == 1) return Status::Ok();
-    const int next = (rank_ + 1) % world_;
-    const size_t base = channels_.size();
-    channels_.resize(nch);
-    Status result = Status::Ok();
-    for (size_t c = base; c < nch && result.ok(); ++c) {
-      result = ConnectHello(next, kRingHelloTag | c, &channels_[c].send_comm);
-    }
-    for (size_t i = base; i < nch && result.ok(); ++i) {
-      uint64_t rc = 0, h = 0;
-      result = AcceptHello(&rc, &h);
-      if (!result.ok()) break;
-      uint64_t c = h & 0xFFFFFFFFull;
-      if ((h & ~0xFFFFFFFFull) != kRingHelloTag || c < base || c >= nch ||
-          channels_[c].recv_comm != 0) {
-        net_->close_recv(rc);
-        result = Status::Inner("unexpected channel hello " + std::to_string(h));
-      } else {
-        channels_[c].recv_comm = rc;
-      }
-    }
-    // Quiesce before returning: a rank whose wiring completes early (its
-    // accepts only need PREV to have started) must not race ahead — its next
-    // listener-touching op (EnsureMesh) could reach a peer still blocked in
-    // the accept loop above and be mistaken for a channel connect. W-1
-    // one-byte ring steps on channel 0: completing them implies every rank
-    // entered this quiesce, i.e. finished wiring. Direct Exchange, not
-    // Barrier() — that would re-lock async_mu_.
-    for (int s = 0; s < world_ - 1 && result.ok(); ++s) {
-      uint8_t token_out = 1, token_in = 0;
-      result = Exchange(&token_out, 1, &token_in, 1, nullptr, channels_[0]);
-    }
-    if (!result.ok()) {
-      // Peers may have wired a subset — the communicator's channel state is
-      // inconsistent across ranks and cannot be retried; fail every later
-      // async call the same way. Partially-wired comms close in ~RingComm.
-      async_wire_status_ = result;
+    for (uint64_t c : mrecv) {
+      if (c) net_->close_recv(c);
     }
     return result;
   }
+  mesh_send_ = std::move(msend);
+  mesh_recv_ = std::move(mrecv);
+  return Status::Ok();
+}
 
-  // A ticket is live (waitable) if it is queued, currently executing, or
-  // completed-but-unclaimed.
-  bool TicketLive(uint64_t ticket) REQUIRES(async_mu_) {
-    if (done_.count(ticket)) return true;
-    for (uint64_t r : running_) {
-      if (r == ticket) return true;
-    }
-    for (const auto& q : queues_) {
-      for (const auto& job : q) {
-        if (job.first == ticket) return true;
-      }
-    }
-    return false;
+// EnsureMesh + one-time quiesce: W-1 one-byte ring steps on channel 0.
+// Completing them implies every rank finished its accept loop, so a rank
+// that wires fast cannot run ahead into another listener-touching op
+// (EnsureAsyncChannels' channel hellos would be hard errors in a peer's
+// mesh accept loop). Same construction as EnsureAsyncChannels' quiesce;
+// runs on whatever thread owns channel 0 right now (the fenced caller, or
+// worker 0 inside a queue-0 job), which is exactly the thread running the
+// collective that needed the mesh.
+Status ScheduledCommunicator::EnsureMeshQuiesced() {
+  Status s = EnsureMesh();
+  if (!s.ok()) return s;
+  if (mesh_quiesced_ || world_ == 1) return Status::Ok();
+  for (int st = 0; st < world_ - 1; ++st) {
+    uint8_t token_out = 1, token_in = 0;
+    s = Exchange(&token_out, 1, &token_in, 1, nullptr, channels_[0]);
+    if (!s.ok()) return s;
+  }
+  mesh_quiesced_ = true;
+  return Status::Ok();
+}
+
+Status ScheduledCommunicator::AllToAll(const void* sendbuf, void* recvbuf,
+                                       size_t bytes_per_rank) {
+  FenceAsync();
+  const int W = world_;
+  const size_t B = bytes_per_rank;
+  const uint8_t* in = static_cast<const uint8_t*>(sendbuf);
+  uint8_t* out = static_cast<uint8_t*>(recvbuf);
+  if (static_cast<const void*>(out) != sendbuf) {
+    memcpy(out + rank_ * B, in + rank_ * B, B);  // own block stays local
+  }
+  if (W == 1 || B == 0) return Status::Ok();
+  PhaseSpan whole(Telemetry::Get().tracing_enabled(), trace_comm_id_, ++coll_seq_,
+                  "all_to_all", -1, static_cast<uint64_t>(W) * B);
+  // Direct pairwise exchange by default: O(W*B) bytes on the wire per
+  // rank vs the ring relay's O(W^2*B/2) — the difference between usable
+  // and quadratic cross-host MoE dispatch / DCN-Ulysses at pod scale.
+  // TPUNET_A2A=ring keeps the relay (no extra comms; fine at tiny W).
+  // The mesh costs 2*(W-1) comms per rank, each nstreams+1 fds and
+  // nstreams+1 threads, so very large worlds fall back to the relay
+  // rather than exhausting fds/threads; raise TPUNET_A2A_MESH_MAX_WORLD
+  // on hosts provisioned for it (the long-term fix is single-stream
+  // mesh comms, which need a per-connect nstreams override in Net).
+  static const bool use_ring = GetEnv("TPUNET_A2A", "pairwise") == "ring";
+  static const uint64_t mesh_max_world =
+      GetEnvU64("TPUNET_A2A_MESH_MAX_WORLD", 32);
+  if (!use_ring && static_cast<uint64_t>(W) <= mesh_max_world) {
+    return PairwiseAllToAll(in, out, B);
   }
 
-  void AsyncWorkerLoop(size_t ch) {
-    async_mu_.Lock();
-    while (true) {
-      while (!stop_ && queues_[ch].empty()) work_cv_.Wait(async_mu_);
-      if (stop_) break;
-      auto job = std::move(queues_[ch].front());
-      queues_[ch].pop_front();
-      running_[ch] = job.first;
-      async_mu_.Unlock();
-      Status s = job.second();  // the ring collective, off the caller thread
-      async_mu_.Lock();
-      running_[ch] = 0;
-      done_[job.first] = s;
-      done_cv_.NotifyAll();  // wakes WaitTicket and FenceAsync
+  // Store-and-forward relay. Packet invariant at step s: the packet holds
+  // nblk = W-1-s blocks; position p carries the block with nblk-p hops of
+  // remaining travel (descending). After one Exchange hop every block's
+  // remaining distance drops by one: the last block has arrived (it is the
+  // block rank (rank-s-1) addressed to us), the rest forward verbatim next
+  // step. Both sides compute identical per-step sizes, so the fixed-size
+  // Exchange path (got=nullptr) catches rank disagreement as an error.
+  a2a_fwd_.reserve(static_cast<size_t>(W - 1) * B);
+  a2a_rcv_.reserve(static_cast<size_t>(W - 1) * B);
+  for (int p = 0; p < W - 1; ++p) {
+    int dest = (rank_ + (W - 1 - p)) % W;
+    memcpy(a2a_fwd_.data() + static_cast<size_t>(p) * B, in + dest * B, B);
+  }
+  for (int s = 0; s < W - 1; ++s) {
+    size_t nblk = static_cast<size_t>(W - 1 - s);
+    Status st = Exchange(a2a_fwd_.data(), nblk * B, a2a_rcv_.data(), nblk * B, nullptr,
+                         channels_[0]);
+    if (!st.ok()) return st;
+    int src = (rank_ - s - 1 + W) % W;
+    memcpy(out + src * B, a2a_rcv_.data() + (nblk - 1) * B, B);
+    a2a_fwd_.swap(a2a_rcv_);
+  }
+  return Status::Ok();
+}
+
+// One B-sized message to every peer, one from every peer, all posted
+// up-front on dedicated per-peer comms (so no message queues behind
+// another), then quiesced recv-first. O(W*B) wire bytes per rank.
+Status ScheduledCommunicator::PairwiseAllToAll(const uint8_t* in, uint8_t* out,
+                                               size_t B) {
+  Status st = EnsureMeshQuiesced();
+  if (!st.ok()) return st;
+  const int W = world_;
+  // In-place callers overwrite recv block p while block p is still being
+  // sent to peer p (send/recv blocks coincide in this collective) — stage
+  // the outgoing blocks.
+  const uint8_t* src = in;
+  if (in == out) {
+    a2a_fwd_.reserve(static_cast<size_t>(W) * B);
+    memcpy(a2a_fwd_.data(), in, static_cast<size_t>(W) * B);
+    src = a2a_fwd_.data();
+  }
+  std::vector<uint64_t> rreqs, sreqs;
+  std::vector<int> rpeers, speers;
+  Status first = Status::Ok();
+  for (int s = 1; s < W; ++s) {
+    int to = (rank_ + s) % W;
+    int from = (rank_ - s + W) % W;
+    uint64_t rreq = 0, sreq = 0;
+    Status a = net_->irecv(mesh_recv_[from], out + from * B, B, &rreq);
+    if (a.ok()) {
+      rreqs.push_back(rreq);
+      rpeers.push_back(from);
+    } else if (first.ok()) {
+      first = a;
     }
+    Status b = net_->isend(mesh_send_[to], src + to * B, B, &sreq);
+    if (b.ok()) {
+      sreqs.push_back(sreq);
+      speers.push_back(to);
+    } else if (first.ok()) {
+      first = b;
+    }
+  }
+  for (size_t i = 0; i < rreqs.size(); ++i) {
+    size_t got = 0;
+    Status a = net_->wait(rreqs[i], &got);
+    if (a.ok() && got != B) {
+      a = Status::Inner("all_to_all block from rank " + std::to_string(rpeers[i]) +
+                        ": got " + std::to_string(got) + "B, want " + std::to_string(B));
+    }
+    if (!a.ok() && first.ok()) first = a;
+  }
+  for (size_t i = 0; i < sreqs.size(); ++i) {
+    Status b = net_->wait(sreqs[i], nullptr);
+    if (!b.ok() && first.ok()) {
+      first = Status{b.kind, "all_to_all send to rank " +
+                                 std::to_string(speers[i]) + ": " + b.msg};
+    }
+  }
+  return first;
+}
+
+Status ScheduledCommunicator::NeighborExchange(const void* sendbuf, size_t send_nbytes,
+                                               void* recvbuf, size_t recv_nbytes,
+                                               size_t* got) {
+  FenceAsync();
+  if (world_ == 1) {
+    if (send_nbytes > recv_nbytes) return Status::Invalid("recv buffer too small");
+    memcpy(recvbuf, sendbuf, send_nbytes);
+    if (got) *got = send_nbytes;
+    return Status::Ok();
+  }
+  PhaseSpan whole(Telemetry::Get().tracing_enabled(), trace_comm_id_, ++coll_seq_,
+                  "neighbor_exchange", -1, send_nbytes);
+  return Exchange(sendbuf, send_nbytes, recvbuf, recv_nbytes, got, channels_[0]);
+}
+
+Status ScheduledCommunicator::Barrier() {
+  if (world_ == 1) return Status::Ok();
+  barrier_scratch_.resize(world_);
+  uint8_t token = 1;
+  return AllGather(&token, barrier_scratch_.data(), 1);  // fences via AllGather
+}
+
+// ---------------------------------------------------------------------------
+// Async worker machinery.
+
+Status ScheduledCommunicator::IAllReduce(const void* sendbuf, void* recvbuf,
+                                         size_t count, DType dtype, RedOp op,
+                                         uint64_t* ticket) {
+  size_t esize = DTypeSize(dtype);
+  if (esize == 0) return Status::Invalid("bad dtype");
+  MutexLock lk(async_mu_);
+  if (!worker_started_) {
+    // First async collective: wire the extra channels and spawn one worker
+    // per channel. Safe to touch the listener here — the communicator runs
+    // one collective program, so every rank reaches its first IAllReduce at
+    // the same point of it and nothing else is mid-accept.
+    Status s = EnsureAsyncChannels(AsyncChannelCount());
+    if (!s.ok()) return s;
+    queues_.resize(channels_.size());
+    running_.assign(channels_.size(), 0);
+    worker_started_ = true;
+    for (size_t c = 0; c < channels_.size(); ++c) {
+      workers_.emplace_back([this, c] { AsyncWorkerLoop(c); });
+    }
+  }
+  uint64_t t = next_ticket_++;
+  // Trace seq is claimed at SUBMISSION (same order on every rank), not at
+  // execution, so spans from overlapping tickets keep cross-rank-stable
+  // tags.
+  uint64_t seq = ++coll_seq_;
+  // Schedule is resolved at SUBMISSION, identically on every rank (the
+  // selector is deterministic from negotiated state), because it feeds the
+  // routing below.
+  CollAlgo algo = ResolveAlgo(CollKind::kAllReduce, count * esize);
+  // Deterministic ticket→channel map: submission order is already the
+  // cross-rank contract for nonblocking collectives, so every rank routes
+  // ticket t to the same ring and messages pair up peer-to-peer. Mesh
+  // schedules (rhd/tree) all ride queue 0: the mesh comms are one shared
+  // resource, so their jobs must serialize — and do, in submission order,
+  // the same on every rank. Ring tickets keep the round-robin map, so a
+  // ring ticket can still overlap a mesh ticket on disjoint comms.
+  size_t ch = (algo == CollAlgo::kRing) ? (t - 1) % queues_.size() : 0;
+  queues_[ch].emplace_back(t, [this, sendbuf, recvbuf, count, dtype, op, ch, seq,
+                               algo] {
+    return DoAllReduce(sendbuf, recvbuf, count, dtype, op, channels_[ch], seq, algo);
+  });
+  *ticket = t;
+  work_cv_.NotifyAll();
+  return Status::Ok();
+}
+
+Status ScheduledCommunicator::WaitTicket(uint64_t ticket) {
+  MutexLock lk(async_mu_);
+  if (!TicketLive(ticket)) return Status::Invalid("unknown or already-waited ticket");
+  // Also wake if the ticket stops being live without completing (shutdown
+  // dropped it, or a racing waiter claimed it) — never sleep forever.
+  while (done_.count(ticket) == 0 && TicketLive(ticket)) done_cv_.Wait(async_mu_);
+  auto it = done_.find(ticket);
+  if (it == done_.end()) {
+    return Status::Invalid("ticket abandoned (shutdown or waited elsewhere)");
+  }
+  Status s = it->second;
+  done_.erase(it);
+  return s;
+}
+
+Status ScheduledCommunicator::TestTicket(uint64_t ticket, bool* done) {
+  MutexLock lk(async_mu_);
+  auto it = done_.find(ticket);
+  if (it != done_.end()) {
+    *done = true;
+    return Status::Ok();
+  }
+  if (!TicketLive(ticket)) return Status::Invalid("unknown or already-waited ticket");
+  *done = false;
+  return Status::Ok();
+}
+
+// Number of independent async ring channels (and worker threads). Each
+// extra channel is one more comm pair per rank — with two, bucket k+1's
+// ring transfer runs while bucket k reduces, and the two transfers share
+// the NIC instead of serializing behind a single worker. Must agree across
+// ranks (it changes how many wiring connects each peer expects).
+size_t ScheduledCommunicator::AsyncChannelCount() {
+  static const size_t v = [] {
+    uint64_t n = GetEnvU64("TPUNET_ASYNC_CHANNELS", 2);
+    return static_cast<size_t>(std::min<uint64_t>(std::max<uint64_t>(n, 1), 8));
+  }();
+  return v;
+}
+
+// Wire ring channels [channels_.size(), nch): connect to next with a
+// channel-tagged hello, then accept the matching connects from prev off
+// the shared listener. Connect never blocks on the peer's accept (TCP
+// backlog + the engine's buffered preamble), so connect-all-then-accept-all
+// cannot deadlock; the hello keys each inbound comm to its channel so
+// accept-order races cannot cross-wire rings. Runs once, on the caller
+// thread of the first IAllReduce, before any worker exists.
+Status ScheduledCommunicator::EnsureAsyncChannels(size_t nch) {
+  if (!async_wire_status_.ok()) return async_wire_status_;
+  if (channels_.size() >= nch || world_ == 1) return Status::Ok();
+  const int next = (rank_ + 1) % world_;
+  const size_t base = channels_.size();
+  channels_.resize(nch);
+  Status result = Status::Ok();
+  for (size_t c = base; c < nch && result.ok(); ++c) {
+    result = ConnectHello(next, kRingHelloTag | c, &channels_[c].send_comm);
+  }
+  for (size_t i = base; i < nch && result.ok(); ++i) {
+    uint64_t rc = 0, h = 0;
+    result = AcceptHello(&rc, &h);
+    if (!result.ok()) break;
+    uint64_t c = h & 0xFFFFFFFFull;
+    if ((h & ~0xFFFFFFFFull) != kRingHelloTag || c < base || c >= nch ||
+        channels_[c].recv_comm != 0) {
+      net_->close_recv(rc);
+      result = Status::Inner("unexpected channel hello " + std::to_string(h));
+    } else {
+      channels_[c].recv_comm = rc;
+    }
+  }
+  // Quiesce before returning: a rank whose wiring completes early (its
+  // accepts only need PREV to have started) must not race ahead — its next
+  // listener-touching op (EnsureMesh) could reach a peer still blocked in
+  // the accept loop above and be mistaken for a channel connect. W-1
+  // one-byte ring steps on channel 0: completing them implies every rank
+  // entered this quiesce, i.e. finished wiring. Direct Exchange, not
+  // Barrier() — that would re-lock async_mu_.
+  for (int s = 0; s < world_ - 1 && result.ok(); ++s) {
+    uint8_t token_out = 1, token_in = 0;
+    result = Exchange(&token_out, 1, &token_in, 1, nullptr, channels_[0]);
+  }
+  if (!result.ok()) {
+    // Peers may have wired a subset — the communicator's channel state is
+    // inconsistent across ranks and cannot be retried; fail every later
+    // async call the same way. Partially-wired comms close in the dtor.
+    async_wire_status_ = result;
+  }
+  return result;
+}
+
+// A ticket is live (waitable) if it is queued, currently executing, or
+// completed-but-unclaimed.
+bool ScheduledCommunicator::TicketLive(uint64_t ticket) {
+  if (done_.count(ticket)) return true;
+  for (uint64_t r : running_) {
+    if (r == ticket) return true;
+  }
+  for (const auto& q : queues_) {
+    for (const auto& job : q) {
+      if (job.first == ticket) return true;
+    }
+  }
+  return false;
+}
+
+void ScheduledCommunicator::AsyncWorkerLoop(size_t ch) {
+  async_mu_.Lock();
+  while (true) {
+    while (!stop_ && queues_[ch].empty()) work_cv_.Wait(async_mu_);
+    if (stop_) break;
+    auto job = std::move(queues_[ch].front());
+    queues_[ch].pop_front();
+    running_[ch] = job.first;
     async_mu_.Unlock();
+    Status s = job.second();  // the collective schedule, off the caller thread
+    async_mu_.Lock();
+    running_[ch] = 0;
+    done_[job.first] = s;
+    done_cv_.NotifyAll();  // wakes WaitTicket and FenceAsync
   }
+  async_mu_.Unlock();
+}
 
-  // True when no async job is queued or executing.
-  bool AsyncIdle() REQUIRES(async_mu_) {
-    for (const auto& q : queues_) {
-      if (!q.empty()) return false;
-    }
-    for (uint64_t r : running_) {
-      if (r != 0) return false;
-    }
-    return true;
+// True when no async job is queued or executing.
+bool ScheduledCommunicator::AsyncIdle() {
+  for (const auto& q : queues_) {
+    if (!q.empty()) return false;
   }
+  for (uint64_t r : running_) {
+    if (r != 0) return false;
+  }
+  return true;
+}
 
-  // Blocking collectives fence behind outstanding async work so the two
-  // kinds never interleave on the underlying comms.
-  void FenceAsync() {
+// Blocking collectives fence behind outstanding async work so the two
+// kinds never interleave on the underlying comms.
+void ScheduledCommunicator::FenceAsync() {
+  MutexLock lk(async_mu_);
+  if (!worker_started_) return;
+  while (!AsyncIdle()) done_cv_.Wait(async_mu_);
+}
+
+void ScheduledCommunicator::StopAsyncWorker() {
+  {
     MutexLock lk(async_mu_);
     if (!worker_started_) return;
-    while (!AsyncIdle()) done_cv_.Wait(async_mu_);
-  }
-
-  void StopAsyncWorker() {
-    {
-      MutexLock lk(async_mu_);
-      if (!worker_started_) return;
-      // Destroying with queued work is a caller error (peers would be left
-      // mid-collective); the running jobs finish, queued jobs fail their
-      // tickets so any blocked WaitTicket returns an error instead of
-      // sleeping forever.
-      stop_ = true;
-      for (auto& q : queues_) {
-        for (auto& job : q) {
-          done_[job.first] = Status::Inner("communicator destroyed with pending collectives");
-        }
-        q.clear();
+    // Destroying with queued work is a caller error (peers would be left
+    // mid-collective); the running jobs finish, queued jobs fail their
+    // tickets so any blocked WaitTicket returns an error instead of
+    // sleeping forever.
+    stop_ = true;
+    for (auto& q : queues_) {
+      for (auto& job : q) {
+        done_[job.first] = Status::Inner("communicator destroyed with pending collectives");
       }
-      work_cv_.NotifyAll();
-      done_cv_.NotifyAll();
+      q.clear();
     }
-    for (std::thread& w : workers_) w.join();
+    work_cv_.NotifyAll();
+    done_cv_.NotifyAll();
   }
+  for (std::thread& w : workers_) w.join();
+}
 
-  Status WaitRequest(uint64_t req, size_t* nbytes) {
-    // Blocking condvar wait — a test() poll loop here competes with the
-    // stream worker threads for CPU (catastrophic on few-core hosts).
-    return net_->wait(req, nbytes);
-  }
+}  // namespace internal
 
-  int rank_;
-  int world_;
-  // Wire compression codec for f32 collectives, fixed at construction and
-  // verified equal across ranks by the Init handshake (UseCodec above).
-  WireCodec codec_ = WireCodec::kF32;
-  std::unique_ptr<Net> net_;
-  std::unique_ptr<Bootstrap> bootstrap_;
-  uint64_t listen_comm_ = 0;
-  // Collective tracing identity: comm_id hashes (coordinator, world) — the
-  // same on every rank — and coll_seq_ counts collectives in program order
-  // (MPI semantics make the program identical across ranks), so
-  // (trace_comm_id_, coll_seq_, phase) tags match rank-to-rank.
-  uint64_t trace_comm_id_ = 0;
-  uint64_t coll_seq_ = 0;
-  // channels_[0] is the Init-wired ring every blocking collective uses;
-  // channels_[1..] are wired by EnsureAsyncChannels for overlapping async
-  // tickets. Stable after the first IAllReduce (workers capture indices).
-  std::vector<RingChannel> channels_;
-  // Scratch buffers reused across calls; a Communicator is not thread-safe
-  // (one collective at a time, like an MPI communicator).
-  // Pairwise-mesh comms for AllToAll, keyed by peer rank (0 = unwired /
-  // self). Wired lazily by EnsureMesh from all_handles_.
-  std::vector<SocketHandle> all_handles_;
-  std::vector<uint64_t> mesh_send_;
-  std::vector<uint64_t> mesh_recv_;
-  ScratchBuf work_;
-  std::vector<uint8_t> barrier_scratch_;
-  ScratchBuf a2a_fwd_, a2a_rcv_;
-  // Async (nonblocking-collective) state; async_mu_ guards all of it. Worker
-  // c is the only place async jobs touch channel c's comms/scratch, and
-  // FenceAsync keeps the sync paths out while any job runs. async_mu_ is
-  // released before any job executes, so it is never held around engine or
-  // request locks (docs/DESIGN.md "Concurrency model").
-  Mutex async_mu_;
-  CondVar work_cv_, done_cv_;
-  std::vector<std::deque<std::pair<uint64_t, std::function<Status()>>>> queues_
-      GUARDED_BY(async_mu_);
-  std::vector<uint64_t> running_ GUARDED_BY(async_mu_);
-  std::map<uint64_t, Status> done_ GUARDED_BY(async_mu_);
-  Status async_wire_status_ = Status::Ok();
-  uint64_t next_ticket_ GUARDED_BY(async_mu_) = 1;
-  bool worker_started_ GUARDED_BY(async_mu_) = false;
-  bool stop_ GUARDED_BY(async_mu_) = false;
-  // Joined in StopAsyncWorker AFTER async_mu_ is released (a worker must be
-  // able to take the lock to observe stop_), so the vector itself cannot be
-  // async_mu_-guarded; it only grows under the lock in IAllReduce.
-  std::vector<std::thread> workers_;
-};
-
-}  // namespace
+// ---------------------------------------------------------------------------
+// Construction.
 
 Status Communicator::Create(const std::string& coordinator, int rank, int world_size,
                             std::unique_ptr<Communicator>* out) {
-  return Create(coordinator, rank, world_size, "", out);
+  return Create(coordinator, rank, world_size, "", "", out);
 }
 
 Status Communicator::Create(const std::string& coordinator, int rank, int world_size,
                             const std::string& wire_dtype,
+                            std::unique_ptr<Communicator>* out) {
+  return Create(coordinator, rank, world_size, wire_dtype, "", out);
+}
+
+Status Communicator::Create(const std::string& coordinator, int rank, int world_size,
+                            const std::string& wire_dtype, const std::string& algo,
                             std::unique_ptr<Communicator>* out) {
   if (world_size < 1 || rank < 0 || rank >= world_size) {
     return Status::Invalid("bad rank/world_size");
@@ -1336,7 +739,14 @@ Status Communicator::Create(const std::string& coordinator, int rank, int world_
     return Status::Invalid("unknown wire_dtype \"" + name +
                            "\" (expected f32, bf16 or int8)");
   }
-  auto comm = std::make_unique<RingCommunicator>(rank, world_size, codec);
+  std::string algo_name = algo.empty() ? GetEnv("TPUNET_ALGO", "auto") : algo;
+  CollAlgo calgo;
+  if (!ParseCollAlgo(algo_name, &calgo)) {
+    return Status::Invalid("unknown algo \"" + algo_name +
+                           "\" (expected auto, ring, rhd or tree)");
+  }
+  auto comm = std::make_unique<internal::ScheduledCommunicator>(
+      rank, world_size, codec, calgo);
   Status s = comm->Init(coordinator);
   if (!s.ok()) return s;
   *out = std::move(comm);

@@ -1,0 +1,317 @@
+// Schedule dispatch: built-in thresholds, the TPUNET_DISPATCH_TABLE JSON
+// loader, and the per-algo counters. See dispatch.h for the contract.
+#include "dispatch.h"
+
+#include <atomic>
+#include <cctype>
+#include <cstdio>
+#include <cstring>
+
+#include "tpunet/utils.h"
+
+namespace tpunet {
+
+namespace {
+
+// Built-in size thresholds (bytes). Coarse on purpose: they encode the
+// step-count asymptotics the paperwork can defend anywhere ("The Big
+// Send-off": rings collapse for small/medium messages at scale), not this
+// box's microseconds — the tuned numbers come from `busbw_sweep
+// --emit-dispatch` via TPUNET_DISPATCH_TABLE.
+//   tree:  2*ceil(log2 W) rounds, one rank's worth of bytes per round —
+//          wins while the per-round latency dominates (tiny payloads).
+//   rhd:   2*log2(W') rounds moving 2*(W'-1)/W' * S bytes total (the same
+//          bandwidth optimality as the ring, at log instead of linear
+//          round count) — the small/medium sweet spot.
+//   ring:  linear rounds but a mature chunk pipeline (reduce overlaps
+//          transfer, vectored IO, codec fusion) — keeps the large end.
+constexpr uint64_t kTreeMaxAllReduce = 8ull << 10;    // <= 8 KiB
+constexpr uint64_t kRhdMaxAllReduce = 256ull << 10;   // <= 256 KiB
+constexpr uint64_t kTreeMaxBroadcast = 1ull << 20;    // <= 1 MiB
+
+CollAlgo SelectBuiltin(CollKind coll, uint64_t nbytes, int world) {
+  // W <= 2: every schedule degenerates to the same one exchange (ring
+  // 2(W-1)=2 rounds, rhd 2, tree 2) and the ring channel is already wired —
+  // never pay mesh wiring for zero step savings.
+  if (world <= 2) return CollAlgo::kRing;
+  if (coll == CollKind::kAllReduce) {
+    if (nbytes <= kTreeMaxAllReduce) return CollAlgo::kTree;
+    if (nbytes <= kRhdMaxAllReduce) return CollAlgo::kRhd;
+    return CollAlgo::kRing;
+  }
+  // Broadcast: binomial tree is ceil(log2 W) store-and-forward hops vs the
+  // ring relay's W-1; the pipelined ring only catches up once the payload
+  // is deep enough to stream many chunks.
+  if (nbytes <= kTreeMaxBroadcast) return CollAlgo::kTree;
+  return CollAlgo::kRing;
+}
+
+// ---- Minimal JSON scanner for the dispatch-table schema --------------------
+// Hand-rolled on purpose (no third-party deps in the native core). Supports
+// exactly what --emit-dispatch writes: one object with scalar fields and one
+// "entries" array of flat objects. Anything deeper is a loud error.
+
+struct Cursor {
+  const char* p;
+  const char* end;
+};
+
+void SkipWs(Cursor* c) {
+  while (c->p < c->end && std::isspace(static_cast<unsigned char>(*c->p))) ++c->p;
+}
+
+bool Eat(Cursor* c, char ch) {
+  SkipWs(c);
+  if (c->p < c->end && *c->p == ch) {
+    ++c->p;
+    return true;
+  }
+  return false;
+}
+
+Status ParseJsonString(Cursor* c, std::string* out) {
+  SkipWs(c);
+  if (c->p >= c->end || *c->p != '"') {
+    return Status::Invalid("dispatch table: expected a JSON string");
+  }
+  ++c->p;
+  out->clear();
+  while (c->p < c->end && *c->p != '"') {
+    if (*c->p == '\\') {
+      return Status::Invalid("dispatch table: escaped strings are not supported");
+    }
+    out->push_back(*c->p++);
+  }
+  if (c->p >= c->end) return Status::Invalid("dispatch table: unterminated string");
+  ++c->p;  // closing quote
+  return Status::Ok();
+}
+
+Status ParseJsonU64(Cursor* c, uint64_t* out) {
+  SkipWs(c);
+  const char* start = c->p;
+  uint64_t v = 0;
+  while (c->p < c->end && std::isdigit(static_cast<unsigned char>(*c->p))) {
+    v = v * 10 + static_cast<uint64_t>(*c->p - '0');
+    ++c->p;
+  }
+  if (c->p == start) {
+    return Status::Invalid("dispatch table: expected a non-negative integer");
+  }
+  *out = v;
+  return Status::Ok();
+}
+
+// Skip one scalar value for tolerated-but-unused keys ("version", comment
+// strings). Nested arrays/objects under unknown keys are rejected — this
+// parser is for one schema, not for JSON.
+Status SkipScalar(Cursor* c) {
+  SkipWs(c);
+  if (c->p < c->end && *c->p == '"') {
+    std::string s;
+    return ParseJsonString(c, &s);
+  }
+  const char* start = c->p;
+  while (c->p < c->end && (std::isalnum(static_cast<unsigned char>(*c->p)) ||
+                           *c->p == '-' || *c->p == '.' || *c->p == '+')) {
+    ++c->p;
+  }
+  if (c->p == start) {
+    return Status::Invalid("dispatch table: unsupported value (nested arrays/"
+                           "objects are only allowed under \"entries\")");
+  }
+  return Status::Ok();
+}
+
+Status ParseEntry(Cursor* c, DispatchEntry* e) {
+  if (!Eat(c, '{')) return Status::Invalid("dispatch table: expected '{' starting an entry");
+  bool saw_coll = false, saw_algo = false;
+  if (!Eat(c, '}')) {
+    do {
+      std::string key;
+      Status s = ParseJsonString(c, &key);
+      if (!s.ok()) return s;
+      if (!Eat(c, ':')) return Status::Invalid("dispatch table: expected ':' after key \"" + key + "\"");
+      if (key == "coll") {
+        std::string v;
+        s = ParseJsonString(c, &v);
+        if (!s.ok()) return s;
+        if (v == "allreduce") {
+          e->coll = CollKind::kAllReduce;
+        } else if (v == "broadcast") {
+          e->coll = CollKind::kBroadcast;
+        } else {
+          return Status::Invalid("dispatch table: unknown collective \"" + v +
+                                 "\" (expected allreduce or broadcast)");
+        }
+        saw_coll = true;
+      } else if (key == "algo") {
+        std::string v;
+        s = ParseJsonString(c, &v);
+        if (!s.ok()) return s;
+        CollAlgo a;
+        if (!ParseCollAlgo(v, &a) || a == CollAlgo::kAuto) {
+          return Status::Invalid("dispatch table: unknown algo \"" + v +
+                                 "\" (expected ring, rhd or tree)");
+        }
+        e->algo = a;
+        saw_algo = true;
+      } else if (key == "world") {
+        uint64_t v = 0;
+        s = ParseJsonU64(c, &v);
+        if (!s.ok()) return s;
+        e->world = static_cast<int>(v);
+      } else if (key == "max_bytes") {
+        s = ParseJsonU64(c, &e->max_bytes);
+        if (!s.ok()) return s;
+      } else {
+        s = SkipScalar(c);
+        if (!s.ok()) return s;
+      }
+    } while (Eat(c, ','));
+    if (!Eat(c, '}')) return Status::Invalid("dispatch table: expected '}' closing an entry");
+  }
+  if (!saw_coll || !saw_algo) {
+    return Status::Invalid("dispatch table: entry missing required \"coll\"/\"algo\" keys");
+  }
+  return Status::Ok();
+}
+
+std::atomic<uint64_t> g_coll_steps[kCollAlgoCount] = {};
+std::atomic<uint64_t> g_coll_selected[kCollKindCount][kCollAlgoCount] = {};
+
+}  // namespace
+
+bool ParseCollAlgo(const std::string& name, CollAlgo* out) {
+  if (name == "auto") {
+    *out = CollAlgo::kAuto;
+  } else if (name == "ring") {
+    *out = CollAlgo::kRing;
+  } else if (name == "rhd") {
+    *out = CollAlgo::kRhd;
+  } else if (name == "tree") {
+    *out = CollAlgo::kTree;
+  } else {
+    return false;
+  }
+  return true;
+}
+
+const char* CollAlgoName(CollAlgo a) {
+  switch (a) {
+    case CollAlgo::kAuto:
+      return "auto";
+    case CollAlgo::kRing:
+      return "ring";
+    case CollAlgo::kRhd:
+      return "rhd";
+    case CollAlgo::kTree:
+      return "tree";
+  }
+  return "?";
+}
+
+const char* CollKindName(CollKind c) {
+  switch (c) {
+    case CollKind::kAllReduce:
+      return "allreduce";
+    case CollKind::kBroadcast:
+      return "broadcast";
+  }
+  return "?";
+}
+
+Status ParseDispatchTable(const std::string& json, DispatchTable* out) {
+  out->entries.clear();
+  out->loaded = false;
+  Cursor c{json.data(), json.data() + json.size()};
+  if (!Eat(&c, '{')) return Status::Invalid("dispatch table: expected a top-level JSON object");
+  bool saw_entries = false;
+  if (!Eat(&c, '}')) {
+    do {
+      std::string key;
+      Status s = ParseJsonString(&c, &key);
+      if (!s.ok()) return s;
+      if (!Eat(&c, ':')) return Status::Invalid("dispatch table: expected ':' after key \"" + key + "\"");
+      if (key == "entries") {
+        if (!Eat(&c, '[')) return Status::Invalid("dispatch table: \"entries\" must be an array");
+        saw_entries = true;
+        if (!Eat(&c, ']')) {
+          do {
+            DispatchEntry e;
+            s = ParseEntry(&c, &e);
+            if (!s.ok()) return s;
+            out->entries.push_back(e);
+          } while (Eat(&c, ','));
+          if (!Eat(&c, ']')) return Status::Invalid("dispatch table: expected ']' closing \"entries\"");
+        }
+      } else {
+        s = SkipScalar(&c);
+        if (!s.ok()) return s;
+      }
+    } while (Eat(&c, ','));
+    if (!Eat(&c, '}')) return Status::Invalid("dispatch table: expected '}' closing the table");
+  }
+  SkipWs(&c);
+  if (c.p != c.end) return Status::Invalid("dispatch table: trailing bytes after the table object");
+  if (!saw_entries) return Status::Invalid("dispatch table: missing \"entries\" array");
+  out->loaded = true;
+  return Status::Ok();
+}
+
+Status LoadDispatchTableFile(const std::string& path, DispatchTable* out) {
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (f == nullptr) {
+    return Status::Invalid("TPUNET_DISPATCH_TABLE: cannot open \"" + path + "\"");
+  }
+  std::string text;
+  char buf[4096];
+  size_t n;
+  while ((n = std::fread(buf, 1, sizeof(buf), f)) > 0) text.append(buf, n);
+  std::fclose(f);
+  Status s = ParseDispatchTable(text, out);
+  if (!s.ok()) return Status::Invalid(s.msg + " (TPUNET_DISPATCH_TABLE=" + path + ")");
+  out->crc = Crc32c(text.data(), text.size());
+  return Status::Ok();
+}
+
+CollAlgo SelectCollAlgo(const DispatchTable& table, CollAlgo override_algo,
+                        CollKind coll, uint64_t nbytes, int world) {
+  if (override_algo != CollAlgo::kAuto) return override_algo;
+  if (table.loaded) {
+    for (const DispatchEntry& e : table.entries) {
+      if (e.coll != coll) continue;
+      if (e.world != 0 && e.world != world) continue;
+      if (e.max_bytes != 0 && nbytes > e.max_bytes) continue;
+      return e.algo;
+    }
+  }
+  return SelectBuiltin(coll, nbytes, world);
+}
+
+void CountCollSteps(CollAlgo a, uint64_t n) {
+  g_coll_steps[static_cast<int>(a)].fetch_add(n, std::memory_order_relaxed);
+}
+
+void CountCollAlgoSelected(CollKind c, CollAlgo a) {
+  g_coll_selected[static_cast<int>(c)][static_cast<int>(a)].fetch_add(
+      1, std::memory_order_relaxed);
+}
+
+uint64_t CollStepsTotal(CollAlgo a) {
+  return g_coll_steps[static_cast<int>(a)].load(std::memory_order_relaxed);
+}
+
+uint64_t CollAlgoSelectedTotal(CollKind c, CollAlgo a) {
+  return g_coll_selected[static_cast<int>(c)][static_cast<int>(a)].load(
+      std::memory_order_relaxed);
+}
+
+void ResetCollDispatchCounters() {
+  for (auto& v : g_coll_steps) v.store(0, std::memory_order_relaxed);
+  for (auto& per_kind : g_coll_selected) {
+    for (auto& v : per_kind) v.store(0, std::memory_order_relaxed);
+  }
+}
+
+}  // namespace tpunet

@@ -1,0 +1,88 @@
+// Collective schedule dispatch: which algorithm runs a given
+// (collective, payload size, world) — the per-size auto-selector that turns
+// the ring from "the" AllReduce into one schedule among several
+// (docs/DESIGN.md "Schedules & algorithm selection").
+//
+// Three layers of precedence, strongest first:
+//   1. per-communicator override (tpunet_comm_create_ex algo= / TPUNET_ALGO)
+//      — anything but "auto" pins every collective to that schedule;
+//   2. a dispatch table loaded from TPUNET_DISPATCH_TABLE (JSON written by
+//      `busbw_sweep --emit-dispatch`, the offline-tuned thresholds);
+//   3. built-in thresholds (kept deliberately coarse — they encode the
+//      step-count asymptotics, not this box's microseconds).
+//
+// The resolved choice must agree across ranks (different schedules
+// deadlock), so the communicator handshake negotiates (override, table CRC)
+// at wiring time exactly like the wire codec — a disagreement fails every
+// rank identically before any payload moves.
+#ifndef TPUNET_SRC_DISPATCH_H_
+#define TPUNET_SRC_DISPATCH_H_
+
+#include <cstddef>
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "tpunet/net.h"
+
+namespace tpunet {
+
+// Values cross the bootstrap handshake as one byte; keep them stable.
+enum class CollAlgo : uint8_t { kAuto = 0, kRing = 1, kRhd = 2, kTree = 3 };
+constexpr int kCollAlgoCount = 4;  // including kAuto
+
+enum class CollKind : uint8_t { kAllReduce = 0, kBroadcast = 1 };
+constexpr int kCollKindCount = 2;
+
+// "auto" / "ring" / "rhd" / "tree" <-> CollAlgo. Parse returns false on an
+// unknown name.
+bool ParseCollAlgo(const std::string& name, CollAlgo* out);
+const char* CollAlgoName(CollAlgo a);
+const char* CollKindName(CollKind c);
+
+// One dispatch rule: first entry whose (coll, world, max_bytes) matches the
+// call wins. world 0 matches any world; max_bytes 0 means "no upper bound".
+struct DispatchEntry {
+  CollKind coll = CollKind::kAllReduce;
+  int world = 0;
+  uint64_t max_bytes = 0;
+  CollAlgo algo = CollAlgo::kRing;
+};
+
+struct DispatchTable {
+  std::vector<DispatchEntry> entries;
+  uint32_t crc = 0;  // CRC32C of the source file bytes — the handshake key
+  bool loaded = false;
+};
+
+// Parse the `busbw_sweep --emit-dispatch` JSON:
+//   {"version": 1, "entries": [
+//      {"coll": "allreduce", "world": 8, "max_bytes": 8192, "algo": "tree"},
+//      ...]}
+// Unknown collective/algo names, nested values, or syntax errors are
+// kInvalidArgument with the offending token in the message — a malformed
+// table must fail communicator creation loudly, not silently fall back.
+Status ParseDispatchTable(const std::string& json, DispatchTable* out);
+// Read `path`, parse it, and stamp out->crc with the file bytes' CRC32C.
+Status LoadDispatchTableFile(const std::string& path, DispatchTable* out);
+
+// Resolve the schedule for one collective call. `override_algo` != kAuto
+// wins outright; then the table; then built-ins. Never returns kAuto.
+CollAlgo SelectCollAlgo(const DispatchTable& table, CollAlgo override_algo,
+                        CollKind coll, uint64_t nbytes, int world);
+
+// ---- Counters --------------------------------------------------------------
+// tpunet_coll_steps_total{algo}: sequential wire rounds executed by THIS
+// rank, per schedule — the noise-immune form of the latency claim (ring
+// AllReduce = 2(W-1) rounds; rhd = 2*log2(W') (+2 off a power of two);
+// tree <= 2*ceil(log2 W)). tpunet_coll_algo_selected_total{coll,algo}:
+// dispatch decisions, labeled by the RESOLVED schedule.
+void CountCollSteps(CollAlgo a, uint64_t n = 1);
+void CountCollAlgoSelected(CollKind c, CollAlgo a);
+uint64_t CollStepsTotal(CollAlgo a);
+uint64_t CollAlgoSelectedTotal(CollKind c, CollAlgo a);
+void ResetCollDispatchCounters();
+
+}  // namespace tpunet
+
+#endif  // TPUNET_SRC_DISPATCH_H_

@@ -20,6 +20,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "dispatch.h"
 #include "tpunet/mutex.h"
 #include "tpunet/utils.h"
 
@@ -627,6 +628,7 @@ void Telemetry::Reset() {
   ResetIoSyscallCounts();
   ResetReduceBytesTotal();
   ResetCodecBytesTotals();
+  ResetCollDispatchCounters();
   im->req_queue.Reset();
   im->req_wire.Reset();
   im->req_total.Reset();
@@ -726,6 +728,14 @@ MetricsSnapshot Telemetry::Snapshot() const {
     }
   }
   for (int d = 0; d < 2; ++d) s.codec_payload_bytes[d] = CodecPayloadBytesTotal(d);
+  for (int a = 0; a < 3; ++a) {
+    // Snapshot slot a maps to CollAlgo a+1 (kAuto never executes a step).
+    s.coll_steps[a] = CollStepsTotal(static_cast<CollAlgo>(a + 1));
+    for (int k = 0; k < kCollKindCount; ++k) {
+      s.coll_algo_selected[k][a] =
+          CollAlgoSelectedTotal(static_cast<CollKind>(k), static_cast<CollAlgo>(a + 1));
+    }
+  }
   s.uptime_s = (NowUs() - im->start_us.load(std::memory_order_relaxed)) / 1e6;
   return s;
 }
@@ -939,6 +949,29 @@ std::string Telemetry::PrometheusText() const {
   for (int d = 0; d < 2; ++d) codec_payload += s.codec_payload_bytes[d];
   emit("tpunet_codec_wire_ratio{rank=\"%lld\"} %.6f\n", (long long)rank,
        codec_payload > 0 ? (double)codec_encoded / (double)codec_payload : 1.0);
+  // Schedule-dispatch counters (docs/DESIGN.md "Schedules & algorithm
+  // selection"). Every algo series emits even at zero so step-budget
+  // assertions (perf smoke) can pin "ring executed NO steps" directly.
+  static const char* kAlgoNames[3] = {"ring", "rhd", "tree"};
+  static const char* kCollNames[2] = {"allreduce", "broadcast"};
+  family("tpunet_coll_steps_total", "counter",
+         "Sequential collective wire rounds executed by this rank, per "
+         "schedule (ring AllReduce = 2(W-1); rhd = 2*log2(W'); tree <= "
+         "2*ceil(log2 W)).");
+  for (int a = 0; a < 3; ++a) {
+    emit("tpunet_coll_steps_total{rank=\"%lld\",algo=\"%s\"} %llu\n",
+         (long long)rank, kAlgoNames[a], (unsigned long long)s.coll_steps[a]);
+  }
+  family("tpunet_coll_algo_selected_total", "counter",
+         "Collective dispatch decisions, by collective and RESOLVED "
+         "schedule (override > TPUNET_DISPATCH_TABLE > built-ins).");
+  for (int k = 0; k < 2; ++k) {
+    for (int a = 0; a < 3; ++a) {
+      emit("tpunet_coll_algo_selected_total{rank=\"%lld\",coll=\"%s\",algo=\"%s\"} %llu\n",
+           (long long)rank, kCollNames[k], kAlgoNames[a],
+           (unsigned long long)s.coll_algo_selected[k][a]);
+    }
+  }
   return out;
 }
 

@@ -1,0 +1,344 @@
+// Recursive halving-doubling AllReduce (Rabenseifner) over the pairwise
+// mesh: reduce-scatter by recursive vector halving with distance doubling
+// (log2(W') rounds, partners vr^1, vr^2, vr^4, ...), then all-gather by the
+// reverse doubling (log2(W') rounds) — 2*log2(W') wire rounds moving the
+// same 2*(W'-1)/W' * S total bytes as the ring, i.e. bandwidth-optimal at a
+// LOG instead of LINEAR round count. This is the small/medium-message
+// schedule "The Big Send-off" (arxiv 2504.18658) shows the ring losing to
+// at scale; the dispatch selector (dispatch.h) hands it that regime.
+//
+// Non-power-of-2 worlds fold the remainder in (W' = largest power of two
+// <= W, r = W - W'): the first 2r ranks pair up, the odd rank of each pair
+// ships its whole vector to its partner before the halving and receives the
+// finished result after the doubling — 2 extra rounds, the standard MPI
+// construction.
+//
+// Wire codec (TPUNET_WIRE_DTYPE != f32, f32 payloads): every hop ships
+// encoded bytes. RS hops run the fused decode+reduce (f32 accumulate —
+// quantization enters once per hop, never compounds); the FINAL RS hop runs
+// the quantize handoff so each rank's owned atom lands in `data` already
+// quantized with its encoded form parked in the atom-framed assembly
+// buffer. The AG phase then forwards those encoded atoms VERBATIM — every
+// rank decodes the same bytes per atom, so results are bit-identical across
+// ranks (including the folded-in extras, which receive the same assembly).
+#include <string.h>
+
+#include <algorithm>
+#include <vector>
+
+#include "coll_comm.h"
+
+namespace tpunet {
+namespace internal {
+
+namespace {
+
+// One leaf of the halving tree: the final segment vrank v owns after the RS
+// phase. Element ranges nest by construction (bit k of v picks the half at
+// level k, so level 0 — bit 0 — is the COARSEST split); the encoded
+// assembly lays atoms out in element order, each encoded independently
+// (int8 scale blocks restart per atom), so any level range's encoding is a
+// contiguous, forwardable byte span.
+struct Atom {
+  size_t lo = 0, n = 0;   // element range
+  size_t wire_off = 0;    // offset into the atom-framed encoded assembly
+};
+
+// All ranks derive the identical geometry from (count, W') alone — that is
+// what lets encoded bytes forward verbatim and zero-length exchanges pair.
+std::vector<Atom> AtomLayout(size_t count, int wp, WireCodec codec) {
+  std::vector<Atom> atoms(wp);
+  for (int v = 0; v < wp; ++v) {
+    size_t lo = 0, hi = count;
+    for (int mask = 1; mask < wp; mask <<= 1) {
+      size_t mid = lo + (hi - lo) / 2;
+      if (v & mask) {
+        lo = mid;
+      } else {
+        hi = mid;
+      }
+    }
+    atoms[v] = {lo, hi - lo, 0};
+  }
+  std::sort(atoms.begin(), atoms.end(),
+            [](const Atom& a, const Atom& b) { return a.lo < b.lo; });
+  size_t off = 0;
+  for (Atom& a : atoms) {
+    a.wire_off = off;
+    off += CodecWireBytes(codec, a.n);
+  }
+  return atoms;
+}
+
+// Wire span covering the atoms inside element range [lo, hi) (always a
+// whole subtree of the halving recursion, so the atoms are contiguous).
+void WireSpan(const std::vector<Atom>& atoms, WireCodec codec, size_t lo,
+              size_t hi, size_t* off, size_t* len) {
+  *off = 0;
+  *len = 0;
+  bool first = true;
+  for (const Atom& a : atoms) {
+    if (a.n == 0 || a.lo < lo || a.lo + a.n > hi) continue;
+    if (first) {
+      *off = a.wire_off;
+      first = false;
+    }
+    *len += CodecWireBytes(codec, a.n);
+  }
+}
+
+}  // namespace
+
+Status ScheduledCommunicator::DoAllReduceRhd(const void* sendbuf, void* recvbuf,
+                                             size_t count, DType dtype, RedOp op,
+                                             uint64_t seq) {
+  const size_t esize = DTypeSize(dtype);
+  const bool tracing = Telemetry::Get().tracing_enabled();
+  PhaseSpan whole(tracing, trace_comm_id_, seq, "allreduce", -1, count * esize);
+  Status s = EnsureMeshQuiesced();
+  if (!s.ok()) return s;
+
+  uint8_t* data = static_cast<uint8_t*>(recvbuf);
+  if (sendbuf != recvbuf) memmove(recvbuf, sendbuf, count * esize);
+
+  const int W = world_;
+  int wp = 1;
+  while (wp * 2 <= W) wp <<= 1;
+  const int r = W - wp;
+  const bool codec_on = UseCodec(dtype);
+  const WireRedOp wop = ToWireRedOp(op);
+  float* data_f = reinterpret_cast<float*>(data);
+
+  // Role mapping: the first 2r ranks pair (even = active, odd = extra);
+  // ranks >= 2r are active. Active virtual ranks cover [0, W') exactly.
+  const bool paired = rank_ < 2 * r;
+  const bool active = !paired || (rank_ % 2) == 0;
+  const int vr = paired ? rank_ / 2 : rank_ - r;
+  auto to_rank = [&](int v) { return v < r ? 2 * v : v + r; };
+
+  std::vector<Atom> atoms;
+  size_t total_wire = 0;
+  if (codec_on) {
+    atoms = AtomLayout(count, wp, codec_);
+    total_wire = atoms.empty()
+                     ? 0
+                     : atoms.back().wire_off + CodecWireBytes(codec_, atoms.back().n);
+    mesh_enc_.reserve(total_wire);
+  }
+
+  // ---- Fold-in: extras ship their whole vector to their partner ----------
+  if (paired) {
+    PhaseSpan fold(tracing, trace_comm_id_, seq, "fold", 0, count * esize);
+    CountCollSteps(CollAlgo::kRhd);
+    if (!active) {
+      if (codec_on) {
+        // One whole-vector encoding (blocks from offset 0) — the partner
+        // decodes with the same framing.
+        size_t wb = CodecWireBytes(codec_, count);
+        mesh_scratch_.reserve(wb);
+        CodecEncode(codec_, data_f, mesh_scratch_.data(), count);
+        s = MeshSend(rank_ - 1, mesh_scratch_.data(), wb);
+      } else {
+        s = MeshSend(rank_ - 1, data, count * esize);
+      }
+      if (!s.ok()) return s;
+    } else {
+      if (codec_on) {
+        size_t wb = CodecWireBytes(codec_, count);
+        mesh_scratch_.reserve(wb);
+        s = MeshRecv(rank_ + 1, mesh_scratch_.data(), wb);
+        if (!s.ok()) return s;
+        CodecDecodeReduce(codec_, data_f, nullptr, mesh_scratch_.data(), count, wop);
+      } else {
+        mesh_scratch_.reserve(count * esize);
+        s = MeshRecv(rank_ + 1, mesh_scratch_.data(), count * esize);
+        if (!s.ok()) return s;
+        Reduce(data, data, mesh_scratch_.data(), count, dtype, op);
+      }
+    }
+  }
+
+  struct Level {
+    size_t lo, hi, mid;
+    int peer;
+    bool keep_low;
+  };
+  std::vector<Level> levels;
+
+  if (active) {
+    // ---- Reduce-scatter: recursive vector halving, distance doubling ----
+    // Partners at level k differ only in bit k of vr; all lower bits are
+    // equal, so both made identical keep decisions and share [lo, hi).
+    size_t lo = 0, hi = count;
+    const size_t half_wire =
+        codec_on ? CodecWireBytes(codec_, (count + 1) / 2) : 0;
+    int step = 0;
+    for (int mask = 1; mask < wp; mask <<= 1, ++step) {
+      const int peer = to_rank(vr ^ mask);
+      const size_t mid = lo + (hi - lo) / 2;
+      const bool keep_low = (vr & mask) == 0;
+      const size_t k_lo = keep_low ? lo : mid, k_hi = keep_low ? mid : hi;
+      const size_t s_lo = keep_low ? mid : lo, s_hi = keep_low ? hi : mid;
+      const size_t keep_n = k_hi - k_lo, send_n = s_hi - s_lo;
+      PhaseSpan sp(tracing, trace_comm_id_, seq, "rs", step, send_n * esize);
+      CountCollSteps(CollAlgo::kRhd);
+      const bool last = (mask << 1) >= wp;
+      if (codec_on) {
+        // Encode the shed half, exchange wire bytes, fused decode+reduce
+        // into the kept half; the LAST level quantizes the kept atom and
+        // parks its encoded bytes in the assembly for the AG phase.
+        mesh_scratch_.reserve(2 * half_wire);
+        uint8_t* enc_send = mesh_scratch_.data();
+        uint8_t* enc_recv = mesh_scratch_.data() + half_wire;
+        CodecEncode(codec_, data_f + s_lo, enc_send, send_n);
+        s = MeshExchange(peer, enc_send, CodecWireBytes(codec_, send_n),
+                         enc_recv, CodecWireBytes(codec_, keep_n));
+        if (!s.ok()) return s;
+        if (last) {
+          size_t a_off = 0, a_len = 0;
+          WireSpan(atoms, codec_, k_lo, k_hi, &a_off, &a_len);
+          CodecDecodeReduceQuantize(codec_, data_f + k_lo, nullptr, enc_recv,
+                                    mesh_enc_.data() + a_off, keep_n, wop);
+        } else {
+          CodecDecodeReduce(codec_, data_f + k_lo, nullptr, enc_recv, keep_n, wop);
+        }
+      } else {
+        mesh_scratch_.reserve(keep_n * esize);
+        s = MeshExchange(peer, data + s_lo * esize, send_n * esize,
+                         mesh_scratch_.data(), keep_n * esize);
+        if (!s.ok()) return s;
+        Reduce(data + k_lo * esize, data + k_lo * esize, mesh_scratch_.data(),
+               keep_n, dtype, op);
+      }
+      levels.push_back({lo, hi, mid, peer, keep_low});
+      lo = k_lo;
+      hi = k_hi;
+    }
+
+    // ---- All-gather: reverse doubling ----------------------------------
+    // At level k I own the kept half of levels[k]'s range and my partner
+    // owns the sibling; one exchange reassembles the parent. Codec: the
+    // encoded atoms forward verbatim (each rank decodes identical bytes).
+    for (int k = static_cast<int>(levels.size()) - 1; k >= 0; --k) {
+      const Level& lv = levels[k];
+      const size_t sib_lo = lv.keep_low ? lv.mid : lv.lo;
+      const size_t sib_hi = lv.keep_low ? lv.hi : lv.mid;
+      PhaseSpan sp(tracing, trace_comm_id_, seq, "ag",
+                   static_cast<int>(levels.size()) - 1 - k, (hi - lo) * esize);
+      CountCollSteps(CollAlgo::kRhd);
+      if (codec_on) {
+        size_t my_off = 0, my_len = 0, sib_off = 0, sib_len = 0;
+        WireSpan(atoms, codec_, lo, hi, &my_off, &my_len);
+        WireSpan(atoms, codec_, sib_lo, sib_hi, &sib_off, &sib_len);
+        s = MeshExchange(lv.peer, mesh_enc_.data() + my_off, my_len,
+                         mesh_enc_.data() + sib_off, sib_len);
+        if (!s.ok()) return s;
+        for (const Atom& a : atoms) {
+          if (a.n == 0 || a.lo < sib_lo || a.lo + a.n > sib_hi) continue;
+          CodecDecode(codec_, mesh_enc_.data() + a.wire_off, data_f + a.lo, a.n);
+        }
+      } else {
+        s = MeshExchange(lv.peer, data + lo * esize, (hi - lo) * esize,
+                         data + sib_lo * esize, (sib_hi - sib_lo) * esize);
+        if (!s.ok()) return s;
+      }
+      lo = lv.lo;
+      hi = lv.hi;
+    }
+  }
+
+  // ---- Fold-out: actives return the finished result to their extra -------
+  if (paired) {
+    PhaseSpan fold(tracing, trace_comm_id_, seq, "fold", 1, count * esize);
+    CountCollSteps(CollAlgo::kRhd);
+    if (active) {
+      // Codec: forward the atom-framed assembly, NOT a re-encode — the
+      // extra decodes the same bytes every active rank decoded, so all W
+      // ranks stay bit-identical (a re-encode would re-block int8 scales).
+      s = codec_on ? MeshSend(rank_ + 1, mesh_enc_.data(), total_wire)
+                   : MeshSend(rank_ + 1, data, count * esize);
+    } else {
+      if (codec_on) {
+        s = MeshRecv(rank_ - 1, mesh_enc_.data(), total_wire);
+        if (s.ok()) {
+          for (const Atom& a : atoms) {
+            if (a.n == 0) continue;
+            CodecDecode(codec_, mesh_enc_.data() + a.wire_off, data_f + a.lo, a.n);
+          }
+        }
+      } else {
+        s = MeshRecv(rank_ - 1, data, count * esize);
+      }
+    }
+    if (!s.ok()) return s;
+  }
+  return Status::Ok();
+}
+
+// ---------------------------------------------------------------------------
+// Mesh step primitives (shared with the tree schedule).
+
+// Full-duplex pairwise step on `peer`'s mesh comms: post the irecv first,
+// then the isend; BOTH requests are waited before returning — even on error
+// — so no abandoned in-flight request can touch a freed buffer. Zero-length
+// directions are skipped entirely (empty halving segments at tiny counts);
+// both sides derive the sizes from identical geometry, so the skips pair.
+Status ScheduledCommunicator::MeshExchange(int peer, const void* sendbuf,
+                                           size_t send_nbytes, void* recvbuf,
+                                           size_t recv_nbytes) {
+  uint64_t rreq = 0, sreq = 0;
+  bool rlive = false, slive = false;
+  Status st;
+  if (recv_nbytes > 0) {
+    st = net_->irecv(mesh_recv_[peer], recvbuf, recv_nbytes, &rreq);
+    if (!st.ok()) return st;
+    rlive = true;
+  }
+  if (send_nbytes > 0) {
+    st = net_->isend(mesh_send_[peer], sendbuf, send_nbytes, &sreq);
+    if (!st.ok()) {
+      if (rlive) WaitRequest(rreq, nullptr);
+      return st;
+    }
+    slive = true;
+  }
+  size_t got = 0;
+  Status r_st = rlive ? WaitRequest(rreq, &got) : Status::Ok();
+  Status s_st = slive ? WaitRequest(sreq, nullptr) : Status::Ok();
+  if (!r_st.ok()) return r_st;
+  if (!s_st.ok()) return s_st;
+  if (rlive && got != recv_nbytes) {
+    return Status::Inner("mesh step size mismatch: expected " +
+                         std::to_string(recv_nbytes) + "B from rank " +
+                         std::to_string(peer) + ", got " + std::to_string(got) +
+                         "B (ranks disagree on collective arguments?)");
+  }
+  return Status::Ok();
+}
+
+Status ScheduledCommunicator::MeshSend(int peer, const void* buf, size_t nbytes) {
+  if (nbytes == 0) return Status::Ok();
+  uint64_t req = 0;
+  Status st = net_->isend(mesh_send_[peer], buf, nbytes, &req);
+  if (!st.ok()) return st;
+  return WaitRequest(req, nullptr);
+}
+
+Status ScheduledCommunicator::MeshRecv(int peer, void* buf, size_t nbytes) {
+  if (nbytes == 0) return Status::Ok();
+  uint64_t req = 0;
+  Status st = net_->irecv(mesh_recv_[peer], buf, nbytes, &req);
+  if (!st.ok()) return st;
+  size_t got = 0;
+  st = WaitRequest(req, &got);
+  if (!st.ok()) return st;
+  if (got != nbytes) {
+    return Status::Inner("mesh message size mismatch: expected " +
+                         std::to_string(nbytes) + "B from rank " +
+                         std::to_string(peer) + ", got " + std::to_string(got) + "B");
+  }
+  return Status::Ok();
+}
+
+}  // namespace internal
+}  // namespace tpunet

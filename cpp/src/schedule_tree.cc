@@ -1,0 +1,179 @@
+// Binomial tree schedule over the pairwise mesh: reduce-to-root + binomial
+// broadcast AllReduce for small payloads, and binomial Broadcast.
+//
+// A binomial tree touches each rank in at most ceil(log2 W) rounds per
+// phase — the latency-optimal shape for payloads small enough that the
+// per-round cost dominates the bytes (every rank ships the WHOLE vector per
+// hop, so bandwidth is W*S vs the ring/rhd's 2(W-1)/W * S; the dispatch
+// selector only routes the tiny end here). Rank 0 is the AllReduce root
+// (no root argument to bias toward); Broadcast trees hang off the caller's
+// root via relative ranks.
+//
+// Wire codec: reduce hops ship encoded partials with fused decode+reduce
+// (f32 accumulate, one quantization per hop). The root then quantizes the
+// final vector ONCE — encode, decode back into its own buffer — and the
+// broadcast phase forwards those encoded bytes verbatim, so every rank
+// decodes identical bytes and results are bit-identical across ranks.
+#include <string.h>
+
+#include <algorithm>
+#include <vector>
+
+#include "coll_comm.h"
+
+namespace tpunet {
+namespace internal {
+
+Status ScheduledCommunicator::DoAllReduceTree(const void* sendbuf, void* recvbuf,
+                                              size_t count, DType dtype, RedOp op,
+                                              uint64_t seq) {
+  const size_t esize = DTypeSize(dtype);
+  const bool tracing = Telemetry::Get().tracing_enabled();
+  PhaseSpan whole(tracing, trace_comm_id_, seq, "allreduce", -1, count * esize);
+  Status s = EnsureMeshQuiesced();
+  if (!s.ok()) return s;
+
+  uint8_t* data = static_cast<uint8_t*>(recvbuf);
+  if (sendbuf != recvbuf) memmove(recvbuf, sendbuf, count * esize);
+
+  const int W = world_;
+  const bool codec_on = UseCodec(dtype);
+  const WireRedOp wop = ToWireRedOp(op);
+  float* data_f = reinterpret_cast<float*>(data);
+  const size_t wb = codec_on ? CodecWireBytes(codec_, count) : 0;
+
+  // ---- Phase 1: binomial reduce to rank 0 --------------------------------
+  // At mask m a rank whose bit m is set sends its partial to rank-m and is
+  // done; otherwise it receives from rank+m (which has already folded in
+  // its own subtree — the loop order guarantees it) and reduces. The fold
+  // order at each node is child m=1, then m=2, m=4, ... — deterministic, so
+  // f32 results are reproducible run to run.
+  int step = 0;
+  for (uint64_t mask = 1; mask < static_cast<uint64_t>(W); mask <<= 1, ++step) {
+    if (rank_ & mask) {
+      const int parent = rank_ - static_cast<int>(mask);
+      PhaseSpan sp(tracing, trace_comm_id_, seq, "reduce", step, count * esize);
+      CountCollSteps(CollAlgo::kTree);
+      if (codec_on) {
+        mesh_scratch_.reserve(wb);
+        CodecEncode(codec_, data_f, mesh_scratch_.data(), count);
+        s = MeshSend(parent, mesh_scratch_.data(), wb);
+      } else {
+        s = MeshSend(parent, data, count * esize);
+      }
+      if (!s.ok()) return s;
+      break;
+    }
+    const int child = rank_ + static_cast<int>(mask);
+    if (child < W) {
+      PhaseSpan sp(tracing, trace_comm_id_, seq, "reduce", step, count * esize);
+      CountCollSteps(CollAlgo::kTree);
+      if (codec_on) {
+        mesh_scratch_.reserve(wb);
+        s = MeshRecv(child, mesh_scratch_.data(), wb);
+        if (!s.ok()) return s;
+        CodecDecodeReduce(codec_, data_f, nullptr, mesh_scratch_.data(), count, wop);
+      } else {
+        mesh_scratch_.reserve(count * esize);
+        s = MeshRecv(child, mesh_scratch_.data(), count * esize);
+        if (!s.ok()) return s;
+        Reduce(data, data, mesh_scratch_.data(), count, dtype, op);
+      }
+    }
+  }
+
+  // ---- Phase 2: binomial broadcast of the result from rank 0 -------------
+  // Codec: the root quantizes ONCE (encode, then decode back into its own
+  // buffer so the owner holds exactly what peers will decode); the encoded
+  // bytes forward verbatim — every rank decodes the same bytes.
+  if (codec_on) {
+    mesh_enc_.reserve(wb);
+    if (rank_ == 0) {
+      CodecEncode(codec_, data_f, mesh_enc_.data(), count);
+      CodecDecode(codec_, mesh_enc_.data(), data_f, count);
+    }
+  }
+  uint8_t* bcast_buf = codec_on ? mesh_enc_.data() : data;
+  const size_t bcast_bytes = codec_on ? wb : count * esize;
+  uint64_t mask = 1;
+  step = 0;
+  while (mask < static_cast<uint64_t>(W)) {
+    if (rank_ & mask) {
+      const int src = rank_ - static_cast<int>(mask);
+      PhaseSpan sp(tracing, trace_comm_id_, seq, "bcast", step, bcast_bytes);
+      CountCollSteps(CollAlgo::kTree);
+      s = MeshRecv(src, bcast_buf, bcast_bytes);
+      if (!s.ok()) return s;
+      if (codec_on) CodecDecode(codec_, mesh_enc_.data(), data_f, count);
+      break;
+    }
+    mask <<= 1;
+    ++step;
+  }
+  for (mask >>= 1; mask > 0; mask >>= 1, ++step) {
+    const int dst = rank_ + static_cast<int>(mask);
+    if (dst < W) {
+      PhaseSpan sp(tracing, trace_comm_id_, seq, "bcast", step, bcast_bytes);
+      CountCollSteps(CollAlgo::kTree);
+      s = MeshSend(dst, bcast_buf, bcast_bytes);
+      if (!s.ok()) return s;
+    }
+  }
+  return Status::Ok();
+}
+
+Status ScheduledCommunicator::DoBroadcastTree(void* buf, size_t nbytes, int root,
+                                              uint64_t seq) {
+  const bool tracing = Telemetry::Get().tracing_enabled();
+  PhaseSpan whole(tracing, trace_comm_id_, seq, "broadcast", -1, nbytes);
+  Status s = EnsureMeshQuiesced();
+  if (!s.ok()) return s;
+
+  const int W = world_;
+  uint8_t* data = static_cast<uint8_t*>(buf);
+  const int relr = (rank_ - root + W) % W;
+
+  // Receive edge: the lowest set bit of the relative rank names the parent;
+  // forward edges go to relr + down for each lower power of two (sent in
+  // DECREASING order — the farthest child relays the deepest subtree).
+  uint64_t mask = 1;
+  int src = -1;
+  while (mask < static_cast<uint64_t>(W)) {
+    if (relr & mask) {
+      src = (rank_ - static_cast<int>(mask) + W) % W;
+      break;
+    }
+    mask <<= 1;
+  }
+  std::vector<int> children;
+  for (uint64_t dm = mask >> 1; dm > 0; dm >>= 1) {
+    if (relr + dm < static_cast<uint64_t>(W)) {
+      children.push_back((rank_ + static_cast<int>(dm)) % W);
+    }
+  }
+  CountCollSteps(CollAlgo::kTree, (src >= 0 ? 1 : 0) + children.size());
+
+  // Chunked store-and-forward: receive chunk c, then isend it to every
+  // child while chunk c+1 is inbound — the tree streams the payload like
+  // the ring relay does, just over log-depth instead of W-1 hops.
+  const size_t nchunks = (nbytes + kBcastChunk - 1) / kBcastChunk;
+  std::vector<uint64_t> pending_sends;
+  for (size_t c = 0; c < nchunks; ++c) {
+    const size_t coff = c * kBcastChunk;
+    const size_t clen = std::min(kBcastChunk, nbytes - coff);
+    if (src >= 0) {
+      Status st = MeshRecv(src, data + coff, clen);
+      if (!st.ok()) return DrainSends(pending_sends, st);
+    }
+    for (int child : children) {
+      uint64_t sreq = 0;
+      Status st = net_->isend(mesh_send_[child], data + coff, clen, &sreq);
+      if (!st.ok()) return DrainSends(pending_sends, st);
+      pending_sends.push_back(sreq);
+    }
+  }
+  return DrainSends(pending_sends, Status::Ok());
+}
+
+}  // namespace internal
+}  // namespace tpunet

@@ -91,10 +91,48 @@ ffi::Error DefaultComm(uintptr_t* comm) {
   return ffi::Error::Success();
 }
 
+// Call-time world for the shape checks below. The buffer SHAPES were baked
+// in at trace time, but the communicator resolves at CALL time — after an
+// elastic world change a cached executable would silently gather garbage
+// (shrink) or overflow the result buffer (grow). Shape-vs-world mismatches
+// return kFailedPrecondition naming both numbers so elastic recovery sees a
+// loud comm-shaped failure, never corrupted data.
+ffi::Error CallTimeWorld(uintptr_t comm, int32_t* world) {
+  int32_t rank = 0;
+  *world = 0;
+  if (auto err = ToError(tpunet_comm_rank(comm, &rank, world), "comm_rank");
+      err.failure()) {
+    return err;
+  }
+  if (*world <= 0) {
+    return ffi::Error(ffi::ErrorCode::kFailedPrecondition,
+                      "tpunet communicator reports non-positive world size");
+  }
+  return ffi::Error::Success();
+}
+
+ffi::Error ShapeWorldMismatch(const char* what, uint64_t got, uint64_t want,
+                              int32_t world) {
+  return ffi::Error(
+      ffi::ErrorCode::kFailedPrecondition,
+      std::string("tpunet ") + what + " shape does not match the CALL-TIME "
+          "world size " + std::to_string(world) + ": got " +
+          std::to_string(got) + ", want " + std::to_string(want) +
+          " (executable traced for a different world — elastic change? "
+          "re-trace or rebuild the jitted function)");
+}
+
 ffi::Error AllGatherImpl(ffi::AnyBuffer x, ffi::RemainingArgs,
                          ffi::Result<ffi::AnyBuffer> out) {
   uintptr_t comm;
   if (auto err = DefaultComm(&comm); err.failure()) return err;
+  int32_t world = 0;
+  if (auto err = CallTimeWorld(comm, &world); err.failure()) return err;
+  const uint64_t want = static_cast<uint64_t>(world) * x.size_bytes();
+  if (static_cast<uint64_t>(out->size_bytes()) != want) {
+    return ShapeWorldMismatch("all_gather result bytes", out->size_bytes(),
+                              want, world);
+  }
   return ToError(tpunet_comm_all_gather(comm, x.untyped_data(),
                                         out->untyped_data(), x.size_bytes()),
                  "all_gather");
@@ -105,6 +143,14 @@ ffi::Error ReduceScatterImpl(int64_t dtype, int64_t op, ffi::AnyBuffer x,
                              ffi::Result<ffi::AnyBuffer> out) {
   uintptr_t comm;
   if (auto err = DefaultComm(&comm); err.failure()) return err;
+  int32_t world = 0;
+  if (auto err = CallTimeWorld(comm, &world); err.failure()) return err;
+  const uint64_t want =
+      static_cast<uint64_t>(world) * static_cast<uint64_t>(out->element_count());
+  if (static_cast<uint64_t>(x.element_count()) != want) {
+    return ShapeWorldMismatch("reduce_scatter operand elements",
+                              x.element_count(), want, world);
+  }
   return ToError(
       tpunet_comm_reduce_scatter(comm, x.untyped_data(), out->untyped_data(),
                                  out->element_count(),
@@ -134,12 +180,18 @@ ffi::Error AllToAllImpl(ffi::AnyBuffer x, ffi::RemainingArgs,
                         ffi::Result<ffi::AnyBuffer> out) {
   uintptr_t comm;
   if (auto err = DefaultComm(&comm); err.failure()) return err;
-  int32_t rank = 0, world = 0;
-  if (auto err = ToError(tpunet_comm_rank(comm, &rank, &world), "comm_rank");
-      err.failure()) {
-    return err;
+  int32_t world = 0;
+  if (auto err = CallTimeWorld(comm, &world); err.failure()) return err;
+  // The leading axis IS the per-peer block structure; it must equal the
+  // call-time world or block j lands on the wrong rank (and the byte count
+  // per peer is wrong). A rank-0 scalar payload has no axis to check.
+  auto dims = x.dimensions();
+  const uint64_t lead = dims.size() > 0 ? static_cast<uint64_t>(dims[0]) : 0;
+  if (lead != static_cast<uint64_t>(world)) {
+    return ShapeWorldMismatch("all_to_all leading axis", lead,
+                              static_cast<uint64_t>(world), world);
   }
-  if (world <= 0 || x.size_bytes() % world) {
+  if (x.size_bytes() % world) {
     return ffi::Error(ffi::ErrorCode::kInvalidArgument,
                       "all_to_all payload not divisible by world size");
   }

@@ -53,7 +53,7 @@ void codec_rank_main(int rank, int base_port) {
   for (int ci = 0; ci < 2; ++ci) {
     std::string coord = "127.0.0.1:" + std::to_string(base_port + 1 + ci);
     uintptr_t comm = 0;
-    CHECK_OK(tpunet_comm_create_ex(coord.c_str(), rank, kWorld, codecs[ci], &comm));
+    CHECK_OK(tpunet_comm_create_ex(coord.c_str(), rank, kWorld, codecs[ci], nullptr, &comm));
     int32_t wd = -1;
     CHECK_OK(tpunet_comm_wire_dtype(comm, &wd));
     CHECK_MSG(wd == ci + 1, "wire_dtype %d != %d for %s", wd, ci + 1, codecs[ci]);
@@ -98,7 +98,7 @@ void codec_rank_main(int rank, int base_port) {
     std::string coord = "127.0.0.1:" + std::to_string(base_port + 3);
     uintptr_t comm = 0;
     int32_t rcv = tpunet_comm_create_ex(coord.c_str(), rank, kWorld,
-                                        rank == 0 ? "bf16" : "f32", &comm);
+                                        rank == 0 ? "bf16" : "f32", nullptr, &comm);
     CHECK_MSG(rcv == TPUNET_ERR_CODEC, "expected TPUNET_ERR_CODEC, got %d (%s)",
               rcv, tpunet_c_last_error());
   }
@@ -106,8 +106,65 @@ void codec_rank_main(int rank, int base_port) {
   // Unknown codec name fails before any socket exists.
   {
     uintptr_t comm = 0;
-    int32_t rcv = tpunet_comm_create_ex("127.0.0.1:1", rank, 1, "fp8", &comm);
+    int32_t rcv = tpunet_comm_create_ex("127.0.0.1:1", rank, 1, "fp8", nullptr, &comm);
     CHECK_MSG(rcv == TPUNET_ERR_INVALID, "expected INVALID for fp8, got %d", rcv);
+  }
+}
+
+// Schedule lane: the same f32 allreduce pinned to each schedule (ring /
+// recursive halving-doubling / binomial tree) must produce BYTE-IDENTICAL
+// results — the data is integer-valued, so every summation order is exact
+// and any divergence is an indexing/offset bug, not float noise. W=3
+// exercises the rhd non-power-of-2 fold and the uneven tree. Also pins the
+// algo-mismatch handshake (typed failure on EVERY rank, nobody wedges).
+void schedule_rank_main(int rank, int base_port) {
+  const char* algos[3] = {"ring", "rhd", "tree"};
+  std::vector<float> results[3];
+  for (int ai = 0; ai < 3; ++ai) {
+    std::string coord = "127.0.0.1:" + std::to_string(base_port + 4 + ai);
+    uintptr_t comm = 0;
+    CHECK_OK(tpunet_comm_create_ex(coord.c_str(), rank, kWorld, "f32",
+                                   algos[ai], &comm));
+    std::vector<float> send(kCount), recv(kCount);
+    for (uint64_t i = 0; i < kCount; ++i)
+      send[i] = float(rank + 1) + float(i % 23);
+    CHECK_OK(tpunet_comm_all_reduce(comm, send.data(), recv.data(), kCount, 0, 0));
+    for (uint64_t i = 0; i < kCount; ++i) {
+      float expect = float(kWorld * (kWorld + 1) / 2) + float(kWorld * (i % 23));
+      CHECK_MSG(recv[i] == expect, "%s all_reduce[%" PRIu64 "] %f != %f",
+                algos[ai], i, double(recv[i]), double(expect));
+    }
+    // Broadcast rides the schedule dispatch too (tree for small payloads).
+    std::vector<uint8_t> bc(2048, rank == 1 ? uint8_t(0x5A) : uint8_t(0));
+    CHECK_OK(tpunet_comm_broadcast(comm, bc.data(), bc.size(), 1));
+    CHECK_MSG(bc[0] == 0x5A && bc[2047] == 0x5A, "%s broadcast corrupted",
+              algos[ai]);
+    results[ai] = recv;
+    CHECK_OK(tpunet_comm_destroy(&comm));
+  }
+  CHECK_MSG(memcmp(results[0].data(), results[1].data(), kCount * 4) == 0,
+            "ring vs rhd results differ");
+  CHECK_MSG(memcmp(results[0].data(), results[2].data(), kCount * 4) == 0,
+            "ring vs tree results differ");
+
+  // Algo negotiation failure: rank 0 pins tree, everyone else ring — every
+  // rank must fail typed at wiring, before any schedule could half-run.
+  {
+    std::string coord = "127.0.0.1:" + std::to_string(base_port + 7);
+    uintptr_t comm = 0;
+    int32_t rcv = tpunet_comm_create_ex(coord.c_str(), rank, kWorld, nullptr,
+                                        rank == 0 ? "tree" : "ring", &comm);
+    CHECK_MSG(rcv == TPUNET_ERR_INVALID,
+              "expected TPUNET_ERR_INVALID for algo mismatch, got %d (%s)", rcv,
+              tpunet_c_last_error());
+  }
+
+  // Unknown algo name fails before any socket exists.
+  {
+    uintptr_t comm = 0;
+    int32_t rcv =
+        tpunet_comm_create_ex("127.0.0.1:1", rank, 1, nullptr, "star", &comm);
+    CHECK_MSG(rcv == TPUNET_ERR_INVALID, "expected INVALID for star, got %d", rcv);
   }
 }
 
@@ -243,6 +300,13 @@ int main() {
   ranks.clear();
   for (int r = 0; r < kWorld; ++r)
     ranks.emplace_back(codec_rank_main, r, base_port);
+  for (auto& th : ranks) th.join();
+
+  // Schedule lane: ring vs rhd vs tree bit-equality + algo handshake
+  // (fresh comms on base_port+4..+7).
+  ranks.clear();
+  for (int r = 0; r < kWorld; ++r)
+    ranks.emplace_back(schedule_rank_main, r, base_port);
   for (auto& th : ranks) th.join();
 
   finished.store(true);

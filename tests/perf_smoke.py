@@ -43,6 +43,16 @@ BUDGET_SYSCALLS_PER_MIB = {"BASIC": 3.0, "EPOLL": 6.0}
 CODEC_SIZE = 8 << 20
 CODEC_BUDGET = 0.55
 
+# Dispatch lane: a small-message AllReduce at W=8 under algo=auto must run
+# in <= 6 sequential wire rounds (binomial tree / halving-doubling) where
+# the ring takes 2*(W-1) = 14 — the counter-verified step budget that
+# carries the schedule work's perf claim (tpunet_coll_steps_total{algo}; a
+# wire round is a number this box's GB/s noise cannot touch). Ring steps
+# must be exactly ZERO over the measured collective.
+DISPATCH_WORLD = 8
+DISPATCH_SIZE = 4 << 10
+DISPATCH_STEP_BUDGET = 6
+
 
 def _codec_rank(rank, world, port, q, codec):
     try:
@@ -75,6 +85,60 @@ def _codec_wire_bytes(codec: str) -> int:
     return results[0]
 
 
+def _dispatch_rank(rank, world, port, q):
+    try:
+        # Single-stream, single-channel comms: W=8 wires a 7-peer mesh per
+        # rank and CI's box is small; the step COUNT is invariant to both.
+        os.environ["TPUNET_NSTREAMS"] = "1"
+        os.environ["TPUNET_ASYNC_CHANNELS"] = "1"
+        import numpy as np
+
+        from tpunet import telemetry
+        from tpunet.collectives import Communicator
+
+        comm = Communicator(f"127.0.0.1:{port}", rank, world)
+        arr = np.full(DISPATCH_SIZE // 4, float(rank + 1), np.float32)
+        comm.all_reduce(arr)          # warmup: mesh wiring + quiesce
+        comm.barrier()
+        telemetry.reset()
+        out = comm.all_reduce(arr)
+        m = telemetry.metrics()
+        comm.close()
+        assert out[0] == sum(r + 1 for r in range(world))
+        steps = {}
+        for key, v in m.get("tpunet_coll_steps_total", {}).items():
+            steps[telemetry.labels(key)["algo"]] = int(v)
+        q.put((rank, ("OK", steps)))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, (f"ERR: {e!r}", {})))
+
+
+def _dispatch_smoke(failures) -> None:
+    from benchmarks import check_rank_results, spawn_ranks
+
+    results = check_rank_results(
+        spawn_ranks(_dispatch_rank, DISPATCH_WORLD, timeout=180))
+    worst = 0
+    for rank in range(DISPATCH_WORLD):
+        steps = results[rank]
+        ring = steps.get("ring", 0)
+        non_ring = steps.get("rhd", 0) + steps.get("tree", 0)
+        worst = max(worst, non_ring)
+        if ring != 0:
+            failures.append(
+                f"dispatch: rank {rank} ran {ring} RING steps on a "
+                f"{DISPATCH_SIZE}B allreduce — auto-selector not engaging")
+        if not 1 <= non_ring <= DISPATCH_STEP_BUDGET:
+            failures.append(
+                f"dispatch: rank {rank} took {non_ring} wire steps, budget "
+                f"{DISPATCH_STEP_BUDGET} (ring would be "
+                f"{2 * (DISPATCH_WORLD - 1)})")
+    print(f"[perf_smoke] dispatch: {DISPATCH_SIZE}B allreduce at "
+          f"W={DISPATCH_WORLD} under algo=auto: <= {worst} wire steps/rank "
+          f"(budget {DISPATCH_STEP_BUDGET}, ring would take "
+          f"{2 * (DISPATCH_WORLD - 1)})")
+
+
 def main() -> None:
     os.environ.setdefault("TPUNET_CRC", "0")
     failures = []
@@ -86,6 +150,8 @@ def main() -> None:
               f"({bps} B/syscall, budget {budget})")
         if spm is None or spm > budget:
             failures.append(f"{engine}: {spm} syscalls/MiB exceeds budget {budget}")
+
+    _dispatch_smoke(failures)
 
     f32_bytes = _codec_wire_bytes("f32")
     bf16_bytes = _codec_wire_bytes("bf16")

@@ -273,11 +273,12 @@ def test_progress_watchdog_times_out_typed(monkeypatch):
 
 
 def _matrix_worker(rank: int, world: int, port: int, q, action: str, stream: int,
-                   codec: str = "f32") -> None:
+                   codec: str = "f32", algo: str = "auto") -> None:
     try:
         os.environ["TPUNET_PROGRESS_TIMEOUT_MS"] = "2500"
         os.environ["TPUNET_CRC"] = "1"
         os.environ["TPUNET_WIRE_DTYPE"] = codec
+        os.environ["TPUNET_ALGO"] = algo
         from tpunet import _native as nat
         from tpunet import transport as tp
         from tpunet.collectives import Communicator
@@ -357,6 +358,50 @@ def test_chaos_matrix_never_hangs_never_lies(action, stream):
     if action == "corrupt":
         # CRC on: the corruption is always DETECTED — some rank reports the
         # typed corruption code; nobody reduces damaged data into a result.
+        assert f"code={_native.TPUNET_ERR_CORRUPT}" in statuses, statuses
+
+
+@pytest.mark.parametrize("algo", ["rhd", "tree"])
+@pytest.mark.parametrize("action", ["close", "stall", "corrupt"])
+def test_chaos_matrix_schedules(action, algo):
+    """The failure-containment contract is schedule-independent: the rhd and
+    tree AllReduce paths ride the SAME transport (per-chunk CRC32C, stream
+    failover, progress watchdog), so every injected fault must still end in
+    a correct result or a typed error within the bounded wait — the chaos
+    coverage is no longer ring-only."""
+    import multiprocessing as mp
+
+    from conftest import free_port
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = free_port()
+    procs = [
+        ctx.Process(target=_matrix_worker,
+                    args=(r, 2, port, q, action, 0, "f32", algo))
+        for r in range(2)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(2):
+            rank, status = q.get(timeout=150)  # the bounded-wait guarantee
+            results[rank] = status
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.kill()
+    assert len(results) == 2, f"missing rank report: {results}"
+    statuses = " | ".join(results.values())
+    for rank, status in results.items():
+        assert not status.startswith("FAIL"), f"rank {rank}: {status}"
+        assert "correct=False" not in status, f"rank {rank}: {status}"
+        assert status.startswith(("OK", "TYPED")), f"rank {rank}: {status}"
+    if action == "stall":
+        assert f"code={_native.TPUNET_ERR_TIMEOUT}" in statuses, statuses
+    if action == "corrupt":
         assert f"code={_native.TPUNET_ERR_CORRUPT}" in statuses, statuses
 
 

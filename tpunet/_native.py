@@ -143,7 +143,9 @@ def load() -> ctypes.CDLL:
 
     lib.tpunet_comm_create.argtypes = [ctypes.c_char_p, i32, i32, P(u)]
     lib.tpunet_comm_create.restype = i32
-    lib.tpunet_comm_create_ex.argtypes = [ctypes.c_char_p, i32, i32, ctypes.c_char_p, P(u)]
+    lib.tpunet_comm_create_ex.argtypes = [
+        ctypes.c_char_p, i32, i32, ctypes.c_char_p, ctypes.c_char_p, P(u),
+    ]
     lib.tpunet_comm_create_ex.restype = i32
     lib.tpunet_comm_wire_dtype.argtypes = [u, P(i32)]
     lib.tpunet_comm_wire_dtype.restype = i32

@@ -124,6 +124,7 @@ class Communicator:
         rank: int | None = None,
         world_size: int | None = None,
         wire_dtype: str | None = None,
+        algo: str | None = None,
     ):
         env = os.environ
         coordinator = coordinator or env.get("TPUNET_COORDINATOR", "127.0.0.1:29500")
@@ -136,13 +137,20 @@ class Communicator:
         self._lib = _native.load()
         cid = ctypes.c_size_t(0)
         # wire_dtype selects the f32 wire compression codec ("f32"/"bf16"/
-        # "int8"; None defers to TPUNET_WIRE_DTYPE, default f32). Negotiated
-        # at wiring time: a cross-rank disagreement raises CodecMismatchError
-        # on every rank before any payload could be mis-decoded.
+        # "int8"; None defers to TPUNET_WIRE_DTYPE, default f32). algo pins
+        # the collective schedule ("auto"/"ring"/"rhd"/"tree"; None defers
+        # to TPUNET_ALGO, default auto — per-(collective, size, world)
+        # selection through the built-in thresholds or the
+        # TPUNET_DISPATCH_TABLE JSON from `busbw_sweep --emit-dispatch`).
+        # Both are negotiated at wiring time: a cross-rank disagreement
+        # raises CodecMismatchError (codec) / NativeError (algo, dispatch
+        # table) on every rank before any payload could be mis-decoded or
+        # any half-world schedule could deadlock.
         _native.check(
             self._lib.tpunet_comm_create_ex(
                 coordinator.encode(), rank, world_size,
-                (wire_dtype or "").encode(), ctypes.byref(cid),
+                (wire_dtype or "").encode(), (algo or "").encode(),
+                ctypes.byref(cid),
             ),
             "comm_create",
         )

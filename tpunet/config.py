@@ -9,6 +9,7 @@ noted.
 
 from __future__ import annotations
 
+import json
 import os
 from dataclasses import dataclass
 
@@ -68,6 +69,30 @@ def _env_choice(name: str, fallback: str, choices: tuple[str, ...],
         raise ValueError(
             f"{name}={v} is invalid: {what} must be one of {', '.join(choices)}"
         )
+    return v
+
+
+def _env_dispatch_table(name: str) -> str:
+    """Read a dispatch-table path env var; when set, the file must exist and
+    parse as a JSON object with an "entries" list, else ValueError naming
+    the var. The native loader enforces the full schema (and the cross-rank
+    CRC handshake) at communicator creation; this pre-check catches a typo'd
+    path at Config.from_env() instead of deep inside wiring."""
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return ""
+    try:
+        with open(v, encoding="utf-8") as f:
+            table = json.load(f)
+    except OSError as e:
+        raise ValueError(f"{name}={v} is invalid: cannot read the dispatch "
+                         f"table ({e})") from e
+    except ValueError as e:
+        raise ValueError(f"{name}={v} is invalid: dispatch table is not "
+                         f"valid JSON ({e})") from e
+    if not isinstance(table, dict) or not isinstance(table.get("entries"), list):
+        raise ValueError(f"{name}={v} is invalid: dispatch table must be a "
+                         f"JSON object with an \"entries\" list")
     return v
 
 
@@ -183,6 +208,18 @@ class Config:
     # communicator wiring — all ranks must agree or creation fails with
     # CodecMismatchError. docs/DESIGN.md "Compressed collectives".
     wire_dtype: str = "f32"
+    # Collective schedule ("auto" = per-(collective, size, world) selection;
+    # "ring"/"rhd"/"tree" pin one schedule). Negotiated at communicator
+    # wiring like the codec — ranks on different schedules would deadlock,
+    # so a disagreement fails creation on every rank. docs/DESIGN.md
+    # "Schedules & algorithm selection".
+    algo: str = "auto"
+    # Path to the dispatch-table JSON written by `busbw_sweep
+    # --emit-dispatch` (empty = built-in thresholds). Loaded per
+    # communicator; the file's CRC rides the wiring handshake so every rank
+    # must see identical contents. A missing or malformed file is a loud
+    # config error here AND at communicator creation.
+    dispatch_table: str = ""
 
     @staticmethod
     def from_env() -> "Config":
@@ -293,4 +330,9 @@ class Config:
                 "TPUNET_WIRE_DTYPE", "f32", ("f32", "bf16", "int8"),
                 "collective wire codec",
             ),
+            algo=_env_choice(
+                "TPUNET_ALGO", "auto", ("auto", "ring", "rhd", "tree"),
+                "collective schedule",
+            ),
+            dispatch_table=_env_dispatch_table("TPUNET_DISPATCH_TABLE"),
         )

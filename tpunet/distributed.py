@@ -26,6 +26,7 @@ def initialize(
     rank: int | None = None,
     world_size: int | None = None,
     wire_dtype: str | None = None,
+    algo: str | None = None,
 ) -> Communicator:
     """Create (or return) the process-global communicator.
 
@@ -35,21 +36,26 @@ def initialize(
     wire compression codec ("f32"/"bf16"/"int8"; None defers to
     TPUNET_WIRE_DTYPE) — because the FFI custom-call collectives route
     through this communicator, it is also the codec every jitted dcn_*
-    collective rides.
+    collective rides. ``algo`` pins the collective schedule
+    ("auto"/"ring"/"rhd"/"tree"; None defers to TPUNET_ALGO, default auto
+    — per-(collective, size, world) selection, docs/DESIGN.md §2c).
     """
     global _comm, _comm_args
     with _lock:
         if _comm is None:
-            _comm = Communicator(coordinator, rank, world_size, wire_dtype)
+            _comm = Communicator(coordinator, rank, world_size, wire_dtype,
+                                 algo)
             _comm.set_as_default()  # FFI collectives resolve it at call time
-            _comm_args = (coordinator, rank, world_size, wire_dtype)
-        elif (coordinator, rank, world_size, wire_dtype) != _comm_args and any(
-            a is not None for a in (coordinator, rank, world_size, wire_dtype)
+            _comm_args = (coordinator, rank, world_size, wire_dtype, algo)
+        elif (coordinator, rank, world_size, wire_dtype, algo) != _comm_args and any(
+            a is not None
+            for a in (coordinator, rank, world_size, wire_dtype, algo)
         ):
             raise RuntimeError(
                 f"tpunet.distributed already initialized with {_comm_args}; "
                 f"got conflicting ({coordinator}, {rank}, {world_size}, "
-                f"{wire_dtype}) — call finalize() first to re-initialize"
+                f"{wire_dtype}, {algo}) — call finalize() first to "
+                f"re-initialize"
             )
         return _comm
 

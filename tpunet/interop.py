@@ -34,6 +34,21 @@ call (a dependency no pass can dissolve; stablehlo.optimization_barrier
 is NOT sufficient — XLA expands it away and measurably reordered such
 collectives). `dcn_all_reduce(sum)` is differentiable: the VJP of a sum
 all-reduce is a sum all-reduce of the cotangent.
+
+Ticket API ordering: `dcn_all_reduce_start`/`dcn_all_reduce_finish` run on
+the totally-ordered io_callback path ON PURPOSE (the native ticket pairing
+contract is submission order across ranks, so the submission point must be
+pinned, which `ordered=True` does and the FFI schedule does not). The flip
+side: do NOT interleave start/finish with FFI `dcn_*` calls inside one
+trace when that trace bakes in the rank (rank-asymmetric programs, e.g.
+ring/zigzag attention offsets). The two mechanisms order through different
+machineries — io_callback through its token chain, FFI through the compiled
+schedule — so XLA is free to schedule an FFI collective BEFORE the
+callback-issued submission on one rank and AFTER it on another, desyncing
+the ticket sequence exactly like the unrelated-collectives hazard above
+(and `after=` cannot bridge the two: the ticket is not an FFI operand). In
+rank-asymmetric traces keep the ticket API on its own program segments, or
+use the FFI collectives end to end.
 """
 
 from __future__ import annotations
@@ -270,7 +285,13 @@ def dcn_all_reduce_start(x, op: str = "sum"):
     """Begin a nonblocking AllReduce of `x`; returns a ticket (int64 scalar)
     to pass to `dcn_all_reduce_finish`. The reduction runs on the native
     worker thread, overlapping whatever compute XLA schedules between the
-    start and finish callbacks — the bucketed-gradient-overlap primitive."""
+    start and finish callbacks — the bucketed-gradient-overlap primitive.
+
+    Stays on the totally-ordered io_callback path even when the FFI
+    collectives are enabled: cross-rank ticket pairing is SUBMISSION order,
+    which `ordered=True` pins and the FFI schedule does not. Must not be
+    interleaved with FFI `dcn_*` calls in a rank-asymmetric trace — see the
+    module docstring's "Ticket API ordering" paragraph for the hazard."""
 
     def cb(a):
         c = _comm()
