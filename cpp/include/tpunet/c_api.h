@@ -221,6 +221,19 @@ int32_t tpunet_c_trace_flush(void);
  * tracing even when TPUNET_TRACE_DIR was unset at load. NULL or "" flushes
  * and disables. */
 int32_t tpunet_c_trace_set_dir(const char* dir);
+/* Bound port of the on-demand /metrics listener, or 0 when no listener is
+ * up. TPUNET_METRICS_PORT unset/empty = no listener; an explicit 0 binds an
+ * EPHEMERAL port (multi-tier loopback: several processes on one box each
+ * get their own listener) whose number only this call can report. */
+int32_t tpunet_c_metrics_port(void);
+/* Serving-tier SLO observation (docs/DESIGN.md "Serving tier"): record one
+ * latency sample into the TTFT (kind 0, tpunet_req_ttft_us) or TPOT
+ * (kind 1, tpunet_req_tpot_us) histogram. `us` is microseconds. */
+int32_t tpunet_c_serve_observe(int32_t kind, uint64_t us);
+/* Set the instantaneous queue-depth gauge of a serving tier
+ * (tpunet_serve_queue_depth{tier=...}): 0 = router, 1 = prefill,
+ * 2 = decode. */
+int32_t tpunet_c_serve_queue_depth(int32_t tier, uint64_t depth);
 
 #ifdef __cplusplus
 }

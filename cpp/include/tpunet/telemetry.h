@@ -60,6 +60,10 @@ constexpr int kMaxStreamStats = 32;
 // match FaultAction in src/fault.h; 0 is unused).
 constexpr int kFaultActionSlots = 5;
 
+// Serving-tier queue-depth gauge slots (tpunet_serve_queue_depth{tier=...}):
+// router admission queue, prefill backlog, decode slots+pending.
+constexpr int kServeTierCount = 3;
+
 // Last getsockopt(TCP_INFO) sample for one stream slot. When several comms
 // share a stream index the last-sampled socket wins — gauges describe "a
 // live connection at this stream position", which is what stream-skew
@@ -108,6 +112,13 @@ struct MetricsSnapshot {
   StageHist req_queue_us;       // post -> first wire byte
   StageHist req_wire_us;        // first -> last wire byte
   StageHist req_total_us;       // post -> completion
+  // Serving-tier SLO accounting (docs/DESIGN.md "Serving tier"): per-request
+  // time-to-first-token and time-per-output-token histograms fed by the
+  // router/decode workers through tpunet_c_serve_observe, plus instantaneous
+  // per-tier queue depths (tpunet_c_serve_queue_depth).
+  StageHist req_ttft_us;        // request admission -> first token
+  StageHist req_tpot_us;        // mean inter-token gap after the first
+  uint64_t serve_queue_depth[kServeTierCount] = {0};
   // Zero-copy data-path counters (docs/DESIGN.md "Data path"): wire syscalls
   // indexed by utils.h IoOp (send, recv, sendmsg, recvmsg) and bytes
   // produced by the reduction kernels. syscalls/MiB is derived from these in
@@ -165,6 +176,15 @@ class Telemetry {
   void OnFaultInjected(int action);
   void OnStreamFailover();
   void OnCrcError();
+  // Serving-tier SLO hooks (tpunet_c_serve_*): `kind` 0 = TTFT, 1 = TPOT
+  // (both microseconds, observed into the request stage-latency bucket
+  // layout); `tier` indexes kServeTierCount (router, prefill, decode).
+  void OnServeLatency(int kind, uint64_t us);
+  void OnServeQueueDepth(int tier, uint64_t depth);
+  // Bound port of the on-demand /metrics listener (0 = no listener). With
+  // TPUNET_METRICS_PORT=0 the listener binds an EPHEMERAL port and this is
+  // the only way to learn it (multi-tier loopback tests scrape both tiers).
+  int MetricsPort() const;
 
   MetricsSnapshot Snapshot() const;
   // Prometheus text exposition of the snapshot (also what the push thread
@@ -195,6 +215,8 @@ class Telemetry {
 
  private:
   Telemetry();
+  // Accept loop of the on-demand /metrics listener; owns (and closes) lfd.
+  void ScrapeLoop(int lfd);
   struct Impl;
   std::unique_ptr<Impl> impl_;
   std::atomic<bool> trace_enabled_{false};

@@ -527,4 +527,25 @@ int32_t tpunet_c_trace_set_dir(const char* dir) {
   return TPUNET_OK;
 }
 
+int32_t tpunet_c_metrics_port(void) {
+  return tpunet::Telemetry::Get().MetricsPort();
+}
+
+int32_t tpunet_c_serve_observe(int32_t kind, uint64_t us) {
+  if (kind < 0 || kind > 1) {
+    return Fail(TPUNET_ERR_INVALID, "kind must be 0 (ttft) or 1 (tpot)");
+  }
+  tpunet::Telemetry::Get().OnServeLatency(kind, us);
+  return TPUNET_OK;
+}
+
+int32_t tpunet_c_serve_queue_depth(int32_t tier, uint64_t depth) {
+  if (tier < 0 || tier >= tpunet::kServeTierCount) {
+    return Fail(TPUNET_ERR_INVALID,
+                "tier must be 0 (router), 1 (prefill) or 2 (decode)");
+  }
+  tpunet::Telemetry::Get().OnServeQueueDepth(tier, depth);
+  return TPUNET_OK;
+}
+
 }  // extern "C"

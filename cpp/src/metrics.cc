@@ -207,6 +207,12 @@ struct Telemetry::Impl {
   // consumption).
   StageHistAtomic req_queue, req_wire, req_total;
 
+  // Serving-tier SLO accounting: TTFT/TPOT histograms fed through
+  // tpunet_c_serve_observe by the router/decode workers, plus per-tier
+  // queue-depth gauges (last writer wins — instantaneous depths).
+  StageHistAtomic req_ttft, req_tpot;
+  std::atomic<uint64_t> serve_depth[kServeTierCount] = {};
+
   // TCP introspection (always on unless TPUNET_TCPINFO_INTERVAL_MS=0).
   uint64_t tcp_interval_us =
       GetEnvU64("TPUNET_TCPINFO_INTERVAL_MS", 100) * 1000;
@@ -250,9 +256,12 @@ struct Telemetry::Impl {
   CondVar push_cv;
   bool stopping GUARDED_BY(push_mu) = false;
 
-  // On-demand /metrics scrape listener (TPUNET_METRICS_PORT).
+  // On-demand /metrics scrape listener (TPUNET_METRICS_PORT). The socket is
+  // bound SYNCHRONOUSLY in the constructor so the chosen port (ephemeral
+  // when the var is set to 0) is readable the moment the singleton exists.
   std::thread scraper;
   std::atomic<bool> scrape_stop{false};
+  std::atomic<int> scrape_bound_port{0};
 };
 
 Telemetry& Telemetry::Get() {
@@ -332,51 +341,72 @@ Telemetry::Telemetry() : impl_(new Impl()) {
   }
 
   // On-demand Prometheus scrape endpoint: GET http://host:PORT/metrics.
-  // Each rank needs its own port (first binder wins on a shared one); the
-  // pusher and the listener are independent — either or both may be on.
-  uint64_t scrape_port = GetEnvU64("TPUNET_METRICS_PORT", 0);
-  if (scrape_port != 0 && scrape_port < 65536 && RankGate()) {
-    RegisterAtExit();
-    impl_->scraper = std::thread([this, scrape_port] {
-      int lfd = ::socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
-      if (lfd < 0) return;
+  // Each rank needs its own port; the pusher and the listener are
+  // independent — either or both may be on. An UNSET (or empty/garbage)
+  // var means no listener; an explicit TPUNET_METRICS_PORT=0 binds an
+  // EPHEMERAL port — the disaggregated-serving loopback case, where
+  // several tiers on one box each need their own listener without port
+  // bookkeeping — readable afterwards via tpunet_c_metrics_port(). The
+  // bind happens HERE (synchronously) so the chosen port exists the
+  // moment the singleton does.
+  std::string scrape_env = GetEnv("TPUNET_METRICS_PORT", "");
+  char* scrape_end = nullptr;
+  uint64_t scrape_port =
+      scrape_env.empty() ? 0 : strtoull(scrape_env.c_str(), &scrape_end, 10);
+  bool scrape_numeric = !scrape_env.empty() && scrape_end != nullptr &&
+                        *scrape_end == '\0';
+  if (scrape_numeric && scrape_port < 65536 && RankGate()) {
+    int lfd = ::socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (lfd >= 0) {
       int one = 1;
       ::setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
       sockaddr_in sa = {};
       sa.sin_family = AF_INET;
       sa.sin_port = htons(static_cast<uint16_t>(scrape_port));
       sa.sin_addr.s_addr = htonl(INADDR_ANY);
+      sockaddr_in got = {};
+      socklen_t got_len = sizeof(got);
       if (::bind(lfd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0 ||
-          ::listen(lfd, 16) != 0) {
+          ::listen(lfd, 16) != 0 ||
+          ::getsockname(lfd, reinterpret_cast<sockaddr*>(&got), &got_len) != 0) {
         fprintf(stderr, "[tpunet] /metrics listener: cannot bind port %llu: %s\n",
                 (unsigned long long)scrape_port, strerror(errno));
         ::close(lfd);
-        return;
+        lfd = -1;
       }
-      while (!impl_->scrape_stop.load(std::memory_order_acquire)) {
-        struct pollfd pfd = {lfd, POLLIN, 0};
-        int pr = ::poll(&pfd, 1, 200);
-        if (pr <= 0) continue;
-        int cfd = ::accept(lfd, nullptr, nullptr);
-        if (cfd < 0) continue;
-        // Drain whatever request line arrived (any path gets the exposition;
-        // a scraper that sends nothing within the poll window still gets it).
-        char reqbuf[1024];
-        struct pollfd cpfd = {cfd, POLLIN, 0};
-        if (::poll(&cpfd, 1, 250) > 0) {
-          (void)!::recv(cfd, reqbuf, sizeof(reqbuf), MSG_DONTWAIT);
-        }
-        std::string body = PrometheusText();
-        std::string resp =
-            "HTTP/1.1 200 OK\r\nContent-Type: text/plain; version=0.0.4\r\n"
-            "Content-Length: " + std::to_string(body.size()) +
-            "\r\nConnection: close\r\n\r\n" + body;
-        (void)!::send(cfd, resp.data(), resp.size(), MSG_NOSIGNAL);
-        ::close(cfd);
+      if (lfd >= 0) {
+        impl_->scrape_bound_port.store(ntohs(got.sin_port),
+                                       std::memory_order_release);
+        RegisterAtExit();
+        impl_->scraper = std::thread([this, lfd] { ScrapeLoop(lfd); });
       }
-      ::close(lfd);
-    });
+    }
   }
+}
+
+void Telemetry::ScrapeLoop(int lfd) {
+  while (!impl_->scrape_stop.load(std::memory_order_acquire)) {
+    struct pollfd pfd = {lfd, POLLIN, 0};
+    int pr = ::poll(&pfd, 1, 200);
+    if (pr <= 0) continue;
+    int cfd = ::accept(lfd, nullptr, nullptr);
+    if (cfd < 0) continue;
+    // Drain whatever request line arrived (any path gets the exposition;
+    // a scraper that sends nothing within the poll window still gets it).
+    char reqbuf[1024];
+    struct pollfd cpfd = {cfd, POLLIN, 0};
+    if (::poll(&cpfd, 1, 250) > 0) {
+      (void)!::recv(cfd, reqbuf, sizeof(reqbuf), MSG_DONTWAIT);
+    }
+    std::string body = PrometheusText();
+    std::string resp =
+        "HTTP/1.1 200 OK\r\nContent-Type: text/plain; version=0.0.4\r\n"
+        "Content-Length: " + std::to_string(body.size()) +
+        "\r\nConnection: close\r\n\r\n" + body;
+    (void)!::send(cfd, resp.data(), resp.size(), MSG_NOSIGNAL);
+    ::close(cfd);
+  }
+  ::close(lfd);
 }
 
 Telemetry::~Telemetry() { ShutdownForExit(); }
@@ -592,6 +622,23 @@ void Telemetry::OnCrcError() {
   impl_->crc_errors.fetch_add(1, std::memory_order_relaxed);
 }
 
+void Telemetry::OnServeLatency(int kind, uint64_t us) {
+  if (kind == 0) {
+    impl_->req_ttft.Observe(us);
+  } else if (kind == 1) {
+    impl_->req_tpot.Observe(us);
+  }
+}
+
+void Telemetry::OnServeQueueDepth(int tier, uint64_t depth) {
+  if (tier < 0 || tier >= kServeTierCount) return;
+  impl_->serve_depth[tier].store(depth, std::memory_order_relaxed);
+}
+
+int Telemetry::MetricsPort() const {
+  return impl_->scrape_bound_port.load(std::memory_order_acquire);
+}
+
 void Telemetry::Reset() {
   Impl* im = impl_.get();
   im->isend_count.store(0, std::memory_order_relaxed);
@@ -632,6 +679,9 @@ void Telemetry::Reset() {
   im->req_queue.Reset();
   im->req_wire.Reset();
   im->req_total.Reset();
+  im->req_ttft.Reset();
+  im->req_tpot.Reset();
+  for (auto& d : im->serve_depth) d.store(0, std::memory_order_relaxed);
   {
     MutexLock lk(im->win_mu);
     im->win_init = false;
@@ -717,6 +767,11 @@ MetricsSnapshot Telemetry::Snapshot() const {
   im->req_queue.SnapshotInto(&s.req_queue_us);
   im->req_wire.SnapshotInto(&s.req_wire_us);
   im->req_total.SnapshotInto(&s.req_total_us);
+  im->req_ttft.SnapshotInto(&s.req_ttft_us);
+  im->req_tpot.SnapshotInto(&s.req_tpot_us);
+  for (int t = 0; t < kServeTierCount; ++t) {
+    s.serve_queue_depth[t] = im->serve_depth[t].load(std::memory_order_relaxed);
+  }
   for (int i = 0; i < kIoOpCount; ++i) {
     s.engine_syscalls[i] = IoSyscallCount(static_cast<IoOp>(i));
   }
@@ -872,6 +927,28 @@ std::string Telemetry::PrometheusText() const {
   stage_hist("tpunet_req_total_us",
              "Request post to completion (total latency, microseconds).",
              s.req_total_us);
+  // Serving-tier SLO families (docs/DESIGN.md "Serving tier"): per-request
+  // TTFT/TPOT fed by the router/decode workers, and instantaneous per-tier
+  // queue depths. Every tier series emits even at zero so dashboards (and
+  // the serve smoke lane) never look up a missing series.
+  stage_hist("tpunet_req_ttft_us",
+             "Serving-tier request admission to first generated token "
+             "(microseconds).",
+             s.req_ttft_us);
+  stage_hist("tpunet_req_tpot_us",
+             "Serving-tier mean time per output token after the first "
+             "(microseconds).",
+             s.req_tpot_us);
+  family("tpunet_serve_queue_depth", "gauge",
+         "Requests queued or held per serving tier (router admission queue, "
+         "prefill backlog, decode pending+live slots).");
+  static const char* kTierNames[kServeTierCount] = {"router", "prefill",
+                                                    "decode"};
+  for (int t = 0; t < kServeTierCount; ++t) {
+    emit("tpunet_serve_queue_depth{rank=\"%lld\",tier=\"%s\"} %llu\n",
+         (long long)rank, kTierNames[t],
+         (unsigned long long)s.serve_queue_depth[t]);
+  }
   family("tpunet_hold_on_request", "gauge",
          "Requests posted but not yet test()ed done (in flight).");
   emit("tpunet_hold_on_request{rank=\"%lld\"} %llu\n", (long long)rank,

@@ -1,0 +1,170 @@
+"""CI serve-smoke lane: 2-process loopback disaggregated serving.
+
+Frontend (router + prefill) in THIS process, one decode rank in a spawned
+process; 16 requests ship their KV blocks on the int8 wire. Asserts the
+claims the serving tier makes (docs/DESIGN.md §10):
+
+  * every request completes (complete token arrays, correct lengths);
+  * the TTFT and TPOT histograms are non-empty on the frontend;
+  * the int8 KV wire ratio is the codec's exact number by counters
+    (~0.254x payload — tpunet_codec_wire_ratio, tx-side in this process);
+  * BOTH tiers are scrapeable on one box via TPUNET_METRICS_PORT=0
+    ephemeral binds (the decode tier's port learned only through
+    telemetry.metrics_port()).
+
+Run: python tests/serve_smoke.py   (exit 0 = pass)
+"""
+
+import multiprocessing as mp
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Ephemeral /metrics listener in BOTH processes (the spawned child re-runs
+# this module's top level before the target executes, so the env applies
+# there too — as does the CPU-mesh pin, which must precede any jax import).
+os.environ["TPUNET_METRICS_PORT"] = "0"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+
+N_REQUESTS = 16
+MAX_NEW = 4
+SLOTS = 4
+MAX_LEN = 48
+KV_CODEC = "int8"
+
+
+def _model_and_params():
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_platforms", "cpu")
+    from tpunet.models import Transformer
+
+    model = Transformer(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                        d_ff=64, compute_dtype=jnp.float32)
+    toks = jax.random.randint(jax.random.PRNGKey(0), (2, 24), 0, 64)
+    params = model.init(jax.random.PRNGKey(1), toks)["params"]
+    return model, params
+
+
+def _decode_child(addr: str, port_q, stop_ev) -> None:
+    try:
+        from tpunet import serve, telemetry
+
+        model, params = _model_and_params()
+        worker = serve.connect_decode(addr, model, params, slots=SLOTS,
+                                      max_len=MAX_LEN, kv_codec=KV_CODEC)
+        port_q.put(("port", telemetry.metrics_port()))
+        worker.serve()
+        # Keep the process (and its /metrics listener) alive until the
+        # frontend has scraped this tier.
+        stop_ev.wait(timeout=120)
+        port_q.put(("done", worker.stats))
+    except Exception as e:  # noqa: BLE001
+        port_q.put(("error", f"{type(e).__name__}: {e}"))
+
+
+def main() -> int:
+    from tpunet import serve, telemetry
+
+    model, params = _model_and_params()
+    lsock = serve.Router.listen("127.0.0.1:0")
+    addr = "127.0.0.1:%d" % lsock.getsockname()[1]
+
+    ctx = mp.get_context("spawn")
+    port_q = ctx.Queue()
+    stop_ev = ctx.Event()
+    child = ctx.Process(target=_decode_child, args=(addr, port_q, stop_ev))
+    child.start()
+    try:
+        prefill = serve.PrefillEngine(model, params, max_len=MAX_LEN)
+        telemetry.reset()  # engine wiring noise out of the measured window
+        router = serve.Router(prefill, kv_codec=KV_CODEC)
+        router.accept_ranks(lsock, 1)
+        kind, decode_port = port_q.get(timeout=120)
+        assert kind == "port", decode_port
+
+        rng = np.random.default_rng(7)
+        lengths = (6, 9, 12, 15)
+        ids = [_submit_with_backpressure(
+                   router,
+                   rng.integers(0, 64, lengths[i % len(lengths)]).astype(np.int32),
+                   MAX_NEW)
+               for i in range(N_REQUESTS)]
+        results = router.run(timeout=240)
+        assert sorted(results) == sorted(ids)
+        assert all(len(v) == MAX_NEW for v in results.values()), \
+            "truncated stream detected"
+
+        m = telemetry.metrics()
+        ttft = sum(m["tpunet_req_ttft_us_count"].values())
+        tpot = sum(m["tpunet_req_tpot_us_count"].values())
+        assert ttft >= N_REQUESTS, f"TTFT histogram has {ttft} samples"
+        assert tpot >= N_REQUESTS, f"TPOT histogram has {tpot} samples"
+        ratio = next(iter(m["tpunet_codec_wire_ratio"].values()))
+        assert 0.25 <= ratio <= 0.26, \
+            f"int8 KV wire ratio {ratio} not ~0.254x payload"
+
+        # Both tiers scrapeable on one box: frontend via its own ephemeral
+        # bind, decode via the port only metrics_port() could reveal.
+        front = telemetry.scrape()
+        assert "tpunet_req_ttft_us_count" in front
+        back = telemetry.scrape(port=decode_port)
+        assert "tpunet_serve_queue_depth" in back
+        rx = [v for k, v in _parse_codec(back).items()
+              if k == ("int8", "rx")]
+        assert rx and rx[0] > 0, "decode tier shows no int8 rx bytes"
+
+        router.shutdown()
+        stop_ev.set()
+        kind, payload = port_q.get(timeout=120)
+        assert kind == "done", payload
+        print(f"serve_smoke OK: {len(results)} requests, ttft={ttft} "
+              f"tpot={tpot} wire_ratio={ratio:.6f} "
+              f"decode_stats={payload}")
+        return 0
+    finally:
+        stop_ev.set()
+        child.join(timeout=30)
+        if child.is_alive():
+            child.kill()
+
+
+def _submit_with_backpressure(router, prompt, max_new, timeout=240.0):
+    """Retry admission on RouterBusyError — the client-side half of the
+    backpressure contract (poll drains retirements, freeing slots)."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return router.submit(prompt, max_new)
+        except Exception as e:
+            from tpunet import serve
+
+            if not isinstance(e, serve.RouterBusyError):
+                raise
+            if time.monotonic() > deadline:
+                raise
+            router.poll()
+            time.sleep(0.005)
+
+
+def _parse_codec(text: str) -> dict:
+    from tpunet import telemetry
+
+    out = {}
+    for line in text.splitlines():
+        m = telemetry._LINE.match(line)
+        if not m or m.group(1) != "tpunet_codec_bytes_total":
+            continue
+        labels = telemetry.labels(tuple((m.group(2) or "").split(",")))
+        out[(labels.get("codec"), labels.get("dir"))] = float(m.group(3))
+    return out
+
+
+if __name__ == "__main__":
+    sys.exit(main())

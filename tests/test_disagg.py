@@ -1,0 +1,527 @@
+"""Disaggregated prefill/decode serving tier (tpunet/serve, DESIGN.md §10).
+
+Coverage map:
+  * KV-block codec goldens — shipped layout pinned byte-for-byte per wire
+    dtype: f32 passthrough, bf16 RNE, int8 block-scale layout with scale
+    blocks RESTARTING per KV block, the |err| <= amax/254 bound, and
+    non-finite -> NaN-block loudness.
+  * Tier wiring handshake — codec/model mismatches raise TYPED errors on
+    BOTH ranks before any payload moves.
+  * W=2 ship-and-adopt — a full loopback frontend+decode tier on the f32
+    wire produces greedy outputs BITWISE-equal to single-host BatchServer
+    (and the generate() oracle); int8 completes with the exact ~0.254x
+    wire ratio by counters.
+  * Failure containment — an abrupt decode-rank death mid-request is
+    replayed from the retained KV block (or re-prefilled) on the
+    surviving rank with zero corrupted/truncated streams; the real
+    process-kill case is injected via the TPUNET_FAULT_SPEC grammar.
+  * Router admission backpressure (typed RouterBusyError).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from conftest import free_port  # noqa: F401  (pins JAX_PLATFORMS=cpu first)
+
+import jax
+import jax.numpy as jnp
+
+from tpunet import serve, telemetry, transport
+from tpunet.models import BatchServer, Transformer, generate
+from tpunet.serve import protocol as proto
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+def _tiny_model():
+    return Transformer(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                       d_ff=64, compute_dtype=jnp.float32)
+
+
+def _tiny_setup():
+    model = _tiny_model()
+    toks = jax.random.randint(jax.random.PRNGKey(0), (2, 24), 0, 64)
+    params = model.init(jax.random.PRNGKey(1), toks)["params"]
+    return model, params
+
+
+def _oracle(model, params, prompt, n):
+    out = generate(model, params, jnp.asarray(prompt)[None], n)
+    return np.asarray(out)[0, len(prompt):]
+
+
+# ---------------------------------------------------------------------------
+# KV-block codec goldens (no sockets, no jax compute).
+
+
+def _fake_rows(plen, heads=4, dh=8, leaves=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.standard_normal((plen, heads, dh)).astype(np.float32)
+            for _ in range(leaves)]
+
+
+def test_kv_block_f32_is_exact_passthrough():
+    rows = _fake_rows(7)
+    wire = serve.encode_kv_block(rows, "f32")
+    flat = np.concatenate([r.ravel() for r in rows])
+    np.testing.assert_array_equal(wire.view(np.float32), flat)
+    back = serve.decode_kv_block(wire, "f32", [r.shape for r in rows])
+    for a, b in zip(back, rows):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_kv_block_bf16_matches_codec_golden():
+    rows = _fake_rows(5, seed=1)
+    wire = serve.encode_kv_block(rows, "bf16")
+    flat = np.concatenate([r.ravel() for r in rows])
+    np.testing.assert_array_equal(wire, transport.codec_encode(flat, "bf16"))
+    back = serve.decode_kv_block(wire, "bf16", [r.shape for r in rows])
+    flat_back = np.concatenate([b.ravel() for b in back])
+    np.testing.assert_array_equal(
+        flat_back, transport.codec_decode(wire, "bf16", flat.size))
+
+
+def test_kv_block_int8_layout_scale_blocks_restart_per_block():
+    """Two different KV blocks encode INDEPENDENTLY: each block's first 4
+    wire bytes are ITS OWN first-256-element scale (amax/127) — the scale
+    blocks restart per KV block because a block is one encode call."""
+    b1 = _fake_rows(8, seed=2)          # 1024 elems: 4 scale blocks
+    b2 = [100.0 * r for r in _fake_rows(8, seed=3)]
+    for rows in (b1, b2):
+        flat = np.concatenate([r.ravel() for r in rows])
+        wire = serve.encode_kv_block(rows, "int8")
+        assert wire.size == flat.size + 4 * ((flat.size + 255) // 256)
+        (scale0,) = struct.unpack("<f", wire[:4].tobytes())
+        np.testing.assert_allclose(
+            scale0, np.abs(flat[:256]).max() / 127, rtol=1e-6)
+    # ...and the error bound survives the round trip, per 256-block.
+    flat = np.concatenate([r.ravel() for r in b2])
+    back = serve.decode_kv_block(
+        serve.encode_kv_block(b2, "int8"), "int8", [r.shape for r in b2])
+    flat_back = np.concatenate([b.ravel() for b in back])
+    for off in range(0, flat.size, 256):
+        blk = flat[off:off + 256]
+        err = np.abs(flat_back[off:off + 256] - blk)
+        assert err.max() <= np.abs(blk).max() / 254 + 1e-6
+
+
+def test_kv_block_int8_nonfinite_is_loud():
+    """A non-finite K/V value poisons its whole 256-element scale block to
+    NaN — shipped corruption is LOUD, never a silently-clamped number."""
+    rows = _fake_rows(8, seed=4)
+    rows[1][3, 2, 5] = np.inf
+    flat = np.concatenate([r.ravel() for r in rows])
+    bad_block = int(np.flatnonzero(~np.isfinite(flat))[0]) // 256
+    back = serve.decode_kv_block(
+        serve.encode_kv_block(rows, "int8"), "int8", [r.shape for r in rows])
+    flat_back = np.concatenate([b.ravel() for b in back])
+    assert np.isnan(flat_back[bad_block * 256:(bad_block + 1) * 256]).all()
+    finite = np.ones(flat.size, bool)
+    finite[bad_block * 256:(bad_block + 1) * 256] = False
+    assert np.isfinite(flat_back[finite]).all()
+
+
+def test_kv_wire_bytes_sizing_and_model_signature():
+    shapes = [(7, 4, 8)] * 4
+    n = serve.kv_block_elems(shapes)
+    assert n == 7 * 4 * 8 * 4
+    assert serve.kv_wire_bytes("f32", shapes) == 4 * n
+    assert serve.kv_wire_bytes("bf16", shapes) == 2 * n
+    assert serve.kv_wire_bytes("int8", shapes) == n + 4 * ((n + 255) // 256)
+    m1, m2 = _tiny_model(), Transformer(vocab=64, d_model=48, n_layers=2,
+                                        n_heads=4, d_ff=64)
+    assert serve.model_signature(m1) == serve.model_signature(_tiny_model())
+    assert serve.model_signature(m1) != serve.model_signature(m2)
+
+
+# ---------------------------------------------------------------------------
+# Tier wiring handshake: typed mismatch on BOTH ranks.
+
+
+def _handshake_both_sides(front_hello, back_hello):
+    """Run the wiring handshake with the given hellos; returns the
+    exception (or None) each side raised."""
+    lsock = serve.Router.listen("127.0.0.1:0")
+    addr = ("127.0.0.1", lsock.getsockname()[1])
+    errs = {}
+
+    def back():
+        with transport.Net() as net:
+            try:
+                link = proto.wire_decode(addr, net, back_hello, timeout=30)
+                link.close()
+                errs["back"] = None
+            except Exception as e:  # noqa: BLE001
+                errs["back"] = e
+
+    th = threading.Thread(target=back)
+    th.start()
+    conn, _ = lsock.accept()
+    with transport.Net() as net:
+        try:
+            link = proto.wire_frontend(conn, net, front_hello)
+            link.close()
+            errs["front"] = None
+        except Exception as e:  # noqa: BLE001
+            errs["front"] = e
+        finally:
+            conn.close()
+    th.join(timeout=30)
+    lsock.close()
+    return errs
+
+
+def test_tier_codec_mismatch_typed_on_both_ranks():
+    sig = 0x1234
+    front = proto.Hello(proto.ROLE_FRONTEND, "int8", 0, 64, 64, sig)
+    back = proto.Hello(proto.ROLE_DECODE, "f32", 2, 64, 64, sig)
+    errs = _handshake_both_sides(front, back)
+    assert isinstance(errs["front"], serve.KVCodecMismatchError)
+    assert isinstance(errs["back"], serve.KVCodecMismatchError)
+    assert "int8" in str(errs["front"]) and "f32" in str(errs["front"])
+
+
+def test_tier_model_signature_mismatch_typed_on_both_ranks():
+    front = proto.Hello(proto.ROLE_FRONTEND, "int8", 0, 64, 64, 0xAAAA)
+    back = proto.Hello(proto.ROLE_DECODE, "int8", 2, 64, 64, 0xBBBB)
+    errs = _handshake_both_sides(front, back)
+    assert isinstance(errs["front"], serve.TierMismatchError)
+    assert isinstance(errs["back"], serve.TierMismatchError)
+
+
+def test_tier_wiring_succeeds_and_frames_roundtrip():
+    sig = 0x77
+    front = proto.Hello(proto.ROLE_FRONTEND, "bf16", 0, 64, 64, sig)
+    back = proto.Hello(proto.ROLE_DECODE, "bf16", 2, 64, 64, sig)
+    lsock = serve.Router.listen("127.0.0.1:0")
+    addr = ("127.0.0.1", lsock.getsockname()[1])
+    out = {}
+
+    def back_side():
+        with transport.Net() as net:
+            link = proto.wire_decode(addr, net, back, timeout=30)
+            out["frame"] = link.recv_frame(timeout=30)
+            link.send_frame(proto.T_RESULT, 9,
+                            proto.pack_result(np.arange(3, dtype=np.int32),
+                                              0, 123))
+            link.close()
+
+    th = threading.Thread(target=back_side)
+    th.start()
+    conn, _ = lsock.accept()
+    with transport.Net() as net:
+        link = proto.wire_frontend(conn, net, front)
+        conn.close()
+        assert link.peer.slots == 2 and link.peer.kv_codec == "bf16"
+        link.send_frame(proto.T_FIRST, 42, aux=7)
+        ftype, rid, payload, tpot = link.recv_frame(timeout=30)
+        assert ftype == proto.T_RESULT and rid == 9
+        tokens, status, tpot_us = proto.unpack_result(payload)
+        np.testing.assert_array_equal(tokens, [0, 1, 2])
+        assert status == 0 and tpot_us == 123
+        link.close()
+    th.join(timeout=30)
+    lsock.close()
+    assert out["frame"][0] == proto.T_FIRST and out["frame"][1] == 42
+    assert out["frame"][3] == 7
+
+
+# ---------------------------------------------------------------------------
+# W=2 ship-and-adopt: bitwise equality + wire-ratio counters.
+
+
+def _start_tier(model, params, *, kv_codec, max_len=40, decode_slots=2,
+                queue_limit=None, retain_kv=True):
+    """One frontend (this thread) + one decode rank (worker thread) over
+    real loopback transport comms; returns (router, worker_box, thread)."""
+    lsock = serve.Router.listen("127.0.0.1:0")
+    addr = "127.0.0.1:%d" % lsock.getsockname()[1]
+    worker_box = {}
+
+    def decode_main():
+        worker = serve.connect_decode(addr, model, params,
+                                      slots=decode_slots, max_len=max_len,
+                                      kv_codec=kv_codec)
+        worker_box["worker"] = worker
+        try:
+            worker.serve()
+        finally:
+            worker.close()  # engines torn down: no thread/socket leak
+                            # into later (timing-sensitive) tests
+
+    th = threading.Thread(target=decode_main, daemon=True)
+    th.start()
+    prefill = serve.PrefillEngine(model, params, max_len=max_len)
+    router = serve.Router(prefill, kv_codec=kv_codec,
+                          queue_limit=queue_limit, retain_kv=retain_kv)
+    router.accept_ranks(lsock, 1)
+    lsock.close()
+    return router, worker_box, th
+
+
+def _run_tier(model, params, prompts, lens, *, kv_codec, max_len=40,
+              decode_slots=2, queue_limit=None, retain_kv=True):
+    router, worker_box, th = _start_tier(
+        model, params, kv_codec=kv_codec, max_len=max_len,
+        decode_slots=decode_slots, queue_limit=queue_limit,
+        retain_kv=retain_kv)
+    ids = [router.submit(p, n) for p, n in zip(prompts, lens)]
+    results = router.run(timeout=240)
+    router.shutdown()
+    th.join(timeout=60)
+    router.close()
+    return {i: results[i] for i in ids}, router, worker_box.get("worker")
+
+
+def test_ship_and_adopt_bitwise_equal_single_host_f32():
+    """The acceptance pin: a 2-rank loopback disaggregated serve on the
+    f32 KV wire produces greedy outputs BITWISE-equal to single-host
+    BatchServer (and therefore to generate()) — prefill-side computation,
+    the shipped bytes, and the adopt path introduce zero drift."""
+    model, params = _tiny_setup()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 64, n).astype(np.int32)
+               for n in (5, 9, 13, 7)]
+    lens = [8, 6, 8, 5]
+    results, router, worker = _run_tier(model, params, prompts, lens,
+                                        kv_codec="f32")
+    # Single-host oracle: same requests through one BatchServer.
+    srv = BatchServer(model, params, slots=2, max_len=40)
+    sids = [srv.submit(p, n) for p, n in zip(prompts, lens)]
+    single = srv.run()
+    for (rid, sid, p, n) in zip(results, sids, prompts, lens):
+        np.testing.assert_array_equal(results[rid], single[sid])
+        np.testing.assert_array_equal(results[rid],
+                                      _oracle(model, params, p, n))
+    assert router.stats["completed"] == len(prompts)
+    assert router.stats["rank_failures"] == 0
+    assert worker.srv.stats["kv_adopts"] == len(prompts)
+    assert worker.srv.stats["prefills"] == 0  # decode NEVER re-prefills
+
+
+def test_ship_and_adopt_int8_wire_ratio_by_counters():
+    """int8 KV shipping completes every request and the wire bytes are the
+    codec's exact ratio (~0.254x payload) by the codec counters — the
+    same counters that CI-gate the compressed collectives."""
+    model, params = _tiny_setup()
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, 64, 8).astype(np.int32) for _ in range(3)]
+    telemetry.reset()
+    results, router, worker = _run_tier(model, params, prompts, [6, 6, 6],
+                                        kv_codec="int8")
+    assert all(len(v) == 6 for v in results.values())
+    m = telemetry.metrics()
+    ratio = next(iter(m["tpunet_codec_wire_ratio"].values()))
+    # 8 tokens x 4 leaves x 32 = 1024 elems/block, a multiple of 256:
+    # exactly (1024 + 16)/4096.
+    np.testing.assert_allclose(ratio, 0.25390625, atol=2e-4)
+    codec_tx = m["tpunet_codec_bytes_total"]
+    int8_tx = sum(v for k, v in codec_tx.items()
+                  if telemetry.labels(k).get("codec") == "int8"
+                  and telemetry.labels(k).get("dir") == "tx")
+    assert int8_tx == 3 * (1024 + 16)  # 3 blocks x 1040 wire bytes
+
+
+def test_router_backpressure_typed():
+    """With zero queue headroom and every decode slot busy, admission
+    rejects with RouterBusyError (typed, retryable) instead of queueing
+    unboundedly — and the tier still drains what it accepted."""
+    model, params = _tiny_setup()
+    rng = np.random.default_rng(2)
+    p0 = rng.integers(0, 64, 6).astype(np.int32)
+    router, _, th = _start_tier(model, params, kv_codec="f32",
+                                decode_slots=1, queue_limit=0)
+    rid = router.submit(p0, 4)  # occupies the single decode slot
+    with pytest.raises(serve.RouterBusyError):
+        router.submit(p0, 4)    # slot busy, zero queue headroom -> typed
+    assert router.stats["rejected"] == 1
+    results = router.run(timeout=240)
+    np.testing.assert_array_equal(results[rid],
+                                  _oracle(model, params, p0, 4))
+    router.shutdown()
+    th.join(timeout=60)
+    router.close()
+
+
+# ---------------------------------------------------------------------------
+# Failure containment: decode-rank death mid-request.
+
+
+@pytest.mark.parametrize("retain_kv", [True, False])
+def test_decode_rank_death_replay_contained(retain_kv):
+    """One decode rank dies ABRUPTLY with a shipped request unreported;
+    the router contains it: the request replays on the surviving rank —
+    from the retained KV block (retain_kv=True, no second prefill) or by
+    re-prefilling — and every stream completes bitwise-correct."""
+    model, params = _tiny_setup()
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, 64, 7).astype(np.int32) for _ in range(4)]
+    lens = [6, 6, 6, 6]
+
+    lsock = serve.Router.listen("127.0.0.1:0")
+    addr = "127.0.0.1:%d" % lsock.getsockname()[1]
+
+    def flaky_decode():
+        worker = serve.connect_decode(addr, model, params, slots=1,
+                                      max_len=40, kv_codec="f32")
+        # Ingest blocks but never report, then die with them in flight.
+        worker.serve(max_blocks=1)
+        worker.close()
+
+    def healthy_decode():
+        worker = serve.connect_decode(addr, model, params, slots=1,
+                                      max_len=40, kv_codec="f32")
+        try:
+            worker.serve()
+        finally:
+            worker.close()
+
+    th_flaky = threading.Thread(target=flaky_decode, daemon=True)
+    th_flaky.start()
+    prefill = serve.PrefillEngine(model, params, max_len=40)
+    router = serve.Router(prefill, kv_codec="f32", retain_kv=retain_kv)
+    router.accept_ranks(lsock, 1)
+    th_healthy = threading.Thread(target=healthy_decode, daemon=True)
+    th_healthy.start()
+    router.accept_ranks(lsock, 1)
+    lsock.close()
+
+    ids = [router.submit(p, n) for p, n in zip(prompts, lens)]
+    results = router.run(timeout=240)
+    router.shutdown()
+    th_flaky.join(timeout=60)
+    th_healthy.join(timeout=60)
+
+    assert sorted(results) == sorted(ids)  # nothing lost
+    for p, n, i in zip(prompts, lens, ids):
+        got = results[i]
+        assert len(got) == n, "truncated stream"
+        np.testing.assert_array_equal(got, _oracle(model, params, p, n))
+    router.close()
+    assert router.stats["rank_failures"] == 1
+    if retain_kv:
+        assert router.stats["replays_kv"] >= 1
+        assert router.stats["replays_prefill"] == 0
+    else:
+        assert router.stats["replays_prefill"] >= 1
+
+
+def _fault_spec_decode_child(rank: int, world: int, port: int, q,
+                             fault_spec: str) -> None:
+    """Spawned decode rank; arms TPUNET_FAULT_SPEC before any engine
+    exists when given one (the chaos 'decode-rank kill'). The armed rank
+    runs one data stream so the injected close is a LAST-stream loss —
+    poison, not the single-stream failover a multi-stream comm survives —
+    i.e. a process-death-shaped failure."""
+    try:
+        import os
+
+        if fault_spec:
+            os.environ["TPUNET_FAULT_SPEC"] = fault_spec
+            os.environ["TPUNET_NSTREAMS"] = "1"
+        import jax as _jax  # env pinned by conftest import at module load
+        import jax.numpy as _jnp  # noqa: F401
+
+        from tpunet import serve as _serve
+
+        model = Transformer(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                            d_ff=64, compute_dtype=_jnp.float32)
+        toks = _jax.random.randint(_jax.random.PRNGKey(0), (2, 24), 0, 64)
+        params = model.init(_jax.random.PRNGKey(1), toks)["params"]
+        worker = _serve.connect_decode(f"127.0.0.1:{port}", model, params,
+                                       slots=2, max_len=40, kv_codec="f32")
+        worker.serve(idle_timeout=120)
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        # The fault-armed rank is SUPPOSED to die; report how.
+        q.put((rank, f"DEAD: {type(e).__name__}"))
+
+
+def test_chaos_fault_spec_decode_kill_mid_request():
+    """The acceptance chaos case: a decode rank killed mid-request via the
+    TPUNET_FAULT_SPEC grammar (all its transport streams close after a
+    byte budget — a process-death-shaped failure) while requests are in
+    flight. Every request completes via replay-from-KV on the surviving
+    rank; every output is bitwise the oracle's — zero corrupted or
+    truncated streams."""
+    import multiprocessing as mp
+
+    model, params = _tiny_setup()
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(0, 64, 8).astype(np.int32) for _ in range(6)]
+    lens = [6] * 6
+
+    lsock = serve.Router.listen("127.0.0.1:0")
+    port = lsock.getsockname()[1]
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    # The faulty rank's REPORT stream (its only send stream) closes after
+    # 100 data bytes — past its first request's FIRST+RESULT (~92B), so it
+    # dies while reporting its SECOND request: a mid-request kill with
+    # work provably in flight, whatever the scheduling interleave.
+    spec = "stream=*:side=send:after_bytes=100:action=close"
+    procs = [
+        ctx.Process(target=_fault_spec_decode_child,
+                    args=(0, 2, port, q, spec)),
+        ctx.Process(target=_fault_spec_decode_child,
+                    args=(1, 2, port, q, "")),
+    ]
+    for p in procs:
+        p.start()
+    try:
+        prefill = serve.PrefillEngine(model, params, max_len=40)
+        router = serve.Router(prefill, kv_codec="f32", retain_kv=True)
+        router.accept_ranks(lsock, 2, timeout=240)
+        lsock.close()
+        ids = [router.submit(p, n) for p, n in zip(prompts, lens)]
+        results = router.run(timeout=240)
+        router.shutdown()
+
+        assert sorted(results) == sorted(ids)
+        for p, n, i in zip(prompts, lens, ids):
+            got = results[i]
+            assert len(got) == n, "truncated stream"
+            np.testing.assert_array_equal(
+                got, _oracle(model, params, p, n))
+        assert router.stats["rank_failures"] == 1
+        assert router.stats["replays_kv"] >= 1
+        statuses = {}
+        for _ in range(2):
+            rank, status = q.get(timeout=120)
+            statuses[rank] = status
+        # The armed rank died by injection; the healthy rank drained clean.
+        assert statuses[0].startswith("DEAD"), statuses
+        assert statuses[1] == "OK", statuses
+        router.close()
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.kill()
+
+
+# ---------------------------------------------------------------------------
+# submit_kv validation surface.
+
+
+def test_submit_kv_validation():
+    model, params = _tiny_setup()
+    srv = BatchServer(model, params, slots=1, max_len=24)
+    shapes = srv.kv_leaf_shapes(5)
+    assert shapes == [(5, 4, 8)] * 4
+    rows = [np.zeros(s, np.float32) for s in shapes]
+    logits = np.zeros(64, np.float32)
+    with pytest.raises(ValueError, match="KV block 0"):
+        srv.submit_kv(np.arange(5, dtype=np.int32), 4,
+                      [np.zeros((5, 4, 7), np.float32)] + rows[1:], logits)
+    with pytest.raises(ValueError, match="last_logits"):
+        srv.submit_kv(np.arange(5, dtype=np.int32), 4, rows,
+                      np.zeros(63, np.float32))
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        srv.submit_kv(np.arange(5, dtype=np.int32), 40, rows, logits)

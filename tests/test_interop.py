@@ -175,3 +175,47 @@ def test_two_communicator_async_registry_no_collision():
     finally:
         comm_a.close()
         comm_b.close()
+
+
+def _ticket_after_worker(rank: int, world: int, port: int, q) -> None:
+    """`after=` threads through the TICKET API: the start/finish callbacks
+    become consumers of earlier FFI results (and the ticket/finish result
+    are legal FFI `after=` operands), so a rank-asymmetric trace can bridge
+    the two ordering machineries by data flow instead of reading a
+    documented hazard."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from tpunet import distributed
+        from tpunet.interop import (
+            dcn_all_gather,
+            dcn_all_reduce,
+            dcn_all_reduce_finish,
+            dcn_all_reduce_start,
+        )
+
+        distributed.initialize(f"127.0.0.1:{port}", rank, world)
+        x = jnp.asarray(_rank_arr(rank, 1024))
+
+        def prog(v):
+            a = dcn_all_reduce(v, "sum")                    # FFI path
+            t = dcn_all_reduce_start(2.0 * v, after=(a,))   # pinned after a
+            g = dcn_all_gather(v, after=(t,))               # pinned after start
+            r = dcn_all_reduce_finish(t, v, after=(g,))     # pinned after gather
+            return a, g, r
+
+        a, g, r = jax.jit(prog)(x)
+        expect = sum(_rank_arr(s, 1024) for s in range(world))
+        np.testing.assert_allclose(np.asarray(a), expect, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(r), 2.0 * expect, rtol=1e-5,
+                                   atol=1e-5)
+        for s in range(world):
+            np.testing.assert_array_equal(np.asarray(g)[s], _rank_arr(s, 1024))
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def test_ticket_after_bridges_ffi_ordering():
+    run_spawn_workers(_ticket_after_worker, 2)

@@ -406,3 +406,44 @@ def _push_worker(rank: int, world: int, port: int, q) -> None:
 
 def test_metrics_push():
     run_spawn_workers(_push_worker, 1)
+
+
+def _ephemeral_port_worker(rank: int, world: int, port: int, q) -> None:
+    """TPUNET_METRICS_PORT=0 binds an EPHEMERAL port: the env still reads
+    0, the bound port is learnable only via telemetry.metrics_port(), and
+    scrape() with no argument finds it — the multi-tier-on-one-box
+    contract (serving tiers each run their own listener with zero port
+    bookkeeping)."""
+    try:
+        os.environ["TPUNET_METRICS_PORT"] = "0"
+        os.environ["TPUNET_RANK"] = str(rank)
+
+        from tpunet import telemetry
+
+        telemetry.metrics_text()  # constructs the singleton -> binds
+        bound = telemetry.metrics_port()
+        assert bound > 0, "ephemeral bind did not happen"
+        assert os.environ["TPUNET_METRICS_PORT"] == "0"  # env untouched
+        text = telemetry.scrape()  # no port arg: native fallback
+        assert "tpunet_serve_queue_depth" in text
+        assert "tpunet_req_ttft_us_count" in text
+        _lint_exposition(text)
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def test_metrics_port_ephemeral_bind():
+    run_spawn_workers(_ephemeral_port_worker, 1)
+
+
+def test_serve_observe_validation():
+    """The serving-tier SLO accessors reject unknown kinds/tiers loudly."""
+    import pytest
+
+    from tpunet import telemetry
+
+    with pytest.raises(ValueError, match="kind"):
+        telemetry.serve_observe("latency", 1)
+    with pytest.raises(ValueError, match="tier"):
+        telemetry.serve_queue_depth("edge", 1)

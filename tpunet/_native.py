@@ -188,6 +188,12 @@ def load() -> ctypes.CDLL:
     lib.tpunet_c_trace_flush.restype = i32
     lib.tpunet_c_trace_set_dir.argtypes = [ctypes.c_char_p]
     lib.tpunet_c_trace_set_dir.restype = i32
+    lib.tpunet_c_metrics_port.argtypes = []
+    lib.tpunet_c_metrics_port.restype = i32
+    lib.tpunet_c_serve_observe.argtypes = [i32, u64]
+    lib.tpunet_c_serve_observe.restype = i32
+    lib.tpunet_c_serve_queue_depth.argtypes = [i32, u64]
+    lib.tpunet_c_serve_queue_depth.restype = i32
 
     lib.tpunet_c_fault_inject.argtypes = [ctypes.c_char_p]
     lib.tpunet_c_fault_inject.restype = i32

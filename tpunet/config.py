@@ -220,6 +220,18 @@ class Config:
     # must see identical contents. A missing or malformed file is a loud
     # config error here AND at communicator creation.
     dispatch_table: str = ""
+    # ---- Disaggregated serving tier (docs/DESIGN.md "Serving tier") ------
+    # KV-block wire codec for prefill->decode shipping ("int8" block-scaled
+    # by default — the EQuARX-bound codec; "f32" makes the wire exact and
+    # greedy outputs bitwise-equal to single-host serving). Negotiated at
+    # tier wiring: a mismatch raises KVCodecMismatchError on every rank.
+    kv_wire_dtype: str = "int8"
+    # Decode-rank placement policy at the router ("least_loaded" picks the
+    # rank with the most free slots; "round_robin" cycles).
+    router_policy: str = "least_loaded"
+    # Pin this process's serving-tier role ("" = unpinned). Wiring as the
+    # OTHER role then fails loudly — catches copy-pasted launch commands.
+    serve_role: str = ""
 
     @staticmethod
     def from_env() -> "Config":
@@ -335,4 +347,16 @@ class Config:
                 "collective schedule",
             ),
             dispatch_table=_env_dispatch_table("TPUNET_DISPATCH_TABLE"),
+            kv_wire_dtype=_env_choice(
+                "TPUNET_KV_WIRE_DTYPE", "int8", ("f32", "bf16", "int8"),
+                "KV-block wire codec",
+            ),
+            router_policy=_env_choice(
+                "TPUNET_ROUTER_POLICY", "least_loaded",
+                ("least_loaded", "round_robin"), "router placement policy",
+            ),
+            serve_role=_env_choice(
+                "TPUNET_SERVE_ROLE", "", ("", "frontend", "decode"),
+                "serving-tier role",
+            ),
         )

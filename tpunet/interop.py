@@ -41,14 +41,20 @@ contract is submission order across ranks, so the submission point must be
 pinned, which `ordered=True` does and the FFI schedule does not). The flip
 side: do NOT interleave start/finish with FFI `dcn_*` calls inside one
 trace when that trace bakes in the rank (rank-asymmetric programs, e.g.
-ring/zigzag attention offsets). The two mechanisms order through different
-machineries — io_callback through its token chain, FFI through the compiled
-schedule — so XLA is free to schedule an FFI collective BEFORE the
-callback-issued submission on one rank and AFTER it on another, desyncing
-the ticket sequence exactly like the unrelated-collectives hazard above
-(and `after=` cannot bridge the two: the ticket is not an FFI operand). In
-rank-asymmetric traces keep the ticket API on its own program segments, or
-use the FFI collectives end to end.
+ring/zigzag attention offsets) WITHOUT bridging them by data flow. The two
+mechanisms order through different machineries — io_callback through its
+token chain, FFI through the compiled schedule — so XLA is free to
+schedule an FFI collective BEFORE the callback-issued submission on one
+rank and AFTER it on another, desyncing the ticket sequence exactly like
+the unrelated-collectives hazard above. The bridge is `after=`, threaded
+through BOTH directions: `dcn_all_reduce_start(x, after=(ffi_result,))` /
+`dcn_all_reduce_finish(t, like, after=...)` make the callback an extra
+CONSUMER of the earlier FFI results (operands of its io_callback, so the
+token chain can't issue the submission until the FFI values exist), and an
+FFI call's `after=` accepts the start's ticket or the finish's result to
+pin the other direction (the ticket IS an array, hence a legal operand).
+In rank-asymmetric traces either bridge every adjacency that way or keep
+the ticket API on its own program segments.
 """
 
 from __future__ import annotations
@@ -281,33 +287,43 @@ def dcn_async_stats_reset() -> None:
     _async_stats["max_in_flight"] = 0
 
 
-def dcn_all_reduce_start(x, op: str = "sum"):
-    """Begin a nonblocking AllReduce of `x`; returns a ticket (int64 scalar)
-    to pass to `dcn_all_reduce_finish`. The reduction runs on the native
-    worker thread, overlapping whatever compute XLA schedules between the
-    start and finish callbacks — the bucketed-gradient-overlap primitive.
+def dcn_all_reduce_start(x, op: str = "sum", *, after=()):
+    """Begin a nonblocking AllReduce of `x`; returns a ticket (uint32
+    scalar) to pass to `dcn_all_reduce_finish`. The reduction runs on the
+    native worker thread, overlapping whatever compute XLA schedules
+    between the start and finish callbacks — the bucketed-gradient-overlap
+    primitive.
 
     Stays on the totally-ordered io_callback path even when the FFI
     collectives are enabled: cross-rank ticket pairing is SUBMISSION order,
-    which `ordered=True` pins and the FFI schedule does not. Must not be
-    interleaved with FFI `dcn_*` calls in a rank-asymmetric trace — see the
-    module docstring's "Ticket API ordering" paragraph for the hazard."""
+    which `ordered=True` pins and the FFI schedule does not. `after=`:
+    results of earlier data-independent FFI `dcn_*` calls this submission
+    must follow — they become extra operands of the start callback, so the
+    io_callback token chain cannot issue the submission before the FFI
+    collectives produced them (the cross-machinery ordering bridge; module
+    docstring "Ticket API ordering"). The returned ticket is itself a
+    legal `after=` operand for a later FFI call, pinning the reverse
+    direction."""
 
-    def cb(a):
+    def cb(a, *_deps):
         c = _comm()
         return np.uint32(_register_pending(c, c.iall_reduce(np.asarray(a), op)))
 
-    return io_callback(cb, jax.ShapeDtypeStruct((), jnp.uint32), x, ordered=True)
+    return io_callback(cb, jax.ShapeDtypeStruct((), jnp.uint32), x,
+                       *tuple(after), ordered=True)
 
 
-def dcn_all_reduce_finish(ticket, like):
+def dcn_all_reduce_finish(ticket, like, *, after=()):
     """Complete the nonblocking AllReduce for `ticket`; returns the reduced
-    array (shape/dtype of `like`, the array passed to the start call)."""
+    array (shape/dtype of `like`, the array passed to the start call).
+    `after=` pins this completion behind earlier FFI `dcn_*` results, same
+    contract as `dcn_all_reduce_start`."""
 
-    def cb(t):
+    def cb(t, *_deps):
         return _pop_pending(_comm(), int(t)).wait()
 
-    return io_callback(cb, _callback_result_spec(like), ticket, ordered=True)
+    return io_callback(cb, _callback_result_spec(like), ticket,
+                       *tuple(after), ordered=True)
 
 
 # -- other collectives ------------------------------------------------------

@@ -567,6 +567,21 @@ def _map_cache_index(cache, fn):
     return jax.tree_util.tree_map_with_path(fix, cache)
 
 
+def _kv_leaves(cache):
+    """The cached_key/cached_value leaves of a decode cache, in
+    tree-flatten order — the ONE ordering contract the serving tier's KV
+    shipping relies on: the prefill rank extracts leaf prefixes in this
+    order and the decode rank scatters them back in the same order, so the
+    flax naming/layout knowledge stays in this module (like
+    _map_cache_index). Leaves are (batch, position, kv_heads, head_dim)."""
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(cache)[0]:
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in ("cached_key", "cached_value"):
+            out.append(leaf)
+    return out
+
+
 def _spec_ring_ok(m, gamma: int) -> bool:
     """True when speculative rounds of this gamma can run on the model's
     rolling ring cache: a round writes gamma + 1 positions, which must not

@@ -29,6 +29,14 @@ A dispatch then commits up to gamma+1 tokens per row instead of one;
 greedy outputs are bitwise `generate()`'s. The draft cache rides the same
 slot lifecycle (row surgery prefills both).
 
+Disaggregated mode (`submit_kv()`): a request whose prompt K/V was
+computed on a PREFILL RANK and shipped over the transport (tpunet.serve)
+refills its slot through a jitted adopt program — shipped prefix written
+into the row, index set, first token sampled from the shipped logits —
+instead of re-running prefill. On an exact (f32) KV wire the adopted state
+is bitwise what local prefill would have produced, so greedy outputs
+cannot be told apart from single-host serving (docs/DESIGN.md §10).
+
 The reference repo has no inference path at all (it is a transport;
 SURVEY §2.3); this is framework capability above it.
 """
@@ -43,9 +51,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpunet.models.generate import (_get_cache_index, _make_spec_round_core,
-                                    _map_cache_index, _prefill,
-                                    _set_cache_index, _spec_ring_ok,
+from tpunet.models.generate import (_get_cache_index, _kv_leaves,
+                                    _make_spec_round_core, _map_cache_index,
+                                    _prefill, _set_cache_index, _spec_ring_ok,
                                     _validate_sampling, filtered_logits,
                                     init_cache, make_sampler)
 
@@ -81,7 +89,8 @@ class BatchServer:
                  top_p: float | None = None, eos_id: int | None = None,
                  rng=None, prefill_chunk: int | None = None,
                  steps_per_call: int = 1, refill_coalesce: int = 1,
-                 draft_model=None, draft_params=None, gamma: int = 4):
+                 draft_model=None, draft_params=None, gamma: int = 4,
+                 on_first_token=None):
         _validate_sampling(temperature, top_k, top_p)
         if (draft_model is None) != (draft_params is None):
             raise ValueError("draft_model and draft_params come together")
@@ -157,7 +166,12 @@ class BatchServer:
         self._toks = jnp.zeros(slots, jnp.int32)
         self._key = rng if rng is not None else jax.random.PRNGKey(0)
         self._done_buffer: list[dict] = []  # finished before step() drained
-        self.stats = {"decode_windows": 0, "prefills": 0}
+        self.stats = {"decode_windows": 0, "prefills": 0, "kv_adopts": 0}
+        # Serving-tier hook: called with a request's id the moment its FIRST
+        # token is committed (TTFT instrumentation for the disaggregated
+        # decode worker). Host-side, after the window readback — never
+        # inside a jitted program.
+        self._on_first_token = on_first_token
 
         sample = make_sampler(temperature, top_k, top_p)
 
@@ -216,6 +230,40 @@ class BatchServer:
             tok = sample(last, sub)  # (n,)
             toks = toks.at[rows].set(tok)
             return cache, toks, tok, key
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def adopt_slots(cache, toks, kv, last, rows, key):
+            # Disaggregated-serving refill: install SHIPPED prompt K/V into
+            # the claimed slots instead of re-running prefill. `kv` is a
+            # tuple of (n, p, kv_heads, head_dim) blocks in _kv_leaves
+            # order (the prefill rank extracted them in the same order);
+            # `last` is the prefill's final-position logits (n, vocab), so
+            # the first token is sampled EXACTLY like the local-prefill
+            # path (greedy outputs bitwise-equal to single-host serving on
+            # an exact KV wire). Stale K/V above position p in the adopted
+            # rows is masked by the decode step until overwritten — the
+            # same argument that makes ordinary slot refill sound.
+            key, sub = jax.random.split(key)
+            plen = kv[0].shape[1]
+            span = jnp.arange(plen)
+            blocks = iter(kv)
+
+            def fix(path, leaf):
+                name = (path[-1].key if hasattr(path[-1], "key")
+                        else str(path[-1]))
+                if name in ("cached_key", "cached_value"):
+                    blk = next(blocks).astype(leaf.dtype)
+                    return leaf.at[rows[:, None], span[None, :]].set(blk)
+                if name == "cache_index":
+                    return leaf.at[rows].set(
+                        jnp.asarray(plen, leaf.dtype))
+                return leaf
+            cache = jax.tree_util.tree_map_with_path(fix, cache)
+            tok = sample(last, sub)  # (n,)
+            toks = toks.at[rows].set(tok)
+            return cache, toks, tok, key
+
+        self._adopt_slots = adopt_slots
 
         if draft_model is not None:
             greedy = temperature == 0.0
@@ -328,6 +376,65 @@ class BatchServer:
         self._pending.append(req)
         return req["id"]
 
+    def kv_leaf_shapes(self, plen: int) -> list[tuple]:
+        """Expected shapes of the per-leaf KV blocks `submit_kv` installs
+        for a prompt of length `plen`, in shipping order: one
+        (plen, kv_heads, head_dim) entry per cached_key/cached_value leaf
+        (tree-flatten order — the prefill tier extracts in the same
+        order)."""
+        return [(plen,) + tuple(leaf.shape[2:])
+                for leaf in _kv_leaves(self._cache)]
+
+    def submit_kv(self, prompt, max_new_tokens: int, kv_rows, last_logits) -> int:
+        """Enqueue one request whose prompt K/V was computed ELSEWHERE (a
+        prefill rank) and shipped here: the refill installs `kv_rows` into
+        the claimed slot instead of re-running prefill — the decode half
+        of the disaggregated serving tier (tpunet.serve). `kv_rows` is a
+        list of numpy arrays matching kv_leaf_shapes(len(prompt));
+        `last_logits` is the prefill's final-position logit row (vocab,),
+        from which the first token is sampled exactly like the
+        local-prefill path (greedy outputs are bitwise-equal to
+        single-host serving when the KV wire is exact)."""
+        if self._draft is not None:
+            raise ValueError(
+                "submit_kv requires a non-speculative server: the draft "
+                "cache has no shipped prompt K/V to propose from")
+        if getattr(self.model, "attn_window", None) is not None:
+            raise ValueError(
+                "submit_kv requires a full-capacity cache (attn_window "
+                "models keep a rolling ring whose slot->position mapping "
+                "is not the shipped prefix layout)")
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1 or prompt.size < 1:
+            raise ValueError(f"prompt must be 1-D non-empty, got "
+                             f"shape {prompt.shape}")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.size + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new ({max_new_tokens}) "
+                f"exceeds max_len {self.max_len}")
+        shapes = self.kv_leaf_shapes(prompt.size)
+        if len(kv_rows) != len(shapes):
+            raise ValueError(f"expected {len(shapes)} KV blocks, "
+                             f"got {len(kv_rows)}")
+        kv_rows = [np.asarray(b, np.float32) for b in kv_rows]
+        for i, (blk, want) in enumerate(zip(kv_rows, shapes)):
+            if tuple(blk.shape) != want:
+                raise ValueError(
+                    f"KV block {i} has shape {tuple(blk.shape)}, "
+                    f"expected {want}")
+        last_logits = np.asarray(last_logits, np.float32)
+        if last_logits.shape != (self.model.vocab,):
+            raise ValueError(
+                f"last_logits must be ({self.model.vocab},), got "
+                f"{last_logits.shape}")
+        req = {"id": next(self._ids), "prompt": prompt,
+               "max_new": max_new_tokens, "chunks": [], "n_out": 0,
+               "kv_rows": kv_rows, "kv_logits": last_logits}
+        self._pending.append(req)
+        return req["id"]
+
     def _fill_slots(self, defer: bool = False) -> None:
         if not (self._free and self._pending):
             return
@@ -344,8 +451,29 @@ class BatchServer:
         while self._free and self._pending:
             claims.append((self._pending.pop(0), self._free.pop()))
         by_len: dict[int, list] = {}
+        by_len_kv: dict[int, list] = {}
         for req, r in claims:
-            by_len.setdefault(req["prompt"].size, []).append((req, r))
+            target = by_len_kv if "kv_rows" in req else by_len
+            target.setdefault(req["prompt"].size, []).append((req, r))
+
+        def commit(group, tok):
+            if defer:
+                # Pipelined mode: don't sync on the refill's sampled
+                # tokens (that would drain every in-flight window behind
+                # them). Hold the device vector; the next absorb resolves
+                # it BEFORE appending that window's tokens, so outputs and
+                # retirement decisions are unchanged — only their
+                # host-side timing shifts to the next window boundary.
+                holder = {"dev": tok, "np": None}  # one readback, shared
+                for i, (req, r) in enumerate(group):
+                    self._live[r] = req
+                    req["_pending"] = (holder, i)
+            else:
+                arr = np.asarray(tok)
+                for i, (req, r) in enumerate(group):
+                    self._live[r] = req
+                    self._append_tokens(r, req, arr[i: i + 1])
+
         for group in by_len.values():
             reqs = [q for q, _ in group]
             rows = jnp.asarray(np.array([r for _, r in group], np.int32))
@@ -363,22 +491,25 @@ class BatchServer:
                     self._cache, self._toks, prompts, rows,
                     self._key, self._prefill_chunk)
             self.stats["prefills"] += len(group)
-            if defer:
-                # Pipelined mode: don't sync on the prefill's sampled
-                # tokens (that would drain every in-flight window behind
-                # them). Hold the device vector; the next absorb resolves
-                # it BEFORE appending that window's tokens, so outputs and
-                # retirement decisions are unchanged — only their
-                # host-side timing shifts to the next window boundary.
-                holder = {"dev": tok, "np": None}  # one readback, shared
-                for i, (req, r) in enumerate(group):
-                    self._live[r] = req
-                    req["_pending"] = (holder, i)
-            else:
-                arr = np.asarray(tok)
-                for i, (req, r) in enumerate(group):
-                    self._live[r] = req
-                    self._append_tokens(r, req, arr[i: i + 1])
+            commit(group, tok)
+        for group in by_len_kv.values():
+            # Shipped-KV refill (disaggregated serving): one batched adopt
+            # dispatch per same-length group — the row surgery writes the
+            # shipped prefix instead of recomputing it.
+            reqs = [q for q, _ in group]
+            rows = jnp.asarray(np.array([r for _, r in group], np.int32))
+            kv = tuple(
+                jnp.asarray(np.stack([q["kv_rows"][i] for q in reqs]))
+                for i in range(len(reqs[0]["kv_rows"])))
+            last = jnp.asarray(np.stack([q["kv_logits"] for q in reqs]))
+            for q in reqs:  # the device copies above own the data now
+                q.pop("kv_rows")
+                q.pop("kv_logits")
+            (self._cache, self._toks, tok,
+             self._key) = self._adopt_slots(
+                self._cache, self._toks, kv, last, rows, self._key)
+            self.stats["kv_adopts"] += len(group)
+            commit(group, tok)
 
     def _append_tokens(self, r: int, req: dict, toks_np) -> None:
         """Commit a window's tokens to a request — vectorized: cut at
@@ -387,6 +518,7 @@ class BatchServer:
         the done buffer) when either bound is hit; a request can finish at
         ANY commit point, including its first prefill-sampled token."""
         take = min(req["max_new"] - req["n_out"], len(toks_np))
+        first = req["n_out"] == 0
         chunk = toks_np[:take]
         if self.eos_id is not None:
             hits = np.nonzero(chunk == self.eos_id)[0]
@@ -394,6 +526,8 @@ class BatchServer:
                 chunk = chunk[: hits[0] + 1]  # keep the eos itself
         req["chunks"].append(chunk)
         req["n_out"] += len(chunk)
+        if first and len(chunk) and self._on_first_token is not None:
+            self._on_first_token(req["id"])  # TTFT hook (serving tier)
         if (req["n_out"] >= req["max_new"]
                 or (self.eos_id is not None and chunk.size
                     and chunk[-1] == self.eos_id)):

@@ -1,0 +1,65 @@
+"""Disaggregated prefill/decode serving tier over the tpunet transport.
+
+The single-host inference stack (BatchServer continuous batching, per-row
+KV cache) crosses the DCN here: **prefill ranks** run prompt ingestion and
+produce KV blocks, **decode ranks** run the BatchServer slot machine, and
+the blocks ship between them over the transport's multi-stream P2P path
+using the block-scaled wire codec (int8 by default — the EQuARX
+|err| <= amax/254 bound and its goldens carry over unchanged; f32 makes
+the wire exact and the greedy output stream bitwise-equal to single-host
+serving).
+
+Layers (docs/DESIGN.md "Serving tier"):
+
+  kv        KV-block flatten/encode/decode + model signature
+  protocol  tier wiring handshake (typed mismatch on every rank) and the
+            CRC-covered block/first/result frames
+  prefill   PrefillEngine — the frontend's prompt-ingestion engine
+  router    Router — admission, least-loaded placement, backpressure,
+            failover (replay-from-KV / re-prefill), TTFT/TPOT SLO export
+  decode    DecodeWorker — the decode rank's serve loop (adopts shipped
+            KV into BatchServer slots, never re-prefills)
+
+Minimal two-process setup::
+
+    # decode box
+    worker = serve.connect_decode("10.0.0.1:7100", model, params,
+                                  slots=8, max_len=512)
+    worker.serve()
+
+    # frontend box
+    pe = serve.PrefillEngine(model, params, max_len=512)
+    router = serve.Router(pe)
+    lsock = serve.Router.listen("0.0.0.0:7100")
+    router.accept_ranks(lsock, n=1)
+    rid = router.submit(prompt_tokens, max_new_tokens=64)
+    tokens = router.run()[rid]
+
+Env knobs (registered in Config.from_env): TPUNET_KV_WIRE_DTYPE,
+TPUNET_ROUTER_POLICY, TPUNET_SERVE_ROLE.
+"""
+
+from tpunet.serve.decode import DecodeWorker, connect as connect_decode  # noqa: F401
+from tpunet.serve.kv import (  # noqa: F401
+    KV_CODECS,
+    decode_kv_block,
+    encode_kv_block,
+    kv_block_elems,
+    kv_wire_bytes,
+    model_signature,
+)
+from tpunet.serve.prefill import PrefillEngine  # noqa: F401
+from tpunet.serve.protocol import (  # noqa: F401
+    Hello,
+    FrameLink,
+    KVCodecMismatchError,
+    KVIntegrityError,
+    NoLiveDecodeRankError,
+    RouterBusyError,
+    ServeError,
+    TierMismatchError,
+    TierProtocolError,
+    wire_decode,
+    wire_frontend,
+)
+from tpunet.serve.router import Router  # noqa: F401

@@ -1,0 +1,161 @@
+"""Decode tier: the BatchServer slot machine fed by shipped KV blocks.
+
+A DecodeWorker owns one BatchServer and one FrameLink to the frontend. Its
+serve loop is single-threaded and non-blocking: drain arriving BLOCK
+frames (decode the KV wire, ``submit_kv`` — never a re-prefill), advance
+every live slot one window, then report — a FIRST frame the moment a
+request's first token commits (the router's TTFT stamp) and a RESULT frame
+with the full token array and the measured TPOT when it retires. Requests
+are never streamed token-by-token across the DCN: a request either
+completes with its whole (exact) output or it doesn't report at all and
+the router replays it elsewhere — the invariant that makes decode-rank
+death unable to corrupt or truncate a stream.
+"""
+
+from __future__ import annotations
+
+import time
+
+from tpunet import telemetry, transport
+from tpunet.models.serve import BatchServer
+from tpunet.serve import kv as kv_mod
+from tpunet.serve import protocol as proto
+
+
+class DecodeWorker:
+    """Serve loop around a BatchServer for one decode rank."""
+
+    def __init__(self, model, params, link: proto.FrameLink, *,
+                 slots: int, max_len: int, kv_codec: str = "int8",
+                 **server_kwargs):
+        if kv_codec not in kv_mod.KV_CODECS:
+            raise ValueError(f"unknown KV wire codec {kv_codec!r}")
+        self._net = None  # set by connect(): the engine this worker owns
+        self.link = link
+        self.kv_codec = kv_codec
+        self.srv = BatchServer(model, params, slots=slots, max_len=max_len,
+                               on_first_token=self._on_first,
+                               **server_kwargs)
+        self._router_id: dict[int, int] = {}  # local id -> router req id
+        self._t_first: dict[int, float] = {}
+        self._first_pending: list[int] = []
+        self.stats = {"blocks": 0, "results": 0}
+
+    def _on_first(self, local_id: int) -> None:
+        self._t_first[local_id] = time.monotonic()
+        self._first_pending.append(local_id)
+
+    def _ingest(self) -> tuple[bool, bool]:
+        """Drain available frames; returns (progressed, shutdown_seen)."""
+        progressed = shutdown = False
+        while True:
+            frame = self.link.poll()
+            if frame is None:
+                return progressed, shutdown
+            progressed = True
+            ftype, rid, payload, _aux = frame
+            if ftype == proto.T_BLOCK:
+                prompt, max_new, n_kv, logits, wire = proto.unpack_block(
+                    payload, self.kv_codec)
+                shapes = self.srv.kv_leaf_shapes(len(prompt))
+                if kv_mod.kv_block_elems(shapes) != n_kv:
+                    raise proto.TierProtocolError(
+                        f"BLOCK for request {rid} carries {n_kv} KV "
+                        f"elements; this model/prompt-length expects "
+                        f"{kv_mod.kv_block_elems(shapes)}")
+                rows = kv_mod.decode_kv_block(wire, self.kv_codec, shapes)
+                local = self.srv.submit_kv(prompt, max_new, rows, logits)
+                self._router_id[local] = rid
+                self.stats["blocks"] += 1
+            elif ftype == proto.T_SHUTDOWN:
+                shutdown = True
+            else:
+                raise proto.TierProtocolError(
+                    f"decode tier got unexpected frame type {ftype}")
+
+    def _report(self, finished: list[dict]) -> None:
+        # FIRST frames go out before any RESULT so the router's TTFT stamp
+        # for a request always precedes its completion.
+        for local in self._first_pending:
+            rid = self._router_id.get(local)
+            if rid is not None:
+                self.link.send_frame(proto.T_FIRST, rid)
+        self._first_pending.clear()
+        for rec in finished:
+            rid = self._router_id.pop(rec["id"], None)
+            if rid is None:
+                continue
+            t_first = self._t_first.pop(rec["id"], None)
+            ntok = len(rec["tokens"])
+            tpot_us = 0
+            if t_first is not None and ntok > 1:
+                tpot_us = int((time.monotonic() - t_first) / (ntok - 1) * 1e6)
+            self.link.send_frame(
+                proto.T_RESULT, rid,
+                proto.pack_result(rec["tokens"], 0, tpot_us))
+            self.stats["results"] += 1
+
+    def serve(self, *, idle_timeout: float | None = None,
+              poll_interval: float = 0.001,
+              max_blocks: int | None = None) -> None:
+        """Run until a SHUTDOWN frame arrives and every live request has
+        reported (or `idle_timeout` seconds pass with no traffic — a test
+        harness convenience). `max_blocks` returns after ingesting that
+        many KV blocks WITHOUT draining — a canary/chaos control (the
+        failover tests use it to die with requests in flight). Transport
+        errors propagate: a dead frontend ends the worker, and a worker
+        killed by fault injection simply stops reporting — the router's
+        failover owns what happens next."""
+        draining = False
+        idle_since = time.monotonic()
+        while True:
+            progressed, shutdown = self._ingest()
+            draining = draining or shutdown
+            if max_blocks is not None and self.stats["blocks"] >= max_blocks:
+                return
+            if self.srv._live or self.srv._pending:
+                finished = self.srv.step()
+                self._report(finished)
+                progressed = True
+            telemetry.serve_queue_depth(
+                "decode", len(self.srv._live) + len(self.srv._pending))
+            if draining and not (self.srv._live or self.srv._pending):
+                return
+            if progressed:
+                idle_since = time.monotonic()
+            else:
+                if (idle_timeout is not None
+                        and time.monotonic() - idle_since > idle_timeout):
+                    return
+                time.sleep(poll_interval)
+
+    def close(self) -> None:
+        """Tear down the link (and the engine, when this worker owns one —
+        the connect() path): comms closed, stream threads joined."""
+        self.link.close()
+        if self._net is not None:
+            self._net.close()
+            self._net = None
+
+
+def connect(addr, model, params, *, slots: int, max_len: int,
+            kv_codec: str | None = None, timeout: float = 60.0,
+            net: transport.Net | None = None,
+            **server_kwargs) -> DecodeWorker:
+    """Wire this process to a frontend at `addr` ("host:port" or tuple) as
+    a decode rank and return the ready DecodeWorker. `kv_codec` None
+    defers to TPUNET_KV_WIRE_DTYPE (default int8)."""
+    from tpunet.config import Config
+
+    if kv_codec is None:
+        kv_codec = Config.from_env().kv_wire_dtype
+    owns_net = net is None
+    net = net or transport.Net()
+    hello = proto.Hello(proto.ROLE_DECODE, kv_codec, slots, max_len,
+                        model.vocab, kv_mod.model_signature(model))
+    link = proto.wire_decode(addr, net, hello, timeout=timeout)
+    worker = DecodeWorker(model, params, link, slots=slots, max_len=max_len,
+                          kv_codec=kv_codec, **server_kwargs)
+    if owns_net:
+        worker._net = net  # close() tears the engine down with the link
+    return worker

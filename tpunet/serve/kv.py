@@ -1,0 +1,80 @@
+"""KV-block wire codec for the disaggregated serving tier.
+
+A *KV block* is one request's prompt K/V — the ``cached_key`` /
+``cached_value`` prefixes ``[0:plen]`` of every layer, in
+``generate._kv_leaves`` order — flattened to ONE f32 vector and encoded
+with the collective wire codec (``tpunet_c_codec_encode``): f32
+passthrough, bf16 RNE, or block-scaled int8 with the EQuARX-derived
+|err| <= amax/254 bound. Because each block is a single encode call, the
+int8 scale blocks RESTART PER KV BLOCK (first 4 wire bytes = f32 scale of
+the first 256 elements) and non-finite inputs poison their scale block to
+NaN loudly — the exact properties the codec goldens pin, carried over
+unchanged.
+
+The final-position logits ride NEXT TO the block as raw f32, never through
+the codec: the first sampled token stays exact under every KV codec, so an
+int8 wire approximates only the attention context, not the sampling
+distribution it was prefilled for.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from tpunet import transport
+
+#: Wire dtypes a KV block can ship as (the collective codec vocabulary).
+KV_CODECS = ("f32", "bf16", "int8")
+
+
+def kv_block_elems(shapes: list[tuple]) -> int:
+    """Total f32 element count of a KV block with the given per-leaf shapes
+    (``BatchServer.kv_leaf_shapes`` / ``PrefillEngine.kv_leaf_shapes``)."""
+    return sum(int(math.prod(s)) for s in shapes)
+
+
+def kv_wire_bytes(codec: str, shapes: list[tuple]) -> int:
+    """Encoded byte count of a KV block under ``codec`` — the exact sizing
+    rule both tiers frame against (bf16: 2n; int8: n + 4*ceil(n/256))."""
+    return transport.codec_wire_bytes(codec, kv_block_elems(shapes))
+
+
+def encode_kv_block(kv_rows: list[np.ndarray], codec: str) -> np.ndarray:
+    """Flatten the per-leaf KV prefixes into one f32 vector and encode it
+    with the wire codec (ONE encode call — int8 scale blocks restart here).
+    Returns the wire bytes (uint8). Feeds ``tpunet_codec_bytes_total`` /
+    ``tpunet_codec_wire_ratio`` like every other codec call."""
+    if codec not in KV_CODECS:
+        raise ValueError(f"unknown KV wire codec {codec!r}")
+    flat = np.concatenate(
+        [np.ascontiguousarray(b, np.float32).ravel() for b in kv_rows])
+    return transport.codec_encode(flat, codec)
+
+
+def decode_kv_block(wire, codec: str, shapes: list[tuple]) -> list[np.ndarray]:
+    """Decode a KV block's wire bytes back into per-leaf f32 arrays of
+    ``shapes`` (the receiver's ``kv_leaf_shapes(plen)``) — the adopt-side
+    half of the round trip. Raises ValueError when the wire size does not
+    match the shapes' encoded size."""
+    if codec not in KV_CODECS:
+        raise ValueError(f"unknown KV wire codec {codec!r}")
+    n = kv_block_elems(shapes)
+    flat = transport.codec_decode(np.frombuffer(bytes(wire), np.uint8), codec, n)
+    out = []
+    off = 0
+    for s in shapes:
+        m = int(math.prod(s))
+        out.append(flat[off:off + m].reshape(s))
+        off += m
+    return out
+
+
+def model_signature(model) -> int:
+    """Config fingerprint both tiers must agree on before any KV block can
+    be interpreted: CRC32C of the module's repr (flax dataclass — captures
+    vocab, depth, heads, dims, window, cache flavor). Parameter VALUES are
+    deliberately not covered (too big to hash at wiring); mismatched
+    weights produce wrong tokens, not mis-framed wire bytes."""
+    return transport.crc32c(repr(model).encode())

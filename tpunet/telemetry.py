@@ -19,12 +19,18 @@ Chrome-trace spans for every request plus collective phase spans tagged
   merge_traces()      -> join per-rank trace files into one Perfetto
                          timeline, aligned by collective tags
   scrape()            -> GET the native /metrics listener
+  metrics_port()      -> bound port of the /metrics listener (0 = none);
+                         the only way to learn an ephemeral-port bind
+  serve_observe()     -> record one serving-tier TTFT/TPOT latency sample
+  serve_queue_depth() -> set a serving tier's queue-depth gauge
 
 Env flags (rank-gated 0-7 like the reference, nthread:108-130):
   TPUNET_TRACE_DIR            directory for Chrome-trace JSON (Perfetto)
   TPUNET_METRICS_ADDR         pushgateway "user:pass@host:port"
   TPUNET_METRICS_INTERVAL_MS  push period, default 1000
   TPUNET_METRICS_PORT         on-demand /metrics scrape listener port
+                              (unset = off; 0 = bind an EPHEMERAL port,
+                              readable via metrics_port())
   TPUNET_TCPINFO_INTERVAL_MS  TCP_INFO sample period per stream (0 = off)
   TPUNET_STRAGGLER_FACTOR     straggler threshold k over the median sRTT
 """
@@ -117,6 +123,48 @@ def reset() -> None:
     window so the first doesn't bleed into the second."""
     lib = _native.load()
     _native.check(lib.tpunet_c_metrics_reset(), "metrics_reset")
+
+
+def metrics_port() -> int:
+    """Bound port of the on-demand /metrics listener, or 0 when none is up.
+
+    With ``TPUNET_METRICS_PORT=0`` the native layer binds an EPHEMERAL port
+    (so several tiers on one loopback box can each run a listener without
+    port bookkeeping) and this accessor is the only way to learn which —
+    the env var still reads 0. Forces singleton construction, so it is safe
+    to call before any engine exists."""
+    lib = _native.load()
+    return int(lib.tpunet_c_metrics_port())
+
+
+_SERVE_KINDS = {"ttft": 0, "tpot": 1}
+_SERVE_TIERS = {"router": 0, "prefill": 1, "decode": 2}
+
+
+def serve_observe(kind: str, us: int) -> None:
+    """Record one serving-tier latency sample (microseconds) into the
+    ``tpunet_req_ttft_us`` (kind="ttft") or ``tpunet_req_tpot_us``
+    (kind="tpot") histogram — the per-request SLO families the
+    disaggregated serving tier feeds (docs/DESIGN.md "Serving tier")."""
+    if kind not in _SERVE_KINDS:
+        raise ValueError(f"kind must be one of {sorted(_SERVE_KINDS)}, got {kind!r}")
+    lib = _native.load()
+    _native.check(
+        lib.tpunet_c_serve_observe(_SERVE_KINDS[kind], max(0, int(us))),
+        "serve_observe",
+    )
+
+
+def serve_queue_depth(tier: str, depth: int) -> None:
+    """Set the instantaneous ``tpunet_serve_queue_depth{tier=...}`` gauge
+    for one serving tier ("router", "prefill" or "decode")."""
+    if tier not in _SERVE_TIERS:
+        raise ValueError(f"tier must be one of {sorted(_SERVE_TIERS)}, got {tier!r}")
+    lib = _native.load()
+    _native.check(
+        lib.tpunet_c_serve_queue_depth(_SERVE_TIERS[tier], max(0, int(depth))),
+        "serve_queue_depth",
+    )
 
 
 def flush_trace() -> None:
@@ -221,10 +269,16 @@ def merge_traces(trace_dir: str, out_path: str | None = None) -> str:
 
 def scrape(port: int | None = None, host: str = "127.0.0.1", timeout: float = 5.0) -> str:
     """GET the native on-demand /metrics listener (TPUNET_METRICS_PORT) and
-    return the exposition text — what a Prometheus scraper would see."""
+    return the exposition text — what a Prometheus scraper would see. With
+    no explicit port, falls back to the env var and then to the natively
+    bound port (metrics_port()) — which covers the ephemeral-port case
+    (TPUNET_METRICS_PORT=0)."""
     if port is None:
-        port = int(os.environ.get("TPUNET_METRICS_PORT", "0"))
+        port = int(os.environ.get("TPUNET_METRICS_PORT", "0") or "0")
     if not port:
-        raise ValueError("no port given and TPUNET_METRICS_PORT unset")
+        port = metrics_port()
+    if not port:
+        raise ValueError("no port given, TPUNET_METRICS_PORT unset, and no "
+                         "native /metrics listener is bound")
     with urllib.request.urlopen(f"http://{host}:{port}/metrics", timeout=timeout) as r:
         return r.read().decode()
