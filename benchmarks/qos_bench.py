@@ -1,0 +1,275 @@
+"""Two-tenant QoS bench: bulk AllReduce flood vs latency-class P2P pings.
+
+The Transport-QoS acceptance bench (docs/DESIGN.md "Transport QoS"): two
+spawned ranks run a bulk-class 64 MiB AllReduce loop; rank 0 concurrently
+fires latency-class 64 KiB P2P pings at rank 1 (round-trip). Three phases,
+all counter-gated (the PR 3/5 epistemic stance — wall-clock ratios are
+reported for real-NIC runs, but the CLAIMS ride counters):
+
+  1. bulk alone          -> the no-contention baseline (bytes + seconds)
+  2. pings alone         -> the uncontended latency RTT floor
+  3. bulk + pings        -> the contended run
+
+Reported per rank 0:
+  * latency-class p99 wire-credit queue wait (tpunet_qos_queue_wait_us)
+    under contention — the scheduler-side bound;
+  * ping RTT p50/p99 uncontended vs contended — the end-to-end view;
+  * bulk bytes by counters in phases 1 and 3 (must be EQUAL: the gate
+    reorders, it never drops) and the wall-clock ratio (the "within 10%"
+    throughput claim on hardware where the wire, not the 1-core loopback
+    memcpy floor, is the bottleneck);
+  * per-class byte counters + preemptions.
+
+`--check` asserts the gates (qos_smoke.py is the CI twin of this bench):
+latency p99 queue wait <= --p99-budget-us AND contended bulk bytes match
+the baseline.
+
+Run:
+  TPUNET_QOS_INFLIGHT_BYTES=wire=4M python -m benchmarks.qos_bench --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _percentile(xs, q):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+def _p99_queue_wait_us(metrics, cls):
+    from tpunet import telemetry
+
+    buckets = []
+    for key, value in metrics.get("tpunet_qos_queue_wait_us_bucket", {}).items():
+        lab = telemetry.labels(key)
+        if lab.get("class") != cls:
+            continue
+        le = lab["le"]
+        buckets.append((float("inf") if le == "+Inf" else float(le), int(value)))
+    buckets.sort()
+    if not buckets or buckets[-1][1] == 0:
+        return None
+    total = buckets[-1][1]
+    for bound, cum in buckets:
+        if cum >= 0.99 * total:
+            return bound
+    return float("inf")
+
+
+def _rank_main(rank, port, handle_q, out_q, args):
+    try:
+        import numpy as np
+
+        from tpunet import telemetry
+        from tpunet import transport as tp
+        from tpunet.collectives import Communicator
+
+        bulk_comm = Communicator(f"127.0.0.1:{port}", rank, 2,
+                                 traffic_class="bulk")
+        net_lat = tp.Net(traffic_class="latency")
+        if rank == 1:
+            lc = net_lat.listen()
+            handle_q.put(bytes(lc.handle))
+            rc = lc.accept()
+            sc = net_lat.connect(handle_q.get(timeout=60))
+        else:
+            sc = net_lat.connect(handle_q.get(timeout=60))
+            lc = net_lat.listen()
+            handle_q.put(bytes(lc.handle))
+            rc = lc.accept()
+
+        grad = np.ones(args.bulk_bytes // 4, np.float32)
+        ping = np.full(args.ping_bytes, 7, np.uint8)
+        pong = np.empty_like(ping)
+
+        def bulk_loop(n):
+            t0 = time.monotonic()
+            for _ in range(n):
+                bulk_comm.all_reduce(grad)
+            return time.monotonic() - t0
+
+        stop = threading.Event()
+
+        def ponger():
+            # rank 1 echoes every ping back on the latency link.
+            while not stop.is_set():
+                try:
+                    rc.irecv(pong).wait(timeout=1)
+                except Exception:  # noqa: BLE001 — timeout poll
+                    continue
+                sc.isend(pong).wait(timeout=60)
+
+        def ping_round():
+            t0 = time.monotonic()
+            sc.isend(ping).wait(timeout=60)
+            rc.irecv(pong).wait(timeout=60)
+            return (time.monotonic() - t0) * 1e3
+
+        result = {"rank": rank}
+        if rank == 1:
+            th = threading.Thread(target=ponger, daemon=True)
+            th.start()
+            for phase in ("baseline", "contended"):
+                result[f"bulk_{phase}_s"] = bulk_loop(args.iters)
+            stop.set()
+            th.join(timeout=5)
+        else:
+            telemetry.reset()
+            result["bulk_baseline_s"] = bulk_loop(args.iters)
+            m = telemetry.metrics()
+            result["bulk_baseline_bytes"] = _qos_tx(m, "bulk")
+            result["ping_rtt_ms_uncontended"] = [
+                ping_round() for _ in range(args.pings)]
+            telemetry.reset()
+            rtts = []
+            bulk_done = {}
+
+            def bulk_bg():
+                bulk_done["s"] = bulk_loop(args.iters)
+
+            th = threading.Thread(target=bulk_bg, daemon=True)
+            th.start()
+            while th.is_alive():
+                rtts.append(ping_round())
+                time.sleep(args.ping_interval_ms / 1e3)
+            th.join()
+            m = telemetry.metrics()
+            result.update(
+                bulk_contended_s=bulk_done["s"],
+                bulk_contended_bytes=_qos_tx(m, "bulk"),
+                ping_rtt_ms_contended=rtts,
+                lat_p99_queue_wait_us=_p99_queue_wait_us(m, "latency"),
+                qos_bytes={
+                    f"{telemetry.labels(k)['class']}/{telemetry.labels(k)['dir']}":
+                        int(v)
+                    for k, v in m.get("tpunet_qos_bytes_total", {}).items()},
+                qos_preempts={
+                    telemetry.labels(k)["class"]: int(v)
+                    for k, v in m.get("tpunet_qos_preempts_total", {}).items()},
+                wire_window=tp.qos_state()["wire_window"],
+            )
+        out_q.put((rank, "OK", result))
+    except Exception as e:  # noqa: BLE001
+        out_q.put((rank, f"FAIL: {type(e).__name__}: {e}", None))
+
+
+def _qos_tx(metrics, cls):
+    from tpunet import telemetry
+
+    for k, v in metrics.get("tpunet_qos_bytes_total", {}).items():
+        lab = telemetry.labels(k)
+        if lab.get("class") == cls and lab.get("dir") == "tx":
+            return int(v)
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--iters", type=int, default=4,
+                    help="bulk AllReduce iterations per phase")
+    ap.add_argument("--bulk-bytes", type=int, default=64 << 20,
+                    help="bulk AllReduce payload bytes (default 64MiB)")
+    ap.add_argument("--ping-bytes", type=int, default=64 << 10,
+                    help="latency-class ping bytes (default 64KiB)")
+    ap.add_argument("--pings", type=int, default=32,
+                    help="uncontended RTT samples")
+    ap.add_argument("--ping-interval-ms", type=float, default=5.0)
+    ap.add_argument("--p99-budget-us", type=float, default=100_000.0)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--check", action="store_true",
+                    help="assert the QoS gates (else report only)")
+    args = ap.parse_args()
+
+    # The gate must be armed before any native load; keep the operator's
+    # setting when present, else a bench-sized default.
+    os.environ.setdefault("TPUNET_QOS_INFLIGHT_BYTES", "wire=4M")
+    os.environ.setdefault("TPUNET_QOS_WEIGHTS", "latency=8,bulk=1")
+
+    ctx = mp.get_context("spawn")
+    handle_q, out_q = ctx.Queue(), ctx.Queue()
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    procs = [ctx.Process(target=_rank_main, args=(r, port, handle_q, out_q, args))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(2):
+            rank, status, payload = out_q.get(timeout=600)
+            if status != "OK":
+                raise RuntimeError(f"rank {rank}: {status}")
+            results[rank] = payload
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.kill()
+
+    r0 = results[0]
+    summary = {
+        "config": {"iters": args.iters, "bulk_bytes": args.bulk_bytes,
+                   "ping_bytes": args.ping_bytes,
+                   "qos_inflight_bytes": os.environ["TPUNET_QOS_INFLIGHT_BYTES"],
+                   "qos_weights": os.environ["TPUNET_QOS_WEIGHTS"]},
+        "bulk_baseline_s": r0["bulk_baseline_s"],
+        "bulk_contended_s": r0["bulk_contended_s"],
+        "bulk_slowdown": r0["bulk_contended_s"] / max(r0["bulk_baseline_s"], 1e-9),
+        "bulk_baseline_bytes": r0["bulk_baseline_bytes"],
+        "bulk_contended_bytes": r0["bulk_contended_bytes"],
+        "lat_p99_queue_wait_us": r0["lat_p99_queue_wait_us"],
+        "ping_rtt_ms": {
+            "uncontended_p50": _percentile(r0["ping_rtt_ms_uncontended"], 0.5),
+            "uncontended_p99": _percentile(r0["ping_rtt_ms_uncontended"], 0.99),
+            "contended_p50": _percentile(r0["ping_rtt_ms_contended"], 0.5),
+            "contended_p99": _percentile(r0["ping_rtt_ms_contended"], 0.99),
+        },
+        "qos_bytes": r0["qos_bytes"],
+        "qos_preempts": r0["qos_preempts"],
+        "wire_window": r0["wire_window"],
+    }
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(f"bulk: {summary['bulk_baseline_s']:.2f}s solo -> "
+              f"{summary['bulk_contended_s']:.2f}s contended "
+              f"({summary['bulk_slowdown']:.2f}x)")
+        print(f"latency p99 queue wait: {summary['lat_p99_queue_wait_us']}us; "
+              f"ping p99 {summary['ping_rtt_ms']['uncontended_p99']:.2f} -> "
+              f"{summary['ping_rtt_ms']['contended_p99']:.2f} ms")
+    if args.check:
+        p99 = summary["lat_p99_queue_wait_us"]
+        assert p99 is not None and p99 <= args.p99_budget_us, p99
+        # Budget parity by counters: both phases moved the full AllReduce
+        # byte volume (ring wire bytes = payload per rank at W=2). Baseline
+        # additionally carries a few wiring/quiesce token bytes, so compare
+        # each phase against the payload floor, not phase-vs-phase.
+        floor = args.iters * args.bulk_bytes
+        assert summary["bulk_baseline_bytes"] >= floor
+        assert summary["bulk_contended_bytes"] >= floor
+        # The 10% throughput claim is a real-NIC number: on the 1-core
+        # loopback box both tenants share one memcpy floor, so the check
+        # there is generous (the counters above carry the strict claims).
+        assert summary["bulk_slowdown"] <= 2.0, summary["bulk_slowdown"]
+        print("qos bench checks OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

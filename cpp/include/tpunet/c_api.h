@@ -44,6 +44,11 @@ extern "C" {
  * communicator wiring time by the codec handshake on EVERY rank, before any
  * payload could be mis-decoded. */
 #define TPUNET_ERR_CODEC -7
+/* QoS admission backpressure (TPUNET_QOS_INFLIGHT_BYTES): the send's
+ * traffic class already has its in-flight byte budget posted. Nothing was
+ * enqueued or charged — retry after in-flight work drains (the serve
+ * router replays front-of-queue). docs/DESIGN.md "Transport QoS". */
+#define TPUNET_ERR_QOS_ADMISSION -8
 
 /* 64-byte opaque rendezvous blob: the serialized listen sockaddr, sized to
  * NCCL's handle budget (reference: cc/nccl_types.h:44). Ship it to the
@@ -66,6 +71,13 @@ typedef struct tpunet_net_properties {
 
 /* Engine selected by env TPUNET_IMPLEMENT in {BASIC (default), EPOLL}. */
 int32_t tpunet_c_create(uintptr_t* out_instance);
+/* As tpunet_c_create, pinning the QoS traffic class every comm this engine
+ * CONNECTS will carry — traffic_class in {"latency","bulk","control"};
+ * NULL or "" defers to TPUNET_TRAFFIC_CLASS (default bulk). The class
+ * nibble rides the connect preamble, so the far side's recv comm adopts it
+ * (sender's class wins, like nstreams). Unknown names are
+ * TPUNET_ERR_INVALID. docs/DESIGN.md "Transport QoS". */
+int32_t tpunet_c_create_ex(const char* traffic_class, uintptr_t* out_instance);
 int32_t tpunet_c_destroy(uintptr_t* instance);
 
 int32_t tpunet_c_devices(uintptr_t instance, int32_t* ndev);
@@ -156,9 +168,15 @@ int32_t tpunet_comm_create(const char* coordinator, int32_t rank, int32_t world_
  * disagreements fail wiring on EVERY rank: TPUNET_ERR_CODEC for the codec,
  * TPUNET_ERR_INVALID for the algo/dispatch-table handshake (ranks on
  * different schedules deadlock — this fails them loudly first). */
+/* traffic_class in {"latency","bulk","control"} selects the QoS lane every
+ * comm the communicator wires will carry; NULL or "" defers to
+ * TPUNET_TRAFFIC_CLASS (default bulk). The class byte rides the same
+ * bootstrap handshake as the codec/algo: a cross-rank disagreement is
+ * TPUNET_ERR_INVALID on EVERY rank. */
 int32_t tpunet_comm_create_ex(const char* coordinator, int32_t rank,
                               int32_t world_size, const char* wire_dtype,
-                              const char* algo, uintptr_t* comm);
+                              const char* algo, const char* traffic_class,
+                              uintptr_t* comm);
 /* Negotiated wire codec of a live communicator: 0=f32, 1=bf16, 2=int8. */
 int32_t tpunet_comm_wire_dtype(uintptr_t comm, int32_t* wire_dtype);
 /* Process-default communicator for callers that cannot thread a handle —
@@ -234,6 +252,25 @@ int32_t tpunet_c_serve_observe(int32_t kind, uint64_t us);
  * (tpunet_serve_queue_depth{tier=...}): 0 = router, 1 = prefill,
  * 2 = decode. */
 int32_t tpunet_c_serve_queue_depth(int32_t tier, uint64_t depth);
+
+/* ---- Transport QoS introspection (docs/DESIGN.md "Transport QoS") -------
+ * Text echo of the process QoS scheduler's parsed config (weights, budgets,
+ * wire window) and live state (admitted/in-flight bytes, queue depths) into
+ * buf (NUL-terminated, truncated to cap). Returns the full length
+ * (excluding NUL) — the buffer-sizing contract of tpunet_c_metrics_text.
+ * Lets Python pin that TPUNET_QOS_WEIGHTS / TPUNET_QOS_INFLIGHT_BYTES
+ * parsed to what the operator meant. */
+int32_t tpunet_c_qos_state(char* buf, uint64_t cap);
+/* Deficit-round-robin arithmetic golden: simulate the wire-credit grant
+ * order for `chunks` ("class:bytes,class:bytes,...", queued in order) under
+ * `weights` (TPUNET_QOS_WEIGHTS grammar) and `window` ("wire=<bytes>");
+ * completions retire in grant order. Writes the comma-separated class grant
+ * sequence into out (same sizing contract). Pure arithmetic — no sockets,
+ * no clocks — so tests can pin strict control priority and the weighted
+ * latency/bulk interleave exactly. Malformed specs are TPUNET_ERR_INVALID
+ * with the offending token in tpunet_c_last_error(). */
+int32_t tpunet_c_qos_drr_golden(const char* weights, const char* window,
+                                const char* chunks, char* out, uint64_t cap);
 
 #ifdef __cplusplus
 }

@@ -72,6 +72,17 @@ class Communicator {
   static Status Create(const std::string& coordinator, int rank, int world_size,
                        const std::string& wire_dtype, const std::string& algo,
                        std::unique_ptr<Communicator>* out);
+  // As above, additionally pinning the QoS traffic class ("latency" /
+  // "bulk" / "control"; empty = TPUNET_TRAFFIC_CLASS, default bulk —
+  // docs/DESIGN.md "Transport QoS"). The class byte rides the same
+  // bootstrap handshake as the codec/algo: ranks that disagree ALL fail at
+  // wiring time (half a group on the latency lane would silently unbalance
+  // the scheduler, so the disagreement is loud instead). Unknown names are
+  // kInvalidArgument.
+  static Status Create(const std::string& coordinator, int rank, int world_size,
+                       const std::string& wire_dtype, const std::string& algo,
+                       const std::string& traffic_class,
+                       std::unique_ptr<Communicator>* out);
 
   // sendbuf may equal recvbuf (in-place). count = elements. Blocking
   // AllReduce is exactly IAllReduce+WaitTicket (MPI/NCCL matching rule:

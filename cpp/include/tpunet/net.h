@@ -37,6 +37,10 @@ namespace tpunet {
 //     compression codec (TPUNET_WIRE_DTYPE / wire_dtype); raised at
 //     communicator wiring time by the codec-byte handshake, before any
 //     data could be mis-decoded (docs/DESIGN.md "Compressed collectives").
+//   kQosAdmission — QoS admission control rejected a send: the traffic
+//     class's in-flight byte budget (TPUNET_QOS_INFLIGHT_BYTES) is full.
+//     Pure backpressure — NOTHING was enqueued; retry after in-flight work
+//     drains (docs/DESIGN.md "Transport QoS").
 enum class ErrorKind : int32_t {
   kOk = 0,
   kIOError = 1,
@@ -47,6 +51,7 @@ enum class ErrorKind : int32_t {
   kTimeout = 6,
   kVersion = 7,
   kCodec = 8,
+  kQosAdmission = 9,
 };
 
 struct Status {
@@ -63,6 +68,9 @@ struct Status {
   static Status Timeout(std::string m) { return Status{ErrorKind::kTimeout, std::move(m)}; }
   static Status Version(std::string m) { return Status{ErrorKind::kVersion, std::move(m)}; }
   static Status Codec(std::string m) { return Status{ErrorKind::kCodec, std::move(m)}; }
+  static Status QosAdmission(std::string m) {
+    return Status{ErrorKind::kQosAdmission, std::move(m)};
+  }
 };
 
 // Reference: interface.rs:13-22 NCCLNetProperties.
@@ -129,6 +137,15 @@ class Net {
   virtual Status close_send(uint64_t send_comm) = 0;
   virtual Status close_recv(uint64_t recv_comm) = 0;
   virtual Status close_listen(uint64_t listen_comm) = 0;
+
+  // QoS traffic class carried by every comm this engine CONNECTS (the
+  // class nibble rides the preamble flags word, so the far side's recv
+  // comm adopts it — sender's class wins, like nstreams/min_chunksize).
+  // Values are TrafficClass ints (qos.h: 0 latency, 1 bulk, 2 control);
+  // out-of-range is clamped to bulk. Set it before connect(); default is
+  // TPUNET_TRAFFIC_CLASS (bulk). docs/DESIGN.md "Transport QoS".
+  virtual void set_traffic_class(int32_t cls) { (void)cls; }
+  virtual int32_t traffic_class() const { return 1; /* bulk */ }
 };
 
 // Factory. Engine selected by env TPUNET_IMPLEMENT in {"BASIC" (default),

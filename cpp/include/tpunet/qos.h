@@ -1,0 +1,183 @@
+// tpunet transport QoS: traffic classes, weighted-fair wire scheduling, and
+// per-tenant admission control (docs/DESIGN.md "Transport QoS").
+//
+// A production host runs COMPETING tenants on one engine process — bulk
+// gradient AllReduce, latency-critical KV-block shipping, control traffic —
+// and the paper's per-stream fairness says nothing about isolation BETWEEN
+// them. This layer adds it in three pieces:
+//
+//   * Every comm carries a TRAFFIC CLASS (latency | bulk | control),
+//     advertised in the connect preamble (sender's class wins on the far
+//     side, like nstreams/min_chunksize) and negotiated across a collective
+//     group at wiring time (a disagreement fails every rank typed, the
+//     codec/algo-handshake stance).
+//   * A process-wide WIRE SCHEDULER replaces first-come chunk dispatch when
+//     a wire window is configured (TPUNET_QOS_INFLIGHT_BYTES wire=<bytes>):
+//     each data chunk must hold wire credit before its bytes enter the
+//     kernel, credit is granted by deficit round-robin over the per-class
+//     queues (quantum = TPUNET_QOS_WEIGHTS x 64KiB) with STRICT priority
+//     for the control class, and the shared window bounds how much bulk can
+//     sit in kernel socket buffers ahead of a latency chunk — the p99
+//     queue-wait bound the two-tenant bench gates on. window 0 (default)
+//     disables the gate entirely: grants are unconditional and free.
+//   * ADMISSION CONTROL: per-class in-flight message-byte budgets
+//     (TPUNET_QOS_INFLIGHT_BYTES latency=/bulk=/control=). A send posted
+//     over its class budget fails IMMEDIATELY with the typed
+//     kQosAdmission (-8, QosAdmissionError) backpressure error — nothing
+//     is enqueued, the caller (e.g. the serve router) retries. A class with
+//     zero bytes in flight always admits one message, so a message larger
+//     than its budget cannot be rejected forever.
+//
+// Observability: every decision feeds tpunet_qos_bytes_total{class,dir},
+// tpunet_qos_queue_wait_us{class} and tpunet_qos_preempts_total{class}
+// (metrics.cc), all telemetry.reset()-able.
+#ifndef TPUNET_QOS_H_
+#define TPUNET_QOS_H_
+
+#include <atomic>
+#include <cstdint>
+#include <deque>
+#include <map>
+#include <memory>
+#include <string>
+
+#include "tpunet/mutex.h"
+#include "tpunet/net.h"
+
+namespace tpunet {
+
+// Values are wire/ABI: the class nibble rides the connect preamble's flags
+// word and the collective bootstrap blob, and the ints cross the C ABI.
+enum class TrafficClass : uint8_t { kLatency = 0, kBulk = 1, kControl = 2 };
+constexpr int kTrafficClassCount = 3;
+
+// "latency" / "bulk" / "control" <-> TrafficClass. Parse returns false on
+// unknown names.
+bool ParseTrafficClass(const std::string& name, TrafficClass* out);
+const char* TrafficClassName(TrafficClass c);
+
+// DRR quantum unit: one weight point buys this many wire bytes per round.
+constexpr uint64_t kQosQuantumBytes = 64 << 10;
+
+struct QosConfig {
+  // DRR weights (TPUNET_QOS_WEIGHTS "latency=8,bulk=1"). The control class
+  // is strict-priority, so its weight is accepted but never consulted.
+  uint64_t weights[kTrafficClassCount] = {8, 1, 1};
+  // Admission budgets: max in-flight posted-send bytes per class
+  // (TPUNET_QOS_INFLIGHT_BYTES "latency=64M,bulk=256M"). 0 = unlimited.
+  uint64_t budgets[kTrafficClassCount] = {0, 0, 0};
+  // Shared wire window (TPUNET_QOS_INFLIGHT_BYTES "wire=4M"): max bytes of
+  // granted-but-unwritten chunk credit across ALL classes. 0 = gate off.
+  uint64_t wire_window = 0;
+};
+
+// Grammar: comma-separated key=value. Weights: latency|bulk|control = int
+// >= 1. Budgets: latency|bulk|control|wire = size with optional K/M/G
+// suffix (the fault-spec size grammar). Unknown keys / malformed values
+// return kInvalidArgument naming the token — Config.from_env() is the loud
+// Python-side gate; the native singleton warns to stderr and keeps its
+// defaults rather than crashing engine creation.
+Status ParseQosWeights(const std::string& spec, QosConfig* cfg);
+Status ParseQosInflightBytes(const std::string& spec, QosConfig* cfg);
+
+// Process-wide scheduler. One instance arbitrates every engine in the
+// process — the whole point is cross-tenant isolation, and tenants share
+// the process's NIC, not an engine object.
+class QosScheduler {
+ public:
+  explicit QosScheduler(const QosConfig& cfg);
+  ~QosScheduler();
+
+  // Env-configured singleton (TPUNET_QOS_WEIGHTS / TPUNET_QOS_INFLIGHT_BYTES
+  // read once, at first use). Leaked on purpose: engines may release credit
+  // during static teardown.
+  static QosScheduler& Get();
+
+  const QosConfig& config() const { return cfg_; }
+  bool wire_gate_enabled() const { return cfg_.wire_window > 0; }
+
+  // ---- Admission control (send posting time) ------------------------------
+  // Charge `nbytes` against the class budget, or fail typed kQosAdmission
+  // WITHOUT recording anything. *recorded is what FinishMessage must later
+  // return (0 when the class is unbudgeted — the uncharged fast path).
+  // A class with zero in-flight bytes always admits (oversize liveness).
+  Status AdmitMessage(TrafficClass cls, uint64_t nbytes, uint64_t* recorded);
+  void FinishMessage(TrafficClass cls, uint64_t nbytes);
+  uint64_t AdmittedBytes(TrafficClass cls) const;
+
+  // ---- Wire-credit gate (chunk dispatch time) -----------------------------
+  // Blocking acquire (BASIC stream workers): parks until the DRR pump
+  // grants `nbytes` of wire credit. Returns false — with nothing held —
+  // when *aborted flips while waiting (comm poisoned/shut down), checked
+  // every 50ms. Records the wait into the class queue-wait histogram.
+  bool AcquireWire(TrafficClass cls, uint64_t nbytes,
+                   const std::atomic<bool>* aborted);
+  // Nonblocking acquire (EPOLL event loop): true = credit held (ticket
+  // untouched). false = a ticket was enqueued into the DRR queues; poll it
+  // with PollTicket (true = credit now held, ticket consumed) and cancel it
+  // with CancelTicket if the segment dies first. With the gate disabled,
+  // always true.
+  bool TryAcquireWire(TrafficClass cls, uint64_t nbytes, uint64_t* ticket);
+  bool PollTicket(uint64_t ticket);
+  void CancelTicket(uint64_t ticket);
+  // Return `nbytes` of credit (after the chunk's bytes reached the kernel,
+  // or on any failure path of a holder).
+  void ReleaseWire(TrafficClass cls, uint64_t nbytes);
+
+  // Human-readable config + live-state echo (tpunet_c_qos_state): lets
+  // Python pin that env parsing and the native view agree.
+  std::string StateText();
+
+  // DRR arithmetic golden (tpunet_c_qos_drr_golden): simulate the grant
+  // order for a queue of chunks under `weights_spec` and a wire window from
+  // `window_spec` ("wire=64K"). `chunks` is "class:bytes,class:bytes,..."
+  // enqueued in order with the window initially full occupied by nothing;
+  // completions retire in grant order. Returns the comma-separated class
+  // grant order, or empty with *err set on a malformed spec. Pure
+  // arithmetic — no threads, no clocks — so tests can pin the scheduler's
+  // exact weighted interleave.
+  static std::string DrrGolden(const std::string& weights_spec,
+                               const std::string& window_spec,
+                               const std::string& chunks, std::string* err);
+
+ private:
+  struct Waiter {
+    TrafficClass cls = TrafficClass::kBulk;
+    uint64_t bytes = 0;
+    uint64_t seq = 0;     // global FIFO order (preemption accounting)
+    uint64_t ticket = 0;  // 0 = blocking waiter (condvar), else EPOLL ticket
+    bool granted = false;
+  };
+
+  // Grant every waiter the window + DRR arithmetic allows right now.
+  void PumpLocked() REQUIRES(mu_);
+  bool RoomLocked(uint64_t nbytes) const REQUIRES(mu_);
+  void GrantFrontLocked(int cls) REQUIRES(mu_);
+  void RemoveWaiterLocked(Waiter* w) REQUIRES(mu_);
+
+  const QosConfig cfg_;
+  // Telemetry hooks are suppressed in DrrGolden's throwaway instances so
+  // simulations don't pollute the process counters.
+  bool report_ = true;
+
+  Mutex mu_;  // leaf: nothing is acquired under it (telemetry is lock-free)
+  CondVar cv_;
+  std::deque<Waiter*> queues_[kTrafficClassCount] GUARDED_BY(mu_);
+  // Ticket storage (EPOLL waiters outlive the Try call); blocking waiters
+  // live on their caller's stack.
+  std::map<uint64_t, std::unique_ptr<Waiter>> tickets_ GUARDED_BY(mu_);
+  uint64_t next_seq_ GUARDED_BY(mu_) = 1;
+  uint64_t next_ticket_ GUARDED_BY(mu_) = 1;
+  uint64_t wire_inflight_ GUARDED_BY(mu_) = 0;
+  uint64_t deficit_[kTrafficClassCount] GUARDED_BY(mu_) = {0, 0, 0};
+  int drr_next_ GUARDED_BY(mu_) = 0;   // latency/bulk rotation pointer
+  int drr_turn_ GUARDED_BY(mu_) = -1;  // class mid-turn (-1 = pick next)
+  // DrrGolden grant log (null in the live singleton).
+  std::deque<std::pair<int, uint64_t>>* grant_log_ GUARDED_BY(mu_) = nullptr;
+
+  std::atomic<uint64_t> admitted_[kTrafficClassCount] = {};
+};
+
+}  // namespace tpunet
+
+#endif  // TPUNET_QOS_H_

@@ -64,6 +64,10 @@ constexpr int kFaultActionSlots = 5;
 // router admission queue, prefill backlog, decode slots+pending.
 constexpr int kServeTierCount = 3;
 
+// QoS traffic-class slots (latency, bulk, control — TrafficClass in qos.h;
+// kept as a bare count here so telemetry.h need not include qos.h).
+constexpr int kQosClassCount = 3;
+
 // Last getsockopt(TCP_INFO) sample for one stream slot. When several comms
 // share a stream index the last-sampled socket wins — gauges describe "a
 // live connection at this stream position", which is what stream-skew
@@ -103,11 +107,21 @@ struct MetricsSnapshot {
   // per-stream effective-time observers instead, nthread:343-348).
   uint64_t stream_tx_bytes[kMaxStreamStats] = {0};
   uint64_t stream_rx_bytes[kMaxStreamStats] = {0};
+  // QoS accounting (docs/DESIGN.md "Transport QoS"): bytes per traffic
+  // class and direction (the receiver learns the class from the preamble
+  // nibble), time chunks waited for wire credit in the DRR scheduler, and
+  // grants that jumped an older waiter of another class.
+  uint64_t qos_bytes[kQosClassCount][2] = {};  // [class][tx=0, rx=1]
+  StageHist qos_wait_us[kQosClassCount];
+  uint64_t qos_preempts[kQosClassCount] = {0};
   // Deep-observability additions (docs/DESIGN.md "Observability"):
   StreamTcpSample stream_tcp_tx[kMaxStreamStats];
   StreamTcpSample stream_tcp_rx[kMaxStreamStats];
-  double fairness_tx = 1.0;     // Jain's index over windowed per-stream bytes
-  double fairness_rx = 1.0;
+  // Jain's index over windowed per-stream bytes, per traffic class — the
+  // paper's per-stream fairness claim reported WITHIN a class, so bulk's
+  // deliberate deprioritization can't read as striping unfairness.
+  double fairness_tx[kQosClassCount] = {1.0, 1.0, 1.0};
+  double fairness_rx[kQosClassCount] = {1.0, 1.0, 1.0};
   uint64_t straggler_events = 0;
   StageHist req_queue_us;       // post -> first wire byte
   StageHist req_wire_us;        // first -> last wire byte
@@ -156,7 +170,14 @@ class Telemetry {
   void OnRequestDone(uint64_t owner, uint64_t req, bool failed);
   // Engine hot-path hook: `nbytes` moved on data-stream `stream_idx`
   // (relaxed atomic add; indices >= kMaxStreamStats clamp to the last slot).
-  void OnStreamBytes(bool is_send, uint64_t stream_idx, uint64_t nbytes);
+  // `cls` is the comm's TrafficClass int (default bulk) — it feeds both the
+  // per-class byte counters and the class-split fairness windows.
+  void OnStreamBytes(bool is_send, uint64_t stream_idx, uint64_t nbytes,
+                     int cls = 1);
+  // QoS scheduler hooks (qos.cc): one queue-wait sample per gated chunk,
+  // and one preemption event per out-of-arrival-order grant.
+  void OnQosQueueWait(int cls, uint64_t wait_us);
+  void OnQosPreempt(int cls);
   // Rate-limited TCP_INFO sampler: called from the engines' data paths after
   // chunk IO with the live socket. Costs one clock read + one relaxed atomic
   // compare when the slot's sampling window has not elapsed; otherwise does

@@ -164,6 +164,11 @@ struct Comm {
   size_t min_chunksize = 0;
   bool spin = false;
   bool crc = false;  // per-chunk CRC32C trailers (negotiated in the preamble)
+  // QoS traffic class (sender's engine class, carried to the receiver in
+  // the preamble nibble — docs/DESIGN.md "Transport QoS"). Drives the
+  // wire-credit gate on send workers and per-class byte accounting on both
+  // sides; immutable after wiring.
+  TrafficClass cls = TrafficClass::kBulk;
   std::vector<std::unique_ptr<StreamWorker>> workers;
   Queue<Msg> msgs;
   std::unique_ptr<std::thread> scheduler;
@@ -200,6 +205,9 @@ struct Comm {
   std::unique_ptr<std::thread> nack_reader;
 
   bool Aborted() const { return aborted_.load(std::memory_order_acquire); }
+  // For QosScheduler::AcquireWire's bounded park: a worker waiting for wire
+  // credit must notice comm shutdown without a dedicated wakeup channel.
+  const std::atomic<bool>* aborted_flag() const { return &aborted_; }
   // Inline fast path state (PERF_NOTES: caller->scheduler->worker hops cost
   // ~0.4ms per 1MiB message on a 1-core host). `inflight` counts messages
   // not yet fully settled; when it reads 0 the scheduler is idle and every
@@ -488,8 +496,25 @@ void PoisonAndDrainQueue(Comm* c, const std::string& why);  // defined below
 
 void SendWorkerLoop(StreamWorker* w, bool spin) {
   Comm* c = w->comm;
+  QosScheduler& qos = QosScheduler::Get();
+  const bool gated = qos.wire_gate_enabled();
   ChunkTask t;
   while (w->tasks.Pop(&t)) {
+    // QoS wire gate: hold credit for this chunk's wire bytes before they
+    // may enter the kernel socket buffer. The DRR pump (qos.cc) decides
+    // grant order across classes, so a latency-class chunk on another comm
+    // waits behind at most the window of already-granted bytes — never
+    // behind this comm's whole backlog. Credit is returned right after the
+    // write syscall on EVERY path (the kernel buffer drains on its own).
+    size_t wire_len = t.len + (c->crc ? 4 : 0);
+    if (gated && !qos.AcquireWire(c->cls, wire_len, c->aborted_flag())) {
+      // Comm aborted while parked for credit: same verdict as an IO error
+      // on an aborted comm — settle the chunk and drain.
+      t.state->SetError("comm aborted while awaiting QoS wire credit");
+      FinishChunk(w, t);
+      PoisonAndDrainQueue(c, "comm aborted while awaiting QoS wire credit");
+      continue;
+    }
     t.state->MarkWireStart(MonotonicUs());  // queue stage ends at first chunk IO
     FaultAction fa = FaultCheck(true, w->idx, w->fd, t.len);
     Status s;
@@ -509,6 +534,7 @@ void SendWorkerLoop(StreamWorker* w, bool spin) {
     } else {
       s = SendChunkWire(w->fd, t.data, t.len, c->crc, spin);
     }
+    if (gated) qos.ReleaseWire(c->cls, wire_len);
     if (!s.ok()) {
       if (SenderStreamFailed(c, w)) return;  // failover: records carry the rest
       t.state->SetError(s.msg);
@@ -528,7 +554,8 @@ void SendWorkerLoop(StreamWorker* w, bool spin) {
       }
       return;
     }
-    Telemetry::Get().OnStreamBytes(true, w->idx, t.len);
+    Telemetry::Get().OnStreamBytes(true, w->idx, t.len,
+                                   static_cast<int>(c->cls));
     Telemetry::Get().MaybeSampleStream(true, w->idx, w->fd);
     FinishChunk(w, t);
   }
@@ -569,7 +596,8 @@ void RecvWorkerLoop(StreamWorker* w, bool spin) {
                         "CRC32C mismatch on data stream " + std::to_string(w->idx) +
                             ": payload corrupted in transit");
     } else {
-      Telemetry::Get().OnStreamBytes(false, w->idx, t.len);
+      Telemetry::Get().OnStreamBytes(false, w->idx, t.len,
+                                     static_cast<int>(c->cls));
       Telemetry::Get().MaybeSampleStream(false, w->idx, w->fd);
     }
     PopRec(c, w->idx, t.seq);
@@ -765,7 +793,7 @@ Status ProcessFailoverMarkerLocked(Comm* c, uint64_t frame) REQUIRES(c->ctrl_mu)
       }
     }
     if (!r.state->failed.load(std::memory_order_acquire)) {
-      Telemetry::Get().OnStreamBytes(false, k, r.len);
+      Telemetry::Get().OnStreamBytes(false, k, r.len, static_cast<int>(c->cls));
     }
     AccountChunkDone(c, r.state, r.len);
   }
@@ -927,7 +955,8 @@ bool HandleNack(Comm* c, size_t k, uint64_t completed) {
           if (s.ok() && !r.written) {
             // First time these bytes reach the kernel: complete their
             // accounting (written records were counted by their worker).
-            Telemetry::Get().OnStreamBytes(true, k, r.len);
+            Telemetry::Get().OnStreamBytes(true, k, r.len,
+                                           static_cast<int>(c->cls));
             AccountChunkDone(c, r.state, r.len);
             r.written = true;
           }
@@ -1051,7 +1080,8 @@ void ExecuteLazyRecv(Comm* c, const Msg& m) {
                           "CRC32C mismatch on data stream " + std::to_string(idx) +
                               ": payload corrupted in transit");
       } else {
-        Telemetry::Get().OnStreamBytes(false, idx, len);
+        Telemetry::Get().OnStreamBytes(false, idx, len,
+                                       static_cast<int>(c->cls));
         Telemetry::Get().MaybeSampleStream(false, idx, w->fd);
       }
       PopRec(c, idx, seq);
@@ -1103,6 +1133,7 @@ class BasicEngine : public EngineBase {
     comm->min_chunksize = min_chunksize_;
     comm->spin = spin_;
     comm->crc = crc_;
+    comm->cls = static_cast<TrafficClass>(traffic_class());
     comm->ctrl_fd = ctrl_fd;
     for (int fd : data_fds) {
       auto w = std::make_unique<StreamWorker>();
@@ -1145,7 +1176,16 @@ class BasicEngine : public EngineBase {
     if (ForkGeneration() != c->fork_gen) {
       return Status::Inner("send comm created before fork(); its threads do not exist here");
     }
+    // QoS admission control: a send over its class's in-flight byte budget
+    // fails typed RIGHT HERE — nothing enqueued, nothing charged — so the
+    // caller (serve router, trainer) gets retryable backpressure instead of
+    // unbounded queue growth (docs/DESIGN.md "Transport QoS").
+    uint64_t admitted = 0;
+    Status as = QosScheduler::Get().AdmitMessage(c->cls, nbytes, &admitted);
+    if (!as.ok()) return as;
     auto state = std::make_shared<RequestState>();
+    state->qos_cls = static_cast<uint8_t>(c->cls);
+    state->qos_admitted = admitted;
     state->t_post_us = MonotonicUs();
     ArmWatchdog(state, c);
     uint64_t id = next_id_.fetch_add(1);
@@ -1230,6 +1270,7 @@ class BasicEngine : public EngineBase {
         *done = false;
         return Status::Ok();
       }
+      state->ReleaseQosAdmission();  // consumption point: return budget bytes
       requests_.Erase(request);
       return Status{state->ErrKind(), "request failed: " + state->ErrorMsg()};
     }
@@ -1237,6 +1278,7 @@ class BasicEngine : public EngineBase {
     if (*done) {
       if (nbytes) *nbytes = state->nbytes.load(std::memory_order_acquire);
       RecordRequestStages(state);
+      state->ReleaseQosAdmission();  // consumption point: return budget bytes
       requests_.Erase(request);  // reference leaked these (bagua_net.cc:111-121)
     }
     return Status::Ok();
@@ -1383,6 +1425,9 @@ class BasicEngine : public EngineBase {
     comm->nstreams = b.nstreams;
     comm->min_chunksize = b.min_chunksize;
     comm->crc = (b.flags & kPreambleFlagCrc) != 0;
+    // The traffic class travels the same way: the receiver accounts this
+    // comm's bytes under the SENDER's class nibble.
+    comm->cls = static_cast<TrafficClass>(PreambleClassOf(b.flags));
     comm->spin = spin_;
     comm->ctrl_fd = b.ctrl_fd;
     b.ctrl_fd = -1;

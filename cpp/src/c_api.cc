@@ -14,6 +14,7 @@
 #include "id_map.h"
 #include "tpunet/mutex.h"
 #include "tpunet/net.h"
+#include "tpunet/qos.h"
 #include "tpunet/telemetry.h"
 #include "tpunet/utils.h"
 
@@ -44,6 +45,8 @@ int32_t FromStatus(const Status& s) {
       return Fail(TPUNET_ERR_VERSION, s.msg);
     case tpunet::ErrorKind::kCodec:
       return Fail(TPUNET_ERR_CODEC, s.msg);
+    case tpunet::ErrorKind::kQosAdmission:
+      return Fail(TPUNET_ERR_QOS_ADMISSION, s.msg);
     default:
       return Fail(TPUNET_ERR_INNER, s.msg);
   }
@@ -76,10 +79,22 @@ std::shared_ptr<Instance> GetInstance(uintptr_t id) {
 extern "C" {
 
 int32_t tpunet_c_create(uintptr_t* out_instance) {
+  return tpunet_c_create_ex(nullptr, out_instance);
+}
+
+int32_t tpunet_c_create_ex(const char* traffic_class, uintptr_t* out_instance) {
   if (!out_instance) return Fail(TPUNET_ERR_NULL, "out_instance is null");
+  tpunet::TrafficClass cls = tpunet::TrafficClass::kBulk;
+  bool have_cls = traffic_class != nullptr && *traffic_class != '\0';
+  if (have_cls && !tpunet::ParseTrafficClass(traffic_class, &cls)) {
+    return Fail(TPUNET_ERR_INVALID,
+                std::string("unknown traffic_class \"") + traffic_class +
+                    "\" (expected latency, bulk or control)");
+  }
   auto inst = std::make_shared<Instance>();
   inst->net = tpunet::CreateEngine();
   if (!inst->net) return Fail(TPUNET_ERR_INNER, "engine creation failed");
+  if (have_cls) inst->net->set_traffic_class(static_cast<int32_t>(cls));
   uint64_t id = g_next_instance_id.fetch_add(1);
   g_instances.Put(id, inst);
   *out_instance = id;
@@ -343,17 +358,20 @@ extern "C" {
 int32_t tpunet_comm_create(const char* coordinator, int32_t rank, int32_t world_size,
                            uintptr_t* comm) {
   return tpunet_comm_create_ex(coordinator, rank, world_size, nullptr, nullptr,
-                               comm);
+                               nullptr, comm);
 }
 
 int32_t tpunet_comm_create_ex(const char* coordinator, int32_t rank,
                               int32_t world_size, const char* wire_dtype,
-                              const char* algo, uintptr_t* comm) {
+                              const char* algo, const char* traffic_class,
+                              uintptr_t* comm) {
   if (!coordinator || !comm) return Fail(TPUNET_ERR_NULL, "null param");
   std::unique_ptr<tpunet::Communicator> c;
   Status s = tpunet::Communicator::Create(coordinator, rank, world_size,
                                           wire_dtype ? wire_dtype : "",
-                                          algo ? algo : "", &c);
+                                          algo ? algo : "",
+                                          traffic_class ? traffic_class : "",
+                                          &c);
   if (!s.ok()) return FromStatus(s);
   uint64_t id = g_next_comm_id.fetch_add(1);
   g_comms.Put(id, std::shared_ptr<tpunet::Communicator>(std::move(c)));
@@ -546,6 +564,32 @@ int32_t tpunet_c_serve_queue_depth(int32_t tier, uint64_t depth) {
   }
   tpunet::Telemetry::Get().OnServeQueueDepth(tier, depth);
   return TPUNET_OK;
+}
+
+int32_t tpunet_c_qos_state(char* buf, uint64_t cap) {
+  if (!buf && cap > 0) return Fail(TPUNET_ERR_NULL, "buf is null");
+  std::string text = tpunet::QosScheduler::Get().StateText();
+  if (cap > 0) {
+    uint64_t n = std::min<uint64_t>(text.size(), cap - 1);
+    memcpy(buf, text.data(), n);
+    buf[n] = '\0';
+  }
+  return static_cast<int32_t>(text.size());
+}
+
+int32_t tpunet_c_qos_drr_golden(const char* weights, const char* window,
+                                const char* chunks, char* out, uint64_t cap) {
+  if ((!out && cap > 0) || !chunks) return Fail(TPUNET_ERR_NULL, "null param");
+  std::string err;
+  std::string order = tpunet::QosScheduler::DrrGolden(
+      weights ? weights : "", window ? window : "", chunks, &err);
+  if (!err.empty()) return Fail(TPUNET_ERR_INVALID, err);
+  if (cap > 0) {
+    uint64_t n = std::min<uint64_t>(order.size(), cap - 1);
+    memcpy(out, order.data(), n);
+    out[n] = '\0';
+  }
+  return static_cast<int32_t>(order.size());
 }
 
 }  // extern "C"

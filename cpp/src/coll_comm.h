@@ -32,6 +32,7 @@
 #include "tpunet/collectives.h"
 #include "tpunet/mutex.h"
 #include "tpunet/net.h"
+#include "tpunet/qos.h"
 #include "tpunet/telemetry.h"
 #include "tpunet/utils.h"
 
@@ -142,8 +143,10 @@ class ScheduledCommunicator : public Communicator {
     ScratchBuf scratch;  // chunk landing slots; aligned, never zero-filled
   };
 
-  ScheduledCommunicator(int rank, int world, WireCodec codec, CollAlgo algo)
-      : rank_(rank), world_(world), codec_(codec), algo_override_(algo) {}
+  ScheduledCommunicator(int rank, int world, WireCodec codec, CollAlgo algo,
+                        TrafficClass cls)
+      : rank_(rank), world_(world), codec_(codec), algo_override_(algo),
+        cls_(cls) {}
   ~ScheduledCommunicator() override;
 
   Status Init(const std::string& coordinator);
@@ -262,6 +265,11 @@ class ScheduledCommunicator : public Communicator {
   // at Init — (override, table CRC) ride the codec handshake — so every
   // rank resolves the same schedule for the same collective.
   CollAlgo algo_override_ = CollAlgo::kAuto;
+  // QoS traffic class for every comm this communicator wires (latency for
+  // serving P2P links, bulk for gradient rings, control for bootstrap-ish
+  // traffic). Negotiated at Init — the class byte rides the codec/algo
+  // handshake — so the whole group schedules under one class.
+  TrafficClass cls_ = TrafficClass::kBulk;
   DispatchTable dispatch_;
   std::unique_ptr<Net> net_;
   std::unique_ptr<Bootstrap> bootstrap_;

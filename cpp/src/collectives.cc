@@ -70,6 +70,10 @@ ScheduledCommunicator::~ScheduledCommunicator() {
 
 Status ScheduledCommunicator::Init(const std::string& coordinator) {
   net_ = CreateEngine();
+  // Every comm this communicator wires (ring channels, mesh pairs, async
+  // channels) carries the negotiated traffic class — set before the first
+  // connect so the preamble nibble is right from comm zero.
+  net_->set_traffic_class(static_cast<int32_t>(cls_));
   // Trace identity: every rank hashes the SAME coordinator string and
   // world size, so (comm_id, coll_seq) tags agree across ranks without a
   // wire round. |1 keeps it nonzero even for a degenerate hash.
@@ -107,6 +111,7 @@ Status ScheduledCommunicator::Init(const std::string& coordinator) {
   my_blob[3] = static_cast<uint8_t>(table_crc >> 16);
   my_blob[4] = static_cast<uint8_t>(table_crc >> 8);
   my_blob[5] = static_cast<uint8_t>(table_crc);
+  my_blob[6] = static_cast<uint8_t>(cls_);  // QoS traffic class
   std::vector<uint8_t> blobs;
   s = bootstrap_->AllGather(my_blob, sizeof(my_blob), &blobs);
   if (!s.ok()) return s;
@@ -141,6 +146,20 @@ Status ScheduledCommunicator::Init(const std::string& coordinator) {
           " and rank " + std::to_string(r) +
           " loaded different TPUNET_DISPATCH_TABLE contents (every rank must "
           "see the same table or none — per-size selection must agree)");
+    }
+    if (theirs[6] != my_blob[6]) {
+      std::string name =
+          theirs[6] < kTrafficClassCount
+              ? std::string(
+                    TrafficClassName(static_cast<TrafficClass>(theirs[6])))
+              : "#" + std::to_string(theirs[6]);
+      return Status::Invalid(
+          "traffic class mismatch: rank " + std::to_string(rank_) + " uses " +
+          TrafficClassName(cls_) + " but rank " + std::to_string(r) +
+          " uses " + name +
+          " (set TPUNET_TRAFFIC_CLASS / traffic_class= identically on every "
+          "rank — half a group on another QoS lane unbalances the "
+          "scheduler)");
     }
   }
 
@@ -729,6 +748,13 @@ Status Communicator::Create(const std::string& coordinator, int rank, int world_
 Status Communicator::Create(const std::string& coordinator, int rank, int world_size,
                             const std::string& wire_dtype, const std::string& algo,
                             std::unique_ptr<Communicator>* out) {
+  return Create(coordinator, rank, world_size, wire_dtype, algo, "", out);
+}
+
+Status Communicator::Create(const std::string& coordinator, int rank, int world_size,
+                            const std::string& wire_dtype, const std::string& algo,
+                            const std::string& traffic_class,
+                            std::unique_ptr<Communicator>* out) {
   if (world_size < 1 || rank < 0 || rank >= world_size) {
     return Status::Invalid("bad rank/world_size");
   }
@@ -745,8 +771,16 @@ Status Communicator::Create(const std::string& coordinator, int rank, int world_
     return Status::Invalid("unknown algo \"" + algo_name +
                            "\" (expected auto, ring, rhd or tree)");
   }
+  std::string cls_name = traffic_class.empty()
+                             ? GetEnv("TPUNET_TRAFFIC_CLASS", "bulk")
+                             : traffic_class;
+  TrafficClass cls;
+  if (!ParseTrafficClass(cls_name, &cls)) {
+    return Status::Invalid("unknown traffic_class \"" + cls_name +
+                           "\" (expected latency, bulk or control)");
+  }
   auto comm = std::make_unique<internal::ScheduledCommunicator>(
-      rank, world_size, codec, calgo);
+      rank, world_size, codec, calgo, cls);
   Status s = comm->Init(coordinator);
   if (!s.ok()) return s;
   *out = std::move(comm);

@@ -9,12 +9,14 @@
 #include <algorithm>
 #include <atomic>
 #include <chrono>
+#include <cstdio>
 #include <string>
 #include <vector>
 
 #include "id_map.h"
 #include "tpunet/mutex.h"
 #include "tpunet/net.h"
+#include "tpunet/qos.h"
 #include "tpunet/telemetry.h"
 #include "tpunet/utils.h"
 #include "wire.h"
@@ -33,9 +35,31 @@ class EngineBase : public Net {
     if (nstreams_ == 0) nstreams_ = 1;
     if (nstreams_ > kMaxStreams) nstreams_ = kMaxStreams;
     if (min_chunksize_ == 0) min_chunksize_ = 1;
+    // Engine-default traffic class (every comm this engine CONNECTS carries
+    // it; per-communicator overrides arrive via set_traffic_class before
+    // wiring). Unknown names fall back to bulk with a stderr warning —
+    // Config.from_env() is the loud gate (_env_choice raises).
+    TrafficClass tc = TrafficClass::kBulk;
+    std::string name = GetEnv("TPUNET_TRAFFIC_CLASS", "bulk");
+    if (!ParseTrafficClass(name, &tc)) {
+      fprintf(stderr,
+              "[tpunet] TPUNET_TRAFFIC_CLASS=%s is not latency|bulk|control; "
+              "using bulk\n",
+              name.c_str());
+      tc = TrafficClass::kBulk;
+    }
+    traffic_class_.store(static_cast<int32_t>(tc), std::memory_order_relaxed);
   }
 
   int32_t devices() override { return static_cast<int32_t>(nics_.size()); }
+
+  void set_traffic_class(int32_t cls) override {
+    if (cls < 0 || cls >= kTrafficClassCount) cls = 1;  // unknown: bulk
+    traffic_class_.store(cls, std::memory_order_relaxed);
+  }
+  int32_t traffic_class() const override {
+    return traffic_class_.load(std::memory_order_relaxed);
+  }
 
   Status get_properties(int32_t dev, NetProperties* props) override {
     Status s = CheckDev(dev);
@@ -162,14 +186,18 @@ class EngineBase : public Net {
   }
 
   // Preamble flags this engine advertises when connecting (sender's flags
-  // win on the far side, like nstreams/min_chunksize).
-  uint64_t PreambleFlags() const { return crc_ ? kPreambleFlagCrc : 0; }
+  // win on the far side, like nstreams/min_chunksize). Carries the QoS
+  // traffic-class nibble so the receiver's comm adopts the sender's class.
+  uint64_t PreambleFlags() const {
+    return (crc_ ? kPreambleFlagCrc : 0) | PreambleClassBits(traffic_class());
+  }
 
   std::vector<NicInfo> nics_;
   uint64_t nstreams_;
   uint64_t min_chunksize_;
   bool crc_;              // TPUNET_CRC=1: per-chunk CRC32C trailers
   uint64_t watchdog_ms_;  // TPUNET_PROGRESS_TIMEOUT_MS (0 = off)
+  std::atomic<int32_t> traffic_class_{1};  // TrafficClass int; default bulk
   std::atomic<uint64_t> next_id_{1};
   IdMap<ListenSockPtr> listen_comms_;
 };

@@ -70,6 +70,15 @@ struct Segment {
   size_t trailer_len = 0;   // 0 = no trailer (ctrl frames, CRC off)
   size_t trailer_done = 0;
   bool corrupt = false;     // injected fault: damage payload before verify
+  // QoS wire-credit state (send-side data segments only; docs/DESIGN.md
+  // "Transport QoS"): qos_wire is the credit this segment must hold before
+  // its bytes may enter the kernel (0 = ungated), qos_ticket a parked
+  // scheduler ticket awaiting a DRR grant, qos_enq_us the dispatch stamp
+  // behind the queue-wait histogram.
+  uint64_t qos_wire = 0;
+  uint64_t qos_ticket = 0;
+  uint64_t qos_enq_us = 0;
+  bool qos_granted = false;
   RequestPtr state;
   std::unique_ptr<uint8_t[]> owned;  // backing store for send-side ctrl frames
 };
@@ -89,6 +98,10 @@ struct FdState {
   EComm* comm = nullptr;
   std::deque<Segment> segs;
   uint32_t armed = 0;  // events currently registered with epoll
+  // Front segment is waiting for QoS wire credit: interest is disarmed
+  // (a writable socket we refuse to write would storm level-triggered
+  // epoll) and the loop's bounded-timeout QoS pass re-advances us.
+  bool qos_parked = false;
 };
 
 struct PendingRecv {
@@ -102,6 +115,9 @@ struct EComm {
   size_t nstreams = 0;
   size_t min_chunksize = 0;
   bool crc = false;  // per-chunk CRC32C trailers (negotiated in the preamble)
+  // QoS traffic class (sender's engine class; receivers adopt the preamble
+  // nibble). Immutable after wiring.
+  TrafficClass cls = TrafficClass::kBulk;
   // Inline fast path (caller-thread IO; see Loop::TryInline). `mu` guards
   // ALL mutable comm state below, taken by the loop thread at each entry
   // point and by the caller thread in TryInline — uncontended in steady
@@ -253,11 +269,16 @@ class Loop {
     epoll_event evs[kMaxEvents];
     bool stop = false;
     while (!stop) {
-      int n = ::epoll_wait(ep_, evs, kMaxEvents, -1);
+      // Credit-parked fds get no readiness events (interest disarmed), so
+      // poll on a short timeout while any exist and re-advance them —
+      // that is how a DRR grant turns back into wire bytes.
+      int timeout_ms = qos_parked_.load(std::memory_order_acquire) > 0 ? 2 : -1;
+      int n = ::epoll_wait(ep_, evs, kMaxEvents, timeout_ms);
       if (n < 0) {
         if (errno == EINTR) continue;
         break;  // unrecoverable epoll failure; drained below
       }
+      if (qos_parked_.load(std::memory_order_acquire) > 0) RetryQosParked();
       for (int i = 0; i < n; ++i) {
         FdState* fs = static_cast<FdState*>(evs[i].data.ptr);
         if (fs == nullptr) {
@@ -474,6 +495,12 @@ class Loop {
         // reads the peer's 4 bytes into it and verifies at completion.
         if (c->is_send) EncodeU32BE(Crc32c(seg.data, seg.len), seg.trailer);
       }
+      if (c->is_send && QosScheduler::Get().wire_gate_enabled()) {
+        // Gate this chunk's wire bytes behind the DRR scheduler; the grant
+        // happens in AdvanceFdLocked right before the bytes would move.
+        seg.qos_wire = seg.len + seg.trailer_len;
+        seg.qos_enq_us = MonotonicUs();
+      }
       fs->segs.push_back(std::move(seg));
       WantIOLocked(c, fs);
       off += n;
@@ -515,8 +542,67 @@ class Loop {
   // iovecs (payload remainder + trailer remainder); well under IOV_MAX.
   static constexpr int kIovBatch = 64;
 
+  // True when `seg` may put bytes on the wire (holds credit or needs none).
+  // On false a scheduler ticket is parked; the segment re-polls it on every
+  // advance until the DRR pump grants.
+  bool QosGrantLocked(EComm* c, Segment& seg) REQUIRES(c->mu) {
+    if (seg.qos_wire == 0 || seg.qos_granted) return true;
+    QosScheduler& qs = QosScheduler::Get();
+    bool got;
+    if (seg.qos_ticket == 0) {
+      got = qs.TryAcquireWire(c->cls, seg.qos_wire, &seg.qos_ticket);
+    } else {
+      got = qs.PollTicket(seg.qos_ticket);
+      if (got) seg.qos_ticket = 0;
+    }
+    if (got) {
+      seg.qos_granted = true;
+      Telemetry::Get().OnQosQueueWait(static_cast<int>(c->cls),
+                                      MonotonicUs() - seg.qos_enq_us);
+    }
+    return got;
+  }
+
+  static bool QosNeedsCredit(const Segment& seg) {
+    return seg.qos_wire > 0 && !seg.qos_granted;
+  }
+
+  // Caller holds c->mu (by convention — only the atomic counter and the
+  // convention-guarded FdState flag are touched, and FailCommLocked calls
+  // the unpark from a lambda TSA analyzes as a separate function).
+  void QosParkLocked(EComm* c, FdState* fs) {
+    (void)c;
+    if (fs->qos_parked) return;
+    fs->qos_parked = true;
+    qos_parked_.fetch_add(1, std::memory_order_acq_rel);
+    // The loop may be blocked in epoll_wait(-1); nudge it onto the bounded
+    // timeout so the QoS retry pass runs. Harmless when called on-loop.
+    uint64_t one = 1;
+    (void)!::write(wake_, &one, sizeof(one));
+  }
+
+  void QosUnparkLocked(EComm* c, FdState* fs) {
+    (void)c;
+    if (!fs->qos_parked) return;
+    fs->qos_parked = false;
+    qos_parked_.fetch_sub(1, std::memory_order_acq_rel);
+  }
+
+  // QoS retry pass (loop thread): re-advance every fd parked on wire
+  // credit. Runs at most every couple of ms while anything is parked.
+  void RetryQosParked() {
+    for (auto& kv : comms_) {
+      EComm* c = kv.second.get();
+      MutexLock lk(c->mu);
+      for (auto& fss : c->streams) {
+        if (fss->qos_parked) AdvanceFdLocked(c, fss.get());
+      }
+    }
+  }
+
   void AdvanceFdLocked(EComm* c, FdState* fs) REQUIRES(c->mu) {
     if (c->failed || fs->fd < 0) return;
+    QosUnparkLocked(c, fs);  // re-parks below if still credit-blocked
     if (!c->is_send && fs->is_ctrl) {
       AdvanceRecvCtrlLocked(c);
       return;
@@ -533,6 +619,11 @@ class Loop {
       size_t want = 0;
       for (Segment& seg : fs->segs) {
         if (niov + 2 > kIovBatch) break;
+        if (c->is_send && !fs->is_ctrl && !QosGrantLocked(c, seg)) {
+          // No wire credit yet: nothing past this segment may move either
+          // (per-fd FIFO keeps the wire order the receiver expects).
+          break;
+        }
         size_t left = seg.len - seg.done;
         if (left > 0 && !fs->is_ctrl) {
           // Fault gate (data payload only; ctrl frames and trailers are
@@ -591,7 +682,8 @@ class Loop {
           if (!fs->is_ctrl) {
             if (seg.done == 0) seg.state->MarkWireStart(now);
             Telemetry::Get().OnStreamBytes(c->is_send, fs->stream_idx,
-                                           static_cast<uint64_t>(take));
+                                           static_cast<uint64_t>(take),
+                                           static_cast<int>(c->cls));
           }
           seg.done += take;
           moved -= take;
@@ -607,6 +699,15 @@ class Loop {
         break;  // kernel stopped mid-segment; moved is 0 here
       }
       if (static_cast<size_t>(m) < want) break;  // kernel full/empty: arm below
+    }
+    if (c->is_send && !fs->is_ctrl && !fs->segs.empty() &&
+        QosNeedsCredit(fs->segs.front())) {
+      // Head-of-queue segment lacks wire credit: disarm interest (a
+      // writable socket we refuse to write would storm level-triggered
+      // epoll) and park for the loop's bounded-timeout QoS pass.
+      Arm(c, fs, 0);
+      QosParkLocked(c, fs);
+      return;
     }
     WantIOLocked(c, fs);
   }
@@ -661,6 +762,10 @@ class Loop {
   }
 
   void CompleteSegment(EComm* c, Segment& seg, FdState* fs) REQUIRES(c->mu) {
+    if (seg.qos_granted) {
+      QosScheduler::Get().ReleaseWire(c->cls, seg.qos_wire);
+      seg.qos_granted = false;
+    }
     if (seg.counts_bytes) {
       seg.state->nbytes.fetch_add(seg.len, std::memory_order_relaxed);
       seg.state->MarkWireEnd(MonotonicUs());
@@ -687,11 +792,23 @@ class Loop {
     c->fail_msg = msg;
     auto fail_fd = [&](FdState& fs) {
       for (Segment& seg : fs.segs) {
+        // QoS bookkeeping must not leak with the segment: held credit goes
+        // back to the DRR pump (so a dead bulk comm can never starve the
+        // latency lane) and parked tickets are withdrawn.
+        if (seg.qos_granted) {
+          QosScheduler::Get().ReleaseWire(c->cls, seg.qos_wire);
+          seg.qos_granted = false;
+        }
+        if (seg.qos_ticket != 0) {
+          QosScheduler::Get().CancelTicket(seg.qos_ticket);
+          seg.qos_ticket = 0;
+        }
         seg.state->SetError(msg);
         seg.state->completed.fetch_add(1, std::memory_order_acq_rel);
         seg.state->NotifyIfSettled();
       }
       fs.segs.clear();
+      QosUnparkLocked(c, &fs);
       // Fully deregister (not just interest=0): EPOLLHUP/ERR are reported
       // regardless of the requested mask, so a dead peer's fds left in the
       // epoll set would spin this loop thread at 100% until detach.
@@ -715,6 +832,9 @@ class Loop {
   const uint64_t fork_gen_ = ForkGeneration();  // fork detection (see Post)
   std::unique_ptr<std::thread> thread_;
   Mutex mu_;
+  // Count of fds parked on QoS wire credit: while nonzero the loop swaps
+  // its infinite epoll_wait for a short timeout and runs RetryQosParked.
+  std::atomic<int> qos_parked_{0};
   // Written unlocked only in the constructor (TSA exempts ctors; no other
   // thread exists until thread_ starts below that write).
   bool dead_ GUARDED_BY(mu_) = false;
@@ -755,8 +875,9 @@ class EpollEngine : public EngineBase {
     Status s = ConnectBundle(nics_, dev, handle, nstreams_, min_chunksize_, PreambleFlags(),
                              &data_fds, &ctrl_fd);
     if (!s.ok()) return s;
-    return AttachComm(true, nstreams_, min_chunksize_, crc_, ctrl_fd, data_fds, send_comm,
-                      &send_comms_);
+    return AttachComm(true, nstreams_, min_chunksize_, crc_,
+                      static_cast<TrafficClass>(traffic_class()), ctrl_fd,
+                      data_fds, send_comm, &send_comms_);
   }
 
   Status accept(uint64_t listen_comm, uint64_t* recv_comm) override {
@@ -769,8 +890,10 @@ class EpollEngine : public EngineBase {
     b.data_fds.clear();
     b.ctrl_fd = -1;
     // Sender's chunk-map inputs win (carried in the preamble) — the CRC
-    // flag too: the receiver verifies iff the sender appends trailers.
+    // flag too: the receiver verifies iff the sender appends trailers. The
+    // traffic-class nibble travels the same way (rx accounting).
     return AttachComm(false, b.nstreams, b.min_chunksize, (b.flags & kPreambleFlagCrc) != 0,
+                      static_cast<TrafficClass>(PreambleClassOf(b.flags)),
                       ctrl_fd, data_fds, recv_comm, &recv_comms_);
   }
 
@@ -791,6 +914,7 @@ class EpollEngine : public EngineBase {
     if (state->failed.load(std::memory_order_acquire)) {
       // Failed segments are dropped on the loop thread before failed is set,
       // so the caller's buffer is already quiescent here.
+      state->ReleaseQosAdmission();  // consumption point: return budget bytes
       requests_.Erase(request);
       return Status{state->ErrKind(), "request failed: " + state->ErrorMsg()};
     }
@@ -798,6 +922,7 @@ class EpollEngine : public EngineBase {
     if (*done) {
       if (nbytes) *nbytes = state->nbytes.load(std::memory_order_acquire);
       RecordRequestStages(state);
+      state->ReleaseQosAdmission();  // consumption point: return budget bytes
       requests_.Erase(request);
     }
     return Status::Ok();
@@ -827,13 +952,14 @@ class EpollEngine : public EngineBase {
 
  private:
   Status AttachComm(bool is_send, uint64_t nstreams, uint64_t min_chunksize, bool crc,
-                    int ctrl_fd, const std::vector<int>& data_fds, uint64_t* out_id,
-                    IdMap<CommHandle>* map) {
+                    TrafficClass cls, int ctrl_fd, const std::vector<int>& data_fds,
+                    uint64_t* out_id, IdMap<CommHandle>* map) {
     auto comm = std::make_shared<EComm>();
     comm->is_send = is_send;
     comm->nstreams = nstreams;
     comm->min_chunksize = min_chunksize;
     comm->crc = crc;
+    comm->cls = cls;
     comm->ctrl.fd = ctrl_fd;
     comm->ctrl.is_ctrl = true;
     comm->ctrl.comm = comm.get();
@@ -858,7 +984,16 @@ class EpollEngine : public EngineBase {
     if (!map.Get(comm_id, &h)) {
       return Status::Invalid("unknown comm " + std::to_string(comm_id));
     }
+    // QoS admission control (send side): a post over the class's in-flight
+    // byte budget fails typed before anything is enqueued or charged.
+    uint64_t admitted = 0;
+    if (h.comm->is_send) {
+      Status as = QosScheduler::Get().AdmitMessage(h.comm->cls, nbytes, &admitted);
+      if (!as.ok()) return as;
+    }
     auto state = std::make_shared<RequestState>();
+    state->qos_cls = static_cast<uint8_t>(h.comm->cls);
+    state->qos_admitted = admitted;
     state->t_post_us = MonotonicUs();
     if (watchdog_ms_ > 0) {
       // Progress-watchdog abort hook: a timeout verdict in WaitIn shuts the

@@ -195,8 +195,16 @@ struct Telemetry::Impl {
   std::atomic<uint64_t> irecv_hist[kHistBuckets] = {};
   std::atomic<uint64_t> inflight{0};
   std::atomic<uint64_t> failed{0};
-  std::atomic<uint64_t> stream_tx[kMaxStreamStats] = {};
-  std::atomic<uint64_t> stream_rx[kMaxStreamStats] = {};
+  // Per-(class, stream) byte cells: tpunet_stream_{tx,rx}_bytes sums the
+  // class axis, tpunet_qos_bytes_total sums the stream axis, and the
+  // class-split Jain windows read the cells directly — one write site
+  // feeds all three views.
+  std::atomic<uint64_t> stream_tx[kQosClassCount][kMaxStreamStats] = {};
+  std::atomic<uint64_t> stream_rx[kQosClassCount][kMaxStreamStats] = {};
+  // QoS scheduler accounting: per-class wire-credit queue-wait histograms
+  // and the out-of-arrival-order grant (preemption) counters.
+  StageHistAtomic qos_wait[kQosClassCount];
+  std::atomic<uint64_t> qos_preempts[kQosClassCount] = {};
   std::atomic<uint64_t> faults_injected[kFaultActionSlots] = {};
   std::atomic<uint64_t> stream_failovers{0};
   std::atomic<uint64_t> crc_errors{0};
@@ -232,10 +240,12 @@ struct Telemetry::Impl {
   bool win_init GUARDED_BY(win_mu) = false;
   uint64_t win_last_us GUARDED_BY(win_mu) = 0;
   uint64_t fairness_window_us = GetEnvU64("TPUNET_FAIRNESS_WINDOW_MS", 1000) * 1000;
-  uint64_t win_tx[kMaxStreamStats] GUARDED_BY(win_mu) = {0};
-  uint64_t win_rx[kMaxStreamStats] GUARDED_BY(win_mu) = {0};
-  std::atomic<uint64_t> fair_tx_bits{DoubleToBits(1.0)};
-  std::atomic<uint64_t> fair_rx_bits{DoubleToBits(1.0)};
+  uint64_t win_tx[kQosClassCount][kMaxStreamStats] GUARDED_BY(win_mu) = {};
+  uint64_t win_rx[kQosClassCount][kMaxStreamStats] GUARDED_BY(win_mu) = {};
+  std::atomic<uint64_t> fair_tx_bits[kQosClassCount] = {
+      DoubleToBits(1.0), DoubleToBits(1.0), DoubleToBits(1.0)};
+  std::atomic<uint64_t> fair_rx_bits[kQosClassCount] = {
+      DoubleToBits(1.0), DoubleToBits(1.0), DoubleToBits(1.0)};
 
   // Span tracking (tracing only). span_mu also serializes trace-file writes
   // (FlushTrace) and the trace target swap (SetTraceDir); leaf lock.
@@ -499,10 +509,23 @@ void Telemetry::OnRequestDone(uint64_t owner, uint64_t req, bool failed) {
   if (flush) FlushTrace();
 }
 
-void Telemetry::OnStreamBytes(bool is_send, uint64_t stream_idx, uint64_t nbytes) {
+void Telemetry::OnStreamBytes(bool is_send, uint64_t stream_idx, uint64_t nbytes,
+                              int cls) {
   if (stream_idx >= kMaxStreamStats) stream_idx = kMaxStreamStats - 1;
-  auto& slot = is_send ? impl_->stream_tx[stream_idx] : impl_->stream_rx[stream_idx];
+  if (cls < 0 || cls >= kQosClassCount) cls = 1;  // unknown class: bulk
+  auto& slot = is_send ? impl_->stream_tx[cls][stream_idx]
+                       : impl_->stream_rx[cls][stream_idx];
   slot.fetch_add(nbytes, std::memory_order_relaxed);
+}
+
+void Telemetry::OnQosQueueWait(int cls, uint64_t wait_us) {
+  if (cls < 0 || cls >= kQosClassCount) return;
+  impl_->qos_wait[cls].Observe(wait_us);
+}
+
+void Telemetry::OnQosPreempt(int cls) {
+  if (cls < 0 || cls >= kQosClassCount) return;
+  impl_->qos_preempts[cls].fetch_add(1, std::memory_order_relaxed);
 }
 
 void Telemetry::MaybeSampleStream(bool is_send, uint64_t stream_idx, int fd) {
@@ -652,9 +675,15 @@ void Telemetry::Reset() {
   // inflight is deliberately NOT reset: it tracks live requests whose done
   // events will still arrive — zeroing it would make them wrap the clamp.
   im->failed.store(0, std::memory_order_relaxed);
+  for (int c = 0; c < kQosClassCount; ++c) {
+    for (int i = 0; i < kMaxStreamStats; ++i) {
+      im->stream_tx[c][i].store(0, std::memory_order_relaxed);
+      im->stream_rx[c][i].store(0, std::memory_order_relaxed);
+    }
+    im->qos_wait[c].Reset();
+    im->qos_preempts[c].store(0, std::memory_order_relaxed);
+  }
   for (int i = 0; i < kMaxStreamStats; ++i) {
-    im->stream_tx[i].store(0, std::memory_order_relaxed);
-    im->stream_rx[i].store(0, std::memory_order_relaxed);
     for (StreamTcpState* slots : {im->tcp_tx, im->tcp_rx}) {
       slots[i].rtt_us.store(0, std::memory_order_relaxed);
       slots[i].srtt_us.store(0, std::memory_order_relaxed);
@@ -688,8 +717,10 @@ void Telemetry::Reset() {
     im->win_last_us = 0;
     memset(im->win_tx, 0, sizeof(im->win_tx));
     memset(im->win_rx, 0, sizeof(im->win_rx));
-    im->fair_tx_bits.store(DoubleToBits(1.0), std::memory_order_relaxed);
-    im->fair_rx_bits.store(DoubleToBits(1.0), std::memory_order_relaxed);
+    for (int c = 0; c < kQosClassCount; ++c) {
+      im->fair_tx_bits[c].store(DoubleToBits(1.0), std::memory_order_relaxed);
+      im->fair_rx_bits[c].store(DoubleToBits(1.0), std::memory_order_relaxed);
+    }
   }
   im->start_us.store(NowUs(), std::memory_order_relaxed);
 }
@@ -697,44 +728,72 @@ void Telemetry::Reset() {
 MetricsSnapshot Telemetry::Snapshot() const {
   Impl* im = impl_.get();
   MetricsSnapshot s;
-  for (int i = 0; i < kMaxStreamStats; ++i) {
-    s.stream_tx_bytes[i] = im->stream_tx[i].load(std::memory_order_relaxed);
-    s.stream_rx_bytes[i] = im->stream_rx[i].load(std::memory_order_relaxed);
+  uint64_t cls_tx[kQosClassCount][kMaxStreamStats];
+  uint64_t cls_rx[kQosClassCount][kMaxStreamStats];
+  for (int c = 0; c < kQosClassCount; ++c) {
+    for (int i = 0; i < kMaxStreamStats; ++i) {
+      cls_tx[c][i] = im->stream_tx[c][i].load(std::memory_order_relaxed);
+      cls_rx[c][i] = im->stream_rx[c][i].load(std::memory_order_relaxed);
+      s.stream_tx_bytes[i] += cls_tx[c][i];
+      s.stream_rx_bytes[i] += cls_rx[c][i];
+      s.qos_bytes[c][0] += cls_tx[c][i];
+      s.qos_bytes[c][1] += cls_rx[c][i];
+    }
+    im->qos_wait[c].SnapshotInto(&s.qos_wait_us[c]);
+    s.qos_preempts[c] = im->qos_preempts[c].load(std::memory_order_relaxed);
   }
   // Fairness window roll: at most once per TPUNET_FAIRNESS_WINDOW_MS so two
   // back-to-back scrapes don't compute Jain over an empty delta. The first
-  // roll covers everything since start/Reset.
+  // roll covers everything since start/Reset. Each traffic class rolls its
+  // OWN per-stream deltas: the gauge answers "is striping fair WITHIN this
+  // class" — cross-class weighting is the scheduler's job, not skew.
   {
     MutexLock lk(im->win_mu);
     uint64_t now = NowUs();
     if (!im->win_init || now - im->win_last_us >= im->fairness_window_us) {
-      uint64_t dtx[kMaxStreamStats], drx[kMaxStreamStats];
-      uint64_t tot_tx = 0, tot_rx = 0;
-      for (int i = 0; i < kMaxStreamStats; ++i) {
-        dtx[i] = s.stream_tx_bytes[i] - im->win_tx[i];
-        drx[i] = s.stream_rx_bytes[i] - im->win_rx[i];
-        tot_tx += dtx[i];
-        tot_rx += drx[i];
+      bool moved_any = false;
+      for (int c = 0; c < kQosClassCount; ++c) {
+        uint64_t dtx[kMaxStreamStats], drx[kMaxStreamStats];
+        uint64_t tot_tx = 0, tot_rx = 0;
+        for (int i = 0; i < kMaxStreamStats; ++i) {
+          dtx[i] = cls_tx[c][i] - im->win_tx[c][i];
+          drx[i] = cls_rx[c][i] - im->win_rx[c][i];
+          tot_tx += dtx[i];
+          tot_rx += drx[i];
+        }
+        // Only move the gauge when bytes moved (else keep the last verdict).
+        if (tot_tx > 0) {
+          im->fair_tx_bits[c].store(
+              DoubleToBits(JainIndex(dtx, kMaxStreamStats)),
+              std::memory_order_relaxed);
+        }
+        if (tot_rx > 0) {
+          im->fair_rx_bits[c].store(
+              DoubleToBits(JainIndex(drx, kMaxStreamStats)),
+              std::memory_order_relaxed);
+        }
+        if (tot_tx > 0 || tot_rx > 0) {
+          memcpy(im->win_tx[c], cls_tx[c], sizeof(im->win_tx[c]));
+          memcpy(im->win_rx[c], cls_rx[c], sizeof(im->win_rx[c]));
+          moved_any = true;
+        }
       }
-      // Only move the gauge when bytes moved (else keep the last verdict).
-      if (tot_tx > 0) {
-        im->fair_tx_bits.store(DoubleToBits(JainIndex(dtx, kMaxStreamStats)),
-                               std::memory_order_relaxed);
-      }
-      if (tot_rx > 0) {
-        im->fair_rx_bits.store(DoubleToBits(JainIndex(drx, kMaxStreamStats)),
-                               std::memory_order_relaxed);
-      }
-      if (!im->win_init || tot_tx > 0 || tot_rx > 0) {
-        memcpy(im->win_tx, s.stream_tx_bytes, sizeof(im->win_tx));
-        memcpy(im->win_rx, s.stream_rx_bytes, sizeof(im->win_rx));
+      if (!im->win_init || moved_any) {
+        if (!im->win_init) {
+          memcpy(im->win_tx, cls_tx, sizeof(im->win_tx));
+          memcpy(im->win_rx, cls_rx, sizeof(im->win_rx));
+        }
         im->win_init = true;
         im->win_last_us = now;
       }
     }
   }
-  s.fairness_tx = BitsToDouble(im->fair_tx_bits.load(std::memory_order_relaxed));
-  s.fairness_rx = BitsToDouble(im->fair_rx_bits.load(std::memory_order_relaxed));
+  for (int c = 0; c < kQosClassCount; ++c) {
+    s.fairness_tx[c] =
+        BitsToDouble(im->fair_tx_bits[c].load(std::memory_order_relaxed));
+    s.fairness_rx[c] =
+        BitsToDouble(im->fair_rx_bits[c].load(std::memory_order_relaxed));
+  }
   for (int i = 0; i < kMaxStreamStats; ++i) {
     for (auto [slots, out] : {std::pair<StreamTcpState*, StreamTcpSample*>{
                                   im->tcp_tx, s.stream_tcp_tx},
@@ -890,12 +949,60 @@ std::string Telemetry::PrometheusText() const {
       }
     }
   }
+  static const char* kQosClassNames[kQosClassCount] = {"latency", "bulk",
+                                                       "control"};
   family("tpunet_stream_fairness_jain", "gauge",
-         "Jain's fairness index over windowed per-stream bytes (1.0 = perfectly fair).");
-  emit("tpunet_stream_fairness_jain{rank=\"%lld\",dir=\"tx\"} %.6f\n", (long long)rank,
-       s.fairness_tx);
-  emit("tpunet_stream_fairness_jain{rank=\"%lld\",dir=\"rx\"} %.6f\n", (long long)rank,
-       s.fairness_rx);
+         "Jain's fairness index over windowed per-stream bytes, per traffic "
+         "class (1.0 = perfectly fair striping within the class).");
+  for (int c = 0; c < kQosClassCount; ++c) {
+    emit("tpunet_stream_fairness_jain{rank=\"%lld\",dir=\"tx\",class=\"%s\"} %.6f\n",
+         (long long)rank, kQosClassNames[c], s.fairness_tx[c]);
+    emit("tpunet_stream_fairness_jain{rank=\"%lld\",dir=\"rx\",class=\"%s\"} %.6f\n",
+         (long long)rank, kQosClassNames[c], s.fairness_rx[c]);
+  }
+  // QoS families (docs/DESIGN.md "Transport QoS"). Every class x dir series
+  // emits even at zero so the two-tenant bench/smoke never look up a
+  // missing series.
+  family("tpunet_qos_bytes_total", "counter",
+         "Payload bytes moved per traffic class and direction (receivers "
+         "learn the class from the preamble nibble).");
+  for (int c = 0; c < kQosClassCount; ++c) {
+    emit("tpunet_qos_bytes_total{rank=\"%lld\",class=\"%s\",dir=\"tx\"} %llu\n",
+         (long long)rank, kQosClassNames[c],
+         (unsigned long long)s.qos_bytes[c][0]);
+    emit("tpunet_qos_bytes_total{rank=\"%lld\",class=\"%s\",dir=\"rx\"} %llu\n",
+         (long long)rank, kQosClassNames[c],
+         (unsigned long long)s.qos_bytes[c][1]);
+  }
+  family("tpunet_qos_queue_wait_us", "histogram",
+         "Time data chunks waited for QoS wire credit in the DRR scheduler, "
+         "per traffic class (microseconds; empty when no wire window is "
+         "configured).");
+  for (int c = 0; c < kQosClassCount; ++c) {
+    const StageHist& h = s.qos_wait_us[c];
+    uint64_t cum = 0;
+    for (int i = 0; i < kStageHistBuckets - 1; ++i) {
+      cum += h.buckets[i];
+      emit("tpunet_qos_queue_wait_us_bucket{rank=\"%lld\",class=\"%s\",le=\"%llu\"} %llu\n",
+           (long long)rank, kQosClassNames[c],
+           (unsigned long long)kStageHistBounds[i], (unsigned long long)cum);
+    }
+    cum += h.buckets[kStageHistBuckets - 1];
+    emit("tpunet_qos_queue_wait_us_bucket{rank=\"%lld\",class=\"%s\",le=\"+Inf\"} %llu\n",
+         (long long)rank, kQosClassNames[c], (unsigned long long)cum);
+    emit("tpunet_qos_queue_wait_us_sum{rank=\"%lld\",class=\"%s\"} %llu\n",
+         (long long)rank, kQosClassNames[c], (unsigned long long)h.sum_us);
+    emit("tpunet_qos_queue_wait_us_count{rank=\"%lld\",class=\"%s\"} %llu\n",
+         (long long)rank, kQosClassNames[c], (unsigned long long)h.count);
+  }
+  family("tpunet_qos_preempts_total", "counter",
+         "QoS wire-credit grants that jumped ahead of an older waiter of "
+         "another class (strict control priority / DRR weighting at work).");
+  for (int c = 0; c < kQosClassCount; ++c) {
+    emit("tpunet_qos_preempts_total{rank=\"%lld\",class=\"%s\"} %llu\n",
+         (long long)rank, kQosClassNames[c],
+         (unsigned long long)s.qos_preempts[c]);
+  }
   family("tpunet_straggler_events_total", "counter",
          "Streams whose smoothed RTT newly exceeded k x the comm median "
          "(TPUNET_STRAGGLER_FACTOR).");
@@ -1199,6 +1306,10 @@ class TelemetryNet : public Net {
   Status close_send(uint64_t c) override { return inner_->close_send(c); }
   Status close_recv(uint64_t c) override { return inner_->close_recv(c); }
   Status close_listen(uint64_t c) override { return inner_->close_listen(c); }
+  void set_traffic_class(int32_t cls) override {
+    inner_->set_traffic_class(cls);
+  }
+  int32_t traffic_class() const override { return inner_->traffic_class(); }
 
  private:
   uint64_t Owner() const { return reinterpret_cast<uint64_t>(this); }

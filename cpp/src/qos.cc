@@ -1,0 +1,461 @@
+// Transport QoS implementation. See include/tpunet/qos.h for the model.
+#include "tpunet/qos.h"
+
+#include <stdio.h>
+
+#include <algorithm>
+#include <utility>
+#include <vector>
+
+#include "tpunet/telemetry.h"
+#include "tpunet/utils.h"
+
+namespace tpunet {
+namespace {
+
+const char* kClassNames[kTrafficClassCount] = {"latency", "bulk", "control"};
+
+// "123", "64K", "8M", "1G" -> bytes (the fault-spec size grammar).
+bool ParseSizeSuffix(const std::string& v, uint64_t* out) {
+  if (v.empty()) return false;
+  size_t i = 0;
+  uint64_t n = 0;
+  while (i < v.size() && v[i] >= '0' && v[i] <= '9') {
+    n = n * 10 + static_cast<uint64_t>(v[i] - '0');
+    ++i;
+  }
+  if (i == 0) return false;
+  if (i + 1 == v.size()) {
+    switch (v[i] | 0x20) {
+      case 'k': n <<= 10; ++i; break;
+      case 'm': n <<= 20; ++i; break;
+      case 'g': n <<= 30; ++i; break;
+      default: return false;
+    }
+  }
+  if (i != v.size()) return false;
+  *out = n;
+  return true;
+}
+
+// Split "k=v,k=v" and hand each pair to `apply`; empty spec is a no-op.
+Status ForEachPair(const std::string& spec, const char* what,
+                   Status (*apply)(const std::string&, const std::string&,
+                                   QosConfig*),
+                   QosConfig* cfg) {
+  size_t pos = 0;
+  while (pos <= spec.size()) {
+    size_t comma = spec.find(',', pos);
+    if (comma == std::string::npos) comma = spec.size();
+    std::string tok = spec.substr(pos, comma - pos);
+    pos = comma + 1;
+    if (tok.empty()) continue;
+    size_t eq = tok.find('=');
+    if (eq == std::string::npos) {
+      return Status::Invalid(std::string(what) + ": token '" + tok +
+                             "' is not key=value");
+    }
+    Status s = apply(tok.substr(0, eq), tok.substr(eq + 1), cfg);
+    if (!s.ok()) return s;
+  }
+  return Status::Ok();
+}
+
+int ClassIndex(const std::string& key) {
+  for (int i = 0; i < kTrafficClassCount; ++i) {
+    if (key == kClassNames[i]) return i;
+  }
+  return -1;
+}
+
+}  // namespace
+
+bool ParseTrafficClass(const std::string& name, TrafficClass* out) {
+  int i = ClassIndex(name);
+  if (i < 0) return false;
+  *out = static_cast<TrafficClass>(i);
+  return true;
+}
+
+const char* TrafficClassName(TrafficClass c) {
+  int i = static_cast<int>(c);
+  return (i >= 0 && i < kTrafficClassCount) ? kClassNames[i] : "?";
+}
+
+Status ParseQosWeights(const std::string& spec, QosConfig* cfg) {
+  return ForEachPair(
+      spec, "TPUNET_QOS_WEIGHTS",
+      [](const std::string& key, const std::string& val, QosConfig* c) {
+        int i = ClassIndex(key);
+        if (i < 0) {
+          return Status::Invalid("TPUNET_QOS_WEIGHTS: unknown class '" + key +
+                                 "' (expected latency, bulk or control)");
+        }
+        uint64_t w = 0;
+        if (!ParseSizeSuffix(val, &w) || w == 0) {
+          return Status::Invalid("TPUNET_QOS_WEIGHTS: weight '" + val +
+                                 "' for " + key + " must be an integer >= 1");
+        }
+        c->weights[i] = w;
+        return Status::Ok();
+      },
+      cfg);
+}
+
+Status ParseQosInflightBytes(const std::string& spec, QosConfig* cfg) {
+  return ForEachPair(
+      spec, "TPUNET_QOS_INFLIGHT_BYTES",
+      [](const std::string& key, const std::string& val, QosConfig* c) {
+        uint64_t n = 0;
+        if (!ParseSizeSuffix(val, &n)) {
+          return Status::Invalid("TPUNET_QOS_INFLIGHT_BYTES: bad size '" +
+                                 val + "' for " + key +
+                                 "' (integer with optional K/M/G)");
+        }
+        if (key == "wire") {
+          c->wire_window = n;
+          return Status::Ok();
+        }
+        int i = ClassIndex(key);
+        if (i < 0) {
+          return Status::Invalid(
+              "TPUNET_QOS_INFLIGHT_BYTES: unknown key '" + key +
+              "' (expected latency, bulk, control or wire)");
+        }
+        c->budgets[i] = n;
+        return Status::Ok();
+      },
+      cfg);
+}
+
+QosScheduler::QosScheduler(const QosConfig& cfg) : cfg_(cfg) {}
+
+QosScheduler::~QosScheduler() = default;
+
+QosScheduler& QosScheduler::Get() {
+  // Leaked on purpose (engines may release credit during static teardown).
+  // A malformed env spec WARNS and keeps defaults here — Config.from_env()
+  // is the loud gate (the TPUNET_DISPATCH_TABLE stance); crashing engine
+  // creation from a getter would turn a config typo into a hang upstream.
+  static QosScheduler* g = [] {
+    QosConfig cfg;
+    Status ws = ParseQosWeights(GetEnv("TPUNET_QOS_WEIGHTS", ""), &cfg);
+    if (!ws.ok()) fprintf(stderr, "[tpunet] ignoring %s\n", ws.msg.c_str());
+    Status bs =
+        ParseQosInflightBytes(GetEnv("TPUNET_QOS_INFLIGHT_BYTES", ""), &cfg);
+    if (!bs.ok()) fprintf(stderr, "[tpunet] ignoring %s\n", bs.msg.c_str());
+    return new QosScheduler(cfg);
+  }();
+  return *g;
+}
+
+// ---------------------------------------------------------------------------
+// Admission control.
+
+Status QosScheduler::AdmitMessage(TrafficClass cls, uint64_t nbytes,
+                                  uint64_t* recorded) {
+  *recorded = 0;
+  int i = static_cast<int>(cls);
+  uint64_t budget = cfg_.budgets[i];
+  if (budget == 0) return Status::Ok();  // unbudgeted class: uncharged
+  uint64_t cur = admitted_[i].load(std::memory_order_relaxed);
+  while (true) {
+    // A class with nothing in flight always admits one message, so a
+    // message larger than its budget drains eventually instead of being
+    // rejected forever.
+    if (cur != 0 && cur + nbytes > budget) {
+      return Status::QosAdmission(
+          "QoS admission: class '" + std::string(TrafficClassName(cls)) +
+          "' has " + std::to_string(cur) + "B of its " +
+          std::to_string(budget) +
+          "B in-flight budget (TPUNET_QOS_INFLIGHT_BYTES) posted; a " +
+          std::to_string(nbytes) +
+          "B send exceeds it — retry after in-flight work drains");
+    }
+    if (admitted_[i].compare_exchange_weak(cur, cur + nbytes,
+                                           std::memory_order_relaxed)) {
+      break;
+    }
+  }
+  *recorded = nbytes;
+  return Status::Ok();
+}
+
+void QosScheduler::FinishMessage(TrafficClass cls, uint64_t nbytes) {
+  if (nbytes == 0) return;
+  admitted_[static_cast<int>(cls)].fetch_sub(nbytes,
+                                             std::memory_order_relaxed);
+}
+
+uint64_t QosScheduler::AdmittedBytes(TrafficClass cls) const {
+  return admitted_[static_cast<int>(cls)].load(std::memory_order_relaxed);
+}
+
+// ---------------------------------------------------------------------------
+// Wire-credit gate.
+
+bool QosScheduler::RoomLocked(uint64_t nbytes) const {
+  // An empty wire always admits one chunk (a chunk larger than the window
+  // must not wedge); otherwise the shared window binds every class.
+  return wire_inflight_ == 0 || wire_inflight_ + nbytes <= cfg_.wire_window;
+}
+
+void QosScheduler::GrantFrontLocked(int cls) {
+  Waiter* w = queues_[cls].front();
+  queues_[cls].pop_front();
+  wire_inflight_ += w->bytes;
+  w->granted = true;
+  if (grant_log_) grant_log_->emplace_back(cls, w->bytes);
+  if (report_) {
+    // Preemption: this grant jumped ahead of an older waiter still queued
+    // in another class — the scheduler chose priority over arrival order.
+    for (int other = 0; other < kTrafficClassCount; ++other) {
+      if (other == cls || queues_[other].empty()) continue;
+      if (queues_[other].front()->seq < w->seq) {
+        Telemetry::Get().OnQosPreempt(cls);
+        break;
+      }
+    }
+  }
+}
+
+void QosScheduler::PumpLocked() {
+  const int kControlIdx = static_cast<int>(TrafficClass::kControl);
+  // Strict priority: control grants ahead of everything, FIFO. While a
+  // control chunk is window-blocked, nothing lower may grant either.
+  while (!queues_[kControlIdx].empty() &&
+         RoomLocked(queues_[kControlIdx].front()->bytes)) {
+    GrantFrontLocked(kControlIdx);
+  }
+  if (!queues_[kControlIdx].empty()) {
+    cv_.NotifyAll();
+    return;
+  }
+  // Deficit round-robin between latency and bulk. A TURN belongs to one
+  // class: it earns weight x 64KiB exactly once (at turn start) and spends
+  // it front-first until the deficit or the queue runs out. A head that
+  // does not fit the shared window PAUSES the turn — the next pump (after
+  // a Release) resumes the same turn WITHOUT re-crediting, so weights stay
+  // honest under a tight window and neither class can starve: bulk's turn
+  // always comes, and always carries its quantum.
+  while (true) {
+    if (drr_turn_ < 0) {
+      bool l = !queues_[0].empty(), b = !queues_[1].empty();
+      if (!l && !b) {
+        deficit_[0] = deficit_[1] = 0;  // no banking while idle
+        break;
+      }
+      int pick = drr_next_;
+      if (queues_[pick].empty()) pick ^= 1;
+      drr_next_ = pick ^ 1;  // the other class opens the next turn
+      drr_turn_ = pick;
+      deficit_[pick] += cfg_.weights[pick] * kQosQuantumBytes;
+    }
+    int c = drr_turn_;
+    while (!queues_[c].empty() && deficit_[c] >= queues_[c].front()->bytes) {
+      if (!RoomLocked(queues_[c].front()->bytes)) {
+        cv_.NotifyAll();
+        return;  // window full mid-turn: resume here on the next pump
+      }
+      deficit_[c] -= queues_[c].front()->bytes;
+      GrantFrontLocked(c);
+    }
+    if (queues_[c].empty()) deficit_[c] = 0;
+    drr_turn_ = -1;  // turn exhausted: rotation picks the next class
+  }
+  cv_.NotifyAll();
+}
+
+void QosScheduler::RemoveWaiterLocked(Waiter* w) {
+  auto& q = queues_[static_cast<int>(w->cls)];
+  for (auto it = q.begin(); it != q.end(); ++it) {
+    if (*it == w) {
+      q.erase(it);
+      return;
+    }
+  }
+}
+
+bool QosScheduler::AcquireWire(TrafficClass cls, uint64_t nbytes,
+                               const std::atomic<bool>* aborted) {
+  if (!wire_gate_enabled()) return true;
+  uint64_t t0 = MonotonicUs();
+  Waiter w;
+  w.cls = cls;
+  w.bytes = nbytes;
+  {
+    MutexLock lk(mu_);
+    w.seq = next_seq_++;
+    queues_[static_cast<int>(cls)].push_back(&w);
+    PumpLocked();
+    while (!w.granted) {
+      if (aborted != nullptr && aborted->load(std::memory_order_acquire)) {
+        RemoveWaiterLocked(&w);
+        return false;
+      }
+      cv_.WaitFor(mu_, 50);
+    }
+  }
+  if (report_) Telemetry::Get().OnQosQueueWait(static_cast<int>(cls),
+                                               MonotonicUs() - t0);
+  return true;
+}
+
+bool QosScheduler::TryAcquireWire(TrafficClass cls, uint64_t nbytes,
+                                  uint64_t* ticket) {
+  if (!wire_gate_enabled()) return true;
+  MutexLock lk(mu_);
+  auto w = std::make_unique<Waiter>();
+  w->cls = cls;
+  w->bytes = nbytes;
+  w->seq = next_seq_++;
+  w->ticket = next_ticket_++;
+  Waiter* raw = w.get();
+  queues_[static_cast<int>(cls)].push_back(raw);
+  PumpLocked();
+  if (raw->granted) return true;  // w destroyed; credit held by the caller
+  *ticket = raw->ticket;
+  tickets_[raw->ticket] = std::move(w);
+  return false;
+}
+
+bool QosScheduler::PollTicket(uint64_t ticket) {
+  MutexLock lk(mu_);
+  auto it = tickets_.find(ticket);
+  if (it == tickets_.end()) return false;  // cancelled elsewhere: not held
+  if (!it->second->granted) PumpLocked();
+  if (!it->second->granted) return false;
+  tickets_.erase(it);  // credit transfers to the caller
+  return true;
+}
+
+void QosScheduler::CancelTicket(uint64_t ticket) {
+  MutexLock lk(mu_);
+  auto it = tickets_.find(ticket);
+  if (it == tickets_.end()) return;
+  if (it->second->granted) {
+    // Granted but never claimed: the credit must flow back.
+    wire_inflight_ -= std::min(wire_inflight_, it->second->bytes);
+    tickets_.erase(it);
+    PumpLocked();
+    return;
+  }
+  RemoveWaiterLocked(it->second.get());
+  tickets_.erase(it);
+}
+
+void QosScheduler::ReleaseWire(TrafficClass cls, uint64_t nbytes) {
+  (void)cls;
+  if (!wire_gate_enabled()) return;
+  MutexLock lk(mu_);
+  wire_inflight_ -= std::min(wire_inflight_, nbytes);
+  PumpLocked();
+}
+
+// ---------------------------------------------------------------------------
+// Introspection + golden simulation.
+
+std::string QosScheduler::StateText() {
+  std::string out = "weights";
+  for (int i = 0; i < kTrafficClassCount; ++i) {
+    out += " " + std::string(kClassNames[i]) + "=" +
+           std::to_string(cfg_.weights[i]);
+  }
+  out += "\nbudgets";
+  for (int i = 0; i < kTrafficClassCount; ++i) {
+    out += " " + std::string(kClassNames[i]) + "=" +
+           std::to_string(cfg_.budgets[i]);
+  }
+  out += "\nwire_window " + std::to_string(cfg_.wire_window);
+  out += "\nadmitted";
+  for (int i = 0; i < kTrafficClassCount; ++i) {
+    out += " " + std::string(kClassNames[i]) + "=" +
+           std::to_string(admitted_[i].load(std::memory_order_relaxed));
+  }
+  MutexLock lk(mu_);
+  out += "\nwire_inflight " + std::to_string(wire_inflight_);
+  out += "\nqueued";
+  for (int i = 0; i < kTrafficClassCount; ++i) {
+    out += " " + std::string(kClassNames[i]) + "=" +
+           std::to_string(queues_[i].size());
+  }
+  out += "\n";
+  return out;
+}
+
+std::string QosScheduler::DrrGolden(const std::string& weights_spec,
+                                    const std::string& window_spec,
+                                    const std::string& chunks,
+                                    std::string* err) {
+  QosConfig cfg;
+  Status s = ParseQosWeights(weights_spec, &cfg);
+  if (s.ok()) s = ParseQosInflightBytes(window_spec, &cfg);
+  if (!s.ok()) {
+    *err = s.msg;
+    return "";
+  }
+  if (cfg.wire_window == 0) {
+    *err = "DRR golden needs a wire window (window_spec \"wire=<bytes>\")";
+    return "";
+  }
+  QosScheduler sim(cfg);
+  sim.report_ = false;  // throwaway instance: keep process counters clean
+  std::vector<std::unique_ptr<Waiter>> waiters;
+  {
+    MutexLock lk(sim.mu_);
+    size_t pos = 0;
+    while (pos <= chunks.size()) {
+      size_t comma = chunks.find(',', pos);
+      if (comma == std::string::npos) comma = chunks.size();
+      std::string tok = chunks.substr(pos, comma - pos);
+      pos = comma + 1;
+      if (tok.empty()) continue;
+      size_t colon = tok.find(':');
+      TrafficClass cls;
+      uint64_t bytes = 0;
+      if (colon == std::string::npos ||
+          !ParseTrafficClass(tok.substr(0, colon), &cls) ||
+          !ParseSizeSuffix(tok.substr(colon + 1), &bytes) || bytes == 0) {
+        *err = "bad chunk token '" + tok + "' (want class:bytes)";
+        return "";
+      }
+      auto w = std::make_unique<Waiter>();
+      w->cls = cls;
+      w->bytes = bytes;
+      w->seq = sim.next_seq_++;
+      sim.queues_[static_cast<int>(cls)].push_back(w.get());
+      waiters.push_back(std::move(w));
+    }
+  }
+  // Drive: pump, and whenever the window blocks further grants, retire the
+  // oldest granted chunk (grant order == service order in the simulation).
+  std::deque<std::pair<int, uint64_t>> log;
+  std::string out;
+  size_t retired = 0, emitted = 0;
+  {
+    MutexLock lk(sim.mu_);
+    sim.grant_log_ = &log;
+    while (emitted < waiters.size()) {
+      size_t before = log.size();
+      sim.PumpLocked();
+      for (; emitted < log.size(); ++emitted) {
+        if (!out.empty()) out += ",";
+        out += kClassNames[log[emitted].first];
+      }
+      if (log.size() == before) {
+        if (retired >= log.size()) {
+          *err = "simulation wedged (chunk larger than the window?)";
+          sim.grant_log_ = nullptr;
+          return "";
+        }
+        sim.wire_inflight_ -=
+            std::min(sim.wire_inflight_, log[retired].second);
+        ++retired;
+      }
+    }
+    sim.grant_log_ = nullptr;
+  }
+  return out;
+}
+
+}  // namespace tpunet

@@ -1,6 +1,8 @@
 // Shared wire protocol + rendezvous implementation. See wire.h.
 #include "wire.h"
 
+#include "tpunet/qos.h"
+
 #include <arpa/inet.h>
 #include <errno.h>
 #include <netinet/in.h>
@@ -16,6 +18,15 @@
 #include <thread>
 
 namespace tpunet {
+
+void RequestState::ReleaseQosAdmission() {
+  if (qos_admitted == 0) return;
+  if (qos_released.exchange(true, std::memory_order_acq_rel)) return;
+  QosScheduler::Get().FinishMessage(static_cast<TrafficClass>(qos_cls),
+                                    qos_admitted);
+}
+
+RequestState::~RequestState() { ReleaseQosAdmission(); }
 
 socklen_t AddrLenForFamily(const sockaddr_storage& ss) {
   return ss.ss_family == AF_INET6 ? sizeof(sockaddr_in6) : sizeof(sockaddr_in);

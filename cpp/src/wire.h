@@ -41,6 +41,30 @@ constexpr uint64_t kMaxStreams = 256;  // sanity bound on peer-supplied nstreams
 // Preamble flag bits (sender-advertised; like nstreams/min_chunksize the
 // sender's values win so the two sides can never disagree).
 constexpr uint64_t kPreambleFlagCrc = 1ull << 0;
+// QoS advertisement (docs/DESIGN.md "Transport QoS"): the sender speaks the
+// traffic-class protocol and its class nibble is valid at bits 8..11. The
+// class rides the per-connection header (the preamble) rather than each
+// chunk: a TCP stream's class is constant for its lifetime, so per-chunk
+// repetition would be pure wire overhead — the receiver accounts every
+// chunk on the connection under this nibble. Peers without the flag (older
+// builds) default to the bulk class.
+constexpr uint64_t kPreambleFlagQos = 1ull << 1;
+constexpr int kPreambleClassShift = 8;
+constexpr uint64_t kPreambleClassMask = 0xFull << kPreambleClassShift;
+
+inline uint64_t PreambleClassBits(int32_t cls) {
+  return kPreambleFlagQos |
+         ((static_cast<uint64_t>(cls) << kPreambleClassShift) &
+          kPreambleClassMask);
+}
+// Class nibble from a received preamble flags word; bulk (1) when the peer
+// predates QoS or advertises an unknown class.
+inline int32_t PreambleClassOf(uint64_t flags) {
+  if ((flags & kPreambleFlagQos) == 0) return 1;
+  int32_t cls = static_cast<int32_t>((flags & kPreambleClassMask) >>
+                                     kPreambleClassShift);
+  return cls >= 0 && cls < 3 ? cls : 1;
+}
 
 // Ctrl-stream frame vocabulary. A plain message length frame is a raw
 // big-endian u64 < 2^56; frames with a reserved top byte are transport
@@ -129,6 +153,18 @@ struct RequestState {
   // so blocked workers quiesce after a timeout verdict. Captures a weak
   // reference — the comm may die first.
   std::function<void()> on_stall;
+
+  // QoS admission accounting (docs/DESIGN.md "Transport QoS"): bytes this
+  // send charged against its traffic class's in-flight budget at isend
+  // time. Returned EXACTLY ONCE — at test()/wait() consumption on both
+  // engines, with the destructor as the backstop for requests that are
+  // never polled (close-time drains). qos_admitted == 0 means the class is
+  // unbudgeted and nothing was charged.
+  uint8_t qos_cls = 1;  // TrafficClass int (qos.h)
+  uint64_t qos_admitted = 0;
+  std::atomic<bool> qos_released{false};
+  void ReleaseQosAdmission();  // defined in wire.cc (needs qos.h)
+  ~RequestState();
 
   // Stage-latency clock points (telemetry stage histograms, docs/DESIGN.md
   // "Observability"): t_post_us is stamped by the engine at isend/irecv;

@@ -53,7 +53,7 @@ void codec_rank_main(int rank, int base_port) {
   for (int ci = 0; ci < 2; ++ci) {
     std::string coord = "127.0.0.1:" + std::to_string(base_port + 1 + ci);
     uintptr_t comm = 0;
-    CHECK_OK(tpunet_comm_create_ex(coord.c_str(), rank, kWorld, codecs[ci], nullptr, &comm));
+    CHECK_OK(tpunet_comm_create_ex(coord.c_str(), rank, kWorld, codecs[ci], nullptr, nullptr, &comm));
     int32_t wd = -1;
     CHECK_OK(tpunet_comm_wire_dtype(comm, &wd));
     CHECK_MSG(wd == ci + 1, "wire_dtype %d != %d for %s", wd, ci + 1, codecs[ci]);
@@ -98,7 +98,8 @@ void codec_rank_main(int rank, int base_port) {
     std::string coord = "127.0.0.1:" + std::to_string(base_port + 3);
     uintptr_t comm = 0;
     int32_t rcv = tpunet_comm_create_ex(coord.c_str(), rank, kWorld,
-                                        rank == 0 ? "bf16" : "f32", nullptr, &comm);
+                                        rank == 0 ? "bf16" : "f32", nullptr,
+                                        nullptr, &comm);
     CHECK_MSG(rcv == TPUNET_ERR_CODEC, "expected TPUNET_ERR_CODEC, got %d (%s)",
               rcv, tpunet_c_last_error());
   }
@@ -106,7 +107,7 @@ void codec_rank_main(int rank, int base_port) {
   // Unknown codec name fails before any socket exists.
   {
     uintptr_t comm = 0;
-    int32_t rcv = tpunet_comm_create_ex("127.0.0.1:1", rank, 1, "fp8", nullptr, &comm);
+    int32_t rcv = tpunet_comm_create_ex("127.0.0.1:1", rank, 1, "fp8", nullptr, nullptr, &comm);
     CHECK_MSG(rcv == TPUNET_ERR_INVALID, "expected INVALID for fp8, got %d", rcv);
   }
 }
@@ -124,7 +125,7 @@ void schedule_rank_main(int rank, int base_port) {
     std::string coord = "127.0.0.1:" + std::to_string(base_port + 4 + ai);
     uintptr_t comm = 0;
     CHECK_OK(tpunet_comm_create_ex(coord.c_str(), rank, kWorld, "f32",
-                                   algos[ai], &comm));
+                                   algos[ai], nullptr, &comm));
     std::vector<float> send(kCount), recv(kCount);
     for (uint64_t i = 0; i < kCount; ++i)
       send[i] = float(rank + 1) + float(i % 23);
@@ -153,17 +154,41 @@ void schedule_rank_main(int rank, int base_port) {
     std::string coord = "127.0.0.1:" + std::to_string(base_port + 7);
     uintptr_t comm = 0;
     int32_t rcv = tpunet_comm_create_ex(coord.c_str(), rank, kWorld, nullptr,
-                                        rank == 0 ? "tree" : "ring", &comm);
+                                        rank == 0 ? "tree" : "ring", nullptr,
+                                        &comm);
     CHECK_MSG(rcv == TPUNET_ERR_INVALID,
               "expected TPUNET_ERR_INVALID for algo mismatch, got %d (%s)", rcv,
               tpunet_c_last_error());
+  }
+
+  // Traffic-class negotiation failure: rank 0 wires the latency lane,
+  // everyone else bulk — typed on every rank, nobody wedges (half a group
+  // on another QoS lane would unbalance the scheduler silently).
+  {
+    std::string coord = "127.0.0.1:" + std::to_string(base_port + 8);
+    uintptr_t comm = 0;
+    int32_t rcv = tpunet_comm_create_ex(coord.c_str(), rank, kWorld, nullptr,
+                                        nullptr,
+                                        rank == 0 ? "latency" : "bulk", &comm);
+    CHECK_MSG(rcv == TPUNET_ERR_INVALID,
+              "expected TPUNET_ERR_INVALID for class mismatch, got %d (%s)",
+              rcv, tpunet_c_last_error());
+  }
+
+  // Unknown traffic class fails before any socket exists.
+  {
+    uintptr_t comm = 0;
+    int32_t rcv = tpunet_comm_create_ex("127.0.0.1:1", rank, 1, nullptr,
+                                        nullptr, "express", &comm);
+    CHECK_MSG(rcv == TPUNET_ERR_INVALID, "expected INVALID for express, got %d",
+              rcv);
   }
 
   // Unknown algo name fails before any socket exists.
   {
     uintptr_t comm = 0;
     int32_t rcv =
-        tpunet_comm_create_ex("127.0.0.1:1", rank, 1, nullptr, "star", &comm);
+        tpunet_comm_create_ex("127.0.0.1:1", rank, 1, nullptr, "star", nullptr, &comm);
     CHECK_MSG(rcv == TPUNET_ERR_INVALID, "expected INVALID for star, got %d", rcv);
   }
 }
@@ -303,7 +328,7 @@ int main() {
   for (auto& th : ranks) th.join();
 
   // Schedule lane: ring vs rhd vs tree bit-equality + algo handshake
-  // (fresh comms on base_port+4..+7).
+  // (fresh comms on base_port+4..+8).
   ranks.clear();
   for (int r = 0; r < kWorld; ++r)
     ranks.emplace_back(schedule_rank_main, r, base_port);

@@ -73,10 +73,15 @@ def _worker(rank: int, world: int, port: int, q, trace_dir: str) -> None:
             "tpunet_stream_delivery_rate_bps",
         ):
             assert m.get(gauge), f"missing {gauge} after transfer: {sorted(m)}"
-        # Fairness gauge present for both directions, in (0, 1].
+        # Fairness gauge present for both directions x all three traffic
+        # classes (the QoS split: per-stream fairness reported WITHIN a
+        # class), every series in (0, 1].
         fair = m["tpunet_stream_fairness_jain"]
-        assert len(fair) == 2
+        assert len(fair) == 6
         assert all(0.0 < v <= 1.0 for v in fair.values()), fair
+        assert {telemetry.labels(k)["class"] for k in fair} == {
+            "latency", "bulk", "control"}
+        assert {telemetry.labels(k)["dir"] for k in fair} == {"tx", "rx"}
         # Stage-latency histograms: wire time observed for the ring messages,
         # and the numeric bucket view is monotonic with +Inf last.
         assert m["tpunet_req_wire_us_count"][rank_key] > 0

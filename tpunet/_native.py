@@ -27,6 +27,7 @@ TPUNET_ERR_CORRUPT = -4   # per-chunk CRC32C mismatch (TPUNET_CRC=1)
 TPUNET_ERR_TIMEOUT = -5   # progress watchdog (TPUNET_PROGRESS_TIMEOUT_MS)
 TPUNET_ERR_VERSION = -6   # wire-framing version mismatch with the peer
 TPUNET_ERR_CODEC = -7     # ranks disagree on the collective wire codec
+TPUNET_ERR_QOS_ADMISSION = -8  # QoS class in-flight budget full (retryable)
 
 HANDLE_SIZE = 64
 
@@ -112,6 +113,8 @@ def load() -> ctypes.CDLL:
 
     lib.tpunet_c_create.argtypes = [P(u)]
     lib.tpunet_c_create.restype = i32
+    lib.tpunet_c_create_ex.argtypes = [ctypes.c_char_p, P(u)]
+    lib.tpunet_c_create_ex.restype = i32
     lib.tpunet_c_destroy.argtypes = [P(u)]
     lib.tpunet_c_destroy.restype = i32
     lib.tpunet_c_devices.argtypes = [u, P(i32)]
@@ -144,7 +147,8 @@ def load() -> ctypes.CDLL:
     lib.tpunet_comm_create.argtypes = [ctypes.c_char_p, i32, i32, P(u)]
     lib.tpunet_comm_create.restype = i32
     lib.tpunet_comm_create_ex.argtypes = [
-        ctypes.c_char_p, i32, i32, ctypes.c_char_p, ctypes.c_char_p, P(u),
+        ctypes.c_char_p, i32, i32, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, P(u),
     ]
     lib.tpunet_comm_create_ex.restype = i32
     lib.tpunet_comm_wire_dtype.argtypes = [u, P(i32)]
@@ -194,6 +198,12 @@ def load() -> ctypes.CDLL:
     lib.tpunet_c_serve_observe.restype = i32
     lib.tpunet_c_serve_queue_depth.argtypes = [i32, u64]
     lib.tpunet_c_serve_queue_depth.restype = i32
+    lib.tpunet_c_qos_state.argtypes = [ctypes.c_char_p, u64]
+    lib.tpunet_c_qos_state.restype = i32
+    lib.tpunet_c_qos_drr_golden.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, u64,
+    ]
+    lib.tpunet_c_qos_drr_golden.restype = i32
 
     lib.tpunet_c_fault_inject.argtypes = [ctypes.c_char_p]
     lib.tpunet_c_fault_inject.restype = i32
@@ -255,11 +265,20 @@ class CodecMismatchError(NativeError):
     the communicator; nothing was corrupted."""
 
 
+class QosAdmissionError(NativeError):
+    """QoS admission control rejected a send: the traffic class's in-flight
+    byte budget (TPUNET_QOS_INFLIGHT_BYTES) is fully posted. Pure
+    backpressure — NOTHING was enqueued or charged, so the send is safely
+    retryable once in-flight work drains (the serve router replays it
+    front-of-queue). docs/DESIGN.md "Transport QoS"."""
+
+
 _TYPED_ERRORS = {
     TPUNET_ERR_CORRUPT: CorruptionError,
     TPUNET_ERR_TIMEOUT: ProgressTimeoutError,
     TPUNET_ERR_VERSION: VersionMismatchError,
     TPUNET_ERR_CODEC: CodecMismatchError,
+    TPUNET_ERR_QOS_ADMISSION: QosAdmissionError,
 }
 
 

@@ -125,6 +125,7 @@ class Communicator:
         world_size: int | None = None,
         wire_dtype: str | None = None,
         algo: str | None = None,
+        traffic_class: str | None = None,
     ):
         env = os.environ
         coordinator = coordinator or env.get("TPUNET_COORDINATOR", "127.0.0.1:29500")
@@ -142,14 +143,19 @@ class Communicator:
         # to TPUNET_ALGO, default auto — per-(collective, size, world)
         # selection through the built-in thresholds or the
         # TPUNET_DISPATCH_TABLE JSON from `busbw_sweep --emit-dispatch`).
-        # Both are negotiated at wiring time: a cross-rank disagreement
-        # raises CodecMismatchError (codec) / NativeError (algo, dispatch
-        # table) on every rank before any payload could be mis-decoded or
-        # any half-world schedule could deadlock.
+        # traffic_class pins the QoS lane every comm this communicator
+        # wires will carry ("latency"/"bulk"/"control"; None defers to
+        # TPUNET_TRAFFIC_CLASS, default bulk — gradient comms unchanged).
+        # All three are negotiated at wiring time: a cross-rank
+        # disagreement raises CodecMismatchError (codec) / NativeError
+        # (algo, dispatch table, traffic class) on every rank before any
+        # payload could be mis-decoded, any half-world schedule could
+        # deadlock, or half a group could ride another QoS lane.
         _native.check(
             self._lib.tpunet_comm_create_ex(
                 coordinator.encode(), rank, world_size,
                 (wire_dtype or "").encode(), (algo or "").encode(),
+                (traffic_class or "").encode(),
                 ctypes.byref(cid),
             ),
             "comm_create",

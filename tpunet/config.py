@@ -72,6 +72,51 @@ def _env_choice(name: str, fallback: str, choices: tuple[str, ...],
     return v
 
 
+_QOS_CLASSES = ("latency", "bulk", "control")
+
+
+def _parse_qos_size(val: str) -> int | None:
+    """'123' / '64K' / '8M' / '1G' -> bytes (the native ParseSizeSuffix
+    grammar); None on garbage."""
+    mult = 1
+    if val and val[-1] in "kKmMgG":
+        mult = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}[val[-1].lower()]
+        val = val[:-1]
+    if not val.isdigit():
+        return None
+    return int(val) * mult
+
+
+def _env_qos_spec(name: str, keys: tuple[str, ...], what: str,
+                  minimum: int = 0) -> str:
+    """Validate a comma-separated key=value QoS spec env var against the
+    native grammar (qos.cc): keys restricted to `keys`, values sized ints
+    with optional K/M/G suffix, each >= `minimum`. Malformed specs raise
+    ValueError naming the var — the native side only WARNS and keeps its
+    defaults, so this is the loud gate (the TPUNET_DISPATCH_TABLE stance).
+    Returns the raw string (the native layer re-parses it)."""
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return ""
+    for tok in v.split(","):
+        if not tok:
+            continue
+        key, eq, val = tok.partition("=")
+        if not eq:
+            raise ValueError(
+                f"{name}={v} is invalid: token {tok!r} is not key=value")
+        if key not in keys:
+            raise ValueError(
+                f"{name}={v} is invalid: unknown key {key!r} ({what} keys "
+                f"are {', '.join(keys)})")
+        n = _parse_qos_size(val)
+        if n is None or n < minimum:
+            raise ValueError(
+                f"{name}={v} is invalid: value {val!r} for {key} must be an "
+                f"integer >= {minimum} (optional K/M/G suffix)")
+    return v
+
+
 def _env_dispatch_table(name: str) -> str:
     """Read a dispatch-table path env var; when set, the file must exist and
     parse as a JSON object with an "entries" list, else ValueError naming
@@ -232,6 +277,22 @@ class Config:
     # Pin this process's serving-tier role ("" = unpinned). Wiring as the
     # OTHER role then fails loudly — catches copy-pasted launch commands.
     serve_role: str = ""
+    # ---- Transport QoS (docs/DESIGN.md "Transport QoS") ------------------
+    # Default traffic class for every comm this process connects (and the
+    # class a Communicator negotiates when traffic_class= is not passed).
+    # "latency" | "bulk" | "control"; carried in the connect preamble and
+    # the collective bootstrap handshake (mismatch fails every rank typed).
+    traffic_class: str = "bulk"
+    # DRR weights for the wire-credit scheduler, "latency=8,bulk=1"
+    # (control is strict-priority; empty = built-in 8:1). One weight point
+    # buys 64KiB of wire credit per scheduling turn.
+    qos_weights: str = ""
+    # Per-class in-flight budgets, "latency=64M,bulk=256M,control=0,wire=4M"
+    # (sizes take K/M/G). latency/bulk/control bound ADMISSION (posted-send
+    # bytes; over-budget isends fail typed QosAdmissionError, -8; 0 =
+    # unlimited). wire= sets the shared WIRE WINDOW that arms the DRR chunk
+    # scheduler (0 = gate off, the default — dispatch is then unchanged).
+    qos_inflight_bytes: str = ""
 
     @staticmethod
     def from_env() -> "Config":
@@ -358,5 +419,18 @@ class Config:
             serve_role=_env_choice(
                 "TPUNET_SERVE_ROLE", "", ("", "frontend", "decode"),
                 "serving-tier role",
+            ),
+            traffic_class=_env_choice(
+                "TPUNET_TRAFFIC_CLASS", "bulk", _QOS_CLASSES,
+                "QoS traffic class",
+            ),
+            # Weights must be >= 1 (a zero-weight class would never earn
+            # wire credit); budgets accept 0 = unlimited / gate off.
+            qos_weights=_env_qos_spec(
+                "TPUNET_QOS_WEIGHTS", _QOS_CLASSES, "DRR weight", minimum=1,
+            ),
+            qos_inflight_bytes=_env_qos_spec(
+                "TPUNET_QOS_INFLIGHT_BYTES", _QOS_CLASSES + ("wire",),
+                "in-flight budget",
             ),
         )

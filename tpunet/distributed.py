@@ -27,6 +27,7 @@ def initialize(
     world_size: int | None = None,
     wire_dtype: str | None = None,
     algo: str | None = None,
+    traffic_class: str | None = None,
 ) -> Communicator:
     """Create (or return) the process-global communicator.
 
@@ -39,22 +40,22 @@ def initialize(
     collective rides. ``algo`` pins the collective schedule
     ("auto"/"ring"/"rhd"/"tree"; None defers to TPUNET_ALGO, default auto
     — per-(collective, size, world) selection, docs/DESIGN.md §2c).
+    ``traffic_class`` pins the QoS lane ("latency"/"bulk"/"control"; None
+    defers to TPUNET_TRAFFIC_CLASS, default bulk — gradient comms keep the
+    bulk class unchanged; the serving tier wires latency-class links).
     """
     global _comm, _comm_args
+    args = (coordinator, rank, world_size, wire_dtype, algo, traffic_class)
     with _lock:
         if _comm is None:
             _comm = Communicator(coordinator, rank, world_size, wire_dtype,
-                                 algo)
+                                 algo, traffic_class)
             _comm.set_as_default()  # FFI collectives resolve it at call time
-            _comm_args = (coordinator, rank, world_size, wire_dtype, algo)
-        elif (coordinator, rank, world_size, wire_dtype, algo) != _comm_args and any(
-            a is not None
-            for a in (coordinator, rank, world_size, wire_dtype, algo)
-        ):
+            _comm_args = args
+        elif args != _comm_args and any(a is not None for a in args):
             raise RuntimeError(
                 f"tpunet.distributed already initialized with {_comm_args}; "
-                f"got conflicting ({coordinator}, {rank}, {world_size}, "
-                f"{wire_dtype}, {algo}) — call finalize() first to "
+                f"got conflicting {args} — call finalize() first to "
                 f"re-initialize"
             )
         return _comm

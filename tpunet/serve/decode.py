@@ -150,7 +150,9 @@ def connect(addr, model, params, *, slots: int, max_len: int,
     if kv_codec is None:
         kv_codec = Config.from_env().kv_wire_dtype
     owns_net = net is None
-    net = net or transport.Net()
+    # Latency-class link: FIRST/RESULT frames are the router's TTFT signal
+    # (see Router.__init__ on why the tier rides the latency lane).
+    net = net or transport.Net(traffic_class="latency")
     hello = proto.Hello(proto.ROLE_DECODE, kv_codec, slots, max_len,
                         model.vocab, kv_mod.model_signature(model))
     link = proto.wire_decode(addr, net, hello, timeout=timeout)

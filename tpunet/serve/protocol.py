@@ -32,6 +32,7 @@ import time
 import numpy as np
 
 from tpunet import transport
+from tpunet._native import QosAdmissionError
 
 MAGIC = b"TPKV"
 VERSION = 1
@@ -48,12 +49,20 @@ ROLE_DECODE = 1
 
 _HEADER = struct.Struct("<4sHHQII")     # magic, version, type, req_id, body_len, aux
 _HELLO = struct.Struct("<4sHBBIIIIQ")   # magic, version, role, codec, slots,
-                                        # max_len, vocab, reserved, model_sig
+                                        # max_len, vocab, traffic class (low
+                                        # byte; rest reserved), model_sig
 _BLOCK_HDR = struct.Struct("<IIIIB3x")  # plen, max_new, n_kv, vocab, codec
 _RESULT_HDR = struct.Struct("<IIQ")     # ntok, status, tpot_us
 
 _CODEC_IDS = {"f32": 0, "bf16": 1, "int8": 2}
 _CODEC_NAMES = {v: k for k, v in _CODEC_IDS.items()}
+
+# QoS traffic classes (tpunet.transport.TRAFFIC_CLASSES order — the native
+# TrafficClass ints). KV BLOCK and FIRST/RESULT frames ship on a
+# latency-class link by default so TTFT-bound traffic never queues behind a
+# co-tenant's bulk gradient AllReduce (docs/DESIGN.md "Transport QoS").
+_CLASS_IDS = {"latency": 0, "bulk": 1, "control": 2}
+_CLASS_NAMES = {v: k for k, v in _CLASS_IDS.items()}
 
 
 class ServeError(RuntimeError):
@@ -105,25 +114,29 @@ class Hello:
     """One side's wiring contract (see module docstring)."""
 
     def __init__(self, role: int, kv_codec: str, slots: int, max_len: int,
-                 vocab: int, model_sig: int):
+                 vocab: int, model_sig: int, traffic_class: str = "latency"):
         if kv_codec not in _CODEC_IDS:
             raise ValueError(f"unknown KV wire codec {kv_codec!r}")
+        if traffic_class not in _CLASS_IDS:
+            raise ValueError(f"unknown traffic class {traffic_class!r}")
         self.role = role
         self.kv_codec = kv_codec
         self.slots = slots
         self.max_len = max_len
         self.vocab = vocab
         self.model_sig = model_sig
+        self.traffic_class = traffic_class
 
     def pack(self) -> bytes:
         return _HELLO.pack(MAGIC, VERSION, self.role,
                            _CODEC_IDS[self.kv_codec], self.slots,
-                           self.max_len, self.vocab, 0,
+                           self.max_len, self.vocab,
+                           _CLASS_IDS[self.traffic_class],
                            self.model_sig & 0xFFFFFFFFFFFFFFFF)
 
     @staticmethod
     def unpack(raw: bytes) -> "Hello":
-        magic, ver, role, codec, slots, max_len, vocab, _, sig = \
+        magic, ver, role, codec, slots, max_len, vocab, cls, sig = \
             _HELLO.unpack(raw)
         if magic != MAGIC:
             raise TierProtocolError(
@@ -134,7 +147,11 @@ class Hello:
                 f"tier hello version {ver} != local {VERSION}")
         if codec not in _CODEC_NAMES:
             raise TierProtocolError(f"tier hello carries unknown codec id {codec}")
-        return Hello(role, _CODEC_NAMES[codec], slots, max_len, vocab, sig)
+        if (cls & 0xFF) not in _CLASS_NAMES:
+            raise TierProtocolError(
+                f"tier hello carries unknown traffic class id {cls & 0xFF}")
+        return Hello(role, _CODEC_NAMES[codec], slots, max_len, vocab, sig,
+                     _CLASS_NAMES[cls & 0xFF])
 
 
 def _check_peer(mine: Hello, peer: Hello, want_role: int) -> None:
@@ -150,6 +167,11 @@ def _check_peer(mine: Hello, peer: Hello, want_role: int) -> None:
             f"KV wire codec mismatch: local {mine.kv_codec!r} vs peer "
             f"{peer.kv_codec!r} — set TPUNET_KV_WIRE_DTYPE (or kv_codec=) "
             f"identically on both tiers")
+    if peer.traffic_class != mine.traffic_class:
+        raise TierMismatchError(
+            f"QoS traffic-class mismatch: local {mine.traffic_class!r} vs "
+            f"peer {peer.traffic_class!r} — both tiers must wire the link "
+            f"on the same lane (traffic_class= / TPUNET_TRAFFIC_CLASS)")
     if peer.model_sig != mine.model_sig:
         raise TierMismatchError(
             f"model-config signature mismatch: local {mine.model_sig:#x} "
@@ -206,8 +228,23 @@ class FrameLink:
                    aux: int = 0, timeout: float | None = 60.0) -> None:
         header = _HEADER.pack(MAGIC, VERSION, ftype, req_id, len(payload), aux)
         trailer = struct.pack("<I", _crc_frame(header, payload))
+        # QoS admission backpressure (QosAdmissionError, -8): the HEADER
+        # send is the atomic admission point — it fails with NOTHING on the
+        # wire, so the caller (router) can safely requeue the whole frame.
+        # Once the header is out, the body MUST follow or the link would
+        # desync, so a body-side rejection retries in place: the class has
+        # bytes in flight (at least our header), and an idle class always
+        # admits, so this converges as the link drains.
         self.send_comm.send(header, timeout=timeout)
-        self.send_comm.send(payload + trailer, timeout=timeout)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                self.send_comm.send(payload + trailer, timeout=timeout)
+                return
+            except QosAdmissionError:
+                if deadline is not None and time.monotonic() > deadline:
+                    raise
+                time.sleep(0.001)
 
     # -- receiving ---------------------------------------------------------
 

@@ -80,7 +80,11 @@ class Router:
         self.policy = policy
         self.retain_kv = retain_kv
         self._queue_limit = queue_limit
-        self._net = net or transport.Net()
+        # KV BLOCK and FIRST/RESULT frames ship on a LATENCY-class link:
+        # the class nibble rides every comm this Net wires, so TTFT-bound
+        # tier traffic never queues behind a co-tenant's bulk gradient
+        # AllReduce in the QoS scheduler (docs/DESIGN.md "Transport QoS").
+        self._net = net or transport.Net(traffic_class="latency")
         self._ranks: list[_Rank] = []
         self._rr_next = 0
         self._queue: deque[dict] = deque()
@@ -88,7 +92,8 @@ class Router:
         self._results: dict[int, np.ndarray] = {}
         self._next_id = 0
         self.stats = {"submitted": 0, "completed": 0, "rank_failures": 0,
-                      "replays_kv": 0, "replays_prefill": 0, "rejected": 0}
+                      "replays_kv": 0, "replays_prefill": 0, "rejected": 0,
+                      "qos_backpressure": 0}
 
     # -- wiring ------------------------------------------------------------
 
@@ -199,6 +204,14 @@ class Router:
                     rec["payload"] = payload
             try:
                 rank.link.send_frame(proto.T_BLOCK, rec["id"], payload)
+            except _native.QosAdmissionError:
+                # Typed QoS backpressure: the latency class's in-flight
+                # budget is full. NOTHING reached the wire (the header send
+                # is the admission point), so requeue front-of-queue and
+                # retry on the next poll — the rank is healthy.
+                self.stats["qos_backpressure"] += 1
+                self._queue.appendleft(rec)
+                break
             except (_native.NativeError, TimeoutError, OSError) as e:
                 self._queue.appendleft(rec)
                 self._fail_rank(rank, e)

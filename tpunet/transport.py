@@ -83,6 +83,49 @@ def reduce_into(dst: np.ndarray, a: np.ndarray, b: np.ndarray, dtype: str,
 
 _CODECS = {"f32": 0, "bf16": 1, "int8": 2}
 
+TRAFFIC_CLASSES = ("latency", "bulk", "control")
+
+
+def qos_state() -> dict:
+    """Parsed view of the process QoS scheduler's config + live state
+    (weights, admission budgets, wire window, in-flight bytes) via
+    ``tpunet_c_qos_state`` — lets tests and operators pin that
+    ``TPUNET_QOS_WEIGHTS`` / ``TPUNET_QOS_INFLIGHT_BYTES`` parsed to what
+    they meant. Keys: weights/budgets/admitted/queued ({class: int}),
+    wire_window, wire_inflight (ints)."""
+    lib = _native.load()
+    buf = ctypes.create_string_buffer(4096)
+    n = lib.tpunet_c_qos_state(buf, 4096)
+    if n < 0:
+        raise _native.NativeError(n, "qos_state")
+    out: dict = {}
+    for line in buf.value.decode().splitlines():
+        parts = line.split()
+        if not parts:
+            continue
+        if "=" in (parts[1] if len(parts) > 1 else ""):
+            out[parts[0]] = {k: int(v) for k, v in
+                             (kv.split("=") for kv in parts[1:])}
+        elif len(parts) == 2:
+            out[parts[0]] = int(parts[1])
+    return out
+
+
+def qos_drr_golden(weights: str, window: str, chunks: str) -> list[str]:
+    """Deficit-round-robin arithmetic golden: the exact wire-credit grant
+    order the QoS scheduler would produce for ``chunks``
+    ("class:bytes,...", queued in order; completions retire in grant
+    order) under ``weights`` (TPUNET_QOS_WEIGHTS grammar) and ``window``
+    ("wire=<bytes>"). Pure arithmetic — no sockets — so tests can pin
+    strict control priority and the weighted latency/bulk interleave.
+    Malformed specs raise NativeError (INVALID) naming the token."""
+    lib = _native.load()
+    buf = ctypes.create_string_buffer(65536)
+    n = lib.tpunet_c_qos_drr_golden(weights.encode(), window.encode(),
+                                    chunks.encode(), buf, 65536)
+    _native.check(min(n, 0), "qos_drr_golden")
+    return buf.value.decode().split(",") if buf.value else []
+
 
 def codec_wire_bytes(codec: str, n: int) -> int:
     """Encoded byte count for ``n`` f32 elements under ``codec`` ("f32",
@@ -271,13 +314,28 @@ class ListenComm:
 
 class Net:
     """One transport engine instance (reference: BaguaNet singleton — but
-    multiple instances are allowed here)."""
+    multiple instances are allowed here).
 
-    def __init__(self) -> None:
+    ``traffic_class`` ("latency" / "bulk" / "control") pins the QoS lane
+    every comm this engine CONNECTS will carry — the class nibble rides the
+    connect preamble, so the far side's recv comm adopts it (sender's class
+    wins, like nstreams). None defers to TPUNET_TRAFFIC_CLASS (default
+    bulk). docs/DESIGN.md "Transport QoS"."""
+
+    def __init__(self, traffic_class: str | None = None) -> None:
+        if traffic_class is not None and traffic_class not in TRAFFIC_CLASSES:
+            raise ValueError(
+                f"traffic_class must be one of {TRAFFIC_CLASSES}, "
+                f"got {traffic_class!r}")
         self._lib = _native.load()
         inst = ctypes.c_size_t(0)
-        _native.check(self._lib.tpunet_c_create(ctypes.byref(inst)), "create")
+        _native.check(
+            self._lib.tpunet_c_create_ex(
+                (traffic_class or "").encode(), ctypes.byref(inst)),
+            "create",
+        )
         self._id = inst.value
+        self.traffic_class = traffic_class
 
     def devices(self) -> int:
         n = ctypes.c_int32(0)
