@@ -57,6 +57,22 @@ def _syscall_total() -> int:
         "tpunet_engine_syscalls_total", {}).values()))
 
 
+def _stream_tx_split() -> dict:
+    """Per-stream tx byte shares since the last telemetry.reset() — the
+    observable stripe skew (round 9): uniform striping reads ~1/nstreams
+    per stream; a weighted/degraded comm reads its actual split."""
+    from tpunet import telemetry
+
+    per = {}
+    for key, value in telemetry.metrics().get(
+            "tpunet_stream_tx_bytes", {}).items():
+        lab = telemetry.labels(key)
+        if "stream" in lab:
+            per[int(lab["stream"])] = int(value)
+    total = sum(per.values())
+    return {str(s): round(v / total, 4) for s, v in sorted(per.items())} if total else {}
+
+
 def _peer(rank: int, conn, q, engine: str, nstreams: int,
           sizes: list, iters: int) -> None:
     try:
@@ -110,7 +126,10 @@ def _peer(rank: int, conn, q, engine: str, nstreams: int,
                          "syscalls_per_mib": (round(syscalls / (moved / 2**20), 3)
                                               if moved else None),
                          "bytes_per_syscall": (round(moved / syscalls)
-                                               if syscalls and moved else None)}
+                                               if syscalls and moved else None),
+                         # Per-stream tx byte shares over the timed window —
+                         # stripe skew made eyeball-able (round 9).
+                         "stream_tx_split": _stream_tx_split()}
         sc.close()
         rc.close()
         listen.close()
@@ -232,6 +251,9 @@ def main(argv=None) -> None:
                                      if spm else None),
                 "bytes_per_syscall": (round(statistics.median(bps))
                                       if bps else None),
+                # Last rep's per-stream tx shares (deterministic from the
+                # rotation, so any rep is representative).
+                "stream_tx_split": raw[eng][-1][s].get("stream_tx_split"),
             }
         out["engines"][eng] = agg
     if "BASIC" in out["engines"] and "EPOLL" in out["engines"]:

@@ -12,13 +12,28 @@ Jain's fairness index J = (Σx)² / (n·Σx²) — 1.0 is perfectly fair, 1/n is
 one stream hogging everything.
 
     python -m benchmarks.fairness --nstreams 4 --messages 2000 --size 8192
+
+Lane mode (docs/DESIGN.md "Lanes & adaptive striping"): under ``--lanes``
+the bench drives a two-Net loopback pair through the WEIGHTED stripe
+scheduler, optionally delay-faulting the last lane into an asymmetric
+path, and reports — all from counters — per-lane byte shares
+(tpunet_lane_bytes_total), per-class Jain indices
+(tpunet_stream_fairness_jain), measured lane rates (tpunet_lane_rate_bps),
+restripe epochs, and the weight-convergence HALF-LIFE: the time for the
+demoted lane's tpunet_lane_weight gauge to cover half the distance from
+its initial to its final value.
+
+    python -m benchmarks.fairness --lanes w=1,w=1 --delay-ms 3 --json out.json
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
+import threading
+import time
 
 
 def _worker(rank, world, port, q, args):
@@ -83,6 +98,125 @@ def jain(xs) -> float:
     return sum(xs) ** 2 / (len(xs) * sum(x * x for x in xs))
 
 
+# ---------------------------------------------------------------------------
+# Lane mode: weighted striping over an (optionally) asymmetric loopback pair.
+
+
+def _lane_gauge(metrics, family, labels_fn):
+    out = {}
+    for key, value in metrics.get(family, {}).items():
+        lab = labels_fn(key)
+        if "lane" in lab and lab.get("dir") in (None, "tx"):
+            out[int(lab["lane"])] = int(value)
+    return out
+
+
+def run_lanes(args) -> dict:
+    os.environ["TPUNET_LANES"] = args.lanes
+    os.environ["TPUNET_LANE_ADAPT"] = "0" if args.no_adapt else "1"
+    os.environ["TPUNET_LANE_ADAPT_MS"] = str(args.adapt_ms)
+    os.environ["TPUNET_MIN_CHUNKSIZE"] = str(max(1, args.size // 8))
+    os.environ["TPUNET_CRC"] = "1"
+    import numpy as np
+
+    from tpunet import telemetry
+    from tpunet import transport as tp
+    from tpunet.transport import Net
+
+    nlanes = len(args.lanes.split(","))
+    telemetry.reset()
+    ns, nr = Net(), Net()
+    lc = nr.listen()
+    got = {}
+    th = threading.Thread(target=lambda: got.setdefault("rc", lc.accept()))
+    th.start()
+    sc = ns.connect(lc.handle)
+    th.join()
+    rc = got["rc"]
+    weight_trace = []  # (seconds, {lane: weight}) — the convergence record
+    try:
+        if args.delay_ms:
+            tp.fault_inject(
+                f"stream={nlanes - 1}:side=send:action=delay={args.delay_ms}")
+        src = np.arange(args.size, dtype=np.uint8)
+        t0 = time.perf_counter()
+        batch = 10
+        for start in range(0, args.messages, batch):
+            for _ in range(min(batch, args.messages - start)):
+                dst = np.zeros_like(src)
+                r = rc.irecv(dst)
+                sc.isend(src).wait(timeout=60)
+                r.wait(timeout=60)
+                if not np.array_equal(src, dst):
+                    raise RuntimeError("payload corrupt — lane layout desync?")
+            weight_trace.append((
+                time.perf_counter() - t0,
+                _lane_gauge(telemetry.metrics(), "tpunet_lane_weight",
+                            telemetry.labels),
+            ))
+        elapsed = time.perf_counter() - t0
+    finally:
+        tp.fault_clear()
+        for c in (sc, rc, lc):
+            c.close()
+        ns.close()
+        nr.close()
+
+    m = telemetry.metrics()
+    lanes = _lane_gauge(m, "tpunet_lane_bytes_total", telemetry.labels)
+    rates = _lane_gauge(m, "tpunet_lane_rate_bps", telemetry.labels)
+    total = sum(lanes.values())
+    shares = {str(k): round(v / total, 4) for k, v in sorted(lanes.items())} if total else {}
+    jain_by_class = {}
+    for key, value in m.get("tpunet_stream_fairness_jain", {}).items():
+        lab = telemetry.labels(key)
+        if lab.get("dir") == "tx":
+            jain_by_class[lab.get("class", "?")] = round(float(value), 4)
+
+    # Weight-convergence half-life: for the lane whose weight moved the
+    # most, the first trace time at which it had covered half the distance
+    # from its initial to its final value. None when weights never moved
+    # (uniform control / symmetric paths).
+    half_life_s = None
+    if weight_trace:
+        final = weight_trace[-1][1]
+        initial = weight_trace[0][1]
+        mover, dist = None, 0
+        for lane in final:
+            d = abs(final.get(lane, 1) - initial.get(lane, 1))
+            if d > dist:
+                mover, dist = lane, d
+        if mover is not None and dist > 0:
+            target = initial.get(mover, 1) + (final[mover] - initial.get(mover, 1)) / 2
+            for t, ws in weight_trace:
+                w = ws.get(mover)
+                if w is None:
+                    continue
+                if (final[mover] >= initial.get(mover, 1) and w >= target) or \
+                   (final[mover] < initial.get(mover, 1) and w <= target):
+                    half_life_s = round(t, 4)
+                    break
+
+    return {
+        "mode": "lanes",
+        "lanes": args.lanes,
+        "adapt": not args.no_adapt,
+        "delay_ms": args.delay_ms,
+        "messages": args.messages,
+        "size": args.size,
+        "elapsed_s": round(elapsed, 3),
+        "lane_tx_bytes": {str(k): v for k, v in sorted(lanes.items())},
+        "lane_tx_shares": shares,
+        "lane_rate_bps": {str(k): v for k, v in sorted(rates.items())},
+        "lane_weights": {str(k): v for k, v in
+                         sorted(weight_trace[-1][1].items())} if weight_trace else {},
+        "jain_tx_by_class": jain_by_class,
+        "restripe_events": int(sum(
+            m.get("tpunet_restripe_events_total", {}).values())),
+        "weight_half_life_s": half_life_s,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--nstreams", type=int, default=4)
@@ -90,7 +224,31 @@ def main(argv=None):
     ap.add_argument("--size", type=int, default=8192, help="bytes per message")
     ap.add_argument("-n", "--world", type=int, default=2,
                     help="ring size; >2 = all ranks stripe concurrently")
+    ap.add_argument("--lanes", default=None, metavar="SPEC",
+                    help="lane mode: TPUNET_LANES spec (e.g. w=1,w=1) — "
+                         "weighted striping over a loopback pair; reports "
+                         "per-lane shares / rates / weights / half-life")
+    ap.add_argument("--delay-ms", type=int, default=0,
+                    help="lane mode: delay-fault the LAST lane by this many "
+                         "ms per chunk (the asymmetric-path injection)")
+    ap.add_argument("--adapt-ms", type=int, default=20,
+                    help="lane mode: adaptation tick (TPUNET_LANE_ADAPT_MS)")
+    ap.add_argument("--no-adapt", action="store_true",
+                    help="lane mode: pin base weights (uniform control)")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="also write the result object to PATH (stdout keeps "
+                         "the one-JSON-line contract in lane mode)")
     args = ap.parse_args(argv)
+
+    if args.lanes:
+        if args.messages == 2000 and args.size == 8192:
+            args.messages, args.size = 400, 256 << 10  # lane-mode defaults
+        out = run_lanes(args)
+        print(json.dumps(out))
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(out, f, indent=2)
+        return out
 
     from benchmarks import check_rank_results, spawn_ranks
 
@@ -101,6 +259,7 @@ def main(argv=None):
           f"nstreams={args.nstreams} messages={args.messages} "
           f"size={args.size}B (single-chunk)")
     worst = 1.0
+    per_rank = {}
     for rank in sorted(results):
         counts = [results[rank].get(i, 0) for i in range(args.nstreams)]
         j = jain(counts)
@@ -108,9 +267,16 @@ def main(argv=None):
         total = sum(counts)
         pcts = " ".join(f"{100.0 * c / total if total else 0.0:5.1f}%"
                         for c in counts)
+        per_rank[str(rank)] = {"tx_bytes": counts, "jain": round(j, 4)}
         print(f"  rank {rank} tx: {pcts}  Jain {j:.4f}")
     print(f"  worst-rank Jain fairness index: {worst:.4f}  (1.0 = perfectly "
           f"fair, {1.0 / args.nstreams:.2f} = one stream hogs)")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"mode": "streams", "world": args.world,
+                       "nstreams": args.nstreams, "messages": args.messages,
+                       "size": args.size, "per_rank": per_rank,
+                       "worst_jain": round(worst, 4)}, f, indent=2)
     return worst
 
 

@@ -148,6 +148,28 @@ int32_t tpunet_c_codec_encode(int32_t codec, const void* src, uint64_t n,
 int32_t tpunet_c_codec_decode(int32_t codec, const void* wire, uint64_t n,
                               void* dst);
 
+/* ---- Lane striping (docs/DESIGN.md "Lanes & adaptive striping") ---------
+ * Pure views of the weighted stripe scheduler so Python goldens can pin the
+ * chunk->stream layout both sides derive — no sockets involved. */
+/* Parse a TPUNET_LANES spec ("addr=10.0.0.1:w=4,addr=10.0.1.1:w=1"; a lane
+ * may omit either key) and echo the normalized form, one lane per line:
+ * "lane=<i> addr=<a|-> w=<n>". Malformed specs are TPUNET_ERR_INVALID with
+ * the offending token in tpunet_c_last_error(). Returns the full text
+ * length (the tpunet_c_metrics_text buffer-sizing contract). */
+int32_t tpunet_c_lane_parse(const char* spec, char* out, uint64_t cap);
+/* The chunk->stream assignment a message of `len` bytes gets under the
+ * weighted stripe scheduler: `weights` is a comma-separated per-stream
+ * weight list (1..255 each; its length is the stream count), `cursor` the
+ * comm's rotation cursor at message start. Writes the comma-separated
+ * stream index per chunk (empty for len == 0). Both transport engines
+ * derive layouts from exactly this arithmetic — the golden tests pin that
+ * sender and receiver agree for every (len, min_chunksize, weights, cursor)
+ * without layout metadata on the wire. Equal weights reproduce the uniform
+ * cursor%nstreams rotation bit-for-bit. */
+int32_t tpunet_c_stripe_map(uint64_t len, uint64_t min_chunksize,
+                            const char* weights, uint64_t cursor, char* out,
+                            uint64_t cap);
+
 /* ---- Collectives (ring communicator over the transport) ----------------
  * The layer NCCL provided above the reference plugin (SURVEY §2.3); here it
  * is in-repo: bootstrap rendezvous + ring AllReduce/ReduceScatter/AllGather/

@@ -28,6 +28,14 @@
 //     zero bytes in flight always admits one message, so a message larger
 //     than its budget cannot be rejected forever.
 //
+// Composition with lane striping (docs/DESIGN.md §1c): the weighted stripe
+// scheduler changes only WHICH stream a chunk rides, never chunk sizes or
+// counts, so wire credit is still acquired per chunk for payload+CRC bytes
+// and the per-class budgets see identical charge sequences whether a comm
+// is uniform or lane-weighted. DRR grant order and lane weighting compose
+// orthogonally: QoS decides WHEN a class's chunk may enter the kernel,
+// lanes decide WHERE it goes.
+//
 // Observability: every decision feeds tpunet_qos_bytes_total{class,dir},
 // tpunet_qos_queue_wait_us{class} and tpunet_qos_preempts_total{class}
 // (metrics.cc), all telemetry.reset()-able.

@@ -78,6 +78,12 @@ struct StreamTcpSample {
   uint64_t retrans_total = 0;     // tcpi_total_retrans of the sampled socket
   uint64_t cwnd = 0;              // tcpi_snd_cwnd (segments)
   uint64_t delivery_rate_bps = 0; // tcpi_delivery_rate * 8 (0 on old kernels)
+  uint64_t min_rtt_us = 0;        // tcpi_min_rtt (0 on old kernels) — the
+                                  // per-path RTT floor the static
+                                  // TPUNET_STRAGGLER_MIN_RTT_US knob
+                                  // approximates; observable per stream so
+                                  // heterogeneous-path floors stop being a
+                                  // one-size env guess
   bool sampled = false;
 };
 
@@ -126,6 +132,15 @@ struct MetricsSnapshot {
   StageHist req_queue_us;       // post -> first wire byte
   StageHist req_wire_us;        // first -> last wire byte
   StageHist req_total_us;       // post -> completion
+  // Lane-striping accounting (docs/DESIGN.md "Lanes & adaptive striping"):
+  // the stripe scheduler's current per-lane weight and measured service
+  // rate (last writer wins across comms — like the TCP slots, the gauges
+  // describe "a live lane at this index"), payload bytes per lane and
+  // direction, and weight-vector epochs published (re-stripe events).
+  uint64_t lane_weight[kMaxStreamStats] = {0};
+  uint64_t lane_rate_bps[kMaxStreamStats] = {0};
+  uint64_t lane_bytes[kMaxStreamStats][2] = {};  // [lane][tx=0, rx=1]
+  uint64_t restripe_events = 0;
   // Serving-tier SLO accounting (docs/DESIGN.md "Serving tier"): per-request
   // time-to-first-token and time-per-output-token histograms fed by the
   // router/decode workers through tpunet_c_serve_observe, plus instantaneous
@@ -183,6 +198,18 @@ class Telemetry {
   // compare when the slot's sampling window has not elapsed; otherwise does
   // the getsockopt, updates the slot's gauges, and runs the straggler check.
   void MaybeSampleStream(bool is_send, uint64_t stream_idx, int fd);
+  // Straggler-detector verdict for one stream slot (relaxed read of the
+  // hysteresis flag the sampler maintains) — the lane adaptation loop's
+  // demotion trigger (docs/DESIGN.md "Lanes & adaptive striping").
+  bool StreamStraggling(bool is_send, uint64_t stream_idx) const;
+  // Lane-striping hooks (lane-mode comms only; docs/DESIGN.md "Lanes &
+  // adaptive striping"): current stripe weight / measured service rate per
+  // lane (gauges, last writer wins), payload bytes per lane and direction,
+  // and one restripe event per weight-vector epoch published.
+  void OnLaneWeight(uint64_t lane, uint64_t weight);
+  void OnLaneRate(uint64_t lane, uint64_t bps);
+  void OnLaneBytes(bool is_send, uint64_t lane, uint64_t nbytes);
+  void OnRestripe();
   // Stage-latency accounting, called by the engines when a successful request
   // is consumed by test()/wait(). Timestamps are MonotonicUs(); completion
   // time is "now". post_us == 0 (no stamp) is ignored.

@@ -45,6 +45,20 @@ size_t ChunkSize(size_t total, size_t min_chunksize, size_t n);
 // Number of chunks a message of `total` bytes splits into (0 for total==0).
 size_t ChunkCount(size_t total, size_t chunksize);
 
+// Weighted-round-robin slot table for lane striping (docs/DESIGN.md "Lanes
+// & adaptive striping"): stream i appears weights[i] times per period
+// (sum of weights), interleaved by stride scheduling — at every slot the
+// stream with the largest accumulated credit wins (ties break to the lowest
+// index), so heavy lanes spread across the period instead of bursting.
+// Deterministic: identical weights produce identical tables on both sides
+// of a comm, which (with the shared rotating cursor) is what keeps the
+// sender's and receiver's chunk->stream maps symmetric without any
+// per-chunk wire metadata. Equal weights degenerate to [0, 1, ..., n-1] —
+// exactly the uniform rotation. Weights of 0 are treated as 1 (a lane may
+// be demoted to the floor but never unscheduled: floor-1 keeps its rate
+// measurable for recovery).
+std::vector<uint8_t> BuildWrrSlots(const std::vector<uint32_t>& weights);
+
 // ---- Wire-syscall accounting (tpunet_engine_syscalls_total{op,dir}) -------
 // Every send/recv-family syscall the engines issue on their data paths bumps
 // one relaxed process-wide counter, indexed by the syscall actually made

@@ -204,6 +204,36 @@ struct Comm {
   // sender direction of the ctrl connection, waiting for NACK frames.
   std::unique_ptr<std::thread> nack_reader;
 
+  // ---- Lane striping (docs/DESIGN.md "Lanes & adaptive striping") --------
+  // `lanes` flips the chunk→stream rotation from the uniform cursor onto a
+  // weighted-round-robin slot table derived from `weights`. Negotiated via
+  // kPreambleFlagLanes (sender-wins): both sides run the slot-table walk or
+  // neither does, so the maps stay symmetric. Weights change only via
+  // epoch-stamped WEIGHTS ctrl frames, emitted/applied under fo_mu in the
+  // same total order as message LEN frames — re-striping therefore lands
+  // exactly at message boundaries and every downstream mechanism (CRC
+  // framing, failover records, QoS credits, codec chunk sizing) composes
+  // unchanged.
+  bool lanes = false;
+  bool lane_adapt = false;          // sender runs the adaptation loop
+  uint64_t lane_adapt_us = 100000;  // TPUNET_LANE_ADAPT_MS
+  std::vector<uint32_t> base_weights;  // configured lane weights (TPUNET_LANES)
+  std::vector<uint32_t> weights GUARDED_BY(fo_mu);
+  std::vector<uint8_t> slots GUARDED_BY(fo_mu);  // WRR slot table
+  uint64_t stripe_epoch GUARDED_BY(fo_mu) = 0;
+  uint64_t next_adapt_us GUARDED_BY(fo_mu) = 0;
+  // Per-lane wire-service accounting fed by the send workers (relaxed
+  // atomics — the adaptation tick drains them under fo_mu). busy_us counts
+  // the full chunk service time including kernel backpressure and injected
+  // delays, which is what makes the measured rate track the path a TCP_INFO
+  // delivery-rate sample cannot see through on loopback.
+  struct LaneIo {
+    std::atomic<uint64_t> busy_us{0};
+    std::atomic<uint64_t> bytes{0};
+    std::atomic<uint64_t> rate_ewma_bps{0};
+  };
+  std::unique_ptr<LaneIo[]> lane_io;  // sized nstreams before threads start
+
   bool Aborted() const { return aborted_.load(std::memory_order_acquire); }
   // For QosScheduler::AcquireWire's bounded park: a worker waiting for wire
   // credit must notice comm shutdown without a dedicated wakeup channel.
@@ -345,6 +375,19 @@ void FinishChunk(StreamWorker* w, ChunkTask& t) { AccountChunkDone(w->comm, t.st
 // identical cursor (assignments are identical in ctrl order), so the
 // reduced-width rotation stays symmetric.
 size_t AssignStreamIdx(Comm* c) REQUIRES(c->fo_mu) {
+  if (c->lanes && !c->slots.empty()) {
+    // Weighted rotation: walk the WRR slot table from the shared cursor,
+    // skipping retired streams (post-failover re-stripe of the survivors).
+    // Both sides advance the cursor identically — including the skips —
+    // because retirement and weight epochs land at the same points in ctrl
+    // order, so the maps stay symmetric with zero per-chunk wire metadata.
+    for (size_t tries = 0; tries <= c->slots.size(); ++tries) {
+      size_t s = c->slots[c->cursor % c->slots.size()];
+      c->cursor += 1;
+      if (!c->stream_retired[s]) return s;
+    }
+    return 0;  // unreachable: alive >= 1 and every stream has >= 1 slot
+  }
   size_t alive = c->nstreams - [&] {
     size_t r = 0;
     for (size_t i = 0; i < c->nstreams; ++i) r += c->stream_retired[i] ? 1 : 0;
@@ -516,6 +559,11 @@ void SendWorkerLoop(StreamWorker* w, bool spin) {
       continue;
     }
     t.state->MarkWireStart(MonotonicUs());  // queue stage ends at first chunk IO
+    // Lane service clock: spans the fault gate AND the (blocking) write, so
+    // injected delays and kernel backpressure both land in the measured
+    // per-lane rate — the adaptation signal TCP_INFO's burst-window
+    // delivery-rate estimate cannot see on loopback.
+    uint64_t lane_t0 = c->lanes ? MonotonicUs() : 0;
     FaultAction fa = FaultCheck(true, w->idx, w->fd, t.len);
     Status s;
     if (fa == FaultAction::kCorrupt) {
@@ -553,6 +601,12 @@ void SendWorkerLoop(StreamWorker* w, bool spin) {
       while (w->tasks.TryPop(&d)) {
       }
       return;
+    }
+    if (c->lanes && c->lane_io) {
+      uint64_t dt = MonotonicUs() - lane_t0;
+      c->lane_io[w->idx].busy_us.fetch_add(dt ? dt : 1, std::memory_order_relaxed);
+      c->lane_io[w->idx].bytes.fetch_add(t.len, std::memory_order_relaxed);
+      Telemetry::Get().OnLaneBytes(true, w->idx, t.len);
     }
     Telemetry::Get().OnStreamBytes(true, w->idx, t.len,
                                    static_cast<int>(c->cls));
@@ -598,6 +652,7 @@ void RecvWorkerLoop(StreamWorker* w, bool spin) {
     } else {
       Telemetry::Get().OnStreamBytes(false, w->idx, t.len,
                                      static_cast<int>(c->cls));
+      if (c->lanes) Telemetry::Get().OnLaneBytes(false, w->idx, t.len);
       Telemetry::Get().MaybeSampleStream(false, w->idx, w->fd);
     }
     PopRec(c, w->idx, t.seq);
@@ -675,6 +730,96 @@ void FailAndDrain(Comm* c, const RequestPtr& state, const std::string& msg) {
   PoisonAndDrainQueue(c, msg);
 }
 
+// ---- Lane adaptation (send side; docs/DESIGN.md "Lanes & adaptive
+// striping") ----------------------------------------------------------------
+
+// Weight resolution of the adaptive scheduler: the fastest lane is pinned
+// at this weight and slower lanes scale below it, so byte shares track the
+// measured rate ratio within one part in kLaneWeightScale.
+constexpr uint32_t kLaneWeightScale = 16;
+
+// Publish the comm's current weight vector as an epoch-stamped WEIGHTS ctrl
+// frame. fo_mu held — the frame is totally ordered against LEN/FAILOVER
+// frames, which is what confines re-striping to message boundaries.
+Status PublishWeightsLocked(Comm* c) REQUIRES(c->fo_mu) {
+  uint8_t buf[8 + 256];
+  size_t n = BuildWeightsUnit(c->stripe_epoch, c->weights, buf);
+  Status s = WriteAll(c->ctrl_fd, buf, n, c->spin);
+  if (!s.ok()) return s;
+  for (size_t i = 0; i < c->weights.size(); ++i) {
+    Telemetry::Get().OnLaneWeight(i, c->weights[i]);
+  }
+  return Status::Ok();
+}
+
+// One adaptation tick, rate-limited to the comm's TPUNET_LANE_ADAPT_MS
+// cadence: drain the per-lane service accounting into rate EWMAs, derive
+// weight targets (rate-proportional, kLaneWeightScale resolution, floor 1),
+// demote straggler-flagged lanes (TCP_INFO sRTT detector, rising-edge
+// hysteresis upstream) by halving, and step current weights halfway toward
+// their targets — geometric convergence whose half-life the fairness bench
+// reads off the tpunet_lane_weight gauge. A changed vector bumps the epoch
+// and publishes; an unchanged one costs two clock reads. The ctrl write is
+// the only fallible step; the caller treats failure like a LEN-frame loss.
+Status MaybeAdaptLanesLocked(Comm* c) REQUIRES(c->fo_mu) {
+  if (!c->lanes || !c->is_send || !c->lane_adapt || !c->lane_io) return Status::Ok();
+  uint64_t now = MonotonicUs();
+  if (now < c->next_adapt_us) return Status::Ok();
+  c->next_adapt_us = now + c->lane_adapt_us;
+  uint64_t rmax = 0;
+  bool moved = false;
+  for (size_t i = 0; i < c->nstreams; ++i) {
+    uint64_t bytes = c->lane_io[i].bytes.exchange(0, std::memory_order_relaxed);
+    uint64_t busy = c->lane_io[i].busy_us.exchange(0, std::memory_order_relaxed);
+    uint64_t ewma = c->lane_io[i].rate_ewma_bps.load(std::memory_order_relaxed);
+    if (bytes > 0 && busy > 0) {
+      uint64_t inst = bytes * 8 * 1000000 / busy;  // bits/s over service time
+      ewma = ewma == 0 ? inst : (ewma + inst) / 2;
+      c->lane_io[i].rate_ewma_bps.store(ewma, std::memory_order_relaxed);
+      Telemetry::Get().OnLaneRate(i, ewma);
+      moved = true;
+    }
+    // Re-export the weight gauge every tick (not only on publishes) so a
+    // mid-run telemetry.reset() — how benches split warmup from
+    // measurement — repopulates it without waiting for the next epoch.
+    Telemetry::Get().OnLaneWeight(i, c->weights[i]);
+    if (!c->stream_retired[i] && ewma > rmax) rmax = ewma;
+  }
+  if (!moved || rmax == 0) return Status::Ok();
+  bool changed = false;
+  for (size_t i = 0; i < c->nstreams; ++i) {
+    if (c->stream_retired[i]) continue;
+    uint64_t ewma = c->lane_io[i].rate_ewma_bps.load(std::memory_order_relaxed);
+    uint32_t w = c->weights[i];
+    uint32_t target = w;  // no measurement yet: hold
+    if (ewma > 0) {
+      target = static_cast<uint32_t>((kLaneWeightScale * ewma + rmax / 2) / rmax);
+      if (target < 1) target = 1;
+      if (target > kLaneWeightScale) target = kLaneWeightScale;
+    }
+    if (Telemetry::Get().StreamStraggling(true, i)) {
+      uint32_t demoted = w > 1 ? w / 2 : 1;
+      if (demoted < target) target = demoted;
+    }
+    uint32_t next = w;
+    if (target > w) {
+      next = w + std::max<uint32_t>(1, (target - w) / 2);
+    } else if (target < w) {
+      next = w - std::max<uint32_t>(1, (w - target) / 2);
+    }
+    if (next != w) {
+      c->weights[i] = next;
+      changed = true;
+    }
+  }
+  if (!changed) return Status::Ok();
+  c->stripe_epoch += 1;
+  c->slots = BuildWrrSlots(c->weights);
+  Telemetry::Get().OnRestripe();
+  TPUNET_DBG("lane re-stripe epoch=%llu", (unsigned long long)c->stripe_epoch);
+  return PublishWeightsLocked(c);
+}
+
 // Per-message sender work: chunk dispatch + ctrl length frame. Runs on the
 // scheduler thread normally, or on the caller thread via the inline fast
 // path (never concurrently — see Comm::inflight).
@@ -699,23 +844,37 @@ bool SendOneMsg(Comm* c, const Msg& m) {
   size_t nchunks = ChunkCount(m.len, csize);
   m.state->total.store(nchunks + 1, std::memory_order_release);
   Status s;
+  bool dispatched = false;
   {
-    // One fo_mu section covers this message's chunk assignment AND its ctrl
-    // length frame, so a concurrent FAILOVER marker (NACK handler) lands
-    // strictly before or strictly after the whole message in ctrl order —
-    // the receiver applies the same assignment set either way.
+    // One fo_mu section covers this message's adaptation tick (possible
+    // WEIGHTS frame), chunk assignment AND its ctrl length frame, so a
+    // concurrent FAILOVER marker (NACK handler) lands strictly before or
+    // strictly after the whole message in ctrl order — the receiver applies
+    // the same assignment set either way, and a re-stripe can never split a
+    // message.
     MutexLock lk(c->fo_mu);
-    size_t off = 0;
-    for (size_t i = 0; i < nchunks; ++i) {
-      size_t n = std::min(csize, m.len - off);
-      AssignChunk(c, m.data + off, n, m.state);
-      off += n;
+    s = MaybeAdaptLanesLocked(c);
+    if (s.ok()) {
+      dispatched = true;
+      size_t off = 0;
+      for (size_t i = 0; i < nchunks; ++i) {
+        size_t n = std::min(csize, m.len - off);
+        AssignChunk(c, m.data + off, n, m.state);
+        off += n;
+      }
+      s = WriteAll(c->ctrl_fd, hdr, sizeof(hdr), c->spin);
     }
-    s = WriteAll(c->ctrl_fd, hdr, sizeof(hdr), c->spin);
   }
   if (!s.ok()) m.state->SetError(s.msg);
+  if (!dispatched) {
+    // WEIGHTS ctrl write failed before any chunk was assigned: the ctrl
+    // unit below is the message's only completion unit, or test() would
+    // wait forever for chunks that never dispatched.
+    m.state->total.store(1, std::memory_order_release);
+  }
+  uint64_t total_units = dispatched ? nchunks + 1 : 1;
   uint64_t prior = m.state->completed.fetch_add(1, std::memory_order_acq_rel);
-  if (prior + 1 >= nchunks + 1) {
+  if (prior + 1 >= total_units) {
     c->inflight.fetch_sub(1, std::memory_order_release);
   }
   m.state->NotifyIfSettled();
@@ -794,11 +953,50 @@ Status ProcessFailoverMarkerLocked(Comm* c, uint64_t frame) REQUIRES(c->ctrl_mu)
     }
     if (!r.state->failed.load(std::memory_order_acquire)) {
       Telemetry::Get().OnStreamBytes(false, k, r.len, static_cast<int>(c->cls));
+      if (c->lanes) Telemetry::Get().OnLaneBytes(false, k, r.len);
     }
     AccountChunkDone(c, r.state, r.len);
   }
   c->recs[k].clear();
   c->stream_retired[k] = 1;  // rotation excludes k from here on — both sides
+  return Status::Ok();
+}
+
+// WEIGHTS epoch frame: the sender re-striped as of this point in ctrl
+// order. Read the per-stream weight bytes, rebuild the slot table, and
+// advance the epoch — subsequent LEN frames' messages are laid out on the
+// new vector on both sides. ctrl_mu held; takes fo_mu for the table swap.
+Status ProcessWeightsFrameLocked(Comm* c, uint64_t frame) REQUIRES(c->ctrl_mu) {
+  uint64_t count = WeightsFrameCount(frame);
+  uint64_t epoch = WeightsFrameEpoch(frame);
+  if (!c->lanes || count != c->nstreams || count == 0) {
+    return Status::Inner("WEIGHTS frame for " + std::to_string(count) +
+                         " streams on a " + std::to_string(c->nstreams) +
+                         "-stream " + (c->lanes ? "lane" : "non-lane") +
+                         " comm (protocol desync)");
+  }
+  uint8_t wbytes[256];
+  Status s = ReadExact(c->ctrl_fd, wbytes, count, c->spin);
+  if (!s.ok()) return s;
+  MutexLock lk(c->fo_mu);
+  if (epoch <= c->stripe_epoch) {
+    return Status::Inner("WEIGHTS epoch " + std::to_string(epoch) +
+                         " is not past the current epoch " +
+                         std::to_string(c->stripe_epoch) + " (protocol desync)");
+  }
+  for (uint64_t i = 0; i < count; ++i) {
+    if (wbytes[i] == 0) {
+      return Status::Inner("WEIGHTS frame carries a zero weight (protocol desync)");
+    }
+    c->weights[i] = wbytes[i];
+    Telemetry::Get().OnLaneWeight(i, wbytes[i]);
+  }
+  bool initial = c->stripe_epoch == 0;
+  c->stripe_epoch = epoch;
+  c->slots = BuildWrrSlots(c->weights);
+  // The epoch-1 frame is the sender's configured baseline, not a re-stripe.
+  if (!initial) Telemetry::Get().OnRestripe();
+  TPUNET_DBG("lane weights applied epoch=%llu", (unsigned long long)epoch);
   return Status::Ok();
 }
 
@@ -817,6 +1015,11 @@ Status RecvCtrlFrame(Comm* c, const Msg& m, uint64_t* target) REQUIRES(c->ctrl_m
     if (!s.ok()) return s;
     if ((frame >> 56) == kCtrlFrameFailover) {
       s = ProcessFailoverMarkerLocked(c, frame);
+      if (!s.ok()) return s;
+      continue;
+    }
+    if ((frame >> 56) == kCtrlFrameWeights) {
+      s = ProcessWeightsFrameLocked(c, frame);
       if (!s.ok()) return s;
       continue;
     }
@@ -876,6 +1079,14 @@ void PumpCtrlUntilRetired(Comm* c, size_t idx) {
     }
     if ((frame >> 56) == kCtrlFrameFailover) {
       s = ProcessFailoverMarkerLocked(c, frame);
+      if (!s.ok()) {
+        PoisonAndDrainQueue(c, s.msg);
+        return;
+      }
+      continue;
+    }
+    if ((frame >> 56) == kCtrlFrameWeights) {
+      s = ProcessWeightsFrameLocked(c, frame);
       if (!s.ok()) {
         PoisonAndDrainQueue(c, s.msg);
         return;
@@ -957,6 +1168,7 @@ bool HandleNack(Comm* c, size_t k, uint64_t completed) {
             // accounting (written records were counted by their worker).
             Telemetry::Get().OnStreamBytes(true, k, r.len,
                                            static_cast<int>(c->cls));
+            if (c->lanes) Telemetry::Get().OnLaneBytes(true, k, r.len);
             AccountChunkDone(c, r.state, r.len);
             r.written = true;
           }
@@ -1082,6 +1294,7 @@ void ExecuteLazyRecv(Comm* c, const Msg& m) {
       } else {
         Telemetry::Get().OnStreamBytes(false, idx, len,
                                        static_cast<int>(c->cls));
+        if (c->lanes) Telemetry::Get().OnLaneBytes(false, idx, len);
         Telemetry::Get().MaybeSampleStream(false, idx, w->fd);
       }
       PopRec(c, idx, seq);
@@ -1124,7 +1337,7 @@ class BasicEngine : public EngineBase {
     std::vector<int> data_fds;
     int ctrl_fd = -1;
     Status s = ConnectBundle(nics_, dev, handle, nstreams_, min_chunksize_, PreambleFlags(),
-                             &data_fds, &ctrl_fd);
+                             &data_fds, &ctrl_fd, lane_mode_ ? &lanes_ : nullptr);
     if (!s.ok()) return s;
 
     auto comm = std::make_shared<Comm>();
@@ -1134,6 +1347,10 @@ class BasicEngine : public EngineBase {
     comm->spin = spin_;
     comm->crc = crc_;
     comm->cls = static_cast<TrafficClass>(traffic_class());
+    comm->lanes = lane_mode_;
+    comm->lane_adapt = lane_mode_ && lane_adapt_;
+    comm->lane_adapt_us = lane_adapt_ms_ * 1000;
+    comm->base_weights = LaneBaseWeights();
     comm->ctrl_fd = ctrl_fd;
     for (int fd : data_fds) {
       auto w = std::make_unique<StreamWorker>();
@@ -1154,7 +1371,11 @@ class BasicEngine : public EngineBase {
         return ns;
       }
     }
-    StartThreads(comm.get());
+    s = StartThreads(comm.get());
+    if (!s.ok()) {
+      comm->Shutdown();
+      return s;
+    }
     uint64_t id = next_id_.fetch_add(1);
     send_comms_.Put(id, comm);
     *send_comm = id;
@@ -1388,7 +1609,7 @@ class BasicEngine : public EngineBase {
     if (!c->msgs.Push(m)) FailMsg(c, m.state, "recv comm is poisoned");
   }
 
-  void StartThreads(Comm* c) {
+  Status StartThreads(Comm* c) {
     {
       // Failover bookkeeping is per-stream; size it before any IO thread
       // runs. No concurrency yet — the lock exists for the TSA contract.
@@ -1398,6 +1619,23 @@ class BasicEngine : public EngineBase {
       c->recs.resize(c->nstreams);
       c->next_seq.assign(c->nstreams, 0);
       c->done_seq.assign(c->nstreams, 0);
+      if (c->lanes) {
+        // Lane mode: both sides start on equal weights (the receiver knows
+        // nothing else yet); the sender publishes its configured base
+        // vector as epoch 1 before any message, so the first LEN frame
+        // already finds both sides on the same (possibly non-uniform) map.
+        c->weights.assign(c->nstreams, 1);
+        c->slots = BuildWrrSlots(c->weights);
+        c->lane_io.reset(new Comm::LaneIo[c->nstreams]);
+        if (c->is_send) {
+          c->weights = c->base_weights;
+          c->weights.resize(c->nstreams, 1);
+          c->stripe_epoch = 1;
+          c->slots = BuildWrrSlots(c->weights);
+          Status ps = PublishWeightsLocked(c);
+          if (!ps.ok()) return ps;
+        }
+      }
     }
     bool spin = c->spin;
     for (auto& w : c->workers) {
@@ -1413,6 +1651,7 @@ class BasicEngine : public EngineBase {
       // data streams dies (single-stream failover, docs/DESIGN.md).
       c->nack_reader = std::make_unique<std::thread>(NackReaderLoop, c);
     }
+    return Status::Ok();
   }
 
   Status BuildRecvComm(PartialBundle& b, uint64_t* recv_comm) {
@@ -1425,6 +1664,9 @@ class BasicEngine : public EngineBase {
     comm->nstreams = b.nstreams;
     comm->min_chunksize = b.min_chunksize;
     comm->crc = (b.flags & kPreambleFlagCrc) != 0;
+    // Lane capability travels the same way (sender-wins): the receiver
+    // mirrors the weighted slot-table rotation and accepts WEIGHTS frames.
+    comm->lanes = (b.flags & kPreambleFlagLanes) != 0;
     // The traffic class travels the same way: the receiver accounts this
     // comm's bytes under the SENDER's class nibble.
     comm->cls = static_cast<TrafficClass>(PreambleClassOf(b.flags));
@@ -1446,7 +1688,11 @@ class BasicEngine : public EngineBase {
       comm->Shutdown();
       return ns;
     }
-    StartThreads(comm.get());
+    ns = StartThreads(comm.get());
+    if (!ns.ok()) {
+      comm->Shutdown();
+      return ns;
+    }
     uint64_t id = next_id_.fetch_add(1);
     recv_comms_.Put(id, comm);
     *recv_comm = id;

@@ -2,6 +2,7 @@
 // reference quirks deliberately fixed here (reference: src/lib.rs:19-392).
 #include "tpunet/c_api.h"
 
+#include <stdlib.h>
 #include <string.h>
 
 #include <algorithm>
@@ -17,6 +18,7 @@
 #include "tpunet/qos.h"
 #include "tpunet/telemetry.h"
 #include "tpunet/utils.h"
+#include "wire.h"
 
 namespace {
 
@@ -564,6 +566,69 @@ int32_t tpunet_c_serve_queue_depth(int32_t tier, uint64_t depth) {
   }
   tpunet::Telemetry::Get().OnServeQueueDepth(tier, depth);
   return TPUNET_OK;
+}
+
+int32_t tpunet_c_lane_parse(const char* spec, char* out, uint64_t cap) {
+  if ((!out && cap > 0) || !spec) return Fail(TPUNET_ERR_NULL, "null param");
+  std::vector<tpunet::LaneSpec> lanes;
+  Status s = tpunet::ParseLaneSpec(spec, &lanes);
+  if (!s.ok()) return FromStatus(s);
+  std::string text;
+  for (size_t i = 0; i < lanes.size(); ++i) {
+    text += "lane=" + std::to_string(i) + " addr=" +
+            (lanes[i].addr.empty() ? "-" : lanes[i].addr) +
+            " w=" + std::to_string(lanes[i].weight) + "\n";
+  }
+  if (cap > 0) {
+    uint64_t n = std::min<uint64_t>(text.size(), cap - 1);
+    memcpy(out, text.data(), n);
+    out[n] = '\0';
+  }
+  return static_cast<int32_t>(text.size());
+}
+
+int32_t tpunet_c_stripe_map(uint64_t len, uint64_t min_chunksize,
+                            const char* weights, uint64_t cursor, char* out,
+                            uint64_t cap) {
+  if ((!out && cap > 0) || !weights) return Fail(TPUNET_ERR_NULL, "null param");
+  if (min_chunksize == 0) return Fail(TPUNET_ERR_INVALID, "min_chunksize must be >= 1");
+  std::vector<uint32_t> w;
+  std::string tok;
+  std::string spec(weights);
+  for (size_t pos = 0; pos <= spec.size(); ++pos) {
+    if (pos < spec.size() && spec[pos] != ',') {
+      tok += spec[pos];
+      continue;
+    }
+    if (tok.empty()) return Fail(TPUNET_ERR_INVALID, "empty weight in list");
+    char* end = nullptr;
+    unsigned long v = strtoul(tok.c_str(), &end, 10);
+    if ((end && *end != '\0') || v < 1 || v > 255) {
+      return Fail(TPUNET_ERR_INVALID, "weight \"" + tok + "\" must be 1..255");
+    }
+    w.push_back(static_cast<uint32_t>(v));
+    tok.clear();
+  }
+  if (w.empty() || w.size() > 256) {
+    return Fail(TPUNET_ERR_INVALID, "weight list must name 1..256 streams");
+  }
+  // Exactly the engines' derivation: shared chunk math, then the WRR
+  // slot-table walk from the cursor (uniform weights degenerate to
+  // cursor % nstreams — the pre-lane rotation).
+  size_t csize = tpunet::ChunkSize(len, min_chunksize, w.size());
+  size_t nchunks = tpunet::ChunkCount(len, csize);
+  std::vector<uint8_t> slots = tpunet::BuildWrrSlots(w);
+  std::string text;
+  for (size_t i = 0; i < nchunks; ++i) {
+    if (i) text += ",";
+    text += std::to_string(slots[(cursor + i) % slots.size()]);
+  }
+  if (cap > 0) {
+    uint64_t n = std::min<uint64_t>(text.size(), cap - 1);
+    memcpy(out, text.data(), n);
+    out[n] = '\0';
+  }
+  return static_cast<int32_t>(text.size());
 }
 
 int32_t tpunet_c_qos_state(char* buf, uint64_t cap) {

@@ -35,6 +35,25 @@ class EngineBase : public Net {
     if (nstreams_ == 0) nstreams_ = 1;
     if (nstreams_ > kMaxStreams) nstreams_ = kMaxStreams;
     if (min_chunksize_ == 0) min_chunksize_ = 1;
+    // Lane striping (TPUNET_LANES; docs/DESIGN.md "Lanes & adaptive
+    // striping"): one lane == one data stream, so a lane spec overrides
+    // TPUNET_NSTREAMS with its lane count. A malformed spec warns and runs
+    // single-path — Config.from_env() is the loud gate (ValueError naming
+    // the var), matching the TPUNET_TRAFFIC_CLASS stance.
+    std::string lane_spec = GetEnv("TPUNET_LANES", "");
+    if (!lane_spec.empty()) {
+      Status ls = ParseLaneSpec(lane_spec, &lanes_);
+      if (!ls.ok()) {
+        fprintf(stderr, "[tpunet] ignoring TPUNET_LANES: %s\n", ls.msg.c_str());
+        lanes_.clear();
+      } else if (!lanes_.empty()) {
+        lane_mode_ = true;
+        nstreams_ = lanes_.size();
+        lane_adapt_ = GetEnvU64("TPUNET_LANE_ADAPT", 1) != 0;
+        lane_adapt_ms_ = GetEnvU64("TPUNET_LANE_ADAPT_MS", 100);
+        if (lane_adapt_ms_ == 0) lane_adapt_ms_ = 100;
+      }
+    }
     // Engine-default traffic class (every comm this engine CONNECTS carries
     // it; per-communicator overrides arrive via set_traffic_class before
     // wiring). Unknown names fall back to bulk with a stderr warning —
@@ -189,12 +208,29 @@ class EngineBase : public Net {
   // win on the far side, like nstreams/min_chunksize). Carries the QoS
   // traffic-class nibble so the receiver's comm adopts the sender's class.
   uint64_t PreambleFlags() const {
-    return (crc_ ? kPreambleFlagCrc : 0) | PreambleClassBits(traffic_class());
+    return (crc_ ? kPreambleFlagCrc : 0) | PreambleClassBits(traffic_class()) |
+           (lane_mode_ ? kPreambleFlagLanes : 0);
+  }
+
+  // Configured (base) lane weights; all-1 when TPUNET_LANES is unset.
+  std::vector<uint32_t> LaneBaseWeights() const {
+    std::vector<uint32_t> w(nstreams_, 1);
+    for (size_t i = 0; i < lanes_.size() && i < w.size(); ++i) {
+      w[i] = lanes_[i].weight;
+    }
+    return w;
   }
 
   std::vector<NicInfo> nics_;
   uint64_t nstreams_;
   uint64_t min_chunksize_;
+  // Lane striping (TPUNET_LANES): per-stream local bind addresses + base
+  // weights; lane_mode_ gates the preamble capability bit, the weighted
+  // scheduler, and the ctrl WEIGHTS epoch protocol in the engines.
+  std::vector<LaneSpec> lanes_;
+  bool lane_mode_ = false;
+  bool lane_adapt_ = true;       // TPUNET_LANE_ADAPT (lane mode only)
+  uint64_t lane_adapt_ms_ = 100; // TPUNET_LANE_ADAPT_MS adaptation tick
   bool crc_;              // TPUNET_CRC=1: per-chunk CRC32C trailers
   uint64_t watchdog_ms_;  // TPUNET_PROGRESS_TIMEOUT_MS (0 = off)
   std::atomic<int32_t> traffic_class_{1};  // TrafficClass int; default bulk

@@ -144,7 +144,35 @@ struct EComm {
   std::string fail_msg GUARDED_BY(mu);
   bool attached GUARDED_BY(mu) = false;
   std::atomic<uint64_t> queued{0};
+
+  // ---- Lane striping (docs/DESIGN.md "Lanes & adaptive striping") --------
+  // Mirror of the BASIC engine's lane state, all under `mu` (every IO and
+  // dispatch already runs under it): weighted slot-table rotation, epoch-
+  // stamped WEIGHTS ctrl units (send: queued as ctrl segments ahead of the
+  // LEN frame; recv: assembled by the wneed/wdone machine below), and the
+  // send-side adaptation accounting the loop's sendmsg passes feed.
+  bool lanes = false;
+  bool lane_adapt = false;
+  uint64_t lane_adapt_us = 100000;
+  std::vector<uint32_t> base_weights;
+  std::vector<uint32_t> weights GUARDED_BY(mu);
+  std::vector<uint8_t> slots GUARDED_BY(mu);
+  uint64_t stripe_epoch GUARDED_BY(mu) = 0;
+  uint64_t next_adapt_us GUARDED_BY(mu) = 0;
+  std::vector<uint64_t> lane_busy_us GUARDED_BY(mu);
+  std::vector<uint64_t> lane_bytes GUARDED_BY(mu);
+  std::vector<uint64_t> lane_rate_bps GUARDED_BY(mu);
+  // recv ctrl: in-flight WEIGHTS unit (weight bytes after the 8-byte frame).
+  uint8_t wbuf[256] GUARDED_BY(mu);
+  size_t wneed GUARDED_BY(mu) = 0;
+  size_t wdone GUARDED_BY(mu) = 0;
+  uint64_t wepoch GUARDED_BY(mu) = 0;
 };
+
+// Weight resolution of the adaptive stripe scheduler (same value as the
+// BASIC engine's kLaneWeightScale — the two engines must demote/recover to
+// identical vectors for cross-engine comms to behave the same).
+constexpr uint32_t kEpollLaneWeightScale = 16;
 
 struct Command {
   enum Kind { kAttach, kMsg, kClose, kStop } kind = kStop;
@@ -364,6 +392,18 @@ class Loop {
       FailCommLocked(c, "epoll registration failed: " + std::string(strerror(errno)));
       return;
     }
+    if (c->lanes && c->is_send && c->stripe_epoch == 0) {
+      // Publish the configured base weight vector as epoch 1 before any
+      // message can dispatch (kAttach precedes every kMsg in command
+      // order, and TryInline declines until `attached` flips below) — the
+      // first LEN frame already finds both sides on the same map.
+      c->weights = c->base_weights;
+      c->weights.resize(c->nstreams, 1);
+      c->stripe_epoch = 1;
+      c->slots = BuildWrrSlots(c->weights);
+      QueueWeightsSegmentLocked(c);
+      AdvanceFdLocked(c, &c->ctrl);
+    }
     c->attached = true;  // TryInline may take the fast path from here on
   }
 
@@ -436,6 +476,88 @@ class Loop {
 
   // ----- message start (comm mutex held) -----------------------------------
 
+  // Queue one WEIGHTS ctrl unit ([frame u64][w u8 x n]) ahead of whatever
+  // LEN frame follows — ctrl segments are FIFO on the fd, so the receiver
+  // re-stripes at exactly this message boundary. The unit carries a dummy
+  // RequestState (never polled; total stays unscheduled) purely so the
+  // shared segment-completion accounting needs no null-state branch.
+  static void QueueWeightsSegmentLocked(EComm* c) REQUIRES(c->mu) {
+    Segment seg;
+    size_t n = 8 + c->weights.size();
+    seg.owned.reset(new uint8_t[n]);
+    BuildWeightsUnit(c->stripe_epoch, c->weights, seg.owned.get());
+    seg.data = seg.owned.get();
+    seg.len = n;
+    seg.counts_bytes = false;
+    seg.state = std::make_shared<RequestState>();
+    c->ctrl.segs.push_back(std::move(seg));
+    for (size_t i = 0; i < c->weights.size(); ++i) {
+      Telemetry::Get().OnLaneWeight(i, c->weights[i]);
+    }
+  }
+
+  // Send-side adaptation tick (the EPOLL twin of BASIC's
+  // MaybeAdaptLanesLocked — same rate math, same targets, same geometric
+  // step; see that function for the policy commentary). Runs under c->mu at
+  // message starts; a changed vector bumps the epoch and queues the WEIGHTS
+  // unit, whose wire failure surfaces through the ordinary ctrl-fd failure
+  // path (FailComm).
+  static void MaybeAdaptLanesLocked(EComm* c) REQUIRES(c->mu) {
+    if (!c->lanes || !c->is_send || !c->lane_adapt) return;
+    uint64_t now = MonotonicUs();
+    if (now < c->next_adapt_us) return;
+    c->next_adapt_us = now + c->lane_adapt_us;
+    uint64_t rmax = 0;
+    bool moved = false;
+    for (size_t i = 0; i < c->nstreams; ++i) {
+      if (c->lane_bytes[i] > 0 && c->lane_busy_us[i] > 0) {
+        uint64_t inst = c->lane_bytes[i] * 8 * 1000000 / c->lane_busy_us[i];
+        c->lane_rate_bps[i] =
+            c->lane_rate_bps[i] == 0 ? inst : (c->lane_rate_bps[i] + inst) / 2;
+        Telemetry::Get().OnLaneRate(i, c->lane_rate_bps[i]);
+        moved = true;
+      }
+      c->lane_bytes[i] = 0;
+      c->lane_busy_us[i] = 0;
+      // Per-tick gauge re-export: survives a mid-run telemetry.reset()
+      // (see the BASIC twin for the rationale).
+      Telemetry::Get().OnLaneWeight(i, c->weights[i]);
+      if (c->lane_rate_bps[i] > rmax) rmax = c->lane_rate_bps[i];
+    }
+    if (!moved || rmax == 0) return;
+    bool changed = false;
+    for (size_t i = 0; i < c->nstreams; ++i) {
+      uint64_t ewma = c->lane_rate_bps[i];
+      uint32_t w = c->weights[i];
+      uint32_t target = w;
+      if (ewma > 0) {
+        target = static_cast<uint32_t>(
+            (kEpollLaneWeightScale * ewma + rmax / 2) / rmax);
+        if (target < 1) target = 1;
+        if (target > kEpollLaneWeightScale) target = kEpollLaneWeightScale;
+      }
+      if (Telemetry::Get().StreamStraggling(true, i)) {
+        uint32_t demoted = w > 1 ? w / 2 : 1;
+        if (demoted < target) target = demoted;
+      }
+      uint32_t next = w;
+      if (target > w) {
+        next = w + std::max<uint32_t>(1, (target - w) / 2);
+      } else if (target < w) {
+        next = w - std::max<uint32_t>(1, (w - target) / 2);
+      }
+      if (next != w) {
+        c->weights[i] = next;
+        changed = true;
+      }
+    }
+    if (!changed) return;
+    c->stripe_epoch += 1;
+    c->slots = BuildWrrSlots(c->weights);
+    Telemetry::Get().OnRestripe();
+    QueueWeightsSegmentLocked(c);
+  }
+
   void StartMsgLocked(EComm* c, uint8_t* data, size_t len, const RequestPtr& state)
       REQUIRES(c->mu) {
     if (c->failed) {
@@ -445,6 +567,7 @@ class Loop {
       return;
     }
     if (c->is_send) {
+      MaybeAdaptLanesLocked(c);
       // total = ctrl frame + chunks; the frame counts as a subtask so "done"
       // means every byte (incl. the frame) reached the kernel buffer.
       size_t csize = ChunkSize(len, c->min_chunksize, c->nstreams);
@@ -483,7 +606,13 @@ class Loop {
     size_t off = 0;
     for (size_t i = 0; i < nchunks; ++i) {
       size_t n = std::min(csize, len - off);
-      FdState* fs = c->streams[c->cursor % c->nstreams].get();
+      // Lane mode swaps the uniform rotation for the WRR slot table (same
+      // cursor discipline as BASIC's AssignStreamIdx; no failover here, so
+      // no retired-skip walk). Both derivations persist across messages.
+      size_t pick = (c->lanes && !c->slots.empty())
+                        ? c->slots[c->cursor % c->slots.size()]
+                        : c->cursor % c->nstreams;
+      FdState* fs = c->streams[pick].get();
       c->cursor += 1;  // persists across messages — fairness rotation
       Segment seg;
       seg.data = data + off;
@@ -607,6 +736,7 @@ class Loop {
       AdvanceRecvCtrlLocked(c);
       return;
     }
+    const bool lane_clock = c->lanes && c->is_send && !fs->is_ctrl;
     while (!fs->segs.empty()) {
       // Iovec cursor over the segment FIFO: gather every queued segment's
       // remaining payload + CRC trailer into ONE sendmsg/recvmsg, then walk
@@ -614,6 +744,9 @@ class Loop {
       // one syscall per partial segment move (plus one per trailer); this
       // pass moves as many whole segments as the kernel will take per
       // syscall — the tx half of the syscalls/MiB budget (docs/DESIGN.md).
+      // Lane mode additionally clocks each pass (fault gate + syscall) into
+      // the lane's service accounting — the adaptive scheduler's rate input.
+      uint64_t lane_t0 = lane_clock ? MonotonicUs() : 0;
       struct iovec iov[kIovBatch];
       int niov = 0;
       size_t want = 0;
@@ -674,6 +807,12 @@ class Loop {
       // Cursor walk: spread the moved bytes over the front segments,
       // completing (and popping) each one that fills.
       const uint64_t now = MonotonicUs();
+      if (lane_clock) {
+        size_t li = fs->stream_idx < c->nstreams ? fs->stream_idx : 0;
+        uint64_t dt = now - lane_t0;
+        c->lane_busy_us[li] += dt ? dt : 1;
+        c->lane_bytes[li] += static_cast<uint64_t>(m);  // wire bytes: rate input
+      }
       size_t moved = static_cast<size_t>(m);
       while (moved > 0 && !fs->segs.empty()) {
         Segment& seg = fs->segs.front();
@@ -684,6 +823,10 @@ class Loop {
             Telemetry::Get().OnStreamBytes(c->is_send, fs->stream_idx,
                                            static_cast<uint64_t>(take),
                                            static_cast<int>(c->cls));
+            if (c->lanes) {
+              Telemetry::Get().OnLaneBytes(c->is_send, fs->stream_idx,
+                                           static_cast<uint64_t>(take));
+            }
           }
           seg.done += take;
           moved -= take;
@@ -712,10 +855,53 @@ class Loop {
     WantIOLocked(c, fs);
   }
 
+  // Apply a fully-assembled WEIGHTS unit (recv side; see BASIC's
+  // ProcessWeightsFrameLocked for the protocol commentary). Returns false
+  // after failing the comm on a desync.
+  bool ApplyWeightsLocked(EComm* c) REQUIRES(c->mu) {
+    for (size_t i = 0; i < c->wneed; ++i) {
+      if (c->wbuf[i] == 0) {
+        FailCommLocked(c, "WEIGHTS frame carries a zero weight (protocol desync)");
+        return false;
+      }
+      c->weights[i] = c->wbuf[i];
+      Telemetry::Get().OnLaneWeight(i, c->wbuf[i]);
+    }
+    bool initial = c->stripe_epoch == 0;
+    c->stripe_epoch = c->wepoch;
+    c->slots = BuildWrrSlots(c->weights);
+    if (!initial) Telemetry::Get().OnRestripe();
+    c->wneed = 0;
+    c->wdone = 0;
+    return true;
+  }
+
   void AdvanceRecvCtrlLocked(EComm* c) REQUIRES(c->mu) {
     FdState* fs = &c->ctrl;
     bool dispatched = false;
     while (!c->pending.empty()) {
+      // In-flight WEIGHTS unit: finish its weight bytes before any further
+      // frame — the ctrl stream is one FIFO and the next LEN's message must
+      // be laid out on the NEW vector.
+      if (c->wneed > 0) {
+        CountIoSyscall(kIoRecv);
+        ssize_t wm = ::recv(fs->fd, c->wbuf + c->wdone, c->wneed - c->wdone,
+                            MSG_DONTWAIT);
+        if (wm > 0) {
+          c->wdone += static_cast<size_t>(wm);
+          if (c->wdone < c->wneed) continue;
+          if (!ApplyWeightsLocked(c)) return;
+          continue;
+        }
+        if (wm == 0) {
+          FailCommLocked(c, "peer closed ctrl stream");
+          return;
+        }
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        if (errno == EINTR) continue;
+        FailCommLocked(c, std::string("ctrl recv failed: ") + strerror(errno));
+        return;
+      }
       CountIoSyscall(kIoRecv);
       ssize_t m = ::recv(fs->fd, c->hdr + c->hdr_done, 8 - c->hdr_done, MSG_DONTWAIT);
       if (m > 0) {
@@ -723,6 +909,20 @@ class Loop {
         if (c->hdr_done < 8) continue;
         c->hdr_done = 0;
         uint64_t target = DecodeU64BE(c->hdr);
+        if ((target >> 56) == kCtrlFrameWeights) {
+          uint64_t count = WeightsFrameCount(target);
+          uint64_t epoch = WeightsFrameEpoch(target);
+          if (!c->lanes || count != c->nstreams || count == 0 ||
+              epoch <= c->stripe_epoch) {
+            FailCommLocked(c, "WEIGHTS frame in an impossible state "
+                              "(protocol desync)");
+            return;
+          }
+          c->wneed = static_cast<size_t>(count);
+          c->wdone = 0;
+          c->wepoch = epoch;
+          continue;
+        }
         PendingRecv pr = c->pending.front();
         c->pending.pop_front();
         if (target > pr.len) {
@@ -873,11 +1073,11 @@ class EpollEngine : public EngineBase {
     std::vector<int> data_fds;
     int ctrl_fd = -1;
     Status s = ConnectBundle(nics_, dev, handle, nstreams_, min_chunksize_, PreambleFlags(),
-                             &data_fds, &ctrl_fd);
+                             &data_fds, &ctrl_fd, lane_mode_ ? &lanes_ : nullptr);
     if (!s.ok()) return s;
     return AttachComm(true, nstreams_, min_chunksize_, crc_,
-                      static_cast<TrafficClass>(traffic_class()), ctrl_fd,
-                      data_fds, send_comm, &send_comms_);
+                      static_cast<TrafficClass>(traffic_class()), lane_mode_,
+                      ctrl_fd, data_fds, send_comm, &send_comms_);
   }
 
   Status accept(uint64_t listen_comm, uint64_t* recv_comm) override {
@@ -894,6 +1094,7 @@ class EpollEngine : public EngineBase {
     // traffic-class nibble travels the same way (rx accounting).
     return AttachComm(false, b.nstreams, b.min_chunksize, (b.flags & kPreambleFlagCrc) != 0,
                       static_cast<TrafficClass>(PreambleClassOf(b.flags)),
+                      (b.flags & kPreambleFlagLanes) != 0,
                       ctrl_fd, data_fds, recv_comm, &recv_comms_);
   }
 
@@ -952,7 +1153,8 @@ class EpollEngine : public EngineBase {
 
  private:
   Status AttachComm(bool is_send, uint64_t nstreams, uint64_t min_chunksize, bool crc,
-                    TrafficClass cls, int ctrl_fd, const std::vector<int>& data_fds,
+                    TrafficClass cls, bool lanes, int ctrl_fd,
+                    const std::vector<int>& data_fds,
                     uint64_t* out_id, IdMap<CommHandle>* map) {
     auto comm = std::make_shared<EComm>();
     comm->is_send = is_send;
@@ -960,6 +1162,19 @@ class EpollEngine : public EngineBase {
     comm->min_chunksize = min_chunksize;
     comm->crc = crc;
     comm->cls = cls;
+    comm->lanes = lanes;
+    if (lanes) {
+      comm->lane_adapt = is_send && lane_adapt_;
+      comm->lane_adapt_us = lane_adapt_ms_ * 1000;
+      comm->base_weights = LaneBaseWeights();
+      // Pre-attach, single-owner: the lock satisfies the TSA contract.
+      MutexLock lk(comm->mu);
+      comm->weights.assign(nstreams, 1);
+      comm->slots = BuildWrrSlots(comm->weights);
+      comm->lane_busy_us.assign(nstreams, 0);
+      comm->lane_bytes.assign(nstreams, 0);
+      comm->lane_rate_bps.assign(nstreams, 0);
+    }
     comm->ctrl.fd = ctrl_fd;
     comm->ctrl.is_ctrl = true;
     comm->ctrl.comm = comm.get();

@@ -130,6 +130,7 @@ struct StreamTcpState {
   std::atomic<uint64_t> retrans_total{0};
   std::atomic<uint64_t> cwnd{0};
   std::atomic<uint64_t> delivery_rate_bps{0};
+  std::atomic<uint64_t> min_rtt_us{0};  // tcpi_min_rtt (per-path RTT floor)
   std::atomic<uint8_t> sampled{0};
   std::atomic<uint8_t> straggling{0};  // hysteresis: count rising edges only
 };
@@ -231,6 +232,17 @@ struct Telemetry::Impl {
   StreamTcpState tcp_tx[kMaxStreamStats];
   StreamTcpState tcp_rx[kMaxStreamStats];
   std::atomic<uint64_t> straggler_events{0};
+
+  // Lane-striping state (docs/DESIGN.md "Lanes & adaptive striping"): the
+  // stripe scheduler's current per-lane weight / measured service rate
+  // (last writer wins across comms), per-lane payload bytes, and published
+  // weight-vector epochs. lane_weight 0 = "no lane-mode comm ever reported
+  // this slot" (lane weights themselves have floor 1), which is the emit
+  // gate for the gauge families.
+  std::atomic<uint64_t> lane_weight[kMaxStreamStats] = {};
+  std::atomic<uint64_t> lane_rate_bps[kMaxStreamStats] = {};
+  std::atomic<uint64_t> lane_bytes[kMaxStreamStats][2] = {};
+  std::atomic<uint64_t> restripe_events{0};
 
   // Fairness window (win_mu): Jain's index over per-stream byte deltas
   // between rolls. Rolled lazily from Snapshot() at most once per
@@ -557,6 +569,9 @@ void Telemetry::MaybeSampleStream(bool is_send, uint64_t stream_idx, int fd) {
   if (len >= offsetof(TcpInfoCompat, delivery_rate) + sizeof(uint64_t)) {
     slot.delivery_rate_bps.store(ti.delivery_rate * 8, std::memory_order_relaxed);
   }
+  if (len >= offsetof(TcpInfoCompat, min_rtt) + sizeof(uint32_t)) {
+    slot.min_rtt_us.store(ti.min_rtt, std::memory_order_relaxed);
+  }
   slot.sampled.store(1, std::memory_order_relaxed);
 
   // Straggler check: this stream's smoothed RTT vs the median across the
@@ -595,6 +610,32 @@ void Telemetry::MaybeSampleStream(bool is_send, uint64_t stream_idx, int fd) {
   } else {
     slot.straggling.store(0, std::memory_order_relaxed);
   }
+}
+
+bool Telemetry::StreamStraggling(bool is_send, uint64_t stream_idx) const {
+  if (stream_idx >= kMaxStreamStats) stream_idx = kMaxStreamStats - 1;
+  const StreamTcpState* slots = is_send ? impl_->tcp_tx : impl_->tcp_rx;
+  return slots[stream_idx].straggling.load(std::memory_order_relaxed) != 0;
+}
+
+void Telemetry::OnLaneWeight(uint64_t lane, uint64_t weight) {
+  if (lane >= kMaxStreamStats) lane = kMaxStreamStats - 1;
+  impl_->lane_weight[lane].store(weight, std::memory_order_relaxed);
+}
+
+void Telemetry::OnLaneRate(uint64_t lane, uint64_t bps) {
+  if (lane >= kMaxStreamStats) lane = kMaxStreamStats - 1;
+  impl_->lane_rate_bps[lane].store(bps, std::memory_order_relaxed);
+}
+
+void Telemetry::OnLaneBytes(bool is_send, uint64_t lane, uint64_t nbytes) {
+  if (lane >= kMaxStreamStats) lane = kMaxStreamStats - 1;
+  impl_->lane_bytes[lane][is_send ? 0 : 1].fetch_add(nbytes,
+                                                     std::memory_order_relaxed);
+}
+
+void Telemetry::OnRestripe() {
+  impl_->restripe_events.fetch_add(1, std::memory_order_relaxed);
 }
 
 void Telemetry::OnRequestStages(uint64_t post_us, uint64_t first_wire_us,
@@ -690,11 +731,17 @@ void Telemetry::Reset() {
       slots[i].retrans_total.store(0, std::memory_order_relaxed);
       slots[i].cwnd.store(0, std::memory_order_relaxed);
       slots[i].delivery_rate_bps.store(0, std::memory_order_relaxed);
+      slots[i].min_rtt_us.store(0, std::memory_order_relaxed);
       slots[i].sampled.store(0, std::memory_order_relaxed);
       slots[i].straggling.store(0, std::memory_order_relaxed);
       slots[i].next_sample_us.store(0, std::memory_order_relaxed);
     }
+    im->lane_weight[i].store(0, std::memory_order_relaxed);
+    im->lane_rate_bps[i].store(0, std::memory_order_relaxed);
+    im->lane_bytes[i][0].store(0, std::memory_order_relaxed);
+    im->lane_bytes[i][1].store(0, std::memory_order_relaxed);
   }
+  im->restripe_events.store(0, std::memory_order_relaxed);
   for (int i = 0; i < kFaultActionSlots; ++i) {
     im->faults_injected[i].store(0, std::memory_order_relaxed);
   }
@@ -805,8 +852,14 @@ MetricsSnapshot Telemetry::Snapshot() const {
       out[i].cwnd = slots[i].cwnd.load(std::memory_order_relaxed);
       out[i].delivery_rate_bps =
           slots[i].delivery_rate_bps.load(std::memory_order_relaxed);
+      out[i].min_rtt_us = slots[i].min_rtt_us.load(std::memory_order_relaxed);
     }
+    s.lane_weight[i] = im->lane_weight[i].load(std::memory_order_relaxed);
+    s.lane_rate_bps[i] = im->lane_rate_bps[i].load(std::memory_order_relaxed);
+    s.lane_bytes[i][0] = im->lane_bytes[i][0].load(std::memory_order_relaxed);
+    s.lane_bytes[i][1] = im->lane_bytes[i][1].load(std::memory_order_relaxed);
   }
+  s.restripe_events = im->restripe_events.load(std::memory_order_relaxed);
   s.straggler_events = im->straggler_events.load(std::memory_order_relaxed);
   s.isend_count = im->isend_count.load(std::memory_order_relaxed);
   s.irecv_count = im->irecv_count.load(std::memory_order_relaxed);
@@ -936,6 +989,12 @@ std::string Telemetry::PrometheusText() const {
       {"tpunet_stream_delivery_rate_bps", "gauge",
        "TCP delivery rate per data stream (tcpi_delivery_rate, bits/s; 0 on old kernels).",
        &StreamTcpSample::delivery_rate_bps},
+      {"tpunet_stream_min_rtt_us", "gauge",
+       "TCP minimum observed round-trip time per data stream (tcpi_min_rtt, "
+       "microseconds; 0 on old kernels) — the per-path RTT floor the "
+       "straggler detector's static TPUNET_STRAGGLER_MIN_RTT_US knob "
+       "approximates.",
+       &StreamTcpSample::min_rtt_us},
   };
   for (const TcpGaugeDef& g : kTcpGauges) {
     family(g.name, g.type, g.help);
@@ -1008,6 +1067,42 @@ std::string Telemetry::PrometheusText() const {
          "(TPUNET_STRAGGLER_FACTOR).");
   emit("tpunet_straggler_events_total{rank=\"%lld\"} %llu\n", (long long)rank,
        (unsigned long long)s.straggler_events);
+  // Lane-striping families (docs/DESIGN.md "Lanes & adaptive striping").
+  // Gauges emit only for lanes a lane-mode comm has reported (weight floor
+  // is 1, so weight 0 means "slot never used"); the bytes counter emits
+  // only nonzero cells like the per-stream byte counters.
+  family("tpunet_lane_weight", "gauge",
+         "Current stripe weight per lane in the weighted chunk scheduler "
+         "(TPUNET_LANES; floor 1, demoted lanes decay toward it).");
+  for (int i = 0; i < kMaxStreamStats; ++i) {
+    if (s.lane_weight[i] == 0) continue;
+    emit("tpunet_lane_weight{rank=\"%lld\",lane=\"%d\"} %llu\n", (long long)rank, i,
+         (unsigned long long)s.lane_weight[i]);
+  }
+  family("tpunet_lane_rate_bps", "gauge",
+         "Measured per-lane delivery rate the stripe weights chase (EWMA of "
+         "payload bytes over wire-service time, bits/s).");
+  for (int i = 0; i < kMaxStreamStats; ++i) {
+    if (s.lane_rate_bps[i] == 0) continue;
+    emit("tpunet_lane_rate_bps{rank=\"%lld\",lane=\"%d\"} %llu\n", (long long)rank, i,
+         (unsigned long long)s.lane_rate_bps[i]);
+  }
+  family("tpunet_lane_bytes_total", "counter",
+         "Payload bytes moved per lane and direction on lane-mode comms "
+         "(the byte-share convergence signal).");
+  for (int d = 0; d < 2; ++d) {
+    for (int i = 0; i < kMaxStreamStats; ++i) {
+      if (s.lane_bytes[i][d] == 0) continue;
+      emit("tpunet_lane_bytes_total{rank=\"%lld\",lane=\"%d\",dir=\"%s\"} %llu\n",
+           (long long)rank, i, d == 0 ? "tx" : "rx",
+           (unsigned long long)s.lane_bytes[i][d]);
+    }
+  }
+  family("tpunet_restripe_events_total", "counter",
+         "Weight-vector epochs published by the adaptive stripe scheduler "
+         "(each re-stripes subsequent messages on both sides).");
+  emit("tpunet_restripe_events_total{rank=\"%lld\"} %llu\n", (long long)rank,
+       (unsigned long long)s.restripe_events);
   // Request stage-latency histograms: queueing delay separable from wire time.
   auto stage_hist = [&](const char* name, const char* help, const StageHist& h) {
     family(name, "histogram", help);

@@ -204,6 +204,30 @@ size_t ChunkCount(size_t total, size_t chunksize) {
   return (total + chunksize - 1) / chunksize;
 }
 
+std::vector<uint8_t> BuildWrrSlots(const std::vector<uint32_t>& weights) {
+  std::vector<uint8_t> slots;
+  if (weights.empty()) return slots;
+  const size_t n = weights.size();
+  std::vector<int64_t> credit(n, 0);
+  std::vector<int64_t> w(n);
+  int64_t total = 0;
+  for (size_t i = 0; i < n; ++i) {
+    w[i] = weights[i] == 0 ? 1 : static_cast<int64_t>(weights[i]);
+    total += w[i];
+  }
+  slots.reserve(static_cast<size_t>(total));
+  for (int64_t s = 0; s < total; ++s) {
+    size_t pick = 0;
+    for (size_t i = 0; i < n; ++i) {
+      credit[i] += w[i];
+      if (credit[i] > credit[pick]) pick = i;  // ties -> lowest index
+    }
+    credit[pick] -= total;
+    slots.push_back(static_cast<uint8_t>(pick));
+  }
+  return slots;
+}
+
 namespace {
 std::atomic<uint64_t> g_io_syscalls[kIoOpCount] = {};
 }  // namespace

@@ -8,6 +8,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
+#include <stdlib.h>
 #include <string.h>
 #include <sys/eventfd.h>
 #include <sys/socket.h>
@@ -241,23 +242,131 @@ Status AcceptBundle(ListenSock* lc, PartialBundle* out) {
   }
 }
 
+Status ParseLaneSpec(const std::string& spec, std::vector<LaneSpec>* out) {
+  out->clear();
+  size_t pos = 0;
+  while (pos <= spec.size()) {
+    size_t comma = spec.find(',', pos);
+    std::string lane_tok = spec.substr(
+        pos, comma == std::string::npos ? std::string::npos : comma - pos);
+    pos = comma == std::string::npos ? spec.size() + 1 : comma + 1;
+    if (lane_tok.empty()) {
+      if (comma == std::string::npos && out->empty() && spec.empty()) break;
+      return Status::Invalid("TPUNET_LANES: empty lane entry in \"" + spec + "\"");
+    }
+    LaneSpec lane;
+    size_t lp = 0;
+    while (lp <= lane_tok.size()) {
+      // Clause separator is ':' at bracket depth 0 — IPv6 literals ride in
+      // brackets ("addr=[fe80::1]:w=2") so their colons don't split.
+      size_t colon = std::string::npos;
+      int depth = 0;
+      for (size_t i = lp; i < lane_tok.size(); ++i) {
+        if (lane_tok[i] == '[') ++depth;
+        else if (lane_tok[i] == ']') --depth;
+        else if (lane_tok[i] == ':' && depth == 0) {
+          colon = i;
+          break;
+        }
+      }
+      std::string kv = lane_tok.substr(
+          lp, colon == std::string::npos ? std::string::npos : colon - lp);
+      lp = colon == std::string::npos ? lane_tok.size() + 1 : colon + 1;
+      if (kv.empty()) {
+        return Status::Invalid("TPUNET_LANES: empty clause in lane \"" + lane_tok + "\"");
+      }
+      size_t eq = kv.find('=');
+      if (eq == std::string::npos) {
+        return Status::Invalid("TPUNET_LANES: clause \"" + kv + "\" is not key=value");
+      }
+      std::string key = kv.substr(0, eq);
+      std::string val = kv.substr(eq + 1);
+      if (key == "addr") {
+        if (val.size() >= 2 && val.front() == '[' && val.back() == ']') {
+          val = val.substr(1, val.size() - 2);  // bracketed IPv6 literal
+        }
+        // Validate now so a typo'd address fails at parse, not mid-connect.
+        unsigned char scratch[sizeof(in6_addr)];
+        if (val.empty() || (inet_pton(AF_INET, val.c_str(), scratch) != 1 &&
+                            inet_pton(AF_INET6, val.c_str(), scratch) != 1)) {
+          return Status::Invalid("TPUNET_LANES: \"" + val +
+                                 "\" is not an IPv4/IPv6 address");
+        }
+        lane.addr = val;
+      } else if (key == "w") {
+        char* end = nullptr;
+        unsigned long w = val.empty() ? 0 : strtoul(val.c_str(), &end, 10);
+        if (val.empty() || (end && *end != '\0') || w < 1 || w > kMaxLaneWeight) {
+          return Status::Invalid("TPUNET_LANES: weight \"" + val + "\" must be 1.." +
+                                 std::to_string(kMaxLaneWeight));
+        }
+        lane.weight = static_cast<uint32_t>(w);
+      } else {
+        return Status::Invalid("TPUNET_LANES: unknown key \"" + key + "\"");
+      }
+    }
+    out->push_back(std::move(lane));
+  }
+  if (out->size() > kMaxStreams) {
+    return Status::Invalid("TPUNET_LANES: " + std::to_string(out->size()) +
+                           " lanes exceeds the stream cap of " +
+                           std::to_string(kMaxStreams));
+  }
+  return Status::Ok();
+}
+
 namespace {
 
+// Resolve a lane's local bind address string into a sockaddr (port 0).
+bool LaneBindAddr(const std::string& addr, sockaddr_storage* ss, socklen_t* len) {
+  memset(ss, 0, sizeof(*ss));
+  auto* v4 = reinterpret_cast<sockaddr_in*>(ss);
+  auto* v6 = reinterpret_cast<sockaddr_in6*>(ss);
+  if (inet_pton(AF_INET, addr.c_str(), &v4->sin_addr) == 1) {
+    v4->sin_family = AF_INET;
+    *len = sizeof(sockaddr_in);
+    return true;
+  }
+  if (inet_pton(AF_INET6, addr.c_str(), &v6->sin6_addr) == 1) {
+    v6->sin6_family = AF_INET6;
+    *len = sizeof(sockaddr_in6);
+    return true;
+  }
+  return false;
+}
+
 Status ConnectOneAttempt(const std::vector<NicInfo>& nics, int32_t dev,
-                         const SocketHandle& handle, int* out_fd, int* conn_errno) {
+                         const SocketHandle& handle, const std::string& lane_addr,
+                         int* out_fd, int* conn_errno) {
   int fd = -1;
   Status s = MakeSocket(handle.addr.ss_family, &fd);
   if (!s.ok()) return s;
-  // Route out of the chosen NIC when address families line up.
-  const NicInfo& nic = nics[dev];
-  if (nic.addr.ss_family == handle.addr.ss_family && nic.name != "lo") {
-    sockaddr_storage local = nic.addr;
-    if (local.ss_family == AF_INET) {
-      reinterpret_cast<sockaddr_in*>(&local)->sin_port = 0;
-    } else {
-      reinterpret_cast<sockaddr_in6*>(&local)->sin6_port = 0;
+  if (!lane_addr.empty()) {
+    // Lane-pinned local address (docs/DESIGN.md "Lanes & adaptive
+    // striping"): the bind selects the egress path (NIC / source-routed
+    // table) this data stream rides. Unlike the best-effort NIC bind below,
+    // a failed lane bind is a hard error — silently collapsing two lanes
+    // onto one path would fake the aggregation the operator configured.
+    sockaddr_storage local;
+    socklen_t llen = 0;
+    if (!LaneBindAddr(lane_addr, &local, &llen) ||
+        ::bind(fd, reinterpret_cast<sockaddr*>(&local), llen) != 0) {
+      ::close(fd);
+      return Status::TCP("lane bind to " + lane_addr +
+                         " failed: " + std::string(strerror(errno)));
     }
-    ::bind(fd, reinterpret_cast<sockaddr*>(&local), nic.addrlen);  // best effort
+  } else {
+    // Route out of the chosen NIC when address families line up.
+    const NicInfo& nic = nics[dev];
+    if (nic.addr.ss_family == handle.addr.ss_family && nic.name != "lo") {
+      sockaddr_storage local = nic.addr;
+      if (local.ss_family == AF_INET) {
+        reinterpret_cast<sockaddr_in*>(&local)->sin_port = 0;
+      } else {
+        reinterpret_cast<sockaddr_in6*>(&local)->sin6_port = 0;
+      }
+      ::bind(fd, reinterpret_cast<sockaddr*>(&local), nic.addrlen);  // best effort
+    }
   }
   // addrlen is derived from the family, not trusted from the handle: a
   // handle marshaled through the 64-byte wire blob (C ABI / ncclNet shim)
@@ -304,7 +413,7 @@ Status ConnectOneAttempt(const std::vector<NicInfo>& nics, int32_t dev,
 // The reference had no retry anywhere (SURVEY §5: "no retries, timeouts");
 // this is the transient-rendezvous hardening VERDICT r1 asked for.
 Status ConnectOne(const std::vector<NicInfo>& nics, int32_t dev, const SocketHandle& handle,
-                  int* out_fd) {
+                  const std::string& lane_addr, int* out_fd) {
   // Read per call, not statically cached: connects are rare, and callers
   // (tests, restart logic) legitimately adjust the window at runtime.
   const uint64_t window_ms = GetEnvU64("TPUNET_CONNECT_RETRY_MS", 10000);
@@ -312,7 +421,7 @@ Status ConnectOne(const std::vector<NicInfo>& nics, int32_t dev, const SocketHan
   uint64_t backoff_ms = 50;
   while (true) {
     int cerr = 0;
-    Status s = ConnectOneAttempt(nics, dev, handle, out_fd, &cerr);
+    Status s = ConnectOneAttempt(nics, dev, handle, lane_addr, out_fd, &cerr);
     if (s.ok()) return s;
     bool transient = cerr == ECONNREFUSED || cerr == ETIMEDOUT || cerr == ECONNRESET ||
                      cerr == EHOSTUNREACH || cerr == ENETUNREACH || cerr == EAGAIN;
@@ -329,7 +438,8 @@ Status ConnectOne(const std::vector<NicInfo>& nics, int32_t dev, const SocketHan
 
 Status ConnectBundle(const std::vector<NicInfo>& nics, int32_t dev, const SocketHandle& handle,
                      uint64_t nstreams, uint64_t min_chunksize, uint64_t flags,
-                     std::vector<int>* data_fds, int* ctrl_fd) {
+                     std::vector<int>* data_fds, int* ctrl_fd,
+                     const std::vector<LaneSpec>* lanes) {
   uint64_t bundle = RandomBundleId();
   auto cleanup = [&]() {
     for (int fd : *data_fds) ::close(fd);
@@ -337,12 +447,17 @@ Status ConnectBundle(const std::vector<NicInfo>& nics, int32_t dev, const Socket
     if (*ctrl_fd >= 0) ::close(*ctrl_fd);
     *ctrl_fd = -1;
   };
+  static const std::string kNoLaneAddr;
   // nstreams data connections, each introducing itself with its stream id
   // (reference: nthread:313-327), then the ctrl connection with
-  // stream_id == nstreams (reference: nthread:366-380).
+  // stream_id == nstreams (reference: nthread:366-380). In lane mode each
+  // data stream binds its lane's local address; ctrl stays on the default
+  // path (it must survive any single lane's death).
   for (uint64_t sid = 0; sid <= nstreams; ++sid) {
+    const std::string& lane_addr =
+        (lanes && sid < lanes->size()) ? (*lanes)[sid].addr : kNoLaneAddr;
     int fd = -1;
-    Status s = ConnectOne(nics, dev, handle, &fd);
+    Status s = ConnectOne(nics, dev, handle, lane_addr, &fd);
     if (!s.ok()) {
       cleanup();
       return s;

@@ -49,6 +49,14 @@ constexpr uint64_t kPreambleFlagCrc = 1ull << 0;
 // chunk on the connection under this nibble. Peers without the flag (older
 // builds) default to the bulk class.
 constexpr uint64_t kPreambleFlagQos = 1ull << 1;
+// Lane capability (docs/DESIGN.md "Lanes & adaptive striping"): the sender
+// runs the weighted stripe scheduler and may publish weight-vector epochs
+// over the ctrl stream (kCtrlFrameWeights). Advertised ONLY when lanes are
+// actually configured (TPUNET_LANES) so the default single-path config
+// stays byte-identical on the wire to pre-lane builds. Sender-wins like
+// nstreams: a receiver seeing the bit switches both its chunk->stream
+// derivation and its ctrl-frame vocabulary to the lane protocol.
+constexpr uint64_t kPreambleFlagLanes = 1ull << 2;
 constexpr int kPreambleClassShift = 8;
 constexpr uint64_t kPreambleClassMask = 0xFull << kPreambleClassShift;
 
@@ -79,8 +87,18 @@ inline int32_t PreambleClassOf(uint64_t flags) {
 //         at) and then count units of [seq u64 | len u64 | payload |
 //         crc32c u32 when negotiated]. From this point in ctrl order both
 //         sides drop the stream from the chunk-assignment rotation.
+//   0xFC  WEIGHTS epoch (sender -> receiver, lane mode only): bits 32..47
+//         carry the stream count (must equal the comm's nstreams — a
+//         mismatch is a protocol desync), bits 0..31 the strictly-
+//         increasing stripe epoch; followed on the ctrl stream by one u8
+//         weight (1..255) per stream. From this point in ctrl order both
+//         sides derive chunk->stream layout from the NEW weight vector —
+//         re-striping lands only at message boundaries because the frame is
+//         emitted under the same lock (and so the same total order) as
+//         message length frames.
 constexpr uint8_t kCtrlFrameNack = 0xFD;
 constexpr uint8_t kCtrlFrameFailover = 0xFE;
+constexpr uint8_t kCtrlFrameWeights = 0xFC;
 // Lengths at or above this collide with the control-frame namespace; no
 // real message gets near 2^56 bytes.
 constexpr uint64_t kMaxCtrlLen = 1ull << 56;
@@ -88,6 +106,29 @@ constexpr uint64_t kMaxCtrlLen = 1ull << 56;
 inline uint64_t PackCtrlFrame(uint8_t type, uint64_t stream, uint64_t arg) {
   return (static_cast<uint64_t>(type) << 56) | ((stream & 0xff) << 48) |
          (arg & 0xffffffffffffull);
+}
+
+// WEIGHTS frame layout (the 8-bit stream field of PackCtrlFrame cannot hold
+// kMaxStreams == 256, so the count rides bits 32..47 instead).
+inline uint64_t PackWeightsFrame(uint64_t nstreams, uint64_t epoch) {
+  return (static_cast<uint64_t>(kCtrlFrameWeights) << 56) |
+         ((nstreams & 0xffff) << 32) | (epoch & 0xffffffff);
+}
+inline uint64_t WeightsFrameCount(uint64_t frame) { return (frame >> 32) & 0xffff; }
+inline uint64_t WeightsFrameEpoch(uint64_t frame) { return frame & 0xffffffff; }
+
+// Serialize one WEIGHTS ctrl unit ([frame u64][w u8 x n]) into buf, which
+// must hold 8 + weights.size() bytes. Returns the unit length.
+inline size_t BuildWeightsUnit(uint64_t epoch, const std::vector<uint32_t>& weights,
+                               uint8_t* buf) {
+  EncodeU64BE(PackWeightsFrame(weights.size(), epoch), buf);
+  for (size_t i = 0; i < weights.size(); ++i) {
+    uint32_t w = weights[i];
+    if (w < 1) w = 1;
+    if (w > 255) w = 255;
+    buf[8 + i] = static_cast<uint8_t>(w);
+  }
+  return 8 + weights.size();
 }
 
 // 4-byte big-endian CRC32C chunk trailer (TPUNET_CRC=1, negotiated via
@@ -275,13 +316,33 @@ void WakeListen(ListenSock* ls);
 // whole; expires half-arrived bundles from dead senders. Blocks.
 Status AcceptBundle(ListenSock* ls, PartialBundle* out);
 
+// One lane of a multi-path comm (docs/DESIGN.md "Lanes & adaptive
+// striping"): an optional LOCAL address data-stream sockets bind to before
+// connecting (multi-NIC / policy-routed paths; empty = kernel default) plus
+// the lane's configured stripe weight. Parsed from TPUNET_LANES
+// ("addr=10.0.0.1:w=4,addr=10.0.1.1:w=1"; a lane may omit either key —
+// "w=4" alone weights the default path). One lane == one data stream.
+struct LaneSpec {
+  std::string addr;     // local bind address, empty = unbound
+  uint32_t weight = 1;  // 1..255
+};
+constexpr uint32_t kMaxLaneWeight = 255;
+
+// Parse a TPUNET_LANES spec; Invalid status naming the offending token on
+// malformed input. Pure — no global state touched.
+Status ParseLaneSpec(const std::string& spec, std::vector<LaneSpec>* out);
+
 // Open the nstreams+1 connection bundle to a remote handle, writing each
 // preamble (flags advertises sender-side options, e.g. kPreambleFlagCrc).
 // On success data_fds holds nstreams stream-ordered connections and ctrl_fd
-// the ctrl connection; all blocking, TCP_NODELAY set.
+// the ctrl connection; all blocking, TCP_NODELAY set. `lanes` (nullable;
+// else size == nstreams) supplies per-data-stream local bind addresses —
+// stream i routes out of lanes[i].addr when set (the ctrl connection always
+// uses the default path: it must survive any single lane's death).
 Status ConnectBundle(const std::vector<NicInfo>& nics, int32_t dev, const SocketHandle& handle,
                      uint64_t nstreams, uint64_t min_chunksize, uint64_t flags,
-                     std::vector<int>* data_fds, int* ctrl_fd);
+                     std::vector<int>* data_fds, int* ctrl_fd,
+                     const std::vector<LaneSpec>* lanes = nullptr);
 
 }  // namespace tpunet
 

@@ -443,3 +443,83 @@ def test_chaos_crc_codec_matrix(action, codec):
         assert status.startswith(("OK", "TYPED")), f"rank {rank}: {status}"
     if action == "corrupt":
         assert f"code={_native.TPUNET_ERR_CORRUPT}" in statuses, statuses
+
+
+# ---------------------------------------------------------------------------
+# Chaos matrix x lanes: lane death under the weighted stripe scheduler.
+
+
+@pytest.mark.parametrize("action", ["close", "stall"])
+def test_chaos_lane_death_fails_over_and_restripes(monkeypatch, action):
+    """Weighted lane mode under lane death (docs/DESIGN.md "Lanes &
+    adaptive striping"): killing the HEAVY lane mid-transfer must ride the
+    PR 1 ctrl-retransmit failover and re-stripe every subsequent message
+    onto the survivor, bit-correct under CRC; a stalled lane must surface
+    the typed watchdog verdict within a bounded wait. Never a hang, never a
+    silent wrong answer — same contract as the uniform chaos matrix."""
+    from tpunet import telemetry
+    from tpunet.transport import Net
+
+    monkeypatch.setenv("TPUNET_LANES", "w=3,w=1")
+    monkeypatch.setenv("TPUNET_LANE_ADAPT", "0")
+    monkeypatch.setenv("TPUNET_MIN_CHUNKSIZE", str(64 << 10))
+    monkeypatch.setenv("TPUNET_CRC", "1")
+    monkeypatch.setenv("TPUNET_IMPLEMENT", "BASIC")
+    if action == "stall":
+        monkeypatch.setenv("TPUNET_PROGRESS_TIMEOUT_MS", "500")
+    telemetry.reset()
+    before_fo = sum(telemetry.metrics().get(
+        "tpunet_stream_failovers_total", {}).values())
+    with Net() as ns, Net() as nr:
+        lc = nr.listen()
+        got = {}
+        th = threading.Thread(target=lambda: got.setdefault("rc", lc.accept()))
+        th.start()
+        sc = ns.connect(lc.handle)
+        th.join()
+        rc = got["rc"]
+        try:
+            # Target the heavy lane (stream 0, weight 3). The stall must
+            # fire INSIDE the single probe message (its second chunk), so
+            # its byte threshold sits below one chunk.
+            after = "1M" if action == "close" else "256K"
+            transport.fault_inject(
+                f"stream=0:side=send:after_bytes={after}:action={action}")
+            src = np.frombuffer(
+                bytes((i * 13 + 7) & 0xFF for i in range(1 << 20)), np.uint8
+            ).copy()
+            if action == "close":
+                for round_ in range(6):
+                    dst = np.zeros_like(src)
+                    rreq = rc.irecv(dst)
+                    sc.isend(src).wait(timeout=60)
+                    assert rreq.wait(timeout=60) == src.nbytes
+                    np.testing.assert_array_equal(src, dst)
+                after_fo = sum(telemetry.metrics().get(
+                    "tpunet_stream_failovers_total", {}).values())
+                assert after_fo > before_fo, "lane death never failed over"
+                # Survivor-only striping: the retired lane moves no new bytes.
+                lanes_before = {}
+                for labels, value in telemetry.metrics().get(
+                        "tpunet_lane_bytes_total", {}).items():
+                    lanes_before[labels] = value
+                dst = np.zeros_like(src)
+                rreq = rc.irecv(dst)
+                sc.isend(src).wait(timeout=60)
+                rreq.wait(timeout=60)
+                np.testing.assert_array_equal(src, dst)
+            else:  # stall: typed watchdog verdict within a bounded wait
+                t0 = time.perf_counter()
+                dst = np.zeros_like(src)
+                rreq = rc.irecv(dst)
+                sreq = sc.isend(src)
+                with pytest.raises(_native.ProgressTimeoutError):
+                    sreq.wait()
+                assert time.perf_counter() - t0 < 10
+        finally:
+            transport.fault_clear()
+            for c in (sc, rc, lc):
+                try:
+                    c.close()
+                except _native.NativeError:
+                    pass

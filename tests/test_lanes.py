@@ -1,0 +1,389 @@
+"""Lane striping suite: weighted chunk scheduling over heterogeneous paths.
+
+Covers the adaptive multi-lane layer (docs/DESIGN.md "Lanes & adaptive
+striping") bottom-up:
+
+  * spec parsing + config validation: the native TPUNET_LANES grammar and
+    Config.from_env's loud gate agree, errors name the offending token/var;
+  * stripe-map goldens: the pure chunk->stream derivation both engines run
+    — equal weights reproduce the pre-lane uniform rotation bit-for-bit,
+    weighted maps spread chunks proportionally, and an epoch bump
+    mid-conversation re-derives deterministically from
+    (len, min_chunksize, weights[epoch], cursor) alone;
+  * live transfers: two-lane comms on loopback in THIS process, BASIC and
+    EPOLL and cross-engine, CRC-verified — static weights produce exact
+    byte shares, the WEIGHTS epoch protocol keeps both sides' layouts
+    symmetric (any desync would corrupt payload bytes);
+  * adaptation: a fault-injected delay on one lane demotes it (restripe
+    events + weight gauges move) while every message stays bit-correct.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from tpunet import _native, transport
+
+# ---------------------------------------------------------------------------
+# Spec parsing (no sockets).
+
+
+def test_lane_parse_normalizes_spec():
+    lanes = transport.lane_parse("addr=127.0.0.1:w=4,addr=[::1]:w=3,w=2")
+    assert lanes == [
+        {"lane": 0, "addr": "127.0.0.1", "w": 4},
+        {"lane": 1, "addr": "::1", "w": 3},
+        {"lane": 2, "addr": None, "w": 2},
+    ]
+    assert transport.lane_parse("") == []
+
+
+@pytest.mark.parametrize(
+    "spec, token",
+    [
+        ("addr=nonsense:w=1", "nonsense"),
+        ("w=0", "0"),
+        ("w=256", "256"),
+        ("w=4x", "4x"),
+        ("flavor=spicy", "flavor"),
+        ("w=1,,w=2", "empty lane"),
+        ("addr=10.0.0.1:", "empty clause"),
+        ("w", "key=value"),
+    ],
+)
+def test_lane_parse_rejects_malformed(spec, token):
+    with pytest.raises(_native.NativeError) as ei:
+        transport.lane_parse(spec)
+    assert ei.value.code == _native.TPUNET_ERR_INVALID
+    assert token in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# Config validation (the loud gate naming the var).
+
+
+@pytest.mark.parametrize(
+    "var, value, ok",
+    [
+        ("TPUNET_LANES", "addr=10.0.0.1:w=4,addr=10.0.1.1:w=1", True),
+        ("TPUNET_LANES", "w=4,w=1", True),
+        ("TPUNET_LANES", "addr=bogus:w=4", False),
+        ("TPUNET_LANES", "w=0", False),
+        ("TPUNET_LANES", "w=999", False),
+        ("TPUNET_LANES", "flavor=spicy", False),
+        ("TPUNET_LANES", "w=1,,w=2", False),
+        ("TPUNET_LANE_ADAPT_MS", "50", True),
+        ("TPUNET_LANE_ADAPT_MS", "0", False),
+        ("TPUNET_LANE_ADAPT_MS", "-5", False),
+    ],
+)
+def test_config_validates_lane_knobs(monkeypatch, var, value, ok):
+    from tpunet.config import Config
+
+    monkeypatch.setenv(var, value)
+    if ok:
+        Config.from_env()
+    else:
+        with pytest.raises(ValueError, match=var):
+            Config.from_env()
+
+
+def test_config_carries_lane_knobs(monkeypatch):
+    from tpunet.config import Config
+
+    monkeypatch.setenv("TPUNET_LANES", "w=4,w=1")
+    monkeypatch.setenv("TPUNET_LANE_ADAPT", "0")
+    monkeypatch.setenv("TPUNET_LANE_ADAPT_MS", "40")
+    cfg = Config.from_env()
+    assert cfg.lanes == "w=4,w=1"
+    assert cfg.lane_adapt is False
+    assert cfg.lane_adapt_ms == 40
+
+
+# ---------------------------------------------------------------------------
+# Stripe-map goldens: the derivation both sides run, pinned with no sockets.
+
+
+def test_stripe_map_equal_weights_is_uniform_rotation():
+    """Equal weights must reproduce the pre-lane cursor%nstreams rotation
+    bit-for-bit — the wire-compat contract for default configs — across a
+    (len, min_chunksize, nstreams, cursor) grid."""
+    for n in (1, 2, 3, 4, 8):
+        for length in (0, 1, 4096, 1 << 20, (8 << 20) + 13):
+            for minc in (1 << 10, 1 << 20):
+                for cursor in (0, 1, 7, 1000):
+                    got = transport.stripe_map(length, minc, [1] * n, cursor)
+                    csize = max(-(-length // n), minc) if length else minc
+                    nchunks = -(-length // csize) if length else 0
+                    assert got == [(cursor + i) % n for i in range(nchunks)], (
+                        n, length, minc, cursor)
+
+
+def test_stripe_map_weighted_goldens():
+    """WRR slot tables are pinned literals: stride scheduling spreads the
+    heavy lane across the period instead of bursting it. A message never
+    has more than nstreams chunks (csize >= ceil(len/n)), so the table is
+    observed by walking the persisted cursor across consecutive messages —
+    exactly what the comms do."""
+    # weights [4,1] -> period-5 table [0,0,1,0,0].
+    table41 = [0, 0, 1, 0, 0]
+    walk = []
+    for c in range(0, 10, 2):  # five 2-chunk messages
+        walk += transport.stripe_map(4 << 20, 1 << 20, [4, 1], cursor=c)
+    assert walk == table41 * 2
+    # weights [1,2,3] -> period-6 table [2,1,0,2,1,2].
+    assert transport.stripe_map(6 << 20, 1 << 10, [1, 2, 3]) == [2, 1, 0]
+    assert transport.stripe_map(6 << 20, 1 << 10, [1, 2, 3], cursor=3) == [2, 1, 2]
+    # Cursor continuation: message 2 picks up exactly where message 1's
+    # chunks left the rotation — the persisted-cursor fairness contract.
+    msg1 = transport.stripe_map(4 << 20, 1 << 20, [4, 1], cursor=0)
+    msg2 = transport.stripe_map(4 << 20, 1 << 20, [4, 1], cursor=len(msg1))
+    assert msg1 + msg2 == table41[:4]
+
+
+def test_stripe_map_shares_track_weights():
+    for weights in ([4, 1], [1, 2, 3], [16, 1], [3, 3, 1]):
+        counts = {i: 0 for i in range(len(weights))}
+        cursor = 0
+        total = 0
+        for _ in range(200):  # cursor persists across messages, as in a comm
+            m = transport.stripe_map(len(weights) << 20, 1 << 10, weights, cursor)
+            cursor += len(m)
+            total += len(m)
+            for s in m:
+                counts[s] += 1
+        for i, w in enumerate(weights):
+            share = counts[i] / total
+            expect = w / sum(weights)
+            assert abs(share - expect) < 0.02, (weights, i, share, expect)
+
+
+def test_stripe_map_epoch_bump_mid_conversation():
+    """A weight-vector epoch change between messages re-derives the layout
+    from the NEW vector only — both sides compute the same maps from the
+    same (len, min_chunksize, weights[epoch], cursor) inputs, before and
+    after the bump."""
+    cursor = 0
+    epoch_a = [1, 1]
+    epoch_b = [7, 2]
+    msgs = [3 << 20, 5 << 20, 4 << 20]
+    seen = []
+    for i, length in enumerate(msgs):
+        weights = epoch_a if i < 1 else epoch_b  # bump after message 0
+        m = transport.stripe_map(length, 1 << 20, weights, cursor)
+        m2 = transport.stripe_map(length, 1 << 20, weights, cursor)
+        assert m == m2  # deterministic: "both sides" agree by construction
+        cursor += len(m)
+        seen.append(m)
+    assert seen[0] == [0, 1]  # uniform rotation, 2 chunks of 1.5 MiB
+    # Epoch B's table is [0,0,1,0,0,0,1,0,0] (period 9); cursor resumed at 2.
+    assert seen[1] == [1, 0]
+    assert seen[2] == [0, 0]
+
+
+def test_stripe_map_rejects_malformed():
+    for bad_weights in ([0], [256], []):
+        with pytest.raises(_native.NativeError) as ei:
+            transport.stripe_map(1 << 20, 1 << 20, bad_weights)
+        assert ei.value.code == _native.TPUNET_ERR_INVALID
+    with pytest.raises(_native.NativeError):
+        transport.stripe_map(1 << 20, 0, [1])  # min_chunksize must be >= 1
+
+
+# ---------------------------------------------------------------------------
+# Live two-lane transfers on loopback (both engines in THIS process).
+
+
+def _wire_pair(net_s, net_r):
+    lc = net_r.listen()
+    got = {}
+    th = threading.Thread(target=lambda: got.setdefault("rc", lc.accept()))
+    th.start()
+    sc = net_s.connect(lc.handle)
+    th.join()
+    return sc, got["rc"], lc
+
+
+def _lane_tx_bytes():
+    from tpunet import telemetry
+
+    out = {}
+    for labels, value in telemetry.metrics().get(
+            "tpunet_lane_bytes_total", {}).items():
+        lab = telemetry.labels(labels)
+        if lab.get("dir") == "tx":
+            out[int(lab["lane"])] = int(value)
+    return out
+
+
+@pytest.mark.parametrize("engine", ["BASIC", "EPOLL"])
+def test_static_weights_give_exact_byte_shares(monkeypatch, engine):
+    """TPUNET_LANES=w=3,w=1 with adaptation off: CRC-verified transfers land
+    exactly 3:1 bytes across the lanes on both engines. Content equality is
+    the layout-symmetry proof — a receiver deriving a different chunk map
+    would scatter payload bytes to wrong offsets."""
+    from tpunet import telemetry
+    from tpunet.transport import Net
+
+    monkeypatch.setenv("TPUNET_IMPLEMENT", engine)
+    monkeypatch.setenv("TPUNET_LANES", "w=3,w=1")
+    monkeypatch.setenv("TPUNET_LANE_ADAPT", "0")
+    monkeypatch.setenv("TPUNET_MIN_CHUNKSIZE", str(64 << 10))
+    monkeypatch.setenv("TPUNET_CRC", "1")
+    telemetry.reset()
+    with Net() as ns, Net() as nr:
+        sc, rc, lc = _wire_pair(ns, nr)
+        try:
+            src = np.arange(512 << 10, dtype=np.uint8)
+            for _ in range(20):
+                dst = np.zeros_like(src)
+                r = rc.irecv(dst)
+                sc.isend(src).wait(timeout=60)
+                r.wait(timeout=60)
+                np.testing.assert_array_equal(src, dst)
+        finally:
+            for c in (sc, rc, lc):
+                c.close()
+    lanes = _lane_tx_bytes()
+    assert set(lanes) == {0, 1}
+    # 20 msgs x 2 chunks walk the [0,0,1,0] table an integer number of
+    # periods: the 3:1 split is exact, not approximate.
+    assert lanes[0] == 3 * lanes[1], lanes
+
+
+def test_cross_engine_lane_comm(monkeypatch):
+    """A BASIC lane-mode sender striping into an EPOLL receiver: the lane
+    protocol (preamble bit + WEIGHTS frames + slot-table walk) is engine-
+    independent, like the rest of the wire contract."""
+    from tpunet import telemetry
+    from tpunet.transport import Net
+
+    monkeypatch.setenv("TPUNET_LANES", "w=2,w=1")
+    monkeypatch.setenv("TPUNET_LANE_ADAPT", "0")
+    monkeypatch.setenv("TPUNET_MIN_CHUNKSIZE", str(64 << 10))
+    monkeypatch.setenv("TPUNET_CRC", "1")
+    telemetry.reset()
+    monkeypatch.setenv("TPUNET_IMPLEMENT", "BASIC")
+    ns = Net()
+    monkeypatch.setenv("TPUNET_IMPLEMENT", "EPOLL")
+    nr = Net()
+    sc, rc, lc = _wire_pair(ns, nr)
+    try:
+        src = np.arange(384 << 10, dtype=np.uint8)
+        for _ in range(12):
+            dst = np.zeros_like(src)
+            r = rc.irecv(dst)
+            sc.isend(src).wait(timeout=60)
+            r.wait(timeout=60)
+            np.testing.assert_array_equal(src, dst)
+    finally:
+        for c in (sc, rc, lc):
+            c.close()
+        ns.close()
+        nr.close()
+    lanes = _lane_tx_bytes()
+    assert lanes[0] == 2 * lanes[1], lanes
+
+
+@pytest.mark.parametrize("engine", ["BASIC", "EPOLL"])
+def test_single_chunk_messages_rotate_lanes(monkeypatch, engine):
+    """Small (single-chunk) messages take lane turns by weight across
+    messages — the fairness rotation the paper pins, weighted. On BASIC
+    this also exercises the lazy-recv path's WEIGHTS-frame handling."""
+    from tpunet import telemetry
+    from tpunet.transport import Net
+
+    monkeypatch.setenv("TPUNET_IMPLEMENT", engine)
+    monkeypatch.setenv("TPUNET_LANES", "w=3,w=1")
+    monkeypatch.setenv("TPUNET_LANE_ADAPT", "0")
+    monkeypatch.setenv("TPUNET_CRC", "1")
+    telemetry.reset()
+    with Net() as ns, Net() as nr:
+        sc, rc, lc = _wire_pair(ns, nr)
+        try:
+            src = np.arange(8 << 10, dtype=np.uint8)  # single chunk
+            for _ in range(16):
+                dst = np.zeros_like(src)
+                r = rc.irecv(dst)
+                sc.isend(src).wait(timeout=60)
+                r.wait(timeout=60)
+                np.testing.assert_array_equal(src, dst)
+        finally:
+            for c in (sc, rc, lc):
+                c.close()
+    lanes = _lane_tx_bytes()
+    assert lanes[0] == 3 * lanes[1], lanes
+
+
+@pytest.mark.parametrize("engine", ["BASIC", "EPOLL"])
+def test_adaptation_demotes_delayed_lane(monkeypatch, engine):
+    """A fault-injected delay on lane 1 drives the adaptation loop: weight
+    epochs get published (restripe counter), the slow lane's weight decays
+    below the fast lane's, byte shares skew accordingly — and every message
+    stays bit-correct under CRC through every re-stripe boundary."""
+    from tpunet import telemetry
+    from tpunet.transport import Net
+
+    monkeypatch.setenv("TPUNET_IMPLEMENT", engine)
+    monkeypatch.setenv("TPUNET_LANES", "w=1,w=1")
+    monkeypatch.setenv("TPUNET_LANE_ADAPT_MS", "20")
+    monkeypatch.setenv("TPUNET_MIN_CHUNKSIZE", str(64 << 10))
+    monkeypatch.setenv("TPUNET_CRC", "1")
+    telemetry.reset()
+    with Net() as ns, Net() as nr:
+        sc, rc, lc = _wire_pair(ns, nr)
+        try:
+            transport.fault_inject("stream=1:side=send:action=delay=3")
+            src = np.arange(256 << 10, dtype=np.uint8)
+            for _ in range(120):
+                dst = np.zeros_like(src)
+                r = rc.irecv(dst)
+                sc.isend(src).wait(timeout=60)
+                r.wait(timeout=60)
+                np.testing.assert_array_equal(src, dst)
+        finally:
+            transport.fault_clear()
+            for c in (sc, rc, lc):
+                c.close()
+    from tpunet import telemetry as t
+
+    m = t.metrics()
+    restripes = sum(m.get("tpunet_restripe_events_total", {}).values())
+    assert restripes >= 1, "adaptation never published a weight epoch"
+    weights = {}
+    for labels, value in m.get("tpunet_lane_weight", {}).items():
+        weights[int(t.labels(labels)["lane"])] = int(value)
+    assert weights[0] > weights[1], weights
+    lanes = _lane_tx_bytes()
+    share_slow = lanes[1] / (lanes[0] + lanes[1])
+    assert share_slow < 0.4, lanes  # decayed well below the uniform 50%
+
+
+def test_min_rtt_gauge_exported(monkeypatch):
+    """The TCP_INFO sampler exports tcpi_min_rtt per stream/dir — the
+    observable per-path RTT floor (satellite)."""
+    from tpunet import telemetry
+    from tpunet.transport import Net
+
+    monkeypatch.setenv("TPUNET_IMPLEMENT", "BASIC")
+    telemetry.reset()
+    with Net() as ns, Net() as nr:
+        sc, rc, lc = _wire_pair(ns, nr)
+        try:
+            src = np.arange(1 << 20, dtype=np.uint8)
+            dst = np.zeros_like(src)
+            r = rc.irecv(dst)
+            sc.isend(src).wait(timeout=60)
+            r.wait(timeout=60)
+        finally:
+            for c in (sc, rc, lc):
+                c.close()
+    fam = telemetry.metrics().get("tpunet_stream_min_rtt_us", {})
+    assert fam, "no tpunet_stream_min_rtt_us samples after loopback traffic"
+    for labels in fam:
+        lab = telemetry.labels(labels)
+        assert "stream" in lab and lab.get("dir") in ("tx", "rx")

@@ -46,6 +46,7 @@ NAMING_EXCEPTIONS = {
     "tpunet_faults_injected": "label-less compat twin of tpunet_faults_injected_total",
     "tpunet_codec_wire_ratio": "dimensionless encoded/payload byte ratio in (0, 1]",
     "tpunet_serve_queue_depth": "instantaneous request count per serving tier (dimensionless gauge)",
+    "tpunet_lane_weight": "dimensionless stripe weight (1..16) per lane in the WRR scheduler",
 }
 
 _SNAKE = re.compile(r"^tpunet_[a-z0-9]+(?:_[a-z0-9]+)*$")

@@ -200,6 +200,11 @@ def load() -> ctypes.CDLL:
     lib.tpunet_c_serve_queue_depth.restype = i32
     lib.tpunet_c_qos_state.argtypes = [ctypes.c_char_p, u64]
     lib.tpunet_c_qos_state.restype = i32
+    lib.tpunet_c_lane_parse.argtypes = [ctypes.c_char_p, ctypes.c_char_p, u64]
+    lib.tpunet_c_lane_parse.restype = i32
+    lib.tpunet_c_stripe_map.argtypes = [u64, u64, ctypes.c_char_p, u64,
+                                        ctypes.c_char_p, u64]
+    lib.tpunet_c_stripe_map.restype = i32
     lib.tpunet_c_qos_drr_golden.argtypes = [
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, u64,
     ]

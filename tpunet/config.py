@@ -117,6 +117,63 @@ def _env_qos_spec(name: str, keys: tuple[str, ...], what: str,
     return v
 
 
+def _env_lanes(name: str) -> str:
+    """Validate a TPUNET_LANES spec against the native grammar (wire.cc
+    ParseLaneSpec): comma-separated lanes of colon-separated key=value
+    clauses, keys ``addr`` (IPv4/IPv6 literal) and ``w`` (1..255), either
+    optional per lane. Malformed specs raise ValueError naming the var —
+    the native side only WARNS and runs single-path, so this is the loud
+    gate (the QoS-spec validator stance). Returns the raw string (the
+    native layer re-parses it)."""
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return ""
+    def _clauses(lane: str) -> list[str]:
+        # ':' separates clauses only at bracket depth 0 — IPv6 literals ride
+        # in brackets ("addr=[fe80::1]:w=2"), matching the native tokenizer.
+        out, cur, depth = [], "", 0
+        for ch in lane:
+            if ch == "[":
+                depth += 1
+            elif ch == "]":
+                depth -= 1
+            if ch == ":" and depth == 0:
+                out.append(cur)
+                cur = ""
+            else:
+                cur += ch
+        out.append(cur)
+        return out
+
+    for lane in v.split(","):
+        if not lane:
+            raise ValueError(f"{name}={v} is invalid: empty lane entry")
+        for clause in _clauses(lane):
+            key, eq, val = clause.partition("=")
+            if not eq:
+                raise ValueError(
+                    f"{name}={v} is invalid: clause {clause!r} is not key=value")
+            if key == "addr":
+                import ipaddress
+                try:
+                    ipaddress.ip_address(val.strip("[]"))
+                except ValueError as e:
+                    raise ValueError(
+                        f"{name}={v} is invalid: {val!r} is not an IPv4/IPv6 "
+                        f"address") from e
+            elif key == "w":
+                if not val.isdigit() or not 1 <= int(val) <= 255:
+                    raise ValueError(
+                        f"{name}={v} is invalid: weight {val!r} must be 1..255")
+            else:
+                raise ValueError(
+                    f"{name}={v} is invalid: unknown key {key!r} (lane keys "
+                    f"are addr, w)")
+    if len(v.split(",")) > 256:
+        raise ValueError(f"{name}={v} is invalid: more than 256 lanes")
+    return v
+
+
 def _env_dispatch_table(name: str) -> str:
     """Read a dispatch-table path env var; when set, the file must exist and
     parse as a JSON object with an "entries" list, else ValueError naming
@@ -277,6 +334,20 @@ class Config:
     # Pin this process's serving-tier role ("" = unpinned). Wiring as the
     # OTHER role then fails loudly — catches copy-pasted launch commands.
     serve_role: str = ""
+    # ---- Lane striping (docs/DESIGN.md "Lanes & adaptive striping") ------
+    # Multi-path lane spec, "addr=10.0.0.1:w=4,addr=10.0.1.1:w=1": one lane
+    # == one data stream (the spec's lane count overrides TPUNET_NSTREAMS),
+    # addr pins the lane's local bind (egress path; omit for the default
+    # route), w its base stripe weight. Empty = single-path uniform striping,
+    # byte-identical on the wire to pre-lane builds.
+    lanes: str = ""
+    # Sender-side adaptive re-striping (lane mode only): per-lane service-
+    # rate EWMAs + the TCP_INFO straggler detector drive weight demotion
+    # (floor 1) and recovery, published as epoch-stamped ctrl frames. 0
+    # pins the configured base weights (the uniform-striping control).
+    lane_adapt: bool = True
+    # Adaptation tick cadence in ms.
+    lane_adapt_ms: int = 100
     # ---- Transport QoS (docs/DESIGN.md "Transport QoS") ------------------
     # Default traffic class for every comm this process connects (and the
     # class a Communicator negotiates when traffic_class= is not passed).
@@ -419,6 +490,12 @@ class Config:
             serve_role=_env_choice(
                 "TPUNET_SERVE_ROLE", "", ("", "frontend", "decode"),
                 "serving-tier role",
+            ),
+            lanes=_env_lanes("TPUNET_LANES"),
+            # GetEnvU64 semantics (default 1): only a numeric 0 disables.
+            lane_adapt=_env_int("TPUNET_LANE_ADAPT", 1) != 0,
+            lane_adapt_ms=_env_int_checked(
+                ("TPUNET_LANE_ADAPT_MS",), 100, 1, "lane adaptation tick"
             ),
             traffic_class=_env_choice(
                 "TPUNET_TRAFFIC_CLASS", "bulk", _QOS_CLASSES,

@@ -127,6 +127,50 @@ def qos_drr_golden(weights: str, window: str, chunks: str) -> list[str]:
     return buf.value.decode().split(",") if buf.value else []
 
 
+def lane_parse(spec: str) -> list[dict]:
+    """Parse a ``TPUNET_LANES`` spec through the native parser — the same
+    grammar the engines consume (``"addr=10.0.0.1:w=4,addr=10.0.1.1:w=1"``;
+    a lane may omit either key). Returns one ``{"lane", "addr", "w"}`` dict
+    per lane (``addr`` is ``None`` for the default path). Malformed specs
+    raise NativeError (INVALID) naming the offending token, so
+    ``Config.from_env`` and the native layer can never disagree on what a
+    spec means. docs/DESIGN.md "Lanes & adaptive striping"."""
+    lib = _native.load()
+    buf = ctypes.create_string_buffer(16384)
+    n = lib.tpunet_c_lane_parse(spec.encode(), buf, 16384)
+    _native.check(min(n, 0), "lane_parse")
+    out = []
+    for line in buf.value.decode().splitlines():
+        kv = dict(tok.split("=", 1) for tok in line.split())
+        out.append({"lane": int(kv["lane"]),
+                    "addr": None if kv["addr"] == "-" else kv["addr"],
+                    "w": int(kv["w"])})
+    return out
+
+
+def stripe_map(length: int, min_chunksize: int, weights: list[int] | tuple[int, ...],
+               cursor: int = 0) -> list[int]:
+    """Chunk→stream assignment a message of ``length`` bytes gets under the
+    weighted stripe scheduler (one entry per chunk), via
+    ``tpunet_c_stripe_map`` — EXACTLY the arithmetic both engines run, so
+    golden tests can pin that sender and receiver derive identical layouts
+    from ``(len, min_chunksize, weights[epoch])`` alone with no layout
+    metadata on the wire. Equal weights reproduce the pre-lane uniform
+    rotation ``(cursor + i) % nstreams``."""
+    lib = _native.load()
+    wspec = ",".join(str(int(w)) for w in weights)
+    # Two-call sizing (the tpunet_c_metrics_text contract): probe the text
+    # length, then read it exactly — a dense map over a big grid can be long.
+    n = lib.tpunet_c_stripe_map(length, min_chunksize, wspec.encode(), cursor,
+                                None, 0)
+    _native.check(min(n, 0), "stripe_map")
+    buf = ctypes.create_string_buffer(n + 1)
+    n = lib.tpunet_c_stripe_map(length, min_chunksize, wspec.encode(), cursor,
+                                buf, n + 1)
+    _native.check(min(n, 0), "stripe_map")
+    return [int(t) for t in buf.value.decode().split(",")] if buf.value else []
+
+
 def codec_wire_bytes(codec: str, n: int) -> int:
     """Encoded byte count for ``n`` f32 elements under ``codec`` ("f32",
     "bf16" or "int8") — the exact sizing rule the compressed ring uses
