@@ -128,12 +128,16 @@ def _run_collective_rank(rank, world, coordinator, args, emit):
 
 def _run_dispatch_rank(rank, world, coordinator, args, emit):
     """--emit-dispatch lane: time the allreduce sweep under EACH schedule
-    (ring / rhd / tree, one communicator per algo on coordinator port +0/+1/
-    +2), take the MEDIAN of 3 timed reps per (algo, size) — a single-shot
-    winner is noise-picked on a busy host — and write the winner table as
-    the TPUNET_DISPATCH_TABLE JSON (docs/DESIGN.md "Schedules & algorithm
-    selection"). Adjacent sizes with the same winner coalesce into one
-    entry; the last run is open-ended (max_bytes 0)."""
+    (ring / rhd / tree — plus hier when the topology is hierarchical: a
+    real multi-host launch, or --fake-hosts H splitting the local spawn
+    into H fake hosts via TPUNET_HOST_ID — one communicator per algo on
+    coordinator port +0/+1/...), take the MEDIAN of 3 timed reps per
+    (algo, size) — a single-shot winner is noise-picked on a busy host —
+    and write the winner table as the TPUNET_DISPATCH_TABLE JSON
+    (docs/DESIGN.md "Schedules & algorithm selection"). Adjacent sizes with
+    the same winner coalesce into one entry; the last run is open-ended
+    (max_bytes 0). A table routing sizes to "hier" is then loadable on the
+    matching topology — the emitted table can select it per size."""
     import statistics
 
     import numpy as np
@@ -142,6 +146,10 @@ def _run_dispatch_rank(rank, world, coordinator, args, emit):
 
     host, port = coordinator.rsplit(":", 1)
     algos = ["ring", "rhd", "tree"]
+    # hier only sweeps on a hierarchical topology (>= 2 hosts); on a flat
+    # one it would silently time the ring twice and could noise-win rows.
+    if getattr(args, "fake_hosts", 0) or os.environ.get("TPUNET_HOST_ID"):
+        algos.append("hier")
     sizes = sweep_sizes(args.begin, args.end, args.factor)
     reps = 3
     medians: dict[str, dict[int, float]] = {a: {} for a in algos}
@@ -300,6 +308,14 @@ def _worker(rank, world, port, q, args):
             os.environ["TPUNET_NSTREAMS"] = str(args.nstreams)
         if args.wire_dtype:
             os.environ["TPUNET_WIRE_DTYPE"] = args.wire_dtype
+        if getattr(args, "fake_hosts", 0):
+            # Contiguous equal groups: ranks [0, W/H) on fake host 0, etc.
+            # (uniform ranks/host is what makes `hier` usable). TPUNET_SHM=1
+            # gives the intra-"host" pairs ring segments, so the sweep's
+            # hier lane exercises the real SHM-intra + TCP-inter split.
+            os.environ["TPUNET_HOST_ID"] = (
+                f"sweephost{rank * args.fake_hosts // world}")
+            os.environ.setdefault("TPUNET_SHM", "1")
         if args.emit_dispatch:
             run = _run_dispatch_rank
         else:
@@ -330,10 +346,17 @@ def main() -> None:
     ap.add_argument("--json", default="", help="also dump rows to this file")
     ap.add_argument("--emit-dispatch", dest="emit_dispatch", default="",
                     help="time the allreduce sweep under each schedule "
-                         "(ring/rhd/tree; median of 3 reps per size) and "
-                         "write the winner table to this path as "
+                         "(ring/rhd/tree, + hier on a hierarchical "
+                         "topology; median of 3 reps per size) and write "
+                         "the winner table to this path as "
                          "TPUNET_DISPATCH_TABLE JSON (uses coordinator "
-                         "ports +0/+1/+2)")
+                         "ports +0/+1/...)")
+    ap.add_argument("--fake-hosts", dest="fake_hosts", type=int, default=0,
+                    help="split the local spawn into this many fake "
+                         "'hosts' (contiguous equal rank groups via "
+                         "TPUNET_HOST_ID, TPUNET_SHM=1 within them) so the "
+                         "hier schedule engages on one box — the "
+                         "--emit-dispatch sweep then times it per size")
     ap.add_argument("--external", action="store_true",
                     help="run as one rank; rank/world/coordinator from env")
     args = ap.parse_args()

@@ -73,10 +73,28 @@ def _stream_tx_split() -> dict:
     return {str(s): round(v / total, 4) for s, v in sorted(per.items())} if total else {}
 
 
+def _shm_stats() -> tuple:
+    """(shm_bytes, wakeups) since the last telemetry.reset() — the SHM
+    engine lane's bytes/wakeup is the ring's syscalls/MiB analogue."""
+    from tpunet import telemetry
+
+    m = telemetry.metrics()
+    return (int(sum(m.get("tpunet_shm_bytes_total", {}).values())),
+            int(sum(m.get("tpunet_shm_wakeups_total", {}).values())))
+
+
 def _peer(rank: int, conn, q, engine: str, nstreams: int,
           sizes: list, iters: int) -> None:
     try:
-        os.environ["TPUNET_IMPLEMENT"] = engine
+        # "SHM" is the intra-host shared-memory lane: the BASIC engine
+        # fronted by the SHM engine (TPUNET_SHM=1) — payloads ride mmap'd
+        # ring segments instead of loopback TCP.
+        if engine.upper() == "SHM":
+            os.environ["TPUNET_IMPLEMENT"] = "BASIC"
+            os.environ["TPUNET_SHM"] = "1"
+        else:
+            os.environ["TPUNET_IMPLEMENT"] = engine
+            os.environ["TPUNET_SHM"] = "0"
         os.environ["TPUNET_NSTREAMS"] = str(nstreams)
         import numpy as np
 
@@ -120,6 +138,7 @@ def _peer(rank: int, conn, q, engine: str, nstreams: int,
             # size bytes out AND size bytes in per iteration (ping-pong).
             syscalls = _syscall_total()
             moved = 2 * size * iters
+            shm_bytes, shm_wakeups = _shm_stats()
             out[size] = {"rtt_ms": round(best * 1e3, 4),
                          "gbps": round(size / (best / 2) / 1e9, 3) if size else None,
                          "syscalls": syscalls,
@@ -127,6 +146,12 @@ def _peer(rank: int, conn, q, engine: str, nstreams: int,
                                               if moved else None),
                          "bytes_per_syscall": (round(moved / syscalls)
                                                if syscalls and moved else None),
+                         # SHM lane: ring bytes + futex wakes over the window
+                         # (bytes/wakeup — the ring's bytes/syscall analogue).
+                         "shm_bytes": shm_bytes or None,
+                         "bytes_per_wakeup": (round(shm_bytes / shm_wakeups)
+                                              if shm_bytes and shm_wakeups
+                                              else None),
                          # Per-stream tx byte shares over the timed window —
                          # stripe skew made eyeball-able (round 9).
                          "stream_tx_split": _stream_tx_split()}
@@ -238,6 +263,8 @@ def main(argv=None) -> None:
                    if r[s].get("syscalls_per_mib") is not None]
             bps = [r[s]["bytes_per_syscall"] for r in raw[eng]
                    if r[s].get("bytes_per_syscall") is not None]
+            bpw = [r[s]["bytes_per_wakeup"] for r in raw[eng]
+                   if r[s].get("bytes_per_wakeup") is not None]
             agg[s] = {
                 "rtt_ms": round(statistics.median(rtts), 4),
                 "rtt_iqr_ms": round(spread, 4) if spread is not None else None,
@@ -251,6 +278,11 @@ def main(argv=None) -> None:
                                      if spm else None),
                 "bytes_per_syscall": (round(statistics.median(bps))
                                       if bps else None),
+                # SHM lane only: payload bytes per futex wake syscall over
+                # the timed window (median over reps; None on TCP lanes and
+                # on reps whose window never parked a waiter).
+                "bytes_per_wakeup": (round(statistics.median(bpw))
+                                     if bpw else None),
                 # Last rep's per-stream tx shares (deterministic from the
                 # rotation, so any rep is representative).
                 "stream_tx_split": raw[eng][-1][s].get("stream_tx_split"),

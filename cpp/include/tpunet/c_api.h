@@ -123,6 +123,13 @@ int32_t tpunet_c_fault_clear(void);
  * discontiguous buffers). Exposed for golden-vector tests and so Python
  * tooling can pre-verify payloads against the wire trailers. */
 uint32_t tpunet_c_crc32c(const void* data, uint64_t nbytes, uint32_t seed);
+/* Stable host identity (never 0): hash of TPUNET_HOST_ID when set (the
+ * fake-host override that splits one box into testable "hosts"), else of
+ * the kernel boot id, else of the hostname. Two processes report the same
+ * id iff they can share a memory segment — the locality verdict behind the
+ * SHM transport handshake (TPUNET_SHM=1) and the hierarchical collective's
+ * host grouping. Exposed so Python tests can pin the derivation. */
+uint64_t tpunet_c_host_id(void);
 /* Elementwise reduction dst[i] = a[i] op b[i] over n elements — the
  * runtime-dispatched (SIMD when the CPU has it, scalar otherwise) kernel the
  * ring collectives run post-wire, exposed so SIMD-vs-scalar equivalence
@@ -182,8 +189,11 @@ int32_t tpunet_comm_create(const char* coordinator, int32_t rank, int32_t world_
 /* As tpunet_comm_create, selecting the wire compression codec for f32
  * collectives — wire_dtype in {"f32","bf16","int8"}; NULL or "" defers to
  * TPUNET_WIRE_DTYPE (default f32) — and the collective schedule: algo in
- * {"auto","ring","rhd","tree"}; NULL or "" defers to TPUNET_ALGO (default
- * auto). "auto" dispatches per (collective, payload bytes, world) through
+ * {"auto","ring","rhd","tree","hier"}; NULL or "" defers to TPUNET_ALGO
+ * (default auto). "hier" is the two-level schedule (intra-host stage +
+ * one-rank-per-host DCN stage; needs >= 2 hosts with uniform ranks/host by
+ * the handshake's host ids, else it runs the ring).
+ * "auto" dispatches per (collective, payload bytes, world) through
  * built-in thresholds or the TPUNET_DISPATCH_TABLE JSON written by
  * `busbw_sweep --emit-dispatch` (docs/DESIGN.md "Schedules & algorithm
  * selection"). Unknown names are TPUNET_ERR_INVALID. Cross-rank

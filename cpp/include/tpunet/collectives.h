@@ -62,8 +62,11 @@ class Communicator {
                        const std::string& wire_dtype,
                        std::unique_ptr<Communicator>* out);
   // As above, additionally pinning the collective schedule ("auto" / "ring"
-  // / "rhd" / "tree"; empty = TPUNET_ALGO, default auto — docs/DESIGN.md
-  // "Schedules & algorithm selection"). "auto" selects per
+  // / "rhd" / "tree" / "hier"; empty = TPUNET_ALGO, default auto —
+  // docs/DESIGN.md "Schedules & algorithm selection"; "hier" is the
+  // two-level intra-host + inter-host schedule and needs >= 2 hosts with
+  // uniform ranks/host by the handshake's host ids, else it runs the
+  // ring). "auto" selects per
   // (collective, payload bytes, world): built-in thresholds, overridable by
   // a TPUNET_DISPATCH_TABLE JSON seeded offline by `busbw_sweep
   // --emit-dispatch`. The (algo, table) pair is negotiated over the

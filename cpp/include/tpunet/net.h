@@ -149,10 +149,15 @@ class Net {
 };
 
 // Factory. Engine selected by env TPUNET_IMPLEMENT in {"BASIC" (default),
-// "EPOLL"} (reference seam: src/lib.rs:20-29 BAGUA_NET_IMPLEMENT).
+// "EPOLL"} (reference seam: src/lib.rs:20-29 BAGUA_NET_IMPLEMENT). With
+// TPUNET_SHM=1 the selected engine is additionally fronted by the
+// shared-memory engine: same-host peers (HostId() equality, verified in
+// the SHM hello handshake) move payloads through a mmap'd per-pair ring
+// segment; everything else falls through to `inner` transparently.
 std::unique_ptr<Net> CreateEngine();
 std::unique_ptr<Net> CreateBasicEngine();
 std::unique_ptr<Net> CreateEpollEngine();
+std::unique_ptr<Net> CreateShmEngine(std::unique_ptr<Net> inner);
 
 }  // namespace tpunet
 

@@ -141,6 +141,14 @@ struct MetricsSnapshot {
   uint64_t lane_rate_bps[kMaxStreamStats] = {0};
   uint64_t lane_bytes[kMaxStreamStats][2] = {};  // [lane][tx=0, rx=1]
   uint64_t restripe_events = 0;
+  // Intra-host shared-memory transport (docs/DESIGN.md "Intra-host shared
+  // memory"): payload bytes moved through SHM ring segments per direction
+  // (deliberately NOT folded into the TCP stream/QoS byte counters, so
+  // "the intra-host stage moved zero TCP bytes" is provable straight off
+  // the counters) and futex wake syscalls issued by the ring protocol
+  // (bytes/wakeup is the ring's syscalls/MiB analogue).
+  uint64_t shm_bytes[2] = {0, 0};  // [tx=0, rx=1]
+  uint64_t shm_wakeups = 0;
   // Serving-tier SLO accounting (docs/DESIGN.md "Serving tier"): per-request
   // time-to-first-token and time-per-output-token histograms fed by the
   // router/decode workers through tpunet_c_serve_observe, plus instantaneous
@@ -169,8 +177,13 @@ struct MetricsSnapshot {
   // executes); kind slots are CollKind order (allreduce, broadcast). These
   // counters carry the small-message latency claim: ring AllReduce is
   // 2(W-1) rounds where rhd is 2*log2(W') and tree <= 2*ceil(log2 W).
-  uint64_t coll_steps[3] = {0, 0, 0};
-  uint64_t coll_algo_selected[2][3] = {{0, 0, 0}, {0, 0, 0}};
+  // Slots 0-2 map to CollAlgo 1-3 (ring, rhd, tree); slots 3-4 are the
+  // hierarchical schedule's two stages (algo="hier.intra"/"hier.inter" —
+  // the split is the point: hier's claim is that the inter slot, the DCN
+  // wire rounds, shrinks while intra rides shared memory). Selected slots
+  // 0-3 map to CollAlgo 1-4 (ring, rhd, tree, hier).
+  uint64_t coll_steps[5] = {0, 0, 0, 0, 0};
+  uint64_t coll_algo_selected[2][4] = {{0, 0, 0, 0}, {0, 0, 0, 0}};
   double uptime_s = 0;          // for bytes/s derivation
 };
 
@@ -210,6 +223,10 @@ class Telemetry {
   void OnLaneRate(uint64_t lane, uint64_t bps);
   void OnLaneBytes(bool is_send, uint64_t lane, uint64_t nbytes);
   void OnRestripe();
+  // Intra-host SHM transport hooks (shm_engine.cc): payload bytes moved
+  // through a ring segment, and futex wake syscalls the ring issued.
+  void OnShmBytes(bool is_send, uint64_t nbytes);
+  void OnShmWakeup();
   // Stage-latency accounting, called by the engines when a successful request
   // is consumed by test()/wait(). Timestamps are MonotonicUs(); completion
   // time is "now". post_us == 0 (no stamp) is ignored.

@@ -239,6 +239,15 @@ uint64_t GetEnvU64(const char* name, uint64_t fallback);
 // are aligned by collective tags in merge_traces() instead.
 uint64_t MonotonicUs();
 
+// Stable host identity: FNV-1a hash of TPUNET_HOST_ID when set (the
+// fake-host override that splits one box into testable "hosts"), else of
+// /proc/sys/kernel/random/boot_id (per-boot-unique, shared by every
+// process/container on the host), else of gethostname(). Never 0. Two
+// processes report the same id iff they can share a memory segment — the
+// locality verdict behind the SHM transport handshake and the hierarchical
+// collective's host grouping (docs/DESIGN.md "Intra-host shared memory").
+uint64_t HostId();
+
 // Fork-generation counter: bumps in the child after every fork() (via a
 // pthread_atfork handler registered on first call). Threads do not survive
 // fork, so anything owning a thread records ForkGeneration() at creation and

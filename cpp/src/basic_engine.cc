@@ -1316,7 +1316,7 @@ void ExecuteLazyRecv(Comm* c, const Msg& m) {
 
 // ---------------------------------------------------------------------------
 
-class BasicEngine : public EngineBase {
+class BasicEngine : public EngineBase, public BundleAdopter {
  public:
   BasicEngine()
       : spin_(GetEnvU64("TPUNET_SPIN", 0) != 0),
@@ -1386,6 +1386,21 @@ class BasicEngine : public EngineBase {
     PartialBundle b;
     Status s = AcceptBundleOn(listen_comm, &b);
     if (!s.ok()) return s;
+    return AdoptBundle(b, recv_comm);
+  }
+
+  // BundleAdopter seam (wire.h): the SHM engine fronts this engine on one
+  // listen socket and hands non-SHM bundles back here.
+  Status AdoptBundle(PartialBundle& b, uint64_t* recv_comm) override {
+    if ((b.flags & kPreambleFlagShm) != 0) {
+      // A zero-stream SHM hello reaching a plain TCP engine means the peer
+      // runs TPUNET_SHM=1 and this process does not — wiring a zero-worker
+      // comm would hang its first message, so fail loudly instead.
+      b.CloseAll();
+      return Status::Inner(
+          "peer attempted shared-memory transport but TPUNET_SHM is not "
+          "enabled here — set TPUNET_SHM identically on every rank");
+    }
     return BuildRecvComm(b, recv_comm);
   }
 
@@ -1725,6 +1740,13 @@ std::unique_ptr<Net> CreateEngine() {
   // process (fault.h); runtime arming goes through tpunet_c_fault_inject().
   ArmFaultFromEnv();
   auto engine = impl == "EPOLL" ? CreateEpollEngine() : CreateBasicEngine();
+  // Intra-host shared memory (TPUNET_SHM=1, docs/DESIGN.md "Intra-host
+  // shared memory"): front the TCP engine with the SHM engine — same-host
+  // peers get mmap'd ring segments, everything else passes through. Must be
+  // set identically on every rank (like the engine choice itself).
+  if (GetEnvU64("TPUNET_SHM", 0) != 0) {
+    engine = CreateShmEngine(std::move(engine));
+  }
   return WrapWithTelemetry(std::move(engine));
 }
 

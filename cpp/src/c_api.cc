@@ -278,6 +278,8 @@ uint32_t tpunet_c_crc32c(const void* data, uint64_t nbytes, uint32_t seed) {
   return tpunet::Crc32c(data, static_cast<size_t>(nbytes), seed);
 }
 
+uint64_t tpunet_c_host_id(void) { return tpunet::HostId(); }
+
 int32_t tpunet_c_reduce(void* dst, const void* a, const void* b, uint64_t n,
                         int32_t dtype, int32_t op) {
   if (dtype < 0 || dtype > 5) return Fail(TPUNET_ERR_INVALID, "bad dtype");

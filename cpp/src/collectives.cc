@@ -94,16 +94,21 @@ Status ScheduledCommunicator::Init(const std::string& coordinator) {
   if (!s.ok()) return s;
   if (world_ == 1) {
     bootstrap_.reset();
+    host_ids_.assign(1, HostId());
     return Status::Ok();
   }
 
   // Schedule-config negotiation, piggybacked on the bootstrap ctrl plane
-  // the wiring already rides: one 8-byte AllGather round carrying
-  // (wire codec, algo override, dispatch-table CRC32C). Every rank compares
-  // the full vector, so ALL ranks fail identically on a mismatch — before
-  // any comm exists that could mis-decode a payload or run half the world
-  // on a different schedule (two schedules deadlock, they don't corrupt).
-  uint8_t my_blob[8] = {0};
+  // the wiring already rides: one 16-byte AllGather round carrying
+  // (wire codec, algo override, dispatch-table CRC32C, QoS class) plus
+  // this rank's HOST ID (utils.h HostId() — boot-id/hostname hash or the
+  // TPUNET_HOST_ID fake-host override). The config bytes must MATCH on
+  // every rank (ALL ranks fail identically on a mismatch — before any comm
+  // exists that could mis-decode a payload or run half the world on a
+  // different schedule; two schedules deadlock, they don't corrupt); the
+  // host ids legitimately differ and become the hierarchical schedule's
+  // topology input (host_ids_).
+  uint8_t my_blob[16] = {0};
   my_blob[0] = static_cast<uint8_t>(codec_);
   my_blob[1] = static_cast<uint8_t>(algo_override_);
   uint32_t table_crc = dispatch_.loaded ? dispatch_.crc : 0;
@@ -112,9 +117,14 @@ Status ScheduledCommunicator::Init(const std::string& coordinator) {
   my_blob[4] = static_cast<uint8_t>(table_crc >> 8);
   my_blob[5] = static_cast<uint8_t>(table_crc);
   my_blob[6] = static_cast<uint8_t>(cls_);  // QoS traffic class
+  EncodeU64BE(HostId(), my_blob + 8);
   std::vector<uint8_t> blobs;
   s = bootstrap_->AllGather(my_blob, sizeof(my_blob), &blobs);
   if (!s.ok()) return s;
+  host_ids_.assign(world_, 0);
+  for (int r = 0; r < world_; ++r) {
+    host_ids_[r] = DecodeU64BE(blobs.data() + r * sizeof(my_blob) + 8);
+  }
   for (int r = 0; r < world_; ++r) {
     const uint8_t* theirs = blobs.data() + r * sizeof(my_blob);
     if (theirs[0] != my_blob[0]) {
@@ -212,9 +222,19 @@ CollAlgo ScheduledCommunicator::ResolveAlgo(CollKind coll, uint64_t nbytes) {
   // early-return) — don't let them pollute the selection counters.
   if (world_ <= 1 || nbytes == 0) return CollAlgo::kRing;
   CollAlgo a = SelectCollAlgo(dispatch_, algo_override_, coll, nbytes, world_);
-  // Halving-doubling is an AllReduce shape; a Broadcast pinned (or table-
-  // routed) to rhd runs the ring relay — and the counter records what RAN.
-  if (coll == CollKind::kBroadcast && a == CollAlgo::kRhd) a = CollAlgo::kRing;
+  // Topology post-pass: hier on a flat/irregular topology degrades to
+  // ring; built-in auto on a profitable hierarchy upgrades large ring
+  // AllReduces to hier. Deterministic from negotiated state (host_ids_ came
+  // off the same handshake on every rank), so every rank agrees.
+  a = ApplyHierPolicy(a, coll, nbytes, HierUsable(), HierProfitable(),
+                      algo_override_ == CollAlgo::kAuto && !dispatch_.loaded);
+  // Halving-doubling / hier are AllReduce shapes; a Broadcast pinned (or
+  // table-routed) to them runs the ring relay — the counter records what
+  // RAN.
+  if (coll == CollKind::kBroadcast &&
+      (a == CollAlgo::kRhd || a == CollAlgo::kHier)) {
+    a = CollAlgo::kRing;
+  }
   CountCollAlgoSelected(coll, a);
   return a;
 }
@@ -235,6 +255,8 @@ Status ScheduledCommunicator::DoAllReduce(const void* sendbuf, void* recvbuf,
       return DoAllReduceRhd(sendbuf, recvbuf, count, dtype, op, seq);
     case CollAlgo::kTree:
       return DoAllReduceTree(sendbuf, recvbuf, count, dtype, op, seq);
+    case CollAlgo::kHier:
+      return DoAllReduceHier(sendbuf, recvbuf, count, dtype, op, seq);
     default:
       return DoAllReduceRing(sendbuf, recvbuf, count, dtype, op, ch, seq);
   }
@@ -769,7 +791,7 @@ Status Communicator::Create(const std::string& coordinator, int rank, int world_
   CollAlgo calgo;
   if (!ParseCollAlgo(algo_name, &calgo)) {
     return Status::Invalid("unknown algo \"" + algo_name +
-                           "\" (expected auto, ring, rhd or tree)");
+                           "\" (expected auto, ring, rhd, tree or hier)");
   }
   std::string cls_name = traffic_class.empty()
                              ? GetEnv("TPUNET_TRAFFIC_CLASS", "bulk")

@@ -152,7 +152,7 @@ Status ParseEntry(Cursor* c, DispatchEntry* e) {
         CollAlgo a;
         if (!ParseCollAlgo(v, &a) || a == CollAlgo::kAuto) {
           return Status::Invalid("dispatch table: unknown algo \"" + v +
-                                 "\" (expected ring, rhd or tree)");
+                                 "\" (expected ring, rhd, tree or hier)");
         }
         e->algo = a;
         saw_algo = true;
@@ -179,6 +179,8 @@ Status ParseEntry(Cursor* c, DispatchEntry* e) {
 
 std::atomic<uint64_t> g_coll_steps[kCollAlgoCount] = {};
 std::atomic<uint64_t> g_coll_selected[kCollKindCount][kCollAlgoCount] = {};
+// Hier stage rounds: [0] intra-host, [1] inter-host (DCN).
+std::atomic<uint64_t> g_hier_steps[2] = {};
 
 }  // namespace
 
@@ -191,6 +193,8 @@ bool ParseCollAlgo(const std::string& name, CollAlgo* out) {
     *out = CollAlgo::kRhd;
   } else if (name == "tree") {
     *out = CollAlgo::kTree;
+  } else if (name == "hier") {
+    *out = CollAlgo::kHier;
   } else {
     return false;
   }
@@ -207,6 +211,8 @@ const char* CollAlgoName(CollAlgo a) {
       return "rhd";
     case CollAlgo::kTree:
       return "tree";
+    case CollAlgo::kHier:
+      return "hier";
   }
   return "?";
 }
@@ -289,8 +295,32 @@ CollAlgo SelectCollAlgo(const DispatchTable& table, CollAlgo override_algo,
   return SelectBuiltin(coll, nbytes, world);
 }
 
+CollAlgo ApplyHierPolicy(CollAlgo a, CollKind coll, uint64_t nbytes,
+                         bool usable, bool profitable, bool builtin_auto) {
+  if (coll != CollKind::kAllReduce) {
+    return a == CollAlgo::kHier ? CollAlgo::kRing : a;
+  }
+  if (a == CollAlgo::kHier) return usable ? a : CollAlgo::kRing;
+  // Built-in auto: the large-message band (where the ring keeps the flat
+  // crown) goes hierarchical on a profitable topology — same thresholds
+  // that hand rhd the middle band.
+  if (builtin_auto && profitable && a == CollAlgo::kRing &&
+      nbytes > kRhdMaxAllReduce) {
+    return CollAlgo::kHier;
+  }
+  return a;
+}
+
 void CountCollSteps(CollAlgo a, uint64_t n) {
   g_coll_steps[static_cast<int>(a)].fetch_add(n, std::memory_order_relaxed);
+}
+
+void CountHierSteps(bool inter, uint64_t n) {
+  g_hier_steps[inter ? 1 : 0].fetch_add(n, std::memory_order_relaxed);
+}
+
+uint64_t HierStepsTotal(bool inter) {
+  return g_hier_steps[inter ? 1 : 0].load(std::memory_order_relaxed);
 }
 
 void CountCollAlgoSelected(CollKind c, CollAlgo a) {
@@ -309,6 +339,7 @@ uint64_t CollAlgoSelectedTotal(CollKind c, CollAlgo a) {
 
 void ResetCollDispatchCounters() {
   for (auto& v : g_coll_steps) v.store(0, std::memory_order_relaxed);
+  for (auto& v : g_hier_steps) v.store(0, std::memory_order_relaxed);
   for (auto& per_kind : g_coll_selected) {
     for (auto& v : per_kind) v.store(0, std::memory_order_relaxed);
   }

@@ -28,14 +28,24 @@
 namespace tpunet {
 
 // Values cross the bootstrap handshake as one byte; keep them stable.
-enum class CollAlgo : uint8_t { kAuto = 0, kRing = 1, kRhd = 2, kTree = 3 };
-constexpr int kCollAlgoCount = 4;  // including kAuto
+// kHier is the two-level schedule (intra-host stage + one-rank-per-host
+// inter-host stage, docs/DESIGN.md "Hierarchical collectives"); it needs a
+// hierarchical topology (>= 2 hosts, uniform ranks/host) and resolves back
+// to ring where the topology is flat.
+enum class CollAlgo : uint8_t {
+  kAuto = 0,
+  kRing = 1,
+  kRhd = 2,
+  kTree = 3,
+  kHier = 4,
+};
+constexpr int kCollAlgoCount = 5;  // including kAuto
 
 enum class CollKind : uint8_t { kAllReduce = 0, kBroadcast = 1 };
 constexpr int kCollKindCount = 2;
 
-// "auto" / "ring" / "rhd" / "tree" <-> CollAlgo. Parse returns false on an
-// unknown name.
+// "auto" / "ring" / "rhd" / "tree" / "hier" <-> CollAlgo. Parse returns
+// false on an unknown name.
 bool ParseCollAlgo(const std::string& name, CollAlgo* out);
 const char* CollAlgoName(CollAlgo a);
 const char* CollKindName(CollKind c);
@@ -71,6 +81,18 @@ Status LoadDispatchTableFile(const std::string& path, DispatchTable* out);
 CollAlgo SelectCollAlgo(const DispatchTable& table, CollAlgo override_algo,
                         CollKind coll, uint64_t nbytes, int world);
 
+// Topology post-pass on the resolved schedule (the selector is pure
+// (coll, size, world) — host grouping lives in the communicator):
+//   * kHier on a flat/irregular topology (!usable) degrades to ring — the
+//     counter then records what RAN, the bcast-rhd-fallback stance.
+//   * Under pure built-in auto selection (no override, no table), a
+//     large-payload ring AllReduce on a PROFITABLE hierarchy (>= 2 hosts,
+//     uniform R >= 2 ranks/host) upgrades to hier: the intra-host stages
+//     ride shared memory / loopback while per-rank DCN wire bytes drop by
+//     ~R x. Deterministic from negotiated state, so every rank agrees.
+CollAlgo ApplyHierPolicy(CollAlgo a, CollKind coll, uint64_t nbytes,
+                         bool usable, bool profitable, bool builtin_auto);
+
 // ---- Counters --------------------------------------------------------------
 // tpunet_coll_steps_total{algo}: sequential wire rounds executed by THIS
 // rank, per schedule — the noise-immune form of the latency claim (ring
@@ -79,6 +101,11 @@ CollAlgo SelectCollAlgo(const DispatchTable& table, CollAlgo override_algo,
 // dispatch decisions, labeled by the RESOLVED schedule.
 void CountCollSteps(CollAlgo a, uint64_t n = 1);
 void CountCollAlgoSelected(CollKind c, CollAlgo a);
+// The hierarchical schedule's wire rounds count per STAGE
+// (algo="hier.intra" / "hier.inter") — per-rank DCN rounds shrinking while
+// intra-host rounds ride shared memory IS the hier claim.
+void CountHierSteps(bool inter, uint64_t n = 1);
+uint64_t HierStepsTotal(bool inter);
 uint64_t CollStepsTotal(CollAlgo a);
 uint64_t CollAlgoSelectedTotal(CollKind c, CollAlgo a);
 void ResetCollDispatchCounters();

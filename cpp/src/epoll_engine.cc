@@ -1050,7 +1050,7 @@ struct CommHandle {
   Loop* loop = nullptr;
 };
 
-class EpollEngine : public EngineBase {
+class EpollEngine : public EngineBase, public BundleAdopter {
  public:
   EpollEngine()
       : inline_io_(GetEnvU64("TPUNET_EPOLL_INLINE", 1) != 0) {
@@ -1084,6 +1084,20 @@ class EpollEngine : public EngineBase {
     PartialBundle b;
     Status s = AcceptBundleOn(listen_comm, &b);
     if (!s.ok()) return s;
+    return AdoptBundle(b, recv_comm);
+  }
+
+  // BundleAdopter seam (wire.h): the SHM engine fronts this engine on one
+  // listen socket and hands non-SHM bundles back here.
+  Status AdoptBundle(PartialBundle& b, uint64_t* recv_comm) override {
+    if ((b.flags & kPreambleFlagShm) != 0) {
+      // SHM hello on a plain TCP engine: the peer runs TPUNET_SHM=1, this
+      // process does not — a zero-stream comm would hang; fail loudly.
+      b.CloseAll();
+      return Status::Inner(
+          "peer attempted shared-memory transport but TPUNET_SHM is not "
+          "enabled here — set TPUNET_SHM identically on every rank");
+    }
     std::vector<int> data_fds;
     for (auto& kv : b.data_fds) data_fds.push_back(kv.second);  // stream-id order
     int ctrl_fd = b.ctrl_fd;

@@ -188,6 +188,46 @@ FaultAction FaultPreIO(bool is_send, uint64_t stream_idx, int fd, size_t nbytes)
   return FaultAction::kNone;
 }
 
+FaultAction FaultPreMem(bool is_send, uint64_t stream_idx, size_t nbytes) {
+  FaultSpec spec;
+  {
+    MutexLock lk(g_mu);
+    if (g_fault_armed.load(std::memory_order_acquire) == 0) return FaultAction::kNone;
+    spec = g_spec;
+  }
+  if (spec.side == 1 && !is_send) return FaultAction::kNone;
+  if (spec.side == 2 && is_send) return FaultAction::kNone;
+  if (spec.stream >= 0 && static_cast<uint64_t>(spec.stream) != stream_idx) {
+    return FaultAction::kNone;
+  }
+  uint64_t before = g_bytes.fetch_add(nbytes, std::memory_order_relaxed);
+  if (before < spec.after_bytes) return FaultAction::kNone;
+  switch (spec.action) {
+    case FaultAction::kClose:
+      if (g_latched.exchange(1, std::memory_order_acq_rel)) return FaultAction::kNone;
+      Telemetry::Get().OnFaultInjected(static_cast<int>(FaultAction::kClose));
+      return FaultAction::kClose;  // caller fails the segment over
+    case FaultAction::kCorrupt:
+      if (g_latched.exchange(1, std::memory_order_acq_rel)) return FaultAction::kNone;
+      Telemetry::Get().OnFaultInjected(static_cast<int>(FaultAction::kCorrupt));
+      return FaultAction::kCorrupt;
+    case FaultAction::kStall:
+      if (!g_latched.exchange(1, std::memory_order_acq_rel)) {
+        Telemetry::Get().OnFaultInjected(static_cast<int>(FaultAction::kStall));
+      }
+      return FaultAction::kStall;  // caller parks against its abort flag
+    case FaultAction::kDelay:
+      if (!g_latched.exchange(1, std::memory_order_acq_rel)) {
+        Telemetry::Get().OnFaultInjected(static_cast<int>(FaultAction::kDelay));
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(spec.delay_ms));
+      return FaultAction::kNone;
+    case FaultAction::kNone:
+      break;
+  }
+  return FaultAction::kNone;
+}
+
 void FaultStall(int fd) {
   // Hold until disarmed or the fd dies (watchdog abort / comm teardown
   // shutdown(2)s it, which raises POLLHUP even for a local half-close).

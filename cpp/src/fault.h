@@ -87,6 +87,14 @@ void ArmFaultFromEnv();
 //             side: in the received bytes before CRC verification)
 FaultAction FaultPreIO(bool is_send, uint64_t stream_idx, int fd, size_t nbytes);
 
+// Memory-transport variant (the SHM engine's ring has no fd to shutdown):
+// identical matching/latching/telemetry, but kClose and kStall are RETURNED
+// instead of applied — the caller owns the side effect (close = fail over
+// the segment to the TCP ctrl path; stall = park against its own abort
+// flag). kDelay still sleeps internally; kCorrupt means flip a byte of the
+// ring copy, never the caller's buffer, like the socket path.
+FaultAction FaultPreMem(bool is_send, uint64_t stream_idx, size_t nbytes);
+
 extern std::atomic<uint32_t> g_fault_armed;
 
 inline FaultAction FaultCheck(bool is_send, uint64_t stream_idx, int fd, size_t nbytes) {

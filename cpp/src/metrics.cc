@@ -244,6 +244,12 @@ struct Telemetry::Impl {
   std::atomic<uint64_t> lane_bytes[kMaxStreamStats][2] = {};
   std::atomic<uint64_t> restripe_events{0};
 
+  // Intra-host SHM transport: ring payload bytes per direction + futex
+  // wake syscalls (shm_engine.cc; docs/DESIGN.md "Intra-host shared
+  // memory").
+  std::atomic<uint64_t> shm_bytes[2] = {};
+  std::atomic<uint64_t> shm_wakeups{0};
+
   // Fairness window (win_mu): Jain's index over per-stream byte deltas
   // between rolls. Rolled lazily from Snapshot() at most once per
   // TPUNET_FAIRNESS_WINDOW_MS; the first roll covers everything since
@@ -638,6 +644,14 @@ void Telemetry::OnRestripe() {
   impl_->restripe_events.fetch_add(1, std::memory_order_relaxed);
 }
 
+void Telemetry::OnShmBytes(bool is_send, uint64_t nbytes) {
+  impl_->shm_bytes[is_send ? 0 : 1].fetch_add(nbytes, std::memory_order_relaxed);
+}
+
+void Telemetry::OnShmWakeup() {
+  impl_->shm_wakeups.fetch_add(1, std::memory_order_relaxed);
+}
+
 void Telemetry::OnRequestStages(uint64_t post_us, uint64_t first_wire_us,
                                 uint64_t last_wire_us) {
   if (post_us == 0) return;  // engine predates stamping / synthetic request
@@ -742,6 +756,9 @@ void Telemetry::Reset() {
     im->lane_bytes[i][1].store(0, std::memory_order_relaxed);
   }
   im->restripe_events.store(0, std::memory_order_relaxed);
+  im->shm_bytes[0].store(0, std::memory_order_relaxed);
+  im->shm_bytes[1].store(0, std::memory_order_relaxed);
+  im->shm_wakeups.store(0, std::memory_order_relaxed);
   for (int i = 0; i < kFaultActionSlots; ++i) {
     im->faults_injected[i].store(0, std::memory_order_relaxed);
   }
@@ -860,6 +877,9 @@ MetricsSnapshot Telemetry::Snapshot() const {
     s.lane_bytes[i][1] = im->lane_bytes[i][1].load(std::memory_order_relaxed);
   }
   s.restripe_events = im->restripe_events.load(std::memory_order_relaxed);
+  s.shm_bytes[0] = im->shm_bytes[0].load(std::memory_order_relaxed);
+  s.shm_bytes[1] = im->shm_bytes[1].load(std::memory_order_relaxed);
+  s.shm_wakeups = im->shm_wakeups.load(std::memory_order_relaxed);
   s.straggler_events = im->straggler_events.load(std::memory_order_relaxed);
   s.isend_count = im->isend_count.load(std::memory_order_relaxed);
   s.irecv_count = im->irecv_count.load(std::memory_order_relaxed);
@@ -898,6 +918,12 @@ MetricsSnapshot Telemetry::Snapshot() const {
   for (int a = 0; a < 3; ++a) {
     // Snapshot slot a maps to CollAlgo a+1 (kAuto never executes a step).
     s.coll_steps[a] = CollStepsTotal(static_cast<CollAlgo>(a + 1));
+  }
+  // Hierarchical schedule: its two stages count separately (slots 3/4 =
+  // hier.intra/hier.inter) — the DCN-round shrinkage IS the claim.
+  s.coll_steps[3] = HierStepsTotal(false);
+  s.coll_steps[4] = HierStepsTotal(true);
+  for (int a = 0; a < 4; ++a) {
     for (int k = 0; k < kCollKindCount; ++k) {
       s.coll_algo_selected[k][a] =
           CollAlgoSelectedTotal(static_cast<CollKind>(k), static_cast<CollAlgo>(a + 1));
@@ -1103,6 +1129,24 @@ std::string Telemetry::PrometheusText() const {
          "(each re-stripes subsequent messages on both sides).");
   emit("tpunet_restripe_events_total{rank=\"%lld\"} %llu\n", (long long)rank,
        (unsigned long long)s.restripe_events);
+  // Intra-host SHM transport families (docs/DESIGN.md "Intra-host shared
+  // memory"). Both dir series emit even at zero so the shm smoke lane can
+  // assert "TCP moved, SHM did not" (and vice versa) without missing-series
+  // special cases.
+  family("tpunet_shm_bytes_total", "counter",
+         "Payload bytes moved through intra-host shared-memory ring "
+         "segments, by direction (TPUNET_SHM=1; never counted into the TCP "
+         "stream/QoS byte families).");
+  emit("tpunet_shm_bytes_total{rank=\"%lld\",dir=\"tx\"} %llu\n", (long long)rank,
+       (unsigned long long)s.shm_bytes[0]);
+  emit("tpunet_shm_bytes_total{rank=\"%lld\",dir=\"rx\"} %llu\n", (long long)rank,
+       (unsigned long long)s.shm_bytes[1]);
+  family("tpunet_shm_wakeups_total", "counter",
+         "Futex wake syscalls issued by the SHM ring protocol (bytes/wakeup "
+         "is the ring's syscalls/MiB analogue — steady-state streaming "
+         "should wake rarely).");
+  emit("tpunet_shm_wakeups_total{rank=\"%lld\"} %llu\n", (long long)rank,
+       (unsigned long long)s.shm_wakeups);
   // Request stage-latency histograms: queueing delay separable from wire time.
   auto stage_hist = [&](const char* name, const char* help, const StageHist& h) {
     family(name, "histogram", help);
@@ -1231,13 +1275,18 @@ std::string Telemetry::PrometheusText() const {
   // Schedule-dispatch counters (docs/DESIGN.md "Schedules & algorithm
   // selection"). Every algo series emits even at zero so step-budget
   // assertions (perf smoke) can pin "ring executed NO steps" directly.
-  static const char* kAlgoNames[3] = {"ring", "rhd", "tree"};
+  // Step slots 3/4 are the hierarchical schedule's two stages: the claim is
+  // precisely that hier.inter (the DCN wire rounds) shrinks by ~R x while
+  // hier.intra rides shared memory.
+  static const char* kAlgoNames[5] = {"ring", "rhd", "tree", "hier.intra",
+                                      "hier.inter"};
+  static const char* kSelAlgoNames[4] = {"ring", "rhd", "tree", "hier"};
   static const char* kCollNames[2] = {"allreduce", "broadcast"};
   family("tpunet_coll_steps_total", "counter",
          "Sequential collective wire rounds executed by this rank, per "
          "schedule (ring AllReduce = 2(W-1); rhd = 2*log2(W'); tree <= "
-         "2*ceil(log2 W)).");
-  for (int a = 0; a < 3; ++a) {
+         "2*ceil(log2 W); hier = 2(R-1) intra-host + 2(H-1) inter-host).");
+  for (int a = 0; a < 5; ++a) {
     emit("tpunet_coll_steps_total{rank=\"%lld\",algo=\"%s\"} %llu\n",
          (long long)rank, kAlgoNames[a], (unsigned long long)s.coll_steps[a]);
   }
@@ -1245,9 +1294,9 @@ std::string Telemetry::PrometheusText() const {
          "Collective dispatch decisions, by collective and RESOLVED "
          "schedule (override > TPUNET_DISPATCH_TABLE > built-ins).");
   for (int k = 0; k < 2; ++k) {
-    for (int a = 0; a < 3; ++a) {
+    for (int a = 0; a < 4; ++a) {
       emit("tpunet_coll_algo_selected_total{rank=\"%lld\",coll=\"%s\",algo=\"%s\"} %llu\n",
-           (long long)rank, kCollNames[k], kAlgoNames[a],
+           (long long)rank, kCollNames[k], kSelAlgoNames[a],
            (unsigned long long)s.coll_algo_selected[k][a]);
     }
   }
@@ -1313,16 +1362,19 @@ bool Telemetry::FlushTrace() {
         break;
       case Span::Kind::kColl:
         // Collective phase span: (comm_id, coll_seq, name) is the cross-rank
-        // join key merge_traces() aligns per-rank timelines with.
+        // join key merge_traces() aligns per-rank timelines with. The host
+        // tag (utils.h HostId(), hex string so JSON consumers never round
+        // a 64-bit id) lets merge_traces() group same-host ranks under ONE
+        // Perfetto track group instead of interleaving them.
         fprintf(f,
                 ",\n{\"name\":\"%s\",\"ph\":\"X\",\"pid\":%lld,\"tid\":%llu,"
                 "\"ts\":%llu,\"dur\":%llu,\"args\":{\"comm_id\":%llu,"
-                "\"coll_seq\":%llu,\"nbytes\":%llu}}",
+                "\"coll_seq\":%llu,\"nbytes\":%llu,\"host\":\"%016llx\"}}",
                 s.name.c_str(), (long long)im->rank,
                 (unsigned long long)(s.comm & 0xffff),
                 (unsigned long long)s.start_us, (unsigned long long)s.dur_us,
                 (unsigned long long)s.comm, (unsigned long long)s.req,
-                (unsigned long long)s.nbytes);
+                (unsigned long long)s.nbytes, (unsigned long long)HostId());
         break;
       case Span::Kind::kInstant:
         fprintf(f,

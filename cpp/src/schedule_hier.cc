@@ -1,0 +1,414 @@
+// Hierarchical two-level AllReduce (HiCCL-style, arxiv 2408.05962) over the
+// pairwise mesh:
+//
+//   1. INTRA-HOST ReduceScatter: the R ranks sharing a host id run a ring
+//      reduce-scatter over the mesh comms (which, under TPUNET_SHM=1, are
+//      shared-memory ring segments — the stage the hierarchy makes cheap).
+//      Local rank index i ends owning shard (i+1) mod R of the R-way
+//      partition, fully reduced within the host.
+//   2. INTER-HOST stage, one rank per host: the H ranks with the same local
+//      index — exactly one per host — AllReduce their owned shard over the
+//      DCN. Schedule reuse: the dispatch table / built-ins pick ring or
+//      recursive halving-doubling for the SHARD size at world H, so the
+//      offline-tuned table drives the inter stage too. Per-rank DCN wire
+//      bytes: 2*(S/R)*(H-1)/H — the ~R x cut vs the flat ring's
+//      2*S*(W-1)/W that the counter tests gate.
+//   3. INTRA-HOST AllGather: the local ring forwards the finished shards
+//      byte-verbatim, so every rank of a host materializes identical bytes.
+//
+// Topology comes from host_ids_ (the Init handshake blob: HostId() per
+// rank). Usable = >= 2 distinct hosts AND every host carries the same rank
+// count R (shard-parallel inter groups need a full column per shard);
+// anything else resolves back to ring in ApplyHierPolicy.
+//
+// Wire codec (TPUNET_WIRE_DTYPE != f32, f32 payloads): only the INTER stage
+// compresses — intra-host hops are memory-cheap by construction, and
+// keeping them exact means quantization enters only at DCN hops. The inter
+// ring's RS half runs the fused decode+reduce with f32 accumulation; the
+// handoff quantizes the owned segment (CodecDecodeReduceQuantize) and the
+// AG half forwards those encoded segments VERBATIM, so every member of an
+// inter group decodes identical bytes — and the intra AG then spreads those
+// identical bytes across the host: all W ranks bit-identical, the PR 5/6
+// contract.
+//
+// Step accounting: every intra wire round bumps hier.intra, every inter
+// round hier.inter (dispatch.h CountHierSteps) — the DCN-round shrinkage is
+// the claim the counters carry.
+#include <string.h>
+
+#include <algorithm>
+#include <map>
+#include <vector>
+
+#include "coll_comm.h"
+
+namespace tpunet {
+namespace internal {
+
+namespace {
+
+// Shard j of an R-way partition of [0, count): [lo, hi).
+void ShardRange(size_t count, size_t parts, size_t j, size_t* lo, size_t* hi) {
+  *lo = count * j / parts;
+  *hi = count * (j + 1) / parts;
+}
+
+struct HierTopo {
+  std::vector<int> local;  // ranks on my host, ascending
+  std::vector<int> inter;  // rank with my local index on each host, host order
+  size_t li = 0;           // my index in `local`
+  size_t hi = 0;           // my host's index in `inter`
+  size_t R = 0, H = 0;
+  bool uniform = false;
+};
+
+// Hosts are ordered by their lowest rank; ranks within a host ascend — every
+// rank derives the identical grouping from the identical host_ids_ vector.
+HierTopo BuildTopo(int rank, const std::vector<uint64_t>& ids) {
+  HierTopo t;
+  if (ids.empty()) return t;
+  std::vector<uint64_t> host_order;
+  std::map<uint64_t, std::vector<int>> groups;
+  for (int r = 0; r < static_cast<int>(ids.size()); ++r) {
+    auto it = groups.find(ids[r]);
+    if (it == groups.end()) {
+      host_order.push_back(ids[r]);
+      groups[ids[r]] = {r};
+    } else {
+      it->second.push_back(r);  // ascending by construction
+    }
+  }
+  t.H = host_order.size();
+  t.local = groups[ids[rank]];
+  t.R = t.local.size();
+  t.uniform = true;
+  for (uint64_t h : host_order) {
+    if (groups[h].size() != t.R) t.uniform = false;
+  }
+  for (size_t i = 0; i < t.local.size(); ++i) {
+    if (t.local[i] == rank) t.li = i;
+  }
+  if (t.uniform) {
+    for (size_t h = 0; h < host_order.size(); ++h) {
+      t.inter.push_back(groups[host_order[h]][t.li]);
+      if (groups[host_order[h]][t.li] == rank) t.hi = h;
+    }
+  }
+  return t;
+}
+
+}  // namespace
+
+bool ScheduledCommunicator::HierUsable() const {
+  if (static_cast<int>(host_ids_.size()) != world_ || world_ < 2) return false;
+  HierTopo t = BuildTopo(rank_, host_ids_);
+  return t.H >= 2 && t.uniform;
+}
+
+bool ScheduledCommunicator::HierProfitable() const {
+  if (static_cast<int>(host_ids_.size()) != world_ || world_ < 2) return false;
+  HierTopo t = BuildTopo(rank_, host_ids_);
+  // R == 1 makes hier == a flat inter AllReduce — legal under an explicit
+  // override, but no reason for auto to leave the tuned ring path.
+  return t.H >= 2 && t.uniform && t.R >= 2;
+}
+
+// Ring step with distinct send/recv peers over the mesh: irecv first, wait
+// both even on error (no abandoned in-flight request may touch a freed
+// buffer — the MeshExchange contract).
+Status ScheduledCommunicator::MeshShift(int to, const void* sendbuf,
+                                        size_t send_nbytes, int from,
+                                        void* recvbuf, size_t recv_nbytes) {
+  if (to == from) {
+    return MeshExchange(to, sendbuf, send_nbytes, recvbuf, recv_nbytes);
+  }
+  uint64_t rreq = 0, sreq = 0;
+  bool rlive = false, slive = false;
+  Status st;
+  if (recv_nbytes > 0) {
+    st = net_->irecv(mesh_recv_[from], recvbuf, recv_nbytes, &rreq);
+    if (!st.ok()) return st;
+    rlive = true;
+  }
+  if (send_nbytes > 0) {
+    st = net_->isend(mesh_send_[to], sendbuf, send_nbytes, &sreq);
+    if (!st.ok()) {
+      if (rlive) WaitRequest(rreq, nullptr);
+      return st;
+    }
+    slive = true;
+  }
+  size_t got = 0;
+  Status r_st = rlive ? WaitRequest(rreq, &got) : Status::Ok();
+  Status s_st = slive ? WaitRequest(sreq, nullptr) : Status::Ok();
+  if (!r_st.ok()) return r_st;
+  if (!s_st.ok()) return s_st;
+  if (rlive && got != recv_nbytes) {
+    return Status::Inner("hier ring step size mismatch: expected " +
+                         std::to_string(recv_nbytes) + "B from rank " +
+                         std::to_string(from) + ", got " + std::to_string(got) +
+                         "B (ranks disagree on collective arguments?)");
+  }
+  return Status::Ok();
+}
+
+// In-place AllReduce over an ordered subgroup: ring reduce-scatter then
+// ring all-gather across the group's G-way partition of [0, count). Used
+// for the hier INTER stage (inter=true; codec engages for f32) and as the
+// building block both intra stages inline around. `idx` is my position in
+// `group` (group[idx] == rank_).
+Status ScheduledCommunicator::SubgroupAllReduce(const std::vector<int>& group,
+                                                size_t idx, uint8_t* data,
+                                                size_t count, DType dtype,
+                                                RedOp op, bool inter,
+                                                uint64_t seq) {
+  const size_t G = group.size();
+  if (G <= 1 || count == 0) return Status::Ok();
+  const size_t esize = DTypeSize(dtype);
+  const bool tracing = Telemetry::Get().tracing_enabled();
+  const int next = group[(idx + 1) % G];
+  const int prev = group[(idx + G - 1) % G];
+  const bool codec_on = inter && UseCodec(dtype);
+  const WireRedOp wop = ToWireRedOp(op);
+  float* data_f = reinterpret_cast<float*>(data);
+  const char* kind = inter ? "hier.inter" : "hier.sub";
+
+  // Segment geometry: G-way partition, identical on every member. For the
+  // codec path, each segment's encoded form lives at a fixed offset in the
+  // assembly buffer (int8 scale blocks restart per segment), so AG hops can
+  // forward encoded bytes verbatim.
+  std::vector<size_t> seg_lo(G), seg_hi(G), wire_off(G + 1, 0);
+  for (size_t j = 0; j < G; ++j) {
+    ShardRange(count, G, j, &seg_lo[j], &seg_hi[j]);
+    wire_off[j + 1] =
+        wire_off[j] +
+        (codec_on ? CodecWireBytes(codec_, seg_hi[j] - seg_lo[j]) : 0);
+  }
+  if (codec_on) mesh_enc_.reserve(wire_off[G]);
+  size_t max_seg = 0;
+  for (size_t j = 0; j < G; ++j) max_seg = std::max(max_seg, seg_hi[j] - seg_lo[j]);
+  mesh_scratch_.reserve(codec_on ? 2 * CodecWireBytes(codec_, max_seg)
+                                 : max_seg * esize);
+
+  // ---- Reduce-scatter half: G-1 ring steps. At step t I send segment
+  // (idx - t) mod G (my running partial) and receive (idx - t - 1) mod G,
+  // folding it into my partial. After G-1 steps I own segment (idx+1) mod G
+  // fully reduced.
+  for (size_t t = 0; t + 1 < G; ++t) {
+    size_t s_j = (idx + G - t) % G;
+    size_t r_j = (idx + G - t - 1) % G;
+    size_t s_n = seg_hi[s_j] - seg_lo[s_j], r_n = seg_hi[r_j] - seg_lo[r_j];
+    PhaseSpan sp(tracing, trace_comm_id_, seq, kind, static_cast<int>(t),
+                 s_n * esize);
+    CountHierSteps(inter);
+    Status st;
+    const bool last = t + 2 == G;
+    if (codec_on) {
+      uint8_t* enc_send = mesh_scratch_.data();
+      uint8_t* enc_recv = mesh_scratch_.data() + CodecWireBytes(codec_, max_seg);
+      CodecEncode(codec_, data_f + seg_lo[s_j], enc_send, s_n);
+      st = MeshShift(next, enc_send, CodecWireBytes(codec_, s_n), prev,
+                     enc_recv, CodecWireBytes(codec_, r_n));
+      if (!st.ok()) return st;
+      if (last) {
+        // Handoff: quantize the owned segment, park its encoded bytes in
+        // the assembly the AG half forwards verbatim; `data` holds the
+        // decode of those bytes — what every peer will materialize.
+        CodecDecodeReduceQuantize(codec_, data_f + seg_lo[r_j], nullptr,
+                                  enc_recv, mesh_enc_.data() + wire_off[r_j],
+                                  r_n, wop);
+      } else {
+        CodecDecodeReduce(codec_, data_f + seg_lo[r_j], nullptr, enc_recv, r_n,
+                          wop);
+      }
+    } else {
+      st = MeshShift(next, data + seg_lo[s_j] * esize, s_n * esize, prev,
+                     mesh_scratch_.data(), r_n * esize);
+      if (!st.ok()) return st;
+      Reduce(data + seg_lo[r_j] * esize, data + seg_lo[r_j] * esize,
+             mesh_scratch_.data(), r_n, dtype, op);
+    }
+  }
+
+  // ---- All-gather half: G-1 ring steps forwarding finished segments. At
+  // step t I send segment (idx + 1 - t) mod G and receive (idx - t) mod G.
+  // Codec: encoded assembly spans forward verbatim; each member decodes the
+  // SAME bytes per segment — bit-identity across the group.
+  for (size_t t = 0; t + 1 < G; ++t) {
+    size_t s_j = (idx + 1 + G - t) % G;
+    size_t r_j = (idx + G - t) % G;
+    size_t s_n = seg_hi[s_j] - seg_lo[s_j], r_n = seg_hi[r_j] - seg_lo[r_j];
+    PhaseSpan sp(tracing, trace_comm_id_, seq, kind,
+                 static_cast<int>(G - 1 + t), s_n * esize);
+    CountHierSteps(inter);
+    Status st;
+    if (codec_on) {
+      st = MeshShift(next, mesh_enc_.data() + wire_off[s_j],
+                     CodecWireBytes(codec_, s_n), prev,
+                     mesh_enc_.data() + wire_off[r_j],
+                     CodecWireBytes(codec_, r_n));
+      if (!st.ok()) return st;
+      CodecDecode(codec_, mesh_enc_.data() + wire_off[r_j], data_f + seg_lo[r_j],
+                  r_n);
+    } else {
+      st = MeshShift(next, data + seg_lo[s_j] * esize, s_n * esize, prev,
+                     data + seg_lo[r_j] * esize, r_n * esize);
+      if (!st.ok()) return st;
+    }
+  }
+  return Status::Ok();
+}
+
+// Halving-doubling subgroup AllReduce: log-depth rounds for the inter-host
+// stage when the dispatch layer picks rhd for (shard size, H). Power-of-two
+// groups, uncompressed payloads (callers route codec / non-pow2 to the
+// subgroup ring). Same vector-halving recursion as schedule_rhd.cc's active
+// branch, with subgroup indices in place of virtual ranks.
+Status ScheduledCommunicator::SubgroupRhdAllReduce(const std::vector<int>& group,
+                                                   size_t idx, uint8_t* data,
+                                                   size_t count, DType dtype,
+                                                   RedOp op, uint64_t seq) {
+  const size_t G = group.size();
+  if (G <= 1 || count == 0) return Status::Ok();
+  const size_t esize = DTypeSize(dtype);
+  const bool tracing = Telemetry::Get().tracing_enabled();
+  mesh_scratch_.reserve(((count + 1) / 2) * esize);
+  struct Level {
+    size_t lo, hi, mid;
+    int peer;
+    bool keep_low;
+  };
+  std::vector<Level> levels;
+  size_t lo = 0, hi = count;
+  int step = 0;
+  for (size_t mask = 1; mask < G; mask <<= 1, ++step) {
+    const int peer = group[idx ^ mask];
+    const size_t mid = lo + (hi - lo) / 2;
+    const bool keep_low = (idx & mask) == 0;
+    const size_t k_lo = keep_low ? lo : mid, k_hi = keep_low ? mid : hi;
+    const size_t s_lo = keep_low ? mid : lo, s_hi = keep_low ? hi : mid;
+    PhaseSpan sp(tracing, trace_comm_id_, seq, "hier.inter", step,
+                 (s_hi - s_lo) * esize);
+    CountHierSteps(/*inter=*/true);
+    Status s = MeshExchange(peer, data + s_lo * esize, (s_hi - s_lo) * esize,
+                            mesh_scratch_.data(), (k_hi - k_lo) * esize);
+    if (!s.ok()) return s;
+    Reduce(data + k_lo * esize, data + k_lo * esize, mesh_scratch_.data(),
+           k_hi - k_lo, dtype, op);
+    levels.push_back({lo, hi, mid, peer, keep_low});
+    lo = k_lo;
+    hi = k_hi;
+  }
+  for (int k = static_cast<int>(levels.size()) - 1; k >= 0; --k) {
+    const Level& lv = levels[k];
+    const size_t sib_lo = lv.keep_low ? lv.mid : lv.lo;
+    const size_t sib_hi = lv.keep_low ? lv.hi : lv.mid;
+    PhaseSpan sp(tracing, trace_comm_id_, seq, "hier.inter",
+                 step + static_cast<int>(levels.size()) - 1 - k,
+                 (hi - lo) * esize);
+    CountHierSteps(/*inter=*/true);
+    Status s = MeshExchange(lv.peer, data + lo * esize, (hi - lo) * esize,
+                            data + sib_lo * esize, (sib_hi - sib_lo) * esize);
+    if (!s.ok()) return s;
+    lo = lv.lo;
+    hi = lv.hi;
+  }
+  return Status::Ok();
+}
+
+Status ScheduledCommunicator::DoAllReduceHier(const void* sendbuf, void* recvbuf,
+                                              size_t count, DType dtype,
+                                              RedOp op, uint64_t seq) {
+  const size_t esize = DTypeSize(dtype);
+  const bool tracing = Telemetry::Get().tracing_enabled();
+  PhaseSpan whole(tracing, trace_comm_id_, seq, "allreduce", -1, count * esize);
+  HierTopo t = BuildTopo(rank_, host_ids_);
+  if (t.H < 2 || !t.uniform) {
+    // ApplyHierPolicy keeps this unreachable; belt-and-braces for an
+    // explicit override racing an exotic topology.
+    return Status::Inner("hier schedule on a non-hierarchical topology");
+  }
+  Status s = EnsureMeshQuiesced();
+  if (!s.ok()) return s;
+  uint8_t* data = static_cast<uint8_t*>(recvbuf);
+  if (sendbuf != recvbuf) memmove(recvbuf, sendbuf, count * esize);
+  if (count == 0) return Status::Ok();
+
+  const size_t R = t.R;
+  const int next = t.local[(t.li + 1) % R];
+  const int prev = t.local[(t.li + R - 1) % R];
+
+  // ---- Stage 1: intra-host ring ReduceScatter (R-1 memory-cheap rounds).
+  // Step arithmetic matches SubgroupAllReduce's RS half; inlined here
+  // because stage 3 needs the shards left IN PLACE, not re-gathered.
+  size_t max_shard = 0;
+  for (size_t j = 0; j < R; ++j) {
+    size_t lo, hi;
+    ShardRange(count, R, j, &lo, &hi);
+    max_shard = std::max(max_shard, hi - lo);
+  }
+  mesh_scratch_.reserve(max_shard * esize);
+  for (size_t st = 0; st + 1 < R; ++st) {
+    size_t s_j = (t.li + R - st) % R;
+    size_t r_j = (t.li + R - st - 1) % R;
+    size_t s_lo, s_hi, r_lo, r_hi;
+    ShardRange(count, R, s_j, &s_lo, &s_hi);
+    ShardRange(count, R, r_j, &r_lo, &r_hi);
+    PhaseSpan sp(tracing, trace_comm_id_, seq, "hier.rs", static_cast<int>(st),
+                 (s_hi - s_lo) * esize);
+    CountHierSteps(/*inter=*/false);
+    s = MeshShift(next, data + s_lo * esize, (s_hi - s_lo) * esize, prev,
+                  mesh_scratch_.data(), (r_hi - r_lo) * esize);
+    if (!s.ok()) return s;
+    Reduce(data + r_lo * esize, data + r_lo * esize, mesh_scratch_.data(),
+           r_hi - r_lo, dtype, op);
+  }
+  const size_t own = (t.li + 1) % R;  // my host-reduced shard
+
+  // ---- Stage 2: inter-host AllReduce of the owned shard, one rank per
+  // host. Schedule reuse: resolve ring-vs-rhd for the SHARD size at world H
+  // through the same selector the top level uses (hier/tree map onto the
+  // ring subgroup — tree's reduce+bcast shape isn't an in-place subgroup
+  // primitive here, and recursion would be silly).
+  size_t own_lo, own_hi;
+  ShardRange(count, R, own, &own_lo, &own_hi);
+  if (own_hi > own_lo) {
+    CollAlgo inter_algo =
+        SelectCollAlgo(dispatch_, CollAlgo::kAuto, CollKind::kAllReduce,
+                       (own_hi - own_lo) * esize, static_cast<int>(t.H));
+    // rhd needs a power-of-two group and an uncompressed payload (the
+    // subgroup ring's verbatim-forwarding AG is where codec bit-identity
+    // lives); everything else — including tree/hier verdicts, which have
+    // no in-place subgroup shape here — runs the ring. Both move the same
+    // 2*(H-1)/H bytes; the table's verdict trades round count only.
+    const bool pow2 = (t.H & (t.H - 1)) == 0;
+    if (inter_algo == CollAlgo::kRhd && pow2 && !UseCodec(dtype)) {
+      s = SubgroupRhdAllReduce(t.inter, t.hi, data + own_lo * esize,
+                               own_hi - own_lo, dtype, op, seq);
+    } else {
+      s = SubgroupAllReduce(t.inter, t.hi, data + own_lo * esize,
+                            own_hi - own_lo, dtype, op, /*inter=*/true, seq);
+    }
+    if (!s.ok()) return s;
+  }
+
+  // ---- Stage 3: intra-host ring AllGather (R-1 rounds, bytes forwarded
+  // verbatim — cross-rank bit-identity rides on the inter stage's).
+  for (size_t st = 0; st + 1 < R; ++st) {
+    size_t s_j = (t.li + 1 + R - st) % R;
+    size_t r_j = (t.li + R - st) % R;
+    size_t s_lo, s_hi, r_lo, r_hi;
+    ShardRange(count, R, s_j, &s_lo, &s_hi);
+    ShardRange(count, R, r_j, &r_lo, &r_hi);
+    PhaseSpan sp(tracing, trace_comm_id_, seq, "hier.ag", static_cast<int>(st),
+                 (s_hi - s_lo) * esize);
+    CountHierSteps(/*inter=*/false);
+    s = MeshShift(next, data + s_lo * esize, (s_hi - s_lo) * esize, prev,
+                  data + r_lo * esize, (r_hi - r_lo) * esize);
+    if (!s.ok()) return s;
+  }
+  return Status::Ok();
+}
+
+}  // namespace internal
+}  // namespace tpunet

@@ -76,6 +76,50 @@ uint64_t ForkGeneration() {
   return g_fork_gen.load(std::memory_order_relaxed);
 }
 
+namespace {
+
+// FNV-1a 64 over a byte string — the host-id hash. Stable across processes
+// and runs (unlike std::hash), cheap, and collision-safe at per-pod host
+// counts.
+uint64_t Fnv1a64(const std::string& s) {
+  uint64_t h = 1469598103934665603ull;
+  for (unsigned char c : s) {
+    h ^= c;
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+uint64_t ComputeHostId() {
+  // Override first: TPUNET_HOST_ID lets tests split one box into fake
+  // "hosts" (and lets operators pin identity on containers that share a
+  // boot id). Any string works; it is hashed, not parsed.
+  std::string override_id = GetEnv("TPUNET_HOST_ID", "");
+  if (!override_id.empty()) return Fnv1a64("override:" + override_id) | 1ull;
+  // /proc boot_id is per-boot-unique and identical for every process on
+  // the host — containers sharing a kernel (the TPU-host pod layout) agree.
+  FILE* f = std::fopen("/proc/sys/kernel/random/boot_id", "rb");
+  if (f != nullptr) {
+    char buf[128];
+    size_t n = std::fread(buf, 1, sizeof(buf), f);
+    std::fclose(f);
+    while (n > 0 && (buf[n - 1] == '\n' || buf[n - 1] == ' ')) --n;
+    if (n > 0) return Fnv1a64("boot:" + std::string(buf, n)) | 1ull;
+  }
+  char host[256] = {0};
+  if (::gethostname(host, sizeof(host) - 1) == 0 && host[0] != '\0') {
+    return Fnv1a64("hostname:" + std::string(host)) | 1ull;
+  }
+  return 1ull;  // degenerate but stable: everything co-located
+}
+
+}  // namespace
+
+uint64_t HostId() {
+  static const uint64_t id = ComputeHostId();
+  return id;
+}
+
 int32_t GetNetIfSpeed(const std::string& ifname) {
   // Reference: utils.rs:7-23 — read /sys/class/net/<if>/speed, default 10000.
   std::ifstream f("/sys/class/net/" + ifname + "/speed");

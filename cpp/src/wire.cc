@@ -76,8 +76,12 @@ Status ReadPreamble(int fd, Preamble* p, int timeout_ms) {
   p->nstreams = DecodeU64BE(buf + 24);
   p->min_chunksize = DecodeU64BE(buf + 32);
   p->flags = DecodeU64BE(buf + 40);
-  if (p->nstreams == 0 || p->nstreams > kMaxStreams || p->stream_id > p->nstreams ||
-      p->min_chunksize == 0) {
+  // nstreams == 0 is legal ONLY for an SHM hello bundle (kPreambleFlagShm):
+  // the ctrl connection is the bundle's sole member and the data path is
+  // the shared-memory ring negotiated right after the preamble.
+  bool shm = (p->flags & kPreambleFlagShm) != 0;
+  if ((p->nstreams == 0 && !shm) || p->nstreams > kMaxStreams ||
+      p->stream_id > p->nstreams || p->min_chunksize == 0) {
     return Status::TCP("malformed preamble: nstreams=" + std::to_string(p->nstreams) +
                        " stream_id=" + std::to_string(p->stream_id));
   }

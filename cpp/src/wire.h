@@ -57,6 +57,14 @@ constexpr uint64_t kPreambleFlagQos = 1ull << 1;
 // nstreams: a receiver seeing the bit switches both its chunk->stream
 // derivation and its ctrl-frame vocabulary to the lane protocol.
 constexpr uint64_t kPreambleFlagLanes = 1ull << 2;
+// Shared-memory transport (docs/DESIGN.md "Intra-host shared memory"): the
+// connection is an SHM HELLO — nstreams is 0 (no TCP data streams; the
+// payload path is the mmap'd ring segment negotiated right after the
+// preamble on this very connection, which then stays on as the comm's ctrl
+// stream carrying LEN frames exactly like a TCP comm's). Only the SHM
+// engine (TPUNET_SHM=1) advertises the bit; a plain engine receiving it
+// rejects the bundle loudly instead of wiring a zero-stream comm.
+constexpr uint64_t kPreambleFlagShm = 1ull << 3;
 constexpr int kPreambleClassShift = 8;
 constexpr uint64_t kPreambleClassMask = 0xFull << kPreambleClassShift;
 
@@ -305,6 +313,19 @@ struct ListenSock {
   ~ListenSock();
 };
 using ListenSockPtr = std::shared_ptr<ListenSock>;
+
+// Internal seam for composing engines (the SHM engine fronts a TCP engine
+// on ONE listen socket): an engine that can adopt an already-accepted
+// connection bundle into its receive path, exactly as its own accept()
+// would have. Both TCP engines implement it; the SHM engine discovers it
+// via dynamic_cast on the inner engine it wraps.
+class BundleAdopter {
+ public:
+  virtual ~BundleAdopter() = default;
+  // Takes ownership of the bundle's fds (clears them from `b`) on success
+  // AND failure, mirroring accept().
+  virtual Status AdoptBundle(PartialBundle& b, uint64_t* recv_comm) = 0;
+};
 
 // Bind an ephemeral listening socket on `nic`; fills the rendezvous handle.
 Status ListenOn(const NicInfo& nic, int32_t dev, SocketHandle* handle, ListenSockPtr* out);

@@ -1,0 +1,149 @@
+"""CI SHM-smoke lane: W=4 single-host allreduce with intra-host shared
+memory (docs/DESIGN.md "Intra-host shared memory").
+
+Two phases:
+
+  * SINGLE HOST (the real deployment shape): all four ranks share the
+    box's host id, so under `algo=hier` the topology post-pass resolves to
+    the ring — running entirely over SHM ring segments. Gates, by counters
+    (the PR 3/5 epistemic stance): TCP engine bytes in the measured window
+    are EXACTLY 0 (every intra-host byte rode shared memory), SHM bytes
+    equal the ring's 2(W-1)/W * S per rank per iteration, and wall-clock
+    busbw meets or beats the flat-ring TCP-loopback control moving the
+    same payload (interleaved reps, medians) — SHM's box-speed claim:
+    the TCP stack and its syscalls leave the intra-host path.
+
+  * FAKE-HOST SPLIT (2 "hosts" x 2 ranks via TPUNET_HOST_ID): `hier`
+    engages for real — intra stages on the rings, inter stage on TCP —
+    and per-rank DCN (TCP) bytes land at EXACTLY the inter stage's S/R
+    per iteration, <= 0.55x the flat ring's per-rank bytes (the
+    hierarchy's wire claim; any intra byte leaking onto TCP breaks the
+    equality).
+
+Run: python tests/shm_smoke.py   (exit 0 = pass)
+"""
+
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+COUNT = 1 << 20  # 4 MiB payload
+ITERS = 6
+REPS = 3
+WORLD = 4
+
+
+def _rank(rank: int, world: int, port: int, q, mode: str) -> None:
+    try:
+        os.environ.update({
+            "TPUNET_NSTREAMS": "1", "TPUNET_ASYNC_CHANNELS": "1",
+        })
+        if mode == "shm":  # single host: hier resolves to ring-over-SHM
+            os.environ["TPUNET_SHM"] = "1"
+            os.environ["TPUNET_ALGO"] = "hier"
+        elif mode == "split":  # 2 fake hosts x 2 ranks: hier engages
+            os.environ["TPUNET_SHM"] = "1"
+            os.environ["TPUNET_ALGO"] = "hier"
+            os.environ["TPUNET_HOST_ID"] = f"smokehost{rank // 2}"
+        else:  # "tcp": flat-ring TCP-loopback control
+            os.environ["TPUNET_SHM"] = "0"
+            os.environ["TPUNET_ALGO"] = "ring"
+        import numpy as np
+
+        from tpunet import telemetry
+        from tpunet.collectives import Communicator
+
+        comm = Communicator(f"127.0.0.1:{port}", rank, world)
+        arr = np.full(COUNT, float(rank + 1), np.float32)
+        comm.all_reduce(arr)  # warmup: wires rings/mesh/segments
+        comm.barrier()
+        telemetry.reset()
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            out = comm.all_reduce(arr)
+        dt = time.perf_counter() - t0
+        m = telemetry.metrics()  # counters read BEFORE any barrier token
+        comm.barrier()
+        comm.close()
+        assert out[0] == sum(r + 1 for r in range(world))
+        tcp_tx = sum(int(v) for key, v in
+                     m.get("tpunet_qos_bytes_total", {}).items()
+                     if telemetry.labels(key)["dir"] == "tx")
+        shm_tx = sum(int(v) for key, v in
+                     m.get("tpunet_shm_bytes_total", {}).items()
+                     if telemetry.labels(key)["dir"] == "tx")
+        q.put((rank, ("OK", {"dt": dt, "tcp_tx": tcp_tx, "shm_tx": shm_tx})))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, (f"ERR: {e!r}", {})))
+
+
+def main() -> None:
+    from benchmarks import check_rank_results, spawn_ranks
+
+    failures: list = []
+    S = COUNT * 4
+    times = {"shm": [], "tcp": []}
+    ring_dcn_max = 0
+    for rep in range(REPS):  # interleaved: drift hits both lanes equally
+        for mode in ("shm", "tcp"):
+            res = check_rank_results(
+                spawn_ranks(_rank, WORLD, extra_args=(mode,), timeout=300))
+            times[mode].append(max(r["dt"] for r in res.values()))
+            if mode == "tcp":
+                ring_dcn_max = max(ring_dcn_max,
+                                   max(r["tcp_tx"] for r in res.values()))
+                continue
+            # Ring over SHM: 2(W-1)/W * S per rank per iteration, and the
+            # intra-host stage (here: everything) moved ZERO TCP bytes.
+            want_shm = ITERS * 2 * (WORLD - 1) * S // WORLD
+            for rank, r in sorted(res.items()):
+                if r["tcp_tx"] != 0:
+                    failures.append(
+                        f"rep {rep} rank {rank}: single-host allreduce moved "
+                        f"{r['tcp_tx']} TCP bytes (want exactly 0)")
+                if r["shm_tx"] != want_shm:
+                    failures.append(
+                        f"rep {rep} rank {rank}: SHM tx {r['shm_tx']} != "
+                        f"{want_shm}")
+
+    # Fake-host split: hier engages; DCN bytes exactly the inter stage.
+    res = check_rank_results(
+        spawn_ranks(_rank, WORLD, extra_args=("split",), timeout=300))
+    hier_dcn = ITERS * S // 2  # S/R per rank per iteration, R = H = 2
+    for rank, r in sorted(res.items()):
+        if r["tcp_tx"] != hier_dcn:
+            failures.append(
+                f"split rank {rank}: TCP tx {r['tcp_tx']} != inter-stage-only "
+                f"{hier_dcn} — intra bytes leaked onto TCP")
+        if r["shm_tx"] != ITERS * S:
+            failures.append(
+                f"split rank {rank}: SHM tx {r['shm_tx']} != {ITERS * S}")
+    if not hier_dcn <= 0.55 * ring_dcn_max:
+        failures.append(
+            f"hier per-rank DCN bytes {hier_dcn} > 0.55x flat ring's "
+            f"{ring_dcn_max}")
+
+    med_shm = statistics.median(times["shm"])
+    med_tcp = statistics.median(times["tcp"])
+    if med_shm > med_tcp:
+        failures.append(
+            f"SHM busbw below the TCP-loopback control: median "
+            f"{med_shm:.3f}s vs {med_tcp:.3f}s for the same payload")
+    print(f"shm_smoke: ring-over-SHM median {med_shm:.3f}s vs TCP-loopback "
+          f"control {med_tcp:.3f}s over {REPS} interleaved reps "
+          f"({ITERS}x{S >> 20} MiB, W={WORLD}); split-topology per-rank DCN "
+          f"bytes {hier_dcn} vs flat ring {ring_dcn_max} "
+          f"({hier_dcn / ring_dcn_max:.2f}x)")
+    if failures:
+        print("shm_smoke FAILURES:")
+        for f in failures:
+            print(f"  - {f}")
+        sys.exit(1)
+    print("shm_smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -173,9 +173,12 @@ def _steps_worker(rank: int, world: int, port: int, q, nbytes, env,
             got = comm.all_reduce(arr, "sum")
             m = telemetry.metrics()
         assert got[0] == sum(r + 1 for r in range(world))
-        steps = {a: 0 for a in _ALGOS}
+        # All series emit (including hier.intra/hier.inter at zero) — build
+        # the dict from the exposition instead of a fixed key set.
+        steps = {}
         for key, v in m.get("tpunet_coll_steps_total", {}).items():
-            steps[telemetry.labels(key)["algo"]] += int(v)
+            algo = telemetry.labels(key)["algo"]
+            steps[algo] = steps.get(algo, 0) + int(v)
         selected = {}
         for key, v in m.get("tpunet_coll_algo_selected_total", {}).items():
             ld = telemetry.labels(key)
@@ -298,6 +301,156 @@ def test_unknown_algo_rejected_before_any_socket():
 
     with pytest.raises(_native.NativeError, match="unknown algo"):
         Communicator("127.0.0.1:1", 0, 1, algo="star")
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical two-level schedule: W=4 as 2 fake hosts x 2 ranks
+# (TPUNET_HOST_ID override), intra stages over SHM, inter stage over TCP.
+# The counters carry the acceptance claim: per-rank DCN (TCP) wire bytes
+# under hier <= 0.55x the flat ring's, results byte-identical to the ring
+# oracle on every rank.
+
+
+def _hier_worker(rank: int, world: int, port: int, q, algo, codec, n) -> None:
+    try:
+        os.environ.update({
+            "TPUNET_NSTREAMS": "1", "TPUNET_ASYNC_CHANNELS": "1",
+            "TPUNET_SHM": "1",
+            # 2 ranks per fake "host": hosts [0, 0, 1, 1].
+            "TPUNET_HOST_ID": f"fakehost{rank // 2}",
+        })
+        from tpunet import telemetry
+        from tpunet.collectives import Communicator
+
+        mine = _int_valued(rank, n)
+        if codec != "f32":
+            mine = (mine / 8.0).astype(np.float32)
+        with Communicator(f"127.0.0.1:{port}", rank, world,
+                          wire_dtype=codec, algo=algo) as comm:
+            comm.all_reduce(mine, "sum")  # warmup: wires SHM rings + mesh
+            comm.barrier()
+            telemetry.reset()
+            got = comm.all_reduce(mine, "sum")
+            m = telemetry.metrics()
+        steps = {}
+        for key, v in m.get("tpunet_coll_steps_total", {}).items():
+            lab = telemetry.labels(key)["algo"]
+            steps[lab] = steps.get(lab, 0) + int(v)
+        # Per-rank DCN proxy: TCP tx bytes (all classes) — the SHM counters
+        # are deliberately a separate family, so this split is exact.
+        tcp_tx = sum(int(v) for key, v in
+                     m.get("tpunet_qos_bytes_total", {}).items()
+                     if telemetry.labels(key)["dir"] == "tx")
+        shm_tx = sum(int(v) for key, v in
+                     m.get("tpunet_shm_bytes_total", {}).items()
+                     if telemetry.labels(key)["dir"] == "tx")
+        q.put((rank, ("OK", steps, tcp_tx, shm_tx, zlib.crc32(got.tobytes()),
+                      got.tobytes() if rank == 0 else b"")))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, (f"FAIL: {type(e).__name__}: {e}",)))
+
+
+def _run_hier_case(algo, codec, n):
+    import multiprocessing as mp
+
+    from conftest import free_port
+
+    world = 4
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = free_port()
+    procs = [ctx.Process(target=_hier_worker,
+                         args=(r, world, port, q, algo, codec, n))
+             for r in range(world)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(world):
+            rank, status = q.get(timeout=180)
+            results[rank] = status
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.kill()
+    assert len(results) == world
+    for rank, status in results.items():
+        assert status[0] == "OK", f"rank {rank}: {status[0]}"
+    return results
+
+
+def test_hier_cuts_dcn_bytes_and_matches_ring_oracle():
+    """THE acceptance gate: at W=4 (2 fake hosts x 2 ranks), every rank's
+    DCN (TCP) wire bytes under hier are <= 0.55x the flat ring's per-rank
+    bytes, the intra stages moved through SHM (nonzero tpunet_shm_bytes),
+    and results are byte-identical to the ring oracle on all ranks."""
+    n = 1 << 18  # 1 MiB payload
+    ring = _run_hier_case("ring", "f32", n)
+    # algo=AUTO here doubles as the built-in auto-upgrade gate: a large
+    # AllReduce on a >= 2-host uniform topology must resolve to hier with
+    # no pinning (ApplyHierPolicy) — the step asserts below prove it ran.
+    hier = _run_hier_case("auto", "f32", n)
+    # Flat ring: every rank ships 2(W-1)/W * S to its next hop; with hosts
+    # [0,0,1,1] the cross-host hops (ranks 1 and 3) are the DCN bytes.
+    ring_dcn = max(status[2] for status in ring.values())
+    assert ring_dcn >= int(1.4 * n * 4), ring_dcn  # ~1.5x S on crossers
+    # Integer-valued f32: exact under any summation order, so hier is
+    # byte-identical to the ring oracle (and across all ranks).
+    assert len({s[4] for s in ring.values()} | {s[4] for s in hier.values()}) == 1
+    for rank, status in hier.items():
+        _, steps, tcp_tx, shm_tx, _, _ = status
+        assert tcp_tx <= 0.55 * ring_dcn, \
+            f"rank {rank}: hier DCN bytes {tcp_tx} vs ring {ring_dcn}"
+        assert shm_tx > 0, f"rank {rank}: intra stage moved no SHM bytes"
+        assert steps.get("hier.inter", 0) >= 1, steps
+        assert steps.get("hier.intra", 0) >= 1, steps
+        assert steps.get("ring", 0) == 0, steps
+
+
+@pytest.mark.parametrize("codec", ["bf16", "int8"])
+def test_hier_codec_bounded_and_bit_identical(codec):
+    """Codec x hier: the inter (DCN) stage ships encoded with f32
+    accumulation and verbatim-forwarded encoded segments, so results stay
+    bit-identical across all 4 ranks and inside the documented error bound
+    (values <= ~50/8; bf16 RNE + int8 amax/254 over <= H quantizations)."""
+    results = _run_hier_case("hier", codec, _CODEC_COUNT)
+    crcs = {s[4] for s in results.values()}
+    assert len(crcs) == 1, f"hier/{codec} results differ across ranks"
+    got = np.frombuffer(results[0][5], np.float32)
+    expect = sum((_int_valued(r, _CODEC_COUNT) / 8.0).astype(np.float32)
+                 for r in range(4))
+    np.testing.assert_allclose(got, expect, atol=0.5)
+
+
+def test_hier_on_flat_topology_runs_ring():
+    """hier pinned on a single-host (flat) topology degrades to the ring —
+    the counter records what RAN, and results stay correct."""
+    env = {"TPUNET_NSTREAMS": "1", "TPUNET_ASYNC_CHANNELS": "1",
+           "TPUNET_ALGO": "hier"}
+    run_spawn_workers(_flat_hier_worker, 2, extra_args=(env,))
+
+
+def _flat_hier_worker(rank: int, world: int, port: int, q, env) -> None:
+    try:
+        for k, v in env.items():
+            os.environ[k] = v
+        from tpunet import telemetry
+        from tpunet.collectives import Communicator
+
+        arr = np.full(1024, float(rank + 1), np.float32)
+        with Communicator(f"127.0.0.1:{port}", rank, world) as comm:
+            telemetry.reset()
+            got = comm.all_reduce(arr, "sum")
+            m = telemetry.metrics()
+        assert got[0] == sum(r + 1 for r in range(world))
+        steps = {telemetry.labels(k)["algo"]: int(v)
+                 for k, v in m.get("tpunet_coll_steps_total", {}).items()}
+        assert steps.get("ring", 0) >= 1, steps
+        assert steps.get("hier.inter", 0) == 0, steps
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
 
 
 def test_config_registers_schedule_knobs(monkeypatch, tmp_path):

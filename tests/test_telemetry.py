@@ -126,22 +126,36 @@ def _worker(rank: int, world: int, port: int, q, trace_dir: str) -> None:
 def test_metrics_and_trace(tmp_path):
     run_spawn_workers(_worker, 2, extra_args=(str(tmp_path),))
     # Cross-rank merge: both ranks' spans for the same (comm_id, coll_seq,
-    # phase) land in ONE Perfetto-loadable timeline.
+    # phase) land in ONE Perfetto-loadable timeline — and, with both workers
+    # on one box (same host id), under ONE host track group with per-rank
+    # thread tracks, instead of interleaving two top-level pid groups.
     from tpunet import telemetry
 
     merged_path = telemetry.merge_traces(str(tmp_path))
     with open(merged_path) as f:
         merged = json.load(f)
     by_tag: dict = {}
+    host_pids: set = set()
+    rank_tids: set = set()
     for ev in merged:
         args = ev.get("args") or {}
         if "comm_id" in args and "coll_seq" in args:
+            assert args.get("host"), f"phase span missing host tag: {ev}"
+            host_pids.add(ev["pid"])
+            rank_tids.add(ev["tid"] // 1_000_000)
             by_tag.setdefault(
                 (args["comm_id"], args["coll_seq"], ev["name"]), set()
-            ).add(ev["pid"])
+            ).add(ev["tid"] // 1_000_000)
     assert by_tag, "no collective spans in merged trace"
-    both = [tag for tag, pids in by_tag.items() if pids == {0, 1}]
+    # Same box, same host id: one host group, both rank thread-track bands.
+    assert host_pids == {1}, host_pids
+    assert rank_tids == {0, 1}, rank_tids
+    both = [tag for tag, tranks in by_tag.items() if tranks == {0, 1}]
     assert both, f"no tag present on both ranks: {by_tag}"
+    # The per-host group metadata names the track.
+    names = [e["args"]["name"] for e in merged
+             if e.get("ph") == "M" and e.get("name") == "process_name"]
+    assert any(n.startswith("host ") for n in names), names
     # Alignment anchored the common tags; every event still has a timestamp.
     assert all("ts" in e for e in merged if e.get("ph") == "X")
 

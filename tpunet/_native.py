@@ -216,6 +216,8 @@ def load() -> ctypes.CDLL:
     lib.tpunet_c_fault_clear.restype = i32
     lib.tpunet_c_crc32c.argtypes = [ctypes.c_void_p, u64, ctypes.c_uint32]
     lib.tpunet_c_crc32c.restype = ctypes.c_uint32
+    lib.tpunet_c_host_id.argtypes = []
+    lib.tpunet_c_host_id.restype = u64
     lib.tpunet_c_reduce.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, u64, i32, i32,
     ]

@@ -311,7 +311,9 @@ class Config:
     # CodecMismatchError. docs/DESIGN.md "Compressed collectives".
     wire_dtype: str = "f32"
     # Collective schedule ("auto" = per-(collective, size, world) selection;
-    # "ring"/"rhd"/"tree" pin one schedule). Negotiated at communicator
+    # "ring"/"rhd"/"tree"/"hier" pin one schedule — "hier" is the two-level
+    # intra-host + inter-host AllReduce and needs a hierarchical topology,
+    # else it runs the ring). Negotiated at communicator
     # wiring like the codec — ranks on different schedules would deadlock,
     # so a disagreement fails creation on every rank. docs/DESIGN.md
     # "Schedules & algorithm selection".
@@ -348,6 +350,23 @@ class Config:
     lane_adapt: bool = True
     # Adaptation tick cadence in ms.
     lane_adapt_ms: int = 100
+    # ---- Intra-host shared memory (docs/DESIGN.md "Intra-host shared
+    # memory") -------------------------------------------------------------
+    # Front the TCP engine with the SHM engine: same-host peers (HostId()
+    # equality, verified in the segment handshake) move payloads through
+    # mmap'd per-pair ring segments; cross-host peers pass through to TCP
+    # untouched. Must be set identically on every rank (like the engine
+    # choice itself — a mixed config fails the handshake loudly).
+    shm: bool = False
+    # Per-pair ring segment capacity in bytes (clamped to [64K, 1G] by the
+    # native layer). A chunk plus its CRC trailer must fit in half of it.
+    shm_ring_bytes: int = 8 << 20
+    # Host-identity override (the fake-host knob): any string, hashed into
+    # the host id the SHM handshake and the hierarchical schedule's host
+    # grouping compare. Unset = boot-id/hostname hash — every process on a
+    # physical host agrees. Setting DIFFERENT values on same-box ranks
+    # splits them into testable fake "hosts" (forced TCP between them).
+    host_id: str = ""
     # ---- Transport QoS (docs/DESIGN.md "Transport QoS") ------------------
     # Default traffic class for every comm this process connects (and the
     # class a Communicator negotiates when traffic_class= is not passed).
@@ -475,7 +494,7 @@ class Config:
                 "collective wire codec",
             ),
             algo=_env_choice(
-                "TPUNET_ALGO", "auto", ("auto", "ring", "rhd", "tree"),
+                "TPUNET_ALGO", "auto", ("auto", "ring", "rhd", "tree", "hier"),
                 "collective schedule",
             ),
             dispatch_table=_env_dispatch_table("TPUNET_DISPATCH_TABLE"),
@@ -491,6 +510,13 @@ class Config:
                 "TPUNET_SERVE_ROLE", "", ("", "frontend", "decode"),
                 "serving-tier role",
             ),
+            # GetEnvU64 semantics (default 0): only a numeric nonzero enables.
+            shm=_env_int("TPUNET_SHM", 0) != 0,
+            shm_ring_bytes=_env_int_checked(
+                ("TPUNET_SHM_RING_BYTES",), 8 << 20, 64 << 10,
+                "shared-memory ring size", maximum=1 << 30,
+            ),
+            host_id=env.get("TPUNET_HOST_ID", ""),
             lanes=_env_lanes("TPUNET_LANES"),
             # GetEnvU64 semantics (default 1): only a numeric 0 disables.
             lane_adapt=_env_int("TPUNET_LANE_ADAPT", 1) != 0,

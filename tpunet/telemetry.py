@@ -225,6 +225,16 @@ def _coll_tags(events: list[dict]) -> dict[tuple, int]:
     return tags
 
 
+def _rank_host(events: list[dict]) -> str | None:
+    """Host id of a rank file: the ``host`` tag the native tracer stamps on
+    collective phase spans (a hex string of utils.h HostId())."""
+    for ev in events:
+        h = (ev.get("args") or {}).get("host")
+        if h:
+            return str(h)
+    return None
+
+
 def merge_traces(trace_dir: str, out_path: str | None = None) -> str:
     """Join every per-rank Chrome-trace JSON in `trace_dir` into ONE
     Perfetto-loadable timeline and return its path.
@@ -235,14 +245,24 @@ def merge_traces(trace_dir: str, out_path: str | None = None) -> str:
     ranks becomes the anchor, and every rank is shifted so its anchor span
     starts at the same instant (the straggler-analysis convention — skew
     WITHIN a collective is preserved, clock offset is not mistaken for it).
-    Files without common tags (point-to-point-only traces) merge unshifted."""
+    Files without common tags (point-to-point-only traces) merge unshifted.
+
+    Track grouping: phase spans carry a ``host`` tag (HostId()), so ranks
+    sharing a host group under ONE Perfetto process track ("host <id>") with
+    per-rank thread tracks inside it, instead of interleaving W top-level
+    groups — the view that makes an intra-host SHM stage vs inter-host DCN
+    stage split readable. Traces from builds without the tag keep the old
+    per-rank pid layout."""
     files = sorted(glob.glob(os.path.join(trace_dir, "tpunet-trace-rank*.json")))
     if not files:
         raise FileNotFoundError(f"no tpunet-trace-rank*.json files in {trace_dir}")
     per_rank: list[list[dict]] = []
-    for path in files:
+    ranks: list[int] = []
+    for fi, path in enumerate(files):
         with open(path) as f:
             per_rank.append(json.load(f))
+        m = re.search(r"rank(\d+)\.json$", path)
+        ranks.append(int(m.group(1)) if m else fi)
     # Alignment: anchor on the earliest (comm_id, coll_seq, phase) present in
     # EVERY rank's file; shift each rank so anchors coincide at the max.
     tag_maps = [_coll_tags(events) for events in per_rank]
@@ -254,12 +274,35 @@ def merge_traces(trace_dir: str, out_path: str | None = None) -> str:
         anchor = min(common, key=lambda k: (k[1], k[2]))  # lowest coll_seq
         target = max(tm[anchor] for tm in tag_maps)
         offsets = [target - tm[anchor] for tm in tag_maps]
+    hosts = [_rank_host(events) for events in per_rank]
+    group_by_host = any(h is not None for h in hosts)
+    host_order: list[str] = []
+    if group_by_host:
+        for h in hosts:
+            key = h if h is not None else "?"
+            if key not in host_order:
+                host_order.append(key)
     merged: list[dict] = []
-    for events, off in zip(per_rank, offsets):
+    if group_by_host:
+        for pid, host in enumerate(host_order, start=1):
+            merged.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "args": {"name": f"host {host}"}})
+    for events, off, host, rank in zip(per_rank, offsets, hosts, ranks):
+        pid = host_order.index(host if host is not None else "?") + 1 \
+            if group_by_host else None
         for ev in events:
-            if off and "ts" in ev:
+            if group_by_host and ev.get("ph") == "M" and \
+                    ev.get("name") == "process_name":
+                continue  # replaced by the per-host group metadata above
+            if off and "ts" in ev or group_by_host:
                 ev = dict(ev)
+            if off and "ts" in ev:
                 ev["ts"] = ev["ts"] + off
+            if group_by_host:
+                # One process group per host; rank-disambiguated thread ids
+                # inside it (native tids are small: comm ids / stream idx).
+                ev["pid"] = pid
+                ev["tid"] = rank * 1_000_000 + int(ev.get("tid", 0))
             merged.append(ev)
     out_path = out_path or os.path.join(trace_dir, "tpunet-trace-merged.json")
     with open(out_path, "w") as f:
