@@ -1,0 +1,251 @@
+"""MoE dispatch/combine microbenchmark: latency-class dispatch vs bulk tenant.
+
+The workload the QoS machinery was built for, finally driving it
+(docs/DESIGN.md "Workloads: MoE dispatch & pipeline stages"): W spawned
+expert-parallel ranks each run
+
+  * a LATENCY-class communicator carrying Zipf-skewed (--skew /
+    TPUNET_MOE_SKEW) MoE dispatch+combine typed AllToAlls
+    (tpunet.workloads.moe), and
+  * a concurrent BULK-class communicator flooding gradient-sized
+    AllReduces,
+
+with the process-wide DRR wire gate armed (TPUNET_QOS_INFLIGHT_BYTES
+wire=...). Claims ride counters, never wall-clock (the PR 3/5 stance):
+
+  * latency-class p99 wire-credit queue wait bounded (--p99-budget-us,
+    default the 100 ms bucket) while the bulk tenant moves its FULL byte
+    budget — both read from tpunet_qos_queue_wait_us /
+    tpunet_qos_bytes_total;
+  * dispatch wire bytes per stage from tpunet_a2a_bytes_total (under a
+    2x2 TPUNET_HOST_ID split + --a2a hier, the DCN bytes are exactly the
+    inter-stage figure);
+  * dropped-token fraction from the dispatcher (capacity overflow is
+    visible, never silent).
+
+`--check` asserts the gates; tests/moe_smoke.py is the CI twin.
+
+Run:
+  python -m benchmarks.moe_bench --world 4 --check --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _p99_queue_wait_us(metrics, cls):
+    from tpunet import telemetry
+
+    buckets = []
+    for key, value in metrics.get("tpunet_qos_queue_wait_us_bucket", {}).items():
+        lab = telemetry.labels(key)
+        if lab.get("class") != cls:
+            continue
+        le = lab["le"]
+        buckets.append((float("inf") if le == "+Inf" else float(le), int(value)))
+    buckets.sort()
+    if not buckets or buckets[-1][1] == 0:
+        return None
+    total = buckets[-1][1]
+    for bound, cum in buckets:
+        if cum >= 0.99 * total:
+            return bound
+    return float("inf")
+
+
+def _rank_main(rank, world, ports, q, args):
+    try:
+        os.environ.update({
+            "TPUNET_NSTREAMS": "1",
+            "TPUNET_ASYNC_CHANNELS": "1",
+            "TPUNET_QOS_INFLIGHT_BYTES": f"wire={args.wire}",
+            "TPUNET_MOE_SKEW": str(args.skew),
+        })
+        if args.fake_hosts > 1:
+            os.environ["TPUNET_SHM"] = "1"
+            os.environ["TPUNET_HOST_ID"] = f"moehost{rank // (world // args.fake_hosts)}"
+        if args.a2a:
+            os.environ["TPUNET_A2A_ALGO"] = args.a2a
+        import numpy as np
+
+        from tpunet import telemetry
+        from tpunet.collectives import Communicator
+        from tpunet.workloads import moe
+
+        lat = Communicator(f"127.0.0.1:{ports[0]}", rank, world,
+                           wire_dtype=args.wire_dtype, traffic_class="latency")
+        blk = Communicator(f"127.0.0.1:{ports[1]}", rank, world,
+                           traffic_class="bulk")
+        rng = np.random.default_rng(123 + rank)
+        disp = moe.MoeDispatcher(lat, d_model=args.d_model, capacity=args.capacity)
+        grad = np.full(args.bulk_bytes // 4, 0.5, np.float32)
+
+        # Warmup both paths (wires meshes, SHM rings, channels), then reset.
+        disp.dispatch(rng.standard_normal((8, args.d_model)).astype(np.float32),
+                      moe.route_tokens(8, world, args.skew, rng))
+        disp.combine(np.zeros((world, args.capacity, args.d_model), np.float32))
+        blk.all_reduce(np.ones(1024, np.float32))
+        lat.barrier()
+        telemetry.reset()
+
+        stop = threading.Event()
+        bulk_iters = [0]
+
+        def bulk_loop():
+            while not stop.is_set():
+                blk.all_reduce(grad, inplace=True)
+                bulk_iters[0] += 1
+
+        bt = threading.Thread(target=bulk_loop, daemon=True)
+        bt.start()
+        # Fixed step count: dispatch/combine are COLLECTIVES, so every rank
+        # must run the same number (a wall-clock-bounded loop desyncs the
+        # ranks and reads as a peer death).
+        lat_us = []
+        steps = 0
+        for _ in range(args.steps):
+            toks = rng.standard_normal((args.tokens, args.d_model)).astype(np.float32)
+            experts = moe.route_tokens(args.tokens, world, args.skew, rng)
+            t0 = time.perf_counter()
+            expert_toks, _counts = disp.dispatch(toks, experts)
+            disp.combine(expert_toks * 2.0)  # a stand-in expert
+            lat_us.append((time.perf_counter() - t0) * 1e6)
+            steps += 1
+        # Bulk must run long enough to move its budget even if dispatch
+        # finished early.
+        while bulk_iters[0] < args.bulk_min_iters:
+            time.sleep(0.01)
+        stop.set()
+        bt.join(timeout=120)
+        m = telemetry.metrics()
+        a2a = {}
+        for key, v in m.get("tpunet_a2a_bytes_total", {}).items():
+            lab = telemetry.labels(key)
+            a2a[f"{lab['stage']}.{lab['dir']}"] = int(v)
+        by_class = {}
+        for key, v in m.get("tpunet_qos_bytes_total", {}).items():
+            lab = telemetry.labels(key)
+            by_class[f"{lab['class']}.{lab['dir']}"] = int(v)
+        lat_us.sort()
+        q.put((rank, {
+            "ok": True,
+            "steps": steps,
+            "bulk_iters": bulk_iters[0],
+            "p99_queue_wait_us": _p99_queue_wait_us(m, "latency"),
+            "bulk_gated": _p99_queue_wait_us(m, "bulk") is not None,
+            "a2a_bytes": a2a,
+            "qos_bytes": by_class,
+            "dispatch_p50_us": lat_us[len(lat_us) // 2] if lat_us else None,
+            "dispatch_p99_us": lat_us[min(len(lat_us) - 1, int(0.99 * len(lat_us)))]
+            if lat_us else None,
+            "drop_fraction": disp.drop_fraction,
+        }))
+        lat.close()
+        blk.close()
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        q.put((rank, {"ok": False,
+                      "error": f"{type(e).__name__}: {e}",
+                      "trace": traceback.format_exc()}))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--world", type=int, default=4)
+    ap.add_argument("--tokens", type=int, default=256, help="tokens per rank per step")
+    ap.add_argument("--d-model", type=int, default=64)
+    ap.add_argument("--capacity", type=int, default=192)
+    ap.add_argument("--skew", type=float, default=float(os.environ.get("TPUNET_MOE_SKEW", "1.0")))
+    ap.add_argument("--wire-dtype", default="f32", choices=["f32", "bf16", "int8"])
+    ap.add_argument("--a2a", default="", choices=["", "auto", "pairwise", "ring", "hier"])
+    ap.add_argument("--fake-hosts", type=int, default=1,
+                    help=">1 splits the ranks into TPUNET_HOST_ID fake hosts (SHM intra)")
+    ap.add_argument("--wire", default="256K", help="QoS wire window (TPUNET_QOS_INFLIGHT_BYTES)")
+    ap.add_argument("--bulk-bytes", type=int, default=4 << 20)
+    ap.add_argument("--bulk-min-iters", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=32,
+                    help="dispatch/combine rounds (identical on every rank "
+                         "— the exchanges are collectives)")
+    ap.add_argument("--p99-budget-us", type=int, default=100_000)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--check", action="store_true")
+    args = ap.parse_args()
+    if args.fake_hosts > 1 and args.world % args.fake_hosts:
+        ap.error("--world must divide evenly into --fake-hosts")
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests"))
+    from conftest import free_port
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    ports = (free_port(), free_port())
+    procs = [ctx.Process(target=_rank_main, args=(r, args.world, ports, q, args))
+             for r in range(args.world)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(args.world):
+            rank, res = q.get(timeout=600)
+            results[rank] = res
+    finally:
+        for p in procs:
+            p.join(timeout=60)
+            if p.is_alive():
+                p.kill()
+    failed = {r: v for r, v in results.items() if not v.get("ok")}
+    if failed:
+        print(json.dumps(failed, indent=2))
+        return 1
+    report = {
+        "world": args.world,
+        "skew": args.skew,
+        "wire_dtype": args.wire_dtype,
+        "per_rank": results,
+    }
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for r in sorted(results):
+            v = results[r]
+            print(f"rank {r}: {v['steps']} dispatch steps, bulk x{v['bulk_iters']}, "
+                  f"latency p99 queue wait {v['p99_queue_wait_us']}us, "
+                  f"dispatch p99 {v['dispatch_p99_us']:.0f}us, "
+                  f"drop {v['drop_fraction']:.3f}, a2a {v['a2a_bytes']}")
+    if args.check:
+        for r, v in results.items():
+            assert v["p99_queue_wait_us"] is not None, \
+                f"rank {r}: latency class never queued — gate unarmed?"
+            assert v["p99_queue_wait_us"] <= args.p99_budget_us, \
+                f"rank {r}: latency p99 queue wait {v['p99_queue_wait_us']}us"
+            # Budget proof: the bulk tenant COMPLETED its AllReduce quota
+            # (each iteration moves its full ring/hier byte share by
+            # construction) and its class moved wire bytes. The exact
+            # flat-ring byte formula only holds without a fake-host split
+            # (under TPUNET_SHM the intra-host share rides the separate
+            # tpunet_shm_bytes_total family) — apply it when it applies.
+            assert v["bulk_iters"] >= args.bulk_min_iters, \
+                f"rank {r}: bulk tenant starved: {v['bulk_iters']} iters"
+            assert v["qos_bytes"].get("bulk.tx", 0) > 0, \
+                f"rank {r}: bulk class moved no wire bytes: {v['qos_bytes']}"
+            if args.fake_hosts <= 1:
+                assert v["qos_bytes"]["bulk.tx"] >= \
+                    args.bulk_min_iters * args.bulk_bytes * 2 * (args.world - 1) // args.world, \
+                    f"rank {r}: bulk tenant starved: {v['qos_bytes']}"
+        print("moe_bench check OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,132 @@
+"""Pipeline-parallel stage bench: microbatch chains over per-stage links.
+
+Drives tpunet.workloads.pipeline across W spawned stages (optionally split
+into TPUNET_HOST_ID fake hosts so inter-stage hops cross a "DCN" boundary):
+stage 0 feeds N microbatches of --mb-bytes, every stage applies a marker
+transform and forwards with ticket `after=` ordering, the last stage
+verifies each microbatch passed through every stage exactly once.
+
+Reported (counters + wall-clock; correctness is the gate, wall-clock the
+context): per-microbatch pipe latency p50/p99 at the last stage, aggregate
+bytes in/out per stage (tpunet_isend/irecv counters), microbatches/s.
+
+Run:
+  python -m benchmarks.pipeline_bench --world 4 --n-micro 32 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _stage_main(rank, world, port, q, args):
+    try:
+        os.environ.update({"TPUNET_NSTREAMS": "1", "TPUNET_ASYNC_CHANNELS": "1"})
+        if args.fake_hosts > 1:
+            os.environ["TPUNET_SHM"] = "1"
+            os.environ["TPUNET_HOST_ID"] = \
+                f"pipehost{rank // (world // args.fake_hosts)}"
+        import numpy as np
+
+        from tpunet import telemetry
+        from tpunet.collectives import Communicator
+        from tpunet.workloads.pipeline import PipelineStage
+
+        n = args.mb_bytes // 4
+        comm = Communicator(f"127.0.0.1:{port}", rank, world)
+        with PipelineStage(comm, traffic_class=args.traffic_class or None) as st:
+            telemetry.reset()
+
+            def fn(x):
+                return x + 1.0  # each stage stamps one increment
+
+            t0 = time.monotonic()
+            if st.is_first:
+                mbs = [np.full(n, float(i), np.float32)
+                       for i in range(args.n_micro)]
+                out = st.run(fn, microbatches=mbs)
+            else:
+                out = st.run(fn, n_micro=args.n_micro, mb_shape=(n,))
+            dt = time.monotonic() - t0
+            stats = {"ok": True, "seconds": dt,
+                     "mb_per_s": args.n_micro / dt if dt > 0 else None}
+            if st.is_last:
+                for i, y in enumerate(out):
+                    assert np.all(y == i + world), \
+                        f"microbatch {i} corrupted: {y[0]} != {i + world}"
+                stats["verified"] = len(out)
+            m = telemetry.metrics()
+            stats["isend_bytes"] = int(sum(
+                m.get("tpunet_isend_nbytes_sum", {}).values()))
+            stats["irecv_bytes"] = int(sum(
+                m.get("tpunet_irecv_nbytes_sum", {}).values()))
+            q.put((rank, stats))
+        comm.close()
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        q.put((rank, {"ok": False, "error": f"{type(e).__name__}: {e}",
+                      "trace": traceback.format_exc()}))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--world", type=int, default=4)
+    ap.add_argument("--n-micro", type=int, default=32)
+    ap.add_argument("--mb-bytes", type=int, default=1 << 20)
+    ap.add_argument("--fake-hosts", type=int, default=1)
+    ap.add_argument("--traffic-class", default="",
+                    choices=["", "latency", "bulk"])
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    if args.fake_hosts > 1 and args.world % args.fake_hosts:
+        ap.error("--world must divide evenly into --fake-hosts")
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests"))
+    from conftest import free_port
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = free_port()
+    procs = [ctx.Process(target=_stage_main, args=(r, args.world, port, q, args))
+             for r in range(args.world)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(args.world):
+            rank, res = q.get(timeout=600)
+            results[rank] = res
+    finally:
+        for p in procs:
+            p.join(timeout=60)
+            if p.is_alive():
+                p.kill()
+    failed = {r: v for r, v in results.items() if not v.get("ok")}
+    if failed:
+        print(json.dumps(failed, indent=2))
+        return 1
+    assert results[args.world - 1].get("verified") == args.n_micro, results
+    if args.json:
+        print(json.dumps({"world": args.world, "n_micro": args.n_micro,
+                          "mb_bytes": args.mb_bytes, "per_stage": results},
+                         indent=2, sort_keys=True))
+    else:
+        for r in sorted(results):
+            v = results[r]
+            print(f"stage {r}: {v['seconds']:.3f}s, {v['mb_per_s']:.1f} mb/s, "
+                  f"tx {v['isend_bytes']}B rx {v['irecv_bytes']}B"
+                  + (f", verified {v['verified']}" if "verified" in v else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -233,6 +233,23 @@ int32_t tpunet_comm_broadcast(uintptr_t comm, void* buf, uint64_t nbytes, int32_
  * world blocks, block j from rank j. sendbuf may equal recvbuf. */
 int32_t tpunet_comm_all_to_all(uintptr_t comm, const void* sendbuf, void* recvbuf,
                                uint64_t bytes_per_rank);
+/* Typed AllToAll: blocks are count_per_rank ELEMENTS of dtype. f32 blocks
+ * honor the communicator's negotiated wire codec — every non-self block is
+ * encoded once at the source (int8 scale blocks restart per (src,dst)
+ * block) and decoded once at the destination, so results are bit-identical
+ * across the pairwise / relay / hierarchical routes and each block's error
+ * stays inside the |err| <= amax/254 bound. Non-f32 dtypes (and codec f32)
+ * ship uncompressed. docs/DESIGN.md "Hierarchical AllToAll". */
+int32_t tpunet_comm_all_to_all_typed(uintptr_t comm, const void* sendbuf,
+                                     void* recvbuf, uint64_t count_per_rank,
+                                     int32_t dtype);
+/* Nonblocking byte-oriented AllToAll: enqueues on the communicator's
+ * dedicated mesh worker (pairwise/hier routes) or a ring channel (relay
+ * route) and returns a ticket for tpunet_comm_ticket_wait/_test — an async
+ * AllToAll overlaps async ring AllReduces on disjoint comms. Same
+ * buffer-lifetime and submission-order rules as tpunet_comm_iall_reduce. */
+int32_t tpunet_comm_iall_to_all(uintptr_t comm, const void* sendbuf, void* recvbuf,
+                                uint64_t bytes_per_rank, uint64_t* ticket);
 /* Send to (rank+1)%world while receiving from (rank-1+world)%world. */
 int32_t tpunet_comm_neighbor_exchange(uintptr_t comm, const void* sendbuf,
                                       uint64_t send_nbytes, void* recvbuf,

@@ -111,6 +111,18 @@ class Communicator {
   // ring makes for not opening W^2 multi-stream socket bundles. This is the
   // primitive Ulysses sequence parallelism and cross-host MoE dispatch ride.
   virtual Status AllToAll(const void* sendbuf, void* recvbuf, size_t bytes_per_rank) = 0;
+  // Typed AllToAll: blocks are count_per_rank ELEMENTS of dtype. f32 blocks
+  // honor the negotiated wire codec (docs/DESIGN.md "Hierarchical
+  // AllToAll"): every non-self block is encoded ONCE at the source (int8
+  // scale blocks restart per (src, dst) block) and decoded ONCE at the
+  // destination — the encoded bytes forward verbatim through whatever
+  // route the schedule picks, so results are bit-identical across the
+  // pairwise mesh, the relay, and the two-stage hierarchical transpose,
+  // and the per-block error stays inside the documented |err| <= amax/254
+  // bound. Non-f32 dtypes (and codec f32) ship uncompressed, exactly like
+  // the byte-oriented AllToAll.
+  virtual Status AllToAllTyped(const void* sendbuf, void* recvbuf,
+                               size_t count_per_rank, DType dtype) = 0;
   // Simultaneous send-to-next / recv-from-prev (the ppermute step of ring
   // attention / sequence parallelism). send_nbytes bytes go to (rank+1)%W;
   // recv buffer receives prev rank's message (recv_nbytes posted capacity;
@@ -137,6 +149,15 @@ class Communicator {
   // async queue to drain first, so mixing is well-defined.
   virtual Status IAllReduce(const void* sendbuf, void* recvbuf, size_t count,
                             DType dtype, RedOp op, uint64_t* ticket) = 0;
+  // Nonblocking byte-oriented AllToAll. Mesh-routed schedules (pairwise /
+  // hierarchical) run on the communicator's dedicated mesh worker — one
+  // shared pairwise mesh means mesh jobs serialize in submission order —
+  // while ring tickets keep their round-robin channels, so an async
+  // AllToAll overlaps async ring AllReduces on disjoint comms instead of
+  // queueing behind them. Same buffer-lifetime and submission-order rules
+  // as IAllReduce.
+  virtual Status IAllToAll(const void* sendbuf, void* recvbuf,
+                           size_t bytes_per_rank, uint64_t* ticket) = 0;
   // Blocks until the ticket's collective completes; returns its Status.
   // A ticket can be waited exactly once; unknown tickets are errors.
   virtual Status WaitTicket(uint64_t ticket) = 0;

@@ -180,10 +180,18 @@ struct MetricsSnapshot {
   // Slots 0-2 map to CollAlgo 1-3 (ring, rhd, tree); slots 3-4 are the
   // hierarchical schedule's two stages (algo="hier.intra"/"hier.inter" —
   // the split is the point: hier's claim is that the inter slot, the DCN
-  // wire rounds, shrinks while intra rides shared memory). Selected slots
-  // 0-3 map to CollAlgo 1-4 (ring, rhd, tree, hier).
-  uint64_t coll_steps[5] = {0, 0, 0, 0, 0};
-  uint64_t coll_algo_selected[2][4] = {{0, 0, 0, 0}, {0, 0, 0, 0}};
+  // wire rounds, shrinks while intra rides shared memory); slots 5-6 are
+  // the hierarchical AllToAll's two stages (algo="a2a.intra"/"a2a.inter").
+  // Selected slots 0-5 map to CollAlgo 1-6 (ring, rhd, tree, hier,
+  // hier_a2a, pairwise); kind slots are CollKind order (allreduce,
+  // broadcast, alltoall).
+  uint64_t coll_steps[7] = {0, 0, 0, 0, 0, 0, 0};
+  uint64_t coll_algo_selected[3][6] = {};
+  // AllToAll wire bytes per [stage][dir] (tpunet_a2a_bytes_total: stage 0 =
+  // intra regroup, 1 = inter DCN transpose, 2 = flat mesh/relay; dir tx=0,
+  // rx=1) — the counter family every hierarchical-AllToAll byte claim is
+  // gated on (docs/DESIGN.md "Hierarchical AllToAll").
+  uint64_t a2a_bytes[3][2] = {};
   double uptime_s = 0;          // for bytes/s derivation
 };
 

@@ -467,6 +467,29 @@ int32_t tpunet_comm_all_to_all(uintptr_t comm, const void* sendbuf, void* recvbu
   return FromStatus(c->AllToAll(sendbuf, recvbuf, bytes_per_rank));
 }
 
+int32_t tpunet_comm_all_to_all_typed(uintptr_t comm, const void* sendbuf,
+                                     void* recvbuf, uint64_t count_per_rank,
+                                     int32_t dtype) {
+  if (count_per_rank > 0 && (!sendbuf || !recvbuf)) {
+    return Fail(TPUNET_ERR_NULL, "null buffer");
+  }
+  if (!ValidDType(dtype)) return Fail(TPUNET_ERR_INVALID, "bad dtype");
+  auto c = GetComm(comm);
+  if (!c) return Fail(TPUNET_ERR_INVALID, "unknown comm");
+  return FromStatus(c->AllToAllTyped(sendbuf, recvbuf, count_per_rank,
+                                     static_cast<tpunet::DType>(dtype)));
+}
+
+int32_t tpunet_comm_iall_to_all(uintptr_t comm, const void* sendbuf, void* recvbuf,
+                                uint64_t bytes_per_rank, uint64_t* ticket) {
+  if (!ticket || (bytes_per_rank > 0 && (!sendbuf || !recvbuf))) {
+    return Fail(TPUNET_ERR_NULL, "null param");
+  }
+  auto c = GetComm(comm);
+  if (!c) return Fail(TPUNET_ERR_INVALID, "unknown comm");
+  return FromStatus(c->IAllToAll(sendbuf, recvbuf, bytes_per_rank, ticket));
+}
+
 int32_t tpunet_comm_neighbor_exchange(uintptr_t comm, const void* sendbuf,
                                       uint64_t send_nbytes, void* recvbuf,
                                       uint64_t recv_nbytes, uint64_t* got) {

@@ -58,6 +58,23 @@ inline size_t RingChunkBytes() {
 // always < world) on the shared listener.
 constexpr uint64_t kRingHelloTag = 0x52494E47ull << 32;  // "RING"
 
+// Host-grouped topology view derived from the Init handshake's host ids —
+// the shared input of the hierarchical schedules (schedule_hier.cc
+// AllReduce, schedule_a2a.cc AllToAll). Hosts are ordered by their lowest
+// rank; ranks within a host ascend — every rank derives the IDENTICAL
+// grouping from the identical host_ids_ vector, which is what lets the
+// stages pair up without any extra negotiation.
+struct HierTopo {
+  std::vector<std::vector<int>> hosts;  // per host, ascending ranks
+  std::vector<int> local;  // ranks on my host, ascending (== hosts[hi])
+  std::vector<int> inter;  // rank with my local index on each host (uniform only)
+  size_t li = 0;           // my index in `local`
+  size_t hi = 0;           // my host's index in `hosts`
+  size_t R = 0, H = 0;
+  bool uniform = false;    // every host carries the same rank count R
+};
+HierTopo BuildHierTopo(int rank, const std::vector<uint64_t>& ids);
+
 // Public DType/RedOp enums -> the wire-layer ones the reduce kernels use.
 inline WireDType ToWireDType(DType d) {
   switch (d) {
@@ -160,11 +177,15 @@ class ScheduledCommunicator : public Communicator {
       override;  // schedule_ring.cc
   Status Broadcast(void* buf, size_t nbytes, int root) override;
   Status AllToAll(const void* sendbuf, void* recvbuf, size_t bytes_per_rank) override;
+  Status AllToAllTyped(const void* sendbuf, void* recvbuf, size_t count_per_rank,
+                       DType dtype) override;
   Status NeighborExchange(const void* sendbuf, size_t send_nbytes, void* recvbuf,
                           size_t recv_nbytes, size_t* got) override;
   Status Barrier() override;
   Status IAllReduce(const void* sendbuf, void* recvbuf, size_t count, DType dtype,
                     RedOp op, uint64_t* ticket) override;
+  Status IAllToAll(const void* sendbuf, void* recvbuf, size_t bytes_per_rank,
+                   uint64_t* ticket) override;
   Status WaitTicket(uint64_t ticket) override;
   Status TestTicket(uint64_t ticket, bool* done) override;
   int rank() const override { return rank_; }
@@ -253,22 +274,56 @@ class ScheduledCommunicator : public Communicator {
                               uint8_t* data, size_t count, DType dtype,
                               RedOp op, uint64_t seq);
 
+  // -- AllToAll dispatch + flat paths (collectives.cc) ----------------------
+  // Resolve the AllToAll schedule for one call: TPUNET_A2A_ALGO override
+  // (negotiated at Init) > dispatch table (coll="alltoall") > built-in
+  // pairwise, with ApplyHierPolicy upgrading to the two-stage transpose on
+  // a profitable topology and the mesh-budget guard routing oversized
+  // worlds to the ring relay. Bumps tpunet_coll_algo_selected_total.
+  CollAlgo ResolveA2aAlgo(uint64_t bytes_per_rank);
+  // Run one byte-oriented AllToAll under the already-resolved schedule
+  // (shared by the blocking call, the async ticket job, and the typed
+  // wrapper). `ch` carries the ring-relay variant; pairwise/hier ride the
+  // mesh. Every flat wire byte lands in tpunet_a2a_bytes_total{stage="flat"}
+  // (the hier stages count inside schedule_a2a.cc).
+  Status DoAllToAll(const uint8_t* in, uint8_t* out, size_t B, uint64_t seq,
+                    CollAlgo algo, RingChannel& ch);
+  Status PairwiseAllToAll(const uint8_t* in, uint8_t* out, size_t B);
+
+  // -- hierarchical AllToAll (schedule_a2a.cc) ------------------------------
+  // Two-stage transpose over the mesh (docs/DESIGN.md "Hierarchical
+  // AllToAll"): R-1 intra-host regroup rounds (H·B bytes each, SHM under
+  // TPUNET_SHM=1) land every block destined to a local-index-li rank on
+  // this rank, then H-1 inter-host column rounds (R·B bytes each, the only
+  // DCN hops) complete the exchange. Requires a usable hierarchy.
+  Status DoAllToAllHier(const uint8_t* in, uint8_t* out, size_t B, uint64_t seq);
+
   // -- wiring / lifecycle (collectives.cc) ----------------------------------
   Status ConnectAndWire(const SocketHandle& next_handle);
   Status AcceptHello(uint64_t* rc, uint64_t* hello);
   Status ConnectHello(int peer, uint64_t hello, uint64_t* comm);
   Status EnsureMesh();
-  // EnsureMesh plus a one-time ring-step quiesce: no rank proceeds past the
-  // first mesh use until EVERY rank finished wiring, so a later
-  // listener-touching op (EnsureAsyncChannels on a fast rank) can never be
-  // mistaken for a mesh connect by a peer still in its accept loop.
+  // EnsureMesh plus a one-time ring-step quiesce OVER THE MESH COMMS: no
+  // rank proceeds past the first mesh use until EVERY rank finished wiring,
+  // so a later listener-touching op (EnsureAsyncChannels on a fast rank)
+  // can never be mistaken for a mesh connect by a peer still in its accept
+  // loop. Riding the mesh (not channel 0) keeps mesh-queue jobs disjoint
+  // from ring-channel traffic — what lets async mesh tickets overlap ring
+  // tickets.
   Status EnsureMeshQuiesced();
-  Status PairwiseAllToAll(const uint8_t* in, uint8_t* out, size_t B);
   Status EnsureAsyncChannels(size_t nch);
   static size_t AsyncChannelCount();
 
   // -- async worker machinery (collectives.cc) ------------------------------
   bool TicketLive(uint64_t ticket) REQUIRES(async_mu_);
+  // First async submission: wire the extra ring channels and spawn one
+  // worker per queue (ring queues 0..C-1 plus the dedicated mesh queue C).
+  Status EnsureAsyncWorkers() REQUIRES(async_mu_);
+  // Queue index of the dedicated mesh worker — the serialization domain of
+  // every mesh-comm job (rhd/tree/hier/a2a share the one pairwise mesh, so
+  // they must run one at a time and in submission order), kept OFF the ring
+  // channels so a mesh ticket can overlap ring tickets on disjoint comms.
+  size_t MeshQueueIndex() REQUIRES(async_mu_) { return queues_.size() - 1; }
   void AsyncWorkerLoop(size_t ch);
   bool AsyncIdle() REQUIRES(async_mu_);
   void FenceAsync();
@@ -300,6 +355,11 @@ class ScheduledCommunicator : public Communicator {
   // at Init — (override, table CRC) ride the codec handshake — so every
   // rank resolves the same schedule for the same collective.
   CollAlgo algo_override_ = CollAlgo::kAuto;
+  // AllToAll schedule override (TPUNET_A2A_ALGO; the legacy TPUNET_A2A=ring
+  // spelling folds in as a kRing override). Negotiated at Init — the byte
+  // rides the same handshake blob — because half a world on the pairwise
+  // mesh and half on the two-stage transpose deadlocks, it never corrupts.
+  CollAlgo a2a_override_ = CollAlgo::kAuto;
   // QoS traffic class for every comm this communicator wires (latency for
   // serving P2P links, bulk for gradient rings, control for bootstrap-ish
   // traffic). Negotiated at Init — the class byte rides the codec/algo
@@ -335,6 +395,11 @@ class ScheduledCommunicator : public Communicator {
   ScratchBuf work_;
   std::vector<uint8_t> barrier_scratch_;
   ScratchBuf a2a_fwd_, a2a_rcv_;
+  // Hierarchical-AllToAll staging: slot (j, h) holds the block from local
+  // source j destined to host h's local-index-li rank (schedule_a2a.cc
+  // layout), plus the typed wrapper's encoded in/out assemblies (scale
+  // blocks restart per (src, dst) block — the bit-identity contract).
+  ScratchBuf a2a_stage_, a2a_enc_in_, a2a_enc_out_;
   // Mesh-schedule scratch (rhd halves / tree partials, and the encoded-atom
   // assembly the codec AG forwards verbatim). Non-ring jobs serialize on
   // channel 0's queue — or run on the fenced caller thread — so one set

@@ -90,6 +90,27 @@ Status ScheduledCommunicator::Init(const std::string& coordinator) {
     Status ts = LoadDispatchTableFile(table_path, &dispatch_);
     if (!ts.ok()) return ts;
   }
+  // AllToAll schedule override: TPUNET_A2A_ALGO ("auto" / "pairwise" /
+  // "ring" / "hier"; "hier_a2a" accepted as the explicit spelling), with
+  // the legacy TPUNET_A2A=ring relay switch folding in as a kRing override.
+  // Parsed before the handshake because the byte rides the bootstrap blob.
+  {
+    std::string a2a_name = GetEnv("TPUNET_A2A_ALGO", "auto");
+    CollAlgo a2a;
+    if (!ParseCollAlgo(a2a_name, &a2a) ||
+        (a2a != CollAlgo::kAuto && a2a != CollAlgo::kPairwise &&
+         a2a != CollAlgo::kRing && a2a != CollAlgo::kHier &&
+         a2a != CollAlgo::kHierA2a)) {
+      return Status::Invalid("unknown a2a algo \"" + a2a_name +
+                             "\" (TPUNET_A2A_ALGO expects auto, pairwise, "
+                             "ring or hier)");
+    }
+    if (a2a == CollAlgo::kHier) a2a = CollAlgo::kHierA2a;
+    if (a2a == CollAlgo::kAuto && GetEnv("TPUNET_A2A", "pairwise") == "ring") {
+      a2a = CollAlgo::kRing;
+    }
+    a2a_override_ = a2a;
+  }
   Status s = Bootstrap::Create(coordinator, rank_, world_, &bootstrap_);
   if (!s.ok()) return s;
   if (world_ == 1) {
@@ -117,6 +138,7 @@ Status ScheduledCommunicator::Init(const std::string& coordinator) {
   my_blob[4] = static_cast<uint8_t>(table_crc >> 8);
   my_blob[5] = static_cast<uint8_t>(table_crc);
   my_blob[6] = static_cast<uint8_t>(cls_);  // QoS traffic class
+  my_blob[7] = static_cast<uint8_t>(a2a_override_);  // AllToAll schedule
   EncodeU64BE(HostId(), my_blob + 8);
   std::vector<uint8_t> blobs;
   s = bootstrap_->AllGather(my_blob, sizeof(my_blob), &blobs);
@@ -170,6 +192,19 @@ Status ScheduledCommunicator::Init(const std::string& coordinator) {
           " (set TPUNET_TRAFFIC_CLASS / traffic_class= identically on every "
           "rank — half a group on another QoS lane unbalances the "
           "scheduler)");
+    }
+    if (theirs[7] != my_blob[7]) {
+      std::string name =
+          theirs[7] < kCollAlgoCount
+              ? std::string(CollAlgoName(static_cast<CollAlgo>(theirs[7])))
+              : "#" + std::to_string(theirs[7]);
+      return Status::Invalid(
+          "a2a algo mismatch: rank " + std::to_string(rank_) + " uses " +
+          CollAlgoName(a2a_override_) + " but rank " + std::to_string(r) +
+          " uses " + name +
+          " (set TPUNET_A2A_ALGO / TPUNET_A2A identically on every rank — "
+          "half a world on the pairwise mesh and half on the two-stage "
+          "transpose deadlocks)");
     }
   }
 
@@ -384,54 +419,146 @@ Status ScheduledCommunicator::EnsureMesh() {
   return Status::Ok();
 }
 
-// EnsureMesh + one-time quiesce: W-1 one-byte ring steps on channel 0.
-// Completing them implies every rank finished its accept loop, so a rank
+// EnsureMesh + one-time quiesce: W-1 one-byte ring steps OVER THE MESH
+// COMMS. Completing them implies every rank finished its accept loop (a
+// rank can only relay the token once its own mesh is wired), so a rank
 // that wires fast cannot run ahead into another listener-touching op
 // (EnsureAsyncChannels' channel hellos would be hard errors in a peer's
-// mesh accept loop). Same construction as EnsureAsyncChannels' quiesce;
-// runs on whatever thread owns channel 0 right now (the fenced caller, or
-// worker 0 inside a queue-0 job), which is exactly the thread running the
-// collective that needed the mesh.
+// mesh accept loop). Riding the mesh instead of channel 0 keeps this —
+// and every mesh-schedule job after it — disjoint from the ring channels,
+// which is what lets the dedicated mesh worker overlap ring tickets.
 Status ScheduledCommunicator::EnsureMeshQuiesced() {
   Status s = EnsureMesh();
   if (!s.ok()) return s;
   if (mesh_quiesced_ || world_ == 1) return Status::Ok();
+  const int next = (rank_ + 1) % world_;
+  const int prev = (rank_ + world_ - 1) % world_;
   for (int st = 0; st < world_ - 1; ++st) {
     uint8_t token_out = 1, token_in = 0;
-    s = Exchange(&token_out, 1, &token_in, 1, nullptr, channels_[0]);
+    s = MeshShift(next, &token_out, 1, prev, &token_in, 1);
     if (!s.ok()) return s;
   }
   mesh_quiesced_ = true;
   return Status::Ok();
 }
 
-Status ScheduledCommunicator::AllToAll(const void* sendbuf, void* recvbuf,
-                                       size_t bytes_per_rank) {
-  FenceAsync();
-  const int W = world_;
-  const size_t B = bytes_per_rank;
-  const uint8_t* in = static_cast<const uint8_t*>(sendbuf);
-  uint8_t* out = static_cast<uint8_t*>(recvbuf);
-  if (static_cast<const void*>(out) != sendbuf) {
-    memcpy(out + rank_ * B, in + rank_ * B, B);  // own block stays local
-  }
-  if (W == 1 || B == 0) return Status::Ok();
-  PhaseSpan whole(Telemetry::Get().tracing_enabled(), trace_comm_id_, ++coll_seq_,
-                  "all_to_all", -1, static_cast<uint64_t>(W) * B);
-  // Direct pairwise exchange by default: O(W*B) bytes on the wire per
-  // rank vs the ring relay's O(W^2*B/2) — the difference between usable
-  // and quadratic cross-host MoE dispatch / DCN-Ulysses at pod scale.
-  // TPUNET_A2A=ring keeps the relay (no extra comms; fine at tiny W).
+// Resolve the AllToAll schedule: negotiated override (TPUNET_A2A_ALGO /
+// legacy TPUNET_A2A=ring) > dispatch table (coll="alltoall") > built-in
+// pairwise, then the topology post-pass (hier on a profitable hierarchy,
+// degrade to pairwise on flat) and the mesh fd/thread budget guard.
+// Deterministic from negotiated state, so every rank agrees.
+CollAlgo ScheduledCommunicator::ResolveA2aAlgo(uint64_t bytes_per_rank) {
+  if (world_ <= 1 || bytes_per_rank == 0) return CollAlgo::kPairwise;
+  CollAlgo a = SelectCollAlgo(dispatch_, a2a_override_, CollKind::kAllToAll,
+                              static_cast<uint64_t>(world_) * bytes_per_rank,
+                              world_);
+  a = ApplyHierPolicy(a, CollKind::kAllToAll, bytes_per_rank, HierUsable(),
+                      HierProfitable(),
+                      a2a_override_ == CollAlgo::kAuto && !dispatch_.loaded);
   // The mesh costs 2*(W-1) comms per rank, each nstreams+1 fds and
   // nstreams+1 threads, so very large worlds fall back to the relay
   // rather than exhausting fds/threads; raise TPUNET_A2A_MESH_MAX_WORLD
   // on hosts provisioned for it (the long-term fix is single-stream
   // mesh comms, which need a per-connect nstreams override in Net).
-  static const bool use_ring = GetEnv("TPUNET_A2A", "pairwise") == "ring";
   static const uint64_t mesh_max_world =
       GetEnvU64("TPUNET_A2A_MESH_MAX_WORLD", 32);
-  if (!use_ring && static_cast<uint64_t>(W) <= mesh_max_world) {
-    return PairwiseAllToAll(in, out, B);
+  if ((a == CollAlgo::kPairwise || a == CollAlgo::kHierA2a) &&
+      static_cast<uint64_t>(world_) > mesh_max_world) {
+    a = CollAlgo::kRing;
+  }
+  CountCollAlgoSelected(CollKind::kAllToAll, a);
+  return a;
+}
+
+Status ScheduledCommunicator::AllToAll(const void* sendbuf, void* recvbuf,
+                                       size_t bytes_per_rank) {
+  FenceAsync();
+  CollAlgo algo = ResolveA2aAlgo(bytes_per_rank);
+  return DoAllToAll(static_cast<const uint8_t*>(sendbuf),
+                    static_cast<uint8_t*>(recvbuf), bytes_per_rank,
+                    ++coll_seq_, algo, channels_[0]);
+}
+
+// Typed AllToAll (docs/DESIGN.md "Hierarchical AllToAll"): f32 blocks under
+// a negotiated codec are encoded ONCE at the source — each (src, dst) block
+// encoded independently, so int8 scale blocks restart per block and the
+// encoded bytes can forward verbatim through ANY route (pairwise, relay, or
+// the two-stage hierarchical transpose) — and decoded ONCE at the
+// destination: results are bit-identical across schedules and each block's
+// error stays inside the per-hop |err| <= amax/254 bound (one hop total,
+// by construction). The self block never crosses a wire and stays exact.
+// Every encoded/decoded byte feeds tpunet_codec_bytes_total{codec,dir} and
+// the wire-ratio gauge exactly like RS/AG hops (the kernels count), and
+// the shipped wire bytes land in tpunet_a2a_bytes_total at the encoded
+// size — the DCN-byte cut the codec buys is counter-visible end to end.
+Status ScheduledCommunicator::AllToAllTyped(const void* sendbuf, void* recvbuf,
+                                            size_t count_per_rank, DType dtype) {
+  size_t esize = DTypeSize(dtype);
+  if (esize == 0) return Status::Invalid("bad dtype");
+  const size_t B = count_per_rank * esize;
+  if (!UseCodec(dtype)) return AllToAll(sendbuf, recvbuf, B);
+  FenceAsync();
+  const int W = world_;
+  const size_t n = count_per_rank;
+  const float* in_f = static_cast<const float*>(sendbuf);
+  float* out_f = static_cast<float*>(recvbuf);
+  if (W == 1 || n == 0) {
+    if (recvbuf != sendbuf && B > 0) memcpy(recvbuf, sendbuf, B);
+    return Status::Ok();
+  }
+  const size_t w = CodecWireBytes(codec_, n);
+  a2a_enc_in_.reserve(static_cast<size_t>(W) * w);
+  a2a_enc_out_.reserve(static_cast<size_t>(W) * w);
+  for (int j = 0; j < W; ++j) {
+    if (j == rank_) continue;  // the self block never crosses a wire
+    CodecEncode(codec_, in_f + static_cast<size_t>(j) * n,
+                a2a_enc_in_.data() + static_cast<size_t>(j) * w, n);
+  }
+  // Zero the self slot so the byte core's own-block copy reads initialized
+  // memory (the decoded result never looks at it).
+  memset(a2a_enc_in_.data() + static_cast<size_t>(rank_) * w, 0, w);
+  CollAlgo algo = ResolveA2aAlgo(w);
+  Status st = DoAllToAll(a2a_enc_in_.data(), a2a_enc_out_.data(), w,
+                         ++coll_seq_, algo, channels_[0]);
+  if (!st.ok()) return st;
+  for (int j = 0; j < W; ++j) {
+    if (j == rank_) continue;
+    CodecDecode(codec_, a2a_enc_out_.data() + static_cast<size_t>(j) * w,
+                out_f + static_cast<size_t>(j) * n, n);
+  }
+  if (recvbuf != sendbuf) {
+    memcpy(out_f + static_cast<size_t>(rank_) * n,
+           in_f + static_cast<size_t>(rank_) * n, B);
+  }
+  return Status::Ok();
+}
+
+// Byte-oriented AllToAll under an already-resolved schedule — the shared
+// core of the blocking call, the async ticket job, and the typed wrapper.
+Status ScheduledCommunicator::DoAllToAll(const uint8_t* in, uint8_t* out,
+                                         size_t B, uint64_t seq, CollAlgo algo,
+                                         RingChannel& ch) {
+  const int W = world_;
+  if (static_cast<const void*>(out) != in) {
+    memcpy(out + rank_ * B, in + rank_ * B, B);  // own block stays local
+  }
+  if (W == 1 || B == 0) return Status::Ok();
+  PhaseSpan whole(Telemetry::Get().tracing_enabled(), trace_comm_id_, seq,
+                  "all_to_all", -1, static_cast<uint64_t>(W) * B);
+  // Two-stage hierarchical transpose on a usable topology; direct pairwise
+  // exchange otherwise: O(W*B) bytes on the wire per rank vs the ring
+  // relay's O(W^2*B/2) — the difference between usable and quadratic
+  // cross-host MoE dispatch / DCN-Ulysses at pod scale. The relay keeps
+  // the constant-connection-degree end (TPUNET_A2A=ring, or worlds past
+  // the mesh fd budget).
+  if (algo == CollAlgo::kHierA2a) return DoAllToAllHier(in, out, B, seq);
+  if (algo != CollAlgo::kRing) {
+    Status st = PairwiseAllToAll(in, out, B);
+    if (st.ok()) {
+      CountA2aBytes(2, 0, static_cast<uint64_t>(W - 1) * B);
+      CountA2aBytes(2, 1, static_cast<uint64_t>(W - 1) * B);
+    }
+    return st;
   }
 
   // Store-and-forward relay. Packet invariant at step s: the packet holds
@@ -441,20 +568,25 @@ Status ScheduledCommunicator::AllToAll(const void* sendbuf, void* recvbuf,
   // block rank (rank-s-1) addressed to us), the rest forward verbatim next
   // step. Both sides compute identical per-step sizes, so the fixed-size
   // Exchange path (got=nullptr) catches rank disagreement as an error.
-  a2a_fwd_.reserve(static_cast<size_t>(W - 1) * B);
-  a2a_rcv_.reserve(static_cast<size_t>(W - 1) * B);
+  // Scratch lives in the CHANNEL (not the communicator): a relay ticket
+  // owns its ring channel for the job's duration, so channel scratch can
+  // never race the mesh queue's a2a_* buffers.
+  ch.scratch.reserve(2 * static_cast<size_t>(W - 1) * B);
+  uint8_t* fwd = ch.scratch.data();
+  uint8_t* rcv = ch.scratch.data() + static_cast<size_t>(W - 1) * B;
   for (int p = 0; p < W - 1; ++p) {
     int dest = (rank_ + (W - 1 - p)) % W;
-    memcpy(a2a_fwd_.data() + static_cast<size_t>(p) * B, in + dest * B, B);
+    memcpy(fwd + static_cast<size_t>(p) * B, in + dest * B, B);
   }
   for (int s = 0; s < W - 1; ++s) {
     size_t nblk = static_cast<size_t>(W - 1 - s);
-    Status st = Exchange(a2a_fwd_.data(), nblk * B, a2a_rcv_.data(), nblk * B, nullptr,
-                         channels_[0]);
+    Status st = Exchange(fwd, nblk * B, rcv, nblk * B, nullptr, ch);
     if (!st.ok()) return st;
+    CountA2aBytes(2, 0, nblk * B);
+    CountA2aBytes(2, 1, nblk * B);
     int src = (rank_ - s - 1 + W) % W;
-    memcpy(out + src * B, a2a_rcv_.data() + (nblk - 1) * B, B);
-    a2a_fwd_.swap(a2a_rcv_);
+    memcpy(out + src * B, rcv + (nblk - 1) * B, B);
+    std::swap(fwd, rcv);
   }
   return Status::Ok();
 }
@@ -542,26 +674,33 @@ Status ScheduledCommunicator::Barrier() {
 // ---------------------------------------------------------------------------
 // Async worker machinery.
 
+// First async submission: wire the extra ring channels and spawn one worker
+// per queue — ring queues 0..C-1 (one per channel) plus the dedicated mesh
+// queue C, whose jobs (rhd/tree/hier/a2a) ride the pairwise mesh and never
+// touch a ring channel. Safe to touch the listener here — the communicator
+// runs one collective program, so every rank reaches its first async
+// submission at the same point of it and nothing else is mid-accept.
+Status ScheduledCommunicator::EnsureAsyncWorkers() {
+  if (worker_started_) return Status::Ok();
+  Status s = EnsureAsyncChannels(AsyncChannelCount());
+  if (!s.ok()) return s;
+  queues_.resize(channels_.size() + 1);
+  running_.assign(channels_.size() + 1, 0);
+  worker_started_ = true;
+  for (size_t c = 0; c < channels_.size() + 1; ++c) {
+    workers_.emplace_back([this, c] { AsyncWorkerLoop(c); });
+  }
+  return Status::Ok();
+}
+
 Status ScheduledCommunicator::IAllReduce(const void* sendbuf, void* recvbuf,
                                          size_t count, DType dtype, RedOp op,
                                          uint64_t* ticket) {
   size_t esize = DTypeSize(dtype);
   if (esize == 0) return Status::Invalid("bad dtype");
   MutexLock lk(async_mu_);
-  if (!worker_started_) {
-    // First async collective: wire the extra channels and spawn one worker
-    // per channel. Safe to touch the listener here — the communicator runs
-    // one collective program, so every rank reaches its first IAllReduce at
-    // the same point of it and nothing else is mid-accept.
-    Status s = EnsureAsyncChannels(AsyncChannelCount());
-    if (!s.ok()) return s;
-    queues_.resize(channels_.size());
-    running_.assign(channels_.size(), 0);
-    worker_started_ = true;
-    for (size_t c = 0; c < channels_.size(); ++c) {
-      workers_.emplace_back([this, c] { AsyncWorkerLoop(c); });
-    }
-  }
+  Status s = EnsureAsyncWorkers();
+  if (!s.ok()) return s;
   uint64_t t = next_ticket_++;
   // Trace seq is claimed at SUBMISSION (same order on every rank), not at
   // execution, so spans from overlapping tickets keep cross-rank-stable
@@ -571,17 +710,47 @@ Status ScheduledCommunicator::IAllReduce(const void* sendbuf, void* recvbuf,
   // selector is deterministic from negotiated state), because it feeds the
   // routing below.
   CollAlgo algo = ResolveAlgo(CollKind::kAllReduce, count * esize);
-  // Deterministic ticket→channel map: submission order is already the
+  // Deterministic ticket→queue map: submission order is already the
   // cross-rank contract for nonblocking collectives, so every rank routes
-  // ticket t to the same ring and messages pair up peer-to-peer. Mesh
-  // schedules (rhd/tree) all ride queue 0: the mesh comms are one shared
-  // resource, so their jobs must serialize — and do, in submission order,
-  // the same on every rank. Ring tickets keep the round-robin map, so a
-  // ring ticket can still overlap a mesh ticket on disjoint comms.
-  size_t ch = (algo == CollAlgo::kRing) ? (t - 1) % queues_.size() : 0;
-  queues_[ch].emplace_back(t, [this, sendbuf, recvbuf, count, dtype, op, ch, seq,
-                               algo] {
+  // ticket t to the same queue and messages pair up peer-to-peer. Mesh
+  // schedules (rhd/tree/hier — and async AllToAlls) ride the dedicated
+  // mesh queue: the mesh comms are one shared resource, so mesh jobs must
+  // serialize — and do, in submission order, the same on every rank — but
+  // they no longer pin ring queue 0, so a mesh ticket and any ring ticket
+  // overlap on their disjoint comms.
+  const bool ring = algo == CollAlgo::kRing;
+  size_t q = ring ? (t - 1) % (queues_.size() - 1) : MeshQueueIndex();
+  size_t ch = ring ? q : 0;  // mesh jobs ignore the channel argument
+  queues_[q].emplace_back(t, [this, sendbuf, recvbuf, count, dtype, op, ch, seq,
+                              algo] {
     return DoAllReduce(sendbuf, recvbuf, count, dtype, op, channels_[ch], seq, algo);
+  });
+  *ticket = t;
+  work_cv_.NotifyAll();
+  return Status::Ok();
+}
+
+// Nonblocking AllToAll: resolved at submission like IAllReduce. Mesh-routed
+// schedules (pairwise / hierarchical) run on the dedicated mesh worker in
+// submission order; a relay verdict rides the ring round-robin map with its
+// channel (the relay's exchanges are ring-channel traffic). Either way an
+// async AllToAll overlaps ring AllReduce tickets on disjoint comms instead
+// of serializing behind queue 0 — the PR 6 mesh bottleneck this fixes.
+Status ScheduledCommunicator::IAllToAll(const void* sendbuf, void* recvbuf,
+                                        size_t bytes_per_rank, uint64_t* ticket) {
+  MutexLock lk(async_mu_);
+  Status s = EnsureAsyncWorkers();
+  if (!s.ok()) return s;
+  uint64_t t = next_ticket_++;
+  uint64_t seq = ++coll_seq_;
+  CollAlgo algo = ResolveA2aAlgo(bytes_per_rank);
+  const bool ring = algo == CollAlgo::kRing;
+  size_t q = ring ? (t - 1) % (queues_.size() - 1) : MeshQueueIndex();
+  size_t ch = ring ? q : 0;
+  const uint8_t* in = static_cast<const uint8_t*>(sendbuf);
+  uint8_t* out = static_cast<uint8_t*>(recvbuf);
+  queues_[q].emplace_back(t, [this, in, out, bytes_per_rank, ch, seq, algo] {
+    return DoAllToAll(in, out, bytes_per_rank, seq, algo, channels_[ch]);
   });
   *ticket = t;
   work_cv_.NotifyAll();

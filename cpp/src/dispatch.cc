@@ -30,6 +30,11 @@ constexpr uint64_t kRhdMaxAllReduce = 256ull << 10;   // <= 256 KiB
 constexpr uint64_t kTreeMaxBroadcast = 1ull << 20;    // <= 1 MiB
 
 CollAlgo SelectBuiltin(CollKind coll, uint64_t nbytes, int world) {
+  // AllToAll: the direct pairwise mesh is the flat default at every size
+  // (minimum wire bytes); ApplyHierPolicy upgrades it to the two-stage
+  // hierarchical transpose on a usable topology, and the communicator's
+  // mesh_max_world guard routes oversized worlds to the ring relay.
+  if (coll == CollKind::kAllToAll) return CollAlgo::kPairwise;
   // W <= 2: every schedule degenerates to the same one exchange (ring
   // 2(W-1)=2 rounds, rhd 2, tree 2) and the ring channel is already wired —
   // never pay mesh wiring for zero step savings.
@@ -140,9 +145,12 @@ Status ParseEntry(Cursor* c, DispatchEntry* e) {
           e->coll = CollKind::kAllReduce;
         } else if (v == "broadcast") {
           e->coll = CollKind::kBroadcast;
+        } else if (v == "alltoall") {
+          e->coll = CollKind::kAllToAll;
         } else {
           return Status::Invalid("dispatch table: unknown collective \"" + v +
-                                 "\" (expected allreduce or broadcast)");
+                                 "\" (expected allreduce, broadcast or "
+                                 "alltoall)");
         }
         saw_coll = true;
       } else if (key == "algo") {
@@ -152,7 +160,8 @@ Status ParseEntry(Cursor* c, DispatchEntry* e) {
         CollAlgo a;
         if (!ParseCollAlgo(v, &a) || a == CollAlgo::kAuto) {
           return Status::Invalid("dispatch table: unknown algo \"" + v +
-                                 "\" (expected ring, rhd, tree or hier)");
+                                 "\" (expected ring, rhd, tree, hier, "
+                                 "hier_a2a or pairwise)");
         }
         e->algo = a;
         saw_algo = true;
@@ -181,6 +190,10 @@ std::atomic<uint64_t> g_coll_steps[kCollAlgoCount] = {};
 std::atomic<uint64_t> g_coll_selected[kCollKindCount][kCollAlgoCount] = {};
 // Hier stage rounds: [0] intra-host, [1] inter-host (DCN).
 std::atomic<uint64_t> g_hier_steps[2] = {};
+// Hierarchical-AllToAll stage rounds: [0] intra, [1] inter (DCN).
+std::atomic<uint64_t> g_a2a_steps[2] = {};
+// AllToAll wire bytes per [stage][dir] (dispatch.h CountA2aBytes).
+std::atomic<uint64_t> g_a2a_bytes[kA2aStageCount][2] = {};
 
 }  // namespace
 
@@ -195,6 +208,10 @@ bool ParseCollAlgo(const std::string& name, CollAlgo* out) {
     *out = CollAlgo::kTree;
   } else if (name == "hier") {
     *out = CollAlgo::kHier;
+  } else if (name == "hier_a2a") {
+    *out = CollAlgo::kHierA2a;
+  } else if (name == "pairwise") {
+    *out = CollAlgo::kPairwise;
   } else {
     return false;
   }
@@ -213,6 +230,10 @@ const char* CollAlgoName(CollAlgo a) {
       return "tree";
     case CollAlgo::kHier:
       return "hier";
+    case CollAlgo::kHierA2a:
+      return "hier_a2a";
+    case CollAlgo::kPairwise:
+      return "pairwise";
   }
   return "?";
 }
@@ -223,6 +244,8 @@ const char* CollKindName(CollKind c) {
       return "allreduce";
     case CollKind::kBroadcast:
       return "broadcast";
+    case CollKind::kAllToAll:
+      return "alltoall";
   }
   return "?";
 }
@@ -297,6 +320,29 @@ CollAlgo SelectCollAlgo(const DispatchTable& table, CollAlgo override_algo,
 
 CollAlgo ApplyHierPolicy(CollAlgo a, CollKind coll, uint64_t nbytes,
                          bool usable, bool profitable, bool builtin_auto) {
+  if (coll == CollKind::kAllToAll) {
+    // "hier" names the hierarchical shape of BOTH collectives; rhd/tree
+    // verdicts have no AllToAll meaning and degrade to the pairwise mesh
+    // (deterministically, so every rank agrees).
+    if (a == CollAlgo::kHier) a = CollAlgo::kHierA2a;
+    if (a == CollAlgo::kRhd || a == CollAlgo::kTree) a = CollAlgo::kPairwise;
+    if (a == CollAlgo::kHierA2a) {
+      return usable ? a : CollAlgo::kPairwise;
+    }
+    // Built-in auto: a usable hierarchy upgrades the pairwise mesh to the
+    // two-stage transpose at every size — per-rank DCN connections drop
+    // from R(H-1) to H-1 and the per-peer shards aggregate R-fold (the
+    // latency lever for small, skewed MoE dispatch shards).
+    if (builtin_auto && usable && a == CollAlgo::kPairwise) {
+      return CollAlgo::kHierA2a;
+    }
+    return a;
+  }
+  // kHierA2a / kPairwise are AllToAll shapes; on the reduce-side
+  // collectives they read as their closest analogue before the normal
+  // policy applies.
+  if (a == CollAlgo::kHierA2a) a = CollAlgo::kHier;
+  if (a == CollAlgo::kPairwise) a = CollAlgo::kRing;
   if (coll != CollKind::kAllReduce) {
     return a == CollAlgo::kHier ? CollAlgo::kRing : a;
   }
@@ -323,6 +369,24 @@ uint64_t HierStepsTotal(bool inter) {
   return g_hier_steps[inter ? 1 : 0].load(std::memory_order_relaxed);
 }
 
+void CountA2aSteps(bool inter, uint64_t n) {
+  g_a2a_steps[inter ? 1 : 0].fetch_add(n, std::memory_order_relaxed);
+}
+
+uint64_t A2aStepsTotal(bool inter) {
+  return g_a2a_steps[inter ? 1 : 0].load(std::memory_order_relaxed);
+}
+
+void CountA2aBytes(int stage, int dir, uint64_t nbytes) {
+  if (stage < 0 || stage >= kA2aStageCount) return;
+  g_a2a_bytes[stage][dir & 1].fetch_add(nbytes, std::memory_order_relaxed);
+}
+
+uint64_t A2aBytesTotal(int stage, int dir) {
+  if (stage < 0 || stage >= kA2aStageCount) return 0;
+  return g_a2a_bytes[stage][dir & 1].load(std::memory_order_relaxed);
+}
+
 void CountCollAlgoSelected(CollKind c, CollAlgo a) {
   g_coll_selected[static_cast<int>(c)][static_cast<int>(a)].fetch_add(
       1, std::memory_order_relaxed);
@@ -340,6 +404,10 @@ uint64_t CollAlgoSelectedTotal(CollKind c, CollAlgo a) {
 void ResetCollDispatchCounters() {
   for (auto& v : g_coll_steps) v.store(0, std::memory_order_relaxed);
   for (auto& v : g_hier_steps) v.store(0, std::memory_order_relaxed);
+  for (auto& v : g_a2a_steps) v.store(0, std::memory_order_relaxed);
+  for (auto& per_stage : g_a2a_bytes) {
+    for (auto& v : per_stage) v.store(0, std::memory_order_relaxed);
+  }
   for (auto& per_kind : g_coll_selected) {
     for (auto& v : per_kind) v.store(0, std::memory_order_relaxed);
   }

@@ -31,21 +31,27 @@ namespace tpunet {
 // kHier is the two-level schedule (intra-host stage + one-rank-per-host
 // inter-host stage, docs/DESIGN.md "Hierarchical collectives"); it needs a
 // hierarchical topology (>= 2 hosts, uniform ranks/host) and resolves back
-// to ring where the topology is flat.
+// to ring where the topology is flat. kHierA2a and kPairwise are AllToAll
+// shapes (docs/DESIGN.md "Hierarchical AllToAll"): kPairwise is the direct
+// per-peer mesh exchange, kHierA2a the two-stage intra-host regroup +
+// one-rank-per-host inter-host transpose; for AllToAll, kRing names the
+// store-and-forward relay.
 enum class CollAlgo : uint8_t {
   kAuto = 0,
   kRing = 1,
   kRhd = 2,
   kTree = 3,
   kHier = 4,
+  kHierA2a = 5,
+  kPairwise = 6,
 };
-constexpr int kCollAlgoCount = 5;  // including kAuto
+constexpr int kCollAlgoCount = 7;  // including kAuto
 
-enum class CollKind : uint8_t { kAllReduce = 0, kBroadcast = 1 };
-constexpr int kCollKindCount = 2;
+enum class CollKind : uint8_t { kAllReduce = 0, kBroadcast = 1, kAllToAll = 2 };
+constexpr int kCollKindCount = 3;
 
-// "auto" / "ring" / "rhd" / "tree" / "hier" <-> CollAlgo. Parse returns
-// false on an unknown name.
+// "auto" / "ring" / "rhd" / "tree" / "hier" / "hier_a2a" / "pairwise"
+// <-> CollAlgo. Parse returns false on an unknown name.
 bool ParseCollAlgo(const std::string& name, CollAlgo* out);
 const char* CollAlgoName(CollAlgo a);
 const char* CollKindName(CollKind c);
@@ -90,6 +96,12 @@ CollAlgo SelectCollAlgo(const DispatchTable& table, CollAlgo override_algo,
 //     uniform R >= 2 ranks/host) upgrades to hier: the intra-host stages
 //     ride shared memory / loopback while per-rank DCN wire bytes drop by
 //     ~R x. Deterministic from negotiated state, so every rank agrees.
+//   * kAllToAll: kHier is read as kHierA2a (the "hier" spelling works for
+//     both collectives); kHierA2a on a flat topology degrades to kPairwise;
+//     built-in auto on a USABLE hierarchy upgrades kPairwise to kHierA2a
+//     (DCN connection count drops from R(H-1) to H-1 per rank and the
+//     per-peer shards aggregate R-fold — the MoE-dispatch shape); rhd/tree
+//     verdicts for an AllToAll have no meaning and degrade to kPairwise.
 CollAlgo ApplyHierPolicy(CollAlgo a, CollKind coll, uint64_t nbytes,
                          bool usable, bool profitable, bool builtin_auto);
 
@@ -106,6 +118,19 @@ void CountCollAlgoSelected(CollKind c, CollAlgo a);
 // intra-host rounds ride shared memory IS the hier claim.
 void CountHierSteps(bool inter, uint64_t n = 1);
 uint64_t HierStepsTotal(bool inter);
+// Hierarchical AllToAll stage rounds (algo="a2a.intra" / "a2a.inter") —
+// the inter slot is the DCN transpose round count (H-1 per call vs the
+// flat mesh's per-peer message storm).
+void CountA2aSteps(bool inter, uint64_t n = 1);
+uint64_t A2aStepsTotal(bool inter);
+// tpunet_a2a_bytes_total{stage,dir}: AllToAll wire bytes per stage —
+// stage 0 = intra (same-host regroup hops, SHM-cheap), 1 = inter (the
+// one-rank-per-host DCN transpose), 2 = flat (the pairwise mesh / ring
+// relay baseline). dir: 0 = tx, 1 = rx. Every byte-movement claim about
+// the hierarchical AllToAll is gated on these, never on wall-clock.
+constexpr int kA2aStageCount = 3;
+void CountA2aBytes(int stage, int dir, uint64_t nbytes);
+uint64_t A2aBytesTotal(int stage, int dir);
 uint64_t CollStepsTotal(CollAlgo a);
 uint64_t CollAlgoSelectedTotal(CollKind c, CollAlgo a);
 void ResetCollDispatchCounters();

@@ -919,15 +919,21 @@ MetricsSnapshot Telemetry::Snapshot() const {
     // Snapshot slot a maps to CollAlgo a+1 (kAuto never executes a step).
     s.coll_steps[a] = CollStepsTotal(static_cast<CollAlgo>(a + 1));
   }
-  // Hierarchical schedule: its two stages count separately (slots 3/4 =
-  // hier.intra/hier.inter) — the DCN-round shrinkage IS the claim.
+  // Hierarchical schedules: their stages count separately (slots 3/4 =
+  // hier.intra/hier.inter, 5/6 = a2a.intra/a2a.inter) — the DCN-round
+  // shrinkage IS the claim.
   s.coll_steps[3] = HierStepsTotal(false);
   s.coll_steps[4] = HierStepsTotal(true);
-  for (int a = 0; a < 4; ++a) {
+  s.coll_steps[5] = A2aStepsTotal(false);
+  s.coll_steps[6] = A2aStepsTotal(true);
+  for (int a = 0; a < 6; ++a) {
     for (int k = 0; k < kCollKindCount; ++k) {
       s.coll_algo_selected[k][a] =
           CollAlgoSelectedTotal(static_cast<CollKind>(k), static_cast<CollAlgo>(a + 1));
     }
+  }
+  for (int st = 0; st < kA2aStageCount; ++st) {
+    for (int d = 0; d < 2; ++d) s.a2a_bytes[st][d] = A2aBytesTotal(st, d);
   }
   s.uptime_s = (NowUs() - im->start_us.load(std::memory_order_relaxed)) / 1e6;
   return s;
@@ -1278,27 +1284,46 @@ std::string Telemetry::PrometheusText() const {
   // Step slots 3/4 are the hierarchical schedule's two stages: the claim is
   // precisely that hier.inter (the DCN wire rounds) shrinks by ~R x while
   // hier.intra rides shared memory.
-  static const char* kAlgoNames[5] = {"ring", "rhd", "tree", "hier.intra",
-                                      "hier.inter"};
-  static const char* kSelAlgoNames[4] = {"ring", "rhd", "tree", "hier"};
-  static const char* kCollNames[2] = {"allreduce", "broadcast"};
+  static const char* kAlgoNames[7] = {"ring",       "rhd",       "tree",
+                                      "hier.intra", "hier.inter", "a2a.intra",
+                                      "a2a.inter"};
+  static const char* kSelAlgoNames[6] = {"ring", "rhd",      "tree",
+                                         "hier", "hier_a2a", "pairwise"};
+  static const char* kCollNames[3] = {"allreduce", "broadcast", "alltoall"};
   family("tpunet_coll_steps_total", "counter",
          "Sequential collective wire rounds executed by this rank, per "
          "schedule (ring AllReduce = 2(W-1); rhd = 2*log2(W'); tree <= "
-         "2*ceil(log2 W); hier = 2(R-1) intra-host + 2(H-1) inter-host).");
-  for (int a = 0; a < 5; ++a) {
+         "2*ceil(log2 W); hier = 2(R-1) intra-host + 2(H-1) inter-host; "
+         "hier AllToAll = R-1 intra + H-1 inter).");
+  for (int a = 0; a < 7; ++a) {
     emit("tpunet_coll_steps_total{rank=\"%lld\",algo=\"%s\"} %llu\n",
          (long long)rank, kAlgoNames[a], (unsigned long long)s.coll_steps[a]);
   }
   family("tpunet_coll_algo_selected_total", "counter",
          "Collective dispatch decisions, by collective and RESOLVED "
          "schedule (override > TPUNET_DISPATCH_TABLE > built-ins).");
-  for (int k = 0; k < 2; ++k) {
-    for (int a = 0; a < 4; ++a) {
+  for (int k = 0; k < 3; ++k) {
+    for (int a = 0; a < 6; ++a) {
       emit("tpunet_coll_algo_selected_total{rank=\"%lld\",coll=\"%s\",algo=\"%s\"} %llu\n",
            (long long)rank, kCollNames[k], kSelAlgoNames[a],
            (unsigned long long)s.coll_algo_selected[k][a]);
     }
+  }
+  // AllToAll byte accounting per stage (docs/DESIGN.md "Hierarchical
+  // AllToAll"). All stage x dir series emit even at zero so the exact-byte
+  // gates (tests/test_a2a.py, moe_smoke) never look up a missing series.
+  static const char* kA2aStageNames[3] = {"intra", "inter", "flat"};
+  family("tpunet_a2a_bytes_total", "counter",
+         "AllToAll wire bytes per stage and direction: intra = same-host "
+         "regroup hops (SHM-cheap), inter = the one-rank-per-host DCN "
+         "transpose, flat = the pairwise mesh / ring relay baseline.");
+  for (int st = 0; st < 3; ++st) {
+    emit("tpunet_a2a_bytes_total{rank=\"%lld\",stage=\"%s\",dir=\"tx\"} %llu\n",
+         (long long)rank, kA2aStageNames[st],
+         (unsigned long long)s.a2a_bytes[st][0]);
+    emit("tpunet_a2a_bytes_total{rank=\"%lld\",stage=\"%s\",dir=\"rx\"} %llu\n",
+         (long long)rank, kA2aStageNames[st],
+         (unsigned long long)s.a2a_bytes[st][1]);
   }
   return out;
 }

@@ -1,0 +1,128 @@
+// Hierarchical two-stage AllToAll over the pairwise mesh (docs/DESIGN.md
+// "Hierarchical AllToAll"; the collective-communication-at-100k-GPUs shape:
+// MoE expert dispatch is AllToAll-bound with small, skewed shards, and a
+// flat W^2 exchange collapses first in connection count and message rate).
+//
+//   1. INTRA-HOST REGROUP (R-1 rounds, H*B bytes each — SHM segments under
+//      TPUNET_SHM=1): the R ranks sharing a host exchange blocks grouped by
+//      DESTINATION LOCAL INDEX. After the stage, local rank li holds — for
+//      every host h and every local source j — the block
+//      (src = local[j]  ->  dst = hosts[h][li]).
+//   2. INTER-HOST TRANSPOSE (H-1 rounds, R*B bytes each — the ONLY DCN
+//      hops): the H ranks with local index li (one per host, the same
+//      "column" construction as the hierarchical AllReduce's inter stage)
+//      exchange their per-destination-host bundles. The bundle received
+//      from host h scatters straight into the output: it holds the R blocks
+//      (src = hosts[h][j] -> dst = me).
+//
+// Wire accounting per rank: intra (R-1)*H*B bytes, inter (H-1)*R*B bytes —
+// vs the flat pairwise mesh's (W-1)*B all-DCN bytes. The inter stage is
+// exactly the cross-host payload lower bound; what the hierarchy buys on
+// top of the SHM routing is AGGREGATION: H-1 DCN messages of R*B instead
+// of R*(H-1) messages of B, and H-1 DCN connections instead of R*(H-1) —
+// the latency/connection levers for small, skewed MoE dispatch shards.
+// Under the typed-A2A codec wrapper (collectives.cc AllToAllTyped) B is
+// already the ENCODED block size, so the DCN bytes shrink by the codec
+// ratio on top. Counters carry every claim: a2a.intra/a2a.inter rounds in
+// tpunet_coll_steps_total, stage bytes in tpunet_a2a_bytes_total — gated
+// in tests/test_a2a.py and the moe_smoke CI lane, never by wall-clock.
+//
+// Topology comes from host_ids_ (the Init handshake blob) via
+// BuildHierTopo — identical on every rank, so the stages pair up with no
+// extra negotiation. Usable = >= 2 hosts AND uniform ranks/host; anything
+// else resolves back to the pairwise mesh in ApplyHierPolicy.
+#include <string.h>
+
+#include <algorithm>
+#include <vector>
+
+#include "coll_comm.h"
+
+namespace tpunet {
+namespace internal {
+
+Status ScheduledCommunicator::DoAllToAllHier(const uint8_t* in, uint8_t* out,
+                                             size_t B, uint64_t seq) {
+  HierTopo t = BuildHierTopo(rank_, host_ids_);
+  if (t.H < 2 || !t.uniform) {
+    // ApplyHierPolicy keeps this unreachable; belt-and-braces for an
+    // explicit override racing an exotic topology.
+    return Status::Inner("hier a2a schedule on a non-hierarchical topology");
+  }
+  Status s = EnsureMeshQuiesced();
+  if (!s.ok()) return s;
+  const size_t R = t.R, H = t.H;
+  const bool tracing = Telemetry::Get().tracing_enabled();
+
+  // Staging layout: slot (j, h) = block (src = local[j] -> dst =
+  // hosts[h][li]) at offset (j*H + h)*B. Stage-1 receives land contiguous
+  // (one j-run per peer); stage-2 sends gather one h-column per peer.
+  a2a_stage_.reserve(R * H * B);
+  auto slot = [&](size_t j, size_t h) {
+    return a2a_stage_.data() + (j * H + h) * B;
+  };
+  // My own contribution: the blocks I address to local index li on every
+  // host (contiguous j = li run).
+  for (size_t h = 0; h < H; ++h) {
+    memcpy(slot(t.li, h), in + static_cast<size_t>(t.hosts[h][t.li]) * B, B);
+  }
+
+  // ---- Stage 1: intra-host regroup, R-1 symmetric shifted rounds. Round
+  // s sends to local[(li+s)%R] the H blocks addressed to ITS local index
+  // and receives the H blocks addressed to MINE from local[(li-s+R)%R] —
+  // recv-first inside MeshShift, sizes identical on both sides.
+  a2a_fwd_.reserve(std::max(H, R) * B);  // stage-1 sends H*B, stage-2 R*B
+  for (size_t st = 1; st < R; ++st) {
+    const size_t to_li = (t.li + st) % R;
+    const size_t from_li = (t.li + R - st) % R;
+    const int to = t.local[to_li];
+    const int from = t.local[from_li];
+    for (size_t h = 0; h < H; ++h) {
+      memcpy(a2a_fwd_.data() + h * B,
+             in + static_cast<size_t>(t.hosts[h][to_li]) * B, B);
+    }
+    PhaseSpan sp(tracing, trace_comm_id_, seq, "a2a.intra",
+                 static_cast<int>(st - 1), H * B);
+    CountA2aSteps(/*inter=*/false);
+    s = MeshShift(to, a2a_fwd_.data(), H * B, from, slot(from_li, 0), H * B);
+    if (!s.ok()) return s;
+    CountA2aBytes(0, 0, H * B);
+    CountA2aBytes(0, 1, H * B);
+  }
+
+  // ---- Stage 2: inter-host column transpose, H-1 symmetric shifted
+  // rounds among the one-rank-per-host column. The bundle for host h is
+  // the h-column of the staging area (R blocks, one per local source); the
+  // bundle received from host h scatters into the output by source rank.
+  a2a_rcv_.reserve(R * B);
+  for (size_t st = 1; st < H; ++st) {
+    const size_t to_h = (t.hi + st) % H;
+    const size_t from_h = (t.hi + H - st) % H;
+    const int to = t.inter[to_h];
+    const int from = t.inter[from_h];
+    for (size_t j = 0; j < R; ++j) {
+      memcpy(a2a_fwd_.data() + j * B, slot(j, to_h), B);
+    }
+    PhaseSpan sp(tracing, trace_comm_id_, seq, "a2a.inter",
+                 static_cast<int>(st - 1), R * B);
+    CountA2aSteps(/*inter=*/true);
+    s = MeshShift(to, a2a_fwd_.data(), R * B, from, a2a_rcv_.data(), R * B);
+    if (!s.ok()) return s;
+    CountA2aBytes(1, 0, R * B);
+    CountA2aBytes(1, 1, R * B);
+    for (size_t j = 0; j < R; ++j) {
+      memcpy(out + static_cast<size_t>(t.hosts[from_h][j]) * B,
+             a2a_rcv_.data() + j * B, B);
+    }
+  }
+
+  // Own-host column: the blocks (src = local[j] -> dst = me) landed in
+  // stage 1 (j = li came from the local copy above) — scatter them out.
+  for (size_t j = 0; j < R; ++j) {
+    memcpy(out + static_cast<size_t>(t.local[j]) * B, slot(j, t.hi), B);
+  }
+  return Status::Ok();
+}
+
+}  // namespace internal
+}  // namespace tpunet

@@ -53,18 +53,14 @@ void ShardRange(size_t count, size_t parts, size_t j, size_t* lo, size_t* hi) {
   *hi = count * (j + 1) / parts;
 }
 
-struct HierTopo {
-  std::vector<int> local;  // ranks on my host, ascending
-  std::vector<int> inter;  // rank with my local index on each host, host order
-  size_t li = 0;           // my index in `local`
-  size_t hi = 0;           // my host's index in `inter`
-  size_t R = 0, H = 0;
-  bool uniform = false;
-};
+}  // namespace
 
 // Hosts are ordered by their lowest rank; ranks within a host ascend — every
 // rank derives the identical grouping from the identical host_ids_ vector.
-HierTopo BuildTopo(int rank, const std::vector<uint64_t>& ids) {
+// Shared by the hierarchical AllReduce here and the hierarchical AllToAll
+// (schedule_a2a.cc), which needs the FULL per-host grouping (t.hosts) to
+// address any (host, local index) rank.
+HierTopo BuildHierTopo(int rank, const std::vector<uint64_t>& ids) {
   HierTopo t;
   if (ids.empty()) return t;
   std::vector<uint64_t> host_order;
@@ -79,6 +75,10 @@ HierTopo BuildTopo(int rank, const std::vector<uint64_t>& ids) {
     }
   }
   t.H = host_order.size();
+  for (size_t h = 0; h < host_order.size(); ++h) {
+    t.hosts.push_back(groups[host_order[h]]);
+    if (host_order[h] == ids[rank]) t.hi = h;
+  }
   t.local = groups[ids[rank]];
   t.R = t.local.size();
   t.uniform = true;
@@ -91,23 +91,20 @@ HierTopo BuildTopo(int rank, const std::vector<uint64_t>& ids) {
   if (t.uniform) {
     for (size_t h = 0; h < host_order.size(); ++h) {
       t.inter.push_back(groups[host_order[h]][t.li]);
-      if (groups[host_order[h]][t.li] == rank) t.hi = h;
     }
   }
   return t;
 }
 
-}  // namespace
-
 bool ScheduledCommunicator::HierUsable() const {
   if (static_cast<int>(host_ids_.size()) != world_ || world_ < 2) return false;
-  HierTopo t = BuildTopo(rank_, host_ids_);
+  HierTopo t = BuildHierTopo(rank_, host_ids_);
   return t.H >= 2 && t.uniform;
 }
 
 bool ScheduledCommunicator::HierProfitable() const {
   if (static_cast<int>(host_ids_.size()) != world_ || world_ < 2) return false;
-  HierTopo t = BuildTopo(rank_, host_ids_);
+  HierTopo t = BuildHierTopo(rank_, host_ids_);
   // R == 1 makes hier == a flat inter AllReduce — legal under an explicit
   // override, but no reason for auto to leave the tuned ring path.
   return t.H >= 2 && t.uniform && t.R >= 2;
@@ -322,7 +319,7 @@ Status ScheduledCommunicator::DoAllReduceHier(const void* sendbuf, void* recvbuf
   const size_t esize = DTypeSize(dtype);
   const bool tracing = Telemetry::Get().tracing_enabled();
   PhaseSpan whole(tracing, trace_comm_id_, seq, "allreduce", -1, count * esize);
-  HierTopo t = BuildTopo(rank_, host_ids_);
+  HierTopo t = BuildHierTopo(rank_, host_ids_);
   if (t.H < 2 || !t.uniform) {
     // ApplyHierPolicy keeps this unreachable; belt-and-braces for an
     // explicit override racing an exotic topology.

@@ -1,0 +1,221 @@
+"""CI MoE-smoke lane: skewed dispatch vs a bulk tenant + exact hier-A2A bytes.
+
+Two phases, both counter-gated (the PR 3/5 epistemic stance — nothing rides
+wall-clock):
+
+  1. TWO-TENANT QOS (W=2, flat, TPUNET_QOS_INFLIGHT_BYTES wire armed): each
+     rank runs a LATENCY-class communicator carrying Zipf-skewed MoE
+     dispatch/combine typed AllToAlls (tpunet.workloads.moe) against a
+     concurrent BULK-class AllReduce tenant. Gates: the latency-class p99
+     wire-credit queue wait stays inside the 100 ms bucket
+     (tpunet_qos_queue_wait_us) while the bulk tenant completes its FULL
+     AllReduce quota and its byte counters carry the full budget
+     (tpunet_qos_bytes_total) — the DRR scheduler arbitrating a REAL
+     competing workload, ISSUE 11's acceptance shape.
+
+  2. EXACT HIER-A2A DCN BYTES (W=4 as 2x2 TPUNET_HOST_ID fake hosts,
+     TPUNET_A2A_ALGO=hier): one dispatch-shaped f32 typed AllToAll must
+     move EXACTLY the inter-stage-only figure per rank — intra (R-1)*H*B,
+     inter R*(H-1)*B, flat 0 — via tpunet_a2a_bytes_total, with a2a.intra/
+     a2a.inter round counts R-1 / H-1 in tpunet_coll_steps_total.
+
+Run: python tests/moe_smoke.py   (exit 0 = pass)
+"""
+
+import multiprocessing as mp
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+P99_BUDGET_US = 100_000
+STEPS = 8
+BULK_MIN_ITERS = 4
+BULK_BYTES = 4 << 20
+
+
+def _p99_us(metrics, cls):
+    from tpunet import telemetry
+
+    buckets = []
+    for key, value in metrics.get("tpunet_qos_queue_wait_us_bucket", {}).items():
+        lab = telemetry.labels(key)
+        if lab.get("class") != cls:
+            continue
+        le = lab["le"]
+        buckets.append((float("inf") if le == "+Inf" else float(le), int(value)))
+    buckets.sort()
+    if not buckets or buckets[-1][1] == 0:
+        return None
+    total = buckets[-1][1]
+    for bound, cum in buckets:
+        if cum >= 0.99 * total:
+            return bound
+    return float("inf")
+
+
+def _tenant_rank(rank, world, ports, q):
+    try:
+        os.environ.update({
+            "TPUNET_NSTREAMS": "1", "TPUNET_ASYNC_CHANNELS": "1",
+            "TPUNET_QOS_INFLIGHT_BYTES": "wire=256K",
+            "TPUNET_QOS_WEIGHTS": "latency=8,bulk=1",
+            "TPUNET_MOE_SKEW": "1.5",
+        })
+        import numpy as np
+
+        from tpunet import telemetry
+        from tpunet.collectives import Communicator
+        from tpunet.workloads import moe
+
+        lat = Communicator(f"127.0.0.1:{ports[0]}", rank, world,
+                           traffic_class="latency")
+        blk = Communicator(f"127.0.0.1:{ports[1]}", rank, world,
+                           traffic_class="bulk")
+        rng = np.random.default_rng(17 + rank)
+        disp = moe.MoeDispatcher(lat, d_model=64, capacity=256)
+        grad = np.full(BULK_BYTES // 4, 0.25, np.float32)
+        # Warmup wires meshes + channels on both comms, then reset counters.
+        disp.dispatch(rng.standard_normal((8, 64)).astype(np.float32),
+                      moe.route_tokens(8, world, rng=rng))
+        disp.combine(np.zeros((world, 256, 64), np.float32))
+        blk.all_reduce(np.ones(1024, np.float32))
+        lat.barrier()
+        telemetry.reset()
+
+        stop = threading.Event()
+        bulk_iters = [0]
+
+        def bulk_loop():
+            while not stop.is_set() or bulk_iters[0] < BULK_MIN_ITERS:
+                blk.all_reduce(grad, inplace=True)
+                bulk_iters[0] += 1
+
+        bt = threading.Thread(target=bulk_loop, daemon=True)
+        bt.start()
+        for _ in range(STEPS):
+            toks = rng.standard_normal((256, 64)).astype(np.float32)
+            experts = moe.route_tokens(256, world, rng=rng)  # env skew
+            expert_toks, _ = disp.dispatch(toks, experts)
+            disp.combine(expert_toks)
+        stop.set()
+        bt.join(timeout=180)
+        assert not bt.is_alive(), "bulk tenant wedged under contention"
+        m = telemetry.metrics()
+        by_class = {}
+        for key, v in m.get("tpunet_qos_bytes_total", {}).items():
+            lab = telemetry.labels(key)
+            by_class[(lab["class"], lab["dir"])] = int(v)
+        q.put((rank, {"ok": True,
+                      "p99_lat": _p99_us(m, "latency"),
+                      "bulk_iters": bulk_iters[0],
+                      "bulk_tx": by_class.get(("bulk", "tx"), 0),
+                      "lat_tx": by_class.get(("latency", "tx"), 0)}))
+        lat.close()
+        blk.close()
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        q.put((rank, {"ok": False, "error": f"{type(e).__name__}: {e}",
+                      "trace": traceback.format_exc()}))
+
+
+def _hier_rank(rank, world, port, q):
+    try:
+        os.environ.update({
+            "TPUNET_NSTREAMS": "1", "TPUNET_ASYNC_CHANNELS": "1",
+            "TPUNET_SHM": "1", "TPUNET_A2A_ALGO": "hier",
+            "TPUNET_HOST_ID": f"smokehost{rank // 2}",
+        })
+        import numpy as np
+
+        from tpunet import telemetry
+        from tpunet.collectives import Communicator
+
+        n = 16_384  # dispatch-shaped block: 64 KiB per (src, dst) pair
+        send = np.stack([np.full(n, float(rank * world + j), np.float32)
+                         for j in range(world)])
+        with Communicator(f"127.0.0.1:{port}", rank, world) as comm:
+            comm.barrier()
+            telemetry.reset()
+            got = comm.all_to_all_typed(send)
+            m = telemetry.metrics()
+        for j in range(world):
+            assert got[j][0] == float(j * world + rank), (j, got[j][0])
+        a2a = {}
+        for key, v in m.get("tpunet_a2a_bytes_total", {}).items():
+            lab = telemetry.labels(key)
+            a2a[(lab["stage"], lab["dir"])] = int(v)
+        steps = {telemetry.labels(k)["algo"]: int(v)
+                 for k, v in m.get("tpunet_coll_steps_total", {}).items()}
+        q.put((rank, {"ok": True, "a2a": a2a, "steps": steps, "B": n * 4}))
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        q.put((rank, {"ok": False, "error": f"{type(e).__name__}: {e}",
+                      "trace": traceback.format_exc()}))
+
+
+def _spawn(target, world, ports):
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=target, args=(r, world, ports, q))
+             for r in range(world)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(world):
+            rank, res = q.get(timeout=300)
+            results[rank] = res
+    finally:
+        for p in procs:
+            p.join(timeout=60)
+            if p.is_alive():
+                p.kill()
+    for r, v in sorted(results.items()):
+        assert v.get("ok"), f"rank {r}: {v.get('error')}\n{v.get('trace', '')}"
+    assert len(results) == world
+    return results
+
+
+def main() -> None:
+    from conftest import free_port
+
+    # Phase 1: two-tenant QoS arbitration, W=2 flat.
+    world = 2
+    res = _spawn(_tenant_rank, world, (free_port(), free_port()))
+    for r, v in res.items():
+        assert v["p99_lat"] is not None, f"rank {r}: latency class never gated"
+        assert v["p99_lat"] <= P99_BUDGET_US, \
+            f"rank {r}: dispatch p99 queue wait {v['p99_lat']}us over budget"
+        assert v["bulk_iters"] >= BULK_MIN_ITERS, \
+            f"rank {r}: bulk tenant starved ({v['bulk_iters']} iters)"
+        # Full budget by counters: each AllReduce moves 2*(W-1)/W * S tx.
+        expect = BULK_MIN_ITERS * BULK_BYTES * 2 * (world - 1) // world
+        assert v["bulk_tx"] >= expect, \
+            f"rank {r}: bulk moved {v['bulk_tx']}B < budget {expect}B"
+        assert v["lat_tx"] > 0, f"rank {r}: dispatch moved no latency bytes"
+
+    # Phase 2: exact inter-stage-only DCN bytes on the 2x2 split.
+    world, hosts = 4, 2
+    R, H = world // hosts, hosts
+    res2 = _spawn(_hier_rank, world, free_port())
+    for r, v in res2.items():
+        B = v["B"]
+        assert v["a2a"][("intra", "tx")] == (R - 1) * H * B, (r, v["a2a"])
+        assert v["a2a"][("inter", "tx")] == R * (H - 1) * B, (r, v["a2a"])
+        assert v["a2a"][("flat", "tx")] == 0, (r, v["a2a"])
+        assert v["steps"].get("a2a.intra") == R - 1, v["steps"]
+        assert v["steps"].get("a2a.inter") == H - 1, v["steps"]
+
+    print(f"moe smoke OK: dispatch p99 queue wait <= "
+          f"{max(v['p99_lat'] for v in res.values()):.0f}us with bulk at full "
+          f"budget; hier-A2A DCN bytes exactly inter-stage-only "
+          f"({res2[0]['a2a'][('inter', 'tx')]}B/rank on the 2x2 split)")
+
+
+if __name__ == "__main__":
+    main()

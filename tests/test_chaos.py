@@ -797,3 +797,272 @@ def test_chaos_hier_dcn_stage(action):
 
         contained = any(int(x) >= 1 for x in _re.findall(r"fo=(\d+)", statuses))
         assert "TYPED" in statuses or contained, statuses
+
+# ---------------------------------------------------------------------------
+# Chaos matrix x hierarchical AllToAll: faults on the DCN (inter) stage.
+
+
+def _hier_a2a_chaos_worker(rank: int, world: int, port: int, q,
+                           action: str) -> None:
+    try:
+        os.environ.update({
+            "TPUNET_PROGRESS_TIMEOUT_MS": "2500", "TPUNET_CRC": "1",
+            "TPUNET_A2A_ALGO": "hier", "TPUNET_SHM": "1",
+            "TPUNET_NSTREAMS": "1", "TPUNET_ASYNC_CHANNELS": "1",
+            "TPUNET_HOST_ID": f"a2achaos{rank // 2}",
+        })
+        from tpunet import _native as nat
+        from tpunet import transport as tp
+        from tpunet.collectives import Communicator
+
+        comm = Communicator(f"127.0.0.1:{port}", rank, world)
+        n = 1 << 18  # 1 MiB blocks -> 4 MiB payload, several wire chunks
+        send = np.stack([np.full(n, 100.0 * rank + j, np.float32)
+                         for j in range(world)])
+        warm = comm.all_to_all(send)
+        for j in range(world):
+            assert warm[j][0] == 100.0 * j + rank
+        comm.barrier()
+        if rank == 1:
+            # Fires during the measured exchange; rank 1's cross-host
+            # (DCN) sends happen in the a2a.inter stage.
+            tp.fault_inject(f"stream=*:side=send:after_bytes=256K:action={action}")
+        t0 = time.perf_counter()
+        from tpunet import telemetry
+
+        try:
+            got = comm.all_to_all_typed(send)
+            dt = time.perf_counter() - t0
+            correct = all(bool(np.all(got[j] == 100.0 * j + rank))
+                          for j in range(world))
+            fo = int(sum(telemetry.metrics().get(
+                "tpunet_stream_failovers_total", {}).values()))
+            q.put((rank, f"OK correct={correct} fo={fo} dt={dt:.1f}"))
+        except nat.NativeError as e:
+            dt = time.perf_counter() - t0
+            q.put((rank, f"TYPED code={e.code} dt={dt:.1f}"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+    finally:
+        try:
+            from tpunet import transport as tp
+
+            tp.fault_clear()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+@pytest.mark.parametrize("action", ["close", "stall", "corrupt"])
+def test_chaos_hier_a2a_dcn_stage(action):
+    """hier-A2A x {close, stall, corrupt} on the DCN stage (W=4 as 2x2 fake
+    hosts): a lost, stalled or corrupted inter-host transpose path must end
+    in a typed error (or a contained failover with a CORRECT result) within
+    the bounded wait on every rank — the hierarchical AllToAll inherits the
+    transport's failure model whole (ISSUE 11 chaos row)."""
+    import multiprocessing as mp
+
+    from conftest import free_port
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = free_port()
+    procs = [
+        ctx.Process(target=_hier_a2a_chaos_worker, args=(r, 4, port, q, action))
+        for r in range(4)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(4):
+            rank, status = q.get(timeout=150)  # the bounded-wait guarantee
+            results[rank] = status
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.kill()
+    assert len(results) == 4, f"missing rank report: {results}"
+    statuses = " | ".join(f"{r}:{s}" for r, s in sorted(results.items()))
+    for rank, status in results.items():
+        assert not status.startswith("FAIL"), f"rank {rank}: {status}"
+        assert "correct=False" not in status, f"rank {rank}: {status}"
+        assert status.startswith(("OK", "TYPED")), f"rank {rank}: {status}"
+    if action == "stall":
+        assert f"code={_native.TPUNET_ERR_TIMEOUT}" in statuses, statuses
+    elif action == "corrupt":
+        assert f"code={_native.TPUNET_ERR_CORRUPT}" in statuses, statuses
+    else:
+        import re as _re
+
+        contained = any(int(x) >= 1 for x in _re.findall(r"fo=(\d+)", statuses))
+        assert "TYPED" in statuses or contained, statuses
+
+
+# ---------------------------------------------------------------------------
+# Workload chaos rows (ISSUE 11): expert-shard loss + mid-pipeline death.
+
+
+def _moe_chaos_worker(rank: int, world: int, port: int, q) -> None:
+    try:
+        os.environ.update({
+            "TPUNET_PROGRESS_TIMEOUT_MS": "2500", "TPUNET_CRC": "1",
+            "TPUNET_NSTREAMS": "1", "TPUNET_ASYNC_CHANNELS": "1",
+        })
+        from tpunet import _native as nat
+        from tpunet import transport as tp
+        from tpunet.collectives import Communicator
+        from tpunet.workloads import moe
+
+        d_model, capacity, T = 64, 256, 512
+        rng = np.random.default_rng(rank)
+        comm = Communicator(f"127.0.0.1:{port}", rank, world,
+                            traffic_class="latency")
+        disp = moe.MoeDispatcher(comm, d_model=d_model, capacity=capacity)
+        toks = rng.standard_normal((T, d_model)).astype(np.float32)
+        experts = moe.route_tokens(T, world, 1.0, rng)
+        disp.dispatch(toks, experts)  # warmup wires the mesh
+        disp.combine(np.zeros((world, capacity, d_model), np.float32))
+        comm.barrier()
+        if rank == 1:
+            # Expert-shard loss: the dispatch stream to/from rank 1 dies
+            # mid-exchange (fault-injected close on its send side).
+            tp.fault_inject("stream=*:side=send:after_bytes=64K:action=close")
+        t0 = time.perf_counter()
+        try:
+            expert_toks, _ = disp.dispatch(toks, experts)
+            disp.combine(expert_toks)
+            dt = time.perf_counter() - t0
+            q.put((rank, f"OK dt={dt:.1f}"))
+        except nat.NativeError as e:
+            dt = time.perf_counter() - t0
+            q.put((rank, f"TYPED code={e.code} dt={dt:.1f}"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+    finally:
+        try:
+            from tpunet import transport as tp
+
+            tp.fault_clear()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def test_chaos_moe_expert_shard_loss():
+    """Expert-shard loss: a fault-injected close on a dispatch stream while
+    an MoE dispatch A2A is in flight must produce a typed verdict
+    (CorruptionError / dead-peer / watchdog) on every AFFECTED rank within
+    the bounded wait — the dispatch can fail, it can never hang or hand
+    back silently wrong expert inputs. Single-stream comms: a close IS a
+    last-stream loss (no failover shield)."""
+    import multiprocessing as mp
+
+    from conftest import free_port
+
+    world = 2
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = free_port()
+    procs = [ctx.Process(target=_moe_chaos_worker, args=(r, world, port, q))
+             for r in range(world)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(world):
+            rank, status = q.get(timeout=150)  # the bounded-wait guarantee
+            results[rank] = status
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.kill()
+    assert len(results) == world, f"missing rank report: {results}"
+    statuses = " | ".join(f"{r}:{s}" for r, s in sorted(results.items()))
+    for rank, status in results.items():
+        assert not status.startswith("FAIL"), f"rank {rank}: {status}"
+        assert status.startswith(("OK", "TYPED")), f"rank {rank}: {status}"
+    # The injected close cannot vanish: at least one rank fails typed.
+    assert "TYPED" in statuses, statuses
+
+
+def _pipe_death_worker(rank: int, world: int, port: int, q) -> None:
+    try:
+        os.environ.update({
+            "TPUNET_PROGRESS_TIMEOUT_MS": "2500",
+            "TPUNET_NSTREAMS": "1", "TPUNET_ASYNC_CHANNELS": "1",
+            "TPUNET_KEEPALIVE_IDLE_S": "1", "TPUNET_KEEPALIVE_INTVL_S": "1",
+        })
+        from tpunet import _native as nat
+        from tpunet.collectives import Communicator
+        from tpunet.workloads.pipeline import PipelineStage
+
+        n = 1 << 16
+        comm = Communicator(f"127.0.0.1:{port}", rank, world)
+        st = PipelineStage(comm)
+        # One healthy microbatch proves the chain, then the middle stage
+        # dies abruptly mid-pipeline.
+        if st.is_first:
+            st.isend(np.full(n, 7.0, np.float32)).wait()
+        elif not st.is_last:
+            buf = np.empty(n, np.float32)
+            st.irecv(buf).wait()
+            st.isend(buf + 1.0).wait()
+        else:
+            buf = np.empty(n, np.float32)
+            st.irecv(buf).wait()
+            assert buf[0] == 7.0 + (world - 2)
+        comm.barrier()
+        if rank == world // 2:
+            os._exit(1)  # mid-pipeline rank death, no goodbye
+        t0 = time.perf_counter()
+        try:
+            if st.is_first:
+                # Keep feeding the dead stage: the send side must surface a
+                # typed verdict (EOF / reset / watchdog), not wedge.
+                for _ in range(64):
+                    st.isend(np.full(n, 8.0, np.float32)).wait()
+                    time.sleep(0.05)
+                q.put((rank, "FAIL: sender never noticed the death"))
+            else:
+                buf = np.empty(n, np.float32)
+                st.irecv(buf).wait()
+                q.put((rank, "FAIL: receiver never noticed the death"))
+        except nat.NativeError as e:
+            dt = time.perf_counter() - t0
+            q.put((rank, f"TYPED code={e.code} dt={dt:.1f}"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def test_chaos_pipeline_rank_death_fails_typed_never_hangs():
+    """Mid-pipeline rank death (W=3, middle stage os._exit): both NEIGHBORS
+    must surface a typed verdict — the receiver sees dead-peer EOF, the
+    sender EOF/reset or the progress watchdog — within the bounded wait.
+    Zero hangs: the chain inherits the transport's loud failure model
+    (ISSUE 11 chaos row)."""
+    import multiprocessing as mp
+
+    from conftest import free_port
+
+    world = 3
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = free_port()
+    procs = [ctx.Process(target=_pipe_death_worker, args=(r, world, port, q))
+             for r in range(world)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(world - 1):  # the dead rank reports nothing
+            rank, status = q.get(timeout=150)  # the bounded-wait guarantee
+            results[rank] = status
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.kill()
+    assert len(results) == world - 1, f"missing rank report: {results}"
+    for rank, status in results.items():
+        assert status.startswith("TYPED"), f"rank {rank}: {status}"

@@ -1,0 +1,253 @@
+"""MoE dispatch/combine + pipeline stage driver (tpunet.workloads).
+
+The workload tier is pure-Python over public tpunet APIs, so most of the
+suite runs without a socket (routing/packing determinism, slot
+bookkeeping, overflow drops); the multiprocess lanes pin end-to-end
+dispatch->expert->combine correctness and the directed microbatch chain
+across stages.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from conftest import run_spawn_workers
+
+from tpunet.workloads import moe
+
+
+# ---------------------------------------------------------------------------
+# Routing: Zipf skew model.
+
+
+def test_zipf_weights_shape_and_skew():
+    w0 = moe.zipf_weights(8, 0.0)
+    np.testing.assert_allclose(w0, np.full(8, 1 / 8))  # skew 0 = uniform
+    w2 = moe.zipf_weights(8, 2.0)
+    assert abs(w2.sum() - 1.0) < 1e-12
+    assert np.all(np.diff(w2) < 0), "popularity must fall with rank"
+    assert w2[0] > 4 * w2[-1], "skew=2 must concentrate load"
+    with pytest.raises(ValueError):
+        moe.zipf_weights(0, 1.0)
+    with pytest.raises(ValueError):
+        moe.zipf_weights(4, -1.0)
+
+
+def test_route_tokens_skew_and_env_default(monkeypatch):
+    rng = np.random.default_rng(3)
+    e = moe.route_tokens(5000, 4, 3.0, rng)
+    assert e.shape == (5000,) and e.min() >= 0 and e.max() < 4
+    counts = np.bincount(e, minlength=4)
+    # skew 3: the hottest expert takes a clear majority
+    assert counts.max() > 0.5 * 5000
+    # skew rides TPUNET_MOE_SKEW when not passed (the registered knob)
+    monkeypatch.setenv("TPUNET_MOE_SKEW", "0.0")
+    e0 = moe.route_tokens(8000, 4, rng=np.random.default_rng(4))
+    c0 = np.bincount(e0, minlength=4)
+    assert c0.max() < 0.35 * 8000, "skew=0 from env should be near-uniform"
+
+
+# ---------------------------------------------------------------------------
+# Packing: capacity, drops, slot bookkeeping (socket-free via W=1 comm).
+
+
+def _w1_comm():
+    from conftest import free_port
+
+    from tpunet.collectives import Communicator
+
+    return Communicator(f"127.0.0.1:{free_port()}", 0, 1)
+
+
+def test_pack_capacity_overflow_drops_loudly():
+    comm = _w1_comm()
+    try:
+        d = moe.MoeDispatcher(comm, d_model=4, capacity=2)
+        toks = np.arange(12, dtype=np.float32).reshape(3, 4)
+        buf, counts = d.pack(toks, np.zeros(3, np.int64))
+        assert counts.tolist() == [2]  # third token dropped, not mixed in
+        assert d.tokens_dropped == 1 and d.tokens_routed == 3
+        np.testing.assert_array_equal(buf[0, 0], toks[0])
+        np.testing.assert_array_equal(buf[0, 1], toks[1])
+        with pytest.raises(ValueError):
+            d.pack(toks, np.array([0, 0, 5]))  # expert id out of range
+        with pytest.raises(ValueError):
+            d.pack(toks[:, :2], np.zeros(3, np.int64))  # wrong d_model
+    finally:
+        comm.close()
+
+
+def test_single_rank_dispatch_combine_roundtrip():
+    comm = _w1_comm()
+    try:
+        d = moe.MoeDispatcher(comm, d_model=8, capacity=16)
+        rng = np.random.default_rng(0)
+        toks = rng.standard_normal((10, 8)).astype(np.float32)
+        expert_toks, counts = d.dispatch(toks, np.zeros(10, np.int64))
+        assert counts.tolist() == [10]
+        out = d.combine(expert_toks * 3.0)
+        np.testing.assert_allclose(out, toks * 3.0, rtol=1e-6)
+        assert d.drop_fraction == 0.0
+    finally:
+        comm.close()
+
+
+# ---------------------------------------------------------------------------
+# Multi-rank: dispatch -> expert -> combine end to end.
+
+
+def _moe_worker(rank, world, port, q, env):
+    try:
+        os.environ.update(env)
+        from tpunet.collectives import Communicator
+
+        d_model, capacity, T = 8, 8, 16
+        rng = np.random.default_rng(100 + rank)
+        toks = rng.standard_normal((T, d_model)).astype(np.float32)
+        experts = moe.route_tokens(T, world, 1.0, rng)
+        with Communicator(f"127.0.0.1:{port}", rank, world) as comm:
+            d = moe.MoeDispatcher(comm, d_model=d_model, capacity=capacity)
+            expert_toks, counts_by_src = d.dispatch(toks, experts)
+            # Expert applies a rank-stamped transform so combine provably
+            # visited the RIGHT expert: out = in * (expert_rank + 2).
+            out = d.combine(expert_toks * float(rank + 2))
+        # Validate against local bookkeeping: every kept token came back
+        # through its expert's transform; dropped tokens stayed zero.
+        kept = d._kept
+        for i in range(T):
+            if kept[i]:
+                np.testing.assert_allclose(
+                    out[i], toks[i] * float(experts[i] + 2), rtol=1e-5)
+            else:
+                assert np.all(out[i] == 0.0)
+        # counts_by_src[s] bounded by capacity, and my own column matches
+        # my local pack counts for my expert.
+        assert counts_by_src.max() <= capacity
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_moe_dispatch_combine_multi_rank(world):
+    env = {"TPUNET_NSTREAMS": "1", "TPUNET_ASYNC_CHANNELS": "1"}
+    run_spawn_workers(_moe_worker, world, extra_args=(env,))
+
+
+def test_moe_dispatch_combine_hier_typed():
+    """The whole stack at once: 2x2 fake hosts, hier A2A, int8 typed wire —
+    combine results stay inside the documented per-block error bound."""
+    env = {"TPUNET_NSTREAMS": "1", "TPUNET_ASYNC_CHANNELS": "1",
+           "TPUNET_SHM": "1", "TPUNET_A2A_ALGO": "hier",
+           "TPUNET_WIRE_DTYPE": "int8"}
+    run_spawn_workers(_moe_typed_worker, 4, extra_args=(env,))
+
+
+def _moe_typed_worker(rank, world, port, q, env):
+    try:
+        os.environ.update(env)
+        os.environ["TPUNET_HOST_ID"] = f"moewl{rank // 2}"
+        from tpunet.collectives import Communicator
+
+        d_model, capacity, T = 16, 8, 16
+        rng = np.random.default_rng(200 + rank)
+        toks = rng.standard_normal((T, d_model)).astype(np.float32)
+        experts = moe.route_tokens(T, world, 1.0, rng)
+        with Communicator(f"127.0.0.1:{port}", rank, world) as comm:
+            d = moe.MoeDispatcher(comm, d_model=d_model, capacity=capacity)
+            expert_toks, _ = d.dispatch(toks, experts)
+            out = d.combine(expert_toks)
+        kept = d._kept
+        # Two wire hops (dispatch + combine), each |err| <= amax/254 per
+        # block; values are standard-normal, so 0.05 is a generous-but-
+        # bug-catching bound.
+        for i in range(T):
+            if kept[i]:
+                np.testing.assert_allclose(out[i], toks[i], atol=0.05)
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+# ---------------------------------------------------------------------------
+# Pipeline stage driver.
+
+
+def test_ticket_after_ordering_unit():
+    from tpunet.workloads.pipeline import Ticket
+
+    order = []
+
+    class FakeReq:
+        def __init__(self, name):
+            self.name = name
+
+        def wait(self, timeout=None):
+            order.append(self.name)
+            return 0
+
+        def test(self):
+            return True, 0
+
+    t1 = Ticket(FakeReq("a"))
+    t2 = Ticket(FakeReq("b"), deps=(t1,))
+    t3 = Ticket(FakeReq("c"), deps=(t2, t1))
+    t3.wait()
+    assert order == ["a", "b", "c"], order  # deps settle first, once each
+    assert t1.done() and t2.done() and t3.done()
+
+
+def _pipe_worker(rank, world, port, q, env):
+    try:
+        os.environ.update(env)
+        from tpunet.collectives import Communicator
+        from tpunet.workloads.pipeline import PipelineStage
+
+        n_micro, n = 6, 1024
+        comm = Communicator(f"127.0.0.1:{port}", rank, world)
+        with PipelineStage(comm) as st:
+            # first/last sanity + misdirected transfers fail loudly
+            assert st.is_first == (rank == 0)
+            assert st.is_last == (rank == world - 1)
+            if st.is_last:
+                try:
+                    st.isend(np.zeros(4, np.float32))
+                    raise AssertionError("last stage isend must raise")
+                except RuntimeError:
+                    pass
+            if st.is_first:
+                mbs = [np.full(n, 10.0 * i, np.float32) for i in range(n_micro)]
+                out = st.run(lambda x: x + 1.0, microbatches=mbs)
+                assert out is None
+            else:
+                out = st.run(lambda x: x + 1.0, n_micro=n_micro, mb_shape=(n,))
+            if st.is_last:
+                assert len(out) == n_micro
+                for i, y in enumerate(out):
+                    assert np.all(y == 10.0 * i + world), (i, y[0])
+        comm.close()
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+@pytest.mark.parametrize("world", [2, 3])
+def test_pipeline_microbatch_chain(world):
+    env = {"TPUNET_NSTREAMS": "1", "TPUNET_ASYNC_CHANNELS": "1"}
+    run_spawn_workers(_pipe_worker, world, extra_args=(env,))
+
+
+def test_pipeline_chain_across_fake_hosts():
+    """Stage boundaries crossing a TPUNET_HOST_ID split: stage links between
+    fake hosts ride TCP, the chain still verifies end to end."""
+    env = {"TPUNET_NSTREAMS": "1", "TPUNET_ASYNC_CHANNELS": "1",
+           "TPUNET_SHM": "1"}
+    run_spawn_workers(_pipe_split_worker, 4, extra_args=(env,))
+
+
+def _pipe_split_worker(rank, world, port, q, env):
+    os.environ["TPUNET_HOST_ID"] = f"pipewl{rank // 2}"
+    _pipe_worker(rank, world, port, q, env)

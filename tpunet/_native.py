@@ -171,6 +171,12 @@ def load() -> ctypes.CDLL:
     lib.tpunet_comm_broadcast.restype = i32
     lib.tpunet_comm_all_to_all.argtypes = [u, ctypes.c_void_p, ctypes.c_void_p, u64]
     lib.tpunet_comm_all_to_all.restype = i32
+    lib.tpunet_comm_all_to_all_typed.argtypes = [
+        u, ctypes.c_void_p, ctypes.c_void_p, u64, i32]
+    lib.tpunet_comm_all_to_all_typed.restype = i32
+    lib.tpunet_comm_iall_to_all.argtypes = [
+        u, ctypes.c_void_p, ctypes.c_void_p, u64, P(u64)]
+    lib.tpunet_comm_iall_to_all.restype = i32
     lib.tpunet_comm_neighbor_exchange.argtypes = [u, ctypes.c_void_p, u64, ctypes.c_void_p, u64, P(u64)]
     lib.tpunet_comm_neighbor_exchange.restype = i32
     lib.tpunet_comm_barrier.argtypes = [u]

@@ -292,6 +292,59 @@ class Communicator:
         )
         return out
 
+    def all_to_all_typed(self, arr: Any) -> np.ndarray:
+        """Typed AllToAll: like :meth:`all_to_all`, but blocks are ELEMENTS
+        of the array's dtype, and float32 blocks honor the communicator's
+        negotiated wire codec (``wire_dtype="bf16"``/``"int8"``) — every
+        non-self block is encoded once at the source (int8 scale blocks
+        restart per (src, dst) block) and decoded once at the destination,
+        so results are bit-identical across the pairwise / relay /
+        hierarchical routes and each block's error stays inside the
+        documented |err| <= amax/254 bound. The MoE dispatch/combine
+        primitive (tpunet.workloads.moe)."""
+        arr = _c_contig(np.asarray(arr))
+        if arr.shape[0] != self.world_size:
+            raise ValueError(
+                f"leading axis {arr.shape[0]} must equal world size {self.world_size}"
+            )
+        out = np.empty_like(arr)
+        _native.check(
+            self._lib.tpunet_comm_all_to_all_typed(
+                self._id,
+                arr.ctypes.data if arr.size else None,
+                out.ctypes.data if out.size else None,
+                arr.size // self.world_size,
+                _dtype_code(arr.dtype),
+            ),
+            "all_to_all_typed",
+        )
+        return out
+
+    def iall_to_all(self, arr: Any) -> AsyncResult:
+        """Nonblocking AllToAll (byte-oriented): returns immediately with an
+        AsyncResult; mesh-routed schedules run on the communicator's
+        dedicated mesh worker, so an async AllToAll overlaps async ring
+        AllReduces on disjoint comms instead of queueing behind them.
+        Submission order across ranks must match, like iall_reduce."""
+        arr = _c_contig(np.asarray(arr))
+        if arr.shape[0] != self.world_size:
+            raise ValueError(
+                f"leading axis {arr.shape[0]} must equal world size {self.world_size}"
+            )
+        out = np.empty_like(arr)
+        ticket = ctypes.c_uint64(0)
+        _native.check(
+            self._lib.tpunet_comm_iall_to_all(
+                self._id,
+                arr.ctypes.data if arr.size else None,
+                out.ctypes.data if out.size else None,
+                arr.nbytes // self.world_size,
+                ctypes.byref(ticket),
+            ),
+            "iall_to_all",
+        )
+        return AsyncResult(self, ticket.value, arr, out)
+
     def neighbor_exchange(self, arr: Any) -> np.ndarray:
         """Send arr to (rank+1)%W, receive the same-shaped message from
         (rank-1+W)%W — the ring-attention / sequence-parallel shift step."""

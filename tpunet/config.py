@@ -72,6 +72,23 @@ def _env_choice(name: str, fallback: str, choices: tuple[str, ...],
     return v
 
 
+def _env_float_checked(name: str, fallback: float, minimum: float,
+                       what: str) -> float:
+    """Read a float env var; a NUMERIC value below `minimum` raises
+    ValueError naming the var; non-numeric garbage falls back (the
+    GetEnvU64 stance, matching the numeric readers above)."""
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return fallback
+    try:
+        f = float(v)
+    except ValueError:
+        return fallback
+    if f < minimum:
+        raise ValueError(f"{name}={v} is invalid: {what} must be >= {minimum}")
+    return f
+
+
 _QOS_CLASSES = ("latency", "bulk", "control")
 
 
@@ -255,6 +272,15 @@ class Config:
     # (store-and-forward relay: no extra comms, but each block travels
     # multiple hops — 2x the bytes at W=4).
     a2a: str = "pairwise"
+    # AllToAll schedule override superseding the legacy TPUNET_A2A switch:
+    # "auto" (pairwise, upgraded to the two-stage hierarchical transpose on
+    # a profitable >= 2-host uniform topology), "pairwise", "ring" (relay),
+    # or "hier" (pin the two-stage transpose; degrades to pairwise on a
+    # flat topology). Negotiated at communicator wiring like TPUNET_ALGO —
+    # half a world on the mesh and half on the transpose deadlocks, so a
+    # disagreement fails every rank typed. docs/DESIGN.md "Hierarchical
+    # AllToAll".
+    a2a_algo: str = "auto"
     # Worlds larger than this fall back to the ring relay rather than paying
     # 2*(W-1) comm bundles of fds/threads per rank for the pairwise mesh.
     a2a_mesh_max_world: int = 32
@@ -383,6 +409,11 @@ class Config:
     # unlimited). wire= sets the shared WIRE WINDOW that arms the DRR chunk
     # scheduler (0 = gate off, the default — dispatch is then unchanged).
     qos_inflight_bytes: str = ""
+    # ---- MoE / pipeline workloads (docs/DESIGN.md "Workloads") -----------
+    # Default Zipf skew exponent for the MoE workload's expert routing
+    # (tpunet.workloads.moe): 0 = uniform expert popularity, larger = more
+    # skewed (the 100k+-GPU paper's hot-expert shape). Must be >= 0.
+    moe_skew: float = 1.0
 
     @staticmethod
     def from_env() -> "Config":
@@ -444,6 +475,11 @@ class Config:
                 ("TPUNET_ASYNC_CHANNELS",), 2, 1, "async ring channel count", maximum=8
             ),
             a2a=env.get("TPUNET_A2A", "pairwise"),
+            a2a_algo=_env_choice(
+                "TPUNET_A2A_ALGO", "auto",
+                ("auto", "pairwise", "ring", "hier", "hier_a2a"),
+                "AllToAll schedule",
+            ),
             a2a_mesh_max_world=_env_int("TPUNET_A2A_MESH_MAX_WORLD", 32),
             # Parsed to match the native consumer (GetEnvU64, default 1):
             # only a numeric 0 disables; "false"/"" fall back to on.
@@ -535,5 +571,8 @@ class Config:
             qos_inflight_bytes=_env_qos_spec(
                 "TPUNET_QOS_INFLIGHT_BYTES", _QOS_CLASSES + ("wire",),
                 "in-flight budget",
+            ),
+            moe_skew=_env_float_checked(
+                "TPUNET_MOE_SKEW", 1.0, 0.0, "MoE Zipf skew exponent",
             ),
         )

@@ -1,0 +1,32 @@
+"""tpunet workloads — the traffic patterns that drive the transport.
+
+The collectives layer was AllReduce-deep but workload-narrow; this package
+adds the two traffic shapes "Collective Communication for 100k+ GPUs" names
+as the new dominant patterns, built entirely on public tpunet APIs so they
+double as end-to-end exercisers of the QoS / codec / hierarchical-schedule
+machinery:
+
+  moe      — Mixture-of-Experts dispatch/combine over the typed AllToAll:
+             Zipf-skewed top-1 expert routing (TPUNET_MOE_SKEW), capacity-
+             bounded packing, dispatch on a latency-class communicator so
+             the PR 8 DRR scheduler finally arbitrates a REAL competing
+             workload (benchmarks/moe_bench.py pits it against a bulk
+             gradient tenant).
+  pipeline — pipeline-parallel stage driver: directed microbatch send/recv
+             chains over per-stage P2P links with ticket `after=` ordering
+             (the workload-tier analogue of the FFI `after=` operand
+             threading), across real or TPUNET_HOST_ID fake-host splits.
+
+docs/DESIGN.md "Workloads: MoE dispatch & pipeline stages".
+"""
+
+from tpunet.workloads.moe import MoeDispatcher, route_tokens, zipf_weights
+from tpunet.workloads.pipeline import PipelineStage, Ticket
+
+__all__ = [
+    "MoeDispatcher",
+    "PipelineStage",
+    "Ticket",
+    "route_tokens",
+    "zipf_weights",
+]
