@@ -49,6 +49,11 @@ extern "C" {
  * enqueued or charged — retry after in-flight work drains (the serve
  * router replays front-of-queue). docs/DESIGN.md "Transport QoS". */
 #define TPUNET_ERR_QOS_ADMISSION -8
+/* Elastic rewire failure (docs/DESIGN.md "Elastic churn"): a mid-run
+ * membership rewire exceeded TPUNET_REWIRE_TIMEOUT_MS or the churn engine
+ * aborted recovery. The old communicator is already finalized; the caller
+ * owns the retry-or-die decision — never a hang. */
+#define TPUNET_ERR_REWIRE -9
 
 /* 64-byte opaque rendezvous blob: the serialized listen sockaddr, sized to
  * NCCL's handle budget (reference: cc/nccl_types.h:44). Ship it to the
@@ -116,9 +121,25 @@ const char* tpunet_c_last_error(void);
  * engine's send/recv hot path. One fault at a time; re-arming replaces and
  * resets the byte counters. NULL or "" clears. Returns TPUNET_ERR_INVALID
  * (with tpunet_c_last_error() naming the bad token) on a malformed spec.
- * TPUNET_FAULT_SPEC arms the same slot at engine creation. */
+ * TPUNET_FAULT_SPEC arms the same slot at engine creation.
+ *
+ * The spec may also be a ';'-separated SCRIPT whose churn segments
+ * ("churn:at_step=N:rank=K:action=kill|join") arm the process-wide churn
+ * script (docs/DESIGN.md "Elastic churn") — deterministic scripted
+ * membership churn, polled at step boundaries rather than applied on the
+ * IO path. At most one classic fault segment may ride along. */
 int32_t tpunet_c_fault_inject(const char* spec);
 int32_t tpunet_c_fault_clear(void);
+/* One-shot churn-script poll at a step boundary: fires (and consumes) the
+ * first armed event with at_step <= step targeting `rank` (or rank=*) and
+ * returns its action — 0 none, 1 kill (the polling rank must die NOW),
+ * 2 join (a new rank enters the world; supervisor/joiner-side verdict).
+ * Fired latches survive engine rebuilds: the rewires a churn script causes
+ * must not re-fire the events the job already recovered from. */
+int32_t tpunet_c_churn_poll(uint64_t step, int64_t rank);
+/* Armed churn events not yet fired (the churn smoke lane's completeness
+ * gate: a finished scripted run must report 0). */
+int32_t tpunet_c_churn_pending(void);
 /* CRC32C (Castagnoli) of `data`, seeded with `seed` (0 = fresh; chain for
  * discontiguous buffers). Exposed for golden-vector tests and so Python
  * tooling can pre-verify payloads against the wire trailers. */
@@ -301,6 +322,21 @@ int32_t tpunet_c_serve_observe(int32_t kind, uint64_t us);
  * (tpunet_serve_queue_depth{tier=...}): 0 = router, 1 = prefill,
  * 2 = decode. */
 int32_t tpunet_c_serve_queue_depth(int32_t tier, uint64_t depth);
+/* ---- Elastic churn observability (docs/DESIGN.md "Elastic churn") -------
+ * Record one rewire-phase duration sample into
+ * tpunet_rewire_duration_us{phase=...}: 0 = detect (last good collective ->
+ * failure classified / join agreed), 1 = quiesce (old comm finalized),
+ * 2 = rendezvous (membership sealed + generation published), 3 = rewire
+ * (new communicator wired at the new shape). `us` is microseconds. */
+int32_t tpunet_c_rewire_observe(int32_t phase, uint64_t us);
+/* Count one membership-churn event into tpunet_churn_events_total{kind=...}:
+ * 0 = kill (scripted death fired), 1 = join (join request honored),
+ * 2 = shrink (world rebuilt smaller), 3 = grow (world rebuilt larger),
+ * 4 = readmit (a recovered decode rank re-entered the serving pool). */
+int32_t tpunet_c_churn_event(int32_t kind);
+/* Set the tpunet_world_size gauge — the live communicator's world as seen
+ * by this rank (the churn suite's "world came back" gate). */
+int32_t tpunet_c_world_size(uint64_t world);
 
 /* ---- Transport QoS introspection (docs/DESIGN.md "Transport QoS") -------
  * Text echo of the process QoS scheduler's parsed config (weights, budgets,

@@ -64,6 +64,15 @@ constexpr int kFaultActionSlots = 5;
 // router admission queue, prefill backlog, decode slots+pending.
 constexpr int kServeTierCount = 3;
 
+// Elastic-churn rewire phases (tpunet_rewire_duration_us{phase=...}):
+// detect, quiesce, rendezvous, rewire — the recovery pipeline's stages
+// (docs/DESIGN.md "Elastic churn").
+constexpr int kRewirePhaseCount = 4;
+
+// Membership-churn event kinds (tpunet_churn_events_total{kind=...}):
+// kill, join, shrink, grow, readmit.
+constexpr int kChurnKindCount = 5;
+
 // QoS traffic-class slots (latency, bulk, control — TrafficClass in qos.h;
 // kept as a bare count here so telemetry.h need not include qos.h).
 constexpr int kQosClassCount = 3;
@@ -156,6 +165,13 @@ struct MetricsSnapshot {
   StageHist req_ttft_us;        // request admission -> first token
   StageHist req_tpot_us;        // mean inter-token gap after the first
   uint64_t serve_queue_depth[kServeTierCount] = {0};
+  // Elastic-churn accounting (docs/DESIGN.md "Elastic churn"): per-phase
+  // rewire duration histograms fed through tpunet_c_rewire_observe by the
+  // elastic layer, membership-churn events by kind, and the live world
+  // size as this rank last saw it (0 until a churn-aware job reports).
+  StageHist rewire_us[kRewirePhaseCount];
+  uint64_t churn_events[kChurnKindCount] = {0};
+  uint64_t world_size = 0;
   // Zero-copy data-path counters (docs/DESIGN.md "Data path"): wire syscalls
   // indexed by utils.h IoOp (send, recv, sendmsg, recvmsg) and bytes
   // produced by the reduction kernels. syscalls/MiB is derived from these in
@@ -254,6 +270,12 @@ class Telemetry {
   // layout); `tier` indexes kServeTierCount (router, prefill, decode).
   void OnServeLatency(int kind, uint64_t us);
   void OnServeQueueDepth(int tier, uint64_t depth);
+  // Elastic-churn hooks (tpunet_c_rewire_observe / tpunet_c_churn_event /
+  // tpunet_c_world_size): `phase` indexes kRewirePhaseCount, `kind` indexes
+  // kChurnKindCount, `world` is the live communicator's world size.
+  void OnRewirePhase(int phase, uint64_t us);
+  void OnChurnEvent(int kind);
+  void OnWorldSize(uint64_t world);
   // Bound port of the on-demand /metrics listener (0 = no listener). With
   // TPUNET_METRICS_PORT=0 the listener binds an EPHEMERAL port and this is
   // the only way to learn it (multi-tier loopback tests scrape both tiers).

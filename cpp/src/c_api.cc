@@ -262,9 +262,12 @@ int32_t tpunet_c_fault_inject(const char* spec) {
     return TPUNET_OK;
   }
   tpunet::FaultSpec f;
-  Status s = tpunet::ParseFaultSpec(spec, &f);
+  bool has_fault = false;
+  std::vector<tpunet::ChurnEvent> churn;
+  Status s = tpunet::ParseFaultScript(spec, &f, &has_fault, &churn);
   if (!s.ok()) return FromStatus(s);
-  tpunet::ArmFault(f);
+  if (has_fault) tpunet::ArmFault(f);
+  if (!churn.empty()) tpunet::ArmChurnScript(churn);
   return TPUNET_OK;
 }
 
@@ -272,6 +275,12 @@ int32_t tpunet_c_fault_clear(void) {
   tpunet::DisarmFault();
   return TPUNET_OK;
 }
+
+int32_t tpunet_c_churn_poll(uint64_t step, int64_t rank) {
+  return static_cast<int32_t>(tpunet::ChurnPoll(step, rank));
+}
+
+int32_t tpunet_c_churn_pending(void) { return tpunet::ChurnPending(); }
 
 uint32_t tpunet_c_crc32c(const void* data, uint64_t nbytes, uint32_t seed) {
   if (data == nullptr && nbytes > 0) return 0;
@@ -590,6 +599,31 @@ int32_t tpunet_c_serve_queue_depth(int32_t tier, uint64_t depth) {
                 "tier must be 0 (router), 1 (prefill) or 2 (decode)");
   }
   tpunet::Telemetry::Get().OnServeQueueDepth(tier, depth);
+  return TPUNET_OK;
+}
+
+int32_t tpunet_c_rewire_observe(int32_t phase, uint64_t us) {
+  if (phase < 0 || phase >= tpunet::kRewirePhaseCount) {
+    return Fail(TPUNET_ERR_INVALID,
+                "phase must be 0 (detect), 1 (quiesce), 2 (rendezvous) or "
+                "3 (rewire)");
+  }
+  tpunet::Telemetry::Get().OnRewirePhase(phase, us);
+  return TPUNET_OK;
+}
+
+int32_t tpunet_c_churn_event(int32_t kind) {
+  if (kind < 0 || kind >= tpunet::kChurnKindCount) {
+    return Fail(TPUNET_ERR_INVALID,
+                "kind must be 0 (kill), 1 (join), 2 (shrink), 3 (grow) or "
+                "4 (readmit)");
+  }
+  tpunet::Telemetry::Get().OnChurnEvent(kind);
+  return TPUNET_OK;
+}
+
+int32_t tpunet_c_world_size(uint64_t world) {
+  tpunet::Telemetry::Get().OnWorldSize(world);
   return TPUNET_OK;
 }
 

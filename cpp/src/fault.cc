@@ -29,6 +29,11 @@ FaultSpec g_spec GUARDED_BY(g_mu);
 std::atomic<uint64_t> g_bytes{0};     // bytes seen on matching (side, stream)
 std::atomic<uint32_t> g_latched{0};   // one-shot claim for close/corrupt
 
+// The armed churn script (docs/DESIGN.md "Elastic churn"). Polled at step
+// boundaries only — never on the IO hot path — so a plain mutex is fine.
+Mutex g_churn_mu;
+std::vector<ChurnEvent> g_churn GUARDED_BY(g_churn_mu);
+
 bool ParseSize(const std::string& v, uint64_t* out) {
   if (v.empty()) return false;
   size_t i = 0;
@@ -117,6 +122,120 @@ Status ParseFaultSpec(const std::string& spec, FaultSpec* out) {
   return Status::Ok();
 }
 
+Status ParseChurnSpec(const std::string& spec, ChurnEvent* out) {
+  ChurnEvent e;
+  bool saw_churn = false, saw_action = false;
+  size_t pos = 0;
+  while (pos <= spec.size()) {
+    size_t end = spec.find(':', pos);
+    if (end == std::string::npos) end = spec.size();
+    std::string item = spec.substr(pos, end - pos);
+    pos = end + 1;
+    if (item.empty()) {
+      if (end == spec.size()) break;
+      return Status::Invalid("churn spec: empty clause in '" + spec + "'");
+    }
+    if (item == "churn") {
+      if (saw_churn) return Status::Invalid("churn spec: duplicate churn token");
+      saw_churn = true;
+      continue;
+    }
+    size_t eq = item.find('=');
+    if (eq == std::string::npos) {
+      return Status::Invalid("churn spec: clause '" + item + "' is not key=value");
+    }
+    std::string key = item.substr(0, eq);
+    std::string val = item.substr(eq + 1);
+    if (key == "at_step") {
+      if (!ParseSize(val, &e.at_step)) {
+        return Status::Invalid("churn spec: bad at_step '" + val + "'");
+      }
+    } else if (key == "rank") {
+      if (val == "*") {
+        e.rank = -1;
+      } else {
+        uint64_t n = 0;
+        if (!ParseSize(val, &n) || n > (1u << 20)) {
+          return Status::Invalid("churn spec: bad rank '" + val + "'");
+        }
+        e.rank = static_cast<int64_t>(n);
+      }
+    } else if (key == "action") {
+      saw_action = true;
+      if (val == "kill") e.action = ChurnAction::kKill;
+      else if (val == "join") e.action = ChurnAction::kJoin;
+      else return Status::Invalid("churn spec: unknown action '" + val +
+                                  "' (want kill or join)");
+    } else {
+      return Status::Invalid("churn spec: unknown key '" + key + "'");
+    }
+  }
+  if (!saw_churn) return Status::Invalid("churn spec: missing churn token");
+  if (!saw_action) return Status::Invalid("churn spec: missing action= clause");
+  *out = e;
+  return Status::Ok();
+}
+
+Status ParseFaultScript(const std::string& spec, FaultSpec* fault,
+                        bool* has_fault, std::vector<ChurnEvent>* churn) {
+  *has_fault = false;
+  churn->clear();
+  size_t pos = 0;
+  while (pos <= spec.size()) {
+    size_t end = spec.find(';', pos);
+    if (end == std::string::npos) end = spec.size();
+    std::string seg = spec.substr(pos, end - pos);
+    bool last = end == spec.size();
+    pos = end + 1;
+    if (seg.empty()) {
+      if (last) break;
+      return Status::Invalid("fault script: empty segment in '" + spec + "'");
+    }
+    if (seg.compare(0, 5, "churn") == 0 &&
+        (seg.size() == 5 || seg[5] == ':')) {
+      ChurnEvent e;
+      Status s = ParseChurnSpec(seg, &e);
+      if (!s.ok()) return s;
+      churn->push_back(e);
+    } else {
+      if (*has_fault) {
+        return Status::Invalid(
+            "fault script: more than one classic fault segment (one fault "
+            "at a time; churn segments may repeat)");
+      }
+      Status s = ParseFaultSpec(seg, fault);
+      if (!s.ok()) return s;
+      *has_fault = true;
+    }
+    if (last) break;
+  }
+  return Status::Ok();
+}
+
+void ArmChurnScript(const std::vector<ChurnEvent>& events) {
+  MutexLock lk(g_churn_mu);
+  g_churn = events;
+  for (ChurnEvent& e : g_churn) e.fired = false;
+}
+
+ChurnAction ChurnPoll(uint64_t step, int64_t rank) {
+  MutexLock lk(g_churn_mu);
+  for (ChurnEvent& e : g_churn) {
+    if (e.fired || e.at_step > step) continue;
+    if (e.rank >= 0 && e.rank != rank) continue;
+    e.fired = true;
+    return e.action;
+  }
+  return ChurnAction::kNone;
+}
+
+int ChurnPending() {
+  MutexLock lk(g_churn_mu);
+  int n = 0;
+  for (const ChurnEvent& e : g_churn) n += e.fired ? 0 : 1;
+  return n;
+}
+
 void ArmFault(const FaultSpec& spec) {
   MutexLock lk(g_mu);
   g_fault_armed.store(0, std::memory_order_release);  // quiesce readers' view
@@ -127,20 +246,35 @@ void ArmFault(const FaultSpec& spec) {
 }
 
 void DisarmFault() {
-  MutexLock lk(g_mu);
-  g_fault_armed.store(0, std::memory_order_release);
+  {
+    MutexLock lk(g_mu);
+    g_fault_armed.store(0, std::memory_order_release);
+  }
+  MutexLock lk(g_churn_mu);
+  g_churn.clear();
 }
 
 void ArmFaultFromEnv() {
   std::string spec = GetEnv("TPUNET_FAULT_SPEC", "");
   if (spec.empty()) return;
   FaultSpec f;
-  Status s = ParseFaultSpec(spec, &f);
+  bool has_fault = false;
+  std::vector<ChurnEvent> churn;
+  Status s = ParseFaultScript(spec, &f, &has_fault, &churn);
   if (!s.ok()) {
     fprintf(stderr, "tpunet: ignoring TPUNET_FAULT_SPEC: %s\n", s.msg.c_str());
     return;
   }
-  ArmFault(f);
+  if (has_fault) ArmFault(f);
+  if (!churn.empty()) {
+    // Once per process: engine creation re-arms classic faults (resetting
+    // their byte counters — the long-standing contract), but a churn
+    // script's fired latches must SURVIVE the rebuilds the script itself
+    // causes — a rewire creates a fresh engine, and re-arming there would
+    // re-fire every kill the job already recovered from.
+    static std::once_flag churn_once;
+    std::call_once(churn_once, [&churn] { ArmChurnScript(churn); });
+  }
 }
 
 FaultAction FaultPreIO(bool is_send, uint64_t stream_idx, int fd, size_t nbytes) {

@@ -33,6 +33,22 @@
 // claims them); stall and delay persist until disarmed. Faults never target
 // the ctrl connection — ctrl loss is a poison-the-comm event by design and
 // needs no injection subtlety beyond `close` on the last data stream.
+//
+// CHURN SCRIPTS (docs/DESIGN.md "Elastic churn"): the grammar also accepts
+// membership-churn events so whole kill/join sequences are deterministic
+// and CI-runnable:
+//
+//   churn:at_step=4:rank=3:action=kill;churn:at_step=8:rank=4:action=join
+//
+// A spec is a ';'-separated list of segments; a segment whose first clause
+// is the bare token `churn` is a churn event (at_step = first step the
+// event fires at, one-shot; rank = the member id it targets, * = any;
+// action = kill | join), anything else is the classic single-fault spec
+// (at most one per script). Churn events are not applied by the engines:
+// the elastic layer polls them at step boundaries (tpunet_c_churn_poll) —
+// a `kill` tells the polling rank to die NOW, a `join` tells the
+// supervisor/joiner side a new rank should enter the world — so the whole
+// churn suite replays bit-identically from one env var.
 #ifndef TPUNET_FAULT_H_
 #define TPUNET_FAULT_H_
 
@@ -40,6 +56,7 @@
 #include <cstddef>
 #include <cstdint>
 #include <string>
+#include <vector>
 
 #include "tpunet/net.h"
 
@@ -62,14 +79,48 @@ struct FaultSpec {
   uint64_t delay_ms = 0;     // kDelay only
 };
 
+// Scripted membership churn (docs/DESIGN.md "Elastic churn"). Actions are
+// advisory verdicts for the elastic layer, never applied by the engines.
+enum class ChurnAction : int32_t {
+  kNone = 0,
+  kKill = 1,  // the polled rank must die at this step (SIGKILL itself)
+  kJoin = 2,  // a new rank enters the world at this step (supervisor-side)
+};
+
+struct ChurnEvent {
+  uint64_t at_step = 0;  // fires at the FIRST poll with step >= at_step
+  int64_t rank = -1;     // member id the event targets (-1 = any)
+  ChurnAction action = ChurnAction::kNone;
+  bool fired = false;    // one-shot latch, set by ChurnPoll
+};
+
 // Parse `spec` into `out`; Invalid status (with the offending token named)
 // on malformed input. Pure — no global state touched.
 Status ParseFaultSpec(const std::string& spec, FaultSpec* out);
+
+// Parse one churn segment ("churn:at_step=N:rank=K:action=kill|join";
+// at_step defaults to 0, rank to *, action is mandatory). Pure.
+Status ParseChurnSpec(const std::string& spec, ChurnEvent* out);
+
+// Parse a whole ';'-separated script: churn segments collect into `churn`,
+// the (at most one) classic segment into `fault`/`has_fault`. Pure.
+Status ParseFaultScript(const std::string& spec, FaultSpec* fault,
+                        bool* has_fault, std::vector<ChurnEvent>* churn);
 
 // Arm/disarm the process-wide fault slot (one fault at a time — chaos tests
 // arm, run, clear). Arming resets the byte counters and one-shot latches.
 void ArmFault(const FaultSpec& spec);
 void DisarmFault();
+// Arm the process-wide churn script (replaces any previous script and its
+// fired latches). DisarmFault()/tpunet_c_fault_clear wipe it too.
+void ArmChurnScript(const std::vector<ChurnEvent>& events);
+// One-shot poll at a step boundary: the first un-fired event with
+// at_step <= step targeting `rank` (or any) fires and returns its action;
+// kNone when nothing fires. ">=" rather than "==" so a rank that resumed
+// past the scripted step (checkpoint restore) still honors the event.
+ChurnAction ChurnPoll(uint64_t step, int64_t rank);
+// Events armed but not yet fired (the smoke lane's completeness gate).
+int ChurnPending();
 // Arm from TPUNET_FAULT_SPEC if set and parseable (called at engine
 // creation); a malformed env spec is reported on stderr and ignored —
 // a typo must not take down training.

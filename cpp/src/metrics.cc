@@ -222,6 +222,12 @@ struct Telemetry::Impl {
   StageHistAtomic req_ttft, req_tpot;
   std::atomic<uint64_t> serve_depth[kServeTierCount] = {};
 
+  // Elastic-churn accounting: per-phase rewire duration histograms, churn
+  // events by kind, and the last-reported live world size (gauge).
+  StageHistAtomic rewire_phase[kRewirePhaseCount];
+  std::atomic<uint64_t> churn_events[kChurnKindCount] = {};
+  std::atomic<uint64_t> world_size{0};
+
   // TCP introspection (always on unless TPUNET_TCPINFO_INTERVAL_MS=0).
   uint64_t tcp_interval_us =
       GetEnvU64("TPUNET_TCPINFO_INTERVAL_MS", 100) * 1000;
@@ -713,6 +719,20 @@ void Telemetry::OnServeQueueDepth(int tier, uint64_t depth) {
   impl_->serve_depth[tier].store(depth, std::memory_order_relaxed);
 }
 
+void Telemetry::OnRewirePhase(int phase, uint64_t us) {
+  if (phase < 0 || phase >= kRewirePhaseCount) return;
+  impl_->rewire_phase[phase].Observe(us);
+}
+
+void Telemetry::OnChurnEvent(int kind) {
+  if (kind < 0 || kind >= kChurnKindCount) return;
+  impl_->churn_events[kind].fetch_add(1, std::memory_order_relaxed);
+}
+
+void Telemetry::OnWorldSize(uint64_t world) {
+  impl_->world_size.store(world, std::memory_order_relaxed);
+}
+
 int Telemetry::MetricsPort() const {
   return impl_->scrape_bound_port.load(std::memory_order_acquire);
 }
@@ -775,6 +795,9 @@ void Telemetry::Reset() {
   im->req_ttft.Reset();
   im->req_tpot.Reset();
   for (auto& d : im->serve_depth) d.store(0, std::memory_order_relaxed);
+  for (auto& h : im->rewire_phase) h.Reset();
+  for (auto& c : im->churn_events) c.store(0, std::memory_order_relaxed);
+  im->world_size.store(0, std::memory_order_relaxed);
   {
     MutexLock lk(im->win_mu);
     im->win_init = false;
@@ -901,6 +924,13 @@ MetricsSnapshot Telemetry::Snapshot() const {
   im->req_total.SnapshotInto(&s.req_total_us);
   im->req_ttft.SnapshotInto(&s.req_ttft_us);
   im->req_tpot.SnapshotInto(&s.req_tpot_us);
+  for (int p = 0; p < kRewirePhaseCount; ++p) {
+    im->rewire_phase[p].SnapshotInto(&s.rewire_us[p]);
+  }
+  for (int k = 0; k < kChurnKindCount; ++k) {
+    s.churn_events[k] = im->churn_events[k].load(std::memory_order_relaxed);
+  }
+  s.world_size = im->world_size.load(std::memory_order_relaxed);
   for (int t = 0; t < kServeTierCount; ++t) {
     s.serve_queue_depth[t] = im->serve_depth[t].load(std::memory_order_relaxed);
   }
@@ -1201,6 +1231,46 @@ std::string Telemetry::PrometheusText() const {
          (long long)rank, kTierNames[t],
          (unsigned long long)s.serve_queue_depth[t]);
   }
+  // Elastic-churn families (docs/DESIGN.md "Elastic churn"). Every phase /
+  // kind series emits even at zero so the churn smoke lane's "non-empty for
+  // EVERY phase" gate never has to special-case a missing series.
+  family("tpunet_rewire_duration_us", "histogram",
+         "Elastic rewire duration per recovery phase (detect, quiesce, "
+         "rendezvous, rewire — microseconds).");
+  static const char* kRewirePhases[kRewirePhaseCount] = {
+      "detect", "quiesce", "rendezvous", "rewire"};
+  for (int p = 0; p < kRewirePhaseCount; ++p) {
+    const StageHist& h = s.rewire_us[p];
+    uint64_t cum = 0;
+    for (int i = 0; i < kStageHistBuckets - 1; ++i) {
+      cum += h.buckets[i];
+      emit("tpunet_rewire_duration_us_bucket{rank=\"%lld\",phase=\"%s\",le=\"%llu\"} %llu\n",
+           (long long)rank, kRewirePhases[p],
+           (unsigned long long)kStageHistBounds[i], (unsigned long long)cum);
+    }
+    cum += h.buckets[kStageHistBuckets - 1];
+    emit("tpunet_rewire_duration_us_bucket{rank=\"%lld\",phase=\"%s\",le=\"+Inf\"} %llu\n",
+         (long long)rank, kRewirePhases[p], (unsigned long long)cum);
+    emit("tpunet_rewire_duration_us_sum{rank=\"%lld\",phase=\"%s\"} %llu\n",
+         (long long)rank, kRewirePhases[p], (unsigned long long)h.sum_us);
+    emit("tpunet_rewire_duration_us_count{rank=\"%lld\",phase=\"%s\"} %llu\n",
+         (long long)rank, kRewirePhases[p], (unsigned long long)h.count);
+  }
+  family("tpunet_churn_events_total", "counter",
+         "Membership-churn events survived, by kind (kill, join, shrink, "
+         "grow, readmit).");
+  static const char* kChurnKinds[kChurnKindCount] = {"kill", "join", "shrink",
+                                                     "grow", "readmit"};
+  for (int k = 0; k < kChurnKindCount; ++k) {
+    emit("tpunet_churn_events_total{rank=\"%lld\",kind=\"%s\"} %llu\n",
+         (long long)rank, kChurnKinds[k],
+         (unsigned long long)s.churn_events[k]);
+  }
+  family("tpunet_world_size", "gauge",
+         "Live communicator world size as this rank last reported it (0 "
+         "until a churn-aware job reports).");
+  emit("tpunet_world_size{rank=\"%lld\"} %llu\n", (long long)rank,
+       (unsigned long long)s.world_size);
   family("tpunet_hold_on_request", "gauge",
          "Requests posted but not yet test()ed done (in flight).");
   emit("tpunet_hold_on_request{rank=\"%lld\"} %llu\n", (long long)rank,

@@ -147,6 +147,35 @@ static void TestFaultSpecParser() {
   CHECK(tpunet_c_fault_clear() == TPUNET_OK);
 }
 
+static void TestChurnScript() {
+  // Churn segments arm the step-polled script (docs/DESIGN.md "Elastic
+  // churn"); a classic fault segment may ride along in the same script.
+  CHECK(tpunet_c_fault_inject(
+            "churn:at_step=4:rank=3:action=kill;"
+            "churn:at_step=8:rank=4:action=join") == TPUNET_OK);
+  CHECK(tpunet_c_churn_pending() == 2);
+  CHECK(tpunet_c_churn_poll(3, 3) == 0);   // before at_step
+  CHECK(tpunet_c_churn_poll(4, 2) == 0);   // wrong member
+  CHECK(tpunet_c_churn_poll(5, 3) == 1);   // kill fires at step >= at_step
+  CHECK(tpunet_c_churn_poll(5, 3) == 0);   // one-shot latch
+  CHECK(tpunet_c_churn_pending() == 1);
+  CHECK(tpunet_c_churn_poll(9, 4) == 2);   // join
+  CHECK(tpunet_c_churn_pending() == 0);
+  CHECK(tpunet_c_fault_inject("stream=1:action=close;churn:rank=*:action=kill")
+        == TPUNET_OK);
+  CHECK(tpunet_c_churn_pending() == 1);
+  CHECK(tpunet_c_churn_poll(0, 17) == 1);  // rank=* matches anyone
+  // Malformed churn segments (and double classic faults) are typed.
+  CHECK(tpunet_c_fault_inject("churn:action=nuke") == TPUNET_ERR_INVALID);
+  CHECK(tpunet_c_fault_inject("churn:at_step=1") == TPUNET_ERR_INVALID);
+  CHECK(tpunet_c_fault_inject("churn:bad=1:action=kill") == TPUNET_ERR_INVALID);
+  CHECK(tpunet_c_fault_inject("action=close;action=close") == TPUNET_ERR_INVALID);
+  // Clearing wipes the script with the fault slot.
+  CHECK(tpunet_c_fault_inject("churn:action=join") == TPUNET_OK);
+  CHECK(tpunet_c_fault_clear() == TPUNET_OK);
+  CHECK(tpunet_c_churn_pending() == 0);
+}
+
 // Wire a fresh BASIC<->BASIC loopback pair; returns comm ids through refs.
 static void WireLoopback(Net* snet, Net* rnet, uint64_t* send_id, uint64_t* recv_id,
                          uint64_t* listen_id) {
@@ -464,6 +493,7 @@ int main() {
   TestInterfaces();
   TestCrc32c();
   TestFaultSpecParser();
+  TestChurnScript();
   TestQosParsing();
   TestQosDrrGolden();
   TestQosSchedulerConcurrent();

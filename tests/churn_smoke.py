@@ -1,0 +1,265 @@
+"""CI churn-smoke lane: scripted kill -> shrink -> join -> grow at W=4
+(2x2 fake hosts, SHM on) — docs/DESIGN.md "Elastic churn".
+
+The whole sequence is one chaos-grammar script (the default below, or the
+env's TPUNET_FAULT_SPEC with ``--no-default-script``): member 3 SIGKILLs
+itself at step 3, the survivors rewire to W=3 mid-run (training keeps
+going from the checkpoint), member 4 requests entry once the job
+checkpoints step 6, and the world grows back to W=4 without restarting
+the job. Gates, by counters (the PR 3/5 epistemic stance):
+
+  * ZERO CRC mismatches: every rank runs the CRC32C cross-rank parameter
+    check after EVERY rewire (a WorldCorruptionError fails the lane).
+  * tpunet_rewire_duration_us non-empty for EVERY phase (detect, quiesce,
+    rendezvous, rewire) on every rewired rank, and no phase's total
+    exceeding TPUNET_REWIRE_TIMEOUT_MS.
+  * Final world size back at 4 — the live comm AND the tpunet_world_size
+    gauge on every rank.
+  * The scripted kill actually fired (victim exit code == -SIGKILL) and
+    every member's final params are bitwise identical.
+
+Run: python tests/churn_smoke.py   (exit 0 = pass)
+"""
+
+import os
+import signal
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+WORLD = 4
+STEPS = 14
+NPARAMS = 256
+DEFAULT_SPEC = ("churn:at_step=3:rank=3:action=kill;"
+                "churn:at_step=6:rank=4:action=join")
+REWIRE_TIMEOUT_MS = 120_000
+
+
+def _host_of(member_id: int) -> int:
+    # 2x2 fake hosts: members 0,1 on host 0; 2,3 on host 1. The joiner
+    # (member 4) replaces the dead host-1 capacity so the grown world is a
+    # uniform 2x2 split again.
+    return 0 if member_id < 2 else 1
+
+
+def _latest_step(ckpt: Path) -> int:
+    steps = [int(p.stem.split("_")[1]) for p in ckpt.glob("step_*.npy")]
+    return max(steps, default=-1)
+
+
+def _rank(member_id: int, world: int, port: int, q, dirpath: str, spec: str,
+          joiner: bool) -> None:
+    try:
+        os.environ.update({
+            "TPUNET_FAULT_SPEC": spec,
+            "TPUNET_SHM": "1",
+            "TPUNET_HOST_ID": f"churnhost{_host_of(member_id)}",
+            "TPUNET_NSTREAMS": "1",
+            "TPUNET_ASYNC_CHANNELS": "1",
+            "TPUNET_BOOTSTRAP_TIMEOUT_MS": "30000",
+            "TPUNET_CONNECT_RETRY_MS": "2000",
+            # RST-independent peer-death bounds (keepalive + watchdog).
+            "TPUNET_PROGRESS_TIMEOUT_MS": "10000",
+            "TPUNET_KEEPALIVE_IDLE_S": "3",
+            "TPUNET_KEEPALIVE_INTVL_S": "2",
+            "TPUNET_KEEPALIVE_CNT": "2",
+        })
+        import numpy as np
+
+        from tpunet import _native, elastic, telemetry
+
+        ckpt = Path(dirpath)
+
+        def grad(step, rank):
+            rng = np.random.default_rng(11 * step + rank)
+            return rng.standard_normal(NPARAMS).astype(np.float32)
+
+        if joiner:
+            _native.load().tpunet_c_fault_inject(spec.encode())
+            while True:
+                latest = _latest_step(ckpt)
+                if latest >= 0 and \
+                        elastic.churn_action(latest, member_id) == "join":
+                    break
+                time.sleep(0.1)
+
+        def train_once(world_obj, comm):
+            while True:
+                latest = _latest_step(ckpt)
+                if latest >= 0:
+                    params = np.load(ckpt / f"step_{latest}.npy")
+                    start = latest + 1
+                else:
+                    params = np.zeros(NPARAMS, np.float32)
+                    start = 0
+                if world_obj.stats["rewires"]:
+                    world_obj.crc_check(params)  # the zero-corruption gate
+                restart = False
+                for step in range(start, STEPS):
+                    if world_obj.churn_action(step) == "kill":
+                        os.kill(os.getpid(), signal.SIGKILL)
+                    new = world_obj.maybe_rewire(step)
+                    if new is not None:
+                        comm = new
+                        restart = True
+                        break
+                    g = comm.all_reduce(grad(step, comm.rank)) / comm.world_size
+                    params = params - 0.1 * g
+                    if comm.rank == 0:
+                        tmp = ckpt / f".step_{step}.tmp.npy"
+                        np.save(tmp, params)
+                        os.replace(tmp, ckpt / f"step_{step}.npy")
+                    comm.barrier()
+                    world_obj.step_ok()
+                    if comm.world_size < WORLD:
+                        time.sleep(0.25)  # keep the join window real
+                if not restart:
+                    return params, comm.world_size, dict(world_obj.stats)
+
+        params, final_world, stats = elastic.run(
+            train_once, coordinator=f"127.0.0.1:{port}",
+            member_id=member_id, world_size=world, directory=dirpath,
+            joiner=joiner, grace_ms=4000,
+            rewire_timeout_ms=REWIRE_TIMEOUT_MS)
+        m = telemetry.metrics()
+        phases = {telemetry.labels(k)["phase"]: int(v)
+                  for k, v in m["tpunet_rewire_duration_us_count"].items()}
+        sums = {telemetry.labels(k)["phase"]: float(v)
+                for k, v in m["tpunet_rewire_duration_us_sum"].items()}
+        kinds = {telemetry.labels(k)["kind"]: int(v)
+                 for k, v in m["tpunet_churn_events_total"].items()}
+        gauge = int(next(iter(m["tpunet_world_size"].values())))
+        shm_tx = sum(int(v) for k, v in
+                     m.get("tpunet_shm_bytes_total", {}).items()
+                     if telemetry.labels(k)["dir"] == "tx")
+        q.put((member_id, ("OK", {
+            "params": params.tolist(), "world": final_world, "gauge": gauge,
+            "phases": phases, "sums": sums, "kinds": kinds, "stats": stats,
+            "shm_tx": shm_tx,
+        })))
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        q.put((member_id, (f"ERR {type(e).__name__}: {e}",
+                           traceback.format_exc()[-800:])))
+
+
+def main() -> None:
+    import multiprocessing as mp
+    import queue as queue_mod
+    import tempfile
+
+    import numpy as np
+
+    from benchmarks import free_port
+    from tpunet import elastic
+
+    spec = DEFAULT_SPEC
+    if "--no-default-script" in sys.argv:
+        spec = os.environ.get("TPUNET_FAULT_SPEC", "")
+        if not spec:
+            raise SystemExit("--no-default-script needs TPUNET_FAULT_SPEC set")
+    events = elastic.parse_churn_script(spec)
+    kills = [e["rank"] for e in events if e["action"] == "kill"]
+    joins = [e["rank"] for e in events if e["action"] == "join"]
+    if not kills or not joins:
+        raise SystemExit(f"churn script needs >= 1 kill and >= 1 join: {spec}")
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = free_port()
+    d = tempfile.mkdtemp(prefix="churn_smoke_")
+    t0 = time.time()
+    procs: dict = {}
+    vqs: dict = {}
+    for mid in range(WORLD):
+        # Victims get a dedicated queue (mp.Queue SIGKILL write-lock hazard).
+        qq = ctx.Queue() if mid in kills else q
+        if mid in kills:
+            vqs[mid] = qq
+        procs[mid] = ctx.Process(target=_rank,
+                                 args=(mid, WORLD, port, qq, d, spec, False))
+    for mid in joins:
+        procs[mid] = ctx.Process(target=_rank,
+                                 args=(mid, WORLD, port, q, d, spec, True))
+    for p in procs.values():
+        p.start()
+
+    expected = (set(range(WORLD)) | set(joins)) - set(kills)
+    results: dict = {}
+    deadline = time.time() + 240
+    while len(results) < len(expected) and time.time() < deadline:
+        try:
+            mid, payload = q.get(timeout=1.0)
+            results[mid] = payload
+        except queue_mod.Empty:
+            pass
+    for p in procs.values():
+        p.join(timeout=30)
+        if p.is_alive():
+            p.kill()
+            p.join()
+
+    failures: list = []
+    for mid in kills:
+        if procs[mid].exitcode != -signal.SIGKILL:
+            failures.append(f"scripted kill of member {mid} never fired "
+                            f"(exit {procs[mid].exitcode})")
+    for mid, payload in sorted(results.items()):
+        if payload[0] != "OK":
+            failures.append(f"member {mid}: {payload[0]}\n{payload[1]}")
+    missing = sorted(expected - results.keys())
+    if missing:
+        failures.append(f"members never reported: {missing}")
+
+    if not failures:
+        ref = np.asarray(results[min(expected)][1]["params"], np.float32)
+        for mid in sorted(expected):
+            r = results[mid][1]
+            if not np.array_equal(
+                    ref, np.asarray(r["params"], np.float32)):
+                failures.append(f"member {mid}: params diverged across churn")
+            if r["world"] != WORLD or r["gauge"] != WORLD:
+                failures.append(
+                    f"member {mid}: world {r['world']} / gauge {r['gauge']} "
+                    f"!= {WORLD} — the world never came back")
+            empty = [ph for ph in ("detect", "quiesce", "rendezvous", "rewire")
+                     if r["phases"].get(ph, 0) < 1]
+            if empty:
+                failures.append(
+                    f"member {mid}: empty tpunet_rewire_duration_us phases "
+                    f"{empty} ({r['phases']})")
+            over = {ph: v for ph, v in r["sums"].items()
+                    if v >= REWIRE_TIMEOUT_MS * 1e3}
+            if over:
+                failures.append(
+                    f"member {mid}: rewire phases exceeded "
+                    f"TPUNET_REWIRE_TIMEOUT_MS: {over}")
+            if r["stats"]["crc_checks"] < r["stats"]["rewires"]:
+                failures.append(
+                    f"member {mid}: {r['stats']['rewires']} rewires but only "
+                    f"{r['stats']['crc_checks']} CRC checks — the "
+                    f"zero-corruption gate did not run after every rewire")
+            if r["shm_tx"] <= 0:
+                failures.append(
+                    f"member {mid}: SHM moved no bytes — the lane did not "
+                    f"exercise churn over the SHM transport")
+
+    dt = time.time() - t0
+    if failures:
+        print(f"churn_smoke FAILURES ({dt:.1f}s):")
+        for f in failures:
+            print(f"  - {f}")
+        sys.exit(1)
+    r0 = results[min(expected)][1]
+    print(f"churn_smoke: OK in {dt:.1f}s — kill->shrink->join->grow at "
+          f"W={WORLD} (2x2 fake hosts, SHM on): world back at {WORLD}, "
+          f"{r0['stats']['rewires']} rewires/rank with all 4 phases timed, "
+          f"{r0['stats']['crc_checks']} CRC cross-rank checks, 0 mismatches; "
+          f"events {r0['kinds']}")
+
+
+if __name__ == "__main__":
+    main()

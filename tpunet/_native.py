@@ -28,6 +28,7 @@ TPUNET_ERR_TIMEOUT = -5   # progress watchdog (TPUNET_PROGRESS_TIMEOUT_MS)
 TPUNET_ERR_VERSION = -6   # wire-framing version mismatch with the peer
 TPUNET_ERR_CODEC = -7     # ranks disagree on the collective wire codec
 TPUNET_ERR_QOS_ADMISSION = -8  # QoS class in-flight budget full (retryable)
+TPUNET_ERR_REWIRE = -9    # elastic rewire exceeded TPUNET_REWIRE_TIMEOUT_MS
 
 HANDLE_SIZE = 64
 
@@ -220,6 +221,16 @@ def load() -> ctypes.CDLL:
     lib.tpunet_c_fault_inject.restype = i32
     lib.tpunet_c_fault_clear.argtypes = []
     lib.tpunet_c_fault_clear.restype = i32
+    lib.tpunet_c_churn_poll.argtypes = [u64, ctypes.c_int64]
+    lib.tpunet_c_churn_poll.restype = i32
+    lib.tpunet_c_churn_pending.argtypes = []
+    lib.tpunet_c_churn_pending.restype = i32
+    lib.tpunet_c_rewire_observe.argtypes = [i32, u64]
+    lib.tpunet_c_rewire_observe.restype = i32
+    lib.tpunet_c_churn_event.argtypes = [i32]
+    lib.tpunet_c_churn_event.restype = i32
+    lib.tpunet_c_world_size.argtypes = [u64]
+    lib.tpunet_c_world_size.restype = i32
     lib.tpunet_c_crc32c.argtypes = [ctypes.c_void_p, u64, ctypes.c_uint32]
     lib.tpunet_c_crc32c.restype = ctypes.c_uint32
     lib.tpunet_c_host_id.argtypes = []
@@ -286,12 +297,23 @@ class QosAdmissionError(NativeError):
     front-of-queue). docs/DESIGN.md "Transport QoS"."""
 
 
+class RewireTimeoutError(NativeError):
+    """An elastic membership rewire (tpunet.elastic.ElasticWorld) failed to
+    complete inside TPUNET_REWIRE_TIMEOUT_MS — the bounded-recovery contract
+    of the churn engine. The old communicator was already finalized when
+    this raises, so the process holds no live comm; callers either retry
+    the rewire (the membership doc may still be filling) or exit. Never a
+    hang: every phase under the deadline is itself bounded (bootstrap
+    timeout, membership grace window). docs/DESIGN.md "Elastic churn"."""
+
+
 _TYPED_ERRORS = {
     TPUNET_ERR_CORRUPT: CorruptionError,
     TPUNET_ERR_TIMEOUT: ProgressTimeoutError,
     TPUNET_ERR_VERSION: VersionMismatchError,
     TPUNET_ERR_CODEC: CodecMismatchError,
     TPUNET_ERR_QOS_ADMISSION: QosAdmissionError,
+    TPUNET_ERR_REWIRE: RewireTimeoutError,
 }
 
 

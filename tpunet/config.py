@@ -409,6 +409,20 @@ class Config:
     # unlimited). wire= sets the shared WIRE WINDOW that arms the DRR chunk
     # scheduler (0 = gate off, the default — dispatch is then unchanged).
     qos_inflight_bytes: str = ""
+    # ---- Elastic churn (docs/DESIGN.md "Elastic churn") ------------------
+    # Membership grace window for churn rendezvous (ms): how long the
+    # sealing leader waits for survivors/joiners to deposit member files
+    # before sealing the new world. Short = fast recovery but a slow rank
+    # may be excluded; long = inclusive but recovery pays the window.
+    churn_grace_ms: int = 10_000
+    # Whole-rewire deadline (ms): a mid-run membership rewire (quiesce +
+    # rendezvous + re-wiring at the new shape) exceeding it raises the
+    # typed RewireTimeoutError (-9) — bounded recovery, never a hang.
+    rewire_timeout_ms: int = 120_000
+    # Serving-tier re-admission probe cadence (ms): how often the router
+    # polls its wiring port for recovered decode hosts once
+    # enable_readmission() armed it.
+    readmit_probe_ms: int = 500
     # ---- MoE / pipeline workloads (docs/DESIGN.md "Workloads") -----------
     # Default Zipf skew exponent for the MoE workload's expert routing
     # (tpunet.workloads.moe): 0 = uniform expert popularity, larger = more
@@ -574,5 +588,19 @@ class Config:
             ),
             moe_skew=_env_float_checked(
                 "TPUNET_MOE_SKEW", 1.0, 0.0, "MoE Zipf skew exponent",
+            ),
+            # Churn deadlines/cadences: 0 would seal empty memberships,
+            # expire every rewire instantly, or spin the readmission probe
+            # — loud config errors, not silent wedges (the PR-1 stance).
+            churn_grace_ms=_env_int_checked(
+                ("TPUNET_CHURN_GRACE_MS",), 10_000, 1,
+                "churn membership grace window",
+            ),
+            rewire_timeout_ms=_env_int_checked(
+                ("TPUNET_REWIRE_TIMEOUT_MS",), 120_000, 1, "rewire deadline"
+            ),
+            readmit_probe_ms=_env_int_checked(
+                ("TPUNET_READMIT_PROBE_MS",), 500, 1,
+                "re-admission probe interval",
             ),
         )

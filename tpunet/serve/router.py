@@ -23,6 +23,18 @@ so a mid-request rank death can delay a response but never corrupt or
 truncate it; with greedy sampling the replayed stream is bitwise the one
 the dead rank would have produced.
 
+**Re-admission.** Death is no longer permanent: with
+``enable_readmission(listen_sock)`` the router keeps probing its wiring
+port (every ``TPUNET_READMIT_PROBE_MS``) for recovered decode hosts. A
+rejoining rank runs the FULL hello re-handshake — a config-signature or
+codec drift on rejoin fails typed (``TierMismatchError`` /
+``KVCodecMismatchError``) instead of silently re-admitting a host that
+would serve a different model — and on success re-enters the placement
+pool as a fresh rank (``tpunet_churn_events_total{kind="readmit"}``),
+immediately eligible for dispatch. Replay-from-retained-KV composes
+unchanged: a stream stranded by the death completes on survivors (or on
+the readmitted rank itself) with zero truncation either way.
+
 **SLO observability.** TTFT is stamped when a rank's FIRST frame arrives
 (admission -> first token, the client-perceived number) into
 ``tpunet_req_ttft_us``; the decode-measured TPOT rides each RESULT frame
@@ -91,9 +103,15 @@ class Router:
         self._recs: dict[int, dict] = {}
         self._results: dict[int, np.ndarray] = {}
         self._next_id = 0
+        # Re-admission probing (docs/DESIGN.md "Elastic churn"): armed by
+        # enable_readmission(); run() polls the wiring port at this cadence.
+        self._listen_sock: socket.socket | None = None
+        self._probe_interval = max(1, cfg.readmit_probe_ms) / 1e3
+        self._last_probe = 0.0
         self.stats = {"submitted": 0, "completed": 0, "rank_failures": 0,
                       "replays_kv": 0, "replays_prefill": 0, "rejected": 0,
-                      "qos_backpressure": 0}
+                      "qos_backpressure": 0, "readmissions": 0,
+                      "readmit_rejected": 0}
 
     # -- wiring ------------------------------------------------------------
 
@@ -128,6 +146,59 @@ class Router:
             finally:
                 conn.close()
             self._ranks.append(_Rank(link, len(self._ranks)))
+
+    # -- re-admission ------------------------------------------------------
+
+    def enable_readmission(self, listen_sock: socket.socket) -> None:
+        """Keep the wiring port open for recovered decode hosts: run()
+        (and explicit poll_admissions() calls) will accept reconnects,
+        re-run the hello handshake, and re-enter survivors of a rank
+        failure into the placement pool. The socket stays caller-owned."""
+        listen_sock.setblocking(False)
+        self._listen_sock = listen_sock
+
+    def poll_admissions(self, raise_on_mismatch: bool = True) -> int:
+        """Non-blocking accept pass over the wiring port (the router-side
+        health probe: a recovered host proves liveness by reconnecting).
+        Each pending connection runs the FULL hello re-handshake; a
+        config-signature/codec drift is a typed TierMismatchError —
+        re-raised when `raise_on_mismatch` (the unit-test/operator surface),
+        else counted in stats["readmit_rejected"] and contained (the
+        serving loop must not die because a stale host knocked). Returns
+        the number of ranks re-admitted."""
+        if self._listen_sock is None:
+            return 0
+        admitted = 0
+        while True:
+            try:
+                conn, _ = self._listen_sock.accept()
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                break  # listener closed under us — probing just stops
+            try:
+                conn.setblocking(True)
+                link = proto.wire_frontend(
+                    conn, self._net, self._hello(),
+                    name=f"decode-{len(self._ranks)}")
+            except proto.TierMismatchError:
+                self.stats["readmit_rejected"] += 1
+                if raise_on_mismatch:
+                    raise
+                continue
+            except (proto.ServeError, _native.NativeError, OSError):
+                # Half-open reconnect (the host died again mid-handshake):
+                # not a pool event, just drop the carcass.
+                continue
+            finally:
+                conn.close()
+            self._ranks.append(_Rank(link, len(self._ranks)))
+            self.stats["readmissions"] += 1
+            telemetry.churn_event("readmit")
+            admitted += 1
+        if admitted:
+            self._pump()  # queued work flows onto the recovered capacity
+        return admitted
 
     # -- admission ---------------------------------------------------------
 
@@ -190,6 +261,8 @@ class Router:
             rank = self._pick_rank()
             if rank is None:
                 if not any(r.alive for r in self._ranks):
+                    if self._listen_sock is not None:
+                        break  # re-admission armed: wait for a rejoin
                     raise proto.NoLiveDecodeRankError(
                         "every decode rank has failed; "
                         f"{len(self._queue)} request(s) cannot be placed")
@@ -299,6 +372,14 @@ class Router:
         request admitted since the last run() and clears the slate."""
         deadline = time.monotonic() + timeout
         while self.outstanding() > 0:
+            now = time.monotonic()
+            if (self._listen_sock is not None
+                    and now - self._last_probe >= self._probe_interval):
+                self._last_probe = now
+                # Contain drift rejections here: the serving loop keeps
+                # draining; poll_admissions() raises only when called
+                # directly (the operator/unit-test surface).
+                self.poll_admissions(raise_on_mismatch=False)
             self.poll()
             if self.outstanding() == 0:
                 break

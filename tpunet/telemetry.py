@@ -23,6 +23,9 @@ Chrome-trace spans for every request plus collective phase spans tagged
                          the only way to learn an ephemeral-port bind
   serve_observe()     -> record one serving-tier TTFT/TPOT latency sample
   serve_queue_depth() -> set a serving tier's queue-depth gauge
+  rewire_observe()    -> record one elastic rewire-phase duration sample
+  churn_event()       -> count one membership-churn event by kind
+  world_size()        -> set the live world-size gauge
 
 Env flags (rank-gated 0-7 like the reference, nthread:108-130):
   TPUNET_TRACE_DIR            directory for Chrome-trace JSON (Perfetto)
@@ -165,6 +168,45 @@ def serve_queue_depth(tier: str, depth: int) -> None:
         lib.tpunet_c_serve_queue_depth(_SERVE_TIERS[tier], max(0, int(depth))),
         "serve_queue_depth",
     )
+
+
+_REWIRE_PHASES = {"detect": 0, "quiesce": 1, "rendezvous": 2, "rewire": 3}
+_CHURN_KINDS = {"kill": 0, "join": 1, "shrink": 2, "grow": 3, "readmit": 4}
+
+
+def rewire_observe(phase: str, us: int) -> None:
+    """Record one elastic rewire-phase duration sample (microseconds) into
+    ``tpunet_rewire_duration_us{phase=...}`` — the bounded-recovery
+    histograms the churn suite gates on (docs/DESIGN.md "Elastic churn").
+    Phases: "detect" (last good collective -> failure classified / join
+    agreed), "quiesce" (old comm finalized), "rendezvous" (membership
+    sealed), "rewire" (new communicator wired)."""
+    if phase not in _REWIRE_PHASES:
+        raise ValueError(
+            f"phase must be one of {sorted(_REWIRE_PHASES)}, got {phase!r}")
+    lib = _native.load()
+    _native.check(
+        lib.tpunet_c_rewire_observe(_REWIRE_PHASES[phase], max(0, int(us))),
+        "rewire_observe",
+    )
+
+
+def churn_event(kind: str) -> None:
+    """Count one membership-churn event into
+    ``tpunet_churn_events_total{kind=...}`` ("kill", "join", "shrink",
+    "grow" or "readmit")."""
+    if kind not in _CHURN_KINDS:
+        raise ValueError(
+            f"kind must be one of {sorted(_CHURN_KINDS)}, got {kind!r}")
+    lib = _native.load()
+    _native.check(lib.tpunet_c_churn_event(_CHURN_KINDS[kind]), "churn_event")
+
+
+def world_size(world: int) -> None:
+    """Set the ``tpunet_world_size`` gauge — the live communicator's world
+    as this rank last saw it (the churn suite's "world came back" gate)."""
+    lib = _native.load()
+    _native.check(lib.tpunet_c_world_size(max(0, int(world))), "world_size")
 
 
 def flush_trace() -> None:

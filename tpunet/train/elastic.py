@@ -102,20 +102,23 @@ class ExcludedFromMembership(RuntimeError):
     membership doc was sealed) and is no longer part of the job."""
 
 
-def _shrink_rendezvous(directory: Path, generation: int, member_id: int,
-                       advertise_host: str, base_port: int,
-                       grace_s: float) -> tuple[str, int, int]:
-    """Agree on the surviving membership for `generation` and return
-    (coordinator, new_rank, new_world).
+def membership_rendezvous(directory: Path, generation: int, member_id: int,
+                          advertise_host: str, base_port: int,
+                          grace_s: float) -> tuple[str, int, int, list[int]]:
+    """Agree on `generation`'s membership and return
+    (coordinator, new_rank, new_world, members).
 
-    Every survivor writes a member file naming its advertise host, then the
-    LEADER — lowest member id present after the grace window — seals
-    ``MEMBERS.json`` exactly once (O_EXCL: a late lower id that lost the
-    race adopts the sealed doc rather than rewriting membership under
-    peers already rendezvousing). Member ids are the caller's stable ids,
-    not per-generation ranks; new ranks are the sealed members' sort order.
-    Survivors absent from the sealed doc raise ExcludedFromMembership —
-    the grace window IS the membership contract.
+    Every participant — survivor OR joiner; the protocol cannot tell them
+    apart, which is exactly what makes the same window serve both shrink
+    and grow (tpunet.elastic.ElasticWorld) — writes a member file naming
+    its advertise host, then the LEADER — lowest member id present after
+    the grace window — seals ``MEMBERS.json`` exactly once (O_EXCL: a late
+    lower id that lost the race adopts the sealed doc rather than
+    rewriting membership under peers already rendezvousing). Member ids
+    are the caller's stable ids, not per-generation ranks; new ranks are
+    the sealed members' sort order. Participants absent from the sealed
+    doc raise ExcludedFromMembership — the grace window IS the membership
+    contract.
     """
     gdir = directory / f"g{generation}"
     gdir.mkdir(parents=True, exist_ok=True)
@@ -169,7 +172,17 @@ def _shrink_rendezvous(directory: Path, generation: int, member_id: int,
         )
     new_rank = members.index(member_id)
     coordinator = f"{doc['hosts'][str(members[0])]}:{base_port + generation}"
-    return coordinator, new_rank, len(members)
+    return coordinator, new_rank, len(members), members
+
+
+def _shrink_rendezvous(directory: Path, generation: int, member_id: int,
+                       advertise_host: str, base_port: int,
+                       grace_s: float) -> tuple[str, int, int]:
+    """run_elastic's 3-tuple view of membership_rendezvous (shrink policy
+    never needs the member list)."""
+    coordinator, new_rank, new_world, _ = membership_rendezvous(
+        directory, generation, member_id, advertise_host, base_port, grace_s)
+    return coordinator, new_rank, new_world
 
 
 def run_elastic(
