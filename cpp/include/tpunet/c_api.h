@@ -54,6 +54,12 @@ extern "C" {
  * aborted recovery. The old communicator is already finalized; the caller
  * owns the retry-or-die decision — never a hang. */
 #define TPUNET_ERR_REWIRE -9
+/* Live weight-swap failure (docs/DESIGN.md "Live weight updates"): a
+ * version publication aborted — publisher/receiver death mid-broadcast,
+ * cross-rank CRC32C digest disagreement (flip refused fleet-wide), or the
+ * swap exceeding TPUNET_SWAP_TIMEOUT_MS. The PREVIOUS version keeps
+ * serving; the partial staged version was discarded. Retryable. */
+#define TPUNET_ERR_WEIGHT_SWAP -10
 
 /* 64-byte opaque rendezvous blob: the serialized listen sockaddr, sized to
  * NCCL's handle budget (reference: cc/nccl_types.h:44). Ship it to the
@@ -127,7 +133,10 @@ const char* tpunet_c_last_error(void);
  * ("churn:at_step=N:rank=K:action=kill|join") arm the process-wide churn
  * script (docs/DESIGN.md "Elastic churn") — deterministic scripted
  * membership churn, polled at step boundaries rather than applied on the
- * IO path. At most one classic fault segment may ride along. */
+ * IO path. Swap segments ("swap:at_step=N:action=publish|corrupt|die")
+ * likewise arm the process-wide weight-swap chaos script (docs/DESIGN.md
+ * "Live weight updates"). At most one classic fault segment may ride
+ * along. */
 int32_t tpunet_c_fault_inject(const char* spec);
 int32_t tpunet_c_fault_clear(void);
 /* One-shot churn-script poll at a step boundary: fires (and consumes) the
@@ -140,6 +149,19 @@ int32_t tpunet_c_churn_poll(uint64_t step, int64_t rank);
 /* Armed churn events not yet fired (the churn smoke lane's completeness
  * gate: a finished scripted run must report 0). */
 int32_t tpunet_c_churn_pending(void);
+/* One-shot swap-script poll at a step boundary (weight hot-swap chaos,
+ * "swap:at_step=N:action=publish|corrupt|die" segments of the fault
+ * script): fires (and consumes) the first armed event with at_step <= step
+ * and returns its action — 0 none, 1 publish (the publisher must start a
+ * weight publication NOW), 2 corrupt (the polling receiver must corrupt
+ * its received weight bytes before digesting — the flip-refusal drill),
+ * 3 die (the polling rank must die NOW, mid-broadcast when timed so).
+ * Unlike churn there is no rank clause: each process arms its own script
+ * via TPUNET_FAULT_SPEC. Fired latches survive swap retries. */
+int32_t tpunet_c_swap_poll(uint64_t step);
+/* Armed swap events not yet fired (the swap smoke lane's completeness
+ * gate: a finished scripted run must report 0). */
+int32_t tpunet_c_swap_pending(void);
 /* CRC32C (Castagnoli) of `data`, seeded with `seed` (0 = fresh; chain for
  * discontiguous buffers). Exposed for golden-vector tests and so Python
  * tooling can pre-verify payloads against the wire trailers. */
@@ -337,6 +359,22 @@ int32_t tpunet_c_churn_event(int32_t kind);
 /* Set the tpunet_world_size gauge — the live communicator's world as seen
  * by this rank (the churn suite's "world came back" gate). */
 int32_t tpunet_c_world_size(uint64_t world);
+/* ---- Live weight updates (docs/DESIGN.md "Live weight updates") ---------
+ * Record one weight-swap phase duration sample into
+ * tpunet_weight_swap_duration_us{phase=...}: 0 = announce (SWAP_BEGIN
+ * frames out / receiver armed), 1 = broadcast (chunked bf16 tree broadcast
+ * on the bulk class), 2 = verify (cross-rank CRC32C digest agreement),
+ * 3 = flip (new BatchServer built, version live). `us` is microseconds. */
+int32_t tpunet_c_swap_observe(int32_t phase, uint64_t us);
+/* Count one weight-swap event into tpunet_swap_events_total{kind=...}:
+ * 0 = publish (a publication attempt started), 1 = commit (every rank
+ * agreed and flipped), 2 = abort (staged version discarded — death or
+ * timeout), 3 = retry (a failed publication re-attempted), 4 = mismatch
+ * (CRC digest disagreement refused the flip fleet-wide). */
+int32_t tpunet_c_swap_event(int32_t kind);
+/* Set the tpunet_weight_version gauge — the checkpoint version this rank
+ * is serving (the swap smoke lane's "v2 reached every rank" gate). */
+int32_t tpunet_c_weight_version(uint64_t version);
 
 /* ---- Transport QoS introspection (docs/DESIGN.md "Transport QoS") -------
  * Text echo of the process QoS scheduler's parsed config (weights, budgets,

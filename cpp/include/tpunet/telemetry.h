@@ -73,6 +73,15 @@ constexpr int kRewirePhaseCount = 4;
 // kill, join, shrink, grow, readmit.
 constexpr int kChurnKindCount = 5;
 
+// Live weight-swap phases (tpunet_weight_swap_duration_us{phase=...}):
+// announce, broadcast, verify, flip — the publication pipeline's stages
+// (docs/DESIGN.md "Live weight updates").
+constexpr int kSwapPhaseCount = 4;
+
+// Weight-swap event kinds (tpunet_swap_events_total{kind=...}):
+// publish, commit, abort, retry, mismatch.
+constexpr int kSwapKindCount = 5;
+
 // QoS traffic-class slots (latency, bulk, control — TrafficClass in qos.h;
 // kept as a bare count here so telemetry.h need not include qos.h).
 constexpr int kQosClassCount = 3;
@@ -172,6 +181,13 @@ struct MetricsSnapshot {
   StageHist rewire_us[kRewirePhaseCount];
   uint64_t churn_events[kChurnKindCount] = {0};
   uint64_t world_size = 0;
+  // Live weight-update accounting (docs/DESIGN.md "Live weight updates"):
+  // per-phase swap duration histograms fed through tpunet_c_swap_observe
+  // by the publication layer, swap events by kind, and the checkpoint
+  // version this rank serves (0 until a versioned tier reports).
+  StageHist swap_us[kSwapPhaseCount];
+  uint64_t swap_events[kSwapKindCount] = {0};
+  uint64_t weight_version = 0;
   // Zero-copy data-path counters (docs/DESIGN.md "Data path"): wire syscalls
   // indexed by utils.h IoOp (send, recv, sendmsg, recvmsg) and bytes
   // produced by the reduction kernels. syscalls/MiB is derived from these in
@@ -276,6 +292,12 @@ class Telemetry {
   void OnRewirePhase(int phase, uint64_t us);
   void OnChurnEvent(int kind);
   void OnWorldSize(uint64_t world);
+  // Live weight-update hooks (tpunet_c_swap_observe / tpunet_c_swap_event /
+  // tpunet_c_weight_version): `phase` indexes kSwapPhaseCount, `kind`
+  // indexes kSwapKindCount, `version` is the serving checkpoint version.
+  void OnSwapPhase(int phase, uint64_t us);
+  void OnSwapEvent(int kind);
+  void OnWeightVersion(uint64_t version);
   // Bound port of the on-demand /metrics listener (0 = no listener). With
   // TPUNET_METRICS_PORT=0 the listener binds an EPHEMERAL port and this is
   // the only way to learn it (multi-tier loopback tests scrape both tiers).

@@ -264,10 +264,12 @@ int32_t tpunet_c_fault_inject(const char* spec) {
   tpunet::FaultSpec f;
   bool has_fault = false;
   std::vector<tpunet::ChurnEvent> churn;
-  Status s = tpunet::ParseFaultScript(spec, &f, &has_fault, &churn);
+  std::vector<tpunet::SwapEvent> swap;
+  Status s = tpunet::ParseFaultScript(spec, &f, &has_fault, &churn, &swap);
   if (!s.ok()) return FromStatus(s);
   if (has_fault) tpunet::ArmFault(f);
   if (!churn.empty()) tpunet::ArmChurnScript(churn);
+  if (!swap.empty()) tpunet::ArmSwapScript(swap);
   return TPUNET_OK;
 }
 
@@ -281,6 +283,12 @@ int32_t tpunet_c_churn_poll(uint64_t step, int64_t rank) {
 }
 
 int32_t tpunet_c_churn_pending(void) { return tpunet::ChurnPending(); }
+
+int32_t tpunet_c_swap_poll(uint64_t step) {
+  return static_cast<int32_t>(tpunet::SwapPoll(step));
+}
+
+int32_t tpunet_c_swap_pending(void) { return tpunet::SwapPending(); }
 
 uint32_t tpunet_c_crc32c(const void* data, uint64_t nbytes, uint32_t seed) {
   if (data == nullptr && nbytes > 0) return 0;
@@ -624,6 +632,31 @@ int32_t tpunet_c_churn_event(int32_t kind) {
 
 int32_t tpunet_c_world_size(uint64_t world) {
   tpunet::Telemetry::Get().OnWorldSize(world);
+  return TPUNET_OK;
+}
+
+int32_t tpunet_c_swap_observe(int32_t phase, uint64_t us) {
+  if (phase < 0 || phase >= tpunet::kSwapPhaseCount) {
+    return Fail(TPUNET_ERR_INVALID,
+                "phase must be 0 (announce), 1 (broadcast), 2 (verify) or "
+                "3 (flip)");
+  }
+  tpunet::Telemetry::Get().OnSwapPhase(phase, us);
+  return TPUNET_OK;
+}
+
+int32_t tpunet_c_swap_event(int32_t kind) {
+  if (kind < 0 || kind >= tpunet::kSwapKindCount) {
+    return Fail(TPUNET_ERR_INVALID,
+                "kind must be 0 (publish), 1 (commit), 2 (abort), 3 (retry) "
+                "or 4 (mismatch)");
+  }
+  tpunet::Telemetry::Get().OnSwapEvent(kind);
+  return TPUNET_OK;
+}
+
+int32_t tpunet_c_weight_version(uint64_t version) {
+  tpunet::Telemetry::Get().OnWeightVersion(version);
   return TPUNET_OK;
 }
 

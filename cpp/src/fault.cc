@@ -34,6 +34,11 @@ std::atomic<uint32_t> g_latched{0};   // one-shot claim for close/corrupt
 Mutex g_churn_mu;
 std::vector<ChurnEvent> g_churn GUARDED_BY(g_churn_mu);
 
+// The armed swap chaos script (docs/DESIGN.md "Live weight updates").
+// Same off-hot-path polling discipline as churn.
+Mutex g_swap_mu;
+std::vector<SwapEvent> g_swap GUARDED_BY(g_swap_mu);
+
 bool ParseSize(const std::string& v, uint64_t* out) {
   if (v.empty()) return false;
   size_t i = 0;
@@ -176,10 +181,57 @@ Status ParseChurnSpec(const std::string& spec, ChurnEvent* out) {
   return Status::Ok();
 }
 
+Status ParseSwapSpec(const std::string& spec, SwapEvent* out) {
+  SwapEvent e;
+  bool saw_swap = false, saw_action = false;
+  size_t pos = 0;
+  while (pos <= spec.size()) {
+    size_t end = spec.find(':', pos);
+    if (end == std::string::npos) end = spec.size();
+    std::string item = spec.substr(pos, end - pos);
+    pos = end + 1;
+    if (item.empty()) {
+      if (end == spec.size()) break;
+      return Status::Invalid("swap spec: empty clause in '" + spec + "'");
+    }
+    if (item == "swap") {
+      if (saw_swap) return Status::Invalid("swap spec: duplicate swap token");
+      saw_swap = true;
+      continue;
+    }
+    size_t eq = item.find('=');
+    if (eq == std::string::npos) {
+      return Status::Invalid("swap spec: clause '" + item + "' is not key=value");
+    }
+    std::string key = item.substr(0, eq);
+    std::string val = item.substr(eq + 1);
+    if (key == "at_step") {
+      if (!ParseSize(val, &e.at_step)) {
+        return Status::Invalid("swap spec: bad at_step '" + val + "'");
+      }
+    } else if (key == "action") {
+      saw_action = true;
+      if (val == "publish") e.action = SwapAction::kPublish;
+      else if (val == "corrupt") e.action = SwapAction::kCorrupt;
+      else if (val == "die") e.action = SwapAction::kDie;
+      else return Status::Invalid("swap spec: unknown action '" + val +
+                                  "' (want publish, corrupt or die)");
+    } else {
+      return Status::Invalid("swap spec: unknown key '" + key + "'");
+    }
+  }
+  if (!saw_swap) return Status::Invalid("swap spec: missing swap token");
+  if (!saw_action) return Status::Invalid("swap spec: missing action= clause");
+  *out = e;
+  return Status::Ok();
+}
+
 Status ParseFaultScript(const std::string& spec, FaultSpec* fault,
-                        bool* has_fault, std::vector<ChurnEvent>* churn) {
+                        bool* has_fault, std::vector<ChurnEvent>* churn,
+                        std::vector<SwapEvent>* swap) {
   *has_fault = false;
   churn->clear();
+  swap->clear();
   size_t pos = 0;
   while (pos <= spec.size()) {
     size_t end = spec.find(';', pos);
@@ -197,6 +249,12 @@ Status ParseFaultScript(const std::string& spec, FaultSpec* fault,
       Status s = ParseChurnSpec(seg, &e);
       if (!s.ok()) return s;
       churn->push_back(e);
+    } else if (seg.compare(0, 4, "swap") == 0 &&
+               (seg.size() == 4 || seg[4] == ':')) {
+      SwapEvent e;
+      Status s = ParseSwapSpec(seg, &e);
+      if (!s.ok()) return s;
+      swap->push_back(e);
     } else {
       if (*has_fault) {
         return Status::Invalid(
@@ -236,6 +294,29 @@ int ChurnPending() {
   return n;
 }
 
+void ArmSwapScript(const std::vector<SwapEvent>& events) {
+  MutexLock lk(g_swap_mu);
+  g_swap = events;
+  for (SwapEvent& e : g_swap) e.fired = false;
+}
+
+SwapAction SwapPoll(uint64_t step) {
+  MutexLock lk(g_swap_mu);
+  for (SwapEvent& e : g_swap) {
+    if (e.fired || e.at_step > step) continue;
+    e.fired = true;
+    return e.action;
+  }
+  return SwapAction::kNone;
+}
+
+int SwapPending() {
+  MutexLock lk(g_swap_mu);
+  int n = 0;
+  for (const SwapEvent& e : g_swap) n += e.fired ? 0 : 1;
+  return n;
+}
+
 void ArmFault(const FaultSpec& spec) {
   MutexLock lk(g_mu);
   g_fault_armed.store(0, std::memory_order_release);  // quiesce readers' view
@@ -250,8 +331,12 @@ void DisarmFault() {
     MutexLock lk(g_mu);
     g_fault_armed.store(0, std::memory_order_release);
   }
-  MutexLock lk(g_churn_mu);
-  g_churn.clear();
+  {
+    MutexLock lk(g_churn_mu);
+    g_churn.clear();
+  }
+  MutexLock lk(g_swap_mu);
+  g_swap.clear();
 }
 
 void ArmFaultFromEnv() {
@@ -260,7 +345,8 @@ void ArmFaultFromEnv() {
   FaultSpec f;
   bool has_fault = false;
   std::vector<ChurnEvent> churn;
-  Status s = ParseFaultScript(spec, &f, &has_fault, &churn);
+  std::vector<SwapEvent> swap;
+  Status s = ParseFaultScript(spec, &f, &has_fault, &churn, &swap);
   if (!s.ok()) {
     fprintf(stderr, "tpunet: ignoring TPUNET_FAULT_SPEC: %s\n", s.msg.c_str());
     return;
@@ -274,6 +360,12 @@ void ArmFaultFromEnv() {
     // re-fire every kill the job already recovered from.
     static std::once_flag churn_once;
     std::call_once(churn_once, [&churn] { ArmChurnScript(churn); });
+  }
+  if (!swap.empty()) {
+    // Same latch-survival contract: the engine rebuilds a swap retry causes
+    // must not re-fire the corrupt/die the drill already played.
+    static std::once_flag swap_once;
+    std::call_once(swap_once, [&swap] { ArmSwapScript(swap); });
   }
 }
 

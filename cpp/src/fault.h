@@ -49,6 +49,22 @@
 // a `kill` tells the polling rank to die NOW, a `join` tells the
 // supervisor/joiner side a new rank should enter the world — so the whole
 // churn suite replays bit-identically from one env var.
+//
+// SWAP SCRIPTS (docs/DESIGN.md "Live weight updates"): the grammar also
+// accepts weight-hot-swap chaos events so the publication drills (death
+// mid-broadcast, corrupted receiver, scripted publish) are deterministic:
+//
+//   swap:at_step=6:action=publish;swap:at_step=9:action=die
+//
+// A segment whose first clause is the bare token `swap` is a swap event
+// (at_step = first step the event fires at, one-shot; action = publish |
+// corrupt | die). There is no rank clause: each process arms its OWN spec
+// via env, so "who corrupts / who dies" is the launcher's choice. Like
+// churn, swap events are polled at step boundaries (tpunet_c_swap_poll) —
+// `publish` tells the publisher to start a publication NOW, `corrupt`
+// tells the polling receiver to damage its received weight bytes before
+// digesting (the fleet-wide flip-refusal drill), `die` tells the polling
+// rank to SIGKILL itself (mid-broadcast when the step lands there).
 #ifndef TPUNET_FAULT_H_
 #define TPUNET_FAULT_H_
 
@@ -94,6 +110,22 @@ struct ChurnEvent {
   bool fired = false;    // one-shot latch, set by ChurnPoll
 };
 
+// Scripted weight-hot-swap chaos (docs/DESIGN.md "Live weight updates").
+// Advisory verdicts for the publication layer, never applied by the
+// engines; no rank clause — each process arms its own script via env.
+enum class SwapAction : int32_t {
+  kNone = 0,
+  kPublish = 1,  // publisher: start a weight publication at this step
+  kCorrupt = 2,  // receiver: damage received weight bytes before digesting
+  kDie = 3,      // polling rank: SIGKILL itself at this step
+};
+
+struct SwapEvent {
+  uint64_t at_step = 0;  // fires at the FIRST poll with step >= at_step
+  SwapAction action = SwapAction::kNone;
+  bool fired = false;    // one-shot latch, set by SwapPoll
+};
+
 // Parse `spec` into `out`; Invalid status (with the offending token named)
 // on malformed input. Pure — no global state touched.
 Status ParseFaultSpec(const std::string& spec, FaultSpec* out);
@@ -102,10 +134,16 @@ Status ParseFaultSpec(const std::string& spec, FaultSpec* out);
 // at_step defaults to 0, rank to *, action is mandatory). Pure.
 Status ParseChurnSpec(const std::string& spec, ChurnEvent* out);
 
+// Parse one swap segment ("swap:at_step=N:action=publish|corrupt|die";
+// at_step defaults to 0, action is mandatory). Pure.
+Status ParseSwapSpec(const std::string& spec, SwapEvent* out);
+
 // Parse a whole ';'-separated script: churn segments collect into `churn`,
-// the (at most one) classic segment into `fault`/`has_fault`. Pure.
+// swap segments into `swap`, the (at most one) classic segment into
+// `fault`/`has_fault`. Pure.
 Status ParseFaultScript(const std::string& spec, FaultSpec* fault,
-                        bool* has_fault, std::vector<ChurnEvent>* churn);
+                        bool* has_fault, std::vector<ChurnEvent>* churn,
+                        std::vector<SwapEvent>* swap);
 
 // Arm/disarm the process-wide fault slot (one fault at a time — chaos tests
 // arm, run, clear). Arming resets the byte counters and one-shot latches.
@@ -121,6 +159,14 @@ void ArmChurnScript(const std::vector<ChurnEvent>& events);
 ChurnAction ChurnPoll(uint64_t step, int64_t rank);
 // Events armed but not yet fired (the smoke lane's completeness gate).
 int ChurnPending();
+// Arm the process-wide swap chaos script (replaces any previous script and
+// its fired latches). DisarmFault()/tpunet_c_fault_clear wipe it too.
+void ArmSwapScript(const std::vector<SwapEvent>& events);
+// One-shot poll at a step boundary: the first un-fired event with
+// at_step <= step fires and returns its action; kNone when nothing fires.
+SwapAction SwapPoll(uint64_t step);
+// Swap events armed but not yet fired.
+int SwapPending();
 // Arm from TPUNET_FAULT_SPEC if set and parseable (called at engine
 // creation); a malformed env spec is reported on stderr and ignored —
 // a typo must not take down training.

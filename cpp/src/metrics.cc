@@ -228,6 +228,12 @@ struct Telemetry::Impl {
   std::atomic<uint64_t> churn_events[kChurnKindCount] = {};
   std::atomic<uint64_t> world_size{0};
 
+  // Live weight-update accounting: per-phase swap duration histograms,
+  // swap events by kind, and the serving checkpoint version (gauge).
+  StageHistAtomic swap_phase[kSwapPhaseCount];
+  std::atomic<uint64_t> swap_events[kSwapKindCount] = {};
+  std::atomic<uint64_t> weight_version{0};
+
   // TCP introspection (always on unless TPUNET_TCPINFO_INTERVAL_MS=0).
   uint64_t tcp_interval_us =
       GetEnvU64("TPUNET_TCPINFO_INTERVAL_MS", 100) * 1000;
@@ -733,6 +739,20 @@ void Telemetry::OnWorldSize(uint64_t world) {
   impl_->world_size.store(world, std::memory_order_relaxed);
 }
 
+void Telemetry::OnSwapPhase(int phase, uint64_t us) {
+  if (phase < 0 || phase >= kSwapPhaseCount) return;
+  impl_->swap_phase[phase].Observe(us);
+}
+
+void Telemetry::OnSwapEvent(int kind) {
+  if (kind < 0 || kind >= kSwapKindCount) return;
+  impl_->swap_events[kind].fetch_add(1, std::memory_order_relaxed);
+}
+
+void Telemetry::OnWeightVersion(uint64_t version) {
+  impl_->weight_version.store(version, std::memory_order_relaxed);
+}
+
 int Telemetry::MetricsPort() const {
   return impl_->scrape_bound_port.load(std::memory_order_acquire);
 }
@@ -798,6 +818,9 @@ void Telemetry::Reset() {
   for (auto& h : im->rewire_phase) h.Reset();
   for (auto& c : im->churn_events) c.store(0, std::memory_order_relaxed);
   im->world_size.store(0, std::memory_order_relaxed);
+  for (auto& h : im->swap_phase) h.Reset();
+  for (auto& c : im->swap_events) c.store(0, std::memory_order_relaxed);
+  im->weight_version.store(0, std::memory_order_relaxed);
   {
     MutexLock lk(im->win_mu);
     im->win_init = false;
@@ -931,6 +954,13 @@ MetricsSnapshot Telemetry::Snapshot() const {
     s.churn_events[k] = im->churn_events[k].load(std::memory_order_relaxed);
   }
   s.world_size = im->world_size.load(std::memory_order_relaxed);
+  for (int p = 0; p < kSwapPhaseCount; ++p) {
+    im->swap_phase[p].SnapshotInto(&s.swap_us[p]);
+  }
+  for (int k = 0; k < kSwapKindCount; ++k) {
+    s.swap_events[k] = im->swap_events[k].load(std::memory_order_relaxed);
+  }
+  s.weight_version = im->weight_version.load(std::memory_order_relaxed);
   for (int t = 0; t < kServeTierCount; ++t) {
     s.serve_queue_depth[t] = im->serve_depth[t].load(std::memory_order_relaxed);
   }
@@ -1271,6 +1301,47 @@ std::string Telemetry::PrometheusText() const {
          "until a churn-aware job reports).");
   emit("tpunet_world_size{rank=\"%lld\"} %llu\n", (long long)rank,
        (unsigned long long)s.world_size);
+  // Live weight-update families (docs/DESIGN.md "Live weight updates").
+  // Same every-series-even-at-zero discipline as the churn families: the
+  // swap smoke lane gates on "every phase non-empty".
+  family("tpunet_weight_swap_duration_us", "histogram",
+         "Live weight-swap duration per publication phase (announce, "
+         "broadcast, verify, flip — microseconds).");
+  static const char* kSwapPhases[kSwapPhaseCount] = {"announce", "broadcast",
+                                                     "verify", "flip"};
+  for (int p = 0; p < kSwapPhaseCount; ++p) {
+    const StageHist& h = s.swap_us[p];
+    uint64_t cum = 0;
+    for (int i = 0; i < kStageHistBuckets - 1; ++i) {
+      cum += h.buckets[i];
+      emit("tpunet_weight_swap_duration_us_bucket{rank=\"%lld\",phase=\"%s\",le=\"%llu\"} %llu\n",
+           (long long)rank, kSwapPhases[p],
+           (unsigned long long)kStageHistBounds[i], (unsigned long long)cum);
+    }
+    cum += h.buckets[kStageHistBuckets - 1];
+    emit("tpunet_weight_swap_duration_us_bucket{rank=\"%lld\",phase=\"%s\",le=\"+Inf\"} %llu\n",
+         (long long)rank, kSwapPhases[p], (unsigned long long)cum);
+    emit("tpunet_weight_swap_duration_us_sum{rank=\"%lld\",phase=\"%s\"} %llu\n",
+         (long long)rank, kSwapPhases[p], (unsigned long long)h.sum_us);
+    emit("tpunet_weight_swap_duration_us_count{rank=\"%lld\",phase=\"%s\"} %llu\n",
+         (long long)rank, kSwapPhases[p], (unsigned long long)h.count);
+  }
+  family("tpunet_swap_events_total", "counter",
+         "Weight-swap events, by kind (publish, commit, abort, retry, "
+         "mismatch).");
+  static const char* kSwapKinds[kSwapKindCount] = {"publish", "commit",
+                                                   "abort", "retry",
+                                                   "mismatch"};
+  for (int k = 0; k < kSwapKindCount; ++k) {
+    emit("tpunet_swap_events_total{rank=\"%lld\",kind=\"%s\"} %llu\n",
+         (long long)rank, kSwapKinds[k],
+         (unsigned long long)s.swap_events[k]);
+  }
+  family("tpunet_weight_version", "gauge",
+         "Checkpoint version this rank is serving (0 until a versioned "
+         "serving tier reports; the swap lane's per-rank flip gate).");
+  emit("tpunet_weight_version{rank=\"%lld\"} %llu\n", (long long)rank,
+       (unsigned long long)s.weight_version);
   family("tpunet_hold_on_request", "gauge",
          "Requests posted but not yet test()ed done (in flight).");
   emit("tpunet_hold_on_request{rank=\"%lld\"} %llu\n", (long long)rank,

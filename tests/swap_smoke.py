@@ -1,0 +1,371 @@
+"""CI swap-smoke lane: zero-downtime live weight updates under open-loop
+load — a clean hot-swap, then a hot-swap with a rank death mid-broadcast.
+
+Fleet: frontend (router + prefill + WeightPublisher) in THIS process, TWO
+decode ranks in spawned processes, QoS DRR gate armed (256 KiB wire
+window) so the bulk-class weight broadcast actually contends with the
+latency-class request traffic. The benchmarks.serve_load open-loop
+harness offers fixed Poisson load; the armed chaos grammar schedules BOTH
+publications (``swap:at_step=N:action=publish``).
+
+**Window 1 — clean swap (the latency claim).** Checkpoint v1 publishes
+mid-window with no faults. Gates: zero failed requests, zero rejections,
+the MEDIAN TTFT blip is at most ONE histogram bucket (pre-swap p50
+bucket vs whole-window p50 bucket) and a loose >=75% floor on TTFTs
+within the 1 s SLO — the swap must be invisible to the typical request,
+and a wedged serve loop (the bug class this lane exists to catch) would
+push EVERY TTFT past the SLO, not a sliver. The tail itself is not
+gated: a CI box running three jax compiles concurrently during the flip
+smears 0-15% of samples past 1 s on scheduler luck alone (with ~100
+samples the histogram p99 IS the max), and gating it would gate on the
+box, not the code.
+
+**Window 2 — death mid-broadcast (the robustness claim).** Checkpoint v2
+publishes mid-window and the publisher's pump hook SIGKILLs decode rank B
+once the publisher reports the broadcast in flight (``pub.phase``). The
+publisher retries and commits on the
+survivor; B respawns STALE (serving v0), is picked up by router
+re-admission, and is caught up to v2 by ``catch_up()``. Gates: zero
+FAILED requests across the death — every ADMITTED request completes
+(replays land on the survivor; the drain proves no hang) — zero CRC
+mismatches anywhere, exactly one rank failure / one readmission / one
+catch-up / >=1 typed retry. Typed admission rejections are LEGAL in this
+window (half the pool is dead and the harness is open-loop: backpressure
+drops, not waits) but must stay a bounded minority of offered load; no
+tail gate either — a killed rank's in-flight replays pay real recovery
+latency, and pretending otherwise would gate on luck.
+
+Fleet-wide postconditions: ``tpunet_weight_version`` reads v2 on EVERY
+rank (frontend in-process, both decode tiers by /metrics scrape —
+including the respawned one); the bulk class moved nonzero broadcast
+bytes while the latency class's p99 queue wait stayed within the 100 ms
+bucket; ``swap_pending() == 0`` (the armed script ran to completion).
+
+Run: python tests/swap_smoke.py   (exit 0 = pass)
+"""
+
+import multiprocessing as mp
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Ephemeral /metrics in every process (children re-run this top level), CPU
+# pin before any jax import, and the QoS gate armed so class accounting +
+# queue-wait histograms are live while weights broadcast under load.
+os.environ["TPUNET_METRICS_PORT"] = "0"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["TPUNET_QOS_INFLIGHT_BYTES"] = "wire=256K"
+os.environ["TPUNET_QOS_WEIGHTS"] = "latency=8,bulk=1"
+# Arm the native progress watchdog + aggressive keepalive (the churn
+# lane's settings): a SIGKILLed peer must surface TYPED in every blocked
+# collective — the survivor's mid-swap receive pump included — instead
+# of parking a serve loop until RST delivery. Without this, the
+# survivor can miss every retry announce and the publication dies on
+# bootstrap timeouts.
+os.environ["TPUNET_PROGRESS_TIMEOUT_MS"] = "10000"
+os.environ["TPUNET_KEEPALIVE_IDLE_S"] = "3"
+os.environ["TPUNET_KEEPALIVE_INTVL_S"] = "2"
+os.environ["TPUNET_KEEPALIVE_CNT"] = "2"
+
+import numpy as np  # noqa: E402
+
+SLOTS = 4
+BUCKETS = (8, 16, 32)
+MAX_NEW = 8
+MAX_LEN = BUCKETS[-1] + MAX_NEW
+KV_CODEC = "int8"
+WINDOW_S = 12.0
+RATE_RPS = 6.0
+SWAP_AT_S = 3.0
+SWAP_CHUNK = 8192       # small chunks -> several pump interleaves per attempt
+TTFT_SLO_OK = 0.75      # window 1: loose floor on TTFTs within the 1 s SLO
+P99_WAIT_BUDGET_US = 100_000
+
+
+def _model_and_params(seed: int):
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_platforms", "cpu")
+    from tpunet.models import Transformer
+
+    model = Transformer(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                        d_ff=64, compute_dtype=jnp.float32)
+    toks = jax.random.randint(jax.random.PRNGKey(0), (2, 24), 0, 64)
+    params = model.init(jax.random.PRNGKey(seed), toks)["params"]
+    return model, params
+
+
+def _decode_child(name: str, addr: str, port_q, stop_ev) -> None:
+    try:
+        from tpunet import serve, telemetry
+
+        model, params = _model_and_params(seed=1)  # every child starts on v0
+        worker = serve.connect_decode(addr, model, params, slots=SLOTS,
+                                      max_len=MAX_LEN, kv_codec=KV_CODEC)
+        port_q.put(("port", name, telemetry.metrics_port()))
+        worker.serve()
+        stop_ev.wait(timeout=240)  # hold the /metrics listener for scraping
+        port_q.put(("done", name, worker.stats))
+    except Exception as e:  # noqa: BLE001
+        port_q.put(("error", name, f"{type(e).__name__}: {e}"))
+
+
+def _scrape_series(text: str, family: str) -> dict:
+    from tpunet import telemetry
+
+    out = {}
+    for line in text.splitlines():
+        m = telemetry._LINE.match(line)
+        if m and m.group(1) == family:
+            lab = telemetry.labels(tuple((m.group(2) or "").split(",")))
+            out[tuple(sorted(lab.items()))] = float(m.group(3))
+    return out
+
+
+def _scrape_one(text: str, family: str, **want) -> float:
+    vals = [v for k, v in _scrape_series(text, family).items()
+            if all((lk, lv) in k for lk, lv in want.items())]
+    assert vals, f"{family} {want} absent from scrape"
+    return sum(vals)
+
+
+def _bucket_index(bounds, value: float) -> int:
+    """Index of the histogram bucket a quantile landed in (inf -> past the
+    last bound) — the unit the p99-blip gate is stated in."""
+    for i, (le, _) in enumerate(bounds):
+        if value <= le:
+            return i
+    return len(bounds)
+
+
+def main() -> int:
+    from benchmarks.serve_load import hist_quantile, run_load
+    from tpunet import _native, serve, telemetry, transport
+    from tpunet.serve import publish
+
+    model, params_v0 = _model_and_params(seed=1)
+    _, params_v1 = _model_and_params(seed=2)
+    _, params_v2 = _model_and_params(seed=3)
+
+    lsock = serve.Router.listen("127.0.0.1:0")
+    addr = "127.0.0.1:%d" % lsock.getsockname()[1]
+    ctx = mp.get_context("spawn")
+    port_q = ctx.Queue()
+    stop_ev = ctx.Event()
+    children = {
+        name: ctx.Process(target=_decode_child,
+                          args=(name, addr, port_q, stop_ev))
+        for name in ("A", "B")
+    }
+    for child in children.values():
+        child.start()
+    try:
+        prefill = serve.PrefillEngine(model, params_v0, max_len=MAX_LEN)
+        router = serve.Router(prefill, kv_codec=KV_CODEC)
+        router.accept_ranks(lsock, 2)
+        router.enable_readmission(lsock)  # the killed rank rejoins here
+        ports = {}
+        for _ in range(2):
+            kind, name, val = port_q.get(timeout=120)
+            assert kind == "port", (name, val)
+            ports[name] = val
+
+        pub = serve.WeightPublisher(router, chunk_bytes=SWAP_CHUNK)
+        # The chaos grammar schedules BOTH publications (step 1 -> window
+        # 1's clean swap, step 2 -> window 2's kill swap); the completeness
+        # gate at the end is swap_pending() == 0.
+        lib = _native.load()
+        _native.check(
+            lib.tpunet_c_fault_inject(
+                b"swap:at_step=1:action=publish;"
+                b"swap:at_step=2:action=publish"),
+            "inject")
+
+        # Warm every prompt-length bucket on BOTH tiers, then reset so the
+        # measured window starts clean.
+        for b in BUCKETS:
+            for _ in range(2):
+                router.submit(np.zeros(b, np.int32), 2)
+        router.run(timeout=240)
+        telemetry.reset()
+        print("swap_smoke: warmup done", flush=True)
+
+        # ---- Window 1: clean hot-swap v0 -> v1 under load ----------------
+        w1 = {"pre": None, "published": False}
+
+        def on_tick_clean(elapsed: float, pump) -> None:
+            if (not w1["published"] and elapsed >= SWAP_AT_S
+                    and publish.swap_action(1) == "publish"):
+                w1["pre"] = telemetry.histogram_buckets("tpunet_req_ttft_us")
+                w1["published"] = True
+                pub.publish(1, params_v1, pump=pump, warm_lengths=BUCKETS)
+
+        res1 = run_load(router, duration_s=WINDOW_S, rate=RATE_RPS,
+                        vocab=64, buckets=BUCKETS, new_range=(2, MAX_NEW),
+                        session_prob=0.25, seed=11, on_tick=on_tick_clean)
+        assert w1["published"], "scripted clean publish never fired"
+        assert res1["failed"] == 0, res1
+        assert res1["rejected"] == 0, res1
+        assert res1["completed"] == res1["offered"] > 0, res1
+
+        # Gate: clean-swap MEDIAN TTFT blip bounded by ONE histogram
+        # bucket, plus the loose >=75% SLO floor (wedged-loop detector).
+        post = telemetry.histogram_buckets("tpunet_req_ttft_us")
+        pre_idx = _bucket_index(w1["pre"], hist_quantile(w1["pre"], 0.50))
+        post_idx = _bucket_index(post, hist_quantile(post, 0.50))
+        blip = post_idx - pre_idx
+        assert blip <= 1, \
+            f"clean-swap p50 TTFT blew {blip} buckets ({w1['pre']} -> {post})"
+        assert res1["ttft_ok_frac"] >= TTFT_SLO_OK, res1
+        assert router.version == 1, router.version
+        print(f"swap_smoke: window 1 (clean swap) done: {res1}", flush=True)
+
+        # ---- Window 2: hot-swap v1 -> v2 with rank B killed mid-broadcast
+        w2 = {"published": False, "respawned": False, "caught": False,
+              "killed": False}
+
+        def pump_kill(pump):
+            def inner():
+                # Deterministic mid-transfer death: the first pump that
+                # sees the publisher's broadcast in flight (past the
+                # rendezvous — a kill DURING it would just time out the
+                # bootstrap) SIGKILLs rank B.
+                if (not w2["killed"]
+                        and pub.phase in ("broadcast", "verify")
+                        and children["B"].is_alive()):
+                    w2["killed"] = True
+                    children["B"].kill()  # decode rank death MID-BROADCAST
+                pump()
+            return inner
+
+        def on_tick_kill(elapsed: float, pump) -> None:
+            if (not w2["published"] and elapsed >= SWAP_AT_S
+                    and publish.swap_action(2) == "publish"):
+                w2["published"] = True
+                pub.publish(2, params_v2, pump=pump_kill(pump),
+                            warm_lengths=BUCKETS)
+            elif w2["published"] and not w2["respawned"]:
+                w2["respawned"] = True
+                children["B2"] = ctx.Process(
+                    target=_decode_child, args=("B2", addr, port_q, stop_ev))
+                children["B2"].start()  # rejoins STALE: HELLO says v0
+            elif w2["respawned"] and not w2["caught"]:
+                router.poll_admissions(raise_on_mismatch=False)
+                if router.stats["readmissions"] >= 1:
+                    assert pub.catch_up(pump=pump) == 1
+                    w2["caught"] = True
+
+        res2 = run_load(router, duration_s=WINDOW_S, rate=RATE_RPS,
+                        vocab=64, buckets=BUCKETS, new_range=(2, MAX_NEW),
+                        session_prob=0.25, seed=13, on_tick=on_tick_kill)
+        print(f"swap_smoke: window 2 (kill mid-broadcast) done: {res2} "
+              f"caught={w2['caught']}", flush=True)
+
+        # The spawn is slow on a loaded CI box: if the window closed before
+        # the rejoin/catch-up landed, finish it now — the gates below still
+        # prove the full kill -> readmit -> catch-up arc.
+        deadline = time.monotonic() + 120
+        while not w2["caught"] and time.monotonic() < deadline:
+            router.poll_admissions(raise_on_mismatch=False)
+            router.poll()
+            if router.stats["readmissions"] >= 1:
+                assert pub.catch_up(pump=router.poll) == 1
+                w2["caught"] = True
+            time.sleep(0.05)
+        assert w2["published"], "scripted kill publish never fired"
+        assert w2["caught"], "killed rank never rejoined / caught up"
+        print("swap_smoke: stale rank caught up, scraping fleet", flush=True)
+        kind, name, b2_port = port_q.get(timeout=120)
+        assert kind == "port" and name == "B2", (kind, name, b2_port)
+        ports["B2"] = b2_port
+
+        # Gate: the swap and the rank death never cost an ADMITTED request
+        # (the completed drain inside run_load already proved no hang).
+        # Open-loop backpressure rejections are legal while half the pool
+        # is dead — typed, counted, and bounded — never silent drops.
+        assert res2["failed"] == 0, res2
+        assert res2["completed"] > 0, res2
+        assert res2["completed"] == res2["offered"] - res2["rejected"], res2
+        assert res2["rejected"] * 2 < res2["offered"], res2
+
+        # Gate: v2 live on EVERY rank — frontend in-process, both decode
+        # tiers (survivor AND the respawned stale rank) by scrape.
+        m = telemetry.metrics()
+        assert next(iter(m["tpunet_weight_version"].values())) == 2, \
+            "frontend gauge is not v2"
+        scrapes = {name: telemetry.scrape(port=ports[name])
+                   for name in ("A", "B2")}
+        for name, text in scrapes.items():
+            got = _scrape_one(text, "tpunet_weight_version")
+            assert got == 2, f"rank {name} serves version {got}, want 2"
+
+        # Gate: weight bytes rode the BULK class (tx at the publisher, rx
+        # at the surviving receiver) while the latency class's p99 queue
+        # wait stayed in budget under the armed DRR gate.
+        bulk_tx = sum(v for k, v in m["tpunet_qos_bytes_total"].items()
+                      if ("class", "bulk") in
+                      tuple(sorted(telemetry.labels(k).items()))
+                      and telemetry.labels(k).get("dir") == "tx")
+        assert bulk_tx > 0, "publisher moved no bulk-class bytes"
+        assert _scrape_one(scrapes["A"], "tpunet_qos_bytes_total",
+                           **{"class": "bulk", "dir": "rx"}) > 0, \
+            "survivor received no bulk-class bytes"
+        lat_wait = [(float("inf") if lab.get("le") in ("+Inf", "Inf")
+                     else float(lab["le"]), int(v))
+                    for k, v in m.get(
+                        "tpunet_qos_queue_wait_us_bucket", {}).items()
+                    if (lab := telemetry.labels(k)).get("class") == "latency"]
+        lat_wait = sorted(
+            {le: c for le, c in sorted(lat_wait)}.items())
+        assert lat_wait and lat_wait[-1][1] > 0, \
+            "latency queue-wait histogram is empty"
+        assert hist_quantile(lat_wait, 0.99) <= P99_WAIT_BUDGET_US, \
+            f"latency p99 queue wait {hist_quantile(lat_wait, 0.99)}us"
+
+        # Gate: zero CRC mismatches anywhere; the failure arc is exactly
+        # one death, >=1 typed retry, one readmission, one catch-up; and
+        # the armed script ran to completion.
+        mism = sum(v for k, v in m["tpunet_swap_events_total"].items()
+                   if telemetry.labels(k).get("kind") == "mismatch")
+        assert mism == 0, f"{mism} CRC mismatches on the frontend"
+        for name, text in scrapes.items():
+            assert _scrape_one(text, "tpunet_swap_events_total",
+                               kind="mismatch") == 0, \
+                f"rank {name} saw a CRC mismatch"
+        assert router.stats["rank_failures"] == 1, router.stats
+        assert router.stats["readmissions"] == 1, router.stats
+        assert pub.stats["retries"] >= 1, pub.stats
+        assert pub.stats["catch_ups"] == 1, pub.stats
+        assert router.version == 2
+        assert publish.swap_pending() == 0, "armed swap script incomplete"
+
+        router.shutdown()
+        stop_ev.set()
+        done = {}
+        for _ in range(2):  # A and B2 report; killed B never does
+            kind, name, payload = port_q.get(timeout=120)
+            assert kind == "done", (name, payload)
+            done[name] = payload
+        assert done["A"]["swaps"] == 2, done   # flipped v1 AND v2
+        assert done["B2"]["swaps"] == 1, done  # caught up straight to v2
+        print(f"swap_smoke OK: {res1['completed']}+{res2['completed']} "
+              f"requests, 0 failed, clean-swap p50 blip {blip} bucket(s), "
+              f"ttft_ok={res1['ttft_ok_frac']}, v2 on 3/3 ranks, "
+              f"bulk_tx={int(bulk_tx)}B, retries={pub.stats['retries']}, "
+              f"decode_stats={done}")
+        return 0
+    finally:
+        transport.fault_clear()
+        stop_ev.set()
+        for child in children.values():
+            child.join(timeout=30)
+            if child.is_alive():
+                child.kill()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

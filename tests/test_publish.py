@@ -1,0 +1,448 @@
+"""Live weight updates (tpunet/serve/publish, DESIGN.md "Live weight
+updates").
+
+Coverage map:
+  * Swap chaos grammar — native parser accept/reject, one-shot poll
+    latch, pending counter, the Python mirror, and typed rejection of
+    malformed specs (same strings on both sides of the ABI).
+  * Protocol — SwapAnnounce pack/unpack goldens and typed refusals,
+    HELLO weight-version ride-along in the class word's upper bytes.
+  * Knobs — TPUNET_SWAP_TIMEOUT_MS / TPUNET_SWAP_CHUNK_BYTES /
+    TPUNET_PUBLISH_CLASS registered, defaulted, range-validated.
+  * Error path — -10 maps to the typed retryable WeightSwapError;
+    receiver deadline/flatten truncation raise it, never hang.
+  * Metrics — swap phase histogram, event counters, version gauge:
+    observable, labeled, reset()-able.
+  * THE PIN: a session admitted under v0 completes BITWISE on v0 while a
+    mid-flight publication flips the fleet to v1 and new sessions serve
+    v1 — both checked against single-version oracles (v1's oracle uses
+    the bf16-ROUNDTRIPPED params: what every rank actually holds after
+    the wire). The drained v0 then retires on both tiers.
+  * CRC refusal: one receiver corrupting one byte refuses the flip
+    FLEET-WIDE (typed, counted), v0 keeps serving bitwise, and the next
+    (clean) attempt of the SAME version succeeds — retryability.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from conftest import free_port  # noqa: F401  (pins JAX_PLATFORMS=cpu first)
+
+import jax
+import jax.numpy as jnp
+
+from tpunet import _native, serve, telemetry, transport
+from tpunet.models import Transformer, generate
+from tpunet.serve import protocol as proto
+from tpunet.serve import publish
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+def _tiny_setup(seed=1):
+    model = Transformer(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                        d_ff=64, compute_dtype=jnp.float32)
+    toks = jax.random.randint(jax.random.PRNGKey(0), (2, 24), 0, 64)
+    params = model.init(jax.random.PRNGKey(seed), toks)["params"]
+    return model, params
+
+
+def _oracle(model, params, prompt, n):
+    out = generate(model, params, jnp.asarray(prompt)[None], n)
+    return np.asarray(out)[0, len(prompt):]
+
+
+# ---------------------------------------------------------------------------
+# Swap chaos grammar: native parser, Python mirror, typed rejection.
+
+
+def test_swap_script_native_parse_and_poll():
+    lib = _native.load()
+    _native.check(lib.tpunet_c_fault_inject(
+        b"swap:at_step=4:action=publish;swap:at_step=8:action=die"),
+        "inject")
+    try:
+        assert publish.swap_pending() == 2
+        assert publish.swap_action(3) is None       # before at_step
+        assert publish.swap_action(5) == "publish"  # >= at_step fires
+        assert publish.swap_action(5) is None       # one-shot latch
+        assert publish.swap_pending() == 1
+        assert publish.swap_action(9) == "die"
+        assert publish.swap_pending() == 0
+    finally:
+        transport.fault_clear()
+    assert publish.swap_pending() == 0  # clear wipes the script
+
+
+def test_swap_script_rides_alongside_churn_and_classic_segments():
+    lib = _native.load()
+    _native.check(lib.tpunet_c_fault_inject(
+        b"stream=1:action=close;churn:at_step=2:rank=0:action=kill;"
+        b"swap:at_step=3:action=corrupt"), "inject")
+    try:
+        from tpunet import elastic
+
+        assert publish.swap_pending() == 1
+        assert elastic.churn_pending() == 1
+        assert publish.swap_action(3) == "corrupt"
+        assert elastic.churn_action(2, 0) == "kill"
+    finally:
+        transport.fault_clear()
+
+
+@pytest.mark.parametrize("spec", [
+    "swap:at_step=1:action=flip",      # unknown action
+    "swap:at_step=1",                  # missing action
+    "swap:badkey=1:action=publish",    # unknown key
+    "swap:at_step=x:action=die",       # bad number
+    "swap",                            # bare token
+])
+def test_swap_script_malformed_typed(spec):
+    lib = _native.load()
+    assert lib.tpunet_c_fault_inject(spec.encode()) == _native.TPUNET_ERR_INVALID
+    assert _native.last_error()
+
+
+def test_parse_swap_script_python_mirror():
+    events = publish.parse_swap_script(
+        "churn:at_step=1:rank=0:action=kill;"
+        "swap:at_step=5:action=publish;swap:at_step=9:action=die")
+    assert events == [{"at_step": 5, "action": "publish"},
+                      {"at_step": 9, "action": "die"}]
+    for bad in ("swap:at_step=1:action=flip", "swap:at_step=1",
+                "swap:badkey=1:action=die", "swap:at_step"):
+        with pytest.raises(ValueError):
+            publish.parse_swap_script(bad)
+
+
+# ---------------------------------------------------------------------------
+# Protocol: SwapAnnounce, HELLO version ride-along.
+
+
+def test_swap_announce_roundtrip():
+    ann = proto.SwapAnnounce(7, 3, 2, 123457, 1 << 16, "bf16", 30_000,
+                             "127.0.0.1:2947", traffic_class="bulk")
+    out = proto.unpack_swap_begin(proto.pack_swap_begin(ann))
+    assert (out.version, out.world, out.rank, out.nelems, out.chunk_bytes,
+            out.codec, out.timeout_ms, out.coordinator, out.traffic_class) \
+        == (7, 3, 2, 123457, 1 << 16, "bf16", 30_000, "127.0.0.1:2947",
+            "bulk")
+
+
+def test_swap_announce_typed_refusals():
+    ann = proto.SwapAnnounce(1, 2, 1, 10, 4096, "bf16", 1000, "h:1")
+    good = proto.pack_swap_begin(ann)
+    with pytest.raises(proto.TierProtocolError):
+        proto.unpack_swap_begin(good[:8])          # shorter than sub-header
+    bad_codec = bytearray(good)
+    bad_codec[proto._SWAP_HDR.size - 6] = 99       # codec id byte
+    with pytest.raises(proto.TierProtocolError):
+        proto.unpack_swap_begin(bytes(bad_codec))
+    with pytest.raises(proto.TierProtocolError):
+        # rank 0 is the publisher — never a receiver
+        proto.unpack_swap_begin(
+            proto._SWAP_HDR.pack(1, 2, 0, 10, 4096, 1, 1, 1000) + b"h:1")
+    with pytest.raises(proto.TierProtocolError):
+        # coordinator must be host:port
+        proto.unpack_swap_begin(
+            proto._SWAP_HDR.pack(1, 2, 1, 10, 4096, 1, 1, 1000) + b"nohost")
+    with pytest.raises(ValueError):
+        proto.pack_swap_begin(proto.SwapAnnounce(
+            1, 2, 1, 10, 4096, "bf16", 1000, "h:1", traffic_class="warp"))
+
+
+def test_hello_weight_version_rides_class_word():
+    h = proto.Hello(proto.ROLE_DECODE, "int8", 4, 128, 64, 0xBEEF,
+                    weight_version=3)
+    out = proto.Hello.unpack(h.pack())
+    assert out.weight_version == 3 and out.traffic_class == "latency"
+    # An old build packs class-only (version 0): never a mismatch, the
+    # router reads "needs catch-up".
+    legacy = proto.Hello(proto.ROLE_DECODE, "int8", 4, 128, 64, 0xBEEF)
+    assert proto.Hello.unpack(legacy.pack()).weight_version == 0
+    with pytest.raises(ValueError):
+        proto.Hello(proto.ROLE_DECODE, "int8", 4, 128, 64, 0,
+                    weight_version=1 << 24)
+
+
+# ---------------------------------------------------------------------------
+# Knobs + typed error + metrics.
+
+
+def test_swap_knobs_registered_and_validated(monkeypatch):
+    from tpunet.config import Config
+
+    cfg = Config.from_env()
+    assert cfg.swap_timeout_ms == 30_000
+    assert cfg.swap_chunk_bytes == 1 << 20
+    assert cfg.publish_class == "bulk"
+    monkeypatch.setenv("TPUNET_SWAP_TIMEOUT_MS", "5000")
+    monkeypatch.setenv("TPUNET_SWAP_CHUNK_BYTES", "65536")
+    monkeypatch.setenv("TPUNET_PUBLISH_CLASS", "control")
+    cfg = Config.from_env()
+    assert (cfg.swap_timeout_ms, cfg.swap_chunk_bytes, cfg.publish_class) \
+        == (5000, 65536, "control")
+    for var, bad in (("TPUNET_SWAP_TIMEOUT_MS", "0"),
+                     ("TPUNET_SWAP_CHUNK_BYTES", "16"),
+                     ("TPUNET_SWAP_CHUNK_BYTES", str(1 << 31)),
+                     ("TPUNET_PUBLISH_CLASS", "fast")):
+        with monkeypatch.context() as m:
+            m.setenv(var, bad)
+            with pytest.raises(ValueError, match=var):
+                Config.from_env()
+
+
+def test_weight_swap_error_is_typed_and_mapped():
+    assert _native.TPUNET_ERR_WEIGHT_SWAP == -10
+    with pytest.raises(publish.WeightSwapError):
+        _native.check(_native.TPUNET_ERR_WEIGHT_SWAP, "probe")
+    assert issubclass(publish.WeightSwapError, _native.NativeError)
+
+
+def test_swap_metrics_accessors_and_reset():
+    telemetry.reset()
+    telemetry.swap_observe("broadcast", 1234)
+    telemetry.swap_observe("flip", 77)
+    telemetry.swap_event("commit")
+    telemetry.weight_version(5)
+    m = telemetry.metrics()
+    counts = {telemetry.labels(k).get("phase"): v
+              for k, v in m["tpunet_weight_swap_duration_us_count"].items()}
+    assert counts["broadcast"] == 1 and counts["flip"] == 1
+    assert counts["announce"] == 0 and counts["verify"] == 0
+    events = {telemetry.labels(k).get("kind"): v
+              for k, v in m["tpunet_swap_events_total"].items()}
+    assert events["commit"] == 1 and events["abort"] == 0
+    assert next(iter(m["tpunet_weight_version"].values())) == 5
+    with pytest.raises(ValueError):
+        telemetry.swap_observe("warmup", 1)
+    with pytest.raises(ValueError):
+        telemetry.swap_event("explode")
+    telemetry.reset()
+    m = telemetry.metrics()
+    assert sum(m["tpunet_weight_swap_duration_us_count"].values()) == 0
+    assert next(iter(m["tpunet_weight_version"].values())) == 0
+
+
+# ---------------------------------------------------------------------------
+# Receiver/helper failure paths: typed, bounded, never a hang.
+
+
+def test_receiver_deadline_typed():
+    model, params = _tiny_setup()
+    ann = proto.SwapAnnounce(1, 2, 1, 64, 4096, "bf16", 1,
+                             "127.0.0.1:1")  # 1ms deadline, no publisher
+    recv = publish.WeightReceiver(ann, params)
+    time.sleep(0.01)
+    with pytest.raises(publish.WeightSwapError, match="deadline"):
+        recv.pump()
+    assert recv.staged is None
+    recv.abort()  # idempotent
+
+
+def test_unflatten_truncation_typed():
+    model, params = _tiny_setup()
+    flat = publish.flatten_params(params)
+    with pytest.raises(publish.WeightSwapError, match="truncated"):
+        publish.unflatten_params(params, flat[:-5])
+    with pytest.raises(publish.WeightSwapError, match="consumes only"):
+        publish.unflatten_params(
+            params, np.concatenate([flat, np.zeros(3, np.float32)]))
+
+
+def test_publish_version_must_increase():
+    class _R:
+        version = 3
+    with pytest.raises(ValueError, match="must increase"):
+        publish.WeightPublisher(_R()).publish(3, {})
+
+
+def test_publish_abandons_wedged_broadcast_thread(monkeypatch):
+    """A peer SIGKILLed at the wrong instant can wedge the native
+    collective in a state even a force-close cannot error out of. The
+    supervisor must then ABANDON the daemon thread past deadline+grace
+    and raise typed — one leaked thread, never a wedged serving loop."""
+
+    class _Rank:
+        alive = True
+        index = 0
+
+    class _Prefill:
+        model = None
+        max_len = 8
+
+    class _Router:
+        version = 0
+        _ranks = [_Rank()]
+        _swap_status: dict = {}
+        prefill = _Prefill()
+
+        def poll(self):
+            pass
+
+    params = {"w": np.arange(8, dtype=np.float32)}
+    pub = publish.WeightPublisher(_Router(), timeout_ms=150)
+    wedge = threading.Event()
+    # A broadcast parked beyond the reach of the deadline force-close
+    # (cast_box never exposes a comm, so there is nothing to close).
+    monkeypatch.setattr(pub, "_broadcast_to",
+                        lambda *a, **k: wedge.wait())
+    monkeypatch.setattr(publish, "_CAST_ABANDON_GRACE_S", 0.2)
+    t0 = time.monotonic()
+    with pytest.raises(publish.WeightSwapError, match="abandoned"):
+        pub.publish(1, params, retries=0)
+    assert time.monotonic() - t0 < 5.0, "abandon did not bound the wait"
+    assert pub.stats["aborts"] == 1
+    assert pub.phase is None
+    wedge.set()  # release the deliberately-leaked daemon thread
+
+
+# ---------------------------------------------------------------------------
+# THE PIN: hot-swap with version-pinned drain, bitwise on both versions.
+
+
+def _start_tier(model, params, *, slots, max_len=40):
+    lsock = serve.Router.listen("127.0.0.1:0")
+    addr = "127.0.0.1:%d" % lsock.getsockname()[1]
+    worker_box = {}
+
+    def decode_main():
+        worker = serve.connect_decode(addr, model, params, slots=slots,
+                                      max_len=max_len, kv_codec="f32")
+        worker_box["worker"] = worker
+        try:
+            worker.serve()
+        finally:
+            worker.close()
+
+    th = threading.Thread(target=decode_main, daemon=True)
+    th.start()
+    prefill = serve.PrefillEngine(model, params, max_len=max_len)
+    router = serve.Router(prefill, kv_codec="f32")
+    router.accept_ranks(lsock, 1)
+    lsock.close()
+    return router, worker_box, th
+
+
+def test_hot_swap_pins_old_sessions_and_serves_new_on_v1():
+    model, params0 = _tiny_setup(seed=1)
+    _, params1 = _tiny_setup(seed=2)
+    rt1 = publish.roundtrip_params(params1, "bf16")
+
+    telemetry.reset()
+    router, worker_box, th = _start_tier(model, params0, slots=1)
+    try:
+        rng = np.random.default_rng(3)
+        filler_p = rng.integers(0, 64, 5).astype(np.int32)
+        pinned_p = rng.integers(0, 64, 7).astype(np.int32)
+        new_p = rng.integers(0, 64, 9).astype(np.int32)
+
+        # Occupy the single slot, then admit a request that must WAIT —
+        # it is pinned to v0 at admission and will decode after the flip.
+        filler = router.submit(filler_p, 24)
+        pinned = router.submit(pinned_p, 6)
+
+        pub = serve.WeightPublisher(router, chunk_bytes=16384)
+        pub.publish(1, params1)
+        assert router.version == 1
+        assert router._ranks[0].versions >= {0, 1}
+
+        new = router.submit(new_p, 6)  # admitted under v1
+        assert router._recs[new]["version"] == 1
+        assert router._recs[pinned]["version"] == 0
+        results = router.run(timeout=240)
+
+        # Bitwise against single-version oracles: v0 requests on the
+        # PRISTINE params (they never crossed the weight wire), the v1
+        # request on the bf16-ROUNDTRIPPED checkpoint.
+        np.testing.assert_array_equal(results[filler],
+                                      _oracle(model, params0, filler_p, 24))
+        np.testing.assert_array_equal(results[pinned],
+                                      _oracle(model, params0, pinned_p, 6))
+        np.testing.assert_array_equal(results[new],
+                                      _oracle(model, rt1, new_p, 6))
+
+        # Drained v0 retires on BOTH tiers (frontend engine dropped, the
+        # decode rank told to drop its old server once locally drained).
+        router.poll()
+        assert 0 not in router._prefills and router.version == 1
+        worker = worker_box["worker"]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and len(worker._servers) != 1:
+            router.poll()
+            time.sleep(0.05)
+        assert set(worker._servers) == {1}
+        assert worker.version == 1 and worker.stats["swaps"] == 1
+
+        # Every phase of the swap is observed and bounded.
+        m = telemetry.metrics()
+        counts = {telemetry.labels(k).get("phase"): v for k, v in
+                  m["tpunet_weight_swap_duration_us_count"].items()}
+        for phase in ("announce", "broadcast", "verify", "flip"):
+            assert counts[phase] >= 1, f"phase {phase} never observed"
+        sums = {telemetry.labels(k).get("phase"): v for k, v in
+                m["tpunet_weight_swap_duration_us_sum"].items()}
+        assert all(v < 30_000_000 for v in sums.values())
+        events = {telemetry.labels(k).get("kind"): v for k, v in
+                  m["tpunet_swap_events_total"].items()}
+        assert events["publish"] >= 1 and events["commit"] >= 2
+        assert events["abort"] == 0 and events["mismatch"] == 0
+        assert next(iter(m["tpunet_weight_version"].values())) == 1
+        assert router.stats["swaps"] == 1
+        assert router.stats["rank_failures"] == 0
+    finally:
+        router.shutdown()
+        th.join(timeout=60)
+        router.close()
+
+
+def test_crc_mismatch_refuses_flip_fleet_wide_then_retries_clean():
+    model, params0 = _tiny_setup(seed=1)
+    _, params1 = _tiny_setup(seed=2)
+
+    telemetry.reset()
+    router, worker_box, th = _start_tier(model, params0, slots=2)
+    try:
+        # Let the decode worker come up, then arm one-byte corruption on
+        # the NEXT receiver (the scripted "corrupt" action's direct hook).
+        deadline = time.monotonic() + 60
+        while "worker" not in worker_box and time.monotonic() < deadline:
+            time.sleep(0.01)
+        worker = worker_box["worker"]
+        worker._corrupt_next = True
+
+        pub = serve.WeightPublisher(router, chunk_bytes=16384)
+        with pytest.raises(publish.WeightSwapError, match="CRC32C"):
+            pub.publish(1, params1, retries=0)
+
+        # Flip refused FLEET-WIDE: both tiers still on v0, still serving.
+        assert router.version == 0 and worker.version == 0
+        rng = np.random.default_rng(5)
+        p = rng.integers(0, 64, 6).astype(np.int32)
+        rid = router.submit(p, 5)
+        res = router.run(timeout=240)
+        np.testing.assert_array_equal(res[rid],
+                                      _oracle(model, params0, p, 5))
+
+        m = telemetry.metrics()
+        events = {telemetry.labels(k).get("kind"): v for k, v in
+                  m["tpunet_swap_events_total"].items()}
+        assert events["mismatch"] >= 1 and events["abort"] >= 1
+
+        # Retryable: the SAME version publishes clean on the next attempt.
+        pub.publish(1, params1)
+        assert router.version == 1
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and worker.version != 1:
+            router.poll()
+            time.sleep(0.05)
+        assert worker.version == 1
+    finally:
+        router.shutdown()
+        th.join(timeout=60)
+        router.close()

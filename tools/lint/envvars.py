@@ -32,6 +32,10 @@ ALLOWLIST = {
     # to dlopen, so it is consumed before any Config (or the library whose
     # behavior Config describes) exists.
     "TPUNET_LIBRARY_PATH": "pre-load .so path override, consumed before Config exists",
+    # Developer-only stderr tracing for the weight-swap pipeline: read once
+    # at import for near-zero steady-state cost; not an operator knob, so
+    # it stays out of the Config surface.
+    "TPUNET_SWAP_DEBUG": "swap-pipeline stderr tracing, import-time dev switch",
 }
 
 _CPP_READ = re.compile(r'(?:GetEnvU64|GetEnv|getenv)\(\s*"(TPUNET_[A-Z0-9_]+)"')

@@ -48,6 +48,7 @@ NAMING_EXCEPTIONS = {
     "tpunet_serve_queue_depth": "instantaneous request count per serving tier (dimensionless gauge)",
     "tpunet_lane_weight": "dimensionless stripe weight (1..16) per lane in the WRR scheduler",
     "tpunet_world_size": "dimensionless rank count of the live communicator (churn gauge)",
+    "tpunet_weight_version": "dimensionless checkpoint version stamp (hot-swap gauge)",
 }
 
 _SNAKE = re.compile(r"^tpunet_[a-z0-9]+(?:_[a-z0-9]+)*$")

@@ -29,6 +29,7 @@ TPUNET_ERR_VERSION = -6   # wire-framing version mismatch with the peer
 TPUNET_ERR_CODEC = -7     # ranks disagree on the collective wire codec
 TPUNET_ERR_QOS_ADMISSION = -8  # QoS class in-flight budget full (retryable)
 TPUNET_ERR_REWIRE = -9    # elastic rewire exceeded TPUNET_REWIRE_TIMEOUT_MS
+TPUNET_ERR_WEIGHT_SWAP = -10  # live weight publication aborted (retryable)
 
 HANDLE_SIZE = 64
 
@@ -225,12 +226,22 @@ def load() -> ctypes.CDLL:
     lib.tpunet_c_churn_poll.restype = i32
     lib.tpunet_c_churn_pending.argtypes = []
     lib.tpunet_c_churn_pending.restype = i32
+    lib.tpunet_c_swap_poll.argtypes = [u64]
+    lib.tpunet_c_swap_poll.restype = i32
+    lib.tpunet_c_swap_pending.argtypes = []
+    lib.tpunet_c_swap_pending.restype = i32
     lib.tpunet_c_rewire_observe.argtypes = [i32, u64]
     lib.tpunet_c_rewire_observe.restype = i32
     lib.tpunet_c_churn_event.argtypes = [i32]
     lib.tpunet_c_churn_event.restype = i32
     lib.tpunet_c_world_size.argtypes = [u64]
     lib.tpunet_c_world_size.restype = i32
+    lib.tpunet_c_swap_observe.argtypes = [i32, u64]
+    lib.tpunet_c_swap_observe.restype = i32
+    lib.tpunet_c_swap_event.argtypes = [i32]
+    lib.tpunet_c_swap_event.restype = i32
+    lib.tpunet_c_weight_version.argtypes = [u64]
+    lib.tpunet_c_weight_version.restype = i32
     lib.tpunet_c_crc32c.argtypes = [ctypes.c_void_p, u64, ctypes.c_uint32]
     lib.tpunet_c_crc32c.restype = ctypes.c_uint32
     lib.tpunet_c_host_id.argtypes = []
@@ -307,6 +318,18 @@ class RewireTimeoutError(NativeError):
     timeout, membership grace window). docs/DESIGN.md "Elastic churn"."""
 
 
+class WeightSwapError(NativeError):
+    """A live weight publication (tpunet.serve.publish) aborted: the
+    publisher or a receiver died mid-broadcast, the cross-rank CRC32C
+    digest agreement failed (flip refused fleet-wide — no rank serves a
+    version any other rank disagrees about), or the swap exceeded
+    TPUNET_SWAP_TIMEOUT_MS. The PREVIOUS version keeps serving on every
+    rank and the partial staged version was discarded, so retrying the
+    publication is always safe. Never a hang: every wait inside the swap
+    pipeline is bounded by the swap/bootstrap deadlines.
+    docs/DESIGN.md "Live weight updates"."""
+
+
 _TYPED_ERRORS = {
     TPUNET_ERR_CORRUPT: CorruptionError,
     TPUNET_ERR_TIMEOUT: ProgressTimeoutError,
@@ -314,6 +337,7 @@ _TYPED_ERRORS = {
     TPUNET_ERR_CODEC: CodecMismatchError,
     TPUNET_ERR_QOS_ADMISSION: QosAdmissionError,
     TPUNET_ERR_REWIRE: RewireTimeoutError,
+    TPUNET_ERR_WEIGHT_SWAP: WeightSwapError,
 }
 
 

@@ -423,6 +423,20 @@ class Config:
     # polls its wiring port for recovered decode hosts once
     # enable_readmission() armed it.
     readmit_probe_ms: int = 500
+    # ---- Live weight updates (docs/DESIGN.md "Live weight updates") ------
+    # Whole-swap deadline (ms): a weight publication (announce + broadcast
+    # + verify + flip) exceeding it aborts typed (WeightSwapError, -10) on
+    # every rank — the old version keeps serving, never a hang.
+    swap_timeout_ms: int = 30_000
+    # Broadcast chunk size (bytes of bf16 wire per tree broadcast): small
+    # enough that the decode serve loop's per-iteration swap work stays
+    # bounded (the latency p99 protection), large enough to amortize the
+    # per-collective rounds.
+    swap_chunk_bytes: int = 1 << 20
+    # QoS traffic class the publication broadcast rides ("bulk" by default:
+    # gigabytes of weights must not queue ahead of latency-class decode/KV
+    # traffic in the DRR scheduler).
+    publish_class: str = "bulk"
     # ---- MoE / pipeline workloads (docs/DESIGN.md "Workloads") -----------
     # Default Zipf skew exponent for the MoE workload's expert routing
     # (tpunet.workloads.moe): 0 = uniform expert popularity, larger = more
@@ -602,5 +616,19 @@ class Config:
             readmit_probe_ms=_env_int_checked(
                 ("TPUNET_READMIT_PROBE_MS",), 500, 1,
                 "re-admission probe interval",
+            ),
+            # Swap knobs: a zero deadline would abort every publication on
+            # arrival and a zero chunk would never move a byte — loud
+            # config errors, not silent wedges.
+            swap_timeout_ms=_env_int_checked(
+                ("TPUNET_SWAP_TIMEOUT_MS",), 30_000, 1, "weight-swap deadline"
+            ),
+            swap_chunk_bytes=_env_int_checked(
+                ("TPUNET_SWAP_CHUNK_BYTES",), 1 << 20, 4 << 10,
+                "weight-broadcast chunk size", maximum=1 << 30,
+            ),
+            publish_class=_env_choice(
+                "TPUNET_PUBLISH_CLASS", "bulk", _QOS_CLASSES,
+                "weight-publication QoS class",
             ),
         )

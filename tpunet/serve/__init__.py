@@ -19,6 +19,10 @@ Layers (docs/DESIGN.md "Serving tier"):
             failover (replay-from-KV / re-prefill), TTFT/TPOT SLO export
   decode    DecodeWorker — the decode rank's serve loop (adopts shipped
             KV into BatchServer slots, never re-prefills)
+  publish   WeightPublisher/WeightReceiver — zero-downtime live weight
+            updates: version-stamped checkpoint hot-swap over a
+            bulk-class tree broadcast, flipped only behind a fleet-wide
+            CRC32C gate and only at request boundaries
 
 Minimal two-process setup::
 
@@ -36,7 +40,8 @@ Minimal two-process setup::
     tokens = router.run()[rid]
 
 Env knobs (registered in Config.from_env): TPUNET_KV_WIRE_DTYPE,
-TPUNET_ROUTER_POLICY, TPUNET_SERVE_ROLE.
+TPUNET_ROUTER_POLICY, TPUNET_SERVE_ROLE, TPUNET_SWAP_TIMEOUT_MS,
+TPUNET_SWAP_CHUNK_BYTES, TPUNET_PUBLISH_CLASS.
 """
 
 from tpunet.serve.decode import DecodeWorker, connect as connect_decode  # noqa: F401
@@ -57,9 +62,21 @@ from tpunet.serve.protocol import (  # noqa: F401
     NoLiveDecodeRankError,
     RouterBusyError,
     ServeError,
+    SwapAnnounce,
     TierMismatchError,
     TierProtocolError,
     wire_decode,
     wire_frontend,
+)
+from tpunet.serve.publish import (  # noqa: F401
+    WeightPublisher,
+    WeightReceiver,
+    WeightSwapError,
+    flatten_params,
+    parse_swap_script,
+    roundtrip_params,
+    swap_action,
+    swap_pending,
+    unflatten_params,
 )
 from tpunet.serve.router import Router  # noqa: F401

@@ -1,49 +1,103 @@
 """Decode tier: the BatchServer slot machine fed by shipped KV blocks.
 
-A DecodeWorker owns one BatchServer and one FrameLink to the frontend. Its
-serve loop is single-threaded and non-blocking: drain arriving BLOCK
-frames (decode the KV wire, ``submit_kv`` — never a re-prefill), advance
-every live slot one window, then report — a FIRST frame the moment a
-request's first token commits (the router's TTFT stamp) and a RESULT frame
-with the full token array and the measured TPOT when it retires. Requests
-are never streamed token-by-token across the DCN: a request either
-completes with its whole (exact) output or it doesn't report at all and
-the router replays it elsewhere — the invariant that makes decode-rank
-death unable to corrupt or truncate a stream.
+A DecodeWorker owns one BatchServer PER RESIDENT CHECKPOINT VERSION and
+one FrameLink to the frontend. Its serve loop is single-threaded and
+non-blocking: drain arriving BLOCK frames (decode the KV wire,
+``submit_kv`` — never a re-prefill), advance every live slot one window,
+then report — a FIRST frame the moment a request's first token commits
+(the router's TTFT stamp) and a RESULT frame with the full token array
+and the measured TPOT when it retires. Requests are never streamed
+token-by-token across the DCN: a request either completes with its whole
+(exact) output or it doesn't report at all and the router replays it
+elsewhere — the invariant that makes decode-rank death unable to corrupt
+or truncate a stream.
+
+**Live weight updates** (docs/DESIGN.md "Live weight updates") ride the
+same loop: a T_SWAP_BEGIN frame arms a ``WeightReceiver`` that is pumped
+ONE bounded unit per pass (the bulk-class broadcast never parks latency
+traffic); once the received bytes pass the fleet-wide CRC gate, the new
+BatchServer is built AND jit-warmed on a background thread while the old
+version keeps serving, and the flip lands between loop iterations — a
+request boundary by construction. Each in-flight request stays pinned to
+the version that prefilled it (the T_BLOCK aux word); old versions serve
+their pinned sessions until the frontend's T_SWAP_RETIRE and the local
+drain both agree they're done. Any swap failure raises the typed
+``WeightSwapError`` path internally, reports SWAP_ABORTED, and the
+previous version keeps serving — never a hang, never a half-flip.
 """
 
 from __future__ import annotations
 
+import os
+import signal
+import threading
 import time
+from functools import partial
+
+import numpy as np
 
 from tpunet import telemetry, transport
 from tpunet.models.serve import BatchServer
 from tpunet.serve import kv as kv_mod
 from tpunet.serve import protocol as proto
+from tpunet.serve import publish as publish_mod
+from tpunet.serve.publish import WeightReceiver, WeightSwapError
 
 
 class DecodeWorker:
-    """Serve loop around a BatchServer for one decode rank."""
+    """Serve loop around per-version BatchServers for one decode rank."""
 
     def __init__(self, model, params, link: proto.FrameLink, *,
                  slots: int, max_len: int, kv_codec: str = "int8",
-                 **server_kwargs):
+                 weight_version: int = 0, **server_kwargs):
         if kv_codec not in kv_mod.KV_CODECS:
             raise ValueError(f"unknown KV wire codec {kv_codec!r}")
         self._net = None  # set by connect(): the engine this worker owns
         self.link = link
         self.kv_codec = kv_codec
-        self.srv = BatchServer(model, params, slots=slots, max_len=max_len,
-                               on_first_token=self._on_first,
-                               **server_kwargs)
-        self._router_id: dict[int, int] = {}  # local id -> router req id
-        self._t_first: dict[int, float] = {}
-        self._first_pending: list[int] = []
-        self.stats = {"blocks": 0, "results": 0}
+        self._model = model
+        self._slots = slots
+        self._max_len = max_len
+        self._server_kwargs = server_kwargs
+        self.version = int(weight_version)
+        self._params = {self.version: params}
+        self._servers = {
+            self.version: self._build_server(self.version, params)}
+        # (version, local id) -> router req id: BatchServer local ids
+        # restart at 0 per instance, so the version is part of the key.
+        self._router_id: dict[tuple[int, int], int] = {}
+        self._t_first: dict[tuple[int, int], float] = {}
+        self._first_pending: list[tuple[int, int]] = []
+        # Live-swap state: the pumped receiver, the background build/warm
+        # of the next server, versions the frontend says may retire, and
+        # the scripted-chaos step counter.
+        self._receiver: WeightReceiver | None = None
+        self._receiver_token = 0
+        self._flip = None  # (version, token, thread, result box, t0)
+        self._retiring: set[int] = set()
+        self._corrupt_next = False
+        self._swap_step = 0
+        self.stats = {"blocks": 0, "results": 0, "swaps": 0,
+                      "swap_aborts": 0}
+        telemetry.weight_version(self.version)
 
-    def _on_first(self, local_id: int) -> None:
-        self._t_first[local_id] = time.monotonic()
-        self._first_pending.append(local_id)
+    @property
+    def srv(self) -> BatchServer:
+        """The CURRENT version's server (compat surface — pinned traffic
+        may still be running on older resident versions)."""
+        return self._servers[self.version]
+
+    def _build_server(self, version: int, params) -> BatchServer:
+        return BatchServer(self._model, params, slots=self._slots,
+                           max_len=self._max_len,
+                           on_first_token=partial(self._on_first, version),
+                           **self._server_kwargs)
+
+    def _on_first(self, version: int, local_id: int) -> None:
+        self._t_first[(version, local_id)] = time.monotonic()
+        self._first_pending.append((version, local_id))
+
+    # -- frame ingestion -----------------------------------------------------
 
     def _ingest(self) -> tuple[bool, bool]:
         """Drain available frames; returns (progressed, shutdown_seen)."""
@@ -53,47 +107,184 @@ class DecodeWorker:
             if frame is None:
                 return progressed, shutdown
             progressed = True
-            ftype, rid, payload, _aux = frame
+            ftype, rid, payload, aux = frame
             if ftype == proto.T_BLOCK:
                 prompt, max_new, n_kv, logits, wire = proto.unpack_block(
                     payload, self.kv_codec)
-                shapes = self.srv.kv_leaf_shapes(len(prompt))
+                # aux pins the request to the version that prefilled it;
+                # fall back to current if that version already retired
+                # here (the router only replays onto resident versions in
+                # practice — this is the never-drop belt).
+                ver = aux if aux in self._servers else self.version
+                srv = self._servers[ver]
+                shapes = srv.kv_leaf_shapes(len(prompt))
                 if kv_mod.kv_block_elems(shapes) != n_kv:
                     raise proto.TierProtocolError(
                         f"BLOCK for request {rid} carries {n_kv} KV "
                         f"elements; this model/prompt-length expects "
                         f"{kv_mod.kv_block_elems(shapes)}")
                 rows = kv_mod.decode_kv_block(wire, self.kv_codec, shapes)
-                local = self.srv.submit_kv(prompt, max_new, rows, logits)
-                self._router_id[local] = rid
+                local = srv.submit_kv(prompt, max_new, rows, logits)
+                self._router_id[(ver, local)] = rid
                 self.stats["blocks"] += 1
+            elif ftype == proto.T_SWAP_BEGIN:
+                self._begin_swap(rid, payload)
+            elif ftype == proto.T_SWAP_RETIRE:
+                self._retiring.add(aux)
             elif ftype == proto.T_SHUTDOWN:
                 shutdown = True
             else:
                 raise proto.TierProtocolError(
                     f"decode tier got unexpected frame type {ftype}")
 
-    def _report(self, finished: list[dict]) -> None:
+    def _report(self, finished_by_ver: list[tuple[int, list[dict]]]) -> None:
         # FIRST frames go out before any RESULT so the router's TTFT stamp
         # for a request always precedes its completion.
-        for local in self._first_pending:
-            rid = self._router_id.get(local)
+        for key in self._first_pending:
+            rid = self._router_id.get(key)
             if rid is not None:
                 self.link.send_frame(proto.T_FIRST, rid)
         self._first_pending.clear()
-        for rec in finished:
-            rid = self._router_id.pop(rec["id"], None)
-            if rid is None:
+        for ver, finished in finished_by_ver:
+            for rec in finished:
+                rid = self._router_id.pop((ver, rec["id"]), None)
+                if rid is None:
+                    continue  # warmup dummy or already-replayed request
+                t_first = self._t_first.pop((ver, rec["id"]), None)
+                ntok = len(rec["tokens"])
+                tpot_us = 0
+                if t_first is not None and ntok > 1:
+                    tpot_us = int(
+                        (time.monotonic() - t_first) / (ntok - 1) * 1e6)
+                self.link.send_frame(
+                    proto.T_RESULT, rid,
+                    proto.pack_result(rec["tokens"], 0, tpot_us))
+                self.stats["results"] += 1
+
+    # -- live weight updates -------------------------------------------------
+
+    def _begin_swap(self, token: int, payload: bytes) -> None:
+        ann = proto.unpack_swap_begin(payload)
+        if self._receiver is not None:
+            # A retry superseded the in-flight attempt: drop it silently
+            # (the publisher already abandoned its token — an ABORTED
+            # status would be noise it must ignore anyway).
+            self._receiver.abort()
+            self.stats["swap_aborts"] += 1
+        self._receiver = WeightReceiver(
+            ann, self._params[self.version], corrupt=self._corrupt_next)
+        self._receiver_token = token
+        self._corrupt_next = False
+
+    def _status(self, token: int, verdict: int) -> None:
+        try:
+            self.link.send_frame(proto.T_SWAP_STATUS, token, aux=verdict)
+        except Exception:  # noqa: BLE001 — a dead frontend ends us anyway
+            pass
+
+    def _pump_swap(self) -> bool:
+        """One bounded unit of swap work per loop pass. Never raises: a
+        failed swap reports ABORTED and the old version keeps serving."""
+        progressed = False
+        if self._receiver is not None:
+            recv, token = self._receiver, self._receiver_token
+            try:
+                ready = recv.pump()
+            except WeightSwapError:
+                self._receiver = None
+                self.stats["swap_aborts"] += 1
+                self._status(token, proto.SWAP_ABORTED)
+                return True
+            progressed = True
+            if ready:
+                # Verified bytes staged: build + jit-warm the new server
+                # on a background thread so the old version keeps serving
+                # through the compile. The flip itself lands in
+                # _pump_swap on a later pass — a request boundary.
+                self._receiver = None
+                box: dict = {}
+                thread = threading.Thread(
+                    target=self._build_and_warm,
+                    args=(recv.version, recv.staged, box),
+                    name=f"tpunet-flip-v{recv.version}", daemon=True)
+                thread.start()
+                self._flip = (recv.version, token, thread, box,
+                              time.monotonic())
+        if self._flip is not None and not self._flip[2].is_alive():
+            version, token, thread, box, t0 = self._flip
+            thread.join()
+            self._flip = None
+            progressed = True
+            if "err" in box:
+                self.stats["swap_aborts"] += 1
+                telemetry.swap_event("abort")
+                self._status(token, proto.SWAP_ABORTED)
+            else:
+                self._servers[version] = box["srv"]
+                self._params[version] = box["params"]
+                self.version = version
+                telemetry.weight_version(version)
+                telemetry.swap_observe(
+                    "flip", int((time.monotonic() - t0) * 1e6))
+                telemetry.swap_event("commit")
+                self.stats["swaps"] += 1
+                self._status(token, proto.SWAP_FLIPPED)
+        return progressed
+
+    def _build_and_warm(self, version: int, params, box: dict) -> None:
+        """Background thread: build the next version's BatchServer and
+        drive one throwaway request through it so the adopt/decode jit
+        paths are compiled BEFORE the flip — the serving loop never pays
+        the compile."""
+        try:
+            srv = self._build_server(version, params)
+            plen = 1
+            rows = [np.zeros(s, np.float32)
+                    for s in srv.kv_leaf_shapes(plen)]
+            logits = np.zeros(self._model.vocab, np.float32)
+            srv.submit_kv(np.zeros(plen, np.int32), 4, rows, logits)
+            while srv._live or srv._pending:
+                srv.step()  # finished dummy has no router id — dropped
+            box["srv"] = srv
+            box["params"] = params
+        except BaseException as e:  # noqa: BLE001 — surfaced as ABORTED
+            box["err"] = e
+
+    def _poll_chaos(self) -> None:
+        """Scripted swap chaos (swap:at_step=N:action=..., fault.cc): the
+        decode side answers "die" (SIGKILL mid-swap — the router replays,
+        the publisher aborts and retries) and "corrupt" (flip a received
+        byte — the CRC gate must refuse fleet-wide). "publish" verdicts
+        belong to the frontend and are ignored here."""
+        self._swap_step += 1
+        action = publish_mod.swap_action(self._swap_step)
+        if action == "die":
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif action == "corrupt":
+            if self._receiver is not None and not self._receiver.done:
+                self._receiver.corrupt = True
+            else:
+                self._corrupt_next = True
+
+    def _retire_drained(self) -> None:
+        """Drop retired versions once BOTH the frontend said retire AND no
+        local request is still pinned to them."""
+        for ver in list(self._retiring):
+            if ver == self.version:
+                self._retiring.discard(ver)  # never retire the live one
                 continue
-            t_first = self._t_first.pop(rec["id"], None)
-            ntok = len(rec["tokens"])
-            tpot_us = 0
-            if t_first is not None and ntok > 1:
-                tpot_us = int((time.monotonic() - t_first) / (ntok - 1) * 1e6)
-            self.link.send_frame(
-                proto.T_RESULT, rid,
-                proto.pack_result(rec["tokens"], 0, tpot_us))
-            self.stats["results"] += 1
+            srv = self._servers.get(ver)
+            if srv is None:
+                self._retiring.discard(ver)
+                continue
+            if (srv._live or srv._pending
+                    or any(k[0] == ver for k in self._router_id)):
+                continue  # still draining its pinned sessions
+            self._servers.pop(ver)
+            self._params.pop(ver, None)
+            self._retiring.discard(ver)
+
+    # -- the loop ------------------------------------------------------------
 
     def serve(self, *, idle_timeout: float | None = None,
               poll_interval: float = 0.001,
@@ -109,17 +300,25 @@ class DecodeWorker:
         draining = False
         idle_since = time.monotonic()
         while True:
+            self._poll_chaos()
             progressed, shutdown = self._ingest()
             draining = draining or shutdown
             if max_blocks is not None and self.stats["blocks"] >= max_blocks:
                 return
-            if self.srv._live or self.srv._pending:
-                finished = self.srv.step()
-                self._report(finished)
-                progressed = True
+            finished_by_ver = []
+            for ver, srv in list(self._servers.items()):
+                if srv._live or srv._pending:
+                    finished_by_ver.append((ver, srv.step()))
+                    progressed = True
+            if finished_by_ver or self._first_pending:
+                self._report(finished_by_ver)
+            progressed |= self._pump_swap()
+            self._retire_drained()
             telemetry.serve_queue_depth(
-                "decode", len(self.srv._live) + len(self.srv._pending))
-            if draining and not (self.srv._live or self.srv._pending):
+                "decode", sum(len(s._live) + len(s._pending)
+                              for s in self._servers.values()))
+            if draining and not any(s._live or s._pending
+                                    for s in self._servers.values()):
                 return
             if progressed:
                 idle_since = time.monotonic()
@@ -132,6 +331,9 @@ class DecodeWorker:
     def close(self) -> None:
         """Tear down the link (and the engine, when this worker owns one —
         the connect() path): comms closed, stream threads joined."""
+        if self._receiver is not None:
+            self._receiver.abort()
+            self._receiver = None
         self.link.close()
         if self._net is not None:
             self._net.close()
@@ -140,11 +342,13 @@ class DecodeWorker:
 
 def connect(addr, model, params, *, slots: int, max_len: int,
             kv_codec: str | None = None, timeout: float = 60.0,
-            net: transport.Net | None = None,
+            net: transport.Net | None = None, weight_version: int = 0,
             **server_kwargs) -> DecodeWorker:
     """Wire this process to a frontend at `addr` ("host:port" or tuple) as
     a decode rank and return the ready DecodeWorker. `kv_codec` None
-    defers to TPUNET_KV_WIRE_DTYPE (default int8)."""
+    defers to TPUNET_KV_WIRE_DTYPE (default int8). `weight_version` rides
+    the HELLO signature — a stale value (readmission after dying mid-swap)
+    is NOT a mismatch; the publisher catches the rank up."""
     from tpunet.config import Config
 
     if kv_codec is None:
@@ -154,10 +358,12 @@ def connect(addr, model, params, *, slots: int, max_len: int,
     # (see Router.__init__ on why the tier rides the latency lane).
     net = net or transport.Net(traffic_class="latency")
     hello = proto.Hello(proto.ROLE_DECODE, kv_codec, slots, max_len,
-                        model.vocab, kv_mod.model_signature(model))
+                        model.vocab, kv_mod.model_signature(model),
+                        weight_version=weight_version)
     link = proto.wire_decode(addr, net, hello, timeout=timeout)
     worker = DecodeWorker(model, params, link, slots=slots, max_len=max_len,
-                          kv_codec=kv_codec, **server_kwargs)
+                          kv_codec=kv_codec, weight_version=weight_version,
+                          **server_kwargs)
     if owns_net:
         worker._net = net  # close() tears the engine down with the link
     return worker

@@ -1,0 +1,720 @@
+"""Live weight updates: version-stamped hot-swap over the transport itself.
+
+A running fleet must adopt a new checkpoint without dropping a request.
+The publication path reuses the stack's own primitives end to end
+(docs/DESIGN.md "Live weight updates"):
+
+**Control plane on the latency links.** The frontend announces a swap with
+a T_SWAP_BEGIN frame per decode rank (version, broadcast shape, chunk
+size, wire codec, QoS class, rendezvous coordinator, deadline) on the
+SAME latency-class tier links that carry requests — a few hundred bytes,
+invisible to the schedulers. Receivers answer with T_SWAP_STATUS
+(flipped/aborted) and the frontend retires drained versions with
+T_SWAP_RETIRE.
+
+**Weight bytes on the bulk class.** The checkpoint itself is flattened to
+one f32 vector, encoded once under the bf16 wire codec, and chunk-streamed
+through a binomial-tree ``Communicator.broadcast`` wired on the BULK QoS
+class (``TPUNET_PUBLISH_CLASS``) — so the existing DRR scheduler keeps the
+latency-class decode/KV traffic's p99 while gigabytes of weights flow.
+The publisher interleaves its pump callback (``Router.poll``) between
+chunks; receivers pump ONE chunk per serve-loop pass — neither side ever
+parks its serving loop on the fat transfer.
+
+**Flip only on proof, only at a request boundary.** After the last chunk,
+every participant CRC32C-hashes the wire bytes it holds and all-gathers
+the digests: the verdict is computed locally but identically on every
+rank, so ONE corrupt receiver refuses the flip FLEET-WIDE with zero extra
+frames. Only a verified rank stages the decoded parameters and flips —
+between serve-loop iterations, never under a half-stepped batch. Every
+failure path (death mid-broadcast, digest disagreement, deadline) raises
+the typed retryable ``WeightSwapError`` (-10); the previous version keeps
+serving throughout.
+
+**Mixed-version pools are legal.** Each request is pinned at admission to
+the version that prefilled it (the version rides the T_BLOCK aux word and
+the HELLO signature's upper bytes); old versions serve their pinned
+sessions until drained, then retire. A rank that rejoins stale (death
+mid-swap) is caught up by a world=2 re-publication of the retained wire.
+
+Scripted chaos composes: ``swap:at_step=N:action=publish|corrupt|die``
+segments ride TPUNET_FAULT_SPEC next to ``churn`` ones; this module holds
+the Python poll/parse mirror of the native slot (fault.cc).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import socket
+import sys
+import threading
+import time
+
+_DEBUG = bool(os.environ.get("TPUNET_SWAP_DEBUG"))
+
+
+def _dbg(msg: str) -> None:
+    if _DEBUG:
+        print(f"[swapdbg {time.monotonic():.3f}] {msg}",
+              file=sys.stderr, flush=True)
+
+import numpy as np
+
+from tpunet import _native, telemetry, transport
+from tpunet._native import WeightSwapError
+from tpunet.collectives import Communicator
+from tpunet.serve import protocol as proto
+from tpunet.serve.prefill import PrefillEngine
+
+__all__ = [
+    "WeightPublisher", "WeightReceiver", "WeightSwapError", "flatten_params",
+    "parse_swap_script", "roundtrip_params", "swap_action", "swap_pending",
+    "unflatten_params",
+]
+
+_SWAP_ACTIONS = {0: None, 1: "publish", 2: "corrupt", 3: "die"}
+
+_ERR = _native.TPUNET_ERR_WEIGHT_SWAP
+
+# How long past the swap deadline the publisher keeps pumping after
+# force-closing the comm under a parked broadcast thread before it
+# ABANDONS the (daemon) thread and raises typed. A peer SIGKILLed at the
+# wrong instant can wedge the native collective in a state even close()
+# cannot error out of; that must cost one leaked thread, never the
+# serving loop.
+_CAST_ABANDON_GRACE_S = 5.0
+
+
+# -- scripted swap chaos (Python mirror of cpp/src/fault.cc) -----------------
+
+
+def swap_action(step: int) -> str | None:
+    """One-shot poll of the armed swap script (TPUNET_FAULT_SPEC /
+    tpunet_c_fault_inject): the first un-fired ``swap:`` event with
+    at_step <= step fires; returns "publish" (frontend: publish the staged
+    checkpoint NOW), "corrupt" (decode: flip a byte of the received wire
+    before digesting — the CRC-refusal drill), "die" (decode: SIGKILL
+    yourself mid-swap) or None. Fired latches persist until DisarmFault."""
+    lib = _native.load()
+    code = int(lib.tpunet_c_swap_poll(int(step)))
+    if code < 0:
+        raise _native.NativeError(code, "swap_poll")
+    return _SWAP_ACTIONS.get(code)
+
+
+def swap_pending() -> int:
+    """Armed swap events not yet fired (a finished scripted run must
+    report 0 — the smoke lane's completeness gate)."""
+    lib = _native.load()
+    return int(lib.tpunet_c_swap_pending())
+
+
+def parse_swap_script(spec: str) -> list[dict]:
+    """Python mirror of the native swap-segment parser for harness-side
+    scheduling (the native slot is poll-consuming; a harness that must know
+    the publish schedule up front parses the same spec non-destructively).
+    Returns [{"at_step", "action"}, ...] for the swap segments; churn and
+    classic fault segments are ignored. Raises ValueError on a malformed
+    swap segment, naming the offending token (the native parser rejects
+    the same specs through tpunet_c_fault_inject)."""
+    events: list[dict] = []
+    for seg in (spec or "").split(";"):
+        if not seg:
+            continue
+        clauses = seg.split(":")
+        if clauses[0] != "swap":
+            continue  # churn / classic fault segment — not ours
+        ev: dict = {"at_step": 0, "action": None}
+        for clause in clauses[1:]:
+            key, eq, val = clause.partition("=")
+            if not eq:
+                raise ValueError(
+                    f"swap spec: clause {clause!r} is not key=value")
+            if key == "at_step":
+                ev["at_step"] = int(val)
+            elif key == "action":
+                if val not in ("publish", "corrupt", "die"):
+                    raise ValueError(
+                        f"swap spec: unknown action {val!r} (want publish, "
+                        f"corrupt or die)")
+                ev["action"] = val
+            else:
+                raise ValueError(f"swap spec: unknown key {key!r}")
+        if ev["action"] is None:
+            raise ValueError(f"swap spec: missing action= clause in {seg!r}")
+        events.append(ev)
+    return events
+
+
+# -- parameter <-> wire helpers ----------------------------------------------
+
+
+def flatten_params(params) -> np.ndarray:
+    """Flatten a parameter pytree to ONE C-contiguous f32 vector in
+    tree-canonical leaf order — the unit the broadcast ships."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(params)
+    if not leaves:
+        return np.zeros(0, np.float32)
+    return np.concatenate(
+        [np.asarray(leaf, np.float32).ravel() for leaf in leaves])
+
+
+def unflatten_params(template, flat: np.ndarray):
+    """Rebuild a pytree with `template`'s structure/shapes/dtypes from the
+    flat f32 vector (the receiver's own tree is the shape authority — the
+    wire carries no structure, the HELLO model signature already pinned
+    it)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    out, off = [], 0
+    for leaf in leaves:
+        n = int(np.prod(leaf.shape)) if leaf.shape else 1
+        if off + n > flat.size:
+            raise WeightSwapError(
+                _ERR, f"flat parameter vector has {flat.size} elements; "
+                f"template needs more (truncated publication?)")
+        out.append(jnp.asarray(
+            np.asarray(flat[off:off + n]).reshape(leaf.shape), leaf.dtype))
+        off += n
+    if off != flat.size:
+        raise WeightSwapError(
+            _ERR, f"flat parameter vector has {flat.size} elements; "
+            f"template consumes only {off}")
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def roundtrip_params(params, codec: str = "bf16"):
+    """Params as EVERY rank will hold them after a publication under
+    `codec`: encode once, decode once, rebuild. The frontend's new
+    PrefillEngine must be built from THIS (not the pristine checkpoint) so
+    prefill and decode tiers stay bitwise identical — the same contract
+    single-version serving already pins."""
+    flat = flatten_params(params)
+    wire = transport.codec_encode(flat, codec)
+    return unflatten_params(
+        params, transport.codec_decode(wire, codec, flat.size))
+
+
+@contextlib.contextmanager
+def _bounded_bootstrap(deadline: float):
+    """Clamp the rendezvous bootstrap to the REMAINING swap budget.
+
+    The bootstrap's own default (TPUNET_BOOTSTRAP_TIMEOUT_MS, 120s) is
+    sized for training jobs where rank 0 may start minutes after its
+    peers. A swap rendezvous is the opposite regime: the coordinator
+    binds milliseconds after the announce, so a member that hasn't joined
+    within the swap deadline is dead (or the attempt was abandoned) — and
+    a 120s park here would wedge the SERVING loop of whoever waits, which
+    is exactly what a live update must never do. The native layer reads
+    the knob per rendezvous, so a scoped env override is race-free within
+    one process's serve loop."""
+    remaining_ms = max(1, int((deadline - time.monotonic()) * 1e3))
+    prev = os.environ.get("TPUNET_BOOTSTRAP_TIMEOUT_MS")
+    os.environ["TPUNET_BOOTSTRAP_TIMEOUT_MS"] = str(remaining_ms)
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop("TPUNET_BOOTSTRAP_TIMEOUT_MS", None)
+        else:
+            os.environ["TPUNET_BOOTSTRAP_TIMEOUT_MS"] = prev
+
+
+def _ephemeral_coordinator(host: str = "127.0.0.1") -> str:
+    """Pick a fresh rendezvous address per swap attempt: bind :0, read the
+    port, release it. A retry NEVER reuses the previous attempt's
+    coordinator, so a receiver stuck in an abandoned rendezvous cannot
+    cross-talk with the new one (it times out on the old address)."""
+    s = socket.socket()
+    try:
+        s.bind((host, 0))
+        return f"{host}:{s.getsockname()[1]}"
+    finally:
+        s.close()
+
+
+# -- receiver (decode rank) --------------------------------------------------
+
+
+class WeightReceiver:
+    """Pumped receive half of one publication on a decode rank.
+
+    ``pump()`` does ONE bounded unit of work per call — wire the bulk-class
+    comm on the first pass, receive one broadcast chunk per later pass,
+    digest + all-gather after the last — so the owning serve loop keeps
+    draining latency traffic between passes. Returns True once ``staged``
+    holds the verified, decoded parameter pytree (the caller flips at its
+    next request boundary); raises ``WeightSwapError`` on ANY failure
+    (deadline, transport death, digest disagreement) with the comm closed
+    and nothing staged — the previous version keeps serving."""
+
+    def __init__(self, ann: proto.SwapAnnounce, template, *,
+                 corrupt: bool = False):
+        self.ann = ann
+        self.version = ann.version
+        #: Chaos hook ("swap:...:action=corrupt"): flip one byte of the
+        #: received wire before digesting — MUST make every rank refuse.
+        self.corrupt = corrupt
+        self._template = template
+        self._comm: Communicator | None = None
+        self._nwire = transport.codec_wire_bytes(ann.codec, ann.nelems)
+        self._nchunks = max(
+            1, -(-self._nwire // max(1, ann.chunk_bytes)))
+        self._parts: list[np.ndarray] = []
+        self._next = 0
+        self._t_phase = time.monotonic()
+        self._deadline = self._t_phase + ann.timeout_ms / 1e3
+        self.staged = None
+        self.done = False
+
+    def _lap(self) -> int:
+        now = time.monotonic()
+        us = int((now - self._t_phase) * 1e6)
+        self._t_phase = now
+        return us
+
+    def abort(self) -> None:
+        """Discard everything; the old version keeps serving. Idempotent."""
+        if self._comm is not None:
+            try:
+                self._comm.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+            self._comm = None
+        if not self.done:
+            self._parts.clear()
+            self.staged = None
+            telemetry.swap_event("abort")
+            self.done = True
+
+    def _fail(self, msg: str, cause: Exception | None = None):
+        self.abort()
+        err = WeightSwapError(
+            _ERR, f"weight swap to version {self.ann.version} aborted: "
+            f"{msg} — previous version keeps serving; the publisher "
+            f"retries or raises")
+        raise err from cause
+
+    def pump(self) -> bool:
+        """One bounded unit of receive work; True once staged is ready."""
+        if self.done:
+            return self.staged is not None
+        if time.monotonic() > self._deadline:
+            self._fail(f"deadline exceeded (TPUNET_SWAP_TIMEOUT_MS="
+                       f"{self.ann.timeout_ms})")
+        ann = self.ann
+        try:
+            if self._comm is None:
+                # Bulk-class comm, EXPLICIT exact wire + pinned tree: the
+                # broadcast ships pre-encoded bytes, so the comm codec must
+                # be the identity regardless of TPUNET_WIRE_DTYPE.
+                with _bounded_bootstrap(self._deadline):
+                    self._comm = Communicator(
+                        ann.coordinator, ann.rank, ann.world,
+                        wire_dtype="f32", algo="tree",
+                        traffic_class=ann.traffic_class)
+                telemetry.swap_observe("announce", self._lap())
+                return False
+            if self._next < self._nchunks:
+                lo = self._next * ann.chunk_bytes
+                hi = min(self._nwire, lo + ann.chunk_bytes)
+                self._parts.append(self._comm.broadcast(
+                    np.zeros(hi - lo, np.uint8), root=0))
+                self._next += 1
+                if self._next < self._nchunks:
+                    return False
+                telemetry.swap_observe("broadcast", self._lap())
+            wire = (np.concatenate(self._parts) if len(self._parts) != 1
+                    else self._parts[0])
+            if self.corrupt:
+                wire = wire.copy()
+                wire[0] ^= 0xFF
+            digests = self._comm.all_gather(
+                np.array([transport.crc32c(wire)], np.uint32))
+            telemetry.swap_observe("verify", self._lap())
+            if len({int(d) for d in digests.ravel()}) != 1:
+                telemetry.swap_event("mismatch")
+                self._fail(
+                    "cross-rank CRC32C digest disagreement "
+                    f"({[hex(int(d)) for d in digests.ravel()]}) — flip "
+                    "refused FLEET-WIDE (every rank computed this same "
+                    "verdict locally)")
+        except _native.NativeError as e:
+            if isinstance(e, WeightSwapError):
+                raise
+            self._fail(f"transport failure mid-broadcast ({e})", e)
+        flat = transport.codec_decode(wire, ann.codec, ann.nelems)
+        self.staged = unflatten_params(self._template, flat)
+        self.done = True
+        comm, self._comm = self._comm, None
+        comm.close()
+        return True
+
+
+# -- publisher (frontend) ----------------------------------------------------
+
+
+class WeightPublisher:
+    """Frontend half: announce, broadcast, verify, await flips, install.
+
+    Drives one publication at a time against the owning ``Router``'s live
+    rank pool. ``publish()`` blocks until the whole fleet flipped (calling
+    `pump` — default ``router.poll`` — between broadcast chunks and while
+    awaiting flips, so the latency tier keeps draining), retrying up to
+    `retries` times on a typed abort; it retains the encoded wire so
+    ``catch_up()`` can re-publish to a rank that rejoins stale after dying
+    mid-swap."""
+
+    def __init__(self, router, *, codec: str = "bf16",
+                 timeout_ms: int | None = None,
+                 chunk_bytes: int | None = None,
+                 publish_class: str | None = None,
+                 coordinator_host: str = "127.0.0.1"):
+        from tpunet.config import Config
+
+        cfg = Config.from_env()
+        if codec not in ("f32", "bf16"):
+            raise ValueError(
+                f"weight wire codec must be f32 or bf16, got {codec!r} "
+                f"(int8 KV blocks carry per-block scales; whole-checkpoint "
+                f"int8 does not)")
+        self.router = router
+        self.codec = codec
+        self.timeout_ms = int(timeout_ms or cfg.swap_timeout_ms)
+        self.chunk_bytes = int(chunk_bytes or cfg.swap_chunk_bytes)
+        self.publish_class = publish_class or cfg.publish_class
+        self._host = coordinator_host
+        self._retained: tuple[int, np.ndarray, int] | None = None
+        # Attempt sequence: BEGIN/STATUS frames carry (seq << 32) | version
+        # as their req_id, so a LATE aborted-status from an abandoned
+        # attempt can never poison the retry that superseded it.
+        self._seq = 0
+        #: Introspection: the live attempt's phase — None when idle, else
+        #: "announce" -> "broadcast" -> "verify" -> "flip". Written by the
+        #: publishing thread, safe to READ from anywhere (harnesses use it
+        #: to schedule chaos deterministically mid-transfer).
+        self.phase: str | None = None
+        self.stats = {"publishes": 0, "commits": 0, "aborts": 0,
+                      "retries": 0, "catch_ups": 0}
+
+    # -- one attempt ---------------------------------------------------------
+
+    def _settle(self, pump, window_s: float = 0.1) -> None:
+        """Pump long enough for the transport engine to surface a dead
+        peer's EOF on its tier link (~10ms observed on loopback; the
+        window is 10x that) so the next attempt's target set excludes
+        ranks that died during the failed one. A single pump() is NOT
+        enough: an abort lands milliseconds after the death, before the
+        engine has flagged the link, and re-announcing to the corpse
+        parks the rendezvous on the bootstrap timeout with the serving
+        loop wedged behind it."""
+        t_end = time.monotonic() + window_s
+        while time.monotonic() < t_end:
+            pump()
+            time.sleep(0.002)
+
+    def _broadcast_to(self, targets, version: int, token: int,
+                      wire: np.ndarray, nelems: int, deadline: float,
+                      pump, comm_box: dict | None = None) -> None:
+        """Announce + bulk-class tree broadcast + CRC all-gather against
+        `targets` (live _Ranks). Raises WeightSwapError on any failure.
+        `comm_box`, when given, exposes the live comm under "comm" so a
+        supervising thread can force-close it past the deadline."""
+        self.phase = "announce"
+        t_phase = time.monotonic()
+        world = len(targets) + 1
+        coord = _ephemeral_coordinator(self._host)
+        _dbg(f"announce targets={[r.index for r in targets]} coord={coord} "
+             f"version={version}")
+        for i, rank in enumerate(targets):
+            ann = proto.SwapAnnounce(
+                version, world, i + 1, nelems, self.chunk_bytes, self.codec,
+                self.timeout_ms, coord, traffic_class=self.publish_class)
+            try:
+                rank.link.send_frame(proto.T_SWAP_BEGIN, token,
+                                     proto.pack_swap_begin(ann))
+            except (_native.NativeError, TimeoutError, OSError) as e:
+                self.router._fail_rank(rank, e)
+                raise WeightSwapError(
+                    _ERR, f"swap announce to decode rank {rank.index} "
+                    f"failed ({e}) — rank reaped, publication aborted"
+                ) from e
+        comm = None
+        try:
+            _dbg("ctor begin")
+            with _bounded_bootstrap(deadline):
+                comm = Communicator(coord, 0, world, wire_dtype="f32",
+                                    algo="tree",
+                                    traffic_class=self.publish_class)
+            _dbg("ctor done")
+            if comm_box is not None:
+                comm_box["comm"] = comm
+            self.phase = "broadcast"
+            telemetry.swap_observe(
+                "announce", int((time.monotonic() - t_phase) * 1e6))
+            t_phase = time.monotonic()
+            nwire = int(wire.size)
+            nchunks = max(1, -(-nwire // max(1, self.chunk_bytes)))
+            for c in range(nchunks):
+                if time.monotonic() > deadline:
+                    raise WeightSwapError(
+                        _ERR, f"weight broadcast exceeded "
+                        f"TPUNET_SWAP_TIMEOUT_MS={self.timeout_ms} at chunk "
+                        f"{c}/{nchunks}")
+                lo = c * self.chunk_bytes
+                comm.broadcast(wire[lo:min(nwire, lo + self.chunk_bytes)],
+                               root=0)
+                _dbg(f"chunk {c}/{nchunks} sent")
+                pump()  # latency tier keeps draining between bulk chunks
+            self.phase = "verify"
+            telemetry.swap_observe(
+                "broadcast", int((time.monotonic() - t_phase) * 1e6))
+            t_phase = time.monotonic()
+            digests = comm.all_gather(
+                np.array([transport.crc32c(wire)], np.uint32))
+            telemetry.swap_observe(
+                "verify", int((time.monotonic() - t_phase) * 1e6))
+            if len({int(d) for d in digests.ravel()}) != 1:
+                telemetry.swap_event("mismatch")
+                raise WeightSwapError(
+                    _ERR, "cross-rank CRC32C digest disagreement "
+                    f"({[hex(int(d)) for d in digests.ravel()]}) — flip "
+                    "refused FLEET-WIDE; no rank staged these bytes")
+        except _native.NativeError as e:
+            if isinstance(e, WeightSwapError):
+                raise
+            raise WeightSwapError(
+                _ERR, f"weight broadcast to version {version} failed "
+                f"mid-flight ({e}) — receivers abort and keep serving the "
+                f"previous version") from e
+        finally:
+            if comm is not None:
+                comm.close()
+
+    def _supervised_cast(self, targets, version: int, token: int,
+                         wire: np.ndarray, nelems: int, deadline: float,
+                         pump) -> None:
+        """Run ``_broadcast_to`` on a background thread while THIS thread
+        keeps pumping the serve loop. Past the deadline the live comm is
+        force-closed under the thread (a blocking collective then fails
+        fast); if the native layer STILL hasn't surfaced an error a grace
+        window later — a SIGKILLed peer can wedge a collective beyond
+        close()'s reach — the daemon thread is abandoned and the attempt
+        raises typed. The abandoned attempt's token is superseded by the
+        retry's, so even a zombie that eventually reports cannot poison a
+        later attempt."""
+        cast_box: dict = {}
+
+        def _run_broadcast() -> None:
+            try:
+                self._broadcast_to(targets, version, token, wire, nelems,
+                                   deadline, pump=lambda: None,
+                                   comm_box=cast_box)
+                cast_box["ok"] = True
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                cast_box["err"] = e
+
+        caster = threading.Thread(
+            target=_run_broadcast,
+            name=f"tpunet-publish-v{version}", daemon=True)
+        caster.start()
+        closed = False
+        while caster.is_alive():
+            now = time.monotonic()
+            if now > deadline and not closed:
+                # The thread checks the deadline between chunks but can
+                # park inside a blocking collective; closing the comm
+                # under it fails that op fast.
+                comm = cast_box.get("comm")
+                if comm is not None:
+                    closed = True
+                    try:
+                        comm.close()
+                    except Exception:  # noqa: BLE001 — teardown
+                        pass
+            if now > deadline + _CAST_ABANDON_GRACE_S:
+                _dbg(f"abandoning parked broadcast thread for v{version}")
+                raise WeightSwapError(
+                    _ERR, f"weight broadcast to version {version} still "
+                    f"parked {_CAST_ABANDON_GRACE_S:.0f}s past "
+                    f"TPUNET_SWAP_TIMEOUT_MS={self.timeout_ms} with its "
+                    f"comm closed — native collective wedged (peer died "
+                    f"mid-operation); thread abandoned, attempt aborted")
+            pump()
+            time.sleep(0.001)
+        caster.join()
+        if "err" in cast_box:
+            raise cast_box["err"]
+
+    def _await_flips(self, targets, version: int, token: int,
+                     deadline: float, pump) -> None:
+        """Poll the router until every surviving target reported FLIPPED
+        for THIS attempt's token. An ABORTED verdict or a fully-dead
+        target set raises; a target that dies after the broadcast is
+        dropped from the wait (it will be caught up on readmission)."""
+        want = {rank.index: rank for rank in targets}
+        while True:
+            pump()
+            status = self.router._swap_status
+            aborted = sorted(
+                i for i in want if status.get((i, token)) == "aborted")
+            if aborted:
+                raise WeightSwapError(
+                    _ERR, f"decode rank(s) {aborted} aborted the swap to "
+                    f"version {version} — flip refused fleet-wide")
+            alive = {i for i, rank in want.items() if rank.alive}
+            if not alive:
+                raise WeightSwapError(
+                    _ERR, f"every announced decode rank died during the "
+                    f"swap to version {version}")
+            if all(status.get((i, token)) == "flipped" for i in alive):
+                return
+            if time.monotonic() > deadline:
+                missing = sorted(
+                    i for i in alive
+                    if status.get((i, token)) != "flipped")
+                raise WeightSwapError(
+                    _ERR, f"decode rank(s) {missing} did not flip to "
+                    f"version {version} within TPUNET_SWAP_TIMEOUT_MS="
+                    f"{self.timeout_ms}")
+            time.sleep(0.001)
+
+    # -- public surface ------------------------------------------------------
+
+    def publish(self, version: int, params, *, retries: int = 2,
+                pump=None, warm_lengths=()) -> None:
+        """Publish checkpoint `version` (a parameter pytree shaped like the
+        serving model's) to every live decode rank and install the matching
+        bf16-roundtripped PrefillEngine frontend-side. Blocks until the
+        fleet flipped; on a typed abort the whole attempt retries (fresh
+        coordinator, reaped ranks dropped) up to `retries` times. The old
+        version keeps serving throughout and drains under session pinning
+        before it retires. `warm_lengths` pre-compiles the new prefill for
+        those prompt lengths before it goes live."""
+        if version <= self.router.version:
+            raise ValueError(
+                f"published version must increase: {version} <= current "
+                f"{self.router.version}")
+        pump = pump or self.router.poll
+        flat = flatten_params(params)
+        wire = transport.codec_encode(flat, self.codec)
+        # THIS thread never stops pumping. Both halves of a publication
+        # run on background threads — the bulk transfer (rendezvous +
+        # chunk stream + CRC all-gather: each step can block on the
+        # slowest receiver, which drains ONE chunk per serve pass) and
+        # the frontend engine build + jit warm (XLA compiles release the
+        # GIL). In-flight requests never pay the swap in their TTFT —
+        # the same bargain the decode flip makes. The builder starts
+        # ONCE, outside the retry loop: the engine depends only on the
+        # verified bytes, not on which attempt delivered them.
+        t_flip = time.monotonic()
+        rt = unflatten_params(params, transport.codec_decode(
+            wire, self.codec, flat.size))
+        old = self.router.prefill
+        box: dict = {}
+
+        def _build_and_warm() -> None:
+            try:
+                engine = PrefillEngine(
+                    old.model, rt, max_len=old.max_len,
+                    prefill_chunk=getattr(old, "_chunk", None))
+                for plen in warm_lengths:
+                    engine.prefill(np.zeros(int(plen), np.int32))
+                box["engine"] = engine
+            except BaseException as e:  # noqa: BLE001 — typed below
+                box["err"] = e
+
+        builder = threading.Thread(
+            target=_build_and_warm,
+            name=f"tpunet-prefill-v{version}", daemon=True)
+        builder.start()
+        attempt = 0
+        while True:
+            self.stats["publishes"] += 1
+            telemetry.swap_event("publish")
+            self._seq += 1
+            token = (self._seq << 32) | version
+            deadline = time.monotonic() + self.timeout_ms / 1e3
+            try:
+                targets = [r for r in self.router._ranks if r.alive]
+                if not targets:
+                    raise WeightSwapError(
+                        _ERR, "no live decode rank to publish to")
+                self._supervised_cast(targets, version, token, wire,
+                                      flat.size, deadline, pump)
+                self._await_flips(targets, version, token, deadline, pump)
+                self.phase = "flip"
+                while builder.is_alive():
+                    if time.monotonic() > deadline:
+                        raise WeightSwapError(
+                            _ERR, f"prefill build/warm for version "
+                            f"{version} exceeded TPUNET_SWAP_TIMEOUT_MS="
+                            f"{self.timeout_ms}")
+                    pump()
+                    time.sleep(0.001)
+                builder.join()
+                if "err" in box:
+                    raise WeightSwapError(
+                        _ERR, f"prefill build/warm for version {version} "
+                        f"failed ({box['err']})") from box["err"]
+                self.router.install_version(version, box["engine"])
+                telemetry.swap_observe(
+                    "flip", int((time.monotonic() - t_flip) * 1e6))
+                telemetry.swap_event("commit")
+                self.stats["commits"] += 1
+                self._retained = (version, wire, int(flat.size))
+                self.phase = None
+                return
+            except WeightSwapError as e:
+                _dbg(f"attempt {attempt} failed: {e}")
+                self.phase = None
+                self.stats["aborts"] += 1
+                attempt += 1
+                if attempt > retries:
+                    raise
+                telemetry.swap_event("retry")
+                self.stats["retries"] += 1
+                self._settle(pump)  # reap dead links before re-announcing
+                _dbg("post-retry alive="
+                     f"{[(r.index, r.alive) for r in self.router._ranks]}")
+
+    def catch_up(self, *, pump=None) -> int:
+        """Re-publish the retained current checkpoint to every live rank
+        that serves an older version (a host readmitted after dying
+        mid-swap announces its stale version in the HELLO). Each stale
+        rank gets its own world=2 broadcast of the SAME retained wire —
+        byte-identical to what the fleet verified, so the catch-up flip
+        passes the same CRC gate. Returns the number of ranks caught up;
+        raises WeightSwapError if a catch-up aborts."""
+        if self._retained is None:
+            return 0
+        version, wire, nelems = self._retained
+        pump = pump or self.router.poll
+        self._settle(pump)  # catch-up usually follows churn: reap first
+        caught = 0
+        try:
+            return self._catch_up_inner(version, wire, nelems, pump, caught)
+        finally:
+            self.phase = None
+
+    def _catch_up_inner(self, version, wire, nelems, pump,
+                        caught: int) -> int:
+        for rank in list(self.router._ranks):
+            if not rank.alive or version in rank.versions:
+                continue
+            deadline = time.monotonic() + self.timeout_ms / 1e3
+            telemetry.swap_event("publish")
+            self._seq += 1
+            token = (self._seq << 32) | version
+            self._supervised_cast([rank], version, token, wire, nelems,
+                                  deadline, pump)
+            self._await_flips([rank], version, token, deadline, pump)
+            telemetry.swap_event("commit")
+            self.stats["catch_ups"] += 1
+            caught += 1
+        return caught

@@ -66,6 +66,12 @@ class _Rank:
         self.slots = max(1, link.peer.slots)
         self.inflight: set[int] = set()
         self.alive = True
+        # Checkpoint versions resident on this rank: seeded from the HELLO
+        # (a readmitted host announces the version it still serves — stale
+        # is LEGAL, the publisher catches it up; a pre-swap peer without
+        # the field is version 0), grown by SWAP_STATUS flips, shrunk by
+        # the retire sweep.
+        self.versions: set[int] = {getattr(link.peer, "weight_version", 0)}
 
     def free(self) -> int:
         return self.slots - len(self.inflight)
@@ -92,6 +98,15 @@ class Router:
         self.policy = policy
         self.retain_kv = retain_kv
         self._queue_limit = queue_limit
+        # Live weight updates (docs/DESIGN.md "Live weight updates"): the
+        # version NEW sessions are admitted under, one PrefillEngine per
+        # still-draining version (a request prefilled under v1 must decode
+        # and REPLAY under v1 — bitwise pinning), swap verdicts keyed by
+        # (rank index, attempt token), and versions awaiting drain-retire.
+        self.version = 0
+        self._prefills: dict[int, PrefillEngine] = {0: prefill}
+        self._swap_status: dict[tuple[int, int], str] = {}
+        self._retire_pending: set[int] = set()
         # KV BLOCK and FIRST/RESULT frames ship on a LATENCY-class link:
         # the class nibble rides every comm this Net wires, so TTFT-bound
         # tier traffic never queues behind a co-tenant's bulk gradient
@@ -111,7 +126,7 @@ class Router:
         self.stats = {"submitted": 0, "completed": 0, "rank_failures": 0,
                       "replays_kv": 0, "replays_prefill": 0, "rejected": 0,
                       "qos_backpressure": 0, "readmissions": 0,
-                      "readmit_rejected": 0}
+                      "readmit_rejected": 0, "swaps": 0, "swap_aborts": 0}
 
     # -- wiring ------------------------------------------------------------
 
@@ -129,7 +144,8 @@ class Router:
     def _hello(self) -> proto.Hello:
         return proto.Hello(proto.ROLE_FRONTEND, self.kv_codec, 0,
                            self.prefill.max_len, self.prefill.model.vocab,
-                           kv_mod.model_signature(self.prefill.model))
+                           kv_mod.model_signature(self.prefill.model),
+                           weight_version=self.version)
 
     def accept_ranks(self, listen_sock: socket.socket, n: int,
                      timeout: float = 60.0) -> None:
@@ -221,7 +237,12 @@ class Router:
         self._next_id += 1
         rec = {"id": rid, "prompt": prompt, "max_new": int(max_new_tokens),
                "payload": None, "t_submit": time.monotonic(),
-               "t_first": None, "rank": None}
+               "t_first": None, "rank": None,
+               # Pinned at admission: this request prefills, decodes, and
+               # REPLAYS under the version current right now, even if a
+               # swap lands while it is in flight (bitwise session
+               # stability across publications).
+               "version": self.version}
         self._recs[rid] = rec
         self._queue.append(rec)
         self.stats["submitted"] += 1
@@ -236,10 +257,19 @@ class Router:
 
     # -- placement + dispatch ----------------------------------------------
 
-    def _pick_rank(self) -> _Rank | None:
+    def _pick_rank(self, version: int | None = None) -> _Rank | None:
         live = [r for r in self._ranks if r.alive and r.free() > 0]
         if not live:
             return None
+        if version is not None:
+            # Version-pinned placement: prefer ranks where the request's
+            # version is resident (mixed-version pools mid-swap / a stale
+            # readmitted host). Fall through to the whole pool only when
+            # nobody holds it — the decode side then serves on current,
+            # its never-drop belt.
+            resident = [r for r in live if version in r.versions]
+            if resident:
+                live = resident
         if self.policy == "round_robin":
             live.sort(key=lambda r: (r.index < self._rr_next, r.index))
             rank = live[0]
@@ -248,17 +278,21 @@ class Router:
         return max(live, key=lambda r: r.free())  # least loaded
 
     def _build_payload(self, rec: dict) -> bytes:
-        kv_rows, logits = self.prefill.prefill(rec["prompt"])
+        # Prefill under the request's PINNED version (the engine for a
+        # draining version stays resident until retire).
+        eng = self._prefills.get(rec.get("version", self.version),
+                                 self.prefill)
+        kv_rows, logits = eng.prefill(rec["prompt"])
         wire = kv_mod.encode_kv_block(kv_rows, self.kv_codec)
         n_kv = kv_mod.kv_block_elems(
-            self.prefill.kv_leaf_shapes(len(rec["prompt"])))
+            eng.kv_leaf_shapes(len(rec["prompt"])))
         return proto.pack_block(rec["prompt"], rec["max_new"], wire, n_kv,
                                 logits, self.kv_codec)
 
     def _pump(self) -> None:
         """Dispatch queued requests while live capacity exists."""
         while self._queue:
-            rank = self._pick_rank()
+            rank = self._pick_rank(self._queue[0].get("version"))
             if rank is None:
                 if not any(r.alive for r in self._ranks):
                     if self._listen_sock is not None:
@@ -276,7 +310,8 @@ class Router:
                     # death re-ships these bytes instead of re-prefilling.
                     rec["payload"] = payload
             try:
-                rank.link.send_frame(proto.T_BLOCK, rec["id"], payload)
+                rank.link.send_frame(proto.T_BLOCK, rec["id"], payload,
+                                     aux=rec.get("version", self.version))
             except _native.QosAdmissionError:
                 # Typed QoS backpressure: the latency class's in-flight
                 # budget is full. NOTHING reached the wire (the header send
@@ -335,7 +370,20 @@ class Router:
                     break
                 if frame is None:
                     break
-                ftype, rid, payload, _aux = frame
+                ftype, rid, payload, aux = frame
+                if ftype == proto.T_SWAP_STATUS:
+                    # rid is the publisher's attempt token
+                    # ((seq << 32) | version) — echoing it back makes a
+                    # LATE aborted-status from an abandoned attempt inert.
+                    version = rid & 0xFFFFFFFF
+                    if aux == proto.SWAP_FLIPPED:
+                        rank.versions.add(version)
+                        self._swap_status[(rank.index, rid)] = "flipped"
+                        self.stats["swaps"] += 1
+                    else:
+                        self._swap_status[(rank.index, rid)] = "aborted"
+                        self.stats["swap_aborts"] += 1
+                    continue
                 rec = self._recs.get(rid)
                 if rec is None or rid in self._results:
                     continue  # duplicate after a replay — drop
@@ -358,7 +406,46 @@ class Router:
                     self.stats["completed"] += 1
                     if tpot_us > 0:
                         telemetry.serve_observe("tpot", tpot_us)
+        self._retire_sweep()
         self._pump()
+
+    # -- live weight updates -------------------------------------------------
+
+    def install_version(self, version: int, engine: PrefillEngine) -> None:
+        """Adopt `engine` as the prefill for checkpoint `version` and make
+        it current for NEW sessions. The previous version's engine stays
+        resident for its pinned in-flight sessions and retires only once
+        they drain (docs/DESIGN.md "Live weight updates"); called by
+        WeightPublisher after the fleet flipped."""
+        old = self.version
+        self._prefills[version] = engine
+        self.prefill = engine
+        self.version = version
+        telemetry.weight_version(version)
+        if old != version:
+            self._retire_pending.add(old)
+
+    def _retire_sweep(self) -> None:
+        """Retire drained versions: once NO admitted request still pins an
+        old version, tell every rank holding it to drop it after its own
+        local drain, and drop the frontend engine."""
+        for ver in list(self._retire_pending):
+            if ver == self.version:
+                self._retire_pending.discard(ver)
+                continue
+            if any(rec.get("version") == ver and rec["id"] not in
+                   self._results for rec in self._recs.values()):
+                continue  # version still has in-flight pinned sessions
+            for rank in self._ranks:
+                if rank.alive and ver in rank.versions:
+                    try:
+                        rank.link.send_frame(proto.T_SWAP_RETIRE, ver,
+                                             aux=ver)
+                    except Exception:  # noqa: BLE001 — failure poll reaps
+                        pass
+                rank.versions.discard(ver)
+            self._prefills.pop(ver, None)
+            self._retire_pending.discard(ver)
 
     # -- driving -----------------------------------------------------------
 

@@ -26,6 +26,9 @@ Chrome-trace spans for every request plus collective phase spans tagged
   rewire_observe()    -> record one elastic rewire-phase duration sample
   churn_event()       -> count one membership-churn event by kind
   world_size()        -> set the live world-size gauge
+  swap_observe()      -> record one weight-swap phase duration sample
+  swap_event()        -> count one weight-swap event by kind
+  weight_version()    -> set the serving checkpoint-version gauge
 
 Env flags (rank-gated 0-7 like the reference, nthread:108-130):
   TPUNET_TRACE_DIR            directory for Chrome-trace JSON (Perfetto)
@@ -207,6 +210,48 @@ def world_size(world: int) -> None:
     as this rank last saw it (the churn suite's "world came back" gate)."""
     lib = _native.load()
     _native.check(lib.tpunet_c_world_size(max(0, int(world))), "world_size")
+
+
+_SWAP_PHASES = {"announce": 0, "broadcast": 1, "verify": 2, "flip": 3}
+_SWAP_KINDS = {"publish": 0, "commit": 1, "abort": 2, "retry": 3,
+               "mismatch": 4}
+
+
+def swap_observe(phase: str, us: int) -> None:
+    """Record one live weight-swap phase duration sample (microseconds)
+    into ``tpunet_weight_swap_duration_us{phase=...}`` — the publication
+    pipeline's stage histograms (docs/DESIGN.md "Live weight updates").
+    Phases: "announce" (SWAP_BEGIN frames out / receiver armed),
+    "broadcast" (chunked bf16 tree broadcast on the bulk class), "verify"
+    (cross-rank CRC32C digest agreement), "flip" (new server built,
+    version live)."""
+    if phase not in _SWAP_PHASES:
+        raise ValueError(
+            f"phase must be one of {sorted(_SWAP_PHASES)}, got {phase!r}")
+    lib = _native.load()
+    _native.check(
+        lib.tpunet_c_swap_observe(_SWAP_PHASES[phase], max(0, int(us))),
+        "swap_observe",
+    )
+
+
+def swap_event(kind: str) -> None:
+    """Count one weight-swap event into
+    ``tpunet_swap_events_total{kind=...}`` ("publish", "commit", "abort",
+    "retry" or "mismatch")."""
+    if kind not in _SWAP_KINDS:
+        raise ValueError(
+            f"kind must be one of {sorted(_SWAP_KINDS)}, got {kind!r}")
+    lib = _native.load()
+    _native.check(lib.tpunet_c_swap_event(_SWAP_KINDS[kind]), "swap_event")
+
+
+def weight_version(version: int) -> None:
+    """Set the ``tpunet_weight_version`` gauge — the checkpoint version
+    this rank is serving (the swap lane's "v2 reached every rank" gate)."""
+    lib = _native.load()
+    _native.check(
+        lib.tpunet_c_weight_version(max(0, int(version))), "weight_version")
 
 
 def flush_trace() -> None:
