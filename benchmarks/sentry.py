@@ -1,0 +1,299 @@
+"""CI perf-regression sentry: counter-gated claims vs a checked-in baseline.
+
+The repo's perf story is carried by COUNTERS, not wall clocks: syscalls/MiB
+(vectored wire path), codec wire ratio (bf16 halves ring bytes), schedule
+step counts (ring = 2(W-1) wire rounds), and the hier DCN byte split
+(inter-host TCP vs intra-host SHM). Those numbers are deterministic or
+near-deterministic on any box, so a regression in one is a code change,
+not CI weather — unlike GB/s, which swings ±20% on the shared-core runner.
+
+This sentry replays every claim in ``docs/SENTRY_BASELINE.json`` against a
+fresh measurement and fails CI on a VERIFIED regression: a claim that
+fails a live measurement is re-measured once before the verdict, so a
+single scheduling hiccup (the busbw floor is the only wall-clock-adjacent
+claim) cannot red a PR. Canned measurements (``--measurements``) skip the
+re-measure — that is the deterministic test vehicle (tests/test_sentry.py
+proves the sentry goes red on an inflated fixture baseline).
+
+Baseline schema (``tpunet-sentry-v1``)::
+
+    {"schema": "tpunet-sentry-v1",
+     "claims": {
+       "<key>": {"max": 3.0, "desc": "..."}     # measured <= max
+       "<key>": {"min": 0.02, ...}               # measured >= min
+       "<key>": {"equals": 6, ...}               # measured == equals exactly
+     }}
+
+Usage::
+
+    python -m benchmarks.sentry --measure [--out PATH]
+    python -m benchmarks.sentry --check [--baseline PATH]
+                                        [--measurements PATH] [--json PATH]
+
+``--measure`` prints (and optionally writes) the measurement dict — run it
+after an intentional perf change, then update the baseline's margins by
+hand (the baseline is a reviewed artifact, never auto-written). ``--check``
+exits nonzero on a verified regression and prints one verdict line per
+claim.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+BASELINE_DEFAULT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs", "SENTRY_BASELINE.json")
+
+ENGINE_SIZE = 16 << 20
+CODEC_SIZE = 4 << 20
+STEPS_WORLD = 4
+STEPS_SIZE = 1 << 20
+HIER_WORLD = 4
+HIER_SIZE = 4 << 20
+
+# Which measurement keys each measurement group produces: a failing claim
+# re-measures ONLY its group (a full re-run would double the lane's cost).
+GROUPS = {
+    "engines": ("basic_syscalls_per_mib", "epoll_syscalls_per_mib",
+                "basic_busbw_gbps"),
+    "codec": ("codec_wire_ratio_bf16_over_f32",),
+    "steps": ("ring_steps_w4",),
+    "hier": ("hier_dcn_fraction_w4",),
+}
+
+
+def _codec_rank(rank, world, port, q, codec):
+    try:
+        os.environ.update({"TPUNET_WIRE_DTYPE": codec,
+                           "TPUNET_NSTREAMS": "1",
+                           "TPUNET_ASYNC_CHANNELS": "1",
+                           "TPUNET_ALGO": "ring"})
+        import numpy as np
+
+        from tpunet import telemetry
+        from tpunet.collectives import Communicator
+
+        with Communicator(f"127.0.0.1:{port}", rank, world) as comm:
+            arr = np.full(CODEC_SIZE // 4, float(rank + 1), np.float32)
+            comm.all_reduce(arr, inplace=True)  # warmup: wiring + scratch
+            comm.barrier()
+            telemetry.reset()
+            comm.all_reduce(arr, inplace=True)
+            wire = int(sum(
+                telemetry.metrics()["tpunet_isend_nbytes_sum"].values()))
+        q.put((rank, ("OK", wire)))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, (f"ERR: {e!r}", 0)))
+
+
+def _steps_rank(rank, world, port, q):
+    try:
+        os.environ.update({"TPUNET_NSTREAMS": "1",
+                           "TPUNET_ASYNC_CHANNELS": "1",
+                           "TPUNET_ALGO": "ring"})
+        import numpy as np
+
+        from tpunet import telemetry
+        from tpunet.collectives import Communicator
+
+        with Communicator(f"127.0.0.1:{port}", rank, world) as comm:
+            arr = np.full(STEPS_SIZE // 4, float(rank + 1), np.float32)
+            comm.all_reduce(arr)  # warmup
+            comm.barrier()
+            telemetry.reset()
+            comm.all_reduce(arr)
+            m = telemetry.metrics()
+        ring = sum(int(v) for key, v in
+                   m.get("tpunet_coll_steps_total", {}).items()
+                   if telemetry.labels(key)["algo"] == "ring")
+        q.put((rank, ("OK", ring)))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, (f"ERR: {e!r}", 0)))
+
+
+def _hier_rank(rank, world, port, q):
+    try:
+        os.environ.update({
+            "TPUNET_NSTREAMS": "1", "TPUNET_ASYNC_CHANNELS": "1",
+            "TPUNET_SHM": "1",
+            "TPUNET_HOST_ID": f"sentryhost{rank // 2}",  # hosts [0,0,1,1]
+        })
+        import numpy as np
+
+        from tpunet import telemetry
+        from tpunet.collectives import Communicator
+
+        with Communicator(f"127.0.0.1:{port}", rank, world,
+                          algo="hier") as comm:
+            arr = np.full(HIER_SIZE // 4, float(rank + 1), np.float32)
+            comm.all_reduce(arr)  # warmup: wires SHM rings + mesh
+            comm.barrier()
+            telemetry.reset()
+            comm.all_reduce(arr)
+            m = telemetry.metrics()
+        # DCN proxy: TCP tx bytes; intra-host traffic rides the separate
+        # SHM byte family, so the split is exact (test_schedules pattern).
+        tcp_tx = sum(int(v) for key, v in
+                     m.get("tpunet_qos_bytes_total", {}).items()
+                     if telemetry.labels(key)["dir"] == "tx")
+        shm_tx = sum(int(v) for key, v in
+                     m.get("tpunet_shm_bytes_total", {}).items()
+                     if telemetry.labels(key)["dir"] == "tx")
+        q.put((rank, ("OK", (tcp_tx, shm_tx))))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, (f"ERR: {e!r}", (0, 0))))
+
+
+def measure_group(group: str) -> dict:
+    """One measurement group -> {measurement key: value}."""
+    from benchmarks import check_rank_results, spawn_ranks
+
+    if group == "engines":
+        from benchmarks.engine_p2p import run_engine
+
+        out = {}
+        r = run_engine("BASIC", nstreams=2, sizes=[ENGINE_SIZE], iters=4)
+        out["basic_syscalls_per_mib"] = r[ENGINE_SIZE]["syscalls_per_mib"]
+        out["basic_busbw_gbps"] = r[ENGINE_SIZE]["gbps"]
+        r = run_engine("EPOLL", nstreams=2, sizes=[ENGINE_SIZE], iters=4)
+        out["epoll_syscalls_per_mib"] = r[ENGINE_SIZE]["syscalls_per_mib"]
+        return out
+    if group == "codec":
+        wire = {}
+        for codec in ("f32", "bf16"):
+            results = check_rank_results(spawn_ranks(
+                _codec_rank, 2, extra_args=(codec,), timeout=180))
+            wire[codec] = results[0]
+        ratio = (wire["bf16"] / wire["f32"]) if wire["f32"] else float("inf")
+        return {"codec_wire_ratio_bf16_over_f32": round(ratio, 4)}
+    if group == "steps":
+        results = check_rank_results(spawn_ranks(
+            _steps_rank, STEPS_WORLD, timeout=180))
+        # Every rank of a ring allreduce runs the same 2(W-1) wire rounds;
+        # report the MAX so any rank's deviation is the headline number.
+        return {"ring_steps_w4": max(results.values())}
+    if group == "hier":
+        results = check_rank_results(spawn_ranks(
+            _hier_rank, HIER_WORLD, timeout=180))
+        tcp = sum(t for t, _ in results.values())
+        shm = sum(s for _, s in results.values())
+        frac = tcp / (tcp + shm) if (tcp + shm) else 1.0
+        return {"hier_dcn_fraction_w4": round(frac, 4)}
+    raise ValueError(f"unknown measurement group {group!r}")
+
+
+def measure(groups=None) -> dict:
+    out = {}
+    for g in groups or GROUPS:
+        out.update(measure_group(g))
+    return out
+
+
+def _violation(claim: dict, value) -> str | None:
+    """None when the claim holds, else a human-readable violation."""
+    if value is None:
+        return "no measurement"
+    if "max" in claim and value > claim["max"]:
+        return f"{value} > max {claim['max']}"
+    if "min" in claim and value < claim["min"]:
+        return f"{value} < min {claim['min']}"
+    if "equals" in claim and value != claim["equals"]:
+        return f"{value} != {claim['equals']}"
+    return None
+
+
+def check(baseline: dict, measurements: dict | None = None,
+          remeasure: bool = True) -> dict:
+    """Verdict per claim. With live measurements (measurements=None), a
+    failing claim's group is re-measured ONCE before it counts as a
+    verified regression. Returns {"ok": bool, "claims": {key: {"value",
+    "verdict", "detail"}}}."""
+    if baseline.get("schema") != "tpunet-sentry-v1":
+        raise ValueError(
+            f"baseline schema {baseline.get('schema')!r} is not "
+            f"tpunet-sentry-v1")
+    claims = baseline.get("claims", {})
+    key_group = {k: g for g, keys in GROUPS.items() for k in keys}
+    live = measurements is None
+    if live:
+        groups = sorted({key_group[k] for k in claims if k in key_group})
+        measurements = measure(groups)
+    out = {"ok": True, "claims": {}}
+    for key, claim in claims.items():
+        value = measurements.get(key)
+        why = _violation(claim, value)
+        if why is not None and live and remeasure and key in key_group:
+            print(f"[sentry] {key}: {why} — re-measuring once to verify",
+                  file=sys.stderr)
+            measurements.update(measure_group(key_group[key]))
+            value = measurements.get(key)
+            why = _violation(claim, value)
+            if why is not None:
+                why += " (verified on re-measure)"
+        verdict = "ok" if why is None else "REGRESSION"
+        out["claims"][key] = {"value": value, "verdict": verdict,
+                              "detail": why or ""}
+        if why is not None:
+            out["ok"] = False
+    out["measurements"] = measurements
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m benchmarks.sentry", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--measure", action="store_true",
+                      help="run every measurement group and print the dict")
+    mode.add_argument("--check", action="store_true",
+                      help="replay baseline claims; exit 1 on a verified "
+                           "regression")
+    ap.add_argument("--baseline", default=BASELINE_DEFAULT,
+                    help="claims file (default docs/SENTRY_BASELINE.json)")
+    ap.add_argument("--measurements", default=None,
+                    help="canned measurement JSON: check against these "
+                         "instead of measuring (deterministic test vehicle; "
+                         "disables the re-measure pass)")
+    ap.add_argument("--out", default=None,
+                    help="--measure: also write the dict to this path")
+    ap.add_argument("--json", default=None,
+                    help="--check: also write the verdict object here")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("TPUNET_CRC", "0")
+    if args.measure:
+        m = measure()
+        print(json.dumps(m, indent=2))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(m, f, indent=2)
+        return 0
+
+    with open(args.baseline) as f:
+        baseline = json.load(f)
+    canned = None
+    if args.measurements:
+        with open(args.measurements) as f:
+            canned = json.load(f)
+    verdict = check(baseline, canned)
+    for key, c in verdict["claims"].items():
+        detail = f" ({c['detail']})" if c["detail"] else ""
+        print(f"[sentry] {c['verdict']:>10}  {key} = {c['value']}{detail}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(verdict, f, indent=2)
+    if not verdict["ok"]:
+        print("sentry: VERIFIED perf regression — see claims above",
+              file=sys.stderr)
+        return 1
+    print("sentry OK: every baseline claim holds")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -375,6 +375,20 @@ int32_t tpunet_c_swap_event(int32_t kind);
 /* Set the tpunet_weight_version gauge — the checkpoint version this rank
  * is serving (the swap smoke lane's "v2 reached every rank" gate). */
 int32_t tpunet_c_weight_version(uint64_t version);
+/* ---- Flight recorder (docs/DESIGN.md §6c) -------------------------------
+ * Dump the per-rank flight-recorder ring to
+ * <dir>/tpunet-flightrec-rank<R>.json (dir NULL/"" = TPUNET_TRACE_DIR when
+ * set at init, else "."). `reason` (NULL = "api") lands in the dump header.
+ * Writes the dump path into out_path (NUL-terminated, truncated to cap) and
+ * returns its full length — the tpunet_c_metrics_text buffer-sizing
+ * contract. TPUNET_ERR_INVALID when the recorder is disabled
+ * (TPUNET_FLIGHTREC_EVENTS=0) or the target is unwritable. */
+int32_t tpunet_c_flightrec_dump(const char* dir, const char* reason,
+                                char* out_path, uint64_t cap);
+/* Recorder occupancy: events ever recorded (the ring cursor — monotonic,
+ * NOT clamped to capacity) and ring capacity in slots. Both 0 when the
+ * recorder is disabled. Either pointer may be NULL. */
+int32_t tpunet_c_flightrec_stats(uint64_t* recorded, uint64_t* capacity);
 
 /* ---- Transport QoS introspection (docs/DESIGN.md "Transport QoS") -------
  * Text echo of the process QoS scheduler's parsed config (weights, budgets,

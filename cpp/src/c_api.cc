@@ -12,6 +12,7 @@
 #include <vector>
 
 #include "fault.h"
+#include "flightrec.h"
 #include "id_map.h"
 #include "tpunet/mutex.h"
 #include "tpunet/net.h"
@@ -657,6 +658,40 @@ int32_t tpunet_c_swap_event(int32_t kind) {
 
 int32_t tpunet_c_weight_version(uint64_t version) {
   tpunet::Telemetry::Get().OnWeightVersion(version);
+  return TPUNET_OK;
+}
+
+int32_t tpunet_c_flightrec_dump(const char* dir, const char* reason,
+                                char* out_path, uint64_t cap) {
+  if (!out_path && cap > 0) return Fail(TPUNET_ERR_NULL, "out_path is null");
+  // The ring initializes lazily on first Record; an on-demand dump before
+  // any traffic must still produce a (header-only) file.
+  if (tpunet::flightrec::internal::InitRing() == nullptr) {
+    return Fail(TPUNET_ERR_INVALID,
+                "flight recorder disabled (TPUNET_FLIGHTREC_EVENTS=0)");
+  }
+  // The reason lands verbatim inside a JSON string in the dump header:
+  // sanitize the caller-supplied text instead of trusting it.
+  char clean[64];
+  const char* src = reason != nullptr && reason[0] != '\0' ? reason : "api";
+  size_t n = 0;
+  for (; src[n] != '\0' && n < sizeof(clean) - 1; ++n) {
+    char ch = src[n];
+    bool ok = (ch >= 'a' && ch <= 'z') || (ch >= 'A' && ch <= 'Z') ||
+              (ch >= '0' && ch <= '9') || ch == '_' || ch == '-' ||
+              ch == '.' || ch == ' ' || ch == ':';
+    clean[n] = ok ? ch : '_';
+  }
+  clean[n] = '\0';
+  int len = tpunet::flightrec::Dump(dir, clean, out_path, cap);
+  if (len <= 0) {
+    return Fail(TPUNET_ERR_INVALID, "flight recorder dump target unwritable");
+  }
+  return len;
+}
+
+int32_t tpunet_c_flightrec_stats(uint64_t* recorded, uint64_t* capacity) {
+  tpunet::flightrec::Stats(recorded, capacity);
   return TPUNET_OK;
 }
 

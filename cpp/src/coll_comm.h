@@ -28,6 +28,7 @@
 #include <vector>
 
 #include "dispatch.h"
+#include "flightrec.h"
 #include "tpunet/bootstrap.h"
 #include "tpunet/collectives.h"
 #include "tpunet/mutex.h"
@@ -121,14 +122,21 @@ inline void Reduce(void* dst, const void* a, const void* b, size_t n,
 // phase on every rank — the cross-rank join key telemetry.merge_traces()
 // aligns per-rank trace files with. Zero cost when tracing is off (the
 // caller passes tracing_enabled() as `on`; no string is built either way
-// until the destructor fires with on=true).
+// until the destructor fires with on=true) beyond the always-on flight-
+// recorder enter/exit events — the ENTER event is what lets the postmortem
+// name a phase nobody ever left (a hung rank never runs the destructor).
 class PhaseSpan {
  public:
   PhaseSpan(bool on, uint64_t comm_id, uint64_t seq, const char* kind, int step,
             uint64_t nbytes)
       : on_(on), comm_id_(comm_id), seq_(seq), kind_(kind), step_(step),
-        nbytes_(nbytes), start_us_(on ? MonotonicUs() : 0) {}
+        nbytes_(nbytes), start_us_(on ? MonotonicUs() : 0) {
+    flightrec::Record(flightrec::Ev::kPhaseEnter, comm_id_, seq_, nbytes_,
+                      static_cast<uint32_t>(step_ < 0 ? 0 : step_), kind_);
+  }
   ~PhaseSpan() {
+    flightrec::Record(flightrec::Ev::kPhaseExit, comm_id_, seq_, nbytes_,
+                      static_cast<uint32_t>(step_ < 0 ? 0 : step_), kind_);
     if (!on_) return;
     std::string phase =
         step_ < 0 ? std::string(kind_) : std::string(kind_) + "." + std::to_string(step_);

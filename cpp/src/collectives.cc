@@ -271,6 +271,8 @@ CollAlgo ScheduledCommunicator::ResolveAlgo(CollKind coll, uint64_t nbytes) {
     a = CollAlgo::kRing;
   }
   CountCollAlgoSelected(coll, a);
+  flightrec::Record(flightrec::Ev::kCollSubmit, static_cast<uint64_t>(coll),
+                    static_cast<uint64_t>(a), nbytes);
   return a;
 }
 
@@ -467,6 +469,10 @@ CollAlgo ScheduledCommunicator::ResolveA2aAlgo(uint64_t bytes_per_rank) {
     a = CollAlgo::kRing;
   }
   CountCollAlgoSelected(CollKind::kAllToAll, a);
+  flightrec::Record(flightrec::Ev::kCollSubmit,
+                    static_cast<uint64_t>(CollKind::kAllToAll),
+                    static_cast<uint64_t>(a),
+                    static_cast<uint64_t>(world_) * bytes_per_rank);
   return a;
 }
 

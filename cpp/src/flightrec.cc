@@ -1,0 +1,330 @@
+// tpunet flight recorder implementation. See flightrec.h for the contract.
+#include "flightrec.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <signal.h>
+#include <string.h>
+#include <unistd.h>
+
+#include <mutex>
+#include <new>
+
+#include "tpunet/utils.h"
+
+namespace tpunet {
+namespace flightrec {
+
+namespace internal {
+std::atomic<Ring*> g_ring{nullptr};
+std::atomic<bool> g_disabled{false};
+}  // namespace internal
+
+namespace {
+
+// Resolved once at init so the SIGUSR2 handler never calls getenv/malloc:
+// the default dump path, rank, and host id live in static storage.
+char g_default_path[512] = "tpunet-flightrec-rank0.json";
+char g_default_dir[384] = ".";
+uint64_t g_rank = 0;
+uint64_t g_host = 0;
+std::atomic<uint64_t> g_last_verdict_dump_us{0};
+std::once_flag g_init_once;
+
+// Hand-rolled async-signal-safe formatting: none of printf is guaranteed
+// safe in signal context, and the dumper must run there.
+size_t U64ToDec(uint64_t v, char* out) {
+  char tmp[20];
+  size_t n = 0;
+  do {
+    tmp[n++] = static_cast<char>('0' + v % 10);
+    v /= 10;
+  } while (v != 0);
+  for (size_t i = 0; i < n; ++i) out[i] = tmp[n - 1 - i];
+  return n;
+}
+
+size_t U64ToHex16(uint64_t v, char* out) {
+  static const char* digits = "0123456789abcdef";
+  for (int i = 15; i >= 0; --i) {
+    out[i] = digits[v & 0xf];
+    v >>= 4;
+  }
+  return 16;
+}
+
+// Buffered raw-syscall writer (one write() per ~4KiB, not per fragment).
+struct Writer {
+  int fd = -1;
+  size_t len = 0;
+  bool failed = false;
+  char buf[4096];
+
+  void Flush() {
+    size_t off = 0;
+    while (off < len) {
+      ssize_t w = ::write(fd, buf + off, len - off);
+      if (w < 0) {
+        if (errno == EINTR) continue;
+        failed = true;
+        break;
+      }
+      off += static_cast<size_t>(w);
+    }
+    len = 0;
+  }
+  void Put(const char* s, size_t n) {
+    if (failed) return;
+    while (n > 0) {
+      size_t room = sizeof(buf) - len;
+      size_t take = n < room ? n : room;
+      memcpy(buf + len, s, take);
+      len += take;
+      s += take;
+      n -= take;
+      if (len == sizeof(buf)) Flush();
+    }
+  }
+  void Str(const char* s) { Put(s, strlen(s)); }
+  void Dec(uint64_t v) {
+    char tmp[20];
+    Put(tmp, U64ToDec(v, tmp));
+  }
+  void Hex(uint64_t v) {
+    char tmp[16];
+    Put(tmp, U64ToHex16(v, tmp));
+  }
+};
+
+const char* EvName(uint8_t kind) {
+  switch (static_cast<Ev>(kind)) {
+    case Ev::kCollSubmit: return "coll_submit";
+    case Ev::kPhaseEnter: return "phase_enter";
+    case Ev::kPhaseExit: return "phase_exit";
+    case Ev::kWireSend: return "wire_send";
+    case Ev::kWireRecv: return "wire_recv";
+    case Ev::kQosGrant: return "qos_grant";
+    case Ev::kQosPause: return "qos_pause";
+    case Ev::kQosWait: return "qos_wait";
+    case Ev::kQosPreempt: return "qos_preempt";
+    case Ev::kFailover: return "failover";
+    case Ev::kRestripe: return "restripe";
+    case Ev::kRewirePhase: return "rewire_phase";
+    case Ev::kSwapPhase: return "swap_phase";
+    case Ev::kCrcError: return "crc_error";
+    case Ev::kFault: return "fault";
+    case Ev::kReqStart: return "req_start";
+    case Ev::kReqDone: return "req_done";
+    case Ev::kVerdict: return "verdict";
+  }
+  return "unknown";
+}
+
+void SigusrDump(int /*signum*/) {
+  int saved_errno = errno;
+  (void)Dump(nullptr, "sigusr2", nullptr, 0);
+  errno = saved_errno;
+}
+
+void InitOnce() {
+  uint64_t want = GetEnvU64("TPUNET_FLIGHTREC_EVENTS", 16384);
+  if (want == 0) {
+    internal::g_disabled.store(true, std::memory_order_release);
+    return;
+  }
+  uint64_t cap = 8;
+  while (cap < want && cap < (1ull << 24)) cap <<= 1;
+
+  g_rank = GetEnvU64("TPUNET_RANK", GetEnvU64("RANK", 0));
+  g_host = HostId();
+  std::string dir = GetEnv("TPUNET_TRACE_DIR", ".");
+  if (dir.empty() || dir.size() >= sizeof(g_default_dir)) dir = ".";
+  memcpy(g_default_dir, dir.c_str(), dir.size() + 1);
+  char* p = g_default_path;
+  memcpy(p, dir.data(), dir.size());
+  p += dir.size();
+  static const char kStem[] = "/tpunet-flightrec-rank";
+  memcpy(p, kStem, sizeof(kStem) - 1);
+  p += sizeof(kStem) - 1;
+  p += U64ToDec(g_rank, p);
+  static const char kExt[] = ".json";
+  memcpy(p, kExt, sizeof(kExt));
+
+  // Leaked on purpose (like the Telemetry singleton): hot paths may record
+  // during static teardown, so the ring must never be freed.
+  Ring* r = new Ring();
+  r->slots = new Event[cap];
+  r->capacity = cap;
+  r->mask = cap - 1;
+
+  // SIGUSR2 = dump-now. SA_RESTART so a dump doesn't surface EINTR on the
+  // engines' blocking syscalls.
+  struct sigaction sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sa_handler = SigusrDump;
+  sa.sa_flags = SA_RESTART;
+  sigemptyset(&sa.sa_mask);
+  (void)sigaction(SIGUSR2, &sa, nullptr);
+
+  internal::g_ring.store(r, std::memory_order_release);
+}
+
+}  // namespace
+
+namespace internal {
+
+Ring* InitRing() {
+  std::call_once(g_init_once, InitOnce);
+  return g_ring.load(std::memory_order_acquire);
+}
+
+void RecordIn(Ring* r, Ev kind, uint64_t a, uint64_t b, uint64_t c, uint32_t d,
+              const char* name) {
+  uint64_t idx = r->cursor.fetch_add(1, std::memory_order_relaxed);
+  Event& e = r->slots[idx & r->mask];
+  // Invalidate first so a dump racing this write sees a torn slot, not a
+  // half-old half-new event wearing a valid seq.
+  e.seq.store(0, std::memory_order_release);
+  e.t_us.store(MonotonicUs(), std::memory_order_relaxed);
+  e.a.store(a, std::memory_order_relaxed);
+  e.b.store(b, std::memory_order_relaxed);
+  e.c.store(c, std::memory_order_relaxed);
+  e.d.store(d, std::memory_order_relaxed);
+  e.name.store(name, std::memory_order_relaxed);
+  e.kind.store(static_cast<uint8_t>(kind), std::memory_order_relaxed);
+  e.seq.store(idx + 1, std::memory_order_release);
+}
+
+}  // namespace internal
+
+int Dump(const char* dir, const char* reason, char* out_path, uint64_t cap) {
+  Ring* r = internal::g_ring.load(std::memory_order_acquire);
+  if (r == nullptr) return 0;
+
+  char path[512];
+  if (dir != nullptr && dir[0] != '\0') {
+    size_t dn = strlen(dir);
+    char tail[64];
+    char* t = tail;
+    static const char kStem[] = "/tpunet-flightrec-rank";
+    memcpy(t, kStem, sizeof(kStem) - 1);
+    t += sizeof(kStem) - 1;
+    t += U64ToDec(g_rank, t);
+    static const char kExt[] = ".json";
+    memcpy(t, kExt, sizeof(kExt));
+    size_t tn = strlen(tail);
+    if (dn + tn + 1 > sizeof(path)) return 0;
+    memcpy(path, dir, dn);
+    memcpy(path + dn, tail, tn + 1);
+  } else {
+    memcpy(path, g_default_path, sizeof(g_default_path));
+  }
+
+  int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC | O_CLOEXEC, 0644);
+  if (fd < 0) return 0;
+
+  // Snapshot the claim cursor; slots in [first, cur) are the live window.
+  // Writers may keep claiming while we copy — their slots fail the seq
+  // check and count as torn instead of emitting garbage.
+  uint64_t cur = r->cursor.load(std::memory_order_acquire);
+  uint64_t first = cur > r->capacity ? cur - r->capacity : 0;
+
+  Writer w;
+  w.fd = fd;
+  w.Str("{\"schema\":\"tpunet-flightrec-v1\",\"rank\":");
+  w.Dec(g_rank);
+  w.Str(",\"host\":\"");
+  w.Hex(g_host);
+  w.Str("\",\"reason\":\"");
+  w.Str(reason != nullptr ? reason : "on_demand");
+  w.Str("\",\"capacity\":");
+  w.Dec(r->capacity);
+  w.Str(",\"recorded\":");
+  w.Dec(cur);
+  w.Str(",\"dropped\":");
+  w.Dec(first);
+  // The torn count is only known after the scan, so it is emitted as the
+  // key AFTER the events array (single pass, no seek-and-patch).
+  w.Str(",\"events\":[");
+  uint64_t torn = 0;
+  bool first_ev = true;
+  for (uint64_t g = first; g < cur; ++g) {
+    Event& e = r->slots[g & r->mask];
+    if (e.seq.load(std::memory_order_acquire) != g + 1) {
+      ++torn;
+      continue;
+    }
+    uint64_t t_us = e.t_us.load(std::memory_order_relaxed);
+    uint64_t a = e.a.load(std::memory_order_relaxed);
+    uint64_t b = e.b.load(std::memory_order_relaxed);
+    uint64_t c = e.c.load(std::memory_order_relaxed);
+    uint32_t d = e.d.load(std::memory_order_relaxed);
+    const char* name = e.name.load(std::memory_order_relaxed);
+    uint8_t kind = e.kind.load(std::memory_order_relaxed);
+    if (e.seq.load(std::memory_order_acquire) != g + 1) {
+      ++torn;  // writer lapped the slot mid-copy
+      continue;
+    }
+    if (!first_ev) w.Str(",");
+    first_ev = false;
+    w.Str("\n{\"t\":");
+    w.Dec(t_us);
+    w.Str(",\"kind\":\"");
+    w.Str(EvName(kind));
+    w.Str("\",\"a\":");
+    w.Dec(a);
+    w.Str(",\"b\":");
+    w.Dec(b);
+    w.Str(",\"c\":");
+    w.Dec(c);
+    w.Str(",\"d\":");
+    w.Dec(d);
+    if (name != nullptr) {
+      w.Str(",\"name\":\"");
+      w.Str(name);
+      w.Str("\"");
+    }
+    w.Str("}");
+  }
+  w.Str("\n],\"torn\":");
+  w.Dec(torn);
+  w.Str("}\n");
+  w.Flush();
+  (void)::close(fd);
+  if (w.failed) return 0;
+
+  size_t pn = strlen(path);
+  if (out_path != nullptr && cap > 0) {
+    size_t n = pn < cap - 1 ? pn : cap - 1;
+    memcpy(out_path, path, n);
+    out_path[n] = '\0';
+  }
+  return static_cast<int>(pn);
+}
+
+void DumpOnVerdict(const char* reason, uint64_t err_kind) {
+  Record(Ev::kVerdict, err_kind, 0, 0, 0, reason);
+  Ring* r = internal::g_ring.load(std::memory_order_acquire);
+  if (r == nullptr) return;
+  // One dump per second: an error storm (every request of every comm timing
+  // out at once) produces one file per window, not a disk flood.
+  uint64_t now = MonotonicUs();
+  uint64_t last = g_last_verdict_dump_us.load(std::memory_order_relaxed);
+  if (last != 0 && now - last < 1000000) return;
+  if (!g_last_verdict_dump_us.compare_exchange_strong(
+          last, now, std::memory_order_relaxed)) {
+    return;  // a sibling verdict in this window owns the dump
+  }
+  (void)Dump(nullptr, reason, nullptr, 0);
+}
+
+void Stats(uint64_t* recorded, uint64_t* capacity) {
+  Ring* r = internal::g_ring.load(std::memory_order_acquire);
+  if (recorded != nullptr) {
+    *recorded = r != nullptr ? r->cursor.load(std::memory_order_relaxed) : 0;
+  }
+  if (capacity != nullptr) *capacity = r != nullptr ? r->capacity : 0;
+}
+
+}  // namespace flightrec
+}  // namespace tpunet

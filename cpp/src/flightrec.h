@@ -1,0 +1,142 @@
+// tpunet flight recorder (docs/DESIGN.md §6c "Flight recorder & postmortem").
+//
+// A per-rank, always-on, lock-free fixed-size ring of structured events fed
+// from the transport/collective/QoS/elastic hot paths. When a collective
+// hangs or a rewire blows its deadline, the counters say THAT it failed;
+// the recorder says what every phase of every rank was doing when it did.
+//
+// Hot-path cost: one relaxed fetch_add on the ring cursor plus a handful of
+// relaxed stores into the claimed slot (every payload field is a relaxed
+// atomic so a dump racing a writer is well-defined, not UB). No locks, no
+// allocation, no branches beyond the enabled check. The per-slot `seq` word
+// is release-stored LAST (value = global index + 1) so the dumper can
+// detect torn slots: read seq, copy the payload, re-read seq — a mismatch
+// means a writer lapped the slot mid-copy and the event is dropped (counted
+// in the dump header as "torn").
+//
+// Ring size: TPUNET_FLIGHTREC_EVENTS slots (default 16384, rounded up to a
+// power of two; 0 disables recording entirely). The ring is allocated once
+// on first use and leaked on purpose — events may arrive during static
+// teardown, exactly like the Telemetry singleton.
+//
+// Dumps (self-describing JSON, schema "tpunet-flightrec-v1") are written to
+// <dir>/tpunet-flightrec-rank<R>.json:
+//   - on every terminal verdict (watchdog timeout, CRC corruption, rewire /
+//     weight-swap deadline) at the site where the typed error is raised,
+//     rate-limited to one dump per second;
+//   - on SIGUSR2 (handler installed when the recorder initializes enabled);
+//   - on demand via tpunet_c_flightrec_dump / telemetry.flightrec_dump().
+// The dump path is async-signal-safe: raw open/write/close with hand-rolled
+// integer formatting, no malloc, no locks — the SIGUSR2 handler writes the
+// file directly from signal context.
+//
+// Compile-time kill switch: -DTPUNET_FLIGHTREC_DISABLED compiles every
+// Record() to nothing — the baseline the recorder-overhead budget in
+// docs/DESIGN.md is measured against.
+#ifndef TPUNET_FLIGHTREC_H_
+#define TPUNET_FLIGHTREC_H_
+
+#include <atomic>
+#include <cstdint>
+
+namespace tpunet {
+namespace flightrec {
+
+// Event kinds. Values are stable across dumps (the postmortem tool keys on
+// the names the dumper emits, but the wire-stable byte keeps dumps from
+// mixed-version fleets mergeable).
+enum class Ev : uint8_t {
+  kCollSubmit = 1,   // a=kind (CollKind), b=algo (CollAlgo), c=nbytes
+  kPhaseEnter = 2,   // a=comm_id, b=coll_seq, c=nbytes, d=step, name=phase kind
+  kPhaseExit = 3,    // a=comm_id, b=coll_seq, c=nbytes, d=step, name=phase kind
+  kWireSend = 4,     // a=stream idx, b=chunk nbytes, d=traffic class
+  kWireRecv = 5,     // a=stream idx, b=chunk nbytes, d=traffic class
+  kQosGrant = 6,     // a=class, b=granted nbytes
+  kQosPause = 7,     // a=class, b=front nbytes (wire window full, queue parked)
+  kQosWait = 8,      // a=class, b=wait us
+  kQosPreempt = 9,   // a=class (grant jumped an older waiter)
+  kFailover = 10,    // data-stream failover survived
+  kRestripe = 11,    // lane weight-vector epoch published
+  kRewirePhase = 12, // a=phase (kRewirePhaseCount order), b=us
+  kSwapPhase = 13,   // a=phase (kSwapPhaseCount order), b=us
+  kCrcError = 14,    // per-chunk CRC32C mismatch detected
+  kFault = 15,       // a=action (FaultAction) — injected fault fired
+  kReqStart = 16,    // a=comm, b=request id, c=nbytes, d=is_send
+  kReqDone = 17,     // a=request id, d=failed
+  kVerdict = 18,     // a=ErrorKind int, name=verdict label — terminal error
+};
+
+struct Event {
+  // 0 = never written; else the claiming writer's global index + 1,
+  // release-stored after the payload (the dumper's torn-slot check).
+  std::atomic<uint64_t> seq{0};
+  std::atomic<uint64_t> t_us{0};
+  std::atomic<uint64_t> a{0}, b{0}, c{0};
+  // Static string literal (phase kind, verdict label) or nullptr. Literals
+  // only: the dumper dereferences it at dump time, possibly from a signal
+  // handler, so the pointee must be immortal.
+  std::atomic<const char*> name{nullptr};
+  std::atomic<uint32_t> d{0};
+  std::atomic<uint8_t> kind{0};
+};
+
+struct Ring {
+  std::atomic<uint64_t> cursor{0};  // total events ever claimed
+  uint64_t mask = 0;                // capacity - 1 (capacity is a power of 2)
+  uint64_t capacity = 0;
+  Event* slots = nullptr;
+};
+
+namespace internal {
+// nullptr until InitRing() runs; stays nullptr forever when the recorder is
+// disabled (TPUNET_FLIGHTREC_EVENTS=0) — g_disabled distinguishes the two.
+extern std::atomic<Ring*> g_ring;
+extern std::atomic<bool> g_disabled;
+Ring* InitRing();  // idempotent; returns nullptr when disabled
+void RecordIn(Ring* r, Ev kind, uint64_t a, uint64_t b, uint64_t c, uint32_t d,
+              const char* name);
+}  // namespace internal
+
+// Hot-path event append. Safe from any thread at any time (including during
+// static teardown — the ring is leaked). No-op when disabled.
+inline void Record(Ev kind, uint64_t a, uint64_t b = 0, uint64_t c = 0,
+                   uint32_t d = 0, const char* name = nullptr) {
+#ifdef TPUNET_FLIGHTREC_DISABLED
+  (void)kind; (void)a; (void)b; (void)c; (void)d; (void)name;
+#else
+  Ring* r = internal::g_ring.load(std::memory_order_acquire);
+  if (r == nullptr) {
+    if (internal::g_disabled.load(std::memory_order_relaxed)) return;
+    r = internal::InitRing();
+    if (r == nullptr) return;
+  }
+  internal::RecordIn(r, kind, a, b, c, d, name);
+#endif
+}
+
+// Write the ring to <dir>/tpunet-flightrec-rank<R>.json (dir nullptr/"" =
+// the directory resolved at init: TPUNET_TRACE_DIR when set, else ".").
+// `reason` lands in the dump header; it is consumed synchronously (only
+// Record/DumpOnVerdict retain name pointers, so only those require
+// literals) but must not contain JSON-hostile characters — the dumper
+// writes it verbatim. Returns the full dump-path length (0 when the
+// recorder never initialized, is disabled, or the target is unwritable)
+// and NUL-truncates the path into out_path when cap allows — the
+// tpunet_c_metrics_text buffer-sizing contract. Async-signal-safe when
+// dir is nullptr.
+int Dump(const char* dir, const char* reason, char* out_path, uint64_t cap);
+
+// Terminal-verdict dump: records a kVerdict event and dumps to the default
+// directory, rate-limited to one dump per second so an error storm (every
+// rank's every request timing out at once) produces one file, not a disk
+// flood. `reason` must be a static literal.
+void DumpOnVerdict(const char* reason, uint64_t err_kind);
+
+// Recorder occupancy: events ever recorded (cursor) and ring capacity
+// (0/0 when disabled). For tests and tpunet_c_flightrec_stats.
+void Stats(uint64_t* recorded, uint64_t* capacity);
+
+}  // namespace flightrec
+}  // namespace tpunet
+
+#endif  // TPUNET_FLIGHTREC_H_

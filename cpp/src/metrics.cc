@@ -21,6 +21,7 @@
 #include <vector>
 
 #include "dispatch.h"
+#include "flightrec.h"
 #include "tpunet/mutex.h"
 #include "tpunet/utils.h"
 
@@ -296,6 +297,13 @@ struct Telemetry::Impl {
   CondVar push_cv;
   bool stopping GUARDED_BY(push_mu) = false;
 
+  // Counter-timeseries sampler (TPUNET_TS_INTERVAL_MS > 0): appends one full
+  // metric snapshot as a JSONL line per interval to
+  // tpunet-ts-rank<R>.jsonl — the measurement history benchmarks/sentry.py
+  // and offline regression triage replay. Shares push_mu/push_cv/stopping
+  // with the pusher for shutdown.
+  std::thread ts_sampler;
+
   // On-demand /metrics scrape listener (TPUNET_METRICS_PORT). The socket is
   // bound SYNCHRONOUSLY in the constructor so the chosen port (ephemeral
   // when the var is set to 0) is readable the moment the singleton exists.
@@ -422,6 +430,55 @@ Telemetry::Telemetry() : impl_(new Impl()) {
       }
     }
   }
+
+  // Counter-timeseries sampler (docs/DESIGN.md §6c): every
+  // TPUNET_TS_INTERVAL_MS, append the full Prometheus exposition as one
+  // JSONL line ({"t_us":...,"exposition":"..."}) so perf claims have a
+  // HISTORY, not just a final scrape. Off by default (0). One final sample
+  // is taken at shutdown so runs shorter than one interval still record.
+  uint64_t ts_interval_ms = GetEnvU64("TPUNET_TS_INTERVAL_MS", 0);
+  if (ts_interval_ms > 0 && RankGate()) {
+    RegisterAtExit();
+    std::string ts_dir = GetEnv("TPUNET_TRACE_DIR", ".");
+    if (ts_dir.empty()) ts_dir = ".";
+    std::string ts_path =
+        ts_dir + "/tpunet-ts-rank" + std::to_string(impl_->rank) + ".jsonl";
+    impl_->ts_sampler = std::thread([this, ts_path, ts_interval_ms] {
+      FILE* f = fopen(ts_path.c_str(), "a");
+      if (!f) return;
+      auto sample = [&] {
+        std::string expo = PrometheusText();
+        std::string esc;
+        esc.reserve(expo.size() + expo.size() / 8);
+        for (char ch : expo) {
+          if (ch == '"' || ch == '\\') {
+            esc += '\\';
+            esc += ch;
+          } else if (ch == '\n') {
+            esc += "\\n";
+          } else {
+            esc += ch;
+          }
+        }
+        fprintf(f, "{\"t_us\":%llu,\"exposition\":\"%s\"}\n",
+                (unsigned long long)NowUs(), esc.c_str());
+        fflush(f);
+      };
+      while (true) {
+        {
+          MutexLock lk(impl_->push_mu);
+          if (!impl_->stopping) {
+            impl_->push_cv.WaitFor(impl_->push_mu,
+                                   static_cast<int>(ts_interval_ms));
+          }
+          if (impl_->stopping) break;
+        }
+        sample();
+      }
+      sample();
+      fclose(f);
+    });
+  }
 }
 
 void Telemetry::ScrapeLoop(int lfd) {
@@ -431,17 +488,24 @@ void Telemetry::ScrapeLoop(int lfd) {
     if (pr <= 0) continue;
     int cfd = ::accept(lfd, nullptr, nullptr);
     if (cfd < 0) continue;
-    // Drain whatever request line arrived (any path gets the exposition;
-    // a scraper that sends nothing within the poll window still gets it).
+    // Drain whatever request line arrived. GET /healthz gets a tiny liveness
+    // 200 (the serving tier's probe endpoint); every other path gets the
+    // exposition — a scraper that sends nothing within the poll window
+    // still gets it.
     char reqbuf[1024];
+    ssize_t rn = 0;
     struct pollfd cpfd = {cfd, POLLIN, 0};
     if (::poll(&cpfd, 1, 250) > 0) {
-      (void)!::recv(cfd, reqbuf, sizeof(reqbuf), MSG_DONTWAIT);
+      rn = ::recv(cfd, reqbuf, sizeof(reqbuf) - 1, MSG_DONTWAIT);
     }
-    std::string body = PrometheusText();
+    if (rn < 0) rn = 0;
+    reqbuf[rn] = '\0';
+    bool healthz = strncmp(reqbuf, "GET /healthz", 12) == 0;
+    std::string body = healthz ? "ok\n" : PrometheusText();
     std::string resp =
-        "HTTP/1.1 200 OK\r\nContent-Type: text/plain; version=0.0.4\r\n"
-        "Content-Length: " + std::to_string(body.size()) +
+        std::string("HTTP/1.1 200 OK\r\nContent-Type: ") +
+        (healthz ? "text/plain" : "text/plain; version=0.0.4") +
+        "\r\nContent-Length: " + std::to_string(body.size()) +
         "\r\nConnection: close\r\n\r\n" + body;
     (void)!::send(cfd, resp.data(), resp.size(), MSG_NOSIGNAL);
     ::close(cfd);
@@ -457,13 +521,14 @@ void Telemetry::ShutdownForExit() {
   // have been captured locked at fork — skip the shutdown handshake
   // entirely; the parent owns the final flush.
   if (ForkGeneration() != impl_->created_fork_gen) return;
-  if (impl_->pusher.joinable()) {
+  if (impl_->pusher.joinable() || impl_->ts_sampler.joinable()) {
     {
       MutexLock lk(impl_->push_mu);
       impl_->stopping = true;
     }
     impl_->push_cv.NotifyAll();
-    impl_->pusher.join();
+    if (impl_->pusher.joinable()) impl_->pusher.join();
+    if (impl_->ts_sampler.joinable()) impl_->ts_sampler.join();
   }
   if (impl_->scraper.joinable()) {
     impl_->scrape_stop.store(true, std::memory_order_release);
@@ -503,6 +568,8 @@ void Telemetry::OnRequestStart(uint64_t owner, bool is_send, uint64_t comm, uint
     im->irecv_hist[HistBucket(nbytes)].fetch_add(1, std::memory_order_relaxed);
   }
   im->inflight.fetch_add(1, std::memory_order_relaxed);
+  flightrec::Record(flightrec::Ev::kReqStart, comm, req, nbytes,
+                    is_send ? 1u : 0u);
   if (tracing_enabled()) {
     Span s;
     s.kind = Span::Kind::kReq;
@@ -524,6 +591,7 @@ void Telemetry::OnRequestDone(uint64_t owner, uint64_t req, bool failed) {
          !im->inflight.compare_exchange_weak(cur, cur - 1, std::memory_order_relaxed)) {
   }
   if (failed) im->failed.fetch_add(1, std::memory_order_relaxed);
+  flightrec::Record(flightrec::Ev::kReqDone, req, 0, 0, failed ? 1u : 0u);
   if (!tracing_enabled()) return;
   bool flush = false;
   {
@@ -546,16 +614,20 @@ void Telemetry::OnStreamBytes(bool is_send, uint64_t stream_idx, uint64_t nbytes
   auto& slot = is_send ? impl_->stream_tx[cls][stream_idx]
                        : impl_->stream_rx[cls][stream_idx];
   slot.fetch_add(nbytes, std::memory_order_relaxed);
+  flightrec::Record(is_send ? flightrec::Ev::kWireSend : flightrec::Ev::kWireRecv,
+                    stream_idx, nbytes, 0, static_cast<uint32_t>(cls));
 }
 
 void Telemetry::OnQosQueueWait(int cls, uint64_t wait_us) {
   if (cls < 0 || cls >= kQosClassCount) return;
   impl_->qos_wait[cls].Observe(wait_us);
+  flightrec::Record(flightrec::Ev::kQosWait, static_cast<uint64_t>(cls), wait_us);
 }
 
 void Telemetry::OnQosPreempt(int cls) {
   if (cls < 0 || cls >= kQosClassCount) return;
   impl_->qos_preempts[cls].fetch_add(1, std::memory_order_relaxed);
+  flightrec::Record(flightrec::Ev::kQosPreempt, static_cast<uint64_t>(cls));
 }
 
 void Telemetry::MaybeSampleStream(bool is_send, uint64_t stream_idx, int fd) {
@@ -654,6 +726,7 @@ void Telemetry::OnLaneBytes(bool is_send, uint64_t lane, uint64_t nbytes) {
 
 void Telemetry::OnRestripe() {
   impl_->restripe_events.fetch_add(1, std::memory_order_relaxed);
+  flightrec::Record(flightrec::Ev::kRestripe, 0);
 }
 
 void Telemetry::OnShmBytes(bool is_send, uint64_t nbytes) {
@@ -702,14 +775,17 @@ void Telemetry::OnCollPhase(uint64_t comm_id, uint64_t coll_seq, const char* pha
 void Telemetry::OnFaultInjected(int action) {
   if (action < 0 || action >= kFaultActionSlots) return;
   impl_->faults_injected[action].fetch_add(1, std::memory_order_relaxed);
+  flightrec::Record(flightrec::Ev::kFault, static_cast<uint64_t>(action));
 }
 
 void Telemetry::OnStreamFailover() {
   impl_->stream_failovers.fetch_add(1, std::memory_order_relaxed);
+  flightrec::Record(flightrec::Ev::kFailover, 0);
 }
 
 void Telemetry::OnCrcError() {
   impl_->crc_errors.fetch_add(1, std::memory_order_relaxed);
+  flightrec::Record(flightrec::Ev::kCrcError, 0);
 }
 
 void Telemetry::OnServeLatency(int kind, uint64_t us) {
@@ -728,6 +804,7 @@ void Telemetry::OnServeQueueDepth(int tier, uint64_t depth) {
 void Telemetry::OnRewirePhase(int phase, uint64_t us) {
   if (phase < 0 || phase >= kRewirePhaseCount) return;
   impl_->rewire_phase[phase].Observe(us);
+  flightrec::Record(flightrec::Ev::kRewirePhase, static_cast<uint64_t>(phase), us);
 }
 
 void Telemetry::OnChurnEvent(int kind) {
@@ -742,6 +819,7 @@ void Telemetry::OnWorldSize(uint64_t world) {
 void Telemetry::OnSwapPhase(int phase, uint64_t us) {
   if (phase < 0 || phase >= kSwapPhaseCount) return;
   impl_->swap_phase[phase].Observe(us);
+  flightrec::Record(flightrec::Ev::kSwapPhase, static_cast<uint64_t>(phase), us);
 }
 
 void Telemetry::OnSwapEvent(int kind) {

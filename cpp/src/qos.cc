@@ -7,6 +7,7 @@
 #include <utility>
 #include <vector>
 
+#include "flightrec.h"
 #include "tpunet/telemetry.h"
 #include "tpunet/utils.h"
 
@@ -207,6 +208,10 @@ void QosScheduler::GrantFrontLocked(int cls) {
   w->granted = true;
   if (grant_log_) grant_log_->emplace_back(cls, w->bytes);
   if (report_) {
+    // report_ also gates the flight recorder: the DRR-golden throwaway sim
+    // replays thousands of synthetic grants that would drown the ring.
+    flightrec::Record(flightrec::Ev::kQosGrant, static_cast<uint64_t>(cls),
+                      w->bytes);
     // Preemption: this grant jumped ahead of an older waiter still queued
     // in another class — the scheduler chose priority over arrival order.
     for (int other = 0; other < kTrafficClassCount; ++other) {
@@ -228,6 +233,11 @@ void QosScheduler::PumpLocked() {
     GrantFrontLocked(kControlIdx);
   }
   if (!queues_[kControlIdx].empty()) {
+    if (report_) {
+      flightrec::Record(flightrec::Ev::kQosPause,
+                        static_cast<uint64_t>(kControlIdx),
+                        queues_[kControlIdx].front()->bytes);
+    }
     cv_.NotifyAll();
     return;
   }
@@ -254,6 +264,10 @@ void QosScheduler::PumpLocked() {
     int c = drr_turn_;
     while (!queues_[c].empty() && deficit_[c] >= queues_[c].front()->bytes) {
       if (!RoomLocked(queues_[c].front()->bytes)) {
+        if (report_) {
+          flightrec::Record(flightrec::Ev::kQosPause, static_cast<uint64_t>(c),
+                            queues_[c].front()->bytes);
+        }
         cv_.NotifyAll();
         return;  // window full mid-turn: resume here on the next pump
       }

@@ -22,6 +22,7 @@
 #include <string>
 #include <vector>
 
+#include "flightrec.h"
 #include "tpunet/mutex.h"
 #include "tpunet/net.h"
 #include "tpunet/utils.h"
@@ -240,6 +241,15 @@ struct RequestState {
       }
     }
     failed.store(true, std::memory_order_release);
+    // Terminal-verdict hook (docs/DESIGN.md §6c): the watchdog and CRC
+    // verdicts auto-dump the flight recorder AT the raise site — by the
+    // time the typed error surfaces through test()/wait() the interesting
+    // ring contents may already be lapped. Rate-limited inside.
+    if (k == ErrorKind::kTimeout) {
+      flightrec::DumpOnVerdict("watchdog", static_cast<uint64_t>(k));
+    } else if (k == ErrorKind::kCorruption) {
+      flightrec::DumpOnVerdict("corruption", static_cast<uint64_t>(k));
+    }
   }
   std::string ErrorMsg() {
     MutexLock lk(err_mu);

@@ -1,0 +1,207 @@
+"""Flight recorder (docs/DESIGN.md §6c): ring wraparound, dump-under-fire
+torn-read accounting, SIGUSR2 snapshots, disabled mode, and the counter
+timeseries sampler. The cross-rank hang postmortem lives in
+tests/test_postmortem.py; this file pins the single-rank recorder itself."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+
+from conftest import run_spawn_workers
+
+
+def _loopback_transfers(n: int, size: int = 1 << 18):
+    """Drive n loopback transfers through the native wire path, so the
+    recorder accumulates wire/req events. Returns after the data landed."""
+    import numpy as np
+
+    from tpunet.transport import Net
+
+    net = Net()
+    listen = net.listen(0)
+    import threading
+
+    rc_holder = {}
+    t = threading.Thread(target=lambda: rc_holder.update(rc=listen.accept()))
+    t.start()
+    sc = net.connect(listen.handle)
+    t.join()
+    rc = rc_holder["rc"]
+    data = np.arange(size, dtype=np.uint8) % 251
+    buf = np.zeros(size, dtype=np.uint8)
+    for _ in range(n):
+        req = rc.irecv(buf)
+        sc.send(data, timeout=60)
+        req.wait(timeout=60)
+    sc.close()
+    rc.close()
+    listen.close()
+    net.close()
+
+
+def _wraparound_worker(rank: int, world: int, port: int, q, tmpdir) -> None:
+    """Tiny ring (64 slots) + enough traffic to lap it several times: the
+    dump must report recorded > capacity, dropped = recorded - capacity,
+    and carry exactly `capacity` events — the newest window, not garbage."""
+    try:
+        os.environ["TPUNET_FLIGHTREC_EVENTS"] = "64"
+        os.environ["TPUNET_TRACE_DIR"] = tmpdir
+        os.environ["TPUNET_RANK"] = str(rank)
+        from tpunet import telemetry
+
+        _loopback_transfers(40)
+
+        recorded, capacity = telemetry.flightrec_stats()
+        assert capacity == 64, f"pow2 ring capacity: {capacity}"
+        assert recorded > capacity, f"ring never wrapped: {recorded}"
+
+        # On-demand dump to an explicit directory.
+        path = telemetry.flightrec_dump(tmpdir, reason="unit-test")
+        assert os.path.dirname(path) == tmpdir
+        with open(path) as f:
+            d = json.load(f)
+        assert d["schema"] == "tpunet-flightrec-v1"
+        assert d["rank"] == rank
+        assert d["reason"] == "unit-test"
+        assert d["capacity"] == 64
+        assert d["recorded"] > 64
+        assert d["dropped"] == d["recorded"] - 64
+        assert len(d["events"]) == 64
+        # Quiesced dump: no slot was mid-write.
+        assert d["torn"] == 0
+        kinds = {ev["kind"] for ev in d["events"]}
+        assert kinds & {"wire_send", "wire_recv", "req_start", "req_done"}, kinds
+        ts = [ev["t"] for ev in d["events"]]
+        assert ts == sorted(ts), "ring replay must be time-ordered"
+
+        # SIGUSR2: the async-signal-safe handler overwrites the default dump
+        # path; poll because delivery may land on another thread.
+        os.kill(os.getpid(), signal.SIGUSR2)
+        default = os.path.join(tmpdir, f"tpunet-flightrec-rank{rank}.json")
+        deadline = time.monotonic() + 10
+        sig = None
+        while time.monotonic() < deadline:
+            try:
+                with open(default) as f:
+                    sig = json.load(f)
+                if sig.get("reason") == "sigusr2":
+                    break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.05)
+        assert sig and sig["reason"] == "sigusr2", f"no SIGUSR2 dump: {sig}"
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def test_flightrec_wraparound_and_sigusr2(tmp_path):
+    run_spawn_workers(_wraparound_worker, 1, extra_args=(str(tmp_path),))
+
+
+def _disabled_worker(rank: int, world: int, port: int, q, tmpdir) -> None:
+    """TPUNET_FLIGHTREC_EVENTS=0 compiles the recorder out at runtime: a
+    dump request is a typed error, not a zero-event file."""
+    try:
+        os.environ["TPUNET_FLIGHTREC_EVENTS"] = "0"
+        from tpunet import _native, telemetry
+
+        _loopback_transfers(2)
+        try:
+            telemetry.flightrec_dump(tmpdir)
+            q.put((rank, "FAIL: dump succeeded with recorder disabled"))
+            return
+        except _native.NativeError:
+            pass
+        # The never-raises verdict hook degrades to None, not an exception.
+        assert telemetry.flightrec_dump_verdict("unit") is None
+        assert telemetry.flightrec_stats() == (0, 0)
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def test_flightrec_disabled(tmp_path):
+    run_spawn_workers(_disabled_worker, 1, extra_args=(str(tmp_path),))
+
+
+def _torn_worker(rank: int, world: int, port: int, q, tmpdir) -> None:
+    """Dump while the wire keeps recording: every snapshot must parse as
+    valid JSON with sane accounting. Torn slots (writer mid-flight during
+    the copy) are counted, never emitted as garbage events."""
+    try:
+        os.environ["TPUNET_FLIGHTREC_EVENTS"] = "256"
+        os.environ["TPUNET_TRACE_DIR"] = tmpdir
+        import threading
+
+        from tpunet import telemetry
+
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                _loopback_transfers(4, size=1 << 14)
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        try:
+            torn_total = 0
+            for i in range(10):
+                path = telemetry.flightrec_dump(tmpdir, reason=f"fire-{i}")
+                with open(path) as f:
+                    d = json.load(f)  # must parse even mid-traffic
+                assert len(d["events"]) <= d["capacity"]
+                assert d["torn"] >= 0
+                torn_total += d["torn"]
+                for ev in d["events"]:
+                    assert isinstance(ev["t"], int) and ev["kind"], ev
+        finally:
+            stop.set()
+            t.join(timeout=30)
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def test_flightrec_dump_under_fire(tmp_path):
+    run_spawn_workers(_torn_worker, 1, extra_args=(str(tmp_path),))
+
+
+def _ts_worker(rank: int, world: int, port: int, q, tmpdir) -> None:
+    """TPUNET_TS_INTERVAL_MS>0 appends full-exposition snapshots as JSONL —
+    the measurement history the perf sentry and dashboards replay."""
+    try:
+        os.environ["TPUNET_TS_INTERVAL_MS"] = "50"
+        os.environ["TPUNET_TRACE_DIR"] = tmpdir
+        os.environ["TPUNET_RANK"] = str(rank)
+        from tpunet import telemetry
+
+        telemetry.metrics_text()  # construct the singleton -> sampler starts
+        _loopback_transfers(2)
+        path = os.path.join(tmpdir, f"tpunet-ts-rank{rank}.jsonl")
+        deadline = time.monotonic() + 15
+        lines = []
+        while time.monotonic() < deadline:
+            if os.path.exists(path):
+                with open(path) as f:
+                    lines = [ln for ln in f.read().splitlines() if ln.strip()]
+                if len(lines) >= 3:
+                    break
+            time.sleep(0.05)
+        assert len(lines) >= 3, f"sampler wrote {len(lines)} lines"
+        last_t = -1
+        for ln in lines:
+            snap = json.loads(ln)  # every line is one standalone JSON object
+            assert snap["t_us"] > last_t
+            last_t = snap["t_us"]
+            assert "tpunet_isend_nbytes" in snap["exposition"]
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def test_counter_timeseries_sampler(tmp_path):
+    run_spawn_workers(_ts_worker, 1, extra_args=(str(tmp_path),))

@@ -1,0 +1,187 @@
+"""Cross-rank hang postmortem (tools/postmortem.py, docs/DESIGN.md §6c).
+
+The headline scenario: a W=4 ring allreduce with one rank's send stalled by
+fault injection hits the progress watchdog; every rank's flight recorder
+dumps at the verdict site; the postmortem merges the four dumps and NAMES
+the wedged rank and phase. Plus deterministic unit tests of the lattice and
+diagnosis over hand-built dumps (synthetic dumps make the corner cases —
+behind ranks, bootstrap hangs — reproducible without faulting real wires).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from conftest import run_spawn_workers
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.postmortem import diagnose, load_dumps, phase_lattice  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# Synthetic dumps: deterministic lattice/diagnosis pinning.
+
+
+def _dump(rank, events, host="deadbeef00000000"):
+    return {"schema": "tpunet-flightrec-v1", "rank": rank, "host": host,
+            "reason": "watchdog", "capacity": 1024, "recorded": len(events),
+            "dropped": 0, "events": events, "torn": 0}
+
+
+def _phase(kind, t, comm, seq, name, step, nbytes=4096):
+    return {"t": t, "kind": kind, "a": comm, "b": seq, "c": nbytes,
+            "d": step, "name": name}
+
+
+def test_phase_lattice_pairs_enter_exit():
+    d = _dump(0, [
+        _phase("phase_enter", 100, 7, 41, "rs", 0),
+        _phase("phase_exit", 200, 7, 41, "rs", 0),
+        _phase("phase_enter", 210, 7, 41, "rs", 1),
+    ])
+    lat = phase_lattice([d])
+    spans = lat[(7, 41)][0]
+    assert len(spans) == 2
+    assert spans[0] == {"name": "rs", "step": 0, "enter_t": 100,
+                        "exit_t": 200, "nbytes": 4096}
+    assert spans[1]["exit_t"] is None  # still open: the wedge signature
+
+
+def test_diagnose_names_stalled_rank_and_phase():
+    # rank 0 completed the frontier; rank 1 wedged in rs.2; rank 2 never
+    # entered it (its newest collective is coll_seq=40).
+    dumps = [
+        _dump(0, [_phase("phase_enter", 100, 7, 41, "rs", s)
+                  for s in range(3)] +
+                 [_phase("phase_exit", 110 + s, 7, 41, "rs", s)
+                  for s in range(3)]),
+        _dump(1, [_phase("phase_enter", 100, 7, 41, "rs", 2),
+                  {"t": 5000000, "kind": "verdict", "a": 3,
+                   "name": "watchdog"}]),
+        _dump(2, [_phase("phase_enter", 90, 7, 40, "ag", 1),
+                  _phase("phase_exit", 95, 7, 40, "ag", 1)]),
+    ]
+    diag = diagnose(dumps)
+    assert diag["frontier"] == {"comm_id": 7, "coll_seq": 41}
+    assert diag["stalled"] == [{"rank": 1, "phase": "rs.2", "coll_seq": 41,
+                                "since_us": 5000000 - 100}]
+    assert diag["behind"] == [{"rank": 2, "last_coll_seq": 40}]
+    assert diag["complete"] == [0]
+    assert diag["verdicts"] == [{"rank": 1, "reason": "watchdog",
+                                 "t": 5000000}]
+    joined = "\n".join(diag["lines"])
+    assert "rank 1 in rs.2" in joined and "wedged" in joined
+
+
+def test_diagnose_bootstrap_hang():
+    # No phase events at all: the job died before its first collective.
+    dumps = [_dump(0, [{"t": 10, "kind": "verdict", "a": 3,
+                        "name": "watchdog"}])]
+    diag = diagnose(dumps)
+    assert diag["frontier"] is None
+    assert "predates the first collective" in diag["lines"][0]
+
+
+def test_load_dumps_rejects_wrong_schema(tmp_path):
+    p = tmp_path / "tpunet-flightrec-rank0.json"
+    p.write_text(json.dumps({"schema": "nope", "events": []}))
+    with pytest.raises(ValueError, match="tpunet-flightrec-v1"):
+        load_dumps([str(tmp_path)])
+
+
+# ---------------------------------------------------------------------------
+# The real thing: W=4 stalled collective -> watchdog -> 4 dumps -> diagnosis.
+
+
+def _hang_worker(rank: int, world: int, port: int, q, tmpdir) -> None:
+    try:
+        os.environ.update({
+            "TPUNET_TRACE_DIR": tmpdir,
+            "TPUNET_RANK": str(rank),
+            "TPUNET_PROGRESS_TIMEOUT_MS": "2500",
+            "TPUNET_ALGO": "ring",
+            "TPUNET_NSTREAMS": "1", "TPUNET_ASYNC_CHANNELS": "1",
+        })
+        import numpy as np
+
+        from tpunet import _native as nat
+        from tpunet import telemetry
+        from tpunet import transport as tp
+        from tpunet.collectives import Communicator
+
+        comm = Communicator(f"127.0.0.1:{port}", rank, world)
+        warm = comm.all_reduce(np.ones(4, np.float32))
+        assert warm[0] == world
+        comm.barrier()
+        if rank == 1:
+            # Rank 1's ring sends die after 256KiB of the measured 4MiB
+            # allreduce: its neighbor starves mid reduce-scatter, the stall
+            # propagates, and every watchdog fires.
+            tp.fault_inject("stream=*:side=send:after_bytes=256K:action=stall")
+        arr = np.full(1 << 20, float(rank + 1), np.float32)
+        try:
+            comm.all_reduce(arr)
+            q.put((rank, "FAIL: stalled allreduce completed"))
+            return
+        except nat.NativeError:
+            pass
+        # The watchdog's verdict dump is the native path under test; a rank
+        # that got a secondary error (peer teardown) instead snapshots on
+        # demand so the postmortem always sees all four ranks.
+        path = os.path.join(tmpdir, f"tpunet-flightrec-rank{rank}.json")
+        if not os.path.exists(path):
+            telemetry.flightrec_dump(tmpdir, reason="teardown")
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+    finally:
+        try:
+            from tpunet import transport as tp
+
+            tp.fault_clear()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def test_hang_postmortem_w4(tmp_path):
+    run_spawn_workers(_hang_worker, 4, extra_args=(str(tmp_path),))
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if len(list(tmp_path.glob("tpunet-flightrec-rank*.json"))) >= 4:
+            break
+        time.sleep(0.1)
+    dumps = load_dumps([str(tmp_path)])
+    assert len(dumps) == 4, [d["_path"] for d in dumps]
+    assert [d["rank"] for d in dumps] == [0, 1, 2, 3]
+
+    diag = diagnose(dumps)
+    assert diag["frontier"] is not None
+    # At least one watchdog verdict made it into a ring (the native
+    # dump-at-raise-site path, not the python fallback).
+    assert any(v["reason"] == "watchdog" for v in diag["verdicts"]), \
+        diag["verdicts"]
+    # The diagnosis names wedged ranks in a reduce-scatter/allgather phase.
+    wedged = diag["stalled"] + diag["behind"]
+    assert wedged, diag["lines"]
+    for s in diag["stalled"]:
+        assert s["phase"].split(".")[0] in ("rs", "ag", "allreduce"), s
+    joined = "\n".join(diag["lines"])
+    assert "diagnosis:" in joined
+
+    # The merged Perfetto timeline ingests the same dumps (satellite c).
+    from tpunet import telemetry
+
+    out = telemetry.merge_traces(str(tmp_path))
+    with open(out) as f:
+        merged = json.load(f)
+    names = {e.get("name", "") for e in merged if e.get("ph") == "i"}
+    assert any(n.startswith(("phase_enter", "wire_", "verdict"))
+               for n in names), sorted(names)[:20]

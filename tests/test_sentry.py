@@ -1,0 +1,107 @@
+"""Perf-regression sentry (benchmarks/sentry.py): deterministic claim
+evaluation over canned measurements. Goes green on numbers the checked-in
+baseline accepts, RED on the impossible fixture baseline — proving the CI
+gate can actually fail, not just rubber-stamp. The live measurement path
+(spawned engines + collectives) runs in the CI sentry_smoke lane, not here."""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from benchmarks.sentry import GROUPS, check, main  # noqa: E402
+
+# Healthy numbers (the live 2026-08 measurement, see docs/SENTRY_BASELINE.json's
+# comment) — every claim in the checked-in baseline accepts these.
+HEALTHY = {
+    "basic_syscalls_per_mib": 0.188,
+    "epoll_syscalls_per_mib": 0.414,
+    "basic_busbw_gbps": 1.8,
+    "codec_wire_ratio_bf16_over_f32": 0.5,
+    "ring_steps_w4": 6,
+    "hier_dcn_fraction_w4": 0.3333,
+}
+
+
+def _baseline():
+    with open(REPO / "docs" / "SENTRY_BASELINE.json") as f:
+        return json.load(f)
+
+
+def _red_baseline():
+    with open(REPO / "tests" / "fixtures" / "sentry_red_baseline.json") as f:
+        return json.load(f)
+
+
+def test_groups_cover_all_baseline_claims():
+    """Every baseline claim maps to a measurement group (else a regression
+    in it could never re-measure) and every HEALTHY key is claimed."""
+    group_keys = {k for keys in GROUPS.values() for k in keys}
+    for key in _baseline()["claims"]:
+        assert key in group_keys, f"claim {key} has no measurement group"
+    assert set(HEALTHY) == set(_baseline()["claims"])
+
+
+def test_sentry_green_on_healthy_measurements():
+    verdict = check(_baseline(), measurements=HEALTHY)
+    assert verdict["ok"], verdict["claims"]
+    assert all(c["verdict"] == "ok" for c in verdict["claims"].values())
+
+
+def test_sentry_red_on_impossible_fixture():
+    """The same healthy numbers violate every claim of the red fixture —
+    the sentry must fail loudly (exit 1 through main) with per-claim
+    REGRESSION verdicts, no re-measure in canned mode."""
+    verdict = check(_red_baseline(), measurements=HEALTHY)
+    assert not verdict["ok"]
+    regressions = [k for k, c in verdict["claims"].items()
+                   if c["verdict"] == "REGRESSION"]
+    assert set(regressions) == set(_red_baseline()["claims"])
+    # max/min/equals violations all render a human-readable detail.
+    assert "!=" in verdict["claims"]["ring_steps_w4"]["detail"]
+    assert ">" in verdict["claims"]["basic_syscalls_per_mib"]["detail"]
+
+
+def test_sentry_cli_red_exit_code(tmp_path):
+    meas = tmp_path / "meas.json"
+    meas.write_text(json.dumps(HEALTHY))
+    out = tmp_path / "verdict.json"
+    rc = main(["--check",
+               "--baseline", str(REPO / "tests" / "fixtures" /
+                                 "sentry_red_baseline.json"),
+               "--measurements", str(meas), "--json", str(out)])
+    assert rc == 1
+    verdict = json.loads(out.read_text())
+    assert not verdict["ok"]
+
+    rc = main(["--check", "--measurements", str(meas)])
+    assert rc == 0  # checked-in baseline accepts the healthy numbers
+
+
+def test_sentry_single_regression_is_isolated():
+    """One bad number reds only its own claim."""
+    bad = dict(HEALTHY, codec_wire_ratio_bf16_over_f32=1.0)  # codec gone
+    verdict = check(_baseline(), measurements=bad)
+    assert not verdict["ok"]
+    wrong = {k for k, c in verdict["claims"].items()
+             if c["verdict"] == "REGRESSION"}
+    assert wrong == {"codec_wire_ratio_bf16_over_f32"}
+
+
+def test_sentry_missing_measurement_is_a_regression():
+    part = {k: v for k, v in HEALTHY.items() if k != "ring_steps_w4"}
+    verdict = check(_baseline(), measurements=part)
+    assert not verdict["ok"]
+    assert verdict["claims"]["ring_steps_w4"]["detail"] == "no measurement"
+
+
+def test_sentry_rejects_unknown_schema():
+    with pytest.raises(ValueError, match="tpunet-sentry-v1"):
+        check({"schema": "tpunet-sentry-v2", "claims": {}},
+              measurements=HEALTHY)

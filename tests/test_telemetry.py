@@ -281,6 +281,87 @@ def test_metrics_reset():
     run_spawn_workers(_reset_worker, 1)
 
 
+# Families that legitimately do NOT sample zero after reset(). Every entry
+# needs a reason; anything else nonzero after reset is a coverage bug the
+# registry-driven test below reports by name.
+_RESET_EXCEPTIONS = {
+    # Jain fairness is a ratio in (0, 1]; the no-traffic value is a perfect 1.0.
+    "tpunet_stream_fairness_jain": 1.0,
+    # Encoded/payload wire ratio; identity (no codec engaged) reads 1.0.
+    "tpunet_codec_wire_ratio": 1.0,
+    # Deliberately NOT reset: it tracks live requests whose done events will
+    # still arrive — zeroing mid-flight would wrap the clamp (metrics.cc).
+    "tpunet_hold_on_request": None,
+}
+
+
+def _registry_reset_worker(rank: int, world: int, port: int, q, fams_json) -> None:
+    """Registry-driven reset coverage: every family metrics.cc registers
+    (parsed by tools/lint/metricsreg.py, passed in as JSON) samples zero
+    after reset() — or appears in _RESET_EXCEPTIONS with a reason. A new
+    family added without reset plumbing fails here by name, not by a
+    dashboard going stale three PRs later."""
+    try:
+        import numpy as np
+
+        from tpunet import telemetry
+        from tpunet.transport import Net
+
+        families = json.loads(fams_json)
+        assert len(families) > 40, f"suspiciously small registry: {families}"
+
+        net = Net()
+        listen = net.listen(0)
+        import threading
+
+        rc_holder = {}
+        t = threading.Thread(target=lambda: rc_holder.update(rc=listen.accept()))
+        t.start()
+        sc = net.connect(listen.handle)
+        t.join()
+        rc = rc_holder["rc"]
+        data = np.arange(1 << 20, dtype=np.uint8) % 251
+        buf = np.zeros(1 << 20, dtype=np.uint8)
+        req = rc.irecv(buf)
+        sc.send(data, timeout=60)
+        req.wait(timeout=60)
+
+        telemetry.reset()
+        m = telemetry.metrics()
+        bad = []
+        for fam in families:
+            if fam in _RESET_EXCEPTIONS and _RESET_EXCEPTIONS[fam] is None:
+                continue
+            want = _RESET_EXCEPTIONS.get(fam, 0)
+            # Histogram series surface as separate top-level parser keys.
+            for series in (fam, fam + "_bucket", fam + "_sum", fam + "_count"):
+                for labels, value in m.get(series, {}).items():
+                    if value != want:
+                        bad.append(f"{series}{{{','.join(labels)}}} = {value} "
+                                   f"(want {want} after reset)")
+        assert not bad, "families nonzero after reset():\n  " + "\n  ".join(bad)
+
+        sc.close()
+        rc.close()
+        listen.close()
+        net.close()
+        q.put((rank, "OK"))
+    except Exception as e:  # noqa: BLE001
+        q.put((rank, f"FAIL: {type(e).__name__}: {e}"))
+
+
+def test_registry_reset_coverage():
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    sys.path.insert(0, str(repo))
+    from tools.lint.metricsreg import registry_families
+
+    fams = sorted(registry_families(repo))
+    run_spawn_workers(_registry_reset_worker, 1, extra_args=(json.dumps(fams),))
+
+
 def _profile_worker(rank: int, world: int, port: int, q, trace_dir: str) -> None:
     """profile() enables tracing at RUNTIME (no TPUNET_TRACE_DIR at load)."""
     try:
@@ -357,6 +438,25 @@ def _scrape_worker(rank: int, world: int, port: int, q, scrape_port: str) -> Non
         assert "tpunet_isend_nbytes_count" in text
         assert "# HELP tpunet_isend_nbytes" in text
         _lint_exposition(text)
+
+        # Framing: Prometheus scrapers key on the versioned Content-Type and
+        # an exact Content-Length (the listener closes after one response).
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{scrape_port}/metrics", timeout=5) as r:
+            body = r.read()
+            assert r.headers["Content-Type"] == "text/plain; version=0.0.4"
+            assert int(r.headers["Content-Length"]) == len(body)
+        # Liveness endpoint: /healthz answers 200 "ok" without rendering the
+        # full exposition — what a k8s probe polls at 1 Hz.
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{scrape_port}/healthz", timeout=5) as r:
+            body = r.read()
+            assert r.status == 200
+            assert body == b"ok\n"
+            assert r.headers["Content-Type"] == "text/plain"
+            assert int(r.headers["Content-Length"]) == len(body)
         q.put((rank, "OK"))
     except Exception as e:  # noqa: BLE001
         q.put((rank, f"FAIL: {type(e).__name__}: {e}"))

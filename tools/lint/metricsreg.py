@@ -84,6 +84,18 @@ PARSER_FIXTURES = {
 }
 
 
+def registry_families(root: Path) -> set[str]:
+    """The set of metric families registered in cpp/src/metrics.cc.
+
+    Shared with tests/test_telemetry.py's registry-driven reset test: every
+    family the C++ layer declares must sample zero after ``reset()`` (modulo
+    a short, documented exception list) — generated from the same parse the
+    lint checker uses, so a newly registered family is reset-covered on the
+    day it lands or the test names it."""
+    metrics_cc = Path(root) / "cpp" / "src" / "metrics.cc"
+    return set(_registrations(strip_c_comments(read_text(metrics_cc))))
+
+
 def _base_family(name: str) -> str:
     for suffix in _SERIES_SUFFIXES:
         if name.endswith(suffix):

@@ -1,0 +1,235 @@
+"""Cross-rank flight-recorder postmortem (docs/DESIGN.md §6c).
+
+Merges N ranks' flight-recorder dumps (``tpunet-flightrec-rank*.json``,
+written on watchdog/CRC verdicts, SIGUSR2, or on demand) and reconstructs
+the per-phase lattice of every collective, aligned on ``(comm_id,
+coll_seq)`` — the tags every rank stamps identically because the schedule
+is deterministic. From the lattice it names a diagnosis a human would
+otherwise grep four files for::
+
+    frontier: comm_id=7f3a... coll_seq=41
+    rank 3 entered rs.2 of coll_seq=41, never exited (stalled 1840 ms)
+    rank 0 completed coll_seq=41; parked waiting on peers
+    verdicts: rank 0 watchdog, rank 2 watchdog
+
+The mechanics: a ``phase_enter`` event records BEFORE any wire I/O of that
+phase and ``phase_exit`` on scope exit, so a rank wedged mid-collective
+shows an enter with no exit — the recorder's reason for existing. A rank
+whose newest ``(comm_id, coll_seq)`` trails the frontier never submitted
+the frontier collective (died or diverged earlier).
+
+CLI::
+
+    python -m tools.postmortem DIR [--json] [--perfetto [OUT]]
+
+``DIR`` holds the per-rank dumps (TPUNET_TRACE_DIR of the dead job; any
+explicit file list works too). ``--json`` emits the machine-readable
+diagnosis; ``--perfetto`` additionally merges the dumps (and any trace
+files beside them) into one timeline via ``telemetry.merge_traces()``.
+The library surface (``load_dumps``, ``phase_lattice``, ``diagnose``) is
+what tests/test_postmortem.py pins.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def load_dumps(paths: list[str]) -> list[dict]:
+    """Load flight-recorder dumps from explicit files and/or directories
+    (directories are globbed for ``tpunet-flightrec-rank*.json``). Sorted
+    by rank; a dump whose schema is not tpunet-flightrec-v1 is rejected
+    loudly rather than mis-parsed."""
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(
+                glob.glob(os.path.join(p, "tpunet-flightrec-rank*.json"))))
+        else:
+            files.append(p)
+    if not files:
+        raise FileNotFoundError(
+            f"no tpunet-flightrec-rank*.json dumps under {paths}")
+    dumps = []
+    for f in files:
+        with open(f) as fh:
+            d = json.load(fh)
+        if d.get("schema") != "tpunet-flightrec-v1":
+            raise ValueError(f"{f}: not a tpunet-flightrec-v1 dump "
+                             f"(schema={d.get('schema')!r})")
+        d["_path"] = f
+        dumps.append(d)
+    dumps.sort(key=lambda d: d.get("rank", 0))
+    return dumps
+
+
+def phase_lattice(dumps: list[dict]) -> dict:
+    """{(comm_id, coll_seq): {rank: [phase dict, ...]}} where each phase is
+    {"name", "step", "enter_t", "exit_t" (None = never exited), "nbytes"}.
+
+    Enter/exit events pair in per-rank program order per (comm_id,
+    coll_seq, name, step) — the recorder is per-rank sequential for one
+    collective, so a simple open-span stack per key suffices."""
+    lattice: dict = {}
+    for d in dumps:
+        rank = d.get("rank", 0)
+        open_spans: dict = {}
+        for ev in d.get("events", []):
+            kind = ev.get("kind")
+            if kind not in ("phase_enter", "phase_exit"):
+                continue
+            key = (ev.get("a"), ev.get("b"))  # (comm_id, coll_seq)
+            pkey = (key, ev.get("name"), ev.get("d"))
+            if kind == "phase_enter":
+                span = {"name": ev.get("name"), "step": ev.get("d"),
+                        "enter_t": ev.get("t"), "exit_t": None,
+                        "nbytes": ev.get("c")}
+                lattice.setdefault(key, {}).setdefault(rank, []).append(span)
+                open_spans.setdefault(pkey, []).append(span)
+            else:
+                stack = open_spans.get(pkey)
+                if stack:
+                    stack.pop()["exit_t"] = ev.get("t")
+    return lattice
+
+
+def _fmt_phase(span: dict) -> str:
+    name = span.get("name") or "?"
+    step = span.get("step")
+    return f"{name}.{step}" if step is not None else name
+
+
+def diagnose(dumps: list[dict]) -> dict:
+    """The postmortem verdict. Returns::
+
+        {"frontier": {"comm_id", "coll_seq"} | None,
+         "stalled":  [{"rank", "phase", "coll_seq", "since_us"}],
+         "behind":   [{"rank", "last_coll_seq"}],
+         "complete": [rank, ...],           # finished the frontier
+         "verdicts": [{"rank", "reason", "t"}],
+         "lines":    [human-readable diagnosis, ...]}
+
+    ``stalled`` = ranks holding an un-exited phase of the frontier
+    collective (the wedge); ``behind`` = ranks that never entered it
+    (death or divergence upstream); ``since_us`` is measured against that
+    rank's newest event (per-host monotonic clocks are unrelated, so no
+    cross-rank time arithmetic is attempted)."""
+    lattice = phase_lattice(dumps)
+    verdicts = []
+    for d in dumps:
+        for ev in d.get("events", []):
+            if ev.get("kind") == "verdict":
+                verdicts.append({"rank": d.get("rank", 0),
+                                 "reason": ev.get("name") or "?",
+                                 "t": ev.get("t")})
+    out = {"frontier": None, "stalled": [], "behind": [], "complete": [],
+           "verdicts": verdicts, "lines": []}
+    if not lattice:
+        out["lines"].append(
+            "no collective phase events in any dump — the hang predates the "
+            "first collective (bootstrap/rendezvous?); check verdicts and "
+            "wire events")
+        for v in verdicts:
+            out["lines"].append(
+                f"verdict: rank {v['rank']} {v['reason']} (t={v['t']})")
+        return out
+
+    # The frontier: the newest collective ANY rank reached, per comm (the
+    # highest coll_seq of the comm with the highest activity). Collectives
+    # are submitted in identical program order on every rank, so the
+    # frontier is where the job wedged.
+    frontier = max(lattice, key=lambda k: (k[1] if k[1] is not None else -1))
+    comm_id, coll_seq = frontier
+    out["frontier"] = {"comm_id": comm_id, "coll_seq": coll_seq}
+    out["lines"].append(f"frontier: comm_id={comm_id} coll_seq={coll_seq} "
+                        f"({len(lattice)} collective(s) observed)")
+
+    all_ranks = sorted({d.get("rank", 0) for d in dumps})
+    last_ev_t = {d.get("rank", 0): max(
+        (ev.get("t", 0) for ev in d.get("events", [])), default=0)
+        for d in dumps}
+    per_rank = lattice.get(frontier, {})
+    for rank in all_ranks:
+        spans = per_rank.get(rank)
+        if not spans:
+            last = max((k[1] for k, ranks in lattice.items()
+                        if rank in ranks and k[1] is not None), default=None)
+            out["behind"].append({"rank": rank, "last_coll_seq": last})
+            out["lines"].append(
+                f"rank {rank} never entered coll_seq={coll_seq} "
+                f"(last observed coll_seq={last}) — died or diverged "
+                f"upstream of the frontier")
+            continue
+        open_spans = [s for s in spans if s["exit_t"] is None]
+        if open_spans:
+            s = open_spans[-1]
+            since = max(0, last_ev_t[rank] - (s["enter_t"] or 0))
+            out["stalled"].append({"rank": rank, "phase": _fmt_phase(s),
+                                   "coll_seq": coll_seq, "since_us": since})
+            done = [x for x in spans if x["exit_t"] is not None]
+            prior = f" after completing {_fmt_phase(done[-1])}" if done else ""
+            out["lines"].append(
+                f"rank {rank} entered {_fmt_phase(s)} of "
+                f"coll_seq={coll_seq}{prior}, never exited "
+                f"(stalled {since // 1000} ms by its own clock)")
+        else:
+            out["complete"].append(rank)
+            out["lines"].append(
+                f"rank {rank} completed every phase of coll_seq={coll_seq} "
+                f"it entered (last: {_fmt_phase(spans[-1])}); parked waiting "
+                f"on peers")
+    for v in verdicts:
+        out["lines"].append(
+            f"verdict: rank {v['rank']} {v['reason']} (t={v['t']})")
+    if out["stalled"]:
+        culprits = ", ".join(
+            f"rank {s['rank']} in {s['phase']}" for s in out["stalled"])
+        out["lines"].append(f"diagnosis: {culprits} of coll_seq={coll_seq} "
+                            f"wedged the collective; peers parked in WaitIn")
+    elif out["behind"]:
+        ranks = ", ".join(str(b["rank"]) for b in out["behind"])
+        out["lines"].append(
+            f"diagnosis: rank(s) {ranks} never reached coll_seq={coll_seq} "
+            f"— look for death/divergence before the frontier")
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.postmortem", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="+",
+                    help="dump directory (TPUNET_TRACE_DIR of the dead job) "
+                         "or explicit tpunet-flightrec-rank*.json files")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable diagnosis")
+    ap.add_argument("--perfetto", nargs="?", const="", metavar="OUT",
+                    help="also merge dumps (+ any trace files beside them) "
+                         "into one Perfetto timeline via "
+                         "telemetry.merge_traces()")
+    args = ap.parse_args(argv)
+    dumps = load_dumps(args.paths)
+    diag = diagnose(dumps)
+    if args.json:
+        print(json.dumps(diag, indent=2))
+    else:
+        print(f"postmortem over {len(dumps)} rank dump(s): "
+              + ", ".join(os.path.basename(d["_path"]) for d in dumps))
+        for line in diag["lines"]:
+            print("  " + line)
+    if args.perfetto is not None:
+        from tpunet import telemetry
+        trace_dir = args.paths[0] if os.path.isdir(args.paths[0]) \
+            else os.path.dirname(args.paths[0]) or "."
+        out = telemetry.merge_traces(trace_dir,
+                                     out_path=args.perfetto or None)
+        print(f"perfetto timeline: {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

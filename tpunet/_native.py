@@ -242,6 +242,11 @@ def load() -> ctypes.CDLL:
     lib.tpunet_c_swap_event.restype = i32
     lib.tpunet_c_weight_version.argtypes = [u64]
     lib.tpunet_c_weight_version.restype = i32
+    lib.tpunet_c_flightrec_dump.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, u64]
+    lib.tpunet_c_flightrec_dump.restype = i32
+    lib.tpunet_c_flightrec_stats.argtypes = [P(u64), P(u64)]
+    lib.tpunet_c_flightrec_stats.restype = i32
     lib.tpunet_c_crc32c.argtypes = [ctypes.c_void_p, u64, ctypes.c_uint32]
     lib.tpunet_c_crc32c.restype = ctypes.c_uint32
     lib.tpunet_c_host_id.argtypes = []

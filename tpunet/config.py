@@ -316,6 +316,12 @@ class Config:
     straggler_min_rtt_us: int = 1000
     # Pushgateway PUT period when TPUNET_METRICS_ADDR is set.
     metrics_interval_ms: int = 1000
+    # Flight-recorder ring capacity in events (docs/DESIGN.md §6c), rounded
+    # up to a power of two by the native layer (0 = recorder off entirely).
+    flightrec_events: int = 16384
+    # Counter-timeseries sample period (ms): a background sampler appends
+    # full metric snapshots as JSONL to TPUNET_TRACE_DIR (0 = sampler off).
+    ts_interval_ms: int = 0
     # ---- Wire/bootstrap deadlines (docs/DESIGN.md §1) --------------------
     # Whole-preamble read deadline on accept (slow-loris defense); partial
     # bundles expire after 2x this.
@@ -538,6 +544,16 @@ class Config:
             ),
             metrics_interval_ms=_env_int_checked(
                 ("TPUNET_METRICS_INTERVAL_MS",), 1000, 1, "metrics push period"
+            ),
+            # 0 legitimately disables the recorder / timeseries sampler;
+            # only negatives are config errors.
+            flightrec_events=_env_int_checked(
+                ("TPUNET_FLIGHTREC_EVENTS",), 16384, 0,
+                "flight-recorder ring capacity",
+            ),
+            ts_interval_ms=_env_int_checked(
+                ("TPUNET_TS_INTERVAL_MS",), 0, 0,
+                "counter-timeseries sample period",
             ),
             # Deadlines: 0 would make every handshake/bootstrap time out
             # instantly — loud config error, not a silent wedge.

@@ -309,6 +309,7 @@ class ElasticWorld:
                     seen = g  # missed the window; wait for the next bump
             if time.monotonic() > deadline:
                 join_file.unlink(missing_ok=True)
+                telemetry.flightrec_dump_verdict("rewire_deadline")
                 raise _native.RewireTimeoutError(
                     _native.TPUNET_ERR_REWIRE,
                     f"join (no membership rendezvous admitted member "
@@ -319,6 +320,9 @@ class ElasticWorld:
 
     def _check_deadline(self, deadline: float, phase: str) -> None:
         if time.monotonic() > deadline:
+            # Terminal verdict: snapshot the flight recorder at the raise
+            # site, like the native watchdog/CRC paths do (DESIGN.md §6c).
+            telemetry.flightrec_dump_verdict("rewire_deadline")
             raise _native.RewireTimeoutError(
                 _native.TPUNET_ERR_REWIRE,
                 f"rewire ({phase} phase pushed recovery past "

@@ -294,6 +294,9 @@ class WeightReceiver:
 
     def _fail(self, msg: str, cause: Exception | None = None):
         self.abort()
+        # Terminal verdict: snapshot the flight recorder at the raise site
+        # (swap deadline / death / digest mismatch — DESIGN.md §6c).
+        telemetry.flightrec_dump_verdict("swap_abort")
         err = WeightSwapError(
             _ERR, f"weight swap to version {self.ann.version} aborted: "
             f"{msg} — previous version keeps serving; the publisher "
@@ -676,6 +679,9 @@ class WeightPublisher:
                 self.stats["aborts"] += 1
                 attempt += 1
                 if attempt > retries:
+                    # Terminal (retries exhausted): snapshot the flight
+                    # recorder at the raise site (DESIGN.md §6c).
+                    telemetry.flightrec_dump_verdict("swap_deadline")
                     raise
                 telemetry.swap_event("retry")
                 self.stats["retries"] += 1

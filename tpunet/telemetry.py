@@ -29,6 +29,8 @@ Chrome-trace spans for every request plus collective phase spans tagged
   swap_observe()      -> record one weight-swap phase duration sample
   swap_event()        -> count one weight-swap event by kind
   weight_version()    -> set the serving checkpoint-version gauge
+  flightrec_dump()    -> write this rank's flight-recorder ring to disk
+  flightrec_stats()   -> (events_recorded, ring_capacity) of the recorder
 
 Env flags (rank-gated 0-7 like the reference, nthread:108-130):
   TPUNET_TRACE_DIR            directory for Chrome-trace JSON (Perfetto)
@@ -259,6 +261,49 @@ def flush_trace() -> None:
     _native.check(lib.tpunet_c_trace_flush(), "trace_flush")
 
 
+def flightrec_dump(dir: str | None = None, reason: str = "api") -> str:
+    """Write this rank's flight-recorder ring (docs/DESIGN.md §6c) to
+    ``<dir>/tpunet-flightrec-rank<R>.json`` and return the path. ``dir=None``
+    uses the directory resolved when the recorder initialized
+    (TPUNET_TRACE_DIR when set, else "."). ``reason`` lands in the dump
+    header so a postmortem can tell an on-demand snapshot from a watchdog
+    verdict. Raises NativeError when the recorder is disabled
+    (TPUNET_FLIGHTREC_EVENTS=0) or the target is unwritable."""
+    lib = _native.load()
+    buf = ctypes.create_string_buffer(1024)
+    n = lib.tpunet_c_flightrec_dump(
+        dir.encode() if dir else None, reason.encode(), buf, len(buf))
+    if n < 0:
+        _native.check(n, "flightrec_dump")
+    return buf.value.decode()
+
+
+def flightrec_dump_verdict(reason: str) -> str | None:
+    """Best-effort flight-recorder dump for Python-side terminal verdicts
+    (rewire / weight-swap deadline raise sites — the native layer dumps its
+    own watchdog/CRC verdicts). Never raises: the typed error being raised
+    is the story, a failed dump must not replace it. Returns the dump path,
+    or None when the recorder is disabled or the dump failed."""
+    try:
+        return flightrec_dump(reason=reason)
+    except Exception:
+        return None
+
+
+def flightrec_stats() -> tuple[int, int]:
+    """(events_ever_recorded, ring_capacity) of the flight recorder. The
+    first is the monotonic claim cursor (NOT clamped to capacity — subtract
+    to learn how many events the ring has dropped); both are 0 when the
+    recorder is disabled or has never recorded."""
+    lib = _native.load()
+    rec = ctypes.c_uint64()
+    cap = ctypes.c_uint64()
+    _native.check(
+        lib.tpunet_c_flightrec_stats(ctypes.byref(rec), ctypes.byref(cap)),
+        "flightrec_stats")
+    return int(rec.value), int(cap.value)
+
+
 class _Profile:
     """Handle yielded by profile(): where the trace files land."""
 
@@ -339,10 +384,22 @@ def merge_traces(trace_dir: str, out_path: str | None = None) -> str:
     per-rank thread tracks inside it, instead of interleaving W top-level
     groups — the view that makes an intra-host SHM stage vs inter-host DCN
     stage split readable. Traces from builds without the tag keep the old
-    per-rank pid layout."""
+    per-rank pid layout.
+
+    Flight-recorder dumps (``tpunet-flightrec-rank*.json``, docs/DESIGN.md
+    §6c) present in the directory merge too: each rank's events render as
+    instant events on a dedicated "flightrec" thread track inside that
+    rank's host group, shifted by the same per-rank offset as its trace
+    spans (the recorder stamps the same monotonic clock the tracer uses).
+    A directory holding ONLY flightrec dumps — the post-hang case, where
+    tracing was never on — still merges (unshifted)."""
     files = sorted(glob.glob(os.path.join(trace_dir, "tpunet-trace-rank*.json")))
-    if not files:
-        raise FileNotFoundError(f"no tpunet-trace-rank*.json files in {trace_dir}")
+    fr_files = sorted(
+        glob.glob(os.path.join(trace_dir, "tpunet-flightrec-rank*.json")))
+    if not files and not fr_files:
+        raise FileNotFoundError(
+            f"no tpunet-trace-rank*.json or tpunet-flightrec-rank*.json "
+            f"files in {trace_dir}")
     per_rank: list[list[dict]] = []
     ranks: list[int] = []
     for fi, path in enumerate(files):
@@ -353,7 +410,7 @@ def merge_traces(trace_dir: str, out_path: str | None = None) -> str:
     # Alignment: anchor on the earliest (comm_id, coll_seq, phase) present in
     # EVERY rank's file; shift each rank so anchors coincide at the max.
     tag_maps = [_coll_tags(events) for events in per_rank]
-    common = set(tag_maps[0])
+    common = set(tag_maps[0]) if tag_maps else set()
     for tm in tag_maps[1:]:
         common &= set(tm)
     offsets = [0] * len(per_rank)
@@ -361,8 +418,18 @@ def merge_traces(trace_dir: str, out_path: str | None = None) -> str:
         anchor = min(common, key=lambda k: (k[1], k[2]))  # lowest coll_seq
         target = max(tm[anchor] for tm in tag_maps)
         offsets = [target - tm[anchor] for tm in tag_maps]
+    # Flight-recorder dumps are loaded up front so their host ids take part
+    # in the host-grouping decision (post-hang merges often have ONLY dumps).
+    fr_dumps: list[tuple[int, dict]] = []
+    for path in fr_files:
+        with open(path) as f:
+            dump = json.load(f)
+        m = re.search(r"rank(\d+)\.json$", path)
+        fr_dumps.append((int(m.group(1)) if m else int(dump.get("rank", 0)),
+                         dump))
     hosts = [_rank_host(events) for events in per_rank]
-    group_by_host = any(h is not None for h in hosts)
+    group_by_host = any(h is not None for h in hosts) or \
+        any(d.get("host") for _, d in fr_dumps)
     host_order: list[str] = []
     if group_by_host:
         for h in hosts:
@@ -391,6 +458,35 @@ def merge_traces(trace_dir: str, out_path: str | None = None) -> str:
                 ev["pid"] = pid
                 ev["tid"] = rank * 1_000_000 + int(ev.get("tid", 0))
             merged.append(ev)
+    # Flight-recorder dumps ride the same timeline: instant events on a
+    # per-rank "flightrec" thread track, reusing the offset computed from
+    # that rank's trace file (same monotonic clock on the same host).
+    rank_offsets = dict(zip(ranks, offsets))
+    for rank, dump in fr_dumps:
+        off = rank_offsets.get(rank, 0)
+        host = dump.get("host")
+        if group_by_host:
+            key = str(host) if host else "?"
+            if key not in host_order:
+                host_order.append(key)
+                merged.append({"name": "process_name", "ph": "M",
+                               "pid": len(host_order),
+                               "args": {"name": f"host {key}"}})
+            pid = host_order.index(key) + 1
+            tid = rank * 1_000_000 + 999_999
+        else:
+            pid, tid = rank, 999_999
+        merged.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": tid, "args": {"name": f"flightrec rank {rank}"}})
+        for ev in dump.get("events", []):
+            label = ev.get("kind", "?")
+            if ev.get("name"):
+                label = f"{label}:{ev['name']}"
+            merged.append({
+                "name": label, "ph": "i", "s": "t",
+                "ts": ev.get("t", 0) + off, "pid": pid, "tid": tid,
+                "args": {k: ev[k] for k in ("a", "b", "c", "d") if k in ev},
+            })
     out_path = out_path or os.path.join(trace_dir, "tpunet-trace-merged.json")
     with open(out_path, "w") as f:
         json.dump(merged, f)
