@@ -1,0 +1,23 @@
+// Fuzz CheckPeerBootstrapBlob (wire.cc): the 16-byte bootstrap blob is the
+// first peer-controlled payload the collectives handshake validates, and
+// its error path stringifies enum bytes from the untrusted side. Input is
+// split into our blob (first 16 bytes) and the peer's (next 16). The
+// acceptance contract: the verdict is OK exactly when the config bytes
+// (offsets 0..7 — everything but the host id) agree.
+#include <cassert>
+#include <cstring>
+
+#include "../src/wire.h"
+#include "fuzz_common.h"
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  FuzzCanary(data, size);
+  if (size < 2 * tpunet::kBootstrapBlobLen) return 0;
+  const uint8_t* mine = data;
+  const uint8_t* theirs = data + tpunet::kBootstrapBlobLen;
+  tpunet::Status s = tpunet::CheckPeerBootstrapBlob(mine, theirs, 0, 1);
+  bool config_match =
+      std::memcmp(mine, theirs, tpunet::kBlobOffHostId) == 0;
+  assert(s.ok() == config_match);
+  return 0;
+}

@@ -1,0 +1,64 @@
+// Shared scaffolding for the parser fuzz harnesses (docs/DESIGN.md
+// "Protocol registry & model checking").
+//
+// Every harness defines LLVMFuzzerTestOneInput and compiles two ways:
+//
+//   * libFuzzer (`make -C cpp fuzz`): clang -fsanitize=fuzzer,address —
+//     coverage-guided, corpora under cpp/fuzz/corpus/<target>/.
+//   * standalone replay (`make -C cpp fuzz-smoke`): any compiler,
+//     -DFUZZ_STANDALONE adds a main() that replays every file named on the
+//     command line through the harness once. This is the ASan smoke lane
+//     that runs where clang is absent, and the CI regression replayer.
+//
+// FuzzCanary() is the lane's RED self-proof: with TPUNET_FUZZ_CANARY set in
+// the environment, an input starting with "CANARY!!" traps. CI replays
+// cpp/fuzz/canary-input through one harness with the variable set and
+// asserts the process DIES — a smoke lane that cannot detect a crash is
+// green paint, not a sanitizer.
+#ifndef TPUNET_FUZZ_COMMON_H_
+#define TPUNET_FUZZ_COMMON_H_
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size);
+
+inline void FuzzCanary(const uint8_t* data, size_t size) {
+  if (size >= 8 && std::memcmp(data, "CANARY!!", 8) == 0 &&
+      std::getenv("TPUNET_FUZZ_CANARY") != nullptr) {
+    __builtin_trap();
+  }
+}
+
+#ifdef FUZZ_STANDALONE
+#include <cstdio>
+#include <vector>
+
+int main(int argc, char** argv) {
+  int replayed = 0;
+  for (int i = 1; i < argc; ++i) {
+    FILE* f = std::fopen(argv[i], "rb");
+    if (f == nullptr) {
+      std::fprintf(stderr, "fuzz: cannot open %s\n", argv[i]);
+      return 2;
+    }
+    std::fseek(f, 0, SEEK_END);
+    long n = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    std::vector<uint8_t> buf(n > 0 ? static_cast<size_t>(n) : 0);
+    if (n > 0 && std::fread(buf.data(), 1, buf.size(), f) != buf.size()) {
+      std::fprintf(stderr, "fuzz: short read on %s\n", argv[i]);
+      std::fclose(f);
+      return 2;
+    }
+    std::fclose(f);
+    LLVMFuzzerTestOneInput(buf.data(), buf.size());
+    ++replayed;
+  }
+  std::printf("fuzz: replayed %d inputs clean\n", replayed);
+  return 0;
+}
+#endif  // FUZZ_STANDALONE
+
+#endif  // TPUNET_FUZZ_COMMON_H_

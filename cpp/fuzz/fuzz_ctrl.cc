@@ -1,0 +1,46 @@
+// Fuzz DecodeCtrlFrame (wire.h), the single in-tree classifier of ctrl
+// stream u64s. The decode must be TOTAL (every u64 lands in exactly one
+// kind) and must round-trip through the matching Pack* helper — drift
+// between the two is a protocol desync the type system cannot see.
+#include <cassert>
+#include <cstring>
+
+#include "../src/wire.h"
+#include "fuzz_common.h"
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  FuzzCanary(data, size);
+  for (size_t off = 0; off + 8 <= size; off += 8) {
+    uint64_t frame;
+    std::memcpy(&frame, data + off, 8);
+    tpunet::CtrlFrameView v = tpunet::DecodeCtrlFrame(frame);
+    switch (v.kind) {
+      case tpunet::CtrlFrameKind::kLen:
+        assert(frame < tpunet::kMaxCtrlLen);
+        assert(v.len == frame);
+        break;
+      case tpunet::CtrlFrameKind::kNack:
+        assert(tpunet::PackCtrlFrame(tpunet::kCtrlFrameNack, v.stream,
+                                     v.arg) == frame);
+        break;
+      case tpunet::CtrlFrameKind::kFailover:
+        assert(tpunet::PackCtrlFrame(tpunet::kCtrlFrameFailover, v.stream,
+                                     v.arg) == frame);
+        break;
+      case tpunet::CtrlFrameKind::kWeights:
+        assert(tpunet::PackWeightsFrame(v.nstreams, v.epoch) == frame);
+        assert(v.nstreams == tpunet::WeightsFrameCount(frame));
+        assert(v.epoch == tpunet::WeightsFrameEpoch(frame));
+        break;
+      case tpunet::CtrlFrameKind::kBogus: {
+        uint8_t op = static_cast<uint8_t>(frame >> 56);
+        assert(frame >= tpunet::kMaxCtrlLen);
+        assert(op != tpunet::kCtrlFrameNack &&
+               op != tpunet::kCtrlFrameFailover &&
+               op != tpunet::kCtrlFrameWeights);
+        break;
+      }
+    }
+  }
+  return 0;
+}

@@ -1,0 +1,26 @@
+// Fuzz ParseDispatchTable (dispatch.cc): the busbw_sweep --emit-dispatch
+// JSON is the one file-format parser in the tree — operator-supplied, so
+// arbitrarily malformed. A malformed table must come back as a typed
+// Invalid status, never a crash; an accepted table must contain only
+// resolved (non-auto is not required, but in-range) entries.
+#include <cassert>
+#include <string>
+
+#include "../src/dispatch.h"
+#include "fuzz_common.h"
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  FuzzCanary(data, size);
+  std::string json(reinterpret_cast<const char*>(data), size);
+  tpunet::DispatchTable table;
+  tpunet::Status s = tpunet::ParseDispatchTable(json, &table);
+  if (s.ok()) {
+    for (const auto& e : table.entries) {
+      // Both enums are uint8_t, so only the upper bound needs asserting.
+      assert(static_cast<int>(e.algo) < tpunet::kCollAlgoCount);
+      assert(static_cast<int>(e.coll) < tpunet::kCollKindCount);
+      assert(e.world >= 0);  // 0 is the "any world" wildcard
+    }
+  }
+  return 0;
+}

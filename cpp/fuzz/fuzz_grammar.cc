@@ -1,0 +1,39 @@
+// Fuzz every text micro-grammar an operator can feed the transport through
+// the environment: the chaos script (TPUNET_FAULT — classic fault + churn +
+// swap segments, fault.cc), the QoS weights/window specs
+// (TPUNET_QOS_WEIGHTS / TPUNET_QOS_INFLIGHT_BYTES, qos.cc), and the lane
+// spec (TPUNET_LANES, wire.cc). All four parsers are pure by contract;
+// malformed input must come back as a typed Invalid status naming the
+// offending token, never as a crash or an out-of-range config.
+#include <cassert>
+#include <string>
+#include <vector>
+
+#include "../src/fault.h"
+#include "../src/wire.h"
+#include "fuzz_common.h"
+#include "tpunet/qos.h"
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  FuzzCanary(data, size);
+  std::string spec(reinterpret_cast<const char*>(data), size);
+
+  tpunet::FaultSpec fault;
+  bool has_fault = false;
+  std::vector<tpunet::ChurnEvent> churn;
+  std::vector<tpunet::SwapEvent> swap;
+  (void)tpunet::ParseFaultScript(spec, &fault, &has_fault, &churn, &swap);
+
+  tpunet::QosConfig qos;
+  (void)tpunet::ParseQosWeights(spec, &qos);
+  (void)tpunet::ParseQosInflightBytes(spec, &qos);
+
+  std::vector<tpunet::LaneSpec> lanes;
+  tpunet::Status s = tpunet::ParseLaneSpec(spec, &lanes);
+  if (s.ok()) {
+    for (const auto& l : lanes) {
+      assert(l.weight >= 1 && l.weight <= tpunet::kMaxLaneWeight);
+    }
+  }
+  return 0;
+}

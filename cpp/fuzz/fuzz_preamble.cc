@@ -1,0 +1,29 @@
+// Fuzz the two pure preamble parsers (wire.h): CheckWireMagic over the
+// first 8 untrusted bytes a listener reads, ParsePreambleBytes over the
+// full 48. Beyond crash-freedom, asserts the parser's own acceptance
+// contract: an accepted preamble always satisfies the documented bounds.
+#include <cassert>
+
+#include "../src/wire.h"
+#include "fuzz_common.h"
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  FuzzCanary(data, size);
+  if (size >= 8) {
+    (void)tpunet::CheckWireMagic(data);
+  }
+  if (size >= tpunet::kPreambleBytes) {
+    tpunet::Preamble p;
+    tpunet::Status s = tpunet::ParsePreambleBytes(data, &p);
+    if (s.ok()) {
+      // The wire contract an accepting parse vouches for (wire.cc):
+      // stream count bounded, stream id within the bundle, nonzero chunk
+      // size, and nstreams == 0 only on an SHM hello.
+      assert(p.nstreams <= tpunet::kMaxStreams);
+      assert(p.stream_id <= p.nstreams);
+      assert(p.min_chunksize != 0);
+      assert(p.nstreams != 0 || (p.flags & tpunet::kPreambleFlagShm) != 0);
+    }
+  }
+  return 0;
+}

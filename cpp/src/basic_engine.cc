@@ -1013,21 +1013,22 @@ Status RecvCtrlFrame(Comm* c, const Msg& m, uint64_t* target) REQUIRES(c->ctrl_m
     uint64_t frame = 0;
     Status s = ReadCtrlFrameLocked(c, &frame);
     if (!s.ok()) return s;
-    if ((frame >> 56) == kCtrlFrameFailover) {
+    CtrlFrameView cf = DecodeCtrlFrame(frame);
+    if (cf.kind == CtrlFrameKind::kFailover) {
       s = ProcessFailoverMarkerLocked(c, frame);
       if (!s.ok()) return s;
       continue;
     }
-    if ((frame >> 56) == kCtrlFrameWeights) {
+    if (cf.kind == CtrlFrameKind::kWeights) {
       s = ProcessWeightsFrameLocked(c, frame);
       if (!s.ok()) return s;
       continue;
     }
-    if (frame >= kMaxCtrlLen) {
+    if (cf.kind != CtrlFrameKind::kLen) {
       return Status::Inner("bogus ctrl frame 0x" + std::to_string(frame >> 56) +
                            "… — peer desynchronized");
     }
-    *target = frame;
+    *target = cf.len;
     if (*target > m.len) {
       // Peer sent more than the posted buffer — unrecoverable protocol
       // violation (the reference would panic slicing data[..target]).
@@ -1077,7 +1078,8 @@ void PumpCtrlUntilRetired(Comm* c, size_t idx) {
       PoisonAndDrainQueue(c, "ctrl stream lost during failover: " + s.msg);
       return;
     }
-    if ((frame >> 56) == kCtrlFrameFailover) {
+    CtrlFrameView cf = DecodeCtrlFrame(frame);
+    if (cf.kind == CtrlFrameKind::kFailover) {
       s = ProcessFailoverMarkerLocked(c, frame);
       if (!s.ok()) {
         PoisonAndDrainQueue(c, s.msg);
@@ -1085,7 +1087,7 @@ void PumpCtrlUntilRetired(Comm* c, size_t idx) {
       }
       continue;
     }
-    if ((frame >> 56) == kCtrlFrameWeights) {
+    if (cf.kind == CtrlFrameKind::kWeights) {
       s = ProcessWeightsFrameLocked(c, frame);
       if (!s.ok()) {
         PoisonAndDrainQueue(c, s.msg);

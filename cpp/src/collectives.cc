@@ -24,6 +24,7 @@
 
 #include "coll_comm.h"
 #include "dispatch.h"
+#include "wire.h"
 #include "tpunet/bootstrap.h"
 #include "tpunet/mutex.h"
 #include "tpunet/telemetry.h"
@@ -129,83 +130,26 @@ Status ScheduledCommunicator::Init(const std::string& coordinator) {
   // different schedule; two schedules deadlock, they don't corrupt); the
   // host ids legitimately differ and become the hierarchical schedule's
   // topology input (host_ids_).
-  uint8_t my_blob[16] = {0};
-  my_blob[0] = static_cast<uint8_t>(codec_);
-  my_blob[1] = static_cast<uint8_t>(algo_override_);
+  uint8_t my_blob[kBootstrapBlobLen] = {0};
+  my_blob[kBlobOffCodec] = static_cast<uint8_t>(codec_);
+  my_blob[kBlobOffAlgo] = static_cast<uint8_t>(algo_override_);
   uint32_t table_crc = dispatch_.loaded ? dispatch_.crc : 0;
-  my_blob[2] = static_cast<uint8_t>(table_crc >> 24);
-  my_blob[3] = static_cast<uint8_t>(table_crc >> 16);
-  my_blob[4] = static_cast<uint8_t>(table_crc >> 8);
-  my_blob[5] = static_cast<uint8_t>(table_crc);
-  my_blob[6] = static_cast<uint8_t>(cls_);  // QoS traffic class
-  my_blob[7] = static_cast<uint8_t>(a2a_override_);  // AllToAll schedule
-  EncodeU64BE(HostId(), my_blob + 8);
+  EncodeU32BE(table_crc, my_blob + kBlobOffTableCrc);
+  my_blob[kBlobOffQosClass] = static_cast<uint8_t>(cls_);
+  my_blob[kBlobOffA2aAlgo] = static_cast<uint8_t>(a2a_override_);
+  EncodeU64BE(HostId(), my_blob + kBlobOffHostId);
   std::vector<uint8_t> blobs;
   s = bootstrap_->AllGather(my_blob, sizeof(my_blob), &blobs);
   if (!s.ok()) return s;
   host_ids_.assign(world_, 0);
   for (int r = 0; r < world_; ++r) {
-    host_ids_[r] = DecodeU64BE(blobs.data() + r * sizeof(my_blob) + 8);
+    host_ids_[r] =
+        DecodeU64BE(blobs.data() + r * sizeof(my_blob) + kBlobOffHostId);
   }
   for (int r = 0; r < world_; ++r) {
     const uint8_t* theirs = blobs.data() + r * sizeof(my_blob);
-    if (theirs[0] != my_blob[0]) {
-      std::string name =
-          theirs[0] < kWireCodecCount
-              ? std::string(WireCodecName(static_cast<WireCodec>(theirs[0])))
-              : "#" + std::to_string(theirs[0]);
-      return Status::Codec(
-          "wire codec mismatch: rank " + std::to_string(rank_) + " uses " +
-          WireCodecName(codec_) + " but rank " + std::to_string(r) + " uses " +
-          name +
-          " (set TPUNET_WIRE_DTYPE / wire_dtype identically on every rank)");
-    }
-    if (theirs[1] != my_blob[1]) {
-      std::string name =
-          theirs[1] < kCollAlgoCount
-              ? std::string(CollAlgoName(static_cast<CollAlgo>(theirs[1])))
-              : "#" + std::to_string(theirs[1]);
-      return Status::Invalid(
-          "collective algo mismatch: rank " + std::to_string(rank_) + " uses " +
-          CollAlgoName(algo_override_) + " but rank " + std::to_string(r) +
-          " uses " + name +
-          " (set TPUNET_ALGO / algo identically on every rank — ranks on "
-          "different schedules deadlock)");
-    }
-    if (memcmp(theirs + 2, my_blob + 2, 4) != 0) {
-      return Status::Invalid(
-          "dispatch table mismatch: rank " + std::to_string(rank_) +
-          " and rank " + std::to_string(r) +
-          " loaded different TPUNET_DISPATCH_TABLE contents (every rank must "
-          "see the same table or none — per-size selection must agree)");
-    }
-    if (theirs[6] != my_blob[6]) {
-      std::string name =
-          theirs[6] < kTrafficClassCount
-              ? std::string(
-                    TrafficClassName(static_cast<TrafficClass>(theirs[6])))
-              : "#" + std::to_string(theirs[6]);
-      return Status::Invalid(
-          "traffic class mismatch: rank " + std::to_string(rank_) + " uses " +
-          TrafficClassName(cls_) + " but rank " + std::to_string(r) +
-          " uses " + name +
-          " (set TPUNET_TRAFFIC_CLASS / traffic_class= identically on every "
-          "rank — half a group on another QoS lane unbalances the "
-          "scheduler)");
-    }
-    if (theirs[7] != my_blob[7]) {
-      std::string name =
-          theirs[7] < kCollAlgoCount
-              ? std::string(CollAlgoName(static_cast<CollAlgo>(theirs[7])))
-              : "#" + std::to_string(theirs[7]);
-      return Status::Invalid(
-          "a2a algo mismatch: rank " + std::to_string(rank_) + " uses " +
-          CollAlgoName(a2a_override_) + " but rank " + std::to_string(r) +
-          " uses " + name +
-          " (set TPUNET_A2A_ALGO / TPUNET_A2A identically on every rank — "
-          "half a world on the pairwise mesh and half on the two-stage "
-          "transpose deadlocks)");
-    }
+    s = CheckPeerBootstrapBlob(my_blob, theirs, rank_, r);
+    if (!s.ok()) return s;
   }
 
   SocketHandle handle;

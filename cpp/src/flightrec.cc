@@ -137,7 +137,12 @@ void InitOnce() {
 
   g_rank = GetEnvU64("TPUNET_RANK", GetEnvU64("RANK", 0));
   g_host = HostId();
-  std::string dir = GetEnv("TPUNET_TRACE_DIR", ".");
+  // Dump-dir resolution: TPUNET_FLIGHTREC_DIR (dump routing only — set by
+  // the test harness so verdict dumps land under tmp_path, never the CWD a
+  // suite runs from), else TPUNET_TRACE_DIR (a job that traces wants its
+  // verdict dumps beside the trace files tools/postmortem merges), else the
+  // CWD. Resolved once here so the SIGUSR2 path never calls getenv.
+  std::string dir = GetEnv("TPUNET_FLIGHTREC_DIR", GetEnv("TPUNET_TRACE_DIR", "."));
   if (dir.empty() || dir.size() >= sizeof(g_default_dir)) dir = ".";
   memcpy(g_default_dir, dir.c_str(), dir.size() + 1);
   char* p = g_default_path;

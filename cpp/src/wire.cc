@@ -1,6 +1,7 @@
 // Shared wire protocol + rendezvous implementation. See wire.h.
 #include "wire.h"
 
+#include "dispatch.h"
 #include "tpunet/qos.h"
 
 #include <arpa/inet.h>
@@ -51,25 +52,20 @@ Status WritePreamble(int fd, const Preamble& p) {
   return WriteAll(fd, buf, sizeof(buf));
 }
 
-Status ReadPreamble(int fd, Preamble* p, int timeout_ms) {
-  uint8_t buf[48];
-  // Hard deadline over the whole 48 bytes — a slow-loris client trickling
-  // one byte per interval cannot stretch this past timeout_ms. The magic is
-  // checked as soon as its 8 bytes land so a mismatched-version peer (whose
-  // preamble may be shorter) gets the typed verdict instead of a timeout.
-  Status s = ReadExactDeadline(fd, buf, 8, timeout_ms);
-  if (!s.ok()) return s;
+Status CheckWireMagic(const uint8_t buf[8]) {
   uint64_t magic = DecodeU64BE(buf);
-  if (magic != kWireMagic) {
-    if ((magic & kWireMagicPrefixMask) == (kWireMagic & kWireMagicPrefixMask)) {
-      return Status::Version(
-          "tpunet wire version mismatch: peer speaks framing v" +
-          std::to_string(magic & 0xff) + ", this build speaks v" +
-          std::to_string(kWireMagic & 0xff));
-    }
-    return Status::TCP("bad wire magic — peer is not tpunet");
+  if (magic == kWireMagic) return Status::Ok();
+  if ((magic & kWireMagicPrefixMask) == (kWireMagic & kWireMagicPrefixMask)) {
+    return Status::Version(
+        "tpunet wire version mismatch: peer speaks framing v" +
+        std::to_string(magic & 0xff) + ", this build speaks v" +
+        std::to_string(kWireMagic & 0xff));
   }
-  s = ReadExactDeadline(fd, buf + 8, sizeof(buf) - 8, timeout_ms);
+  return Status::TCP("bad wire magic — peer is not tpunet");
+}
+
+Status ParsePreambleBytes(const uint8_t buf[kPreambleBytes], Preamble* p) {
+  Status s = CheckWireMagic(buf);
   if (!s.ok()) return s;
   p->bundle_id = DecodeU64BE(buf + 8);
   p->stream_id = DecodeU64BE(buf + 16);
@@ -84,6 +80,84 @@ Status ReadPreamble(int fd, Preamble* p, int timeout_ms) {
       p->stream_id > p->nstreams || p->min_chunksize == 0) {
     return Status::TCP("malformed preamble: nstreams=" + std::to_string(p->nstreams) +
                        " stream_id=" + std::to_string(p->stream_id));
+  }
+  return Status::Ok();
+}
+
+Status ReadPreamble(int fd, Preamble* p, int timeout_ms) {
+  uint8_t buf[kPreambleBytes];
+  // Hard deadline over the whole 48 bytes — a slow-loris client trickling
+  // one byte per interval cannot stretch this past timeout_ms. The magic is
+  // checked as soon as its 8 bytes land so a mismatched-version peer (whose
+  // preamble may be shorter) gets the typed verdict instead of a timeout.
+  Status s = ReadExactDeadline(fd, buf, 8, timeout_ms);
+  if (!s.ok()) return s;
+  s = CheckWireMagic(buf);
+  if (!s.ok()) return s;
+  s = ReadExactDeadline(fd, buf + 8, sizeof(buf) - 8, timeout_ms);
+  if (!s.ok()) return s;
+  return ParsePreambleBytes(buf, p);
+}
+
+namespace {
+
+// Blob byte -> enum name, or "#N" for a value past the enum's count (a
+// corrupt or future-build peer must still produce a readable verdict).
+template <typename E>
+std::string BlobEnumName(uint8_t v, int count, const char* (*name)(E)) {
+  return v < count ? std::string(name(static_cast<E>(v)))
+                   : "#" + std::to_string(v);
+}
+
+}  // namespace
+
+Status CheckPeerBootstrapBlob(const uint8_t* mine, const uint8_t* theirs,
+                              int rank, int peer) {
+  if (theirs[kBlobOffCodec] != mine[kBlobOffCodec]) {
+    return Status::Codec(
+        "wire codec mismatch: rank " + std::to_string(rank) + " uses " +
+        BlobEnumName(mine[kBlobOffCodec], kWireCodecCount, WireCodecName) +
+        " but rank " + std::to_string(peer) + " uses " +
+        BlobEnumName(theirs[kBlobOffCodec], kWireCodecCount, WireCodecName) +
+        " (set TPUNET_WIRE_DTYPE / wire_dtype identically on every rank)");
+  }
+  if (theirs[kBlobOffAlgo] != mine[kBlobOffAlgo]) {
+    return Status::Invalid(
+        "collective algo mismatch: rank " + std::to_string(rank) + " uses " +
+        BlobEnumName(mine[kBlobOffAlgo], kCollAlgoCount, CollAlgoName) +
+        " but rank " + std::to_string(peer) + " uses " +
+        BlobEnumName(theirs[kBlobOffAlgo], kCollAlgoCount, CollAlgoName) +
+        " (set TPUNET_ALGO / algo identically on every rank — ranks on "
+        "different schedules deadlock)");
+  }
+  if (memcmp(theirs + kBlobOffTableCrc, mine + kBlobOffTableCrc, 4) != 0) {
+    return Status::Invalid(
+        "dispatch table mismatch: rank " + std::to_string(rank) +
+        " and rank " + std::to_string(peer) +
+        " loaded different TPUNET_DISPATCH_TABLE contents (every rank must "
+        "see the same table or none — per-size selection must agree)");
+  }
+  if (theirs[kBlobOffQosClass] != mine[kBlobOffQosClass]) {
+    return Status::Invalid(
+        "traffic class mismatch: rank " + std::to_string(rank) + " uses " +
+        BlobEnumName(mine[kBlobOffQosClass], kTrafficClassCount,
+                     TrafficClassName) +
+        " but rank " + std::to_string(peer) + " uses " +
+        BlobEnumName(theirs[kBlobOffQosClass], kTrafficClassCount,
+                     TrafficClassName) +
+        " (set TPUNET_TRAFFIC_CLASS / traffic_class= identically on every "
+        "rank — half a group on another QoS lane unbalances the "
+        "scheduler)");
+  }
+  if (theirs[kBlobOffA2aAlgo] != mine[kBlobOffA2aAlgo]) {
+    return Status::Invalid(
+        "a2a algo mismatch: rank " + std::to_string(rank) + " uses " +
+        BlobEnumName(mine[kBlobOffA2aAlgo], kCollAlgoCount, CollAlgoName) +
+        " but rank " + std::to_string(peer) + " uses " +
+        BlobEnumName(theirs[kBlobOffA2aAlgo], kCollAlgoCount, CollAlgoName) +
+        " (set TPUNET_A2A_ALGO / TPUNET_A2A identically on every rank — "
+        "half a world on the pairwise mesh and half on the two-stage "
+        "transpose deadlocks)");
   }
   return Status::Ok();
 }

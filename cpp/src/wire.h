@@ -112,6 +112,78 @@ constexpr uint8_t kCtrlFrameWeights = 0xFC;
 // real message gets near 2^56 bytes.
 constexpr uint64_t kMaxCtrlLen = 1ull << 56;
 
+// Decoded view of one ctrl-stream u64. The decode is TOTAL: every u64 is
+// exactly one of LEN / NACK / FAILOVER / WEIGHTS / bogus, so every receiver
+// branches on the same classification instead of re-deriving `frame >> 56`
+// locally (tools/protocol cross-checks the opcode constants; this function
+// is the single in-tree decoder the fuzz harness drives).
+enum class CtrlFrameKind : uint8_t {
+  kLen = 0,       // plain message length, frame < kMaxCtrlLen
+  kNack,          // 0xFD
+  kFailover,      // 0xFE
+  kWeights,       // 0xFC
+  kBogus,         // reserved top byte — protocol desync
+};
+struct CtrlFrameView {
+  CtrlFrameKind kind = CtrlFrameKind::kBogus;
+  uint64_t len = 0;       // kLen: the message length
+  uint64_t stream = 0;    // kNack/kFailover: bits 48..55
+  uint64_t arg = 0;       // kNack: confirmed seq; kFailover: unit count
+  uint64_t nstreams = 0;  // kWeights: bits 32..47
+  uint64_t epoch = 0;     // kWeights: bits 0..31
+};
+inline CtrlFrameView DecodeCtrlFrame(uint64_t frame) {
+  CtrlFrameView v;
+  if (frame < kMaxCtrlLen) {
+    v.kind = CtrlFrameKind::kLen;
+    v.len = frame;
+    return v;
+  }
+  switch (static_cast<uint8_t>(frame >> 56)) {
+    case kCtrlFrameNack:
+      v.kind = CtrlFrameKind::kNack;
+      v.stream = (frame >> 48) & 0xff;
+      v.arg = frame & 0xffffffffffffull;
+      break;
+    case kCtrlFrameFailover:
+      v.kind = CtrlFrameKind::kFailover;
+      v.stream = (frame >> 48) & 0xff;
+      v.arg = frame & 0xffffffffffffull;
+      break;
+    case kCtrlFrameWeights:
+      v.kind = CtrlFrameKind::kWeights;
+      v.nstreams = (frame >> 32) & 0xffff;
+      v.epoch = frame & 0xffffffff;
+      break;
+    default:
+      v.kind = CtrlFrameKind::kBogus;
+      break;
+  }
+  return v;
+}
+
+// ---- Bootstrap config blob (collectives.cc handshake) ----------------------
+// The 16-byte per-rank unit of the schedule-config AllGather that precedes
+// any wiring: [codec u8 | algo u8 | table_crc u32 BE | qos_class u8 |
+// a2a_algo u8 | host_id u64 BE]. The config bytes (offsets 0..7) must match
+// on every rank; the host id legitimately differs (it is the hierarchical
+// topology input). tools/protocol checks the offsets below are
+// non-overlapping, cover the blob exactly, and are each used by both the
+// encode and the peer-validation sides.
+constexpr size_t kBootstrapBlobLen = 16;
+constexpr size_t kBlobOffCodec = 0;     // WireCodec as one byte
+constexpr size_t kBlobOffAlgo = 1;      // CollAlgo override as one byte
+constexpr size_t kBlobOffTableCrc = 2;  // dispatch-table CRC32C, u32 BE
+constexpr size_t kBlobOffQosClass = 6;  // TrafficClass as one byte
+constexpr size_t kBlobOffA2aAlgo = 7;   // AllToAll CollAlgo as one byte
+constexpr size_t kBlobOffHostId = 8;    // HostId(), u64 BE
+
+// Validate one peer's bootstrap blob against ours (pure — collectives.cc
+// calls it per rank after the AllGather; the fuzz harness drives it with
+// arbitrary peer bytes). `rank`/`peer` only flavor the error text.
+Status CheckPeerBootstrapBlob(const uint8_t* mine, const uint8_t* theirs,
+                              int rank, int peer);
+
 inline uint64_t PackCtrlFrame(uint8_t type, uint64_t stream, uint64_t arg) {
   return (static_cast<uint64_t>(type) << 56) | ((stream & 0xff) << 48) |
          (arg & 0xffffffffffffull);
@@ -172,7 +244,16 @@ struct Preamble {
   uint64_t flags = 0;
 };
 
+constexpr size_t kPreambleBytes = 48;  // 6 big-endian u64s
+
 Status WritePreamble(int fd, const Preamble& p);
+// Pure preamble parsing, split at the same boundary the wire read is: the
+// magic word is checked as soon as its 8 bytes land (a mismatched-version
+// peer's preamble may be shorter than ours), then the remaining 40 bytes
+// decode + validate. Both are fuzz targets (cpp/fuzz/fuzz_preamble.cc);
+// ReadPreamble is the fd-facing wrapper.
+Status CheckWireMagic(const uint8_t buf[8]);
+Status ParsePreambleBytes(const uint8_t buf[kPreambleBytes], Preamble* p);
 // Bounded by timeout_ms over the WHOLE 48 bytes (slow-loris defense).
 // A magic whose "tpunet1" prefix matches but whose version byte differs
 // returns a typed kVersion status (framing-version negotiation).

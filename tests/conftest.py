@@ -28,6 +28,20 @@ except ImportError:  # pragma: no cover
 
 import socket  # noqa: E402
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _flightrec_dumps_to_tmp(tmp_path, monkeypatch):
+    """Route flight-recorder verdict dumps through the test's tmp dir.
+
+    Chaos/CRC tests trip DumpOnVerdict in the native layer, whose fallback
+    dump path is the CWD — which under pytest is the repo root. The dedicated
+    TPUNET_FLIGHTREC_DIR knob redirects ONLY the dump path (unlike
+    TPUNET_TRACE_DIR it does not enable span tracing), and spawned worker
+    processes inherit it through the env."""
+    monkeypatch.setenv("TPUNET_FLIGHTREC_DIR", str(tmp_path))
+
 
 def free_port() -> int:
     """Shared helper: an ephemeral 127.0.0.1 port for bootstrap coordinators."""

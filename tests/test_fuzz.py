@@ -1,0 +1,244 @@
+"""Structured fuzzing of every Python parser that touches untrusted bytes.
+
+The C++ parsers get libFuzzer/ASan (cpp/fuzz/, `make -C cpp fuzz-smoke`);
+the Python side gets this hand-rolled equivalent — the image ships neither
+hypothesis nor atheris, and a deterministic seeded mutator reproduces any
+failure from its case index alone, which a coverage-guided fuzzer cannot
+promise.
+
+Contract under test: a parser handed arbitrary bytes either succeeds or
+raises its TYPED error (ServeError subclasses for the serve frames,
+ValueError for the script grammars and the postmortem loader). Anything
+else — struct.error, a numpy ValueError, TypeError, IndexError — is a
+crash an adversarial peer or a torn dump file can trigger at will. This
+suite found three of those (now fixed, and pinned by the regression tests
+at the bottom): oversized counts in unpack_block/unpack_result reached
+np.frombuffer, a short Hello hit struct.error, and non-numeric dump fields
+crashed diagnose() deep in the stall arithmetic.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tpunet.elastic import parse_churn_script  # noqa: E402
+from tpunet.serve import protocol as proto  # noqa: E402
+from tpunet.serve.publish import parse_swap_script  # noqa: E402
+from tools.postmortem import diagnose, load_dumps, phase_lattice  # noqa: E402
+
+CASES = 400  # per target; the full file stays under a few seconds
+
+
+def _mutate(rng: random.Random, base: bytes) -> bytes:
+    """One structured mutation of a valid wire image: truncate, extend,
+    byte-flip, zero a span, or splice random garbage — the shapes framing
+    bugs actually take."""
+    b = bytearray(base)
+    op = rng.randrange(6)
+    if op == 0 and b:
+        del b[rng.randrange(len(b)):]                      # truncate tail
+    elif op == 1:
+        b += rng.randbytes(rng.randrange(1, 64))           # trailing junk
+    elif op == 2 and b:
+        for _ in range(rng.randrange(1, 8)):
+            b[rng.randrange(len(b))] = rng.randrange(256)  # byte flips
+    elif op == 3 and b:
+        i = rng.randrange(len(b))
+        j = min(len(b), i + rng.randrange(1, 16))
+        b[i:j] = bytes(j - i)                              # zeroed span
+    elif op == 4 and b:
+        i = rng.randrange(len(b))
+        b[i:i] = rng.randbytes(rng.randrange(1, 16))       # inserted garbage
+    else:
+        b = bytearray(rng.randbytes(rng.randrange(0, 96)))  # pure noise
+    return bytes(b)
+
+
+def _drive(parse, valid: bytes, allowed: tuple, seed: int) -> None:
+    rng = random.Random(seed)
+    parse(valid)  # the unmutated image must parse
+    for i in range(CASES):
+        payload = _mutate(rng, valid)
+        try:
+            parse(payload)
+        except allowed:
+            pass
+        except Exception as e:  # noqa: BLE001 — the point of the test
+            pytest.fail(
+                f"case {i} (seed {seed}): {type(e).__name__}: {e} on "
+                f"{payload[:64].hex()}... ({len(payload)}B) — untyped "
+                f"escape from {parse.__name__}")
+
+
+# ---------------------------------------------------------------------------
+# Serve frames: ServeError (TierProtocolError / TierMismatchError) only.
+
+
+def test_fuzz_hello_unpack():
+    valid = proto.Hello(proto.ROLE_FRONTEND, "bf16", 8, 2048, 50304,
+                        0x1234_5678_9ABC).pack()
+    _drive(proto.Hello.unpack, valid, (proto.ServeError,), seed=0xE110)
+
+
+def test_fuzz_unpack_block():
+    valid = proto.pack_block(
+        np.arange(7, dtype=np.int32), 16,
+        np.arange(24, dtype=np.uint8), 6,
+        np.linspace(-1, 1, 11).astype(np.float32), "f32")
+
+    def parse(payload: bytes):
+        return proto.unpack_block(payload, "f32")
+
+    parse.__name__ = "unpack_block"
+    _drive(parse, valid, (proto.ServeError,), seed=0xB10C)
+
+
+def test_fuzz_unpack_result():
+    valid = proto.pack_result(np.arange(9, dtype=np.int32), 0, 1234)
+    _drive(proto.unpack_result, valid, (proto.ServeError,), seed=0x5E5)
+
+
+def test_fuzz_unpack_swap_begin():
+    valid = proto.pack_swap_begin(proto.SwapAnnounce(
+        3, 4, 2, 1 << 20, 1 << 16, "bf16", 30_000, "10.0.0.1:7777"))
+    _drive(proto.unpack_swap_begin, valid, (proto.ServeError,), seed=0x54A9)
+
+
+# ---------------------------------------------------------------------------
+# Script grammars: ValueError only.
+
+_SCRIPT_TOKENS = ("churn", "swap", "at_step", "rank", "action", "kill",
+                  "join", "publish", "corrupt", "die", "stream", "=", ":",
+                  ";", "*", "0", "17", "-3", "9" * 30, "", " ", "\n", "\x00",
+                  "actiön", "=:;")
+
+
+def _random_script(rng: random.Random) -> str:
+    return "".join(rng.choice(_SCRIPT_TOKENS) for _ in range(rng.randrange(0, 24)))
+
+
+@pytest.mark.parametrize("parse", [parse_churn_script, parse_swap_script],
+                         ids=["churn", "swap"])
+def test_fuzz_script_grammars(parse):
+    rng = random.Random(0x5C81)
+    parse("churn:at_step=3:rank=1:action=kill;swap:at_step=5:action=publish")
+    for i in range(CASES):
+        spec = _random_script(rng)
+        try:
+            parse(spec)
+        except ValueError:
+            pass
+        except Exception as e:  # noqa: BLE001
+            pytest.fail(f"case {i}: {type(e).__name__}: {e} on {spec!r}")
+
+
+# ---------------------------------------------------------------------------
+# Postmortem loader: torn/hostile dump files -> ValueError naming the file,
+# and whatever load_dumps accepts must flow through the whole analysis
+# (phase_lattice + diagnose) without an exception.
+
+_JUNK = (None, "x", "7f3a", -1, 0.5, [], {}, True, "phase_enter", 10**18)
+
+
+def _valid_dump(rank: int) -> dict:
+    ev = [{"t": 100 * i, "kind": k, "a": 7, "b": 41, "c": 4096, "d": i,
+           "name": "rs"}
+          for i, k in enumerate(("phase_enter", "phase_exit", "phase_enter"))]
+    ev.append({"t": 500, "kind": "verdict", "name": "watchdog"})
+    return {"schema": "tpunet-flightrec-v1", "rank": rank, "host": "00",
+            "reason": "watchdog", "capacity": 64, "recorded": len(ev),
+            "dropped": 0, "events": ev, "torn": 0}
+
+
+def _mutate_json(rng: random.Random, d: dict) -> dict:
+    d = json.loads(json.dumps(d))  # deep copy
+    for _ in range(rng.randrange(1, 4)):
+        op = rng.randrange(4)
+        if op == 0:  # swap a top-level field for junk
+            d[rng.choice(list(d))] = rng.choice(_JUNK)
+        elif op == 1 and isinstance(d.get("events"), list) and d["events"]:
+            ev = rng.choice(d["events"])
+            if isinstance(ev, dict) and ev:
+                ev[rng.choice(list(ev))] = rng.choice(_JUNK)
+        elif op == 2 and isinstance(d.get("events"), list):
+            d["events"].append(rng.choice(_JUNK))
+        else:
+            d.pop(rng.choice(list(d)), None)
+    return d
+
+
+def test_fuzz_postmortem_loader(tmp_path):
+    rng = random.Random(0xD04D)
+    for i in range(120):
+        case = tmp_path / f"case{i}"
+        case.mkdir()
+        for rank in (0, 1):
+            d = _valid_dump(rank)
+            if rng.random() < 0.9:
+                d = _mutate_json(rng, d)
+            path = case / f"tpunet-flightrec-rank{rank}.json"
+            raw = json.dumps(d)
+            if rng.random() < 0.1:
+                raw = raw[:rng.randrange(len(raw))]  # torn write
+            path.write_text(raw)
+        try:
+            dumps = load_dumps([str(case)])
+        except ValueError:
+            continue  # typed rejection naming the file — the contract
+        except Exception as e:  # noqa: BLE001
+            pytest.fail(f"case {i}: load_dumps untyped {type(e).__name__}: {e}")
+        try:
+            diag = diagnose(dumps)
+            assert isinstance(diag["lines"], list)
+            phase_lattice(dumps)
+        except Exception as e:  # noqa: BLE001
+            pytest.fail(f"case {i}: accepted dump crashed analysis: "
+                        f"{type(e).__name__}: {e}")
+
+
+# ---------------------------------------------------------------------------
+# Regressions: the concrete crashes this suite surfaced, pinned as typed.
+
+
+def test_oversized_block_counts_are_typed():
+    payload = proto._BLOCK_HDR.pack(10**6, 1, 0, 0, proto._CODEC_IDS["f32"])
+    with pytest.raises(proto.TierProtocolError, match="prompt"):
+        proto.unpack_block(payload, "f32")
+
+
+def test_oversized_result_count_is_typed():
+    payload = proto._RESULT_HDR.pack(10**6, 0, 0)
+    with pytest.raises(proto.TierProtocolError, match="tokens"):
+        proto.unpack_result(payload)
+
+
+def test_short_hello_is_typed():
+    with pytest.raises(proto.TierProtocolError, match="hello"):
+        proto.Hello.unpack(b"\x00" * 5)
+
+
+def test_postmortem_rejects_non_numeric_fields(tmp_path):
+    d = _valid_dump(0)
+    d["events"][0]["t"] = "not-a-time"
+    f = tmp_path / "tpunet-flightrec-rank0.json"
+    f.write_text(json.dumps(d))
+    with pytest.raises(ValueError, match="rank0"):
+        load_dumps([str(tmp_path)])
+
+
+def test_postmortem_rejects_string_rank(tmp_path):
+    d = _valid_dump(0)
+    d["rank"] = "zero"
+    f = tmp_path / "tpunet-flightrec-rank0.json"
+    f.write_text(json.dumps(d))
+    with pytest.raises(ValueError, match="rank"):
+        load_dumps([str(tmp_path)])

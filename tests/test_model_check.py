@@ -1,0 +1,142 @@
+"""The protocol model checker (tools/model): harness semantics, green on
+HEAD, and — the part that makes it verification rather than documentation —
+RED on every seeded mutation.
+
+Three layers:
+  * Harness unit tests: BFS invariant/deadlock/livelock detection and
+    counterexample traces on toy models, so a harness regression cannot hide
+    behind the real models staying green.
+  * HEAD gates: all five protocol models explore exhaustively with zero
+    violations (the same gate CI applies via ``python -m tools.model --all``).
+  * Sharpness gates: every entry in every model's MUTATIONS table — each a
+    named real-world protocol bug — must turn the checker red. A property
+    that no seeded bug can violate is not being checked.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.model import Model, all_models, all_mutations, explore  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# Harness semantics on toy models.
+
+
+def test_explore_reports_invariant_with_minimal_trace():
+    # 0 -> 1 -> 2, invariant breaks at 2; also a direct 0 -> 2 shortcut, so
+    # BFS must report the 1-step trace, not the 2-step one.
+    m = Model(
+        "toy", lambda: [0],
+        lambda s: ([("step", s + 1)] if s < 2 else []) + ([("skip", 2)] if s == 0 else []),
+        lambda s: "boom" if s == 2 else None,
+        lambda s: True)
+    r = explore(m)
+    assert not r.ok and r.error.kind == "invariant"
+    assert [lbl for lbl, _ in r.error.trace] == ["<init>", "skip"]
+
+
+def test_explore_reports_deadlock_only_when_not_done():
+    stuck = Model("stuck", lambda: [0], lambda s: [], lambda s: None,
+                  lambda s: False)
+    r = explore(stuck)
+    assert not r.ok and r.error.kind == "deadlock"
+    quiesced = Model("quiesced", lambda: [0], lambda s: [], lambda s: None,
+                     lambda s: True)
+    assert explore(quiesced).ok
+
+
+def test_explore_detects_nonprogress_cycle_as_livelock():
+    # 0 <-> 1 spin, marked non-progress; state 2 (real work) is reachable so
+    # the graph is not a deadlock.
+    m = Model(
+        "spin", lambda: [0],
+        lambda s: [("spin", 1 - s), ("work", 2)] if s in (0, 1) else [],
+        lambda s: None, lambda s: s == 2,
+        progress=lambda label: label != "spin")
+    r = explore(m)
+    assert not r.ok and r.error.kind == "livelock"
+    assert "spin" in r.error.message
+
+
+def test_explore_enforces_state_budget():
+    unbounded = Model("big", lambda: [0], lambda s: [("inc", s + 1)],
+                      lambda s: None, lambda s: False)
+    with pytest.raises(RuntimeError, match="state space exceeds"):
+        explore(unbounded, max_states=100)
+
+
+# ---------------------------------------------------------------------------
+# HEAD gates: exhaustive and clean.
+
+
+@pytest.mark.parametrize("name", sorted(all_models()))
+def test_model_green_on_head(name):
+    r = explore(all_models()[name]())
+    assert r.ok, f"{name}: {r.error.render()}"
+    assert r.states > 10, f"{name} explored only {r.states} states — shape degenerate?"
+
+
+def test_every_model_ships_mutations():
+    muts = all_mutations()
+    assert set(muts) == set(all_models())
+    for name, table in muts.items():
+        assert len(table) >= 3, f"{name} has {len(table)} seeded bugs, want >= 3"
+
+
+# ---------------------------------------------------------------------------
+# Sharpness gates: every seeded bug is caught.
+
+_ALL = [(m, mut) for m, muts in sorted(all_mutations().items()) for mut in muts]
+
+
+@pytest.mark.parametrize("name,mutation", _ALL,
+                         ids=[f"{m}.{mut}" for m, mut in _ALL])
+def test_mutation_turns_checker_red(name, mutation):
+    r = explore(all_models()[name](mutation))
+    assert not r.ok, (
+        f"seeded bug {name}.{mutation} survived exhaustive exploration "
+        f"({r.states} states) — the model lost the property that bug violates")
+    assert r.error.trace, "counterexample must carry a trace"
+
+
+def test_unknown_mutation_is_rejected():
+    for name, factory in all_models().items():
+        with pytest.raises(ValueError, match="unknown mutation"):
+            factory("no_such_bug")
+
+
+# ---------------------------------------------------------------------------
+# CLI: the CI lane's exact invocations.
+
+
+def _cli(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run([sys.executable, "-m", "tools.model", *args],
+                          cwd=REPO, capture_output=True, text=True, timeout=300)
+
+
+def test_cli_all_exits_zero_on_head():
+    p = _cli("--all")
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert p.stdout.count(" ok ") == len(all_models())
+
+
+def test_cli_mutate_exits_one_when_caught():
+    p = _cli("--mutate", "drr.strict_latency")
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "caught" in p.stdout
+
+
+def test_cli_mutations_lists_every_seeded_bug():
+    p = _cli("--mutations")
+    assert p.returncode == 0
+    for name, mut in _ALL:
+        assert f"{name}.{mut}:" in p.stdout

@@ -1,0 +1,154 @@
+"""The wire-contract registry checker (tools/protocol): clean on HEAD,
+RED on seeded drift.
+
+The live-tree gate itself runs in tests/test_lint.py (the protocol checker
+is the fifth entry in tools.lint CHECKERS, so the parametrized clean-tree
+test covers it). This file proves the checker can actually FIRE: each test
+copies the real contract-bearing sources into a tmp tree, seeds ONE drift
+of a distinct defect class — flag-bit collision, blob-offset overlap,
+enum drift, struct-format drift, frame-type collision, grammar-token
+mismatch — and asserts the checker names it. A checker that cannot go red
+is decoration, not verification.
+"""
+
+from __future__ import annotations
+
+import shutil
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.protocol import check_protocol  # noqa: E402
+
+# Every file the checker reads; fixtures clone these so a seeded drift is
+# the ONLY difference from HEAD.
+_CONTRACT_FILES = (
+    "cpp/src/wire.h",
+    "cpp/src/wire.cc",
+    "cpp/src/collectives.cc",
+    "cpp/src/dispatch.h",
+    "cpp/src/fault.h",
+    "cpp/src/fault.cc",
+    "cpp/include/tpunet/utils.h",
+    "cpp/include/tpunet/qos.h",
+    "tpunet/serve/protocol.py",
+    "tpunet/serve/publish.py",
+    "tpunet/elastic.py",
+)
+
+
+@pytest.fixture()
+def tree(tmp_path):
+    for rel in _CONTRACT_FILES:
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(REPO / rel, dst)
+    return tmp_path
+
+
+def _seed(tree: Path, rel: str, old: str, new: str) -> None:
+    path = tree / rel
+    text = path.read_text()
+    assert old in text, f"fixture drift: {old!r} no longer in {rel}"
+    path.write_text(text.replace(old, new))
+
+
+def test_fixture_tree_matches_head(tree):
+    assert check_protocol(tree) == []
+
+
+def test_fires_on_preamble_flag_bit_collision(tree):
+    _seed(tree, "cpp/src/wire.h",
+          "constexpr uint64_t kPreambleFlagShm = 1ull << 3;",
+          "constexpr uint64_t kPreambleFlagShm = 1ull << 1;")
+    v = check_protocol(tree)
+    assert any("kPreambleFlagShm" in x and "spec" in x for x in v)
+    assert any("collides" in x for x in v)
+
+
+def test_fires_on_flag_inside_class_nibble(tree):
+    _seed(tree, "cpp/src/wire.h",
+          "constexpr uint64_t kPreambleFlagShm = 1ull << 3;",
+          "constexpr uint64_t kPreambleFlagShm = 1ull << 9;")
+    v = check_protocol(tree)
+    assert any("class nibble" in x for x in v)
+
+
+def test_fires_on_blob_offset_drift(tree):
+    _seed(tree, "cpp/src/wire.h",
+          "constexpr size_t kBlobOffQosClass = 6;",
+          "constexpr size_t kBlobOffQosClass = 5;")
+    v = check_protocol(tree)
+    assert any("kBlobOffQosClass" in x for x in v)
+
+
+def test_fires_on_unencoded_blob_field(tree):
+    # The checker greps by name, so the seeded rename must not keep the
+    # original as a substring.
+    _seed(tree, "cpp/src/collectives.cc", "kBlobOffA2aAlgo", "kBlobOffZzzAlgo")
+    v = check_protocol(tree)
+    assert any("kBlobOffA2aAlgo" in x and "encode" in x for x in v)
+
+
+def test_fires_on_ctrl_opcode_collision(tree):
+    _seed(tree, "cpp/src/wire.h",
+          "constexpr uint8_t kCtrlFrameNack = 0xFD;",
+          "constexpr uint8_t kCtrlFrameNack = 0xFE;")
+    v = check_protocol(tree)
+    assert any("kCtrlFrameNack" in x for x in v)
+    assert any("collides" in x for x in v)
+
+
+def test_fires_on_wire_enum_drift(tree):
+    _seed(tree, "cpp/src/fault.h", "kJoin = 2,", "kJoin = 3,")
+    v = check_protocol(tree)
+    assert any("ChurnAction" in x and "kJoin" in x for x in v)
+
+
+def test_fires_on_serve_struct_format_drift(tree):
+    _seed(tree, "tpunet/serve/protocol.py",
+          '_RESULT_HDR = struct.Struct("<IIQ")',
+          '_RESULT_HDR = struct.Struct("<III")')
+    v = check_protocol(tree)
+    assert any("_RESULT_HDR" in x for x in v)
+
+
+def test_fires_on_serve_frame_type_drift(tree):
+    _seed(tree, "tpunet/serve/protocol.py", "T_SWAP_RETIRE = 7", "T_SWAP_RETIRE = 9")
+    v = check_protocol(tree)
+    assert any("T_SWAP_RETIRE" in x for x in v)
+
+
+def test_fires_on_new_constant_without_spec_entry(tree):
+    # Two-sidedness: a NEW source constant with no spec entry is as red as a
+    # spec entry the sources dropped.
+    _seed(tree, "tpunet/serve/protocol.py", "T_SWAP_RETIRE = 7",
+          "T_SWAP_RETIRE = 7\nT_SHINY_NEW = 12")
+    v = check_protocol(tree)
+    assert any("T_SHINY_NEW" in x and "no spec entry" in x for x in v)
+
+
+def test_fires_on_chaos_token_mismatch(tree):
+    _seed(tree, "tpunet/elastic.py",
+          '_CHURN_ACTIONS = {0: None, 1: "kill", 2: "join"}',
+          '_CHURN_ACTIONS = {0: None, 1: "kill", 2: "jion"}')
+    v = check_protocol(tree)
+    assert any("_CHURN_ACTIONS" in x or "jion" in x for x in v)
+
+
+def test_fires_on_codec_id_mismatch(tree):
+    _seed(tree, "tpunet/serve/protocol.py",
+          '_CODEC_IDS = {"f32": 0, "bf16": 1, "int8": 2}',
+          '_CODEC_IDS = {"f32": 0, "bf16": 2, "int8": 1}')
+    v = check_protocol(tree)
+    assert any("_CODEC_IDS" in x for x in v)
+
+
+def test_fires_on_missing_contract_file(tree):
+    (tree / "cpp/src/wire.h").unlink()
+    v = check_protocol(tree)
+    assert any("wire.h not found" in x for x in v)

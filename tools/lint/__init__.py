@@ -5,15 +5,18 @@ type-checks across the language boundary: the env-var inventory
 (``Config.from_env`` vs every ``GetEnv``/``os.environ`` read site), the
 Prometheus metric catalogue (``metrics.cc`` vs ``tpunet/telemetry.py``
 consumers), the error-code table (``c_api.h`` ``TPUNET_ERR_*`` vs the typed
-exceptions in ``tpunet/_native.py``), and the C ABI itself (declarations vs
-``extern "C"`` definitions vs ctypes bindings). Each has drifted silently in
-at least one real transport project; here drift is a red CI lane.
+exceptions in ``tpunet/_native.py``), the C ABI itself (declarations vs
+``extern "C"`` definitions vs ctypes bindings), and every wire contract —
+preamble flag bits, ctrl-frame opcodes and layouts, bootstrap-blob offsets,
+serve frame structs, chaos-grammar tokens — against the declarative registry
+in ``tools/protocol/spec.py``. Each has drifted silently in at least one
+real transport project; here drift is a red CI lane.
 
 Checkers are pure functions ``check_*(root: Path) -> list[str]`` returning
 human-readable violations (empty = clean), so tests can point them at tiny
 negative-fixture trees to prove each one actually fires
-(``tests/test_lint.py``). Run all four from the CLI with
-``python -m tools.lint``.
+(``tests/test_lint.py``, ``tests/test_protocol_lint.py``). Run all five
+from the CLI with ``python -m tools.lint``.
 """
 
 from __future__ import annotations
@@ -25,11 +28,21 @@ from tools.lint.envvars import check_env_registry
 from tools.lint.errcodes import check_error_codes
 from tools.lint.metricsreg import check_metric_registry
 
+
+def _check_protocol(root: Path) -> list[str]:
+    # Deferred: tools.protocol reuses tools.lint._util, so a module-level
+    # import here would be circular whenever tools.protocol is imported
+    # first (importing any tools.lint submodule runs this __init__).
+    from tools.protocol import check_protocol
+    return check_protocol(root)
+
+
 CHECKERS = {
     "env-registry": check_env_registry,
     "metric-registry": check_metric_registry,
     "error-codes": check_error_codes,
     "c-abi": check_c_abi,
+    "protocol": _check_protocol,
 }
 
 

@@ -1,0 +1,178 @@
+"""Explicit-state model checking for tpunet's distributed protocols.
+
+``python -m tools.model --all`` exhaustively explores small-shape models of
+the five protocol state machines whose bugs do not reproduce under test
+schedulers: single-stream failover, the DRR wire-credit scheduler, the SHM
+async-ack handshake, the 4-phase elastic rewire, and the weight-swap flip.
+Each model is a faithful abstraction of the implementation (module
+docstrings cite the code they model) checked at shapes small enough for
+full-state-space BFS — W<=3, bounded queues — which is exactly the regime
+where protocol bugs live (every published consensus bug has a tiny witness).
+
+The harness is deliberately minimal:
+
+  * a **Model** exposes ``init_states()`` (hashable states),
+    ``actions(state) -> [(label, next_state), ...]`` (the transition
+    relation), ``invariant(state) -> str | None`` (safety), ``done(state)``
+    (states where quiescence is legal), and ``progress(label)`` (which
+    transitions count as real work, for livelock detection).
+  * ``explore()`` BFSes the reachable graph, checking the invariant on
+    every state, flagging **deadlock** (no enabled action, not done) and
+    **livelock** (a reachable cycle of only non-progress transitions), and
+    reconstructs a minimal counterexample trace through BFS parent links.
+
+Sharpness is part of the contract: every model ships a ``MUTATIONS`` table
+of seeded protocol bugs (the real-world failure modes the model exists to
+catch), and ``tests/test_model_check.py`` proves the checker goes RED on
+every one — a model that cannot fail is documentation, not verification.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Hashable, Iterable
+
+
+@dataclass
+class Counterexample:
+    kind: str                      # "invariant" | "deadlock" | "livelock"
+    message: str
+    trace: list[tuple[str, Hashable]]  # (action label, resulting state), trace[0] label is "<init>"
+
+    def render(self) -> str:
+        lines = [f"{self.kind}: {self.message}", "trace:"]
+        lines += [f"  {i:3d}. {label:<28} {state!r}"
+                  for i, (label, state) in enumerate(self.trace)]
+        return "\n".join(lines)
+
+
+@dataclass
+class Result:
+    name: str
+    ok: bool
+    states: int
+    transitions: int
+    error: Counterexample | None = None
+
+
+@dataclass
+class Model:
+    """A protocol state machine. States must be hashable and immutable."""
+    name: str
+    init_states: Callable[[], Iterable[Hashable]]
+    actions: Callable[[Hashable], Iterable[tuple[str, Hashable]]]
+    invariant: Callable[[Hashable], str | None]
+    done: Callable[[Hashable], bool]
+    # Labels that constitute forward progress; a reachable cycle made purely
+    # of non-progress transitions is a livelock (the system can spin forever
+    # without doing work). Default: every transition is progress (disables
+    # livelock detection).
+    progress: Callable[[str], bool] = field(default=lambda label: True)
+
+
+def _trace_to(state: Hashable,
+              parent: dict[Hashable, tuple[Hashable, str] | None]) -> list[tuple[str, Hashable]]:
+    out: list[tuple[str, Hashable]] = []
+    cur: Hashable | None = state
+    while cur is not None:
+        link = parent[cur]
+        if link is None:
+            out.append(("<init>", cur))
+            cur = None
+        else:
+            prev, label = link
+            out.append((label, cur))
+            cur = prev
+    out.reverse()
+    return out
+
+
+def explore(model: Model, max_states: int = 2_000_000) -> Result:
+    """BFS the full reachable state space; first violation wins (BFS order
+    makes its trace minimal in steps)."""
+    parent: dict[Hashable, tuple[Hashable, str] | None] = {}
+    queue: deque[Hashable] = deque()
+    transitions = 0
+    # Edges kept only for the livelock pass; (src, label, dst).
+    nonprogress_edges: dict[Hashable, list[tuple[str, Hashable]]] = {}
+
+    def fail(kind: str, msg: str, state: Hashable,
+             extra: list[tuple[str, Hashable]] = []) -> Result:
+        cex = Counterexample(kind, msg, _trace_to(state, parent) + extra)
+        return Result(model.name, False, len(parent), transitions, cex)
+
+    for s in model.init_states():
+        if s not in parent:
+            parent[s] = None
+            queue.append(s)
+
+    while queue:
+        state = queue.popleft()
+        msg = model.invariant(state)
+        if msg is not None:
+            return fail("invariant", msg, state)
+        acts = list(model.actions(state))
+        if not acts and not model.done(state):
+            return fail("deadlock", "no enabled action in a non-terminal state", state)
+        for label, nxt in acts:
+            transitions += 1
+            if not model.progress(label):
+                nonprogress_edges.setdefault(state, []).append((label, nxt))
+            if nxt not in parent:
+                parent[nxt] = (state, label)
+                if len(parent) > max_states:
+                    raise RuntimeError(
+                        f"model {model.name}: state space exceeds {max_states} — "
+                        f"shrink the shape, exhaustive exploration is the point")
+                queue.append(nxt)
+
+    # Livelock: a cycle within the non-progress subgraph. Iterative DFS with
+    # an explicit stack; a back edge to a node on the current path is a cycle
+    # the system could traverse forever without progress.
+    color: dict[Hashable, int] = {}  # 1 = on path, 2 = finished
+    for root in nonprogress_edges:
+        if color.get(root):
+            continue
+        stack: list[tuple[Hashable, int]] = [(root, 0)]
+        path: list[tuple[Hashable, str]] = []  # (node, label taken from it)
+        while stack:
+            node, idx = stack.pop()
+            edges = nonprogress_edges.get(node, [])
+            if idx == 0:
+                color[node] = 1
+            if idx < len(edges):
+                stack.append((node, idx + 1))
+                label, nxt = edges[idx]
+                if color.get(nxt) == 1:
+                    cycle = [(label, nxt)]
+                    for pnode, plabel in reversed(path):
+                        cycle.append((plabel, pnode))
+                        if pnode == nxt:
+                            break
+                    cycle.reverse()
+                    return fail("livelock",
+                                "cycle of non-progress transitions "
+                                f"({' -> '.join(lbl for lbl, _ in cycle)})",
+                                nxt, cycle)
+                if color.get(nxt) != 2:
+                    path.append((node, label))
+                    stack.append((nxt, 0))
+            else:
+                color[node] = 2
+                if path and path[-1][0] == node:
+                    path.pop()
+
+    return Result(model.name, True, len(parent), transitions)
+
+
+def all_models() -> dict[str, Callable[..., Model]]:
+    """name -> model factory; each factory accepts ``mutation=None``."""
+    from tools.model import drr, failover, rewire, shm, swap
+    return {m.NAME: m.model for m in (failover, drr, shm, rewire, swap)}
+
+
+def all_mutations() -> dict[str, tuple[str, ...]]:
+    """model name -> its seeded-bug mutation names."""
+    from tools.model import drr, failover, rewire, shm, swap
+    return {m.NAME: tuple(m.MUTATIONS) for m in (failover, drr, shm, rewire, swap)}

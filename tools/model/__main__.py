@@ -1,0 +1,90 @@
+"""CLI for the protocol model checker.
+
+  python -m tools.model --all              # explore every model, exit 1 on any violation
+  python -m tools.model drr shm            # explore named models only
+  python -m tools.model --mutations        # list models and their seeded bugs
+  python -m tools.model --mutate drr.strict_latency
+                                           # run ONE seeded bug; exits 1 when the
+                                           # checker catches it (CI's RED self-proof
+                                           # asserts exactly that), 0 if it slipped by
+
+Exit status: 0 = everything explored clean (or, under --mutate, the seeded
+bug embarrassingly survived), 1 = a violation was found (counterexample
+trace printed), 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from tools.model import Result, all_models, all_mutations, explore
+
+
+def _report(r: Result, dt: float, *, trace: bool) -> None:
+    verdict = "ok" if r.ok else f"FAIL({r.error.kind})"
+    print(f"model {r.name:<10} {verdict:<16} {r.states:>8} states "
+          f"{r.transitions:>8} transitions  {dt:6.2f}s")
+    if r.error is not None:
+        text = r.error.render() if trace else f"{r.error.kind}: {r.error.message}"
+        print("  " + text.replace("\n", "\n  "))
+
+
+def main(argv: list[str] | None = None) -> int:
+    models = all_models()
+    mutations = all_mutations()
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.model",
+        description="Exhaustive BFS model checking of tpunet's protocol state machines.")
+    ap.add_argument("names", nargs="*", metavar="MODEL",
+                    help=f"models to explore (default: none; choices: {', '.join(models)})")
+    ap.add_argument("--all", action="store_true", help="explore every model")
+    ap.add_argument("--mutate", metavar="MODEL.MUTATION",
+                    help="explore one model with a seeded bug; exit 1 iff caught")
+    ap.add_argument("--mutations", action="store_true",
+                    help="list every model's seeded-bug mutations and exit")
+    ap.add_argument("--trace", action="store_true",
+                    help="print the full counterexample trace, not just the message")
+    args = ap.parse_args(argv)
+
+    if args.mutations:
+        for name in models:
+            for mut in mutations[name]:
+                mod = __import__(f"tools.model.{name}", fromlist=["MUTATIONS"])
+                print(f"{name}.{mut}: {mod.MUTATIONS[mut]}")
+        return 0
+
+    if args.mutate:
+        name, _, mut = args.mutate.partition(".")
+        if name not in models or mut not in mutations.get(name, ()):
+            ap.error(f"unknown mutation {args.mutate!r}; see --mutations")
+        t0 = time.monotonic()
+        r = explore(models[name](mut))
+        _report(r, time.monotonic() - t0, trace=args.trace)
+        if r.ok:
+            print(f"seeded bug {args.mutate} was NOT caught — the model has "
+                  f"lost its sharpness", file=sys.stderr)
+            return 0
+        print(f"seeded bug {args.mutate} caught ({r.error.kind}) — checker is sharp")
+        return 1
+
+    names = list(models) if args.all else args.names
+    if not names:
+        ap.error("nothing to do: give model names, --all, --mutate, or --mutations")
+    for n in names:
+        if n not in models:
+            ap.error(f"unknown model {n!r} (choices: {', '.join(models)})")
+
+    failed = False
+    for n in names:
+        t0 = time.monotonic()
+        r = explore(models[n]())
+        _report(r, time.monotonic() - t0, trace=args.trace)
+        failed |= not r.ok
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,207 @@
+"""Model: the QoS DRR wire-credit scheduler (cpp/src/qos.cc PumpLocked).
+
+Faithful abstraction of the pump: strict control priority FIFO ahead of
+everything (a window-blocked control head pauses ALL classes); deficit
+round-robin between latency(0) and bulk(1) where a TURN earns
+``weight x quantum`` exactly once at turn start and spends front-first; a
+head that does not fit the shared wire window pauses the turn and the next
+pump resumes it WITHOUT re-crediting (qos.cc:251-278); a drained queue's
+deficit resets (no banking while empty); and ``RoomLocked`` admits any
+single chunk on an empty wire so an oversize chunk cannot wedge the
+scheduler (qos.cc:198-202).
+
+The pump runs under the scheduler mutex, so the model treats each
+``{release | arrival} + PumpLocked`` pair as one atomic action — exactly
+the real call graph (``Release``/``Submit`` -> ``PumpLocked``) — and BFS
+explores every completion/arrival order over a set of fixed small
+workloads (sizes in wire-window units, quantum = 1, weights = (1,1)).
+
+Checked properties:
+
+  * priority — no latency/bulk grant ever happens while control is queued.
+  * credit — the wire never exceeds the window except for a single
+    oversize chunk granted on an empty wire; a class's deficit never
+    exceeds ``max_chunk`` (banked remainder + one quantum), and an empty
+    queue's deficit is zero.
+  * fairness — while both classes stay backlogged, granted bytes differ by
+    at most ``quantum + max_chunk`` (the classic DRR service bound).
+  * liveness — every workload drains to empty queues and empty wire
+    (deadlock detection; a scheduler that stops granting with work queued
+    and wire idle is a wedge).
+
+MUTATIONS seed the scheduler bugs each property exists to catch.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from tools.model import Model
+
+NAME = "drr"
+
+WINDOW = 2
+QUANTUM = 1
+
+# (ctrl, lat, bulk, pending arrivals) — sizes in window units. Shapes chosen
+# so every pump branch is reachable: W1 priority + fairness under size-1
+# backlogs with a late control arrival; W2 mid-turn window pause with banked
+# deficit; W3 the oversize single chunk; W4 deep bulk backlog behind an
+# often-blocked latency head (the no-re-credit honesty case); W5 a late
+# size-2 control arrival that window-blocks behind an inflight size-1 chunk
+# while size-1 DRR heads would still fit (the pause-everything case).
+WORKLOADS = (
+    ((1,), (1, 1, 1, 1), (1, 1, 1, 1), (("ctrl", 1),)),
+    ((), (2, 1), (1, 2), ()),
+    ((), (3,), (1,), ()),
+    ((), (2, 2), (1, 1, 1), ()),
+    ((), (1, 1, 1), (1,), (("ctrl", 2),)),
+)
+
+
+def _pump(qc, q0, q1, d0, d1, turn, nxt, wire, g0, g1, mutation):
+    """One PumpLocked run; returns the post-pump fields + violation."""
+    viol = None
+    qc, qs = list(qc), [list(q0), list(q1)]
+    d, g = [d0, d1], [g0, g1]
+    wire = list(wire)
+
+    def room(n):
+        s = sum(wire)
+        if mutation == "no_oversize_escape":
+            return s + n <= WINDOW          # drops the empty-wire escape
+        return s == 0 or s + n <= WINDOW
+
+    def grant(c, q):
+        nonlocal viol
+        n = q.pop(0)
+        wire.append(n)
+        if c != 2:
+            g[c] += n
+            if qc and viol is None:
+                viol = (f"granted class {c} ({n} units) while control is "
+                        f"backlogged (priority inversion)")
+
+    def snap():
+        return (tuple(qc), tuple(qs[0]), tuple(qs[1]), d[0], d[1],
+                turn, nxt, tuple(sorted(wire)), g[0], g[1], viol)
+
+    if mutation == "bulk_before_control":
+        # Seeded inversion: squeeze one bulk head in ahead of control.
+        if qs[1] and room(qs[1][0]) and d[1] + QUANTUM >= qs[1][0]:
+            d[1] += QUANTUM
+            d[1] -= qs[1][0]
+            grant(1, qs[1])
+
+    # Strict control priority, FIFO; a blocked control head pauses all.
+    while qc and room(qc[0]):
+        grant(2, qc)
+    if qc and mutation != "bypass_blocked_control":
+        return snap()
+
+    # Deficit round-robin between latency and bulk.
+    while True:
+        if turn < 0:
+            if not qs[0] and not qs[1]:
+                d = [0, 0]                   # no banking while idle
+                break
+            pick = nxt
+            if not qs[pick]:
+                pick ^= 1
+            nxt = pick ^ 1
+            if mutation == "strict_latency":
+                pick = 0 if qs[0] else 1     # rotation ignored
+            turn = pick
+            d[pick] += QUANTUM               # earned once, at turn start
+        c = turn
+        while qs[c] and d[c] >= qs[c][0]:
+            if not room(qs[c][0]):
+                if mutation == "recredit_on_pause":
+                    turn = -1                # forget the turn: resume re-earns
+                return snap()                # window full mid-turn: pause
+            d[c] -= qs[c][0]
+            grant(c, qs[c])
+        if not qs[c]:
+            d[c] = 0
+        turn = -1
+    return snap()
+
+
+def model(mutation: str | None = None) -> Model:
+    if mutation is not None and mutation not in MUTATIONS:
+        raise ValueError(f"unknown mutation {mutation!r} (want one of {sorted(MUTATIONS)})")
+
+    def _finish(pumped, b0, b1, maxsz):
+        (qc, q0, q1, d0, d1, turn, nxt, wire, g0, g1, viol) = pumped
+        # Backlog flags latch False the first time a queue is seen empty;
+        # the fairness bound only binds continuously-backlogged classes.
+        return (qc, q0, q1, d0, d1, turn, nxt, wire, g0, g1,
+                b0 and bool(q0), b1 and bool(q1), maxsz, viol)
+
+    def init_states():
+        for qc, q0, q1, pend in WORKLOADS:
+            maxsz = max(q0 + q1 + qc + tuple(n for _c, n in pend))
+            pumped = _pump(qc, q0, q1, 0, 0, -1, 0, (), 0, 0, mutation)
+            yield _finish(pumped, bool(q0), bool(q1), maxsz) + (pend,)
+
+    def actions(state) -> Iterator:
+        (qc, q0, q1, d0, d1, turn, nxt, wire, g0, g1,
+         b0, b1, maxsz, viol, pend) = state
+        if viol:
+            return
+        # A granted chunk completes: Release() -> PumpLocked().
+        for size in sorted(set(wire)):
+            rest = list(wire)
+            rest.remove(size)
+            pumped = _pump(qc, q0, q1, d0, d1, turn, nxt, rest, g0, g1, mutation)
+            yield (f"release({size})", _finish(pumped, b0, b1, maxsz) + (pend,))
+        # A late arrival: Submit() -> PumpLocked().
+        for i, (cls, size) in enumerate(pend):
+            nqc, nq0, nq1 = qc, q0, q1
+            if cls == "ctrl":
+                nqc = qc + (size,)
+            elif cls == "lat":
+                nq0 = q0 + (size,)
+            else:
+                nq1 = q1 + (size,)
+            pumped = _pump(nqc, nq0, nq1, d0, d1, turn, nxt, wire, g0, g1, mutation)
+            yield (f"arrive({cls},{size})",
+                   _finish(pumped, b0, b1, maxsz) + (pend[:i] + pend[i + 1:],))
+
+    def invariant(state) -> str | None:
+        (qc, q0, q1, d0, d1, _turn, _nxt, wire, g0, g1,
+         b0, b1, maxsz, viol, _pend) = state
+        if viol:
+            return viol
+        if sum(wire) > WINDOW and len(wire) != 1:
+            return (f"wire credit {sum(wire)} exceeds window {WINDOW} with "
+                    f"{len(wire)} chunks inflight")
+        for c, (d, q) in enumerate(((d0, q0), (d1, q1))):
+            if d > maxsz:
+                return (f"class {c} deficit {d} exceeds the legit maximum "
+                        f"{maxsz} (re-credited without spending?)")
+            if not q and d != 0:
+                return f"class {c} queue is empty but deficit is {d} (banking while idle)"
+        if b0 and b1 and abs(g0 - g1) > QUANTUM + maxsz:
+            return (f"DRR unfairness: granted bytes {g0} vs {g1} while both "
+                    f"classes stayed backlogged (bound {QUANTUM + maxsz})")
+        return None
+
+    def done_fn(state) -> bool:
+        (qc, q0, q1, _d0, _d1, _turn, _nxt, wire, _g0, _g1,
+         _b0, _b1, _maxsz, _viol, pend) = state
+        return not qc and not q0 and not q1 and not wire and not pend
+
+    # Releases and arrivals always change state (the pump is deterministic),
+    # so liveness reduces to deadlock; default progress is correct.
+    return Model(NAME, init_states, actions, invariant, done_fn)
+
+
+#: Seeded scheduler bugs; each maps to one checked property.
+MUTATIONS = {
+    "bulk_before_control": "DRR served ahead of the control queue — priority inversion",
+    "bypass_blocked_control": "window-blocked control no longer pauses lower classes",
+    "strict_latency": "rotation ignored: latency always wins the turn — bulk starves",
+    "recredit_on_pause": "window pause forgets the turn — the resume re-earns its quantum",
+    "no_oversize_escape": "RoomLocked drops the empty-wire escape — an oversize chunk wedges",
+}

@@ -1,0 +1,189 @@
+"""Model: single-stream failover (NACK / FAILOVER marker / ctrl retransmit).
+
+Abstraction of cpp/src/basic_engine.cc's degradation protocol: the receiver
+tracks the highest contiguous delivered seq per data stream (``done_seq``);
+when a stream dies it emits ``PackCtrlFrame(kCtrlFrameNack, stream,
+done_seq)`` (basic_engine.cc ~line 525). The sender answers with a
+``kCtrlFrameFailover`` marker carrying the retransmit unit count, then
+resends every chunk from the receiver's first missing seq over the ctrl
+stream in order (SenderHandleNack, ~line 1106); the receiver's
+``ProcessFailoverMarkerLocked`` (~line 915) checks the batch lines up with
+its own gap. A concurrent re-striping epoch (``kCtrlFrameWeights``) may
+interleave on the same ctrl stream and must not perturb delivery.
+
+Model shape: one data stream carrying N chunks (seq 0..N-1), which may fail
+at any point, losing everything in flight (and silently eating anything the
+sender writes before it learns of the failure); the ctrl stream is reliable
+and ordered (TCP), carrying WEIGHTS / FAILOVER / retransmitted chunks
+sender->receiver and the NACK receiver->sender. Checked properties:
+
+  * safety — the receiver accepts each seq exactly once, in order (no lost
+    chunk, no duplicate, no gap); the FAILOVER marker's unit count exactly
+    covers the receiver's missing suffix; the receiver's weights epoch
+    never runs ahead of the sender's.
+  * liveness — every execution reaches "all N chunks delivered, epochs
+    converged" (deadlock detection; every transition here is progress).
+
+MUTATIONS are the real-world failure modes this model exists to catch:
+off-by-one resume seq (lost chunk), resume-from-zero (duplicate), and a
+sender that drops the NACK on the floor (wedge -> deadlock).
+"""
+
+from __future__ import annotations
+
+from typing import Hashable, Iterator
+
+from tools.model import Model
+
+NAME = "failover"
+
+N_CHUNKS = 3          # data payload; seq 0..2
+MAX_EPOCH = 1         # one concurrent re-striping epoch bump
+
+
+def _mk(sender_next: int, wire: tuple[int, ...], failed: bool,
+        nack_msg: int | None, ctrl: tuple[Hashable, ...],
+        resend: tuple[int, ...], done: int, s_epoch: int, r_epoch: int,
+        phase: str, viol: str | None):
+    """phase: 'data' (striping), 'nacked' (NACK sent, awaiting failover),
+    'failover' (marker sent or NACK dropped)."""
+    return (sender_next, wire, failed, nack_msg, ctrl, resend, done,
+            s_epoch, r_epoch, phase, viol)
+
+
+def model(mutation: str | None = None) -> Model:
+    if mutation is not None and mutation not in MUTATIONS:
+        raise ValueError(f"unknown mutation {mutation!r} (want one of {sorted(MUTATIONS)})")
+
+    def init_states():
+        yield _mk(0, (), False, None, (), (), 0, 0, 0, "data", None)
+
+    def actions(state) -> Iterator[tuple[str, Hashable]]:
+        (nxt, wire, failed, nack_msg, ctrl, resend, done,
+         s_ep, r_ep, phase, viol) = state
+        if viol:
+            return
+
+        # Sender stripes the next chunk. On a dead stream the write
+        # disappears into the failed socket (the sender has not seen the
+        # NACK yet, so it cannot know).
+        if nxt < N_CHUNKS and phase == "data":
+            new_wire = wire if failed else wire + (nxt,)
+            yield (f"send({nxt})",
+                   _mk(nxt + 1, new_wire, failed, nack_msg, ctrl, resend,
+                       done, s_ep, r_ep, phase, viol))
+
+        # Sender announces a re-striping epoch over ctrl (kCtrlFrameWeights).
+        if s_ep < MAX_EPOCH:
+            yield ("weights_epoch",
+                   _mk(nxt, wire, failed, nack_msg,
+                       ctrl + (("weights", s_ep + 1),), resend, done,
+                       s_ep + 1, r_ep, phase, viol))
+
+        # The data stream fails; everything in flight is lost.
+        if not failed:
+            yield ("stream_fail",
+                   _mk(nxt, (), True, nack_msg, ctrl, resend, done,
+                       s_ep, r_ep, phase, viol))
+
+        # Receiver delivers the head of the (live) data stream.
+        if wire:
+            seq, rest = wire[0], wire[1:]
+            v = viol
+            if seq != done:
+                v = f"receiver got seq {seq} while expecting {done} (lost or duplicated chunk)"
+            yield (f"deliver({seq})",
+                   _mk(nxt, rest, failed, nack_msg, ctrl, resend,
+                       done + (1 if v is None else 0), s_ep, r_ep, phase, v))
+
+        # Receiver: stream is down, chunks are missing -> NACK once with the
+        # confirmed contiguous seq (done_seq).
+        if failed and phase == "data" and done < N_CHUNKS:
+            yield ("nack",
+                   _mk(nxt, wire, failed, done, ctrl, resend, done,
+                       s_ep, r_ep, "nacked", viol))
+
+        # Sender consumes the NACK -> FAILOVER marker + retransmit batch
+        # from the receiver's first missing seq, over ctrl.
+        if nack_msg is not None and phase == "nacked":
+            start = nack_msg
+            if mutation == "resume_off_by_one":
+                start = nack_msg + 1        # skips the first missing chunk
+            elif mutation == "resume_from_zero":
+                start = 0                   # replays already-delivered chunks
+            if mutation == "ignore_nack":
+                yield ("drop_nack",
+                       _mk(nxt, wire, failed, None, ctrl, resend, done,
+                           s_ep, r_ep, "failover", viol))
+            else:
+                batch = tuple(range(start, N_CHUNKS))
+                yield ("failover_marker",
+                       _mk(nxt, wire, failed, None,
+                           ctrl + (("failover", len(batch)),), batch, done,
+                           s_ep, r_ep, "failover", viol))
+
+        # Sender pushes the next retransmit chunk onto the ctrl stream.
+        if resend:
+            seq, rest = resend[0], resend[1:]
+            yield (f"retransmit({seq})",
+                   _mk(nxt, wire, failed, nack_msg, ctrl + (("chunk", seq),),
+                       rest, done, s_ep, r_ep, phase, viol))
+
+        # Receiver consumes the head of the ordered, reliable ctrl stream.
+        if ctrl:
+            head, rest = ctrl[0], ctrl[1:]
+            kind, arg = head
+            if kind == "weights":
+                yield ("apply_weights",
+                       _mk(nxt, wire, failed, nack_msg, rest, resend, done,
+                           s_ep, arg, phase, viol))
+            elif kind == "failover":
+                # ProcessFailoverMarkerLocked's own desync check.
+                v = viol
+                if arg != N_CHUNKS - done:
+                    v = (f"FAILOVER marker announces {arg} units but the "
+                         f"receiver is missing {N_CHUNKS - done} (failover desync)")
+                yield ("failover_check",
+                       _mk(nxt, wire, failed, nack_msg, rest, resend, done,
+                           s_ep, r_ep, phase, v))
+            else:  # retransmitted chunk
+                v = viol
+                if arg != done:
+                    v = (f"ctrl retransmit delivered seq {arg} while expecting "
+                         f"{done} (lost or duplicated chunk)")
+                yield (f"ctrl_deliver({arg})",
+                       _mk(nxt, wire, failed, nack_msg, rest, resend,
+                           done + (1 if v is None else 0), s_ep, r_ep, phase, v))
+
+    def invariant(state) -> str | None:
+        (_nxt, _wire, _failed, _nack, _ctrl, _resend, done,
+         s_ep, r_ep, _phase, viol) = state
+        if viol:
+            return viol
+        if done > N_CHUNKS:
+            return f"receiver delivered {done} chunks of {N_CHUNKS} (duplicate)"
+        if r_ep > s_ep:
+            return f"receiver epoch {r_ep} ahead of sender epoch {s_ep}"
+        return None
+
+    def done_fn(state) -> bool:
+        (_nxt, wire, _failed, _nack, ctrl, resend, done,
+         s_ep, r_ep, _phase, _viol) = state
+        # Legal quiescence: everything delivered (by either path), all
+        # buffers drained, epochs converged. The sender's data-stream cursor
+        # may legally stop short: the failover batch covers the tail.
+        return (done == N_CHUNKS and not wire and not resend and not ctrl
+                and s_ep == r_ep)
+
+    # Every transition moves data or control state forward, so livelock
+    # reduces to deadlock; the default progress (all labels) is correct.
+    return Model(NAME, init_states, actions, invariant, done_fn)
+
+
+#: Seeded protocol bugs; tests/test_model_check.py proves each turns the
+#: checker RED (sharpness), and `--mutate failover.<name>` replays one.
+MUTATIONS = {
+    "resume_off_by_one": "retransmit starts at confirmed+1 — first missing chunk is lost",
+    "resume_from_zero": "retransmit replays from seq 0 — delivered chunks duplicated",
+    "ignore_nack": "sender drops the NACK — receiver waits forever (deadlock)",
+}

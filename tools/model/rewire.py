@@ -1,0 +1,214 @@
+"""Model: the 4-phase elastic rewire under concurrent kill/join
+(tpunet/elastic.py ElasticWorld).
+
+Survivors that detect a failure (or a pending join request) bump and
+publish the generation — ``g = max(self.generation + 1,
+read_generation(dir))`` (elastic.py ``_rewire``) — and enter the
+membership rendezvous for ``g``; the rendezvous seals once every live
+survivor has shown up (the grace window), producing the new world view;
+members then rewire and resume at generation ``g``. A joiner polls the
+published generation and enters the next open rendezvous; one that misses
+a seal stays pending, and its standing request forces another rewire
+(elastic.py ``_join``: "a joiner that misses a grace window waits for the
+survivors to open the next rendezvous").
+
+Model shape: W=3 ranks plus one joiner, at most one kill and one join,
+both free to land at ANY point of an ongoing rewire (including between a
+seal and a member's resume). The seal's member set is the entered set
+intersected with the still-alive set (the grace window's final roll call),
+and a joiner is admitted iff it entered before the seal. Fairness assumption
+(bounds the state space): the joiner misses at most ONE grace window —
+without it, "the joiner is unlucky forever" repeats the rewire cycle at
+ever-growing generations, a livelock the real system excludes by
+scheduling, not protocol.
+
+Checked properties:
+
+  * generation monotone — a seal that does not strictly raise a member's
+    generation is flagged at the transition.
+  * no split world — two live resumed ranks at the same generation always
+    hold identical membership views, and every resumed rank's view
+    contains itself.
+  * liveness — every execution reaches a stable world: all live ranks
+    resumed on one shared view with no dead members in it and no join
+    request outstanding (deadlock detection).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from tools.model import Model
+
+NAME = "rewire"
+
+W = 3
+JOINER = W  # rank id of the single joiner
+
+# Rank record: (alive, phase, gen, view) where phase is 'run', 'rdv',
+# 'rewire', and for the joiner also 'absent'/'pending'. view is a frozenset.
+# Rounds: sorted tuple of (gen, entered frozenset, sealed bool).
+
+
+def model(mutation: str | None = None) -> Model:
+    if mutation is not None and mutation not in MUTATIONS:
+        raise ValueError(f"unknown mutation {mutation!r} (want one of {sorted(MUTATIONS)})")
+
+    full = frozenset(range(W))
+
+    def init_states():
+        ranks = tuple((True, "run", 0, full) for _ in range(W))
+        ranks += ((False, "absent", -1, frozenset()),)
+        # ranks, published, rounds, kills, joins, joiner-misses, viol
+        yield (ranks, 0, (), 1, 1, 1, None)
+
+    def _round(rounds, g):
+        for i, (rg, entered, sealed) in enumerate(rounds):
+            if rg == g:
+                return i, entered, sealed
+        return None, frozenset(), False
+
+    def _set_round(rounds, g, entered, sealed):
+        i, _e, _s = _round(rounds, g)
+        lst = list(rounds)
+        if i is None:
+            lst.append((g, entered, sealed))
+        else:
+            lst[i] = (g, entered, sealed)
+        return tuple(sorted(lst))
+
+    def actions(state) -> Iterator:
+        ranks, published, rounds, kills, joins, misses, viol = state
+        if viol:
+            return
+        alive = {r for r in range(W + 1) if ranks[r][0]}
+        join_pending = ranks[JOINER][0] and ranks[JOINER][1] in ("pending", "rdv")
+
+        def with_rank(r, rec, *, pub=published, rnds=rounds, k=kills, j=joins, v=viol):
+            lst = list(ranks)
+            lst[r] = rec
+            return (tuple(lst), pub, rnds, k, j, misses, v)
+
+        # A rank dies — at any phase, mid-rewire included.
+        if kills:
+            for r in range(W):
+                if ranks[r][0]:
+                    rec = (False,) + ranks[r][1:]
+                    yield (f"kill({r})", with_rank(r, rec, k=kills - 1))
+
+        # The join request lands (directory write a la elastic.py _join).
+        if joins:
+            yield ("join_request",
+                   with_rank(JOINER, (True, "pending", -1, frozenset()), j=joins - 1))
+
+        # A live running member detects a dead member in its view or the
+        # standing join request: bump + publish + enter the rendezvous. An
+        # admitted joiner (gen >= 0) is a full member and rewires too.
+        for r in range(W + 1):
+            is_alive, phase, gen, view = ranks[r]
+            if not is_alive or phase != "run" or gen < 0:
+                continue
+            if not ((view - alive) or join_pending):
+                continue
+            g = max(gen, published) if mutation == "no_gen_bump" \
+                else max(gen + 1, published)
+            _i, entered, sealed = _round(rounds, g)
+            if sealed:
+                continue  # a round this rank could enter will open at g+1
+            nrounds = _set_round(rounds, g, entered | {r}, False)
+            yield (f"detect({r})@g{g}",
+                   with_rank(r, (True, "rdv", gen, view),
+                             pub=max(published, g), rnds=nrounds))
+
+        # The joiner polls the published generation and enters an open round.
+        if ranks[JOINER][1] == "pending":
+            i, entered, sealed = _round(rounds, published)
+            if i is not None and not sealed:
+                nrounds = _set_round(rounds, published, entered | {JOINER}, False)
+                yield (f"join_enter@g{published}",
+                       with_rank(JOINER, (True, "rdv", -1, frozenset()),
+                                 rnds=nrounds))
+
+        # Seal the rendezvous: the grace window closes once every live
+        # survivor is in (HEAD); the seeded quorumless mutation closes it
+        # for any non-empty attendance, re-sealing included.
+        for g, entered, sealed in rounds:
+            if sealed:
+                continue
+            # Every live current MEMBER must make the window; an admitted
+            # joiner counts, a still-pending one does not.
+            survivors = {r for r in range(W + 1)
+                         if ranks[r][0] and ranks[r][2] >= 0}
+            present = entered & alive
+            can_seal = (survivors <= entered) if mutation != "quorumless_seal" \
+                else bool(present)
+            if not can_seal:
+                continue
+            # Fairness bound: a still-pending joiner may be left out of at
+            # most `misses` windows; after that the window waits for it.
+            nmisses = misses
+            if ranks[JOINER][0] and ranks[JOINER][1] == "pending" \
+                    and JOINER not in entered:
+                if misses == 0 and mutation != "quorumless_seal":
+                    continue
+                nmisses = max(0, misses - 1)
+            members = frozenset(present)
+            nranks = list(ranks)
+            v = viol
+            for m in sorted(members):
+                _a, _p, mgen, mview = ranks[m]
+                if g <= mgen and v is None:
+                    v = (f"rank {m} sealed into generation {g} but already "
+                         f"held generation {mgen} (generation not monotone)")
+                new_view = members
+                if mutation == "stale_view_commit" and m != JOINER:
+                    new_view = frozenset(mview & alive)  # own stale detect view
+                nranks[m] = (True, "rewire", g, new_view)
+            yield (f"seal@g{g}",
+                   (tuple(nranks), published, _set_round(rounds, g, entered, True),
+                    kills, joins, nmisses, v))
+
+        # A sealed member finishes rewiring and resumes.
+        for r in range(W + 1):
+            is_alive, phase, gen, view = ranks[r]
+            if is_alive and phase == "rewire":
+                yield (f"resume({r})", with_rank(r, (True, "run", gen, view)))
+
+    def invariant(state) -> str | None:
+        ranks, _published, _rounds, _kills, _joins, _misses, viol = state
+        if viol:
+            return viol
+        running = [(r, gen, view) for r, (a, p, gen, view) in enumerate(ranks)
+                   if a and p == "run"]
+        for r, gen, view in running:
+            if r not in view:
+                return f"rank {r} resumed at generation {gen} with a view {sorted(view)} not containing itself"
+        for i in range(len(running)):
+            for j in range(i + 1, len(running)):
+                r1, g1, v1 = running[i]
+                r2, g2, v2 = running[j]
+                if g1 == g2 and v1 != v2:
+                    return (f"split world: ranks {r1} and {r2} both resumed at "
+                            f"generation {g1} with views {sorted(v1)} vs {sorted(v2)}")
+        return None
+
+    def done_fn(state) -> bool:
+        ranks, _published, _rounds, _kills, _joins, _misses, _viol = state
+        alive = {r for r in range(W + 1) if ranks[r][0]}
+        views = set()
+        for r in alive:
+            _a, phase, _gen, view = ranks[r]
+            if phase != "run" or (view - alive):
+                return False
+            views.add(view)
+        return len(views) == 1
+
+    return Model(NAME, init_states, actions, invariant, done_fn)
+
+
+#: Seeded rewire bugs.
+MUTATIONS = {
+    "no_gen_bump": "survivors reuse their current generation — monotonicity broken",
+    "quorumless_seal": "the rendezvous seals before every survivor arrived — split world",
+    "stale_view_commit": "members commit their local detect view, not the sealed one",
+}

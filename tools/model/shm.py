@@ -1,0 +1,145 @@
+"""Model: the SHM segment handshake (cpp/src/shm_engine.cc).
+
+The property this model guards is stated verbatim in the implementation
+(shm_engine.cc ~line 944): connect "must not require the peer to be inside
+accept() already, or the collectives' connect-all-then-accept-all wiring
+would deadlock". The connector posts its segment offer over the ctrl
+stream and returns; sends proceed **optimistically** into the ring with
+their LEN frames deferred (``SendPreAckMsg``) so completion needs no peer
+participation; whenever the acceptor eventually runs accept() it maps the
+segment and emits a one-byte verdict; ``ResolveShmVerdict`` then either
+flushes the deferred LEN frames (ack: the ring content is live) or replays
+every deferred message over ctrl and drops the segment (nack: TCP mode).
+
+Model shape: two ranks, each executing the collectives' wiring order —
+connect(peer) then accept(peer) then block for its own verdict — with one
+optimistic message per direction and a nondeterministic verdict (ack or
+nack: host mismatch and CRC refusal are real). BFS explores every
+interleaving of the two ranks.
+
+Checked properties:
+
+  * liveness — the cross-connect always completes; a handshake that makes
+    connect wait for the peer's accept deadlocks the wiring (detected).
+  * safety — each direction's message is delivered exactly once, on BOTH
+    verdict paths (ack -> ring flush, nack -> ctrl replay), never zero
+    (dropped deferred) and never twice (double flush).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from tools.model import Model
+
+NAME = "shm"
+
+# Per-rank pc: start -> posted -> accepted -> done (HEAD), with the
+# sync-rendezvous mutation detouring start -> await_sync (connect blocks).
+# Per-direction channel (index = connector rank): offer state, verdict in
+# flight, optimistic send done, verdict resolved, delivered count.
+
+
+def _chan(offer="none", verdict=None, sent=False, resolved=False, delivered=0):
+    return (offer, verdict, sent, resolved, delivered)
+
+
+def model(mutation: str | None = None) -> Model:
+    if mutation is not None and mutation not in MUTATIONS:
+        raise ValueError(f"unknown mutation {mutation!r} (want one of {sorted(MUTATIONS)})")
+
+    def init_states():
+        yield (("start", "start"), (_chan(), _chan()))
+
+    def actions(state) -> Iterator:
+        pcs, chans = state
+        for r in (0, 1):
+            peer = 1 - r
+            pc = pcs[r]
+            offer, verdict, sent, resolved, delivered = chans[r]
+
+            def upd(new_pc=None, _chan=r, _r=r, **chg):
+                """New state: set rank _r's pc and update channel _chan's
+                named fields (default: this rank's own channel)."""
+                np = list(pcs)
+                if new_pc is not None:
+                    np[_r] = new_pc
+                nc = list(chans)
+                if chg:
+                    cur = dict(zip(("offer", "verdict", "sent", "resolved",
+                                    "delivered"), chans[_chan]))
+                    cur.update(chg)
+                    nc[_chan] = tuple(cur.values())
+                return (tuple(np), tuple(nc))
+
+            # connect(): post the segment offer on ctrl. HEAD returns
+            # immediately (async ack); the seeded rendezvous bug blocks
+            # inside connect until the verdict lands.
+            if pc == "start":
+                nxt = "await_sync" if mutation == "sync_rendezvous" else "posted"
+                yield (f"r{r}.connect_post", upd(new_pc=nxt, offer="inflight"))
+
+            # Optimistic send into the ring: legal the moment the offer is
+            # posted, with the LEN frame deferred until the verdict —
+            # explicitly independent of the peer's accept progress.
+            if pc in ("posted", "accepted", "await_sync") and \
+                    offer != "none" and not sent and not resolved:
+                yield (f"r{r}.optimistic_send", upd(sent=True))
+
+            # accept(): consume the PEER's offer, map, emit a verdict byte.
+            # Runs only after this rank's own connect returned — the
+            # connect-all-then-accept-all wiring order.
+            if pc == "posted" and chans[peer][0] == "inflight":
+                for v in ("ack", "nack"):
+                    yield (f"r{r}.accept_{v}",
+                           upd(new_pc="accepted", _chan=peer,
+                               offer="consumed", verdict=v))
+
+            # Resolve this rank's own verdict (ResolveShmVerdict): ack
+            # flushes the deferred LEN frames, nack replays over ctrl —
+            # either way the message is delivered exactly once.
+            want_pc = "await_sync" if mutation == "sync_rendezvous" else "accepted"
+            if pc == want_pc and verdict is not None and sent and not resolved:
+                n = 1
+                if verdict == "nack" and mutation == "nack_drops_deferred":
+                    n = 0       # seeded bug: deferred queue dropped on nack
+                if verdict == "ack" and mutation == "double_flush":
+                    n = 2       # seeded bug: deferred LEN frames flushed twice
+                nxt = "posted" if mutation == "sync_rendezvous" else "done"
+                yield (f"r{r}.resolve_{verdict}",
+                       upd(new_pc=nxt, resolved=True, delivered=delivered + n))
+
+            # sync mutation tail: after the (unreachable in the deadlocking
+            # interleavings) inline verdict, the rank still runs accept+done.
+            if mutation == "sync_rendezvous" and pc == "posted" and resolved \
+                    and chans[peer][0] == "consumed":
+                yield (f"r{r}.finish", upd(new_pc="done"))
+
+        return
+
+    def invariant(state) -> str | None:
+        pcs, chans = state
+        for r, (_o, _v, _s, _res, delivered) in enumerate(chans):
+            if delivered > 1:
+                return (f"direction {r}->{1 - r} delivered {delivered} copies "
+                        f"(deferred LEN frames flushed more than once)")
+        if all(pc == "done" for pc in pcs):
+            for r, (_o, _v, _s, _res, delivered) in enumerate(chans):
+                if delivered != 1:
+                    return (f"handshake completed but direction {r}->{1 - r} "
+                            f"delivered {delivered} messages (lost deferred send)")
+        return None
+
+    def done_fn(state) -> bool:
+        pcs, _chans = state
+        return all(pc == "done" for pc in pcs)
+
+    return Model(NAME, init_states, actions, invariant, done_fn)
+
+
+#: Seeded handshake bugs.
+MUTATIONS = {
+    "sync_rendezvous": "connect blocks for the verdict — cross-connect wiring deadlocks",
+    "nack_drops_deferred": "nack path drops the deferred queue instead of ctrl replay",
+    "double_flush": "ack path flushes the deferred LEN frames twice — duplicate delivery",
+}

@@ -1,0 +1,191 @@
+"""Model: the weight-swap flip (tpunet/serve/publish.py).
+
+The publisher announces each publication attempt with a token —
+``(seq << 32) | version`` rides the BEGIN/STATUS req_id "so a LATE
+aborted-status from an abandoned attempt can never poison the retry that
+superseded it" (publish.py ~line 396). Each decode rank verifies the
+broadcast independently: a verified rank stages and flips, a corrupt one
+refuses, and ``publish()`` succeeds only when the WHOLE fleet flipped —
+mixed-version pools are legal in the meantime because every session is
+pinned at admission to the version that prefilled it, and old versions
+serve their pinned sessions until drained, then retire (T_SWAP_RETIRE).
+
+Model shape: one publisher, two decode ranks, up to two publication
+attempts (token 0 -> version 1, token 1 -> version 2), per-rank
+nondeterministic verify outcome (ok/corrupt), publisher deadline aborts
+that can strand BEGIN/STATUS frames in flight, one pinned session, and
+version retirement. Messages are an unordered in-flight set — late
+delivery of abandoned-attempt frames is the whole point.
+
+Checked properties:
+
+  * abandoned tokens never commit — a stale STATUS must not count toward a
+    newer attempt's flip quorum, and a stale BEGIN must not flip a rank
+    backward (per-rank active version is monotone).
+  * sessions never see mixed versions — a session pinned to version v can
+    always read v from every rank until it drains; retirement waits for
+    pinned sessions, and a rank's ACTIVE version is never retired.
+  * liveness — every execution quiesces with no frame in flight.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from tools.model import Model
+
+NAME = "swap"
+
+WORLD = 2
+ATTEMPTS = ((0, 1), (1, 2))  # (token, version) per publication attempt
+
+
+def model(mutation: str | None = None) -> Model:
+    if mutation is not None and mutation not in MUTATIONS:
+        raise ValueError(f"unknown mutation {mutation!r} (want one of {sorted(MUTATIONS)})")
+
+    def init_states():
+        ranks = tuple((frozenset({0}), 0, -1) for _ in range(WORLD))
+        # publisher: (phase, token, serving, flips, retired frozenset,
+        #             attempts_done) / msgs / ranks / session / viol
+        yield (("idle", -1, 0, 0, frozenset(), 0), frozenset(), ranks,
+               ("none", -1, False, False), None)
+
+    def actions(state) -> Iterator:
+        pub, msgs, ranks, session, viol = state
+        if viol:
+            return
+        phase, token, serving, flips, retired, attempts = pub
+        s_status, s_pin, s_r0, s_r1 = session
+
+        def mk(pub=pub, msgs=msgs, ranks=ranks, session=session, viol=viol):
+            return (pub, msgs, ranks, session, viol)
+
+        # Publisher opens the next attempt: BEGIN to every rank.
+        if phase == "idle" and attempts < len(ATTEMPTS):
+            t, ver = ATTEMPTS[attempts]
+            nmsgs = msgs | {("begin", t, ver, r) for r in range(WORLD)}
+            yield (f"announce(v{ver},t{t})",
+                   mk(pub=("wait", t, serving, 0, retired, attempts + 1),
+                      msgs=nmsgs))
+
+        # Publisher deadline abort: the attempt is abandoned, its frames
+        # stay in flight (the stale-token hazard this model exists for).
+        if phase == "wait":
+            yield ("deadline_abort",
+                   mk(pub=("idle", token, serving, 0, retired, attempts)))
+
+        # Publisher consumes a STATUS frame.
+        for m in sorted(msgs):
+            if m[0] != "status":
+                continue
+            _kind, t, verdict, _r = m
+            rest = msgs - {m}
+            stale = phase != "wait" or t != token
+            if stale and mutation != "accept_stale_status":
+                yield (f"drop_stale_status(t{t})", mk(msgs=rest))
+                continue
+            if verdict == "flipped":
+                nflips = flips + 1
+                if nflips == WORLD:  # whole fleet flipped: commit
+                    ver = t + 1
+                    yield (f"commit(v{ver})",
+                           mk(pub=("idle", token, ver, 0, retired, attempts),
+                              msgs=rest))
+                else:
+                    yield (f"count_flip(t{t})",
+                           mk(pub=(phase, token, serving, nflips, retired,
+                                   attempts), msgs=rest))
+            else:  # one refusal aborts the attempt fleet-wide
+                yield (f"abort_on_refusal(t{t})",
+                       mk(pub=("idle", token, serving, 0, retired, attempts),
+                          msgs=rest))
+
+        # Publisher retires a superseded version on both ranks — only once
+        # no open session is pinned to it (the drain gate).
+        for v in range(serving):
+            if v in retired:
+                continue
+            if not any(v in res for res, _a, _t in ranks):
+                continue
+            if s_status == "open" and s_pin == v and mutation != "early_retire":
+                continue  # a pinned session still drains from v
+            yield (f"retire(v{v})",
+                   mk(pub=(phase, token, serving, flips, retired | {v},
+                           attempts),
+                      msgs=msgs | {("retire", v, r) for r in range(WORLD)}))
+
+        # Rank-side deliveries (any order).
+        for m in sorted(msgs):
+            rest = msgs - {m}
+            if m[0] == "begin":
+                _k, t, ver, r = m
+                res, active, last = ranks[r]
+                if t < last and mutation != "no_token_check":
+                    # Stale announce from an abandoned attempt: ignored.
+                    yield (f"r{r}.ignore_stale_begin(t{t})", mk(msgs=rest))
+                    continue
+                # Verify outcome is the environment's choice: ok flips,
+                # corrupt refuses (CRC mismatch -> aborted status).
+                v = viol
+                if ver < active and v is None:
+                    v = (f"rank {r} flipped BACKWARD to v{ver} from v{active} "
+                         f"(abandoned-attempt BEGIN committed)")
+                nranks = list(ranks)
+                nranks[r] = (res | {ver}, ver, max(last, t))
+                yield (f"r{r}.verify_ok(v{ver},t{t})",
+                       mk(msgs=rest | {("status", t, "flipped", r)},
+                          ranks=tuple(nranks), viol=v))
+                nranks2 = list(ranks)
+                nranks2[r] = (res, active, max(last, t))
+                yield (f"r{r}.verify_corrupt(v{ver},t{t})",
+                       mk(msgs=rest | {("status", t, "aborted", r)},
+                          ranks=tuple(nranks2)))
+            elif m[0] == "retire":
+                _k, v, r = m
+                res, active, last = ranks[r]
+                nv = viol
+                if v == active and nv is None:
+                    nv = f"rank {r} told to retire its ACTIVE version v{v}"
+                nranks = list(ranks)
+                nranks[r] = (res - {v}, active, last)
+                yield (f"r{r}.retire(v{v})",
+                       mk(msgs=rest, ranks=tuple(nranks), viol=nv))
+
+        # The one session: pinned at admission to the serving version, reads
+        # both ranks, then drains.
+        if s_status == "none":
+            yield ("session_open", mk(session=("open", serving, False, False)))
+        if s_status == "open":
+            for r, already in ((0, s_r0), (1, s_r1)):
+                if already:
+                    continue
+                res, _active, _last = ranks[r]
+                v = viol
+                if s_pin not in res and v is None:
+                    v = (f"session pinned to v{s_pin} cannot read it from "
+                         f"rank {r} (resident: {sorted(res)}) — mixed/retired "
+                         f"version visible to a live session")
+                yield (f"session_read(r{r})",
+                       mk(session=("open", s_pin, s_r0 or r == 0,
+                                   s_r1 or r == 1), viol=v))
+            if s_r0 and s_r1:
+                yield ("session_close",
+                       mk(session=("closed", s_pin, True, True)))
+
+    def invariant(state) -> str | None:
+        return state[4]
+
+    def done_fn(state) -> bool:
+        pub, msgs, _ranks, session, _viol = state
+        return pub[0] == "idle" and not msgs and session[0] != "open"
+
+    return Model(NAME, init_states, actions, invariant, done_fn)
+
+
+#: Seeded swap bugs.
+MUTATIONS = {
+    "accept_stale_status": "a flipped-STATUS from an abandoned attempt counts toward commit",
+    "no_token_check": "ranks process BEGIN frames from abandoned attempts — backward flip",
+    "early_retire": "retire ignores the session drain gate — pinned sessions lose their version",
+}

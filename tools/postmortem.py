@@ -39,6 +39,33 @@ import os
 import sys
 
 
+def _validate_dump(path: str, d: dict) -> None:
+    """Shape-check one dump so the analysis below can assume typed fields.
+    Dumps come from dying processes (partial writes, torn JSON recovered by
+    hand), so every field is hostile until proven; a malformed dump must be
+    a ValueError naming the file, not a TypeError three functions deeper
+    (found by tests/test_fuzz.py: a string rank broke the dump sort, a
+    string timestamp broke the stall arithmetic)."""
+    if not isinstance(d.get("rank", 0), int):
+        raise ValueError(f"{path}: rank {d.get('rank')!r} is not an integer")
+    events = d.get("events", [])
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: events is not a list")
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            raise ValueError(f"{path}: events[{i}] is not an object")
+        for k in ("t", "a", "b", "c", "d"):
+            v = ev.get(k)
+            if v is not None and not isinstance(v, (int, float)):
+                raise ValueError(
+                    f"{path}: events[{i}].{k} {v!r} is not a number")
+        for k in ("kind", "name"):
+            v = ev.get(k)
+            if v is not None and not isinstance(v, str):
+                raise ValueError(
+                    f"{path}: events[{i}].{k} {v!r} is not a string")
+
+
 def load_dumps(paths: list[str]) -> list[dict]:
     """Load flight-recorder dumps from explicit files and/or directories
     (directories are globbed for ``tpunet-flightrec-rank*.json``). Sorted
@@ -61,6 +88,7 @@ def load_dumps(paths: list[str]) -> list[dict]:
         if d.get("schema") != "tpunet-flightrec-v1":
             raise ValueError(f"{f}: not a tpunet-flightrec-v1 dump "
                              f"(schema={d.get('schema')!r})")
+        _validate_dump(f, d)
         d["_path"] = f
         dumps.append(d)
     dumps.sort(key=lambda d: d.get("rank", 0))

@@ -1,0 +1,371 @@
+"""Wire-contract registry checker: sources vs tools/protocol/spec.py.
+
+Fifth invariant lint registry (PR-4 pattern: pure ``check_*(root) ->
+list[str]``, wired into ``python -m tools.lint``). The spec is the single
+declarative statement of every byte the stack puts on a wire; this module
+extracts the constants the implementations *actually* compile/interpret and
+cross-checks both directions:
+
+  * preamble flag bits — unique, outside the QoS class nibble, spec-exact
+  * ctrl-frame opcodes — distinct top bytes above the length cut, and each
+    opcode's bit-field layout tiles into the low 56 bits without overlap
+  * bootstrap-blob offsets — tile the 16-byte blob with no overlap, and
+    every field is both written by the encode side (collectives.cc) and
+    read by the peer-validation side (wire.cc)
+  * one-byte wire enums (WireCodec, TrafficClass, CollAlgo, CollKind,
+    chaos actions) — C++ enumerator values byte-identical to the Python
+    mirrors that ride the same frames
+  * serve frames — struct formats and *sizes* (re-derived via
+    struct.calcsize) match the spec, frame types / roles / swap statuses
+    byte-identical
+
+Every comparison is two-sided: a constant added to a source file without a
+spec entry is as red as a spec entry the sources no longer honor.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import struct
+from pathlib import Path
+
+from tools.lint._util import read_text, strip_c_comments
+from tools.protocol import spec
+
+# ---- C++ extraction --------------------------------------------------------
+
+_FLAG = re.compile(r"constexpr\s+uint64_t\s+(kPreambleFlag\w+)\s*=\s*1ull\s*<<\s*(\d+)\s*;")
+_CLASS_SHIFT = re.compile(r"constexpr\s+int\s+kPreambleClassShift\s*=\s*(\d+)\s*;")
+_CLASS_MASK = re.compile(r"constexpr\s+uint64_t\s+kPreambleClassMask\s*=\s*0x([0-9a-fA-F]+)ull\s*<<\s*kPreambleClassShift\s*;")
+_MAGIC = re.compile(r"constexpr\s+uint64_t\s+kWireMagic\s*=\s*0x([0-9a-fA-F]+)ull\s*;")
+_U64_CONST = re.compile(r"constexpr\s+uint64_t\s+(k\w+)\s*=\s*(\d+)\s*;")
+_SIZE_CONST = re.compile(r"constexpr\s+size_t\s+(k\w+)\s*=\s*(\d+)\s*;")
+_OPCODE = re.compile(r"constexpr\s+uint8_t\s+(kCtrlFrame\w+)\s*=\s*0x([0-9a-fA-F]{2})\s*;")
+_MAX_CTRL = re.compile(r"constexpr\s+uint64_t\s+kMaxCtrlLen\s*=\s*1ull\s*<<\s*(\d+)\s*;")
+_INT_COUNT = re.compile(r"constexpr\s+int\s+(k\w+Count)\s*=\s*(\d+)\s*;")
+
+
+def _cpp_enum(text: str, name: str) -> dict[str, int] | None:
+    """Extract ``enum class <name> : ... { ... }`` as {enumerator: value},
+    handling implicit increments. None when the enum is absent."""
+    m = re.search(r"enum\s+class\s+" + re.escape(name) + r"\s*(?::\s*\w+)?\s*\{([^}]*)\}", text)
+    if not m:
+        return None
+    out: dict[str, int] = {}
+    nxt = 0
+    for part in m.group(1).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        em = re.match(r"(\w+)\s*(?:=\s*(\d+))?$", part)
+        if not em:
+            return None  # unparseable enumerator (expression initializer)
+        nxt = int(em.group(2)) if em.group(2) is not None else nxt
+        out[em.group(1)] = nxt
+        nxt += 1
+    return out
+
+
+# ---- Python extraction -----------------------------------------------------
+
+def _py_assigns(text: str, pattern: str) -> dict[str, int]:
+    """{name: int} for module-level ``NAME = <int>`` lines matching pattern."""
+    out = {}
+    for m in re.finditer(r"(?m)^(" + pattern + r")\s*=\s*(\d+)\b", text):
+        out[m.group(1)] = int(m.group(2))
+    return out
+
+
+def _py_struct_fmts(text: str) -> dict[str, str]:
+    return dict(re.findall(r'(?m)^(_\w+)\s*=\s*struct\.Struct\("([^"]+)"\)', text))
+
+
+def _py_dict_literal(text: str, name: str):
+    """literal_eval a single-line ``NAME = {...}`` assignment; None if absent."""
+    m = re.search(r"(?m)^" + re.escape(name) + r"\s*=\s*(\{[^}]*\})", text)
+    if not m:
+        return None
+    try:
+        return ast.literal_eval(m.group(1))
+    except (ValueError, SyntaxError):
+        return None
+
+
+# ---- comparisons -----------------------------------------------------------
+
+def _diff(out: list[str], what: str, actual: dict, want: dict) -> None:
+    """Two-sided dict comparison with per-key value check."""
+    for k in sorted(set(want) - set(actual)):
+        out.append(f"{what}: spec entry {k!r} not found in source")
+    for k in sorted(set(actual) - set(want)):
+        out.append(f"{what}: source defines {k!r} = {actual[k]!r} with no spec entry "
+                   f"(add it to tools/protocol/spec.py)")
+    for k in sorted(set(actual) & set(want)):
+        if actual[k] != want[k]:
+            out.append(f"{what}: {k!r} is {actual[k]!r} in source but {want[k]!r} in spec")
+
+
+def _check_wire_h(root: Path, out: list[str]) -> None:
+    path = root / "cpp/src/wire.h"
+    if not path.is_file():
+        out.append("protocol: cpp/src/wire.h not found")
+        return
+    text = strip_c_comments(read_text(path))
+
+    # Preamble flags: spec-exact, unique bits, clear of the class nibble.
+    flags = {name: int(bit) for name, bit in _FLAG.findall(text)}
+    _diff(out, "preamble flags (wire.h)", flags, spec.PREAMBLE_FLAGS)
+    by_bit: dict[int, str] = {}
+    for name, bit in sorted(flags.items()):
+        if bit in by_bit:
+            out.append(f"preamble flags: {name} collides with {by_bit[bit]} on bit {bit}")
+        by_bit[bit] = name
+    nibble = range(spec.PREAMBLE_CLASS_SHIFT,
+                   spec.PREAMBLE_CLASS_SHIFT + spec.PREAMBLE_CLASS_BITS)
+    for name, bit in sorted(flags.items()):
+        if bit in nibble:
+            out.append(f"preamble flags: {name} (bit {bit}) lands inside the QoS "
+                       f"class nibble bits {nibble.start}..{nibble.stop - 1}")
+    m = _CLASS_SHIFT.search(text)
+    if not m or int(m.group(1)) != spec.PREAMBLE_CLASS_SHIFT:
+        out.append(f"preamble: kPreambleClassShift != spec {spec.PREAMBLE_CLASS_SHIFT}")
+    m = _CLASS_MASK.search(text)
+    want_mask = (1 << spec.PREAMBLE_CLASS_BITS) - 1
+    if not m or int(m.group(1), 16) != want_mask:
+        out.append(f"preamble: kPreambleClassMask nibble != spec 0x{want_mask:X} << shift")
+
+    # Magic + geometry.
+    m = _MAGIC.search(text)
+    if not m or int(m.group(1), 16) != spec.WIRE_MAGIC:
+        out.append(f"preamble: kWireMagic != spec 0x{spec.WIRE_MAGIC:016x}")
+    elif (spec.WIRE_MAGIC & 0xFF) != spec.WIRE_VERSION:
+        out.append("preamble: WIRE_MAGIC low byte disagrees with spec WIRE_VERSION")
+    sizes = {n: int(v) for n, v in _SIZE_CONST.findall(text)}
+    u64s = {n: int(v) for n, v in _U64_CONST.findall(text)}
+    if sizes.get("kPreambleBytes") != spec.PREAMBLE_BYTES:
+        out.append(f"preamble: kPreambleBytes {sizes.get('kPreambleBytes')} != spec {spec.PREAMBLE_BYTES}")
+    if spec.PREAMBLE_BYTES != 8 * len(spec.PREAMBLE_FIELDS):
+        out.append("preamble: spec PREAMBLE_BYTES != 8 * len(PREAMBLE_FIELDS)")
+    if u64s.get("kMaxStreams") != spec.MAX_STREAMS:
+        out.append(f"preamble: kMaxStreams {u64s.get('kMaxStreams')} != spec {spec.MAX_STREAMS}")
+
+    # Ctrl-frame opcodes: spec-exact, distinct, strictly above the length cut.
+    ops = {name: int(v, 16) for name, v in _OPCODE.findall(text)}
+    _diff(out, "ctrl opcodes (wire.h)", ops, spec.CTRL_OPCODES)
+    seen: dict[int, str] = {}
+    for name, v in sorted(ops.items()):
+        if v in seen:
+            out.append(f"ctrl opcodes: {name} collides with {seen[v]} on 0x{v:02X}")
+        seen[v] = name
+        if v == 0:
+            out.append(f"ctrl opcodes: {name} top byte 0 — indistinguishable from a length frame")
+    m = _MAX_CTRL.search(text)
+    if not m or int(m.group(1)) != spec.MAX_CTRL_LEN_BITS:
+        out.append(f"ctrl frames: kMaxCtrlLen != spec 1 << {spec.MAX_CTRL_LEN_BITS}")
+
+    # Ctrl bit-field layouts: per-opcode fields tile below the opcode byte
+    # with no overlap, and the decode masks/shifts appear in wire.h.
+    if set(spec.CTRL_LAYOUTS) != set(spec.CTRL_OPCODES):
+        out.append("ctrl frames: spec CTRL_LAYOUTS keys != CTRL_OPCODES keys")
+    for op, fields in sorted(spec.CTRL_LAYOUTS.items()):
+        used = 0
+        for fname, (low, width) in sorted(fields.items()):
+            if low + width > spec.MAX_CTRL_LEN_BITS:
+                out.append(f"ctrl layout {op}.{fname}: bits {low}..{low + width - 1} "
+                           f"spill into the opcode byte")
+            mask = ((1 << width) - 1) << low
+            if used & mask:
+                out.append(f"ctrl layout {op}.{fname}: overlaps another field")
+            used |= mask
+            field_mask = (1 << width) - 1
+            if f"0x{field_mask:x}" not in text.lower():
+                out.append(f"ctrl layout {op}.{fname}: mask 0x{field_mask:x} not found "
+                           f"in wire.h — decode layout drifted from spec")
+            if low and f">> {low}" not in text:
+                out.append(f"ctrl layout {op}.{fname}: shift '>> {low}' not found "
+                           f"in wire.h — decode layout drifted from spec")
+    ws = spec.CTRL_LAYOUTS.get("kCtrlFrameWeights", {}).get("nstreams")
+    if ws and (1 << ws[1]) <= spec.MAX_STREAMS:
+        out.append("ctrl layout kCtrlFrameWeights.nstreams: field cannot represent "
+                   f"MAX_STREAMS == {spec.MAX_STREAMS}")
+
+    # Bootstrap blob: spec-exact offsets that tile the blob without overlap.
+    blob = {n: v for n, v in sizes.items() if n.startswith("kBlobOff")}
+    _diff(out, "bootstrap blob (wire.h)",
+          blob, {n: off for n, (off, _w) in spec.BOOTSTRAP_BLOB.items()})
+    if sizes.get("kBootstrapBlobLen") != spec.BOOTSTRAP_BLOB_LEN:
+        out.append(f"bootstrap blob: kBootstrapBlobLen != spec {spec.BOOTSTRAP_BLOB_LEN}")
+    taken: dict[int, str] = {}
+    for name, (off, width) in sorted(spec.BOOTSTRAP_BLOB.items()):
+        if off + width > spec.BOOTSTRAP_BLOB_LEN:
+            out.append(f"bootstrap blob: {name} bytes {off}..{off + width - 1} "
+                       f"exceed the {spec.BOOTSTRAP_BLOB_LEN}-byte blob")
+        for b in range(off, min(off + width, spec.BOOTSTRAP_BLOB_LEN)):
+            if b in taken:
+                out.append(f"bootstrap blob: {name} overlaps {taken[b]} at byte {b}")
+                break
+            taken[b] = name
+
+
+def _check_blob_use(root: Path, out: list[str]) -> None:
+    """Every blob field must be written (collectives.cc encode) and read
+    (wire.cc CheckPeerBootstrapBlob) by NAME — a field encoded via a raw
+    offset is invisible to refactors and to this lint."""
+    enc = root / "cpp/src/collectives.cc"
+    dec = root / "cpp/src/wire.cc"
+    enc_text = strip_c_comments(read_text(enc)) if enc.is_file() else ""
+    dec_text = strip_c_comments(read_text(dec)) if dec.is_file() else ""
+    if not enc_text:
+        out.append("protocol: cpp/src/collectives.cc not found")
+    if not dec_text:
+        out.append("protocol: cpp/src/wire.cc not found")
+    for name in sorted(spec.BOOTSTRAP_BLOB):
+        if enc_text and name not in enc_text:
+            out.append(f"bootstrap blob: {name} never used by the encode side "
+                       f"(collectives.cc) — dead or raw-offset-encoded field")
+        # HostId is gathered for topology, not peer-validated; every config
+        # field must be checked against the peer's in wire.cc.
+        if dec_text and name != "kBlobOffHostId" and name not in dec_text:
+            out.append(f"bootstrap blob: {name} never read by CheckPeerBootstrapBlob "
+                       f"(wire.cc) — peers would not detect a mismatch")
+
+
+_ENUM_SITES = (
+    # (enum name, file, spec table, count constant or None)
+    ("WireCodec", "cpp/include/tpunet/utils.h", "WIRE_CODEC_ENUM", "kWireCodecCount"),
+    ("TrafficClass", "cpp/include/tpunet/qos.h", "TRAFFIC_CLASS_ENUM", "kTrafficClassCount"),
+    ("CollAlgo", "cpp/src/dispatch.h", "COLL_ALGO_ENUM", "kCollAlgoCount"),
+    ("CollKind", "cpp/src/dispatch.h", "COLL_KIND_ENUM", "kCollKindCount"),
+    ("FaultAction", "cpp/src/fault.h", "FAULT_ACTION_ENUM", None),
+    ("ChurnAction", "cpp/src/fault.h", "CHURN_ACTION_ENUM", None),
+    ("SwapAction", "cpp/src/fault.h", "SWAP_ACTION_ENUM", None),
+)
+
+
+def _check_cpp_enums(root: Path, out: list[str]) -> None:
+    for enum_name, rel, table, count_name in _ENUM_SITES:
+        path = root / rel
+        if not path.is_file():
+            out.append(f"protocol: {rel} not found")
+            continue
+        text = strip_c_comments(read_text(path))
+        actual = _cpp_enum(text, enum_name)
+        want = getattr(spec, table)
+        if actual is None:
+            out.append(f"wire enum {enum_name}: not found (or unparseable) in {rel}")
+            continue
+        _diff(out, f"wire enum {enum_name} ({rel})", actual, want)
+        if count_name:
+            counts = {n: int(v) for n, v in _INT_COUNT.findall(text)}
+            if counts.get(count_name) != len(want):
+                out.append(f"wire enum {enum_name}: {count_name} "
+                           f"{counts.get(count_name)} != spec count {len(want)}")
+
+
+def _check_serve_protocol(root: Path, out: list[str]) -> None:
+    path = root / "tpunet/serve/protocol.py"
+    if not path.is_file():
+        out.append("protocol: tpunet/serve/protocol.py not found")
+        return
+    text = read_text(path)
+
+    m = re.search(r'(?m)^MAGIC\s*=\s*b"(\w+)"', text)
+    if not m or m.group(1).encode() != spec.SERVE_MAGIC:
+        out.append(f"serve frames: MAGIC != spec {spec.SERVE_MAGIC!r}")
+    vers = _py_assigns(text, "VERSION")
+    if vers.get("VERSION") != spec.SERVE_VERSION:
+        out.append(f"serve frames: VERSION {vers.get('VERSION')} != spec {spec.SERVE_VERSION}")
+
+    types = _py_assigns(text, r"T_\w+")
+    _diff(out, "serve frame types (protocol.py)", types, spec.SERVE_FRAME_TYPES)
+    by_val: dict[int, str] = {}
+    for name, v in sorted(types.items()):
+        if v in by_val:
+            out.append(f"serve frame types: {name} collides with {by_val[v]} on {v}")
+        by_val[v] = name
+    _diff(out, "serve roles (protocol.py)",
+          _py_assigns(text, r"ROLE_\w+"), spec.SERVE_ROLES)
+    _diff(out, "swap status (protocol.py)",
+          _py_assigns(text, r"SWAP_(?:FLIPPED|ABORTED)"), spec.SWAP_STATUS)
+
+    fmts = _py_struct_fmts(text)
+    want_fmts = {n: f for n, (f, _s) in spec.SERVE_STRUCTS.items()}
+    _diff(out, "serve structs (protocol.py)", fmts, want_fmts)
+    for name, (fmt, size) in sorted(spec.SERVE_STRUCTS.items()):
+        try:
+            actual_size = struct.calcsize(fmt)
+        except struct.error:
+            out.append(f"serve structs: spec format {fmt!r} for {name} is invalid")
+            continue
+        if actual_size != size:
+            out.append(f"serve structs: {name} format {fmt!r} is {actual_size}B "
+                       f"on the wire but spec claims {size}B")
+    for name in ("_HEADER", "_HELLO"):
+        fmt = fmts.get(name, "")
+        if fmt and not fmt.startswith("<4s"):
+            out.append(f"serve structs: {name} does not lead with the 4-byte magic")
+
+    # Cross-language byte identity: the Python codec/class ids ride the same
+    # frames the C++ enums define.
+    codec_ids = _py_dict_literal(text, "_CODEC_IDS")
+    if codec_ids != spec.WIRE_CODEC_IDS:
+        out.append(f"serve frames: _CODEC_IDS {codec_ids!r} != spec {spec.WIRE_CODEC_IDS!r}")
+    if sorted(spec.WIRE_CODEC_IDS.values()) != sorted(spec.WIRE_CODEC_ENUM.values()):
+        out.append("wire codec: spec Python ids and C++ enum values are not the same set")
+    class_ids = _py_dict_literal(text, "_CLASS_IDS")
+    if class_ids != spec.TRAFFIC_CLASS_IDS:
+        out.append(f"serve frames: _CLASS_IDS {class_ids!r} != spec {spec.TRAFFIC_CLASS_IDS!r}")
+    if sorted(spec.TRAFFIC_CLASS_IDS.values()) != sorted(spec.TRAFFIC_CLASS_ENUM.values()):
+        out.append("traffic class: spec Python ids and C++ enum values are not the same set")
+
+
+def _check_chaos_grammar(root: Path, out: list[str]) -> None:
+    fault_cc = root / "cpp/src/fault.cc"
+    if not fault_cc.is_file():
+        out.append("protocol: cpp/src/fault.cc not found")
+        cc_strings: set[str] = set()
+    else:
+        cc_strings = set(re.findall(r'"(\w+)"', strip_c_comments(read_text(fault_cc))))
+        for tok in (spec.FAULT_ACTION_TOKENS + spec.CHURN_ACTION_TOKENS
+                    + spec.SWAP_ACTION_TOKENS + ("churn", "swap")):
+            if tok not in cc_strings:
+                out.append(f"chaos grammar: token {tok!r} not accepted by fault.cc")
+
+    # Python mirrors map wire enum value -> token; both sides must agree with
+    # the C++ enum AND the token list.
+    for rel, name, enum_table, tokens in (
+        ("tpunet/elastic.py", "_CHURN_ACTIONS", spec.CHURN_ACTION_ENUM,
+         spec.CHURN_ACTION_TOKENS),
+        ("tpunet/serve/publish.py", "_SWAP_ACTIONS", spec.SWAP_ACTION_ENUM,
+         spec.SWAP_ACTION_TOKENS),
+    ):
+        path = root / rel
+        if not path.is_file():
+            out.append(f"protocol: {rel} not found")
+            continue
+        mapping = _py_dict_literal(read_text(path), name)
+        if not isinstance(mapping, dict):
+            out.append(f"chaos grammar: {name} not found in {rel}")
+            continue
+        # Expected value->token from the spec enum: kKill=1 <-> "kill".
+        want = {0: None}
+        for ename, val in enum_table.items():
+            if val:
+                want[val] = ename[1:].lower()
+        if mapping != want:
+            out.append(f"chaos grammar: {rel} {name} {mapping!r} != C++ enum layout {want!r}")
+        got_tokens = tuple(v for _k, v in sorted(mapping.items()) if v)
+        if got_tokens != tokens:
+            out.append(f"chaos grammar: {rel} tokens {got_tokens!r} != spec {tokens!r}")
+
+
+def check_protocol(root: Path) -> list[str]:
+    """Cross-check every wire contract against tools/protocol/spec.py."""
+    out: list[str] = []
+    _check_wire_h(root, out)
+    _check_blob_use(root, out)
+    _check_cpp_enums(root, out)
+    _check_serve_protocol(root, out)
+    _check_chaos_grammar(root, out)
+    return out

@@ -1,0 +1,125 @@
+"""The tpunet wire-contract registry — one declarative spec, machine-checked.
+
+Every constant here is the *claimed* shape of a wire contract the stack
+speaks; ``tools/protocol/__init__.py`` extracts the *actual* constants from
+the C++ and Python sources and cross-checks both directions, so a drifted
+byte layout (or a spec gone stale) is a red lint lane, not a fleet desync.
+
+The registry is data, not code: editing a protocol is a one-place edit here
+plus the implementation — the checker proves the two agree. docs/DESIGN.md
+"Protocol registry & model checking" documents how to add an entry.
+"""
+
+from __future__ import annotations
+
+# ---- v3 connection preamble (cpp/src/wire.h) -------------------------------
+# [magic | bundle_id | stream_id | nstreams | min_chunksize | flags],
+# all big-endian u64.
+WIRE_MAGIC = 0x7470756E65743103  # "tpunet" + framing version byte (v3)
+WIRE_VERSION = 3
+PREAMBLE_BYTES = 48
+PREAMBLE_FIELDS = (
+    "magic", "bundle_id", "stream_id", "nstreams", "min_chunksize", "flags",
+)
+MAX_STREAMS = 256
+
+# Preamble flags word: single-bit capabilities (low bits) plus the QoS
+# traffic-class nibble at bits 8..11 (valid only with the Qos flag).
+PREAMBLE_FLAGS = {  # name in wire.h -> bit index
+    "kPreambleFlagCrc": 0,
+    "kPreambleFlagQos": 1,
+    "kPreambleFlagLanes": 2,
+    "kPreambleFlagShm": 3,
+}
+PREAMBLE_CLASS_SHIFT = 8
+PREAMBLE_CLASS_BITS = 4
+
+# ---- ctrl-stream frame vocabulary (cpp/src/wire.h) -------------------------
+# A raw u64 < 2^MAX_CTRL_LEN_BITS is a message length; reserved top bytes
+# are transport control frames.
+MAX_CTRL_LEN_BITS = 56
+CTRL_OPCODES = {  # name in wire.h -> top byte
+    "kCtrlFrameWeights": 0xFC,
+    "kCtrlFrameNack": 0xFD,
+    "kCtrlFrameFailover": 0xFE,
+}
+# Bit-field layout per opcode: field -> (low bit, width). NACK/FAILOVER pack
+# via PackCtrlFrame (stream in bits 48..55, arg in 0..47); WEIGHTS packs via
+# PackWeightsFrame (stream count in 32..47 — 8 bits cannot hold
+# MAX_STREAMS == 256 — epoch in 0..31).
+CTRL_LAYOUTS = {
+    "kCtrlFrameNack": {"stream": (48, 8), "confirmed_seq": (0, 48)},
+    "kCtrlFrameFailover": {"stream": (48, 8), "unit_count": (0, 48)},
+    "kCtrlFrameWeights": {"nstreams": (32, 16), "epoch": (0, 32)},
+}
+
+# ---- collective bootstrap blob (wire.h offsets, collectives.cc use) --------
+# The 16-byte per-rank unit of the schedule-config AllGather. Offsets and
+# widths must tile the blob with no overlap; every field must be written by
+# the encode side AND read by the peer-validation side.
+BOOTSTRAP_BLOB_LEN = 16
+BOOTSTRAP_BLOB = {  # wire.h constant -> (offset, width in bytes)
+    "kBlobOffCodec": (0, 1),
+    "kBlobOffAlgo": (1, 1),
+    "kBlobOffTableCrc": (2, 4),
+    "kBlobOffQosClass": (6, 1),
+    "kBlobOffA2aAlgo": (7, 1),
+    "kBlobOffHostId": (8, 8),
+}
+
+# ---- one-byte wire enums (cross the preamble nibble / bootstrap blob /
+# serve frames; C++ definition and Python mirror must be byte-identical) ----
+WIRE_CODEC_ENUM = {"kF32": 0, "kBF16": 1, "kI8": 2}      # utils.h WireCodec
+WIRE_CODEC_IDS = {"f32": 0, "bf16": 1, "int8": 2}        # protocol.py mirror
+TRAFFIC_CLASS_ENUM = {"kLatency": 0, "kBulk": 1, "kControl": 2}  # qos.h
+TRAFFIC_CLASS_IDS = {"latency": 0, "bulk": 1, "control": 2}      # protocol.py
+COLL_ALGO_ENUM = {  # dispatch.h CollAlgo — rides the blob as one byte
+    "kAuto": 0, "kRing": 1, "kRhd": 2, "kTree": 3, "kHier": 4,
+    "kHierA2a": 5, "kPairwise": 6,
+}
+COLL_KIND_ENUM = {"kAllReduce": 0, "kBroadcast": 1, "kAllToAll": 2}
+
+# ---- serving-tier frames (tpunet/serve/protocol.py) ------------------------
+SERVE_MAGIC = b"TPKV"
+SERVE_VERSION = 1
+SERVE_FRAME_TYPES = {
+    "T_BLOCK": 1,
+    "T_FIRST": 2,
+    "T_RESULT": 3,
+    "T_SHUTDOWN": 4,
+    "T_SWAP_BEGIN": 5,
+    "T_SWAP_STATUS": 6,
+    "T_SWAP_RETIRE": 7,
+}
+SERVE_ROLES = {"ROLE_FRONTEND": 0, "ROLE_DECODE": 1}
+# struct name in protocol.py -> (format, size in bytes). Sizes are stated
+# redundantly on purpose: struct.calcsize re-derives them at check time, so
+# a format edit that silently changes a frame size turns the lane red until
+# the spec (and every peer) acknowledges the new layout.
+SERVE_STRUCTS = {
+    "_HEADER": ("<4sHHQII", 24),      # magic, version, type, req_id, body_len, aux
+    "_HELLO": ("<4sHBBIIIIQ", 32),    # magic, version, role, codec, slots,
+                                      # max_len, vocab, class|version<<8, model_sig
+    "_BLOCK_HDR": ("<IIIIB3x", 20),   # plen, max_new, n_kv, vocab, codec
+    "_RESULT_HDR": ("<IIQ", 16),      # ntok, status, tpot_us
+    "_SWAP_HDR": ("<IIIQIBBI", 30),   # version, world, rank, nelems,
+                                      # chunk_bytes, codec, class, timeout_ms
+}
+SWAP_STATUS = {"SWAP_FLIPPED": 1, "SWAP_ABORTED": 2}
+# The HELLO traffic-class word carries the weight version in its upper 24
+# bits (class in the low byte) — the mixed-build interop contract.
+HELLO_WEIGHT_VERSION_SHIFT = 8
+
+# ---- chaos grammar actions (fault.{h,cc} + the Python mirrors) -------------
+FAULT_ACTION_ENUM = {  # fault.h FaultAction
+    "kNone": 0, "kClose": 1, "kStall": 2, "kCorrupt": 3, "kDelay": 4,
+}
+CHURN_ACTION_ENUM = {"kNone": 0, "kKill": 1, "kJoin": 2}
+SWAP_ACTION_ENUM = {"kNone": 0, "kPublish": 1, "kCorrupt": 2, "kDie": 3}
+FAULT_ACTION_TOKENS = ("close", "stall", "corrupt", "delay")
+CHURN_ACTION_TOKENS = ("kill", "join")   # mirrored by tpunet/elastic.py
+SWAP_ACTION_TOKENS = ("publish", "corrupt", "die")  # tpunet/serve/publish.py
+
+# Error-code wire constants (TPUNET_ERR_* <-> typed Python exceptions) are a
+# registry of their own: tools/lint/errcodes.py checks them; this spec does
+# not restate the table.

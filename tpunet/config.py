@@ -243,6 +243,11 @@ class Config:
     # Observability (reference: BAGUA_NET_JAEGER_ADDRESS nthread:113,
     # BAGUA_NET_PROMETHEUS_ADDRESS nthread:184-185). Empty = disabled.
     trace_dir: str = ""
+    # Flight-recorder dump directory override (empty = TPUNET_TRACE_DIR,
+    # then the CWD). Dump routing ONLY — unlike trace_dir it does not enable
+    # span tracing, so test harnesses point verdict dumps at a tmp dir
+    # without changing telemetry behavior.
+    flightrec_dir: str = ""
     metrics_addr: str = ""
     # On-demand /metrics scrape listener port (0 = disabled). Each rank needs
     # its own port; first binder wins on a shared one.
@@ -476,6 +481,7 @@ class Config:
             rank=_env_int("TPUNET_RANK", _env_int("RANK", 0)),
             world_size=_env_int("TPUNET_WORLD_SIZE", _env_int("WORLD_SIZE", 1)),
             trace_dir=env.get("TPUNET_TRACE_DIR", ""),
+            flightrec_dir=env.get("TPUNET_FLIGHTREC_DIR", ""),
             metrics_addr=env.get("TPUNET_METRICS_ADDR", os.environ.get("TPUNET_PROMETHEUS_ADDRESS", "")),
             # The native listener ignores ports >= 65536 silently; the config
             # layer names the bad var instead (PR-1 validator style).

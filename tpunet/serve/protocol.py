@@ -154,6 +154,9 @@ class Hello:
 
     @staticmethod
     def unpack(raw: bytes) -> "Hello":
+        if len(raw) != _HELLO.size:
+            raise TierProtocolError(
+                f"tier hello is {len(raw)}B, want {_HELLO.size}B")
         magic, ver, role, codec, slots, max_len, vocab, cls, sig = \
             _HELLO.unpack(raw)
         if magic != MAGIC:
@@ -359,6 +362,13 @@ def unpack_block(payload: bytes, codec: str):
             f"BLOCK frame codec {_CODEC_NAMES.get(codec_id, codec_id)!r} != "
             f"wiring-negotiated {codec!r}")
     off = _BLOCK_HDR.size
+    # Counts come off the wire: bound them against the actual payload BEFORE
+    # np.frombuffer, whose "buffer is smaller than requested size" ValueError
+    # is not a typed protocol error (found by tests/test_fuzz.py).
+    if len(payload) - off < 4 * (plen + vocab):
+        raise TierProtocolError(
+            f"BLOCK sub-header claims {plen} prompt + {vocab} logit words "
+            f"but only {len(payload) - off}B of payload follow")
     prompt = np.frombuffer(payload, np.int32, plen, off)
     off += 4 * plen
     logits = np.frombuffer(payload, np.float32, vocab, off)
@@ -381,6 +391,10 @@ def unpack_result(payload: bytes):
     if len(payload) < _RESULT_HDR.size:
         raise TierProtocolError("RESULT payload shorter than its sub-header")
     ntok, status, tpot_us = _RESULT_HDR.unpack(payload[:_RESULT_HDR.size])
+    if len(payload) - _RESULT_HDR.size < 4 * ntok:
+        raise TierProtocolError(
+            f"RESULT sub-header claims {ntok} tokens but only "
+            f"{len(payload) - _RESULT_HDR.size}B of payload follow")
     tokens = np.frombuffer(payload, np.int32, ntok, _RESULT_HDR.size)
     return tokens, status, tpot_us
 
